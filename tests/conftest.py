@@ -1,0 +1,49 @@
+"""Test config: force CPU with 8 virtual devices BEFORE jax is imported.
+
+Distributed logic is tested on a virtual CPU mesh, as the reference tests
+its distributed trainer on the in-process MULTI_THREAD backend
+(ydf/learner/.../distributed_gradient_boosted_trees_test.cc:62-70).
+"""
+
+import os
+
+# Hard override: the environment presets JAX_PLATFORMS=axon (the TPU
+# tunnel); tests must run on the virtual CPU mesh. Some pytest plugins
+# (jaxtyping) import jax before this conftest, baking the env value into
+# jax.config — so override the config too, not just the env var.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+REFERENCE_DATASET_DIR = "/root/reference/yggdrasil_decision_forests/test_data/dataset"
+
+
+@pytest.fixture(scope="session")
+def adult_train():
+    import pandas as pd
+
+    return pd.read_csv(os.path.join(REFERENCE_DATASET_DIR, "adult_train.csv"))
+
+
+@pytest.fixture(scope="session")
+def adult_test():
+    import pandas as pd
+
+    return pd.read_csv(os.path.join(REFERENCE_DATASET_DIR, "adult_test.csv"))
+
+
+@pytest.fixture(scope="session")
+def abalone():
+    import pandas as pd
+
+    return pd.read_csv(os.path.join(REFERENCE_DATASET_DIR, "abalone.csv"))

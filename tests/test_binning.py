@@ -1,0 +1,56 @@
+import numpy as np
+
+from ydf_tpu.dataset.binning import Binner
+from ydf_tpu.dataset.dataset import Dataset
+
+
+def test_exact_binning_small_uniques():
+    data = {"x": np.array([1.0, 1.0, 2.0, 3.0, 3.0, 10.0]), "c": np.array(["a"] * 6)}
+    ds = Dataset.from_data(data, min_vocab_frequency=1)
+    binner = Binner.fit(ds, ["x", "c"], num_bins=256)
+    bins = binner.transform(ds)
+    # 4 uniques → 3 midpoint boundaries → bins 0..3
+    np.testing.assert_array_equal(bins[:, 0], [0, 0, 1, 2, 2, 3])
+    # threshold semantics: bin <= t  ⇔  v < boundaries[t]
+    assert binner.boundaries[0, 0] == 1.5
+    assert binner.boundaries[0, 1] == 2.5
+    assert binner.boundaries[0, 2] == 6.5
+
+
+def test_quantile_binning_many_uniques():
+    rng = np.random.RandomState(0)
+    vals = rng.normal(size=10000)
+    ds = Dataset.from_data({"x": vals, "y": vals})
+    binner = Binner.fit(ds, ["x"], num_bins=256)
+    bins = binner.transform(ds)
+    assert bins[:, 0].max() == 255
+    counts = np.bincount(bins[:, 0], minlength=256)
+    # Quantile bins are roughly balanced.
+    assert counts.max() < 5 * counts.mean()
+
+
+def test_missing_numerical_imputed_to_mean_bin():
+    data = {"x": np.array([0.0, 1.0, 2.0, 3.0, 4.0, np.nan])}
+    ds = Dataset.from_data(data)
+    binner = Binner.fit(ds, ["x"], num_bins=256)
+    bins = binner.transform(ds)
+    # mean of non-missing = 2.0 → same bin as the value 2.0
+    assert bins[5, 0] == bins[2, 0]
+
+
+def test_categorical_bins_are_vocab_indices():
+    data = {"c": np.array(["b", "a", "a", "zz", "b", "a"])}
+    ds = Dataset.from_data(data, min_vocab_frequency=2)
+    binner = Binner.fit(ds, ["c"], num_bins=256)
+    bins = binner.transform(ds)
+    col = ds.dataspec.column_by_name("c")
+    assert col.vocabulary == ["<OOD>", "a", "b"]
+    np.testing.assert_array_equal(bins[:, 0], [2, 1, 1, 0, 2, 1])
+
+
+def test_binner_json_roundtrip():
+    data = {"x": np.arange(100.0), "c": np.array(["a", "b"] * 50)}
+    ds = Dataset.from_data(data, min_vocab_frequency=1)
+    binner = Binner.fit(ds, ["x", "c"])
+    b2 = Binner.from_json(binner.to_json())
+    np.testing.assert_array_equal(b2.transform(ds), binner.transform(ds))

@@ -1,0 +1,54 @@
+import numpy as np
+
+from ydf_tpu.dataset.dataspec import (
+    ColumnType,
+    DataSpecification,
+    infer_column,
+    infer_dataspec,
+)
+
+
+def test_numerical_inference():
+    col = infer_column("x", np.array([1.0, 2.0, np.nan, 4.0]))
+    assert col.type == ColumnType.NUMERICAL
+    assert col.num_missing == 1
+    assert abs(col.mean - 7.0 / 3) < 1e-6
+    assert col.min_value == 1.0 and col.max_value == 4.0
+
+
+def test_categorical_dictionary_order_and_oov():
+    values = np.array(["b"] * 5 + ["a"] * 5 + ["c"] * 3 + ["rare"] * 1)
+    col = infer_column("c", values, min_vocab_frequency=2)
+    # index 0 reserved for OOV; ties broken lexicographically; rare pruned
+    assert col.vocabulary == ["<OOD>", "a", "b", "c"]
+    assert col.vocab_counts == [1, 5, 5, 3]
+
+
+def test_max_vocab_count():
+    values = np.array(sum([[f"v{i}"] * (i + 1) for i in range(10)], []))
+    col = infer_column("c", values, min_vocab_frequency=1, max_vocab_count=3)
+    assert len(col.vocabulary) == 4  # OOV + 3
+    assert col.vocabulary[1] == "v9"  # most frequent first
+
+
+def test_boolean_column():
+    col = infer_column("b", np.array([True, False, True]))
+    assert col.type == ColumnType.BOOLEAN
+
+
+def test_label_keeps_all_classes():
+    data = {
+        "f": np.arange(20.0),
+        "y": np.array(["pos"] * 18 + ["neg"] * 2),
+    }
+    spec = infer_dataspec(data, label="y")
+    ycol = spec.column_by_name("y")
+    assert ycol.vocabulary == ["<OOD>", "pos", "neg"]
+
+
+def test_json_roundtrip():
+    data = {"f": np.arange(10.0), "c": np.array(["a", "b"] * 5)}
+    spec = infer_dataspec(data, min_vocab_frequency=1)
+    spec2 = DataSpecification.from_json(spec.to_json())
+    assert spec2.column_by_name("c").vocabulary == spec.column_by_name("c").vocabulary
+    assert spec2.column_by_name("f").mean == spec.column_by_name("f").mean

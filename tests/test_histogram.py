@@ -1,0 +1,47 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ydf_tpu.ops.histogram import histogram
+
+
+def _ref_histogram(bins, slot, stats, L, B):
+    n, F = bins.shape
+    S = stats.shape[1]
+    out = np.zeros((L, F, B, S), np.float64)
+    for i in range(n):
+        if slot[i] >= L:
+            continue
+        for f in range(F):
+            out[slot[i], f, bins[i, f]] += stats[i]
+    return out
+
+
+@pytest.mark.parametrize("impl", ["segment", "matmul"])
+def test_histogram_matches_reference(impl):
+    rng = np.random.RandomState(0)
+    n, F, L, B, S = 500, 4, 8, 16, 3
+    bins = rng.randint(0, B, size=(n, F)).astype(np.uint8)
+    slot = rng.randint(0, L + 1, size=n).astype(np.int32)  # L = inactive
+    stats = rng.normal(size=(n, S)).astype(np.float32)
+    got = histogram(
+        jnp.asarray(bins), jnp.asarray(slot), jnp.asarray(stats),
+        num_slots=L, num_bins=B, impl=impl,
+    )
+    want = _ref_histogram(bins, slot, stats, L, B)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("impl", ["segment", "matmul"])
+def test_histogram_chunking(impl):
+    rng = np.random.RandomState(1)
+    n, F, L, B, S = 1000, 2, 4, 8, 2
+    bins = rng.randint(0, B, size=(n, F)).astype(np.uint8)
+    slot = rng.randint(0, L, size=n).astype(np.int32)
+    stats = rng.normal(size=(n, S)).astype(np.float32)
+    a = histogram(jnp.asarray(bins), jnp.asarray(slot), jnp.asarray(stats),
+                  num_slots=L, num_bins=B, impl=impl, chunk=128)
+    b = histogram(jnp.asarray(bins), jnp.asarray(slot), jnp.asarray(stats),
+                  num_slots=L, num_bins=B, impl="segment")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)

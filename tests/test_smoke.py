@@ -1,0 +1,63 @@
+"""End-to-end smoke: the minimum slice of SURVEY.md §7 stage 2."""
+
+import numpy as np
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+
+
+def _synth_classif(n=2000, seed=0):
+    rng = np.random.RandomState(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    cat = rng.choice(["a", "b", "c"], size=n)
+    logit = 2 * x1 - x2 + (cat == "b") * 1.5
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logit))).astype(int)
+    return {
+        "x1": x1,
+        "x2": x2,
+        "cat": cat,
+        "y": np.where(y == 1, "yes", "no"),
+    }
+
+
+def test_gbt_binary_classification_synthetic():
+    data = _synth_classif()
+    model = ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=30, validation_ratio=0.1
+    ).train(data)
+    ev = model.evaluate(data)
+    assert ev.accuracy > 0.8, str(ev)
+    assert ev.auc > 0.85, str(ev)
+
+
+def test_gbt_regression_synthetic():
+    rng = np.random.RandomState(1)
+    n = 2000
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    y = 3 * x1 + np.sin(3 * x2) + 0.1 * rng.normal(size=n)
+    data = {"x1": x1, "x2": x2, "y": y}
+    model = ydf.GradientBoostedTreesLearner(
+        label="y", task=Task.REGRESSION, num_trees=50
+    ).train(data)
+    ev = model.evaluate(data)
+    assert ev.rmse < 0.8, str(ev)
+
+
+def test_rf_classification_synthetic():
+    data = _synth_classif()
+    model = ydf.RandomForestLearner(label="y", num_trees=20).train(data)
+    ev = model.evaluate(data)
+    assert ev.accuracy > 0.8, str(ev)
+
+
+def test_isolation_forest_synthetic():
+    rng = np.random.RandomState(2)
+    inliers = rng.normal(size=(500, 2))
+    outliers = rng.uniform(-6, 6, size=(20, 2))
+    x = np.concatenate([inliers, outliers])
+    data = {"f1": x[:, 0], "f2": x[:, 1]}
+    model = ydf.IsolationForestLearner(num_trees=50).train(data)
+    scores = model.predict(data)
+    # outliers should score higher on average
+    assert scores[500:].mean() > scores[:500].mean() + 0.05

@@ -1,0 +1,48 @@
+"""ydf_tpu — a TPU-native decision-forest framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of
+google/yggdrasil-decision-forests (YDF): train, evaluate, interpret and serve
+Gradient Boosted Trees, Random Forests, CART and Isolation Forests — built
+histogram-first, layer-synchronous, and fully batched so that the hot loops
+are XLA reductions on the MXU rather than per-node CPU scans.
+
+Public API mirrors the shape of the reference Python package (PYDF):
+
+    import ydf_tpu as ydf
+    model = ydf.GradientBoostedTreesLearner(label="income").train(df)
+    model.predict(df)
+    model.evaluate(test_df)
+
+Reference parity notes cite files in the reference tree as `ydf/<path>:line`
+(= /root/reference/yggdrasil_decision_forests/<path>).
+"""
+
+from ydf_tpu.dataset.dataspec import (
+    ColumnType,
+    Column,
+    DataSpecification,
+    infer_dataspec,
+)
+from ydf_tpu.dataset.dataset import Dataset
+from ydf_tpu.learners.gbt import GradientBoostedTreesLearner
+from ydf_tpu.learners.random_forest import RandomForestLearner
+from ydf_tpu.learners.cart import CartLearner
+from ydf_tpu.learners.isolation_forest import IsolationForestLearner
+from ydf_tpu.models.io import load_model
+from ydf_tpu.config import Task
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ColumnType",
+    "Column",
+    "DataSpecification",
+    "Dataset",
+    "infer_dataspec",
+    "GradientBoostedTreesLearner",
+    "RandomForestLearner",
+    "CartLearner",
+    "IsolationForestLearner",
+    "load_model",
+    "Task",
+]

@@ -1,0 +1,70 @@
+"""Task types and shared configuration.
+
+Mirrors the task enum of the reference (`ydf/model/abstract_model.proto` Task)
+and the generic-hyperparameter surface of `ydf/learner/abstract_learner.proto`,
+re-expressed as Python dataclasses (the TPU build has no protobuf dependency
+on its hot path; configs are plain static Python used as jit-static args).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+
+class Task(enum.Enum):
+    """Modeling task. Reference: ydf/model/abstract_model.proto:Task."""
+
+    CLASSIFICATION = "CLASSIFICATION"
+    REGRESSION = "REGRESSION"
+    RANKING = "RANKING"
+    CATEGORICAL_UPLIFT = "CATEGORICAL_UPLIFT"
+    NUMERICAL_UPLIFT = "NUMERICAL_UPLIFT"
+    ANOMALY_DETECTION = "ANOMALY_DETECTION"
+    SURVIVAL_ANALYSIS = "SURVIVAL_ANALYSIS"
+
+
+@dataclasses.dataclass(frozen=True)
+class TreeConfig:
+    """Static shape/budget configuration of a single tree build.
+
+    These are jit-static: one compilation per distinct TreeConfig.
+
+    The grower is breadth-first / layer-synchronous (the design the reference
+    uses for its *distributed* trainer, `ydf/learner/distributed_decision_tree/
+    training.h:104-143`), because that is the XLA-friendly formulation: the
+    per-layer work is one dense histogram reduction + one argmax, with static
+    shapes everywhere.
+    """
+
+    max_depth: int = 6
+    # Maximum number of nodes that can be split in one layer (frontier cap).
+    # min(2**(max_depth-1), this). Nodes beyond the cap become leaves.
+    max_frontier: int = 1024
+    # Number of histogram bins (including the reserved missing/OOV bin 0 for
+    # categorical columns).
+    num_bins: int = 256
+    min_examples: int = 5
+
+    @property
+    def frontier(self) -> int:
+        if self.max_depth < 0:  # "unlimited" → practical cap
+            return self.max_frontier
+        return min(2 ** max(self.max_depth - 1, 0), self.max_frontier)
+
+    @property
+    def max_nodes(self) -> int:
+        """Capacity of the node arrays of one tree."""
+        if self.max_depth < 0:
+            depth = 32
+        else:
+            depth = self.max_depth
+        # Breadth-first growth: layer d has at most min(2**d, 2*frontier)
+        # nodes. Sum over layers, +1 root slack.
+        total = 0
+        for d in range(depth + 1):
+            total += min(2**d, 2 * self.frontier)
+            if 2**d >= 2 * self.frontier and d > 20:
+                total += (depth - d) * 2 * self.frontier
+                break
+        return int(total)

@@ -1,0 +1,18 @@
+from ydf_tpu.dataset.dataspec import (
+    Column,
+    ColumnType,
+    DataSpecification,
+    infer_dataspec,
+)
+from ydf_tpu.dataset.dataset import Dataset
+from ydf_tpu.dataset.binning import BinnedDataset, Binner
+
+__all__ = [
+    "Column",
+    "ColumnType",
+    "DataSpecification",
+    "infer_dataspec",
+    "Dataset",
+    "BinnedDataset",
+    "Binner",
+]

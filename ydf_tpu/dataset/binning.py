@@ -1,0 +1,196 @@
+"""Feature bucketization: dataset → dense uint8 bin matrix.
+
+This is the TPU build's equivalent of the reference's DISCRETIZED_NUMERICAL
+transform (`ydf/dataset/data_spec.proto:267`) and of the distributed dataset
+cache's discretization (`ydf/learner/distributed_decision_tree/dataset_cache/
+dataset_cache.proto:42-58`) — except it is applied to *every* feature up
+front, because the TPU trainer is histogram-only: training operates on a
+dense `uint8[num_examples, num_features]` matrix, the layout that makes the
+per-layer split search one big XLA reduction.
+
+Semantics:
+  * NUMERICAL / BOOLEAN / DISCRETIZED_NUMERICAL columns: missing values are
+    globally mean-imputed (reference GLOBAL_IMPUTATION,
+    `training.cc:160`), then digitized against per-column ascending
+    boundaries: `bin(v) = #{b : boundary_b <= v}` so the split
+    "bin <= t" ⇔ "v < boundary_t" ⇔ the reference's HigherCondition
+    "v >= threshold goes right" with threshold = boundary_t.
+  * If a column has ≤ num_bins-1 distinct values, boundaries are the
+    midpoints between consecutive distinct values — making binned training
+    *exactly* equivalent to exhaustive split search (the reference's
+    splitter_scanner.h numerical bucket semantics). Otherwise boundaries
+    are (deduplicated) quantiles.
+  * CATEGORICAL columns: bin = dictionary index (0 = OOV). Vocabulary
+    indices ≥ num_bins collapse to OOV; the dictionary is frequency-sorted,
+    so only the rarest categories collapse.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ydf_tpu.dataset.dataspec import ColumnType, DataSpecification
+from ydf_tpu.dataset.dataset import Dataset
+
+_NUMERICAL_LIKE = (
+    ColumnType.NUMERICAL,
+    ColumnType.BOOLEAN,
+    ColumnType.DISCRETIZED_NUMERICAL,
+)
+
+
+@dataclasses.dataclass
+class Binner:
+    """Per-feature binning rules, fit once on the training dataset.
+
+    Feature order is [numericals..., categoricals...] — a static partition so
+    the split-search kernels can slice the bin matrix into a numerical block
+    (scanned with prefix sums over bins) and a categorical block (scanned in
+    gradient-ratio order) without per-feature branching.
+    """
+
+    feature_names: List[str]
+    num_numerical: int  # features [0, num_numerical) are numerical-like
+    num_bins: int
+    # [F, num_bins-1] ascending; padded with +inf. Categorical rows unused.
+    boundaries: np.ndarray
+    # [F] imputation value for missing numericals (column mean).
+    impute_values: np.ndarray
+    # [F] number of "real" bins per feature (numerical: #boundaries+1,
+    # categorical: min(vocab_size, num_bins)).
+    feature_num_bins: np.ndarray
+
+    @property
+    def num_features(self) -> int:
+        return len(self.feature_names)
+
+    @property
+    def num_categorical(self) -> int:
+        return self.num_features - self.num_numerical
+
+    # ------------------------------------------------------------------ #
+
+    @staticmethod
+    def fit(
+        dataset: Dataset,
+        features: Sequence[str],
+        num_bins: int = 256,
+        max_unique_for_exact: Optional[int] = None,
+    ) -> "Binner":
+        spec = dataset.dataspec
+        numericals = [
+            f for f in features
+            if spec.column_by_name(f).type in _NUMERICAL_LIKE
+        ]
+        categoricals = [
+            f for f in features
+            if spec.column_by_name(f).type == ColumnType.CATEGORICAL
+        ]
+        unsupported = set(features) - set(numericals) - set(categoricals)
+        if unsupported:
+            raise NotImplementedError(
+                f"Unsupported feature columns for binning: {sorted(unsupported)}"
+            )
+        ordered = numericals + categoricals
+        F = len(ordered)
+        max_boundaries = num_bins - 1
+        boundaries = np.full((F, max_boundaries), np.inf, dtype=np.float32)
+        impute = np.zeros((F,), dtype=np.float32)
+        fnb = np.ones((F,), dtype=np.int32)
+
+        for i, name in enumerate(numericals):
+            col = spec.column_by_name(name)
+            vals = dataset.encoded_numerical(name)
+            uniq = np.unique(vals)
+            if len(uniq) <= max_boundaries:
+                b = ((uniq[:-1] + uniq[1:]) / 2).astype(np.float32)
+            else:
+                qs = np.quantile(
+                    vals.astype(np.float64),
+                    np.linspace(0, 1, num_bins + 1)[1:-1],
+                    method="linear",
+                )
+                b = np.unique(qs).astype(np.float32)
+            boundaries[i, : len(b)] = b
+            impute[i] = np.float32(col.mean)
+            fnb[i] = len(b) + 1
+
+        for j, name in enumerate(categoricals):
+            col = spec.column_by_name(name)
+            fnb[len(numericals) + j] = min(col.vocab_size, num_bins)
+
+        return Binner(
+            feature_names=ordered,
+            num_numerical=len(numericals),
+            num_bins=num_bins,
+            boundaries=boundaries,
+            impute_values=impute,
+            feature_num_bins=fnb,
+        )
+
+    # ------------------------------------------------------------------ #
+
+    def transform(self, dataset: Dataset) -> np.ndarray:
+        """Returns the uint8 bin matrix [num_rows, num_features]."""
+        n = dataset.num_rows
+        out = np.zeros((n, self.num_features), dtype=np.uint8)
+        for i, name in enumerate(self.feature_names):
+            if i < self.num_numerical:
+                vals = dataset.encoded_numerical(name)
+                nb = int(self.feature_num_bins[i]) - 1
+                out[:, i] = np.searchsorted(
+                    self.boundaries[i, :nb], vals, side="right"
+                ).astype(np.uint8)
+            else:
+                idx = dataset.encoded_categorical(name)
+                idx = np.where(idx >= self.num_bins, 0, idx)
+                out[:, i] = idx.astype(np.uint8)
+        return out
+
+    def threshold_value(self, feature_index: int, threshold_bin: int) -> float:
+        """Float threshold of a numerical split "bin <= threshold_bin goes
+        left" ⇔ "value >= boundaries[threshold_bin] goes right"."""
+        return float(self.boundaries[feature_index, threshold_bin])
+
+    def to_json(self) -> Dict:
+        return {
+            "feature_names": self.feature_names,
+            "num_numerical": self.num_numerical,
+            "num_bins": self.num_bins,
+            "boundaries": self.boundaries.tolist(),
+            "impute_values": self.impute_values.tolist(),
+            "feature_num_bins": self.feature_num_bins.tolist(),
+        }
+
+    @staticmethod
+    def from_json(d: Dict) -> "Binner":
+        return Binner(
+            feature_names=list(d["feature_names"]),
+            num_numerical=int(d["num_numerical"]),
+            num_bins=int(d["num_bins"]),
+            boundaries=np.array(d["boundaries"], dtype=np.float32),
+            impute_values=np.array(d["impute_values"], dtype=np.float32),
+            feature_num_bins=np.array(d["feature_num_bins"], dtype=np.int32),
+        )
+
+
+@dataclasses.dataclass
+class BinnedDataset:
+    """A bin matrix + the Binner that produced it."""
+
+    bins: np.ndarray  # uint8 [n, F]
+    binner: Binner
+
+    @property
+    def num_rows(self) -> int:
+        return self.bins.shape[0]
+
+    @staticmethod
+    def create(
+        dataset: Dataset, features: Sequence[str], num_bins: int = 256
+    ) -> "BinnedDataset":
+        binner = Binner.fit(dataset, features, num_bins=num_bins)
+        return BinnedDataset(bins=binner.transform(dataset), binner=binner)

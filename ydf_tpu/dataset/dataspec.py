@@ -1,0 +1,267 @@
+"""Column schema ("dataspec") and its inference.
+
+TPU-native re-design of the reference dataspec
+(`ydf/dataset/data_spec.proto:49` DataSpecification, column types `:61-85`,
+categorical dictionaries `CategoricalSpec` `:150`), and of one-pass dataspec
+inference (`ydf/dataset/data_spec_inference.h`).
+
+Key semantic contracts kept from the reference:
+  * Categorical dictionaries reserve index 0 for out-of-vocabulary items
+    (the "<OOD>" convention, `data_spec.proto:150-208`); in-vocabulary items
+    are ordered by decreasing frequency (ties broken lexicographically).
+  * `min_vocab_frequency` (default 5) and `max_vocab_count` (default 2000)
+    prune rare categories into OOV.
+  * Missing numericals are globally imputed with the column mean
+    (GLOBAL_IMPUTATION, the default split-search policy — reference
+    `ydf/learner/decision_tree/training.cc:160`).
+
+Unlike the reference there is no protobuf: the dataspec is a plain dataclass,
+JSON-serializable for model save/load.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import math
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class ColumnType(enum.Enum):
+    """Semantic column types. Reference: ydf/dataset/data_spec.proto:61-85."""
+
+    UNKNOWN = "UNKNOWN"
+    NUMERICAL = "NUMERICAL"
+    CATEGORICAL = "CATEGORICAL"
+    BOOLEAN = "BOOLEAN"
+    CATEGORICAL_SET = "CATEGORICAL_SET"
+    DISCRETIZED_NUMERICAL = "DISCRETIZED_NUMERICAL"
+    HASH = "HASH"
+    NUMERICAL_VECTOR_SEQUENCE = "NUMERICAL_VECTOR_SEQUENCE"
+
+
+# Out-of-vocabulary token, reference data_spec.cc kOutOfDictionaryItemKey.
+OOV_ITEM = "<OOD>"
+
+
+@dataclasses.dataclass
+class Column:
+    """Schema + statistics of one column."""
+
+    name: str
+    type: ColumnType
+    # --- numerical ---
+    mean: float = 0.0  # also the global-imputation value for missing
+    min_value: float = 0.0
+    max_value: float = 0.0
+    num_values: int = 0
+    num_missing: int = 0
+    # --- categorical ---
+    # vocabulary[0] == OOV_ITEM always; items sorted by decreasing frequency.
+    vocabulary: Optional[List[str]] = None
+    vocab_counts: Optional[List[int]] = None
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocabulary) if self.vocabulary is not None else 0
+
+    def to_json(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["type"] = self.type.value
+        return d
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "Column":
+        d = dict(d)
+        d["type"] = ColumnType(d["type"])
+        return Column(**d)
+
+
+@dataclasses.dataclass
+class DataSpecification:
+    """Ordered set of columns. Reference: ydf/dataset/data_spec.proto:49."""
+
+    columns: List[Column]
+    created_num_rows: int = 0
+
+    def column_names(self) -> List[str]:
+        return [c.name for c in self.columns]
+
+    def column_by_name(self, name: str) -> Column:
+        for c in self.columns:
+            if c.name == name:
+                return c
+        raise KeyError(f"No column named {name!r} in dataspec")
+
+    def has_column(self, name: str) -> bool:
+        return any(c.name == name for c in self.columns)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "columns": [c.to_json() for c in self.columns],
+            "created_num_rows": self.created_num_rows,
+        }
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "DataSpecification":
+        return DataSpecification(
+            columns=[Column.from_json(c) for c in d["columns"]],
+            created_num_rows=d.get("created_num_rows", 0),
+        )
+
+    def __str__(self) -> str:
+        lines = [f"Number of columns: {len(self.columns)}", ""]
+        by_type: Dict[str, List[str]] = {}
+        for c in self.columns:
+            by_type.setdefault(c.type.value, []).append(c.name)
+        for t, names in sorted(by_type.items()):
+            lines.append(f"{t}: {len(names)}")
+        lines.append("")
+        for i, c in enumerate(self.columns):
+            extra = ""
+            if c.type == ColumnType.NUMERICAL:
+                extra = (
+                    f" mean:{c.mean:.6g} min:{c.min_value:.6g} "
+                    f"max:{c.max_value:.6g}"
+                )
+            elif c.type == ColumnType.CATEGORICAL:
+                extra = f" vocab-size:{c.vocab_size}"
+            if c.num_missing:
+                extra += f" num-missing:{c.num_missing}"
+            lines.append(f'  {i}: "{c.name}" {c.type.value}{extra}')
+        return "\n".join(lines)
+
+
+def _is_numeric_dtype(arr: np.ndarray) -> bool:
+    return np.issubdtype(arr.dtype, np.number) or arr.dtype == np.bool_
+
+
+_MISSING_STRINGS = {"", "NA", "N/A", "nan", "NaN", "null", "None"}
+
+
+def _string_missing_mask(values: np.ndarray) -> np.ndarray:
+    out = np.zeros(len(values), dtype=bool)
+    for i, v in enumerate(values.tolist()):
+        if v is None or (isinstance(v, float) and math.isnan(v)):
+            out[i] = True
+        elif isinstance(v, str) and v in _MISSING_STRINGS:
+            out[i] = True
+    return out
+
+
+def infer_column(
+    name: str,
+    values: np.ndarray,
+    max_vocab_count: int = 2000,
+    min_vocab_frequency: int = 5,
+    force_type: Optional[ColumnType] = None,
+) -> Column:
+    """Infers one column's type + stats.
+
+    Reference behavior: ydf/dataset/data_spec_inference.cc — numerical dtypes
+    become NUMERICAL, booleans BOOLEAN, strings CATEGORICAL with a pruned
+    frequency dictionary. Integer columns stay NUMERICAL (the reference's
+    default `detect_numerical_as_discretized_numerical=false` path; binning
+    happens later regardless, in the TPU build's Binner).
+    """
+    values = np.asarray(values)
+    if values.ndim != 1:
+        raise ValueError(f"Column {name!r} must be 1-D, got shape {values.shape}")
+
+    ctype = force_type
+    if ctype is None:
+        if values.dtype == np.bool_:
+            ctype = ColumnType.BOOLEAN
+        elif _is_numeric_dtype(values):
+            ctype = ColumnType.NUMERICAL
+        else:
+            ctype = ColumnType.CATEGORICAL
+
+    if ctype in (ColumnType.NUMERICAL, ColumnType.BOOLEAN,
+                 ColumnType.DISCRETIZED_NUMERICAL):
+        fvals = values.astype(np.float64)
+        missing = np.isnan(fvals)
+        ok = fvals[~missing]
+        if ok.size == 0:
+            return Column(name=name, type=ctype, num_missing=int(missing.sum()))
+        return Column(
+            name=name,
+            type=ctype,
+            mean=float(ok.mean()),
+            min_value=float(ok.min()),
+            max_value=float(ok.max()),
+            num_values=int(ok.size),
+            num_missing=int(missing.sum()),
+        )
+
+    if ctype == ColumnType.CATEGORICAL:
+        if _is_numeric_dtype(values):
+            fv = values.astype(np.float64)
+            missing = np.isnan(fv)
+            svals = np.array(
+                [str(int(v)) if float(v).is_integer() else str(v) for v in fv[~missing]],
+                dtype=object,
+            )
+        else:
+            missing = _string_missing_mask(values)
+            svals = values[~missing].astype(str)
+        uniq, counts = np.unique(svals, return_counts=True)
+        # Sort by (-count, name): decreasing frequency, lexicographic ties —
+        # the reference dictionary order (data_spec.cc item sorting).
+        order = np.lexsort((uniq, -counts))
+        uniq, counts = uniq[order], counts[order]
+        keep = counts >= max(min_vocab_frequency, 1)
+        kept, kept_counts = uniq[keep], counts[keep]
+        if max_vocab_count > 0 and len(kept) > max_vocab_count:
+            kept, kept_counts = kept[:max_vocab_count], kept_counts[:max_vocab_count]
+        oov_count = int(counts.sum() - kept_counts.sum())
+        return Column(
+            name=name,
+            type=ctype,
+            vocabulary=[OOV_ITEM] + [str(x) for x in kept],
+            vocab_counts=[oov_count] + [int(c) for c in kept_counts],
+            num_values=int(len(svals)),
+            num_missing=int(missing.sum()),
+        )
+
+    raise NotImplementedError(f"Column type {ctype} not yet supported")
+
+
+def infer_dataspec(
+    data: Dict[str, np.ndarray],
+    label: Optional[str] = None,
+    max_vocab_count: int = 2000,
+    min_vocab_frequency: int = 5,
+    column_types: Optional[Dict[str, ColumnType]] = None,
+) -> DataSpecification:
+    """Infers the dataspec of a columnar mapping name → 1-D array.
+
+    The label column (if given) is inferred with `min_vocab_frequency=1` and
+    no vocab cap so every class survives — the reference does the same by
+    routing the label through a guide (`data_spec.proto:348-483`).
+    """
+    column_types = column_types or {}
+    cols = []
+    n = 0
+    for name, values in data.items():
+        values = np.asarray(values)
+        n = len(values)
+        if name == label:
+            cols.append(
+                infer_column(
+                    name, values, max_vocab_count=-1, min_vocab_frequency=1,
+                    force_type=column_types.get(name),
+                )
+            )
+        else:
+            cols.append(
+                infer_column(
+                    name, values,
+                    max_vocab_count=max_vocab_count,
+                    min_vocab_frequency=min_vocab_frequency,
+                    force_type=column_types.get(name),
+                )
+            )
+    return DataSpecification(columns=cols, created_num_rows=n)

@@ -1,0 +1,33 @@
+"""CART learner: a single decision tree.
+
+Counterpart of `ydf/learner/cart/cart.cc`: one tree, no bagging, all
+attributes considered per node. Like the reference, the produced model is a
+single-tree Random Forest model (the reference's CART also returns a
+RandomForestModel). Validation-set pruning (`cart.cc:307-389`) is not yet
+implemented — the tree is grown with the same gain/min_examples stopping
+rules. TODO(round 2): reduced-error pruning on the flattened arrays.
+"""
+
+from __future__ import annotations
+
+from ydf_tpu.config import Task
+from ydf_tpu.learners.random_forest import RandomForestLearner
+
+
+class CartLearner(RandomForestLearner):
+    def __init__(
+        self,
+        label: str,
+        task: Task = Task.CLASSIFICATION,
+        max_depth: int = 16,
+        min_examples: int = 5,
+        **kwargs,
+    ):
+        kwargs.setdefault("num_trees", 1)
+        kwargs.setdefault("bootstrap_training_dataset", False)
+        kwargs.setdefault("num_candidate_attributes", -1)  # all features
+        kwargs.setdefault("winner_take_all", False)
+        super().__init__(
+            label=label, task=task, max_depth=max_depth,
+            min_examples=min_examples, **kwargs,
+        )

@@ -1,0 +1,96 @@
+"""GenericLearner: shared train() plumbing for all learners.
+
+Mirrors the role of the reference's AbstractLearner
+(`ydf/learner/abstract_learner.h:42` TrainWithStatus) + the PYDF
+GenericLearner (`ydf/port/python/ydf/learner/generic_learner.py:255`):
+dataset ingestion → dataspec → feature selection → label encoding →
+learner-specific training, returning a model.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ydf_tpu.config import Task
+from ydf_tpu.dataset.binning import BinnedDataset, Binner
+from ydf_tpu.dataset.dataset import Dataset, InputData
+from ydf_tpu.dataset.dataspec import ColumnType
+
+
+class GenericLearner:
+    def __init__(
+        self,
+        label: Optional[str],
+        task: Task,
+        features: Optional[Sequence[str]] = None,
+        weights: Optional[str] = None,
+        max_vocab_count: int = 2000,
+        min_vocab_frequency: int = 5,
+        num_bins: int = 256,
+        random_seed: int = 123456,
+    ):
+        self.label = label
+        self.task = task
+        self.features = list(features) if features is not None else None
+        self.weights = weights
+        self.max_vocab_count = max_vocab_count
+        self.min_vocab_frequency = min_vocab_frequency
+        self.num_bins = num_bins
+        self.random_seed = random_seed
+
+    # ------------------------------------------------------------------ #
+
+    def _prepare(
+        self, data: InputData, valid: Optional[InputData] = None
+    ) -> Dict:
+        """Common ingestion: dataset, binning, encoded label/weights."""
+        ds = Dataset.from_data(
+            data,
+            label=self.label,
+            max_vocab_count=self.max_vocab_count,
+            min_vocab_frequency=self.min_vocab_frequency,
+        )
+        feature_names = self.features
+        if feature_names is None:
+            exclude = {self.label, self.weights} - {None}
+            feature_names = [
+                c.name
+                for c in ds.dataspec.columns
+                if c.name not in exclude
+                and c.type
+                in (
+                    ColumnType.NUMERICAL,
+                    ColumnType.CATEGORICAL,
+                    ColumnType.BOOLEAN,
+                    ColumnType.DISCRETIZED_NUMERICAL,
+                )
+            ]
+        binned = BinnedDataset.create(ds, feature_names, num_bins=self.num_bins)
+
+        out = {
+            "dataset": ds,
+            "binned": binned,
+            "binner": binned.binner,
+            "bins": binned.bins,
+        }
+        if self.label is not None:
+            out["labels"] = ds.encoded_label(self.label, self.task)
+            if self.task == Task.CLASSIFICATION:
+                out["classes"] = ds.label_classes(self.label)
+        if self.weights is not None:
+            out["sample_weights"] = ds.data[self.weights].astype(np.float32)
+        else:
+            out["sample_weights"] = np.ones((ds.num_rows,), np.float32)
+
+        if valid is not None:
+            vds = Dataset.from_data(valid, label=self.label, dataspec=ds.dataspec)
+            out["valid_dataset"] = vds
+            out["valid_bins"] = binned.binner.transform(vds)
+            if self.label is not None:
+                out["valid_labels"] = vds.encoded_label(self.label, self.task)
+        return out
+
+    def train(self, data: InputData, valid: Optional[InputData] = None):
+        raise NotImplementedError

@@ -1,0 +1,179 @@
+"""Isolation Forest learner.
+
+Re-design of `ydf/learner/isolation_forest/isolation_forest.cc:907`
+(TrainWithStatusImpl): per tree, subsample examples without replacement
+(default 256), grow with uniformly random (feature, threshold) splits to
+depth ceil(log2(subsample)) (`:670-672`), score by mean isolation depth.
+
+The random split is realized through the generic grower with
+`RandomSplitRule`: Gumbel-max over (feature, bin-cut) with per-cut weights
+proportional to the value-space width of the bin gap — which marginalizes
+the reference's "uniform threshold in [min, max)" (`:395`) onto bin cuts.
+Because each tree sees only `subsample_count` examples, the grower runs on
+the gathered subsample (tiny histograms), not the full dataset.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ydf_tpu.config import Task, TreeConfig
+from ydf_tpu.dataset.dataset import InputData
+from ydf_tpu.learners.generic import GenericLearner
+from ydf_tpu.models.forest import forest_from_stacked_trees
+from ydf_tpu.models.if_model import IsolationForestModel, average_path_length
+from ydf_tpu.ops import grower
+from ydf_tpu.ops.split_rules import RandomSplitRule
+
+
+class IsolationForestLearner(GenericLearner):
+    """API shape of the reference PYDF IsolationForestLearner
+    (`specialized_learners_pre_generated.py:892`)."""
+
+    def __init__(
+        self,
+        label: Optional[str] = None,  # unsupervised: label optional
+        task: Task = Task.ANOMALY_DETECTION,
+        num_trees: int = 300,
+        subsample_count: int = 256,
+        subsample_ratio: float = -1.0,
+        max_depth: int = -2,  # -2 → ceil(log2(subsample)) like the reference
+        features: Optional[Sequence[str]] = None,
+        random_seed: int = 123456,
+        **kwargs,
+    ):
+        super().__init__(
+            label=label, task=task, features=features,
+            random_seed=random_seed, **kwargs,
+        )
+        self.num_trees = num_trees
+        self.subsample_count = subsample_count
+        self.subsample_ratio = subsample_ratio
+        self.max_depth = max_depth
+
+    def train(self, data: InputData, valid=None) -> IsolationForestModel:
+        prep = self._prepare(data)
+        binner = prep["binner"]
+        bins = jnp.asarray(prep["bins"])
+        n, F = bins.shape
+
+        if self.subsample_ratio > 0:
+            sub = max(int(self.subsample_ratio * n), 2)
+        else:
+            sub = self.subsample_count
+        sub = min(sub, n)
+        depth = (
+            int(np.ceil(np.log2(max(sub, 2))))
+            if self.max_depth == -2
+            else self.max_depth
+        )
+
+        # log gap widths per (feature, cut): weight of picking cut t is the
+        # value-space distance between consecutive boundaries.
+        B = self.num_bins
+        log_gap = np.full((F, B), -np.inf, np.float32)
+        for f in range(binner.num_numerical):
+            nb = int(binner.feature_num_bins[f]) - 1  # number of boundaries
+            if nb <= 0:
+                continue
+            b = binner.boundaries[f, :nb].astype(np.float64)
+            gaps = np.diff(b, prepend=b[0] - (b[-1] - b[0] + 1e-6) / max(nb, 1))
+            gaps = np.maximum(gaps, 1e-12)
+            log_gap[f, :nb] = np.log(gaps)
+        # Categorical features: uniform over observed cut points.
+        for f in range(binner.num_numerical, F):
+            nb = int(binner.feature_num_bins[f])
+            log_gap[f, : max(nb - 1, 1)] = 0.0
+
+        tree_cfg = TreeConfig(
+            max_depth=depth,
+            max_frontier=max(2 ** max(depth - 1, 0), 1),
+            num_bins=B,
+            min_examples=1,
+        )
+        max_nodes = min(tree_cfg.max_nodes, 4 * sub + 3)
+
+        stacked, leaf_values = _train_if(
+            bins, num_trees=self.num_trees, sub=sub, depth=depth,
+            tree_cfg=tree_cfg, max_nodes=max_nodes,
+            num_numerical=binner.num_numerical,
+            log_gap=jnp.asarray(log_gap), seed=self.random_seed,
+        )
+
+        forest = forest_from_stacked_trees(
+            stacked, leaf_values, binner.boundaries
+        )
+        return IsolationForestModel(
+            task=self.task,
+            label=self.label,
+            classes=None,
+            dataspec=prep["dataset"].dataspec,
+            binner=binner,
+            forest=forest,
+            max_depth=depth,
+            num_examples_per_tree=sub,
+        )
+
+
+def _train_if(
+    bins, *, num_trees, sub, depth, tree_cfg: TreeConfig, max_nodes,
+    num_numerical, log_gap, seed,
+):
+    n = bins.shape[0]
+    rule = RandomSplitRule()
+
+    @jax.jit
+    def run(bins, log_gap):
+        def one_tree(carry, t):
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), t)
+            k_samp, k_grow = jax.random.split(key)
+            # subsample WITHOUT replacement: Gumbel top-k over examples.
+            scores = jax.random.uniform(k_samp, (n,))
+            _, idx = jax.lax.top_k(scores, sub)
+            sub_bins = bins[idx]
+            stats = jnp.ones((sub, 1), jnp.float32)
+            res = grower.grow_tree(
+                sub_bins, stats, k_grow,
+                rule=rule,
+                max_depth=depth,
+                frontier=tree_cfg.frontier,
+                max_nodes=max_nodes,
+                num_bins=tree_cfg.num_bins,
+                num_numerical=num_numerical,
+                min_examples=1,
+                min_split_gain=float("-inf"),
+                candidate_features=-1,
+                rule_ctx=log_gap,
+            )
+            tree = res.tree
+            # Node depths: parents precede children in BFS id order, so
+            # `depth` sweeps converge after max_depth scatter passes.
+            nd = jnp.zeros((max_nodes + 1,), jnp.int32)
+            for _ in range(depth):
+                internal = ~tree.is_leaf
+                tl = jnp.where(internal, tree.left, max_nodes)
+                tr = jnp.where(internal, tree.right, max_nodes)
+                d1 = nd[:max_nodes] + 1
+                nd = nd.at[tl].set(d1)
+                nd = nd.at[tr].set(d1)
+            node_depth = nd[:max_nodes].astype(jnp.float32)
+            counts = tree.leaf_stats[:, 0]
+            lv = (node_depth + _avg_path_length_jnp(counts))[:, None]
+            return carry, (tree, lv)
+
+        _, (trees, lvs) = jax.lax.scan(one_tree, 0, jnp.arange(num_trees))
+        return trees, lvs
+
+    return run(bins, log_gap)
+
+
+def _avg_path_length_jnp(n):
+    euler = 0.5772156649015329
+    nf = jnp.maximum(n, 1.0)
+    h = jnp.log(jnp.maximum(nf - 1.0, 1.0)) + euler
+    c = 2.0 * h - 2.0 * (nf - 1.0) / nf
+    return jnp.where(n > 2, c, jnp.where(n == 2, 1.0, 0.0))

@@ -1,0 +1,142 @@
+"""GBT losses: initial predictions, gradients/hessians, loss values.
+
+Re-design of the reference's pluggable loss interface
+(`ydf/learner/gradient_boosted_trees/loss/loss_interface.h:213-351`
+AbstractLoss: InitialPredictions / UpdateGradients / Loss) as pure JAX
+functions over batched prediction arrays. Implemented losses and their
+reference counterparts:
+
+  * BinomialLogLikelihood  — loss_imp_binomial.cc  (binary classification)
+  * MeanSquaredError       — loss_imp_mean_square_error.cc (regression;
+                             reported loss is RMSE, as in the reference)
+  * MultinomialLogLikelihood — loss_imp_multinomial.cc (multiclass)
+
+Conventions: predictions are raw scores [n, K] (K = num_trees_per_iter:
+1 for binary/regression, C for multiclass). Gradients are d loss/d score, so
+leaf Newton steps are -Σg/(Σh+λ) (the grower's HessianGainRule).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-12
+
+
+@dataclasses.dataclass(frozen=True)
+class BinomialLogLikelihood:
+    """Binary cross-entropy on logits. labels int {0,1}."""
+
+    name = "BINOMIAL_LOG_LIKELIHOOD"
+    num_dims = 1
+
+    def initial_predictions(self, labels, weights):
+        # log-odds of the positive class (reference loss_imp_binomial.cc
+        # InitialPredictions).
+        p = jnp.sum(weights * labels) / (jnp.sum(weights) + _EPS)
+        p = jnp.clip(p, _EPS, 1.0 - _EPS)
+        return jnp.log(p / (1.0 - p))[None]
+
+    def grad_hess(self, labels, preds):
+        p = jax.nn.sigmoid(preds[:, 0])
+        y = labels.astype(jnp.float32)
+        g = p - y
+        h = p * (1.0 - p)
+        return g[:, None], h[:, None]
+
+    def loss(self, labels, preds, weights):
+        # Reported as binomial deviance = 2 × weighted logloss, matching the
+        # reference's displayed training loss.
+        y = labels.astype(jnp.float32)
+        ll = jax.nn.softplus(preds[:, 0]) - y * preds[:, 0]
+        return 2.0 * jnp.sum(weights * ll) / (jnp.sum(weights) + _EPS)
+
+    def predict_proba(self, preds):
+        p1 = jax.nn.sigmoid(preds[:, 0])
+        return jnp.stack([1.0 - p1, p1], axis=1)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeanSquaredError:
+    """Squared error; reported loss is RMSE (reference convention)."""
+
+    name = "SQUARED_ERROR"
+    num_dims = 1
+
+    def initial_predictions(self, labels, weights):
+        return (jnp.sum(weights * labels) / (jnp.sum(weights) + _EPS))[None]
+
+    def grad_hess(self, labels, preds):
+        g = preds[:, 0] - labels
+        h = jnp.ones_like(g)
+        return g[:, None], h[:, None]
+
+    def loss(self, labels, preds, weights):
+        se = jnp.square(preds[:, 0] - labels)
+        return jnp.sqrt(jnp.sum(weights * se) / (jnp.sum(weights) + _EPS))
+
+    def predict_proba(self, preds):
+        return preds
+
+
+@dataclasses.dataclass(frozen=True)
+class MultinomialLogLikelihood:
+    """Softmax cross-entropy; one tree per class per iteration."""
+
+    num_classes: int
+    name = "MULTINOMIAL_LOG_LIKELIHOOD"
+
+    @property
+    def num_dims(self):
+        return self.num_classes
+
+    def initial_predictions(self, labels, weights):
+        # Reference initializes multinomial at zero (loss_imp_multinomial.cc).
+        return jnp.zeros((self.num_classes,), jnp.float32)
+
+    def grad_hess(self, labels, preds):
+        p = jax.nn.softmax(preds, axis=1)
+        y = jax.nn.one_hot(labels, self.num_classes, dtype=jnp.float32)
+        g = p - y
+        h = p * (1.0 - p)
+        return g, h
+
+    def loss(self, labels, preds, weights):
+        logp = jax.nn.log_softmax(preds, axis=1)
+        nll = -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), 1)[:, 0]
+        return jnp.sum(weights * nll) / (jnp.sum(weights) + _EPS)
+
+    def predict_proba(self, preds):
+        return jax.nn.softmax(preds, axis=1)
+
+
+def make_loss(name: str, task, num_classes: int):
+    from ydf_tpu.config import Task
+
+    if name in ("DEFAULT", "AUTO", None):
+        if task == Task.CLASSIFICATION:
+            name = (
+                "BINOMIAL_LOG_LIKELIHOOD"
+                if num_classes == 2
+                else "MULTINOMIAL_LOG_LIKELIHOOD"
+            )
+        elif task in (Task.REGRESSION,):
+            name = "SQUARED_ERROR"
+        elif task == Task.RANKING:
+            name = "LAMBDA_MART_NDCG"
+        else:
+            raise ValueError(f"No default GBT loss for task {task}")
+    if name == "BINOMIAL_LOG_LIKELIHOOD":
+        return BinomialLogLikelihood()
+    if name == "SQUARED_ERROR":
+        return MeanSquaredError()
+    if name == "MULTINOMIAL_LOG_LIKELIHOOD":
+        return MultinomialLogLikelihood(num_classes=num_classes)
+    if name == "LAMBDA_MART_NDCG":
+        from ydf_tpu.learners.ranking_loss import LambdaMartNdcg
+
+        return LambdaMartNdcg()
+    raise ValueError(f"Unknown loss {name!r}")

@@ -1,0 +1,3 @@
+from ydf_tpu.metrics.metrics import Evaluation, evaluate_predictions
+
+__all__ = ["Evaluation", "evaluate_predictions"]

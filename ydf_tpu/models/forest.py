@@ -1,0 +1,80 @@
+"""Forest: the serialized/serving representation of a trained ensemble.
+
+Struct-of-stacked-arrays over trees — the TPU-native analogue of the
+reference's flattened serving models (`ydf/serving/decision_forest/
+decision_forest_serving.h:33-94` flat node arrays), unified with the tree
+structure of `ydf/model/decision_tree/decision_tree.h:279`: every tree lives
+in fixed-capacity node arrays, stacked on a leading tree axis so inference
+is a `lax.scan` over trees of vectorized routing.
+
+Carries both bin-space thresholds (training / binned serving) and value-space
+thresholds (raw-feature serving); they are equivalent by construction.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Forest(NamedTuple):
+    feature: jax.Array        # [T, N] i32, -1 on leaves
+    threshold: jax.Array      # [T, N] f32 value-space: v <  threshold → left
+    threshold_bin: jax.Array  # [T, N] i32 bin-space:  bin <= t        → left
+    is_cat: jax.Array         # [T, N] bool
+    cat_mask: jax.Array       # [T, N, W] u32: bit(vocab idx) → left
+    left: jax.Array           # [T, N] i32
+    right: jax.Array          # [T, N] i32
+    is_leaf: jax.Array        # [T, N] bool
+    leaf_value: jax.Array     # [T, N, V] f32
+    num_nodes: jax.Array      # [T] i32
+
+    @property
+    def num_trees(self) -> int:
+        return self.feature.shape[0]
+
+    @property
+    def node_capacity(self) -> int:
+        return self.feature.shape[1]
+
+    def truncated(self, num_trees: int) -> "Forest":
+        """Keeps the first `num_trees` trees (early-stopping truncation)."""
+        return Forest(*(np.asarray(a)[:num_trees] for a in self))
+
+    def to_numpy(self) -> dict:
+        return {f: np.asarray(getattr(self, f)) for f in self._fields}
+
+    @staticmethod
+    def from_numpy(d: dict) -> "Forest":
+        return Forest(**{f: jnp.asarray(d[f]) for f in Forest._fields})
+
+
+def forest_from_stacked_trees(
+    stacked_trees, leaf_value: jax.Array, boundaries: np.ndarray
+) -> Forest:
+    """stacked TreeArrays (leading T axis) + leaf values → Forest.
+
+    `boundaries` is the binner's [F, B-1] float array; value-space thresholds
+    are boundaries[feature, threshold_bin] (bin <= t  ⇔  v < boundaries[t]).
+    """
+    feature = jnp.asarray(stacked_trees.feature)
+    tbin = jnp.asarray(stacked_trees.threshold_bin)
+    bnd = jnp.asarray(boundaries)  # [F, B-1]
+    f_safe = jnp.maximum(feature, 0)
+    t_safe = jnp.clip(tbin, 0, bnd.shape[1] - 1)
+    threshold = bnd[f_safe, t_safe]
+    return Forest(
+        feature=feature,
+        threshold=threshold.astype(jnp.float32),
+        threshold_bin=tbin,
+        is_cat=jnp.asarray(stacked_trees.is_cat),
+        cat_mask=jnp.asarray(stacked_trees.cat_mask),
+        left=jnp.asarray(stacked_trees.left),
+        right=jnp.asarray(stacked_trees.right),
+        is_leaf=jnp.asarray(stacked_trees.is_leaf),
+        leaf_value=jnp.asarray(leaf_value),
+        num_nodes=jnp.asarray(stacked_trees.num_nodes),
+    )

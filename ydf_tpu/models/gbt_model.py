@@ -1,0 +1,104 @@
+"""GradientBoostedTreesModel.
+
+Counterpart of `ydf/model/gradient_boosted_trees/gradient_boosted_trees.h:
+57-151`: trees + initial_predictions + num_trees_per_iter + loss, with the
+link function applied at prediction time.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ydf_tpu.config import Task
+from ydf_tpu.models.generic_model import GenericModel
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _softmax(x):
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    return e / e.sum(axis=1, keepdims=True)
+
+
+class GradientBoostedTreesModel(GenericModel):
+    model_type = "GRADIENT_BOOSTED_TREES"
+
+    def __init__(
+        self,
+        *,
+        task,
+        label,
+        classes,
+        dataspec,
+        binner,
+        forest,
+        initial_predictions: np.ndarray,
+        num_trees_per_iter: int,
+        max_depth: int,
+        loss_name: str,
+        training_logs: Optional[Dict[str, Any]] = None,
+        extra_metadata=None,
+    ):
+        super().__init__(
+            task=task, label=label, classes=classes, dataspec=dataspec,
+            binner=binner, forest=forest, max_depth=max_depth,
+            extra_metadata=extra_metadata,
+        )
+        self.initial_predictions = np.asarray(initial_predictions, np.float32)
+        self.num_trees_per_iter = num_trees_per_iter
+        self.loss_name = loss_name
+        self.training_logs = training_logs or {}
+
+    # ------------------------------------------------------------------ #
+
+    def predict(self, data) -> np.ndarray:
+        K = self.num_trees_per_iter
+        if K == 1:
+            scores = self._raw_scores(data, combine="sum")[:, 0]
+            scores = scores + self.initial_predictions[0]
+            if self.task == Task.CLASSIFICATION:
+                return _sigmoid(scores)  # P(classes[1])
+            return scores
+        # Multi-dim: route each dim's trees separately.
+        from ydf_tpu.models.forest import Forest
+
+        per_dim = []
+        fo = self.forest.to_numpy()
+        for k in range(K):
+            sub = Forest.from_numpy(
+                {f: a[k::K] for f, a in fo.items()}
+            )
+            sub_model_forest, self.forest = self.forest, sub
+            try:
+                s = self._raw_scores(data, combine="sum")[:, 0]
+            finally:
+                self.forest = sub_model_forest
+            per_dim.append(s + self.initial_predictions[k])
+        scores = np.stack(per_dim, axis=1)
+        if self.task == Task.CLASSIFICATION:
+            return _softmax(scores)
+        return scores
+
+    def _metadata(self) -> Dict[str, Any]:
+        return {
+            "initial_predictions": self.initial_predictions.tolist(),
+            "num_trees_per_iter": self.num_trees_per_iter,
+            "loss_name": self.loss_name,
+            "training_logs": self.training_logs,
+        }
+
+    @classmethod
+    def _from_saved(cls, common, specific):
+        return cls(
+            initial_predictions=np.array(
+                specific["initial_predictions"], np.float32
+            ),
+            num_trees_per_iter=specific["num_trees_per_iter"],
+            loss_name=specific["loss_name"],
+            training_logs=specific.get("training_logs"),
+            **common,
+        )

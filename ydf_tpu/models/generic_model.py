@@ -1,0 +1,149 @@
+"""GenericModel: base class of all trained models.
+
+Role of the reference's AbstractModel (`ydf/model/abstract_model.h:63`:
+Predict/Evaluate/Save + describe) and PYDF GenericModel
+(`ydf/port/python/ydf/model/generic_model.py:277`). Serving here routes raw
+(un-binned) features through the Forest arrays — the vectorized XLA
+equivalent of the reference's fast engines (`ydf/serving/fast_engine.h:41`);
+binned-input serving is also available and bit-identical.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ydf_tpu.config import Task
+from ydf_tpu.dataset.binning import Binner
+from ydf_tpu.dataset.dataset import Dataset, InputData
+from ydf_tpu.dataset.dataspec import DataSpecification
+from ydf_tpu.metrics import Evaluation, evaluate_predictions
+from ydf_tpu.models.forest import Forest
+from ydf_tpu.ops.routing import forest_predict_bins, forest_predict_values
+
+
+class GenericModel:
+    model_type = "GENERIC"
+
+    def __init__(
+        self,
+        task: Task,
+        label: Optional[str],
+        classes: Optional[List[str]],
+        dataspec: DataSpecification,
+        binner: Binner,
+        forest: Forest,
+        max_depth: int,
+        extra_metadata: Optional[Dict[str, Any]] = None,
+    ):
+        self.task = task
+        self.label = label
+        self.classes = classes
+        self.dataspec = dataspec
+        self.binner = binner
+        self.forest = forest
+        self.max_depth = max_depth
+        self.extra_metadata = extra_metadata or {}
+
+    # ------------------------------------------------------------------ #
+    # Introspection
+    # ------------------------------------------------------------------ #
+
+    def input_feature_names(self) -> List[str]:
+        return list(self.binner.feature_names)
+
+    def num_trees(self) -> int:
+        return int(self.forest.num_trees)
+
+    def num_nodes(self) -> int:
+        return int(np.asarray(self.forest.num_nodes).sum())
+
+    def describe(self) -> str:
+        lines = [
+            f'Type: "{self.model_type}"',
+            f"Task: {self.task.value}",
+            f'Label: "{self.label}"',
+            "",
+            f"Input features ({len(self.input_feature_names())}):"
+            f" {' '.join(self.input_feature_names())}",
+            "",
+            f"Number of trees: {self.num_trees()}",
+            f"Total number of nodes: {self.num_nodes()}",
+            "",
+            "Dataspec:",
+            str(self.dataspec),
+        ]
+        return "\n".join(lines)
+
+    # ------------------------------------------------------------------ #
+    # Serving
+    # ------------------------------------------------------------------ #
+
+    def _encode_inputs(self, ds: Dataset):
+        """Raw features → (x_num f32 [n, Fn] imputed, x_cat i32 [n, Fc])."""
+        b = self.binner
+        n = ds.num_rows
+        x_num = np.zeros((n, b.num_numerical), np.float32)
+        x_cat = np.zeros((n, b.num_categorical), np.int32)
+        for i, name in enumerate(b.feature_names):
+            if i < b.num_numerical:
+                if ds.dataspec.has_column(name) and name in ds.data:
+                    x_num[:, i] = ds.encoded_numerical(name)
+                else:
+                    x_num[:, i] = b.impute_values[i]
+            else:
+                j = i - b.num_numerical
+                if ds.dataspec.has_column(name) and name in ds.data:
+                    idx = ds.encoded_categorical(name)
+                    x_cat[:, j] = np.where(idx >= b.num_bins, 0, idx)
+        return x_num, x_cat
+
+    def _raw_scores(self, data: InputData, combine: str) -> np.ndarray:
+        ds = Dataset.from_data(data, dataspec=self.dataspec)
+        x_num, x_cat = self._encode_inputs(ds)
+        out = forest_predict_values(
+            self.forest,
+            jnp.asarray(x_num),
+            jnp.asarray(x_cat),
+            num_numerical=self.binner.num_numerical,
+            max_depth=self.max_depth,
+            combine=combine,
+        )
+        return np.asarray(out)
+
+    def predict(self, data: InputData) -> np.ndarray:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------ #
+    # Evaluation
+    # ------------------------------------------------------------------ #
+
+    def evaluate(self, data: InputData, weights: Optional[str] = None) -> Evaluation:
+        ds = Dataset.from_data(data, dataspec=self.dataspec)
+        preds = self.predict(ds)
+        labels = ds.encoded_label(self.label, self.task)
+        w = ds.data[weights].astype(np.float32) if weights else None
+        groups = None
+        if self.task == Task.RANKING:
+            gcol = self.extra_metadata.get("ranking_group")
+            groups = ds.data[gcol] if gcol else None
+        return evaluate_predictions(
+            self.task, labels, preds, classes=self.classes, weights=w,
+            groups=groups,
+        )
+
+    # ------------------------------------------------------------------ #
+    # Persistence (see models/io.py)
+    # ------------------------------------------------------------------ #
+
+    def save(self, path: str) -> None:
+        from ydf_tpu.models import io
+
+        io.save_model(self, path)
+
+    def _metadata(self) -> Dict[str, Any]:
+        """Subclass-specific JSON metadata."""
+        return {}

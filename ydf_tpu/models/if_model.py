@@ -1,0 +1,52 @@
+"""IsolationForestModel.
+
+Counterpart of `ydf/model/isolation_forest/`: anomaly score from mean
+isolation depth. Leaves store the path length h = depth + c(leaf_count)
+(precomputed at training time); the score is
+
+    score(x) = 2^( -E[h(x)] / c(num_examples_per_tree) )
+
+with c(n) the average BST path length — reference
+`ydf/learner/isolation_forest/isolation_forest.cc:670` and the standard
+Liu et al. normalization.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ydf_tpu.models.generic_model import GenericModel
+
+
+def average_path_length(n) -> np.ndarray:
+    """c(n): expected path length of an unsuccessful BST search, n examples."""
+    n = np.asarray(n, dtype=np.float64)
+    euler = 0.5772156649015329
+    h = np.log(np.maximum(n - 1, 1)) + euler
+    c = 2.0 * h - 2.0 * (n - 1) / np.maximum(n, 1)
+    return np.where(n > 2, c, np.where(n == 2, 1.0, 0.0))
+
+
+class IsolationForestModel(GenericModel):
+    model_type = "ISOLATION_FOREST"
+
+    def __init__(self, *, num_examples_per_tree: int, **kwargs):
+        super().__init__(**kwargs)
+        self.num_examples_per_tree = num_examples_per_tree
+
+    def predict(self, data) -> np.ndarray:
+        """Anomaly score in [0, 1]; higher = more anomalous."""
+        mean_path = self._raw_scores(data, combine="mean")[:, 0]
+        denom = float(average_path_length(self.num_examples_per_tree))
+        return np.power(2.0, -mean_path / max(denom, 1e-9))
+
+    def _metadata(self) -> Dict[str, Any]:
+        return {"num_examples_per_tree": self.num_examples_per_tree}
+
+    @classmethod
+    def _from_saved(cls, common, specific):
+        return cls(
+            num_examples_per_tree=specific["num_examples_per_tree"], **common
+        )

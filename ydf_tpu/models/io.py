@@ -1,0 +1,81 @@
+"""Model persistence.
+
+A model directory holds `model.json` (metadata: task, label, classes,
+dataspec, binner, model-specific fields) and `forest.npz` (node arrays) —
+the role of the reference's model directory (`ydf/model/model_library.cc`
+SaveModel/LoadModel: header + dataspec + node shards), JSON/NPZ instead of
+protobuf. A model-type registry keyed by `model_type` mirrors the reference
+model registry (`model_library.h` REGISTER_AbstractModel).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Type
+
+import numpy as np
+
+from ydf_tpu.config import Task
+from ydf_tpu.dataset.binning import Binner
+from ydf_tpu.dataset.dataspec import DataSpecification
+from ydf_tpu.models.forest import Forest
+from ydf_tpu.models.generic_model import GenericModel
+
+_REGISTRY: Dict[str, Type[GenericModel]] = {}
+
+
+def register_model(cls: Type[GenericModel]) -> Type[GenericModel]:
+    _REGISTRY[cls.model_type] = cls
+    return cls
+
+
+def _ensure_registry():
+    from ydf_tpu.models.gbt_model import GradientBoostedTreesModel
+    from ydf_tpu.models.rf_model import RandomForestModel
+    from ydf_tpu.models.if_model import IsolationForestModel
+
+    for cls in (GradientBoostedTreesModel, RandomForestModel, IsolationForestModel):
+        _REGISTRY.setdefault(cls.model_type, cls)
+
+
+def save_model(model: GenericModel, path: str) -> None:
+    os.makedirs(path, exist_ok=True)
+    meta = {
+        "format_version": 1,
+        "framework": "ydf_tpu",
+        "model_type": model.model_type,
+        "task": model.task.value,
+        "label": model.label,
+        "classes": model.classes,
+        "max_depth": model.max_depth,
+        "dataspec": model.dataspec.to_json(),
+        "binner": model.binner.to_json(),
+        "extra_metadata": model.extra_metadata,
+        "specific": model._metadata(),
+    }
+    with open(os.path.join(path, "model.json"), "w") as f:
+        json.dump(meta, f)
+    np.savez_compressed(
+        os.path.join(path, "forest.npz"), **model.forest.to_numpy()
+    )
+
+
+def load_model(path: str) -> GenericModel:
+    _ensure_registry()
+    with open(os.path.join(path, "model.json")) as f:
+        meta = json.load(f)
+    with np.load(os.path.join(path, "forest.npz")) as z:
+        forest = Forest.from_numpy({k: z[k] for k in z.files})
+    cls = _REGISTRY[meta["model_type"]]
+    common = dict(
+        task=Task(meta["task"]),
+        label=meta["label"],
+        classes=meta["classes"],
+        dataspec=DataSpecification.from_json(meta["dataspec"]),
+        binner=Binner.from_json(meta["binner"]),
+        forest=forest,
+        max_depth=meta["max_depth"],
+        extra_metadata=meta.get("extra_metadata") or {},
+    )
+    return cls._from_saved(common, meta["specific"])

@@ -1,0 +1,65 @@
+"""RandomForestModel.
+
+Counterpart of `ydf/model/random_forest/random_forest.cc`: voting /
+averaging over trees. Classification leaves store the class distribution;
+`winner_take_all` (the reference default) turns each tree's leaf into a hard
+vote — implemented by converting leaf distributions to one-hot votes at
+prediction time, then averaging over trees (identical semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ydf_tpu.config import Task
+from ydf_tpu.models.forest import Forest
+from ydf_tpu.models.generic_model import GenericModel
+
+
+class RandomForestModel(GenericModel):
+    model_type = "RANDOM_FOREST"
+
+    def __init__(self, *, winner_take_all: bool = True, oob_evaluation=None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.winner_take_all = winner_take_all
+        self.oob_evaluation = oob_evaluation
+
+    def predict(self, data) -> np.ndarray:
+        if self.task == Task.CLASSIFICATION and self.winner_take_all:
+            lv = np.asarray(self.forest.leaf_value)
+            votes = np.zeros_like(lv)
+            arg = lv.argmax(axis=-1)
+            t_idx, n_idx = np.meshgrid(
+                np.arange(lv.shape[0]), np.arange(lv.shape[1]), indexing="ij"
+            )
+            votes[t_idx, n_idx, arg] = 1.0
+            orig = self.forest
+            self.forest = orig._replace(leaf_value=votes)
+            try:
+                proba = self._raw_scores(data, combine="mean")
+            finally:
+                self.forest = orig
+        else:
+            proba = self._raw_scores(data, combine="mean")
+        if self.task == Task.CLASSIFICATION:
+            if proba.shape[1] == 2:
+                return proba[:, 1]
+            return proba
+        return proba[:, 0]
+
+    def _metadata(self) -> Dict[str, Any]:
+        return {
+            "winner_take_all": self.winner_take_all,
+            "oob_evaluation": self.oob_evaluation,
+        }
+
+    @classmethod
+    def _from_saved(cls, common, specific):
+        return cls(
+            winner_take_all=specific.get("winner_take_all", True),
+            oob_evaluation=specific.get("oob_evaluation"),
+            **common,
+        )

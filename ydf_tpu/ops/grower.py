@@ -1,0 +1,293 @@
+"""Layer-synchronous, fully-batched decision-tree grower.
+
+This replaces the reference's depth-first recursive trainer
+(`ydf/learner/decision_tree/training.cc:4739` DecisionTreeTrain →
+GrowTreeLocal `:5132`, with its per-(node,feature) CPU work queue
+`:1483`) with the breadth-first formulation the reference itself uses for
+distributed training (`ydf/learner/distributed_decision_tree/training.h:
+104-143`) — the formulation that maps onto XLA:
+
+  per layer:  histogram  →  prefix-scan gains  →  per-node argmax
+              →  allocate children  →  re-route examples
+
+Everything is static-shaped: the frontier (nodes that may still split) is a
+fixed array of `L` slots; node storage has fixed capacity `N`; examples carry
+an int32 frontier-slot (L = retired). The whole tree build is one jittable
+function — no host round-trips, no dynamic shapes, scan/fori friendly, and
+identical code runs single-chip or under shard_map (the histogram then gets a
+psum over the data axis; see ydf_tpu/parallel/).
+
+Tree node layout (struct-of-arrays, capacity N, BFS allocation order):
+  feature[N]        split feature, -1 for leaves
+  threshold_bin[N]  numerical split: bin <= t goes left
+                    categorical split: cut rank in the sorted-bin order
+  is_cat[N]         categorical split?
+  cat_mask[N, W]    uint32 bitmask over bins; bit set → bin goes left
+  left/right[N]     child node ids
+  is_leaf[N]
+  leaf_stats[N, S]  split-rule statistics of the node's examples
+  num_nodes         scalar
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ydf_tpu.ops.histogram import histogram
+
+
+class TreeArrays(NamedTuple):
+    feature: jax.Array
+    threshold_bin: jax.Array
+    is_cat: jax.Array
+    cat_mask: jax.Array
+    left: jax.Array
+    right: jax.Array
+    is_leaf: jax.Array
+    leaf_stats: jax.Array
+    num_nodes: jax.Array
+
+
+class GrowResult(NamedTuple):
+    tree: TreeArrays
+    leaf_id: jax.Array  # int32 [n]: leaf node id of every example
+
+
+def _pack_mask(mask: jax.Array) -> jax.Array:
+    """bool [..., B] → uint32 [..., B//32] bitmask."""
+    b = mask.shape[-1]
+    w = (b + 31) // 32
+    m = mask.reshape(*mask.shape[:-1], w, 32).astype(jnp.uint32)
+    shifts = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+    return jnp.sum(m * shifts, axis=-1, dtype=jnp.uint32)
+
+
+def unpack_mask_bit(packed: jax.Array, bit: jax.Array) -> jax.Array:
+    """packed [..., W] uint32, bit [...] int → bool []."""
+    word = jnp.take_along_axis(
+        packed, (bit >> 5)[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    return ((word >> (bit.astype(jnp.uint32) & 31)) & 1).astype(jnp.bool_)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "rule", "max_depth", "frontier", "max_nodes", "num_bins",
+        "num_numerical", "min_examples", "min_split_gain",
+        "candidate_features", "hist_impl",
+    ),
+)
+def grow_tree(
+    bins: jax.Array,        # uint8 [n, F]
+    stats: jax.Array,       # f32 [n, S] weighted per-example statistics
+    key: jax.Array,
+    *,
+    rule: Any,
+    max_depth: int,
+    frontier: int,
+    max_nodes: int,
+    num_bins: int = 256,
+    num_numerical: Optional[int] = None,
+    min_examples: int = 5,
+    min_split_gain: float = 1e-9,
+    candidate_features: int = -1,   # per-node feature sample; -1 = all
+    hist_impl: str = "auto",
+    rule_ctx: Any = None,
+) -> GrowResult:
+    n, F = bins.shape
+    S = stats.shape[1]
+    L, B, N = frontier, num_bins, max_nodes
+    W = (B + 31) // 32
+    Fn = F if num_numerical is None else num_numerical
+    Fc = F - Fn
+
+    f32 = jnp.float32
+    i32 = jnp.int32
+
+    # Node storage, padded with one trash row at index N.
+    tree = dict(
+        feature=jnp.full((N + 1,), -1, i32),
+        threshold_bin=jnp.zeros((N + 1,), i32),
+        is_cat=jnp.zeros((N + 1,), jnp.bool_),
+        cat_mask=jnp.zeros((N + 1, W), jnp.uint32),
+        left=jnp.zeros((N + 1,), i32),
+        right=jnp.zeros((N + 1,), i32),
+        is_leaf=jnp.ones((N + 1,), jnp.bool_),
+        leaf_stats=jnp.zeros((N + 1, S), f32),
+    )
+    total = jnp.sum(stats, axis=0)  # [S]
+    tree["leaf_stats"] = tree["leaf_stats"].at[0].set(total)
+
+    # Frontier state, padded with one trash slot at index L.
+    frontier_id = jnp.full((L + 1,), N, i32).at[0].set(0)
+    node_stats = jnp.zeros((L + 1, S), f32).at[0].set(total)
+    slot = jnp.zeros((n,), i32)  # every example starts at the root slot 0
+    leaf_id = jnp.zeros((n,), i32)
+    num_nodes = jnp.asarray(1, i32)
+
+    cut_ids = jnp.arange(B, dtype=i32)
+
+    for depth in range(max_depth):
+        key, k_gain, k_feat = jax.random.split(jax.random.fold_in(key, depth), 3)
+        children_in_frontier = depth + 1 < max_depth
+
+        hist = histogram(
+            bins, slot, stats, num_slots=L, num_bins=B, impl=hist_impl
+        )  # [L, F, B, S]
+
+        parent = node_stats[:L]  # [L, S]
+        active = frontier_id[:L] < N
+
+        # ---- candidate left-stats for every cut ------------------------- #
+        # Numerical features: cut t ⇒ left = bins <= t (prefix over bin id).
+        # Categorical: cut t ⇒ left = t+1 smallest bins in cat_sort_key
+        # order (prefix over the sorted order).
+        csum_num = jnp.cumsum(hist[:, :Fn], axis=2)  # [L, Fn, B, S]
+        if Fc > 0:
+            hist_cat = hist[:, Fn:]  # [L, Fc, B, S]
+            cat_key = rule.cat_sort_key(hist_cat, rule_ctx)  # [L, Fc, B]
+            # Empty bins sort last → they land on the right side, so unseen
+            # categories at serving time route right.
+            cat_key = jnp.where(hist_cat[..., -1] > 0, cat_key, jnp.inf)
+            order = jnp.argsort(cat_key, axis=-1)  # [L, Fc, B]
+            ranks = jnp.argsort(order, axis=-1)    # rank of each bin
+            sorted_hist = jnp.take_along_axis(
+                hist_cat, order[..., None], axis=2
+            )
+            csum_cat = jnp.cumsum(sorted_hist, axis=2)
+            left_all = jnp.concatenate([csum_num, csum_cat], axis=1)
+        else:
+            left_all = csum_num
+        right_all = parent[:, None, None, :] - left_all  # [L, F, B, S]
+
+        gain = rule.gain(left_all, right_all, parent[:, None, None, :],
+                         k_gain, rule_ctx)  # [L, F, B]
+
+        valid = (
+            (left_all[..., -1] >= min_examples)
+            & (right_all[..., -1] >= min_examples)
+            & active[:, None, None]
+        )
+        if candidate_features > 0 and candidate_features < F:
+            # Exact per-node sampling of `candidate_features` features
+            # without replacement (reference: per-node attribute sampling,
+            # ydf/learner/decision_tree/training.cc FindBestCondition).
+            scores = jax.random.uniform(k_feat, (L, F))
+            kth = jax.lax.top_k(scores, candidate_features)[0][:, -1]
+            valid &= (scores >= kth[:, None])[:, :, None]
+        gain = jnp.where(valid, gain, -jnp.inf)
+
+        # ---- best cut per frontier slot --------------------------------- #
+        flat = gain.reshape(L, F * B)
+        best_idx = jnp.argmax(flat, axis=1)
+        best_gain = jnp.take_along_axis(flat, best_idx[:, None], 1)[:, 0]
+        best_f = (best_idx // B).astype(i32)
+        best_t = (best_idx % B).astype(i32)
+
+        do_split = active & jnp.isfinite(best_gain) & (best_gain > min_split_gain)
+        if children_in_frontier and 2 ** (depth + 1) > L:
+            # Frontier overflow: keep the top-L/2 splits by gain, the rest
+            # become leaves (breadth-first analogue of the reference's
+            # best-first growth cap, training.cc:4580).
+            order_by_gain = jnp.argsort(
+                jnp.where(do_split, -best_gain, jnp.inf)
+            )
+            rank_by_gain = jnp.argsort(order_by_gain)
+            do_split &= rank_by_gain < (L // 2)
+
+        # ---- allocate children ------------------------------------------ #
+        split_rank = jnp.cumsum(do_split.astype(i32)) - 1  # [L]
+        nid = frontier_id[:L]
+        wid = jnp.where(do_split, nid, N)  # write index (trash when no split)
+        left_id = jnp.where(do_split, num_nodes + 2 * split_rank, N)
+        right_id = jnp.where(do_split, left_id + 1, N)
+
+        # Left-stats of the chosen cut (gather from the candidate cumsums).
+        chosen = jnp.take_along_axis(
+            left_all, best_f[:, None, None, None], axis=1
+        )[:, 0]  # [L, B, S]
+        left_stats = jnp.take_along_axis(
+            chosen, best_t[:, None, None], axis=1
+        )[:, 0]  # [L, S]
+        right_stats = parent - left_stats
+
+        is_cat_split = best_f >= Fn
+        # Per-slot routing mask over bins: numerical → prefix of bin ids,
+        # categorical → prefix of the sorted order (rank <= cut).
+        if Fc > 0:
+            chosen_rank = jnp.take_along_axis(
+                ranks, jnp.maximum(best_f - Fn, 0)[:, None, None], axis=1
+            )[:, 0]  # [L, B]
+            go_left_bins = jnp.where(
+                is_cat_split[:, None],
+                chosen_rank <= best_t[:, None],
+                cut_ids[None, :] <= best_t[:, None],
+            )  # [L, B]
+        else:
+            go_left_bins = cut_ids[None, :] <= best_t[:, None]
+
+        tree["feature"] = tree["feature"].at[wid].set(best_f)
+        tree["threshold_bin"] = tree["threshold_bin"].at[wid].set(best_t)
+        tree["is_cat"] = tree["is_cat"].at[wid].set(is_cat_split)
+        tree["cat_mask"] = tree["cat_mask"].at[wid].set(_pack_mask(go_left_bins))
+        tree["left"] = tree["left"].at[wid].set(left_id)
+        tree["right"] = tree["right"].at[wid].set(right_id)
+        tree["is_leaf"] = tree["is_leaf"].at[wid].set(False)
+        tree["leaf_stats"] = tree["leaf_stats"].at[left_id].set(left_stats)
+        tree["leaf_stats"] = tree["leaf_stats"].at[right_id].set(right_stats)
+        num_nodes = num_nodes + 2 * jnp.sum(do_split.astype(i32))
+
+        # ---- route examples --------------------------------------------- #
+        pad = lambda a, fill: jnp.concatenate(
+            [a, jnp.full((1,) + a.shape[1:], fill, a.dtype)], 0
+        )
+        split_e = pad(do_split, False)[slot]
+        bf_e = pad(best_f, 0)[slot]
+        bin_e = jnp.take_along_axis(
+            bins, bf_e[:, None].astype(i32), axis=1
+        )[:, 0].astype(i32)
+        # Flat 1-D gather — do NOT index [slot] then [bin]: that would
+        # materialize an [n, B] intermediate.
+        glb_flat = pad(go_left_bins, False).reshape(-1)
+        go_left_e = glb_flat[slot * B + bin_e]
+        child_id_e = jnp.where(
+            go_left_e, pad(left_id, N)[slot], pad(right_id, N)[slot]
+        )
+        leaf_id = jnp.where(split_e, child_id_e, leaf_id)
+
+        if children_in_frontier:
+            child_slot_e = jnp.where(
+                go_left_e, 2 * pad(split_rank, 0)[slot], 2 * pad(split_rank, 0)[slot] + 1
+            )
+            slot = jnp.where(split_e, child_slot_e, L)
+            # New frontier: children packed at slots [0, 2·#splits).
+            tgt_l = jnp.where(do_split, 2 * split_rank, L)
+            tgt_r = jnp.where(do_split, 2 * split_rank + 1, L)
+            frontier_id = jnp.full((L + 1,), N, i32)
+            frontier_id = frontier_id.at[tgt_l].set(left_id)
+            frontier_id = frontier_id.at[tgt_r].set(right_id)
+            frontier_id = frontier_id.at[L].set(N)
+            node_stats = jnp.zeros((L + 1, S), f32)
+            node_stats = node_stats.at[tgt_l].set(left_stats)
+            node_stats = node_stats.at[tgt_r].set(right_stats)
+            node_stats = node_stats.at[L].set(0.0)
+        else:
+            slot = jnp.full((n,), L, i32)
+
+    trimmed = TreeArrays(
+        feature=tree["feature"][:N],
+        threshold_bin=tree["threshold_bin"][:N],
+        is_cat=tree["is_cat"][:N],
+        cat_mask=tree["cat_mask"][:N],
+        left=tree["left"][:N],
+        right=tree["right"][:N],
+        is_leaf=tree["is_leaf"][:N],
+        leaf_stats=tree["leaf_stats"][:N],
+        num_nodes=num_nodes,
+    )
+    return GrowResult(tree=trimmed, leaf_id=leaf_id)

@@ -1,0 +1,124 @@
+"""Per-(node, feature, bin) gradient-statistics histograms.
+
+This op replaces the reference's entire split-search machinery:
+`FillExampleBucketSet` (`ydf/learner/decision_tree/splitter_scanner.h:860`,
+one linear pass per (open node, feature) dispatched on a CPU work queue
+`training.cc:1483`) becomes ONE dense contraction producing
+`hist[frontier_slot, feature, bin, stat]` for the whole layer at once.
+
+Two implementations:
+
+  * "matmul" (TPU): for each feature, contract a one-hot of the bin index
+    against the (stats ⊗ slot-one-hot) matrix on the MXU:
+
+        A[n, L*S]   = stats[n, S] scattered into the example's slot row
+        hist[f]     = onehot(bins[:, f])^T  @  A        # [B, L*S]
+
+    TPU has no fast scatter (HLO scatter lowers to a serial loop), so the
+    one-hot matmul is the idiomatic way to histogram on the MXU. Work is
+    chunked over examples to bound the materialized one-hot.
+
+  * "segment" (CPU / small data): `jax.ops.segment_sum` over the fused
+    (slot, bin) index — fast on CPU where scatter-add is native; used by the
+    unit tests and as the correctness oracle.
+
+Inactive examples carry slot == L (one past the last frontier slot) and fall
+into a trash row that is dropped.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _histogram_segment(bins, slot, stats, num_slots: int, num_bins: int):
+    n, F = bins.shape
+    S = stats.shape[1]
+    L, B = num_slots, num_bins
+    idx = slot[:, None].astype(jnp.int32) * B + bins.astype(jnp.int32)  # [n, F]
+
+    def per_feature(col):
+        return jax.ops.segment_sum(
+            stats, col, num_segments=(L + 1) * B, indices_are_sorted=False
+        )
+
+    hist = jax.vmap(per_feature, in_axes=1, out_axes=0)(idx)  # [F, (L+1)*B, S]
+    hist = hist[:, : L * B, :].reshape(F, L, B, S)
+    return jnp.transpose(hist, (1, 0, 2, 3))  # [L, F, B, S]
+
+
+def _histogram_matmul(
+    bins, slot, stats, num_slots: int, num_bins: int, chunk: int = 1 << 18
+):
+    n, F = bins.shape
+    S = stats.shape[1]
+    L, B = num_slots, num_bins
+    chunk = min(chunk, max(n, 1))
+
+    n_pad = ((n + chunk - 1) // chunk) * chunk
+    if n_pad != n:
+        bins = jnp.pad(bins, ((0, n_pad - n), (0, 0)))
+        # Padded examples land in the trash slot L and are dropped below.
+        slot = jnp.pad(slot, (0, n_pad - n), constant_values=L)
+        stats = jnp.pad(stats, ((0, n_pad - n), (0, 0)))
+    bins_c = bins.reshape(n_pad // chunk, chunk, F)
+    slot_c = slot.reshape(n_pad // chunk, chunk)
+    stats_c = stats.reshape(n_pad // chunk, chunk, S)
+
+    bvals = jnp.arange(B, dtype=jnp.int32)
+
+    def one_chunk(carry, xs):
+        b_chunk, s_chunk, st_chunk = xs  # [chunk, F], [chunk], [chunk, S]
+        # stats ⊗ onehot(slot), built per chunk to bound memory; the trash
+        # slot L falls outside arange(L) and contributes zero rows.
+        slot_oh = (
+            s_chunk[:, None] == jnp.arange(L, dtype=s_chunk.dtype)[None, :]
+        ).astype(st_chunk.dtype)  # [chunk, L]
+        a_chunk = (slot_oh[:, :, None] * st_chunk[:, None, :]).reshape(
+            chunk, L * S
+        )
+
+        def per_feature(f, acc):
+            oh = (b_chunk[:, f, None].astype(jnp.int32) == bvals[None, :]).astype(
+                a_chunk.dtype
+            )  # [chunk, B]
+            h = jax.lax.dot_general(
+                oh,
+                a_chunk,
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [B, L*S]
+            return acc.at[f].add(h)
+
+        carry = jax.lax.fori_loop(0, F, per_feature, carry)
+        return carry, None
+
+    init = jnp.zeros((F, B, L * S), dtype=jnp.float32)
+    hist, _ = jax.lax.scan(one_chunk, init, (bins_c, slot_c, stats_c))
+    hist = hist.reshape(F, B, L, S)
+    return jnp.transpose(hist, (2, 0, 1, 3)).astype(stats.dtype)  # [L, F, B, S]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_slots", "num_bins", "impl", "chunk")
+)
+def histogram(
+    bins: jax.Array,  # uint8/int32 [n, F] bin index per (example, feature)
+    slot: jax.Array,  # int32 [n] frontier slot in [0, L]; L = inactive
+    stats: jax.Array,  # float [n, S] weighted per-example statistics
+    num_slots: int,
+    num_bins: int = 256,
+    impl: str = "auto",
+    chunk: int = 1 << 18,
+) -> jax.Array:
+    """Returns hist[num_slots, F, num_bins, S] = Σ_examples stats."""
+    if impl == "auto":
+        impl = "matmul" if jax.default_backend() == "tpu" else "segment"
+    if impl == "segment":
+        return _histogram_segment(bins, slot, stats, num_slots, num_bins)
+    if impl == "matmul":
+        return _histogram_matmul(bins, slot, stats, num_slots, num_bins, chunk)
+    raise ValueError(f"Unknown histogram impl {impl!r}")

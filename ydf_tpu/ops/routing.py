@@ -1,0 +1,124 @@
+"""Tree routing: example → leaf, vectorized over examples (and trees).
+
+The semantic reference is the reference's own JAX export routing
+(`ydf/port/python/ydf/model/export_jax.py:970-1150` _predict_fn /
+_route_example): iterate `max_depth` times, each step gathering the current
+node's condition and stepping to a child; leaves self-loop.
+
+Two input modes:
+  * binned mode — uint8 bin matrix (training / fast serving): numerical
+    condition `bin <= threshold_bin`, categorical `mask bit set`.
+  * value mode — raw float numericals + int categorical indices (serving on
+    un-binned data): numerical condition `v < threshold`, same mask for
+    categoricals. The two are exactly equivalent by construction of the
+    binner (threshold = boundaries[threshold_bin]).
+
+Forests are scanned tree-by-tree with an accumulating [n, V] output (a vmap
+over trees would materialize [T, n] node arrays — too much HBM at scale).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ydf_tpu.ops.grower import TreeArrays, unpack_mask_bit
+
+i32 = jnp.int32
+
+
+def route_tree_bins(tree, bins: jax.Array, max_depth: int) -> jax.Array:
+    """Leaf node id per example. tree: TreeArrays-like (single tree)."""
+    n = bins.shape[0]
+    node = jnp.zeros((n,), i32)
+    for _ in range(max_depth):
+        f = jnp.maximum(tree.feature[node], 0)
+        b = jnp.take_along_axis(bins, f[:, None].astype(i32), axis=1)[:, 0]
+        b = b.astype(i32)
+        go_left = jnp.where(
+            tree.is_cat[node],
+            unpack_mask_bit(tree.cat_mask[node], b),
+            b <= tree.threshold_bin[node],
+        )
+        nxt = jnp.where(go_left, tree.left[node], tree.right[node])
+        node = jnp.where(tree.is_leaf[node], node, nxt)
+    return node
+
+
+def route_tree_values(
+    tree,
+    x_num: jax.Array,  # f32 [n, Fn] (missing already imputed)
+    x_cat: jax.Array,  # i32 [n, Fc] vocabulary indices (OOV/overflow → 0)
+    num_numerical: int,
+    max_depth: int,
+) -> jax.Array:
+    """Leaf node id per example, value mode. tree.threshold is float."""
+    n = x_num.shape[0] if x_num.size else x_cat.shape[0]
+    node = jnp.zeros((n,), i32)
+    for _ in range(max_depth):
+        f = jnp.maximum(tree.feature[node], 0)
+        is_cat = tree.is_cat[node]
+        fn = jnp.clip(f, 0, max(x_num.shape[1] - 1, 0))
+        fc = jnp.clip(f - num_numerical, 0, max(x_cat.shape[1] - 1, 0))
+        if x_num.shape[1] > 0:
+            v = jnp.take_along_axis(x_num, fn[:, None], axis=1)[:, 0]
+        else:
+            v = jnp.zeros((n,), jnp.float32)
+        if x_cat.shape[1] > 0:
+            c = jnp.take_along_axis(x_cat, fc[:, None], axis=1)[:, 0]
+        else:
+            c = jnp.zeros((n,), i32)
+        go_left = jnp.where(
+            is_cat,
+            unpack_mask_bit(tree.cat_mask[node], c),
+            v < tree.threshold[node],
+        )
+        nxt = jnp.where(go_left, tree.left[node], tree.right[node])
+        node = jnp.where(tree.is_leaf[node], node, nxt)
+    return node
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth", "combine"))
+def forest_predict_bins(
+    forest,  # pytree with per-tree arrays stacked on axis 0, incl. leaf_value [T, N, V]
+    bins: jax.Array,
+    max_depth: int,
+    combine: str = "sum",
+) -> jax.Array:
+    """Σ (or mean) over trees of routed leaf values. Returns [n, V]."""
+    T = forest.leaf_value.shape[0]
+    n = bins.shape[0]
+
+    def body(acc, tree):
+        leaves = route_tree_bins(tree, bins, max_depth)
+        return acc + tree.leaf_value[leaves], None
+
+    init = jnp.zeros((n, forest.leaf_value.shape[-1]), jnp.float32)
+    acc, _ = jax.lax.scan(body, init, forest)
+    return acc / T if combine == "mean" else acc
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_numerical", "max_depth", "combine")
+)
+def forest_predict_values(
+    forest,
+    x_num: jax.Array,
+    x_cat: jax.Array,
+    num_numerical: int,
+    max_depth: int,
+    combine: str = "sum",
+) -> jax.Array:
+    T = forest.leaf_value.shape[0]
+    n = x_num.shape[0] if x_num.size else x_cat.shape[0]
+
+    def body(acc, tree):
+        leaves = route_tree_values(tree, x_num, x_cat, num_numerical, max_depth)
+        return acc + tree.leaf_value[leaves], None
+
+    init = jnp.zeros((n, forest.leaf_value.shape[-1]), jnp.float32)
+    acc, _ = jax.lax.scan(body, init, forest)
+    return acc / T if combine == "mean" else acc

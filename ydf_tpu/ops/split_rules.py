@@ -1,0 +1,163 @@
+"""Split rules: gain, leaf-value and categorical-ordering functions.
+
+Each rule is a frozen (hashable, jit-static) dataclass bundling the functions
+that specialize the generic layer-synchronous grower to a task:
+
+  * `HessianGainRule` — GBT: the XGBoost-style hessian gain of the reference
+    (`ydf/learner/decision_tree/training.cc:585`
+    FindBestConditionRegressionHessianGain); stats = [grad, hess, weight].
+  * `ClassificationRule` — RF/CART classification: information gain / Gini
+    (reference `training.cc:397` FindBestConditionClassification); stats =
+    [per-class weighted counts..., weight].
+  * `RegressionRule` — RF/CART regression: variance reduction (reference
+    `training.cc:817`); stats = [Σwy, Σwy², weight].
+  * `RandomSplitRule` — Isolation Forest: gain is Gumbel noise weighted by
+    the value-space width of each bin gap, which reproduces the reference's
+    uniform-threshold random split (`ydf/learner/isolation_forest/
+    isolation_forest.cc:395`) on bucketized data; stats = [weight].
+
+Conventions:
+  * stats[..., -1] is always the weighted example count.
+  * `gain(left, right, parent, key, ctx)` maps prefix stats to a scalar gain;
+    invalid cuts are masked to -inf by the grower, not here.
+  * `cat_sort_key` orders categorical bins; the candidate left-sets are the
+    prefixes of that order (the classic Breiman/LightGBM reduction; the
+    reference sorts buckets the same way for CART categorical splits,
+    `splitter_scanner.h` bucket ordering).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-12
+
+
+@dataclasses.dataclass(frozen=True)
+class HessianGainRule:
+    """GBT hessian gain. stats = [g, h, w]; leaf = -Σg / (Σh + λ)."""
+
+    l2: float = 0.0
+    num_outputs: int = 1  # V
+
+    num_stats = 3
+
+    def gain(self, left, right, parent, key, ctx):
+        def score(s):
+            g, h = s[..., 0], s[..., 1]
+            return jnp.square(g) / (h + self.l2 + _EPS)
+
+        return 0.5 * (score(left) + score(right) - score(parent))
+
+    def leaf_value(self, stats, ctx):
+        g, h = stats[..., 0], stats[..., 1]
+        return (-g / (h + self.l2 + _EPS))[..., None]
+
+    def cat_sort_key(self, hist, ctx):
+        g, h = hist[..., 0], hist[..., 1]
+        return -g / (h + self.l2 + _EPS)
+
+
+@dataclasses.dataclass(frozen=True)
+class ClassificationRule:
+    """Information-gain (default, like the reference) or Gini classification
+    splits. stats = [w·1[y=0], ..., w·1[y=C-1], w]; leaf = class distribution.
+    """
+
+    num_classes: int
+    criterion: str = "entropy"  # or "gini"
+
+    @property
+    def num_stats(self):
+        return self.num_classes + 1
+
+    @property
+    def num_outputs(self):
+        return self.num_classes
+
+    def _impurity_mass(self, s):
+        """weight * impurity(s) — the additive form of the split criterion."""
+        counts = s[..., : self.num_classes]
+        w = s[..., -1]
+        p = counts / (w + _EPS)[..., None]
+        if self.criterion == "gini":
+            imp = 1.0 - jnp.sum(jnp.square(p), axis=-1)
+        else:
+            imp = -jnp.sum(p * jnp.log(p + _EPS), axis=-1)
+        return w * imp
+
+    def gain(self, left, right, parent, key, ctx):
+        return (
+            self._impurity_mass(parent)
+            - self._impurity_mass(left)
+            - self._impurity_mass(right)
+        )
+
+    def leaf_value(self, stats, ctx):
+        counts = stats[..., : self.num_classes]
+        return counts / (stats[..., -1] + _EPS)[..., None]
+
+    def cat_sort_key(self, hist, ctx):
+        # Order categories by P(class 1 | category): exact for binary labels
+        # (the reference's CART categorical ordering); a one-vs-rest
+        # heuristic for multiclass.
+        c = hist[..., min(1, self.num_classes - 1)]
+        return c / (hist[..., -1] + _EPS)
+
+
+@dataclasses.dataclass(frozen=True)
+class RegressionRule:
+    """Variance-reduction regression splits. stats = [Σwy, Σwy², w]."""
+
+    num_stats = 3
+    num_outputs = 1
+
+    def _sse(self, s):
+        sy, sy2, w = s[..., 0], s[..., 1], s[..., 2]
+        return sy2 - jnp.square(sy) / (w + _EPS)
+
+    def gain(self, left, right, parent, key, ctx):
+        return self._sse(parent) - self._sse(left) - self._sse(right)
+
+    def leaf_value(self, stats, ctx):
+        return (stats[..., 0] / (stats[..., 2] + _EPS))[..., None]
+
+    def cat_sort_key(self, hist, ctx):
+        return hist[..., 0] / (hist[..., -1] + _EPS)
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomSplitRule:
+    """Isolation-forest random splits via the Gumbel-max trick.
+
+    ctx = log_gap[F, B]: log of the value-space width between consecutive bin
+    boundaries. gain = log_gap - log(Σ_valid gap) + Gumbel ⇒ taking the argmax
+    over (feature, cut) samples a feature uniformly and a threshold
+    proportional to gap width — i.e. the reference's uniform threshold in
+    [min, max] (`isolation_forest.cc:395`), marginalized onto bin cuts.
+    stats = [w]; leaf stores the example count (depth normalization is applied
+    at scoring time, `isolation_forest.cc:670`).
+    """
+
+    num_stats = 1
+    num_outputs = 1
+
+    def gain(self, left, right, parent, key, ctx):
+        log_gap = ctx  # [F, B], -inf where no boundary
+        shape = left.shape[:-1]  # [L, F, B]
+        valid = (left[..., -1] > 0) & (right[..., -1] > 0)
+        w = jnp.where(valid, log_gap[None], -jnp.inf)
+        # Per-feature normalization → uniform feature choice.
+        norm = jax.scipy.special.logsumexp(w, axis=-1, keepdims=True)
+        gumbel = jax.random.gumbel(key, shape)
+        return jnp.where(valid, w - norm + gumbel, -jnp.inf)
+
+    def leaf_value(self, stats, ctx):
+        return stats[..., 0:1]
+
+    def cat_sort_key(self, hist, ctx):
+        # Random order for categorical bins (rarely used in IF).
+        return hist[..., -1]
