@@ -1,0 +1,39 @@
+import os
+
+import numpy as np
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+from ydf_tpu.learners.ranking_loss import build_group_rows
+
+D = "/root/reference/yggdrasil_decision_forests/test_data/dataset"
+
+
+def test_build_group_rows():
+    groups = np.array(["b", "a", "b", "c", "a", "b"])
+    rows, G = build_group_rows(groups)
+    assert G == 3
+    # group "a" → rows 1, 4 ; "b" → 0, 2, 5 ; "c" → 3
+    sets = [set(r[r >= 0].tolist()) for r in rows]
+    assert {1, 4} in sets and {0, 2, 5} in sets and {3} in sets
+
+
+def test_gbt_ranking_synthetic_dataset():
+    model = ydf.GradientBoostedTreesLearner(
+        label="LABEL",
+        task=Task.RANKING,
+        ranking_group="GROUP",
+        num_trees=40,
+    ).train(f"csv:{D}/synthetic_ranking_train.csv")
+    ev = model.evaluate(f"csv:{D}/synthetic_ranking_test.csv")
+    ndcg = ev.metrics["ndcg@5"]
+    # The reference GBT reaches NDCG@5 ≈ 0.72 on this dataset; random ≈ 0.60.
+    assert ndcg > 0.65, str(ev)
+
+
+def test_ranking_requires_group():
+    with pytest.raises(ValueError, match="ranking_group"):
+        ydf.GradientBoostedTreesLearner(
+            label="LABEL", task=Task.RANKING, num_trees=2
+        ).train(f"csv:{D}/synthetic_ranking_train.csv")

@@ -1,0 +1,55 @@
+"""Regression tests for code-review findings."""
+
+import numpy as np
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+
+
+def test_numeric_label_consistent_encoding_across_datasets():
+    # Labels are ints; an eval set containing only one class must still map
+    # classes through the training dictionary.
+    rng = np.random.RandomState(0)
+    x = rng.normal(size=400)
+    y = (x > 0).astype(np.int64)
+    model = ydf.GradientBoostedTreesLearner(label="y", num_trees=10).train(
+        {"x": x, "y": y}
+    )
+    only_pos = {"x": np.abs(x[:50]) + 1.0, "y": np.ones(50, np.int64)}
+    ev = model.evaluate(only_pos)
+    assert ev.accuracy > 0.9, str(ev)  # class-1-only set, model should nail it
+
+
+def test_invalid_num_bins_rejected():
+    data = {"x": np.arange(100.0), "y": (np.arange(100) % 2).astype(np.int64)}
+    with pytest.raises(ValueError, match="num_bins"):
+        ydf.GradientBoostedTreesLearner(label="y", num_trees=2, num_bins=512).train(data)
+    with pytest.raises(ValueError, match="num_bins"):
+        ydf.GradientBoostedTreesLearner(label="y", num_trees=2, num_bins=100).train(data)
+
+
+def test_weighted_rf_does_not_overflow_nodes():
+    rng = np.random.RandomState(1)
+    n = 800
+    data = {
+        "x1": rng.normal(size=n),
+        "x2": rng.normal(size=n),
+        "y": rng.normal(size=n),
+        "w": np.full(n, 10.0),
+    }
+    model = ydf.RandomForestLearner(
+        label="y", task=Task.REGRESSION, weights="w", num_trees=3,
+        max_depth=12, min_examples=5,
+    ).train(data)
+    preds = model.predict(data)
+    assert np.isfinite(preds).all()
+    # trees must be internally consistent: every non-leaf child id < capacity
+    f = model.forest
+    nn = np.asarray(f.num_nodes)
+    assert (nn <= f.node_capacity).all()
+    left = np.asarray(f.left)
+    is_leaf = np.asarray(f.is_leaf)
+    for t in range(left.shape[0]):
+        internal = ~is_leaf[t]
+        assert (left[t][internal] < f.node_capacity).all()

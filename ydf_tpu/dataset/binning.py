@@ -80,6 +80,15 @@ class Binner:
         num_bins: int = 256,
         max_unique_for_exact: Optional[int] = None,
     ) -> "Binner":
+        if not (2 <= num_bins <= 256):
+            raise ValueError(
+                f"num_bins must be in [2, 256] (uint8 bin matrix), got {num_bins}"
+            )
+        if num_bins % 32 != 0:
+            raise ValueError(
+                f"num_bins must be a multiple of 32 (packed category masks), "
+                f"got {num_bins}"
+            )
         spec = dataset.dataspec
         numericals = [
             f for f in features

@@ -81,6 +81,28 @@ class Dataset:
         column_types: Optional[Dict[str, ColumnType]] = None,
     ) -> "Dataset":
         if isinstance(data, Dataset):
+            if dataspec is not None:
+                # Re-key the same columns under the caller's dataspec (e.g. a
+                # model's / learner's dataspec for eval or validation data) so
+                # dictionaries and imputation values are the shared ones.
+                return Dataset(data.data, dataspec)
+            if column_types:
+                mismatched = [
+                    name
+                    for name, t in column_types.items()
+                    if data.dataspec.has_column(name)
+                    and data.dataspec.column_by_name(name).type != t
+                ]
+                if mismatched:
+                    # Re-infer with the forced types (notably: classification
+                    # labels must be CATEGORICAL whatever the raw dtype).
+                    return Dataset.from_data(
+                        dict(data.data),
+                        label=label,
+                        max_vocab_count=max_vocab_count,
+                        min_vocab_frequency=min_vocab_frequency,
+                        column_types=column_types,
+                    )
             return data
         if isinstance(data, str):
             files = _resolve_typed_path(data)
@@ -140,21 +162,30 @@ class Dataset:
         """Label encoding: classification → int32 in [0, C) (dictionary order,
         i.e. class 0 is the most frequent — matching the reference where class
         indices are dictionary indices 1..C shifted down by one); regression /
-        ranking → float32."""
+        ranking → float32.
+
+        Classification labels MUST be CATEGORICAL in the dataspec (learners
+        force this at dataspec-inference time, like the reference routes the
+        label through a guide) so that the class↔index mapping is the shared
+        dictionary — never re-derived per dataset, which would silently
+        mis-map classes on eval sets with a different class subset."""
         from ydf_tpu.config import Task
 
         col = self.dataspec.column_by_name(name)
         if task == Task.CLASSIFICATION:
-            if col.type == ColumnType.CATEGORICAL:
-                idx = self.encoded_categorical(name)
-                if (idx == 0).any():
-                    raise ValueError(f"Label column {name!r} has missing values")
-                return (idx - 1).astype(np.int32)
-            # numerical/boolean label: treat distinct values as classes
-            vals = self.data[name]
-            uniq = np.unique(vals)
-            lookup = {v: i for i, v in enumerate(uniq.tolist())}
-            return np.array([lookup[v] for v in vals.tolist()], dtype=np.int32)
+            if col.type != ColumnType.CATEGORICAL:
+                raise ValueError(
+                    f"Classification label {name!r} must be CATEGORICAL in "
+                    f"the dataspec (got {col.type.value}); train through a "
+                    "learner so the label type is forced."
+                )
+            idx = self.encoded_categorical(name)
+            if (idx == 0).any():
+                raise ValueError(
+                    f"Label column {name!r} has values outside the training "
+                    "dictionary (missing or unseen classes)"
+                )
+            return (idx - 1).astype(np.int32)
         return self.data[name].astype(np.float32)
 
     def label_classes(self, name: str) -> List[str]:

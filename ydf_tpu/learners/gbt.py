@@ -62,6 +62,8 @@ class GradientBoostedTreesLearner(GenericLearner):
         num_candidate_attributes: int = -1,
         num_candidate_attributes_ratio: float = -1.0,
         loss: str = "DEFAULT",
+        ranking_group: Optional[str] = None,
+        ndcg_truncation: int = 5,
         max_frontier: int = 1024,
         features: Optional[Sequence[str]] = None,
         weights: Optional[str] = None,
@@ -84,6 +86,8 @@ class GradientBoostedTreesLearner(GenericLearner):
         self.num_candidate_attributes = num_candidate_attributes
         self.num_candidate_attributes_ratio = num_candidate_attributes_ratio
         self.loss = loss
+        self.ranking_group = ranking_group
+        self.ndcg_truncation = ndcg_truncation
         self.max_frontier = max_frontier
 
     # ------------------------------------------------------------------ #
@@ -99,18 +103,51 @@ class GradientBoostedTreesLearner(GenericLearner):
         n = bins_all.shape[0]
         num_classes = len(prep.get("classes", [])) or 1
 
+        group_values = None
+        if self.task == Task.RANKING:
+            if self.ranking_group is None:
+                raise ValueError("Task.RANKING requires ranking_group=")
+            group_values = np.asarray(prep["dataset"].data[self.ranking_group])
+
         # --- validation extraction (reference :1243): deterministic split
         # of the training set, unless an explicit valid dataset is given.
+        # Ranking splits whole query groups, like the reference.
+        tr_groups = va_groups = None
         if "valid_bins" in prep:
             bins_tr, y_tr, w_tr = bins_all, labels_all, w_all
             bins_va = prep["valid_bins"]
             y_va = prep["valid_labels"]
-            w_va = np.ones((bins_va.shape[0],), np.float32)
+            w_va = prep.get(
+                "valid_weights", np.ones((bins_va.shape[0],), np.float32)
+            )
+            tr_groups = group_values
+            if self.task == Task.RANKING:
+                va_groups = np.asarray(
+                    prep["valid_dataset"].data[self.ranking_group]
+                )
         elif self.validation_ratio > 0 and self.early_stopping != "NONE":
             rng = np.random.RandomState(self.random_seed)
-            perm = rng.permutation(n)
-            nv = max(int(n * self.validation_ratio), 1)
-            va_idx, tr_idx = perm[:nv], perm[nv:]
+            if group_values is not None:
+                uniq = np.unique(group_values)
+                # Never consume every group (nor zero): a single-group
+                # dataset trains without validation rather than on nothing.
+                nvg = min(
+                    max(int(len(uniq) * self.validation_ratio), 1),
+                    len(uniq) - 1,
+                )
+                gperm = rng.permutation(len(uniq))
+                va_mask = np.isin(group_values, uniq[gperm[:nvg]])
+                va_idx = np.flatnonzero(va_mask)
+                tr_idx = np.flatnonzero(~va_mask)
+                tr_groups = group_values[tr_idx]
+                va_groups = group_values[va_idx]
+            else:
+                perm = rng.permutation(n)
+                nv = min(max(int(n * self.validation_ratio), 1), n - 1)
+                va_idx, tr_idx = perm[:nv], perm[nv:]
+            if len(va_idx) == 0:
+                va_idx = np.zeros((0,), np.int64)
+                tr_idx = np.arange(n)
             bins_tr, y_tr, w_tr = bins_all[tr_idx], labels_all[tr_idx], w_all[tr_idx]
             bins_va, y_va, w_va = bins_all[va_idx], labels_all[va_idx], w_all[va_idx]
         else:
@@ -118,8 +155,22 @@ class GradientBoostedTreesLearner(GenericLearner):
             bins_va = np.zeros((0, bins_all.shape[1]), np.uint8)
             y_va = np.zeros((0,), labels_all.dtype)
             w_va = np.zeros((0,), np.float32)
+            tr_groups = group_values
 
         loss_obj = make_loss(self.loss, self.task, num_classes)
+        from ydf_tpu.learners.ranking_loss import LambdaMartNdcg, build_group_rows
+
+        if isinstance(loss_obj, LambdaMartNdcg):
+            # Non-NDCG losses (e.g. SQUARED_ERROR on a ranking task) need no
+            # group structure and skip this entirely.
+            if self.task != Task.RANKING:
+                raise ValueError("LAMBDA_MART_NDCG requires task=Task.RANKING")
+            loss_obj.ndcg_truncation = self.ndcg_truncation
+            rows_tr, _ = build_group_rows(tr_groups)
+            loss_obj.register_groups("train", len(y_tr), rows_tr)
+            if bins_va.shape[0] > 0:
+                rows_va, _ = build_group_rows(va_groups)
+                loss_obj.register_groups("valid", len(y_va), rows_va)
         K = loss_obj.num_dims
         F = binner.num_features
         if self.num_candidate_attributes_ratio > 0:
@@ -206,6 +257,14 @@ class GradientBoostedTreesLearner(GenericLearner):
                 else None,
                 "num_trees": num_iters,
             },
+            extra_metadata=(
+                {
+                    "ranking_group": self.ranking_group,
+                    "ndcg_truncation": self.ndcg_truncation,
+                }
+                if self.ranking_group
+                else None
+            ),
         )
         return model
 
@@ -273,8 +332,12 @@ def _train_gbt(
 
             trees = jax.tree.map(lambda *xs: jnp.stack(xs), *trees_k)
             lvs = jnp.stack(leaves_k)  # [K, N, 1]
-            tl = loss_obj.loss(y_tr, preds, w_tr)
-            vl = loss_obj.loss(y_va, vpreds, w_va) if nv > 0 else jnp.float32(0)
+            tl = loss_obj.loss(y_tr, preds, w_tr, tag="train")
+            vl = (
+                loss_obj.loss(y_va, vpreds, w_va, tag="valid")
+                if nv > 0
+                else jnp.float32(0)
+            )
             return (preds, vpreds, key), (trees, lvs, tl, vl)
 
         (_, _, _), (trees, lvs, tls, vls) = jax.lax.scan(

@@ -46,15 +46,27 @@ class GenericLearner:
         self, data: InputData, valid: Optional[InputData] = None
     ) -> Dict:
         """Common ingestion: dataset, binning, encoded label/weights."""
+        column_types = {}
+        if self.label is not None and self.task == Task.CLASSIFICATION:
+            # Classification labels are always dictionary-encoded, whatever
+            # their raw dtype (reference: label goes through a categorical
+            # guide) — the shared dictionary makes label encoding consistent
+            # across train/valid/test datasets.
+            column_types[self.label] = ColumnType.CATEGORICAL
         ds = Dataset.from_data(
             data,
             label=self.label,
             max_vocab_count=self.max_vocab_count,
             min_vocab_frequency=self.min_vocab_frequency,
+            column_types=column_types,
         )
         feature_names = self.features
         if feature_names is None:
-            exclude = {self.label, self.weights} - {None}
+            exclude = {
+                self.label,
+                self.weights,
+                getattr(self, "ranking_group", None),
+            } - {None}
             feature_names = [
                 c.name
                 for c in ds.dataspec.columns
@@ -90,6 +102,8 @@ class GenericLearner:
             out["valid_bins"] = binned.binner.transform(vds)
             if self.label is not None:
                 out["valid_labels"] = vds.encoded_label(self.label, self.task)
+            if self.weights is not None:
+                out["valid_weights"] = vds.data[self.weights].astype(np.float32)
         return out
 
     def train(self, data: InputData, valid: Optional[InputData] = None):

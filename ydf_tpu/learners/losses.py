@@ -47,7 +47,7 @@ class BinomialLogLikelihood:
         h = p * (1.0 - p)
         return g[:, None], h[:, None]
 
-    def loss(self, labels, preds, weights):
+    def loss(self, labels, preds, weights, tag: str = "train"):
         # Reported as binomial deviance = 2 × weighted logloss, matching the
         # reference's displayed training loss.
         y = labels.astype(jnp.float32)
@@ -74,7 +74,7 @@ class MeanSquaredError:
         h = jnp.ones_like(g)
         return g[:, None], h[:, None]
 
-    def loss(self, labels, preds, weights):
+    def loss(self, labels, preds, weights, tag: str = "train"):
         se = jnp.square(preds[:, 0] - labels)
         return jnp.sqrt(jnp.sum(weights * se) / (jnp.sum(weights) + _EPS))
 
@@ -104,7 +104,7 @@ class MultinomialLogLikelihood:
         h = p * (1.0 - p)
         return g, h
 
-    def loss(self, labels, preds, weights):
+    def loss(self, labels, preds, weights, tag: str = "train"):
         logp = jax.nn.log_softmax(preds, axis=1)
         nll = -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), 1)[:, 0]
         return jnp.sum(weights * nll) / (jnp.sum(weights) + _EPS)

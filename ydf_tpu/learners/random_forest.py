@@ -110,8 +110,11 @@ class RandomForestLearner(GenericLearner):
             num_bins=self.num_bins,
             min_examples=self.min_examples,
         )
-        # Cap node capacity by what the dataset can actually produce.
-        max_nodes = min(tree_cfg.max_nodes, 2 * (n // self.min_examples) + 3)
+        # Cap node capacity by what the dataset can actually produce: every
+        # leaf holds ≥1 example (min_examples is a *weighted* count, so
+        # n//min_examples would under-size with weights), hence ≤ 2n-1
+        # nodes; the grower additionally guards allocation overflow.
+        max_nodes = min(tree_cfg.max_nodes, 2 * n + 3)
         cand = self._candidate_features(F)
 
         stacked, leaf_values = _train_rf(

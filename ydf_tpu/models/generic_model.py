@@ -127,12 +127,14 @@ class GenericModel:
         labels = ds.encoded_label(self.label, self.task)
         w = ds.data[weights].astype(np.float32) if weights else None
         groups = None
+        ndcg_truncation = 5
         if self.task == Task.RANKING:
             gcol = self.extra_metadata.get("ranking_group")
             groups = ds.data[gcol] if gcol else None
+            ndcg_truncation = int(self.extra_metadata.get("ndcg_truncation", 5))
         return evaluate_predictions(
             self.task, labels, preds, classes=self.classes, weights=w,
-            groups=groups,
+            groups=groups, ndcg_truncation=ndcg_truncation,
         )
 
     # ------------------------------------------------------------------ #

@@ -201,6 +201,11 @@ def grow_tree(
             do_split &= rank_by_gain < (L // 2)
 
         # ---- allocate children ------------------------------------------ #
+        # Node-capacity guard: children that would not fit in N become
+        # leaves. The masked-out slots form a suffix in cumsum order, so
+        # ranks of surviving slots are unchanged.
+        rank0 = jnp.cumsum(do_split.astype(i32)) - 1
+        do_split &= num_nodes + 2 * (rank0 + 1) <= N
         split_rank = jnp.cumsum(do_split.astype(i32)) - 1  # [L]
         nid = frontier_id[:L]
         wid = jnp.where(do_split, nid, N)  # write index (trash when no split)
