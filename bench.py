@@ -1,0 +1,101 @@
+"""Benchmark: GBT training throughput (the flagship metric of BASELINE.json).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+value = rows × trees / wall-seconds of an end-to-end train() call —
+dataspec inference + binning + the jitted boosting loop + model assembly,
+compile excluded (second call, cached executables) — on a Higgs-like
+synthetic dataset (28 numerical features, binary label); the metric
+BASELINE.json calls "GBDT train examples/sec/chip". End-to-end is the
+honest unit: the reference's wall-clock includes its dataset ingestion too.
+
+vs_baseline compares against 64-core CPU YDF on the same shape. The
+reference publishes no numbers and pip `ydf` is not installed in this image,
+so the baseline constant below is an engineering estimate (Higgs-11M ×
+500 trees in ~15 min on 64 cores ≈ 6.1e6 rows·trees/s), recorded in
+BASELINE.md and to be replaced by a real measurement when CPU YDF is
+available.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+BASELINE_CPU_YDF_ROWS_TREES_PER_SEC = 6.1e6
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true", help="force CPU backend")
+    ap.add_argument("--small", action="store_true", help="tiny smoke config")
+    ap.add_argument("--rows", type=int, default=None)
+    ap.add_argument("--trees", type=int, default=None)
+    ap.add_argument("--depth", type=int, default=6)
+    ap.add_argument("--features", type=int, default=28)
+    args = ap.parse_args()
+
+    if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import numpy as np
+    import jax
+
+    if args.cpu:
+        # The env var alone does not stop the axon TPU-tunnel plugin from
+        # initializing (and blocking when the tunnel is unreachable).
+        jax.config.update("jax_platforms", "cpu")
+
+    backend = jax.default_backend()
+    rows = args.rows or (20_000 if (args.small or backend == "cpu") else 2_000_000)
+    trees = args.trees or (5 if (args.small or backend == "cpu") else 20)
+
+    import ydf_tpu as ydf
+
+    rng = np.random.RandomState(0)
+    F = args.features
+    x = rng.normal(size=(rows, F)).astype(np.float32)
+    logit = x[:, 0] - 0.5 * x[:, 1] + np.sin(2 * x[:, 2]) + x[:, 3] * x[:, 4]
+    y = (rng.uniform(size=rows) < 1 / (1 + np.exp(-logit))).astype(np.int64)
+    data = {f"f{i}": x[:, i] for i in range(F)}
+    data["label"] = y
+
+    def train():
+        learner = ydf.GradientBoostedTreesLearner(
+            label="label",
+            num_trees=trees,
+            max_depth=args.depth,
+            validation_ratio=0.0,
+            early_stopping="NONE",
+        )
+        t0 = time.time()
+        model = learner.train(data)
+        return model, time.time() - t0
+
+    _, wall_compile = train()  # compile + run
+    model, wall = train()      # cached steady state
+    del model
+
+    value = rows * trees / wall
+    print(
+        json.dumps(
+            {
+                "metric": "gbt_train_rows_x_trees_per_sec_per_chip",
+                "value": round(value, 1),
+                "unit": "rows*trees/s",
+                "vs_baseline": round(
+                    value / BASELINE_CPU_YDF_ROWS_TREES_PER_SEC, 3
+                ),
+            }
+        )
+    )
+    print(
+        f"# backend={backend} rows={rows} trees={trees} depth={args.depth} "
+        f"F={F} wall={wall:.2f}s (first run incl. compile: {wall_compile:.2f}s)",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -24,6 +24,7 @@ final truncation.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence
 
 import jax
@@ -68,6 +69,7 @@ class GradientBoostedTreesLearner(GenericLearner):
         features: Optional[Sequence[str]] = None,
         weights: Optional[str] = None,
         random_seed: int = 123456,
+        mesh=None,
         **kwargs,
     ):
         super().__init__(
@@ -89,6 +91,10 @@ class GradientBoostedTreesLearner(GenericLearner):
         self.ranking_group = ranking_group
         self.ndcg_truncation = ndcg_truncation
         self.max_frontier = max_frontier
+        # jax.sharding.Mesh with axes (data, feature): distributes training
+        # via GSPMD sharding annotations (see ydf_tpu/parallel/mesh.py — the
+        # TPU-native replacement of the reference's gRPC worker protocol).
+        self.mesh = mesh
 
     # ------------------------------------------------------------------ #
 
@@ -157,6 +163,38 @@ class GradientBoostedTreesLearner(GenericLearner):
             w_va = np.zeros((0,), np.float32)
             tr_groups = group_values
 
+        if self.mesh is not None:
+            from ydf_tpu.parallel import mesh as pmesh
+
+            dp = self.mesh.shape[pmesh.DATA_AXIS]
+            fp = self.mesh.shape[pmesh.FEATURE_AXIS]
+            # Padding rows carry zero weight → no effect on stats/losses.
+            # Done BEFORE ranking-group registration so group row indices
+            # and registered sizes refer to the final (padded) arrays.
+            (bins_tr, y_tr, w_tr), _ = pmesh.pad_rows_to_multiple(
+                [bins_tr, y_tr, w_tr], dp
+            )
+            if bins_va.shape[0] > 0:
+                (bins_va, y_va, w_va), _ = pmesh.pad_rows_to_multiple(
+                    [bins_va, y_va, w_va], dp
+                )
+            if fp > 1:
+                # Pad the feature axis too: constant-zero columns can never
+                # yield a valid split (their right-side count is 0).
+                fpad = (-bins_tr.shape[1]) % fp
+                if fpad:
+                    bins_tr = np.pad(bins_tr, ((0, 0), (0, fpad)))
+                    bins_va = np.pad(bins_va, ((0, 0), (0, fpad)))
+                shard_bins = pmesh.shard_batch_and_features
+            else:
+                shard_bins = pmesh.shard_batch
+            bins_tr = shard_bins(self.mesh, bins_tr)
+            y_tr = pmesh.shard_batch(self.mesh, y_tr)
+            w_tr = pmesh.shard_batch(self.mesh, w_tr)
+            bins_va = shard_bins(self.mesh, bins_va)
+            y_va = pmesh.shard_batch(self.mesh, y_va)
+            w_va = pmesh.shard_batch(self.mesh, w_va)
+
         loss_obj = make_loss(self.loss, self.task, num_classes)
         from ydf_tpu.learners.ranking_loss import LambdaMartNdcg, build_group_rows
 
@@ -203,6 +241,9 @@ class GradientBoostedTreesLearner(GenericLearner):
             subsample=self.subsample,
             candidate_features=cand,
             num_numerical=binner.num_numerical,
+            # Under feature parallelism the bin matrix gains constant-zero
+            # pad columns; per-node feature sampling must ignore them.
+            num_valid_features=F if bins_tr.shape[1] > F else None,
             seed=self.random_seed,
         )
 
@@ -269,23 +310,25 @@ class GradientBoostedTreesLearner(GenericLearner):
         return model
 
 
-def _train_gbt(
-    bins_tr, y_tr, w_tr, bins_va, y_va, w_va, *,
+@functools.lru_cache(maxsize=16)
+def _make_boost_fn(
     loss_obj, rule, tree_cfg: TreeConfig, num_trees, shrinkage, subsample,
-    candidate_features, num_numerical, seed,
+    candidate_features, num_numerical, num_valid_features, seed, n, nv,
 ):
-    """The jitted boosting loop. Returns stacked trees [T, K, ...], leaf
-    values [T, K, N, 1] and per-iteration logs."""
-    n = bins_tr.shape[0]
-    nv = bins_va.shape[0]
+    """Builds (and caches) the jitted boosting loop for one static config.
+
+    Caching the closure is what makes jax.jit's own cache effective across
+    `train()` calls: a fresh closure per call would retrace + recompile the
+    whole lax.scan every time. Keyed on hashable frozen-dataclass configs
+    (LambdaMartNdcg hashes by identity — its captured per-dataset group
+    arrays make cross-call reuse incorrect anyway)."""
     K = loss_obj.num_dims
     N = tree_cfg.max_nodes
 
-    y_f = y_tr.astype(jnp.float32)
-    init_pred = loss_obj.initial_predictions(y_f, w_tr)  # [K]
-
     @jax.jit
     def run(bins_tr, y_tr, w_tr, bins_va, y_va, w_va):
+        y_f = y_tr.astype(jnp.float32)
+        init_pred = loss_obj.initial_predictions(y_f, w_tr)  # [K]
         preds0 = jnp.broadcast_to(init_pred[None, :], (n, K)).astype(jnp.float32)
         vpreds0 = jnp.broadcast_to(init_pred[None, :], (nv, K)).astype(jnp.float32)
         key0 = jax.random.PRNGKey(seed)
@@ -317,6 +360,7 @@ def _train_gbt(
                     num_numerical=num_numerical,
                     min_examples=tree_cfg.min_examples,
                     candidate_features=candidate_features,
+                    num_valid_features=num_valid_features,
                 )
                 # Leaf values scaled by shrinkage at storage time, like the
                 # reference (set_leaf applies shrinkage).
@@ -343,9 +387,34 @@ def _train_gbt(
         (_, _, _), (trees, lvs, tls, vls) = jax.lax.scan(
             boost_step, (preds0, vpreds0, key0), jnp.arange(num_trees)
         )
-        return trees, lvs, tls, vls
+        return trees, lvs, tls, vls, init_pred
 
-    trees, lvs, tls, vls = run(bins_tr, y_tr, w_tr, bins_va, y_va, w_va)
+    return run
+
+
+def _train_gbt(
+    bins_tr, y_tr, w_tr, bins_va, y_va, w_va, *,
+    loss_obj, rule, tree_cfg: TreeConfig, num_trees, shrinkage, subsample,
+    candidate_features, num_numerical, num_valid_features, seed,
+):
+    """The jitted boosting loop. Returns stacked trees [T, K, ...], leaf
+    values [T, K, N, 1] and per-iteration logs."""
+    # Identity-hashed losses (LambdaMartNdcg carries per-dataset group
+    # arrays) can never hit the cache — bypass it so dead entries don't pin
+    # device memory or evict the reusable frozen-dataclass ones.
+    builder = (
+        _make_boost_fn
+        if type(loss_obj).__hash__ is not object.__hash__
+        else _make_boost_fn.__wrapped__
+    )
+    run = builder(
+        loss_obj, rule, tree_cfg, num_trees, shrinkage, subsample,
+        candidate_features, num_numerical, num_valid_features, seed,
+        bins_tr.shape[0], bins_va.shape[0],
+    )
+    trees, lvs, tls, vls, init_pred = run(
+        bins_tr, y_tr, w_tr, bins_va, y_va, w_va
+    )
     logs = {
         "train_loss": tls,
         "valid_loss": vls,

@@ -79,7 +79,7 @@ def unpack_mask_bit(packed: jax.Array, bit: jax.Array) -> jax.Array:
     static_argnames=(
         "rule", "max_depth", "frontier", "max_nodes", "num_bins",
         "num_numerical", "min_examples", "min_split_gain",
-        "candidate_features", "hist_impl",
+        "candidate_features", "num_valid_features", "hist_impl",
     ),
 )
 def grow_tree(
@@ -96,6 +96,7 @@ def grow_tree(
     min_examples: int = 5,
     min_split_gain: float = 1e-9,
     candidate_features: int = -1,   # per-node feature sample; -1 = all
+    num_valid_features: Optional[int] = None,  # real (unpadded) columns
     hist_impl: str = "auto",
     rule_ctx: Any = None,
 ) -> GrowResult:
@@ -135,26 +136,30 @@ def grow_tree(
     for depth in range(max_depth):
         key, k_gain, k_feat = jax.random.split(jax.random.fold_in(key, depth), 3)
         children_in_frontier = depth + 1 < max_depth
+        # Layer d has at most min(2^d, L) candidate nodes — size the
+        # histogram and split search to that, not to the full frontier
+        # capacity (a large constant-factor win at shallow depths).
+        Ld = min(2**depth, L)
 
         hist = histogram(
-            bins, slot, stats, num_slots=L, num_bins=B, impl=hist_impl
-        )  # [L, F, B, S]
+            bins, slot, stats, num_slots=Ld, num_bins=B, impl=hist_impl
+        )  # [Ld, F, B, S]
 
-        parent = node_stats[:L]  # [L, S]
-        active = frontier_id[:L] < N
+        parent = node_stats[:Ld]  # [Ld, S]
+        active = frontier_id[:Ld] < N
 
         # ---- candidate left-stats for every cut ------------------------- #
         # Numerical features: cut t ⇒ left = bins <= t (prefix over bin id).
         # Categorical: cut t ⇒ left = t+1 smallest bins in cat_sort_key
         # order (prefix over the sorted order).
-        csum_num = jnp.cumsum(hist[:, :Fn], axis=2)  # [L, Fn, B, S]
+        csum_num = jnp.cumsum(hist[:, :Fn], axis=2)  # [Ld, Fn, B, S]
         if Fc > 0:
-            hist_cat = hist[:, Fn:]  # [L, Fc, B, S]
-            cat_key = rule.cat_sort_key(hist_cat, rule_ctx)  # [L, Fc, B]
+            hist_cat = hist[:, Fn:]  # [Ld, Fc, B, S]
+            cat_key = rule.cat_sort_key(hist_cat, rule_ctx)  # [Ld, Fc, B]
             # Empty bins sort last → they land on the right side, so unseen
             # categories at serving time route right.
             cat_key = jnp.where(hist_cat[..., -1] > 0, cat_key, jnp.inf)
-            order = jnp.argsort(cat_key, axis=-1)  # [L, Fc, B]
+            order = jnp.argsort(cat_key, axis=-1)  # [Ld, Fc, B]
             ranks = jnp.argsort(order, axis=-1)    # rank of each bin
             sorted_hist = jnp.take_along_axis(
                 hist_cat, order[..., None], axis=2
@@ -163,10 +168,10 @@ def grow_tree(
             left_all = jnp.concatenate([csum_num, csum_cat], axis=1)
         else:
             left_all = csum_num
-        right_all = parent[:, None, None, :] - left_all  # [L, F, B, S]
+        right_all = parent[:, None, None, :] - left_all  # [Ld, F, B, S]
 
         gain = rule.gain(left_all, right_all, parent[:, None, None, :],
-                         k_gain, rule_ctx)  # [L, F, B]
+                         k_gain, rule_ctx)  # [Ld, F, B]
 
         valid = (
             (left_all[..., -1] >= min_examples)
@@ -177,20 +182,27 @@ def grow_tree(
             # Exact per-node sampling of `candidate_features` features
             # without replacement (reference: per-node attribute sampling,
             # ydf/learner/decision_tree/training.cc FindBestCondition).
-            scores = jax.random.uniform(k_feat, (L, F))
+            scores = jax.random.uniform(k_feat, (Ld, F))
+            if num_valid_features is not None and num_valid_features < F:
+                # Constant-zero pad columns (feature-parallel padding) must
+                # not consume sample slots — they'd dilute the real
+                # candidate set relative to the unpadded configuration.
+                scores = jnp.where(
+                    jnp.arange(F) < num_valid_features, scores, -1.0
+                )
             kth = jax.lax.top_k(scores, candidate_features)[0][:, -1]
             valid &= (scores >= kth[:, None])[:, :, None]
         gain = jnp.where(valid, gain, -jnp.inf)
 
         # ---- best cut per frontier slot --------------------------------- #
-        flat = gain.reshape(L, F * B)
+        flat = gain.reshape(Ld, F * B)
         best_idx = jnp.argmax(flat, axis=1)
         best_gain = jnp.take_along_axis(flat, best_idx[:, None], 1)[:, 0]
         best_f = (best_idx // B).astype(i32)
         best_t = (best_idx % B).astype(i32)
 
         do_split = active & jnp.isfinite(best_gain) & (best_gain > min_split_gain)
-        if children_in_frontier and 2 ** (depth + 1) > L:
+        if children_in_frontier and 2 * Ld > L:
             # Frontier overflow: keep the top-L/2 splits by gain, the rest
             # become leaves (breadth-first analogue of the reference's
             # best-first growth cap, training.cc:4580).
@@ -206,8 +218,8 @@ def grow_tree(
         # ranks of surviving slots are unchanged.
         rank0 = jnp.cumsum(do_split.astype(i32)) - 1
         do_split &= num_nodes + 2 * (rank0 + 1) <= N
-        split_rank = jnp.cumsum(do_split.astype(i32)) - 1  # [L]
-        nid = frontier_id[:L]
+        split_rank = jnp.cumsum(do_split.astype(i32)) - 1  # [Ld]
+        nid = frontier_id[:Ld]
         wid = jnp.where(do_split, nid, N)  # write index (trash when no split)
         left_id = jnp.where(do_split, num_nodes + 2 * split_rank, N)
         right_id = jnp.where(do_split, left_id + 1, N)
@@ -215,10 +227,10 @@ def grow_tree(
         # Left-stats of the chosen cut (gather from the candidate cumsums).
         chosen = jnp.take_along_axis(
             left_all, best_f[:, None, None, None], axis=1
-        )[:, 0]  # [L, B, S]
+        )[:, 0]  # [Ld, B, S]
         left_stats = jnp.take_along_axis(
             chosen, best_t[:, None, None], axis=1
-        )[:, 0]  # [L, S]
+        )[:, 0]  # [Ld, S]
         right_stats = parent - left_stats
 
         is_cat_split = best_f >= Fn
@@ -227,12 +239,12 @@ def grow_tree(
         if Fc > 0:
             chosen_rank = jnp.take_along_axis(
                 ranks, jnp.maximum(best_f - Fn, 0)[:, None, None], axis=1
-            )[:, 0]  # [L, B]
+            )[:, 0]  # [Ld, B]
             go_left_bins = jnp.where(
                 is_cat_split[:, None],
                 chosen_rank <= best_t[:, None],
                 cut_ids[None, :] <= best_t[:, None],
-            )  # [L, B]
+            )  # [Ld, B]
         else:
             go_left_bins = cut_ids[None, :] <= best_t[:, None]
 
@@ -248,8 +260,10 @@ def grow_tree(
         num_nodes = num_nodes + 2 * jnp.sum(do_split.astype(i32))
 
         # ---- route examples --------------------------------------------- #
+        # Pad per-slot decision arrays from Ld up to L+1 so they can be
+        # indexed by `slot` (values in [0, Ld) ∪ {L}; L = inactive).
         pad = lambda a, fill: jnp.concatenate(
-            [a, jnp.full((1,) + a.shape[1:], fill, a.dtype)], 0
+            [a, jnp.full((L + 1 - Ld,) + a.shape[1:], fill, a.dtype)], 0
         )
         split_e = pad(do_split, False)[slot]
         bf_e = pad(best_f, 0)[slot]

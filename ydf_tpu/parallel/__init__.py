@@ -1,0 +1,15 @@
+from ydf_tpu.parallel.mesh import (
+    make_mesh,
+    shard_batch,
+    shard_batch_and_features,
+    DATA_AXIS,
+    FEATURE_AXIS,
+)
+
+__all__ = [
+    "make_mesh",
+    "shard_batch",
+    "shard_batch_and_features",
+    "DATA_AXIS",
+    "FEATURE_AXIS",
+]

@@ -1,0 +1,90 @@
+"""Device-mesh distribution of training.
+
+This module replaces the ENTIRE distributed substrate of the reference —
+the manager/worker RPC abstraction (`ydf/utils/distribute/distribute.h:
+17-66`), the gRPC backend (`implementations/grpc/`), the on-disk dataset
+cache (`distributed_decision_tree/dataset_cache/`), and the 12-message
+feature-parallel worker protocol of distributed GBT
+(`distributed_gradient_boosted_trees/worker.proto:65-247`) — with the
+TPU-native formulation: a single-controller SPMD program over a
+`jax.sharding.Mesh`.
+
+Mapping (SURVEY.md §2.3.3 checklist):
+  * example-sharding (data parallelism): the bin matrix / gradients are
+    sharded over the `data` mesh axis; the per-layer histogram contraction
+    produces partial histograms whose psum over ICI *is* the reference's
+    manager-side merge of worker FindSplits answers.
+  * feature-parallel (the reference's model-parallel dimension): shard the
+    bin matrix's feature axis over the `feature` mesh axis; per-node argmax
+    then needs an all-gather over the feature axis. The ShareSplits /
+    GetSplitValue worker↔worker bitmap exchange (`worker.proto:199-207`)
+    disappears entirely: the example→node map is itself row-sharded and
+    updated locally after the (replicated) split decision.
+  * multi-host/slice: jax.distributed initialization + the same mesh over
+    DCN; nothing in this file changes.
+
+All of this is expressed as sharding ANNOTATIONS on the inputs of the
+already-jitted training loop — XLA GSPMD inserts the collectives. No
+explicit psum calls are needed in the grower; the one-hot matmul histogram
+contracts over the (sharded) example axis, so GSPMD emits exactly the
+all-reduce the hand-written protocol would.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+FEATURE_AXIS = "feature"
+
+
+def make_mesh(
+    devices: Optional[Sequence] = None,
+    data_parallelism: Optional[int] = None,
+    feature_parallelism: int = 1,
+) -> Mesh:
+    """Builds a (data, feature) mesh. Defaults to all devices on data."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if data_parallelism is None:
+        data_parallelism = n // feature_parallelism
+    if data_parallelism * feature_parallelism != n:
+        raise ValueError(
+            f"mesh {data_parallelism}x{feature_parallelism} != {n} devices"
+        )
+    arr = np.array(devices).reshape(data_parallelism, feature_parallelism)
+    return Mesh(arr, (DATA_AXIS, FEATURE_AXIS))
+
+
+def shard_batch(mesh: Mesh, x, batch_dim: int = 0):
+    """Places x sharded over the data axis on `batch_dim`, replicated on
+    feature. The batch dim must already be a multiple of the data-axis
+    size — use `pad_rows_to_multiple` first (as the GBT learner does)."""
+    spec = [None] * np.ndim(x)
+    spec[batch_dim] = DATA_AXIS
+    return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+
+def shard_batch_and_features(mesh: Mesh, bins):
+    """Shards the [n, F] bin matrix over (data, feature)."""
+    return jax.device_put(bins, NamedSharding(mesh, P(DATA_AXIS, FEATURE_AXIS)))
+
+
+def replicate(mesh: Mesh, x):
+    return jax.device_put(x, NamedSharding(mesh, P()))
+
+
+def pad_rows_to_multiple(arrs, multiple: int) -> Tuple[list, int]:
+    """Pads each array's axis-0 to a multiple (zero weight rows must be
+    appended by the caller via its weight array)."""
+    n = arrs[0].shape[0]
+    pad = (-n) % multiple
+    if pad == 0:
+        return list(arrs), 0
+    out = [np.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1)) for a in arrs]
+    return out, pad
