@@ -29,6 +29,7 @@ from ydf_tpu.learners.random_forest import RandomForestLearner
 from ydf_tpu.learners.cart import CartLearner
 from ydf_tpu.learners.isolation_forest import IsolationForestLearner
 from ydf_tpu.models.io import load_model
+from ydf_tpu.models.ydf_format import load_ydf_model
 from ydf_tpu.config import Task
 
 __version__ = "0.1.0"
@@ -44,5 +45,6 @@ __all__ = [
     "CartLearner",
     "IsolationForestLearner",
     "load_model",
+    "load_ydf_model",
     "Task",
 ]

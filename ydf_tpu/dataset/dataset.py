@@ -131,16 +131,22 @@ class Dataset:
     # Encoded views (model-internal representations)
     # ------------------------------------------------------------------ #
 
-    def encoded_numerical(self, name: str) -> np.ndarray:
-        """float32 values with missing → column-mean global imputation."""
+    def encoded_numerical(self, name: str, impute: bool = True) -> np.ndarray:
+        """float32 values; missing → column-mean global imputation, or kept
+        as NaN when impute=False (native na_value routing)."""
         col = self.dataspec.column_by_name(name)
         raw = self.data[name]
         vals = raw.astype(np.float32) if raw.dtype != np.float32 else raw.copy()
-        vals = np.where(np.isnan(vals), np.float32(col.mean), vals)
+        if impute:
+            vals = np.where(np.isnan(vals), np.float32(col.mean), vals)
         return vals
 
-    def encoded_categorical(self, name: str) -> np.ndarray:
-        """int32 dictionary indices; missing/unknown → 0 (OOV)."""
+    def encoded_categorical(
+        self, name: str, missing_code: int = 0
+    ) -> np.ndarray:
+        """int32 dictionary indices; unknown → 0 (OOV), missing →
+        `missing_code` (0 = OOV for our learners, -1 for native na_value
+        routing of imported models)."""
         col = self.dataspec.column_by_name(name)
         raw = self.data[name]
         assert col.vocabulary is not None
@@ -156,7 +162,10 @@ class Dataset:
             keys = [
                 "" if m else str(v) for v, m in zip(raw.tolist(), missing)
             ]
-        return np.array([lookup.get(k, 0) for k in keys], dtype=np.int32)
+        return np.array(
+            [missing_code if k == "" else lookup.get(k, 0) for k in keys],
+            dtype=np.int32,
+        )
 
     def encoded_label(self, name: str, task) -> np.ndarray:
         """Label encoding: classification → int32 in [0, C) (dictionary order,

@@ -29,6 +29,12 @@ class Forest(NamedTuple):
     left: jax.Array           # [T, N] i32
     right: jax.Array          # [T, N] i32
     is_leaf: jax.Array        # [T, N] bool
+    # [T, N] bool: direction of MISSING values in value-space routing (NaN
+    # numerical / negative categorical code). Our own learners impute
+    # missing at encode time so this never triggers for them; imported YDF
+    # models carry the reference's learned per-node na_value (inverted:
+    # na_value=true routes to the positive=right child).
+    na_left: jax.Array
     leaf_value: jax.Array     # [T, N, V] f32
     num_nodes: jax.Array      # [T] i32
 
@@ -49,6 +55,9 @@ class Forest(NamedTuple):
 
     @staticmethod
     def from_numpy(d: dict) -> "Forest":
+        d = dict(d)
+        if "na_left" not in d:  # saves from before the na_left field
+            d["na_left"] = np.zeros(np.shape(d["feature"]), bool)
         return Forest(**{f: jnp.asarray(d[f]) for f in Forest._fields})
 
 
@@ -75,6 +84,7 @@ def forest_from_stacked_trees(
         left=jnp.asarray(stacked_trees.left),
         right=jnp.asarray(stacked_trees.right),
         is_leaf=jnp.asarray(stacked_trees.is_leaf),
+        na_left=jnp.zeros(feature.shape, jnp.bool_),
         leaf_value=jnp.asarray(leaf_value),
         num_nodes=jnp.asarray(stacked_trees.num_nodes),
     )

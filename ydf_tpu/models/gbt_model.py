@@ -42,11 +42,12 @@ class GradientBoostedTreesModel(GenericModel):
         loss_name: str,
         training_logs: Optional[Dict[str, Any]] = None,
         extra_metadata=None,
+        native_missing: bool = False,
     ):
         super().__init__(
             task=task, label=label, classes=classes, dataspec=dataspec,
             binner=binner, forest=forest, max_depth=max_depth,
-            extra_metadata=extra_metadata,
+            extra_metadata=extra_metadata, native_missing=native_missing,
         )
         self.initial_predictions = np.asarray(initial_predictions, np.float32)
         self.num_trees_per_iter = num_trees_per_iter

@@ -38,6 +38,7 @@ class GenericModel:
         forest: Forest,
         max_depth: int,
         extra_metadata: Optional[Dict[str, Any]] = None,
+        native_missing: bool = False,
     ):
         self.task = task
         self.label = label
@@ -47,6 +48,12 @@ class GenericModel:
         self.forest = forest
         self.max_depth = max_depth
         self.extra_metadata = extra_metadata or {}
+        # True: missing values reach routing as NaN / -1 and follow the
+        # forest's per-node na_left direction (the reference's NodeCondition
+        # na_value semantics) — used by models imported from YDF format.
+        # False: global imputation at encode time (our learners' training
+        # semantics, reference training.cc LocalImputation*).
+        self.native_missing = native_missing
 
     # ------------------------------------------------------------------ #
     # Introspection
@@ -91,14 +98,23 @@ class GenericModel:
         for i, name in enumerate(b.feature_names):
             if i < b.num_numerical:
                 if ds.dataspec.has_column(name) and name in ds.data:
-                    x_num[:, i] = ds.encoded_numerical(name)
+                    x_num[:, i] = ds.encoded_numerical(
+                        name, impute=not self.native_missing
+                    )
                 else:
-                    x_num[:, i] = b.impute_values[i]
+                    # Whole column absent = every value missing.
+                    x_num[:, i] = (
+                        np.nan if self.native_missing else b.impute_values[i]
+                    )
             else:
                 j = i - b.num_numerical
                 if ds.dataspec.has_column(name) and name in ds.data:
-                    idx = ds.encoded_categorical(name)
+                    idx = ds.encoded_categorical(
+                        name, missing_code=-1 if self.native_missing else 0
+                    )
                     x_cat[:, j] = np.where(idx >= b.num_bins, 0, idx)
+                elif self.native_missing:
+                    x_cat[:, j] = -1
         return x_num, x_cat
 
     def _raw_scores(self, data: InputData, combine: str) -> np.ndarray:

@@ -51,6 +51,7 @@ def save_model(model: GenericModel, path: str) -> None:
         "max_depth": model.max_depth,
         "dataspec": model.dataspec.to_json(),
         "binner": model.binner.to_json(),
+        "native_missing": model.native_missing,
         "extra_metadata": model.extra_metadata,
         "specific": model._metadata(),
     }
@@ -63,6 +64,11 @@ def save_model(model: GenericModel, path: str) -> None:
 
 def load_model(path: str) -> GenericModel:
     _ensure_registry()
+    if not os.path.isfile(os.path.join(path, "model.json")):
+        from ydf_tpu.models import ydf_format
+
+        if ydf_format.is_ydf_model_dir(path):
+            return ydf_format.load_ydf_model(path)
     with open(os.path.join(path, "model.json")) as f:
         meta = json.load(f)
     with np.load(os.path.join(path, "forest.npz")) as z:
@@ -77,5 +83,6 @@ def load_model(path: str) -> GenericModel:
         forest=forest,
         max_depth=meta["max_depth"],
         extra_metadata=meta.get("extra_metadata") or {},
+        native_missing=meta.get("native_missing", False),
     )
     return cls._from_saved(common, meta["specific"])

@@ -1,0 +1,618 @@
+"""Reader for the reference YDF serialized-model directory format.
+
+A YDF model directory (reference `model_library.cc` SaveModel/LoadModel)
+contains:
+  header.pb                     AbstractModel proto (abstract_model.proto:66)
+  data_spec.pb                  DataSpecification (data_spec.proto:49)
+  <type>_header.pb              per-model header (e.g. gradient_boosted_trees.proto:24)
+  nodes-%05d-of-%05d            sharded node records, preorder per tree
+  done                          marker file
+
+Node shards are blob sequences (`utils/blob_sequence.h:125-149`): an 8-byte
+file header {magic 'BS', uint16 LE version, uint8 compression, reserved},
+then uint32-LE length-prefixed records (gzip-wrapped when compression=1).
+Each record is a decision_tree.proto:202 Node. Trees are serialized
+depth-first, NEGATIVE child before POSITIVE child
+(`model/decision_tree/decision_tree.cc:580-599`); a node is a leaf iff it
+has no condition submessage.
+
+Everything here is a clean-room decode of those file-format facts via the
+schema-less wire reader in ydf_tpu/utils/protowire.py — no reference code
+or protoc output is used. Field numbers are cited inline.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ydf_tpu.config import Task
+from ydf_tpu.dataset.binning import Binner
+from ydf_tpu.dataset.dataspec import (
+    Column,
+    ColumnType,
+    DataSpecification,
+    OOV_ITEM,
+)
+from ydf_tpu.models.forest import Forest
+from ydf_tpu.utils import protowire as pw
+
+# --------------------------------------------------------------------- #
+# Blob sequence
+# --------------------------------------------------------------------- #
+
+
+def read_blob_sequence(path: str) -> Iterator[bytes]:
+    """Yields the records of a blob-sequence file."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if len(data) < 8 or data[0:2] != b"BS":
+        raise ValueError(f"{path}: not a blob sequence (bad magic)")
+    version = struct.unpack_from("<H", data, 2)[0]
+    compression = data[4]
+    pos = 8
+    if version >= 1 and compression == 1:
+        data = data[:8] + gzip.decompress(data[8:])
+    while pos < len(data):
+        (length,) = struct.unpack_from("<I", data, pos)
+        pos += 4
+        yield data[pos : pos + length]
+        pos += length
+
+
+# --------------------------------------------------------------------- #
+# Dataspec
+# --------------------------------------------------------------------- #
+
+# data_spec.proto:61-85 ColumnType enum values.
+_COLTYPE = {
+    0: ColumnType.UNKNOWN,
+    1: ColumnType.NUMERICAL,
+    4: ColumnType.CATEGORICAL,
+    5: ColumnType.CATEGORICAL_SET,
+    7: ColumnType.BOOLEAN,
+    9: ColumnType.DISCRETIZED_NUMERICAL,
+    10: ColumnType.HASH,
+    11: ColumnType.NUMERICAL_VECTOR_SEQUENCE,
+}
+
+
+class _YdfColumn:
+    """Decoded reference column: our Column + import-only extras."""
+
+    def __init__(self, col: Column, disc_boundaries: Optional[np.ndarray]):
+        self.col = col
+        self.disc_boundaries = disc_boundaries
+
+
+def _parse_column(msg: pw.Message) -> _YdfColumn:
+    """data_spec.proto:88-126 Column."""
+    ctype = _COLTYPE.get(pw.get_int(msg, 1, 0), ColumnType.UNKNOWN)
+    name = pw.get_str(msg, 2)
+    col = Column(name=name, type=ctype)
+    col.num_missing = pw.get_sint(msg, 7, 0)  # count_nas = 7
+
+    num = pw.get_msg(msg, 5)  # numerical = 5 (NumericalSpec, :209-216)
+    if num is not None:
+        col.mean = pw.get_double(num, 1, 0.0)
+        col.min_value = pw.get_float(num, 2, 0.0)
+        col.max_value = pw.get_float(num, 3, 0.0)
+
+    disc_boundaries = None
+    disc = pw.get_msg(msg, 8)  # discretized_numerical = 8 (:267-279)
+    if disc is not None:
+        disc_boundaries = pw.get_packed_floats(disc, 1)
+
+    cat = pw.get_msg(msg, 6)  # categorical = 6 (CategoricalSpec, :150-208)
+    if cat is not None:
+        n_unique = pw.get_sint(cat, 2, 0)  # number_of_unique_values = 2
+        integerized = pw.get_bool(cat, 5)  # is_already_integerized = 5
+        items = pw.get_repeated_msg(cat, 7)  # items map = 7
+        if items and not integerized:
+            vocab: List[Optional[str]] = [None] * n_unique
+            counts = [0] * n_unique
+            for entry in items:  # map entry: key = 1, value = 2
+                key = pw.get_bytes(entry, 1).decode("utf-8")
+                vv = pw.get_msg(entry, 2)  # VocabValue: index = 1, count = 2
+                idx = pw.get_sint(vv, 1, 0) if vv else 0
+                cnt = pw.get_sint(vv, 2, 0) if vv else 0
+                if 0 <= idx < n_unique:
+                    vocab[idx] = key
+                    counts[idx] = cnt
+            col.vocabulary = [
+                (v if v is not None else (OOV_ITEM if i == 0 else f"<unk:{i}>"))
+                for i, v in enumerate(vocab)
+            ]
+            col.vocab_counts = counts
+        else:
+            # Integerized: the raw value IS the index (0 = out-of-dictionary).
+            col.vocabulary = [
+                OOV_ITEM if i == 0 else str(i) for i in range(max(n_unique, 1))
+            ]
+            col.vocab_counts = [0] * max(n_unique, 1)
+
+    booln = pw.get_msg(msg, 9)  # boolean = 9 (BooleanSpec, :232-235)
+    if booln is not None:
+        ct = pw.get_sint(booln, 1, 0)
+        cf = pw.get_sint(booln, 2, 0)
+        col.mean = ct / max(ct + cf, 1)
+
+    return _YdfColumn(col, disc_boundaries)
+
+
+def parse_dataspec(buf: bytes) -> Tuple[DataSpecification, List[_YdfColumn]]:
+    msg = pw.decode(buf)
+    ycols = [_parse_column(m) for m in pw.get_repeated_msg(msg, 1)]
+    spec = DataSpecification(
+        columns=[y.col for y in ycols],
+        created_num_rows=pw.get_sint(msg, 2, 0),
+    )
+    return spec, ycols
+
+
+# --------------------------------------------------------------------- #
+# Node records → trees
+# --------------------------------------------------------------------- #
+
+
+class _Node:
+    __slots__ = (
+        "is_leaf", "attribute", "cond_type", "cond", "na_value",
+        "leaf", "neg", "pos",
+    )
+
+    def __init__(self):
+        self.is_leaf = True
+        self.attribute = -1
+        self.cond_type = 0
+        self.cond: Optional[pw.Message] = None
+        self.na_value = False
+        self.leaf: Optional[pw.Message] = None
+        self.neg: Optional["_Node"] = None
+        self.pos: Optional["_Node"] = None
+
+
+def _parse_node(buf: bytes) -> _Node:
+    """decision_tree.proto:202 Node."""
+    msg = pw.decode(buf)
+    node = _Node()
+    cond = pw.get_msg(msg, 3)  # condition = 3 (NodeCondition, :179-199)
+    if cond is not None:
+        node.is_leaf = False
+        node.na_value = pw.get_bool(cond, 1)  # na_value = 1
+        node.attribute = pw.get_sint(cond, 2, -1)  # attribute = 2
+        inner = pw.get_msg(cond, 3)  # condition = 3 (Condition, :86-176)
+        if inner is None:
+            raise ValueError("non-leaf node without condition type")
+        # Oneof (decision_tree.proto:164-173): exactly one field set.
+        for f in (1, 2, 3, 4, 5, 6, 7, 8):
+            if f in inner:
+                node.cond_type = f
+                node.cond = pw.decode(bytes(inner[f][-1]))
+                break
+        else:
+            raise ValueError("unknown condition type")
+    node.leaf = msg  # leaf payload read lazily by the model-specific reader
+    return node
+
+
+def _read_tree(records: Iterator[bytes]) -> _Node:
+    """One tree: preorder, negative child first (decision_tree.cc:580-599)."""
+    node = _parse_node(next(records))
+    if not node.is_leaf:
+        node.neg = _read_tree(records)
+        node.pos = _read_tree(records)
+    return node
+
+
+def read_trees(model_dir: str, num_shards: int, num_trees: int) -> List[_Node]:
+    def record_iter():
+        for shard in range(num_shards):
+            path = os.path.join(
+                model_dir, f"nodes-{shard:05d}-of-{num_shards:05d}"
+            )
+            yield from read_blob_sequence(path)
+
+    it = record_iter()
+    return [_read_tree(it) for _ in range(num_trees)]
+
+
+# --------------------------------------------------------------------- #
+# Trees → Forest arrays
+# --------------------------------------------------------------------- #
+
+
+class _FeatureMap:
+    """Maps reference column indices to our [numericals..., categoricals...]
+    serving layout (the order ydf_tpu's Binner uses)."""
+
+    def __init__(self, spec: DataSpecification, ycols: List[_YdfColumn],
+                 input_features: List[int]):
+        num_like, cat_like = [], []
+        for ci in input_features:
+            t = spec.columns[ci].type
+            if t == ColumnType.CATEGORICAL:
+                cat_like.append(ci)
+            elif t in (
+                ColumnType.NUMERICAL,
+                ColumnType.BOOLEAN,
+                ColumnType.DISCRETIZED_NUMERICAL,
+            ):
+                num_like.append(ci)
+            else:
+                raise NotImplementedError(
+                    f"import of column type {t} is not supported yet"
+                )
+        self.num_cols = num_like
+        self.cat_cols = cat_like
+        self.col_to_feature: Dict[int, int] = {}
+        for i, ci in enumerate(num_like + cat_like):
+            self.col_to_feature[ci] = i
+        self.num_numerical = len(num_like)
+        self.ycols = ycols
+        self.spec = spec
+
+    @property
+    def feature_names(self) -> List[str]:
+        return [
+            self.spec.columns[ci].name for ci in self.num_cols + self.cat_cols
+        ]
+
+    @property
+    def max_vocab(self) -> int:
+        vs = [self.spec.columns[ci].vocab_size for ci in self.cat_cols]
+        return max(vs, default=1)
+
+    def make_binner(self) -> Binner:
+        """A serving-only Binner: imputation values + layout. Imported models
+        route on raw values, so bin boundaries are unused (+inf filler)."""
+        F = len(self.col_to_feature)
+        num_bins = max(256, self.max_vocab + 1)
+        impute = np.zeros((F,), np.float32)
+        for i, ci in enumerate(self.num_cols):
+            impute[i] = self.spec.columns[ci].mean
+        return Binner(
+            feature_names=self.feature_names,
+            num_numerical=self.num_numerical,
+            num_bins=num_bins,
+            boundaries=np.full((F, 1), np.inf, np.float32),
+            impute_values=impute,
+            feature_num_bins=np.full((F,), 2, np.int32),
+        )
+
+
+def _bitmap_to_mask(bitmap: bytes, width_words: int) -> np.ndarray:
+    """ContainsBitmap bytes (bit i = category i matches → POSITIVE branch)
+    → our uint32 go-LEFT mask = complement (left is the negative child)."""
+    bits = np.frombuffer(bitmap, dtype=np.uint8)
+    words = np.zeros((width_words,), np.uint32)
+    as_u32 = np.zeros((width_words * 4,), np.uint8)
+    as_u32[: len(bits)] = bits[: width_words * 4]
+    words[:] = as_u32.view("<u4")
+    return ~words
+
+
+def _elements_to_mask(elements: List[int], width_words: int) -> np.ndarray:
+    words = np.zeros((width_words,), np.uint32)
+    for e in elements:
+        if 0 <= e < width_words * 32:
+            words[e >> 5] |= np.uint32(1) << np.uint32(e & 31)
+    return ~words
+
+
+def trees_to_forest(
+    trees: List[_Node],
+    fmap: _FeatureMap,
+    leaf_fn,
+    leaf_dim: int,
+) -> Tuple[Forest, int]:
+    """Flattens parsed trees into a Forest (preorder node ids; root = 0).
+
+    leaf_fn(node_msg, depth) -> np.ndarray [leaf_dim] leaf value.
+    Returns (forest, max_depth).
+    """
+    W = max((fmap.max_vocab + 31) // 32, 1)
+    T = len(trees)
+
+    per_tree = []
+    max_nodes, max_depth = 1, 1
+    for root in trees:
+        rows: List[dict] = []
+
+        def walk(node: _Node, depth: int) -> int:
+            idx = len(rows)
+            row = dict(
+                feature=-1, threshold=np.inf, is_cat=False,
+                cat_mask=np.full((W,), 0xFFFFFFFF, np.uint32),
+                left=0, right=0, is_leaf=node.is_leaf,
+                na_left=not node.na_value,
+                leaf_value=np.zeros((leaf_dim,), np.float32),
+            )
+            rows.append(row)
+            if node.is_leaf:
+                row["leaf_value"] = leaf_fn(node.leaf, depth)
+                return idx
+            ci = node.attribute
+            row["feature"] = fmap.col_to_feature[ci]
+            ct, c = node.cond_type, node.cond
+            if ct == 2:  # Higher: value >= threshold → positive (:93-96)
+                row["threshold"] = pw.get_float(c, 1)
+            elif ct == 3:  # TrueValue on BOOLEAN (:91)
+                row["threshold"] = 0.5
+            elif ct == 4:  # ContainsVector (:98-101)
+                row["is_cat"] = True
+                row["cat_mask"] = _elements_to_mask(
+                    pw.get_packed_varints(c, 1), W
+                )
+            elif ct == 5:  # ContainsBitmap (:104-108)
+                row["is_cat"] = True
+                row["cat_mask"] = _bitmap_to_mask(pw.get_bytes(c, 1), W)
+            elif ct == 6:  # DiscretizedHigher (:110-113)
+                t = pw.get_sint(c, 1)
+                b = fmap.ycols[ci].disc_boundaries
+                if b is None or len(b) == 0:
+                    raise ValueError("discretized condition without boundaries")
+                row["threshold"] = float(b[min(max(t - 1, 0), len(b) - 1)])
+            elif ct == 1:  # NA: value is missing → positive (:89)
+                # Non-missing always goes left (v < inf / every mask bit
+                # set), missing follows na_left=False → right. Categorical
+                # attributes must route through the is_cat path so the
+                # missing code (-1) is recognized.
+                row["threshold"] = np.inf
+                row["is_cat"] = (
+                    fmap.spec.columns[ci].type == ColumnType.CATEGORICAL
+                )
+                row["na_left"] = False
+            elif ct == 7:
+                raise NotImplementedError(
+                    "oblique conditions not supported yet"
+                )
+            else:
+                raise NotImplementedError(f"condition type {ct}")
+            # Negative child → left, positive child → right (our routing:
+            # v < threshold / mask-bit set → left).
+            row["left"] = walk(node.neg, depth + 1)
+            row["right"] = walk(node.pos, depth + 1)
+            return idx
+
+        def depth_of(node: _Node) -> int:
+            if node.is_leaf:
+                return 0
+            return 1 + max(depth_of(node.neg), depth_of(node.pos))
+
+        walk(root, 0)
+        per_tree.append(rows)
+        max_nodes = max(max_nodes, len(rows))
+        max_depth = max(max_depth, depth_of(root))
+
+    def stack(field, dtype, shape=()):
+        out = np.zeros((T, max_nodes) + shape, dtype)
+        if field == "feature":
+            out[:] = -1
+        if field == "is_leaf":
+            out[:] = True
+        for t, rows in enumerate(per_tree):
+            for i, row in enumerate(rows):
+                out[t, i] = row[field]
+        return out
+
+    forest = Forest(
+        feature=stack("feature", np.int32),
+        threshold=stack("threshold", np.float32),
+        threshold_bin=np.zeros((T, max_nodes), np.int32),
+        is_cat=stack("is_cat", np.bool_),
+        cat_mask=stack("cat_mask", np.uint32, (W,)),
+        left=stack("left", np.int32),
+        right=stack("right", np.int32),
+        is_leaf=stack("is_leaf", np.bool_),
+        na_left=stack("na_left", np.bool_),
+        leaf_value=stack("leaf_value", np.float32, (leaf_dim,)),
+        num_nodes=np.array([len(r) for r in per_tree], np.int32),
+    )
+    return forest, max(max_depth, 1)
+
+
+# --------------------------------------------------------------------- #
+# Leaf readers (decision_tree.proto:23-82)
+# --------------------------------------------------------------------- #
+
+
+def _leaf_regressor_top_value(leaf_msg: pw.Message, depth: int) -> np.ndarray:
+    reg = pw.get_msg(leaf_msg, 2)  # Node.regressor = 2
+    v = pw.get_float(reg, 1, 0.0) if reg else 0.0  # top_value = 1
+    return np.array([v], np.float32)
+
+
+def _make_leaf_classifier(num_classes: int):
+    def leaf(leaf_msg: pw.Message, depth: int) -> np.ndarray:
+        cls = pw.get_msg(leaf_msg, 1)  # Node.classifier = 1
+        out = np.zeros((num_classes,), np.float32)
+        if cls is None:
+            return out
+        dist = pw.get_msg(cls, 2)  # distribution = 2 (IntegerDistributionDouble)
+        if dist is not None:
+            counts = pw.get_packed_doubles(dist, 1)  # counts = 1, index 0 = OOV
+            total = counts[1 : num_classes + 1].sum()
+            if total > 0:
+                out[: len(counts) - 1] = counts[1 : num_classes + 1] / total
+                return out
+        top = pw.get_sint(cls, 1, 0)  # top_value = 1 (label index, 1-based)
+        if 1 <= top <= num_classes:
+            out[top - 1] = 1.0
+        return out
+
+    return leaf
+
+
+def _make_leaf_anomaly():
+    from ydf_tpu.models.if_model import average_path_length
+
+    def leaf(leaf_msg: pw.Message, depth: int) -> np.ndarray:
+        ad = pw.get_msg(leaf_msg, 6)  # Node.anomaly_detection = 6
+        n = pw.get_sint(ad, 1, 0) if ad else 0  # num_examples_without_weight
+        return np.array(
+            [depth + float(average_path_length(n))], np.float32
+        )
+
+    return leaf
+
+
+# --------------------------------------------------------------------- #
+# Model assembly
+# --------------------------------------------------------------------- #
+
+# abstract_model.proto:25-62 Task enum.
+_TASK = {
+    1: Task.CLASSIFICATION,
+    2: Task.REGRESSION,
+    3: Task.RANKING,
+    4: Task.CATEGORICAL_UPLIFT,
+    5: Task.NUMERICAL_UPLIFT,
+    6: Task.ANOMALY_DETECTION,
+    7: Task.SURVIVAL_ANALYSIS,
+}
+
+# gradient_boosted_trees.proto:56-81 Loss enum → our loss names.
+_GBT_LOSS = {
+    0: "DEFAULT",
+    1: "BINOMIAL_LOG_LIKELIHOOD",
+    2: "SQUARED_ERROR",
+    3: "MULTINOMIAL_LOG_LIKELIHOOD",
+    5: "XE_NDCG_MART",
+    6: "BINARY_FOCAL_LOSS",
+    7: "POISSON",
+    8: "MEAN_AVERAGE_ERROR",
+    9: "LAMBDA_MART_NDCG",
+    10: "COX_PROPORTIONAL_HAZARD",
+}
+
+
+def _check_node_format(fmt: str, path: str) -> None:
+    """Node container format (e.g. gradient_boosted_trees.proto:42). Only
+    the blob-sequence containers are supported; old TFE_RECORDIO models
+    get an explicit error instead of a bad-magic failure."""
+    if fmt and not fmt.startswith("BLOB_SEQUENCE"):
+        raise NotImplementedError(
+            f"{path}: node container format {fmt!r} is not supported "
+            "(only BLOB_SEQUENCE / BLOB_SEQUENCE_GZIP)"
+        )
+
+
+def _read_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def is_ydf_model_dir(path: str) -> bool:
+    return os.path.isfile(os.path.join(path, "data_spec.pb")) and os.path.isfile(
+        os.path.join(path, "header.pb")
+    )
+
+
+def load_ydf_model(path: str):
+    """Loads a model saved by the reference implementation.
+
+    Supports GBT, RF and Isolation Forest with numerical / categorical /
+    boolean / discretized-numerical conditions. Returns the matching
+    ydf_tpu model class, predicting through the standard Forest engines.
+    """
+    if not is_ydf_model_dir(path):
+        raise ValueError(f"{path} is not a YDF model directory")
+    header = pw.decode(_read_file(os.path.join(path, "header.pb")))
+    spec, ycols = parse_dataspec(_read_file(os.path.join(path, "data_spec.pb")))
+
+    # AbstractModel (abstract_model.proto:66-116)
+    name = pw.get_str(header, 1)
+    task = _TASK.get(pw.get_int(header, 2, 0), Task.CLASSIFICATION)
+    label_col_idx = pw.get_sint(header, 3, -1)
+    input_features = pw.get_packed_varints(header, 5)
+
+    label = None
+    classes = None
+    if 0 <= label_col_idx < len(spec.columns):
+        label_col = spec.columns[label_col_idx]
+        label = label_col.name
+        if task == Task.CLASSIFICATION and label_col.vocabulary:
+            classes = list(label_col.vocabulary[1:])
+
+    fmap = _FeatureMap(spec, ycols, input_features)
+    binner = fmap.make_binner()
+
+    gbt_path = os.path.join(path, "gradient_boosted_trees_header.pb")
+    rf_path = os.path.join(path, "random_forest_header.pb")
+    if_path = os.path.join(path, "isolation_forest_header.pb")
+
+    if os.path.isfile(gbt_path):
+        from ydf_tpu.models.gbt_model import GradientBoostedTreesModel
+
+        # gradient_boosted_trees.proto:24-52 Header.
+        gh = pw.decode(_read_file(gbt_path))
+        num_shards = pw.get_sint(gh, 1, 1)
+        num_trees = pw.get_sint(gh, 2, 0)
+        _check_node_format(pw.get_str(gh, 7, ""), path)
+        loss_name = _GBT_LOSS.get(pw.get_int(gh, 3, 0), "DEFAULT")
+        init_preds = pw.get_packed_floats(gh, 4)
+        trees = read_trees(path, num_shards, num_trees)
+        forest, max_depth = trees_to_forest(
+            trees, fmap, _leaf_regressor_top_value, 1
+        )
+        K = max(len(init_preds), 1)
+        return GradientBoostedTreesModel(
+            task=task, label=label, classes=classes, dataspec=spec,
+            binner=binner, forest=forest,
+            initial_predictions=np.asarray(init_preds, np.float32),
+            num_trees_per_iter=K, max_depth=max_depth, loss_name=loss_name,
+            native_missing=True,
+            extra_metadata={"imported_from": "ydf", "name": name},
+        )
+
+    if os.path.isfile(rf_path):
+        from ydf_tpu.models.rf_model import RandomForestModel
+
+        # random_forest.proto:24-46 Header.
+        rh = pw.decode(_read_file(rf_path))
+        num_shards = pw.get_sint(rh, 1, 1)
+        num_trees = pw.get_sint(rh, 2, 0)
+        _check_node_format(pw.get_str(rh, 7, ""), path)
+        winner_take_all = pw.get_bool(rh, 3, True)
+        trees = read_trees(path, num_shards, num_trees)
+        if task == Task.CLASSIFICATION:
+            ncls = len(classes) if classes else 2
+            leaf_fn, leaf_dim = _make_leaf_classifier(ncls), ncls
+        else:
+            leaf_fn, leaf_dim = _leaf_regressor_top_value, 1
+        forest, max_depth = trees_to_forest(trees, fmap, leaf_fn, leaf_dim)
+        return RandomForestModel(
+            task=task, label=label, classes=classes, dataspec=spec,
+            binner=binner, forest=forest, max_depth=max_depth,
+            winner_take_all=winner_take_all, native_missing=True,
+            extra_metadata={"imported_from": "ydf", "name": name},
+        )
+
+    if os.path.isfile(if_path):
+        from ydf_tpu.models.if_model import IsolationForestModel
+
+        # isolation_forest.proto:27-45 Header.
+        ih = pw.decode(_read_file(if_path))
+        num_shards = pw.get_sint(ih, 1, 1)
+        num_trees = pw.get_sint(ih, 2, 0)
+        _check_node_format(pw.get_str(ih, 3, ""), path)
+        num_examples_per_tree = pw.get_sint(ih, 4, 256)
+        trees = read_trees(path, num_shards, num_trees)
+        forest, max_depth = trees_to_forest(
+            trees, fmap, _make_leaf_anomaly(), 1
+        )
+        return IsolationForestModel(
+            task=Task.ANOMALY_DETECTION, label=label, classes=None,
+            dataspec=spec, binner=binner, forest=forest, max_depth=max_depth,
+            num_examples_per_tree=num_examples_per_tree, native_missing=True,
+            extra_metadata={"imported_from": "ydf", "name": name},
+        )
+
+    raise NotImplementedError(
+        f"{path}: no supported model header found (GBT/RF/IF)"
+    )

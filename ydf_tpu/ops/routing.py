@@ -33,8 +33,8 @@ i32 = jnp.int32
 def route_tree_bins(tree, bins: jax.Array, max_depth: int) -> jax.Array:
     """Leaf node id per example. tree: TreeArrays-like (single tree)."""
     n = bins.shape[0]
-    node = jnp.zeros((n,), i32)
-    for _ in range(max_depth):
+
+    def body(_, node):
         f = jnp.maximum(tree.feature[node], 0)
         b = jnp.take_along_axis(bins, f[:, None].astype(i32), axis=1)[:, 0]
         b = b.astype(i32)
@@ -44,8 +44,12 @@ def route_tree_bins(tree, bins: jax.Array, max_depth: int) -> jax.Array:
             b <= tree.threshold_bin[node],
         )
         nxt = jnp.where(go_left, tree.left[node], tree.right[node])
-        node = jnp.where(tree.is_leaf[node], node, nxt)
-    return node
+        return jnp.where(tree.is_leaf[node], node, nxt)
+
+    # fori_loop (not a Python loop): the body is traced once, keeping the
+    # graph size independent of depth — best-first-grown trees can be
+    # 50+ deep, which would explode an unrolled trace.
+    return jax.lax.fori_loop(0, max_depth, body, jnp.zeros((n,), i32))
 
 
 def route_tree_values(
@@ -57,8 +61,8 @@ def route_tree_values(
 ) -> jax.Array:
     """Leaf node id per example, value mode. tree.threshold is float."""
     n = x_num.shape[0] if x_num.size else x_cat.shape[0]
-    node = jnp.zeros((n,), i32)
-    for _ in range(max_depth):
+
+    def body(_, node):
         f = jnp.maximum(tree.feature[node], 0)
         is_cat = tree.is_cat[node]
         fn = jnp.clip(f, 0, max(x_num.shape[1] - 1, 0))
@@ -73,12 +77,19 @@ def route_tree_values(
             c = jnp.zeros((n,), i32)
         go_left = jnp.where(
             is_cat,
-            unpack_mask_bit(tree.cat_mask[node], c),
+            unpack_mask_bit(tree.cat_mask[node], jnp.maximum(c, 0)),
             v < tree.threshold[node],
         )
+        # Missing values (NaN numerical / negative categorical code) take
+        # the node's stored direction — the reference's NodeCondition
+        # na_value (decision_tree.proto:182), inverted to "goes left".
+        missing = jnp.where(is_cat, c < 0, jnp.isnan(v))
+        go_left = jnp.where(missing, tree.na_left[node], go_left)
         nxt = jnp.where(go_left, tree.left[node], tree.right[node])
-        node = jnp.where(tree.is_leaf[node], node, nxt)
-    return node
+        return jnp.where(tree.is_leaf[node], node, nxt)
+
+    # See route_tree_bins: fori_loop keeps trace size depth-independent.
+    return jax.lax.fori_loop(0, max_depth, body, jnp.zeros((n,), i32))
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth", "combine"))
