@@ -47,3 +47,10 @@ def abalone():
     import pandas as pd
 
     return pd.read_csv(os.path.join(REFERENCE_DATASET_DIR, "abalone.csv"))
+
+
+@pytest.fixture(scope="session")
+def iris_df():
+    import pandas as pd
+
+    return pd.read_csv(os.path.join(REFERENCE_DATASET_DIR, "iris.csv"))

@@ -1,0 +1,127 @@
+"""Analysis-suite tests: PDP, permutation importance, TreeSHAP, analyze.
+
+TreeSHAP correctness is pinned by the additivity identity
+sum(phi) + bias == raw score (reference shap_test.cc does the same)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+
+D = "/root/reference/yggdrasil_decision_forests/test_data/dataset"
+
+
+@pytest.fixture(scope="module")
+def adult_gbt(adult_train):
+    return ydf.GradientBoostedTreesLearner(
+        label="income", num_trees=10, max_depth=4
+    ).train(adult_train.head(3000))
+
+
+def test_shap_additivity_gbt(adult_gbt, adult_test):
+    te = adult_test.head(40)
+    phi, bias, rows = adult_gbt.predict_shap(te, max_rows=40)
+    p = adult_gbt.predict(te)
+    logit = np.log(p / (1 - p))
+    np.testing.assert_allclose(phi.sum(1)[:, 0] + bias[0], logit, atol=1e-5)
+
+
+def test_shap_additivity_rf_regression(abalone):
+    ab = abalone.head(1200)
+    rf = ydf.RandomForestLearner(
+        label="Rings", task=Task.REGRESSION, num_trees=5
+    ).train(ab)
+    te = ab.head(25)
+    phi, bias, _ = rf.predict_shap(te, max_rows=25)
+    np.testing.assert_allclose(
+        phi.sum(1)[:, 0] + bias[0], rf.predict(te), atol=1e-4
+    )
+
+
+def test_shap_additivity_multiclass(iris_df):
+    m = ydf.GradientBoostedTreesLearner(
+        label="class", num_trees=5, max_depth=3
+    ).train(iris_df)
+    phi, bias, _ = m.predict_shap(iris_df.head(20), max_rows=20)
+    assert phi.shape[2] == 3
+    proba = m.predict(iris_df.head(20))
+    raw = phi.sum(1) + bias[None, :]
+    softmax = np.exp(raw) / np.exp(raw).sum(1, keepdims=True)
+    np.testing.assert_allclose(softmax, proba, atol=1e-4)
+
+
+def test_shap_imported_model(adult_test):
+    m = ydf.load_ydf_model(
+        "/root/reference/yggdrasil_decision_forests/test_data/model/"
+        "adult_binary_class_gbdt"
+    )
+    te = adult_test.head(20)
+    phi, bias, _ = m.predict_shap(te, max_rows=20)
+    p = m.predict(te)
+    logit = np.log(p / (1 - p))
+    np.testing.assert_allclose(phi.sum(1)[:, 0] + bias[0], logit, atol=2e-3)
+
+
+def test_permutation_importance(adult_gbt, adult_test):
+    from ydf_tpu.analysis import permutation_importance
+
+    imps = permutation_importance(adult_gbt, adult_test, max_rows=2000)
+    by_name = {d["feature"]: d["importance"] for d in imps}
+    # The strongest known signals on adult dominate weak ones.
+    strong = max(by_name.get("capital_gain", 0), by_name.get("relationship", 0),
+                 by_name.get("marital_status", 0))
+    assert strong > 0.005
+    assert imps == sorted(imps, key=lambda d: -d["importance"])
+
+
+def test_structure_importances(adult_gbt):
+    from ydf_tpu.analysis import structure_importances
+
+    s = structure_importances(adult_gbt)
+    assert s["NUM_NODES"] and s["INV_MEAN_MIN_DEPTH"]
+    total_splits = sum(d["importance"] for d in s["NUM_NODES"])
+    n_internal = (
+        np.asarray(adult_gbt.forest.num_nodes).sum()
+        - (~np.asarray(adult_gbt.forest.is_leaf)).shape[0]
+    )
+    assert total_splits == float(
+        (~np.asarray(adult_gbt.forest.is_leaf))[
+            np.asarray(adult_gbt.forest.feature) >= 0
+        ].sum()
+    )
+
+
+def test_partial_dependence_numerical(adult_gbt, adult_test):
+    from ydf_tpu.analysis import partial_dependence
+
+    pdp = partial_dependence(
+        adult_gbt, adult_test, "age", num_bins=10, max_rows=300
+    )
+    assert len(pdp["values"]) == 10
+    assert pdp["mean_prediction"].shape[0] == 10
+    assert abs(sum(pdp["density"]) - 1.0) < 1e-6
+
+
+def test_partial_dependence_categorical(adult_gbt, adult_test):
+    from ydf_tpu.analysis import partial_dependence
+
+    pdp = partial_dependence(adult_gbt, adult_test, "education", max_rows=300)
+    assert pdp["type"] == "CATEGORICAL"
+    assert len(pdp["values"]) >= 5
+
+
+def test_analyze_end_to_end(adult_gbt, adult_test):
+    a = adult_gbt.analyze(adult_test.head(1000), num_pdp_features=2)
+    text = str(a)
+    assert "Permutation variable importances" in text
+    html = a.to_html()
+    assert html.startswith("<html>") and "PDP" in html
+    vi = a.variable_importances()
+    assert "MEAN_DECREASE_IN_METRIC" in vi and "NUM_NODES" in vi
+
+
+def test_analyze_prediction(adult_gbt, adult_test):
+    txt = adult_gbt.analyze_prediction(adult_test.head(1))
+    assert "bias:" in txt

@@ -1,0 +1,103 @@
+"""Metric-layer tests: CIs, ROC curves, comparison, cross-validation
+(reference test strategy: metric thresholds on real CSVs + statistical
+sanity, ydf/metric/metric_test.cc)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+from ydf_tpu.metrics import (
+    cross_validation,
+    fold_indices,
+    mcnemar_test,
+    paired_bootstrap_test,
+    roc_auc,
+    roc_curve_points,
+    wilson_interval,
+)
+
+D = "/root/reference/yggdrasil_decision_forests/test_data/dataset"
+
+
+def test_roc_curve_monotone_and_auc_consistent():
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, 2, 500)
+    scores = labels * 0.7 + rng.uniform(size=500) * 0.6
+    fpr, tpr, thr = roc_curve_points(labels, scores)
+    assert np.all(np.diff(fpr) >= 0) and np.all(np.diff(tpr) >= 0)
+    assert fpr[0] == 0 and tpr[0] == 0
+    assert fpr[-1] == 1 and tpr[-1] == 1
+    # trapezoid area ≈ rank-statistic AUC
+    area = float(np.trapezoid(tpr, fpr))
+    assert abs(area - roc_auc(labels, scores)) < 1e-9
+
+
+def test_wilson_interval_contains_p():
+    lo, hi = wilson_interval(0.9, 1000)
+    assert lo < 0.9 < hi and hi - lo < 0.05
+
+
+def test_evaluation_with_confidence_intervals(adult_train, adult_test):
+    m = ydf.GradientBoostedTreesLearner(label="income", num_trees=20).train(
+        adult_train
+    )
+    ev = m.evaluate(adult_test, confidence_intervals=True, num_bootstrap=100)
+    assert ev.confidence_intervals is not None
+    lo, hi = ev.confidence_intervals["accuracy"]
+    assert lo < ev.accuracy < hi
+    lo, hi = ev.confidence_intervals["auc"]
+    assert lo < ev.auc < hi
+    assert ev.roc_curve is not None
+    assert "CI95" in str(ev)
+    assert ev.precision > 0.5 and ev.recall > 0.3 and ev.f1 > 0.4
+
+
+def test_mcnemar():
+    labels = np.zeros(200)
+    p_good = np.zeros(200)
+    p_bad = np.zeros(200)
+    p_bad[:60] = 1  # 60 extra errors
+    r = mcnemar_test(labels, p_bad, p_good)
+    assert r["p_value"] < 0.01
+    r2 = mcnemar_test(labels, p_good, p_bad)
+    assert r2["p_value"] > 0.99
+
+
+def test_paired_bootstrap():
+    rng = np.random.RandomState(1)
+    labels = rng.randint(0, 2, 400)
+    good = labels + rng.normal(scale=0.5, size=400)
+    bad = labels + rng.normal(scale=2.0, size=400)
+    r = paired_bootstrap_test(labels, bad, good, roc_auc, num_bootstrap=100)
+    assert r["p_value"] < 0.05
+    assert r["metric2"] > r["metric1"]
+
+
+def test_fold_indices_stratified():
+    labels = np.array([0] * 90 + [1] * 10)
+    folds = fold_indices(100, 5, labels=labels)
+    for f in range(5):
+        m = folds == f
+        assert m.sum() == 20
+        assert labels[m].sum() == 2  # stratified: 2 positives per fold
+
+
+def test_cross_validation_classification(adult_train):
+    small = adult_train.head(2000)
+    learner = ydf.GradientBoostedTreesLearner(
+        label="income", num_trees=10, max_depth=4
+    )
+    ev = cross_validation(learner, small, num_folds=3)
+    assert ev.num_examples == 2000
+    assert ev.accuracy > 0.80, str(ev)
+
+
+def test_cross_validation_regression(abalone):
+    small = abalone.head(1500)
+    learner = ydf.RandomForestLearner(
+        label="Rings", task=Task.REGRESSION, num_trees=10
+    )
+    ev = cross_validation(learner, small, num_folds=3)
+    assert ev.rmse < 3.0, str(ev)

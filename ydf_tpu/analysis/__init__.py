@@ -1,0 +1,16 @@
+from ydf_tpu.analysis.partial_dependence import partial_dependence
+from ydf_tpu.analysis.importance import (
+    permutation_importance,
+    structure_importances,
+)
+from ydf_tpu.analysis.shap_values import tree_shap
+from ydf_tpu.analysis.analysis import Analysis, analyze
+
+__all__ = [
+    "partial_dependence",
+    "permutation_importance",
+    "structure_importances",
+    "tree_shap",
+    "Analysis",
+    "analyze",
+]

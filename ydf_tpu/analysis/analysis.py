@@ -1,0 +1,108 @@
+"""Model analysis report.
+
+Counterpart of the reference's `model_analysis::Analyse`
+(`ydf/utils/model_analysis.h:36-89`, surfaced as `model.analyze()` in the
+Python API): PDPs for the top features, permutation variable importances,
+structure importances — bundled in a printable (and HTML-renderable)
+report object.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ydf_tpu.analysis.importance import (
+    permutation_importance,
+    structure_importances,
+)
+from ydf_tpu.analysis.partial_dependence import partial_dependence
+
+
+@dataclasses.dataclass
+class Analysis:
+    model_type: str
+    task: str
+    permutation_importances: List[Dict]
+    structure_importances: Dict[str, List[Dict]]
+    partial_dependences: List[Dict]
+
+    def variable_importances(self) -> Dict[str, List[Dict]]:
+        out = dict(self.structure_importances)
+        out["MEAN_DECREASE_IN_METRIC"] = self.permutation_importances
+        return out
+
+    def __str__(self) -> str:
+        lines = [f"Analysis of {self.model_type} ({self.task})", ""]
+        lines.append("Permutation variable importances (metric decrease):")
+        for d in self.permutation_importances[:15]:
+            lines.append(
+                f"  {d['feature']:>30}: {d['importance']:+.5f} ({d['metric']})"
+            )
+        lines.append("")
+        for kind, vals in self.structure_importances.items():
+            lines.append(f"Structure importance [{kind}]:")
+            for d in vals[:10]:
+                lines.append(f"  {d['feature']:>30}: {d['importance']:.5g}")
+            lines.append("")
+        if self.partial_dependences:
+            feats = ", ".join(p["feature"] for p in self.partial_dependences)
+            lines.append(f"Partial dependence computed for: {feats}")
+        return "\n".join(lines)
+
+    def to_html(self) -> str:
+        """Self-contained HTML report (reference CreateHtmlReport,
+        model_analysis.h:46)."""
+        rows = "".join(
+            f"<tr><td>{d['feature']}</td><td>{d['importance']:+.5f}</td></tr>"
+            for d in self.permutation_importances
+        )
+        pdp_divs = []
+        for p in self.partial_dependences:
+            ys = np.asarray(p["mean_prediction"]).reshape(len(p["values"]), -1)
+            pts = ", ".join(
+                f"[{v!r}, {float(y[0]):.5f}]"
+                for v, y in zip(p["values"], ys)
+            )
+            pdp_divs.append(
+                f"<h3>PDP: {p['feature']} ({p['type']})</h3>"
+                f"<pre data-pdp='{p['feature']}'>[{pts}]</pre>"
+            )
+        return (
+            "<html><body>"
+            f"<h1>Model analysis — {self.model_type} ({self.task})</h1>"
+            "<h2>Permutation variable importances</h2>"
+            f"<table border=1><tr><th>feature</th><th>importance</th></tr>{rows}</table>"
+            + "".join(pdp_divs)
+            + "</body></html>"
+        )
+
+
+def analyze(
+    model,
+    data,
+    num_pdp_features: int = 4,
+    permutation_rounds: int = 1,
+    max_rows: int = 5000,
+    seed: int = 1234,
+) -> Analysis:
+    perm = permutation_importance(
+        model, data, num_rounds=permutation_rounds, max_rows=max_rows,
+        seed=seed,
+    )
+    struct = structure_importances(model)
+    top = [d["feature"] for d in perm[:num_pdp_features]]
+    pdps = [
+        partial_dependence(model, data, f, max_rows=min(max_rows, 1000),
+                           seed=seed)
+        for f in top
+    ]
+    return Analysis(
+        model_type=model.model_type,
+        task=model.task.value,
+        permutation_importances=perm,
+        structure_importances=struct,
+        partial_dependences=pdps,
+    )

@@ -1,0 +1,125 @@
+"""Variable importances.
+
+* Permutation importance — the reference's
+  `ComputePermutationFeatureImportance` (`ydf/utils/feature_importance.h:
+  65-99`): metric drop when one feature column is shuffled; repeated and
+  averaged. Each round is one batched predict (no per-example work).
+* Structure importances — from the trees themselves
+  (`ydf/model/decision_tree/structure_analysis.cc` / decision_tree.h:430):
+  NUM_NODES (split count per feature) and INV_MEAN_MIN_DEPTH.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ydf_tpu.dataset.dataset import Dataset
+
+
+def _primary_metric(model, ev) -> Tuple[str, float, float]:
+    """(name, value, sign): sign +1 if higher is better."""
+    from ydf_tpu.config import Task
+
+    if model.task == Task.CLASSIFICATION:
+        return "accuracy", ev.metrics["accuracy"], 1.0
+    if model.task == Task.REGRESSION:
+        return "rmse", ev.metrics["rmse"], -1.0
+    if model.task == Task.RANKING:
+        key = next(k for k in ev.metrics if k.startswith("ndcg"))
+        return key, ev.metrics[key], 1.0
+    raise NotImplementedError(model.task)
+
+
+def permutation_importance(
+    model,
+    data,
+    num_rounds: int = 1,
+    max_rows: int = 10_000,
+    seed: int = 1234,
+) -> List[Dict]:
+    """[{feature, importance, metric}] sorted by decreasing importance.
+    importance = sign * (baseline - permuted) averaged over rounds."""
+    ds = Dataset.from_data(data, dataspec=model.dataspec)
+    rng = np.random.default_rng(seed)
+    ds, _ = ds.sample(max_rows, seed=seed)
+
+    base_ev = model.evaluate(ds)
+    metric, base, sign = _primary_metric(model, base_ev)
+
+    out = []
+    for feature in model.input_feature_names():
+        if feature not in ds.data:
+            continue
+        drops = []
+        for _ in range(num_rounds):
+            shuffled = dict(ds.data)
+            perm = rng.permutation(ds.num_rows)
+            shuffled[feature] = ds.data[feature][perm]
+            ev = model.evaluate(Dataset(shuffled, ds.dataspec))
+            drops.append(sign * (base - ev.metrics[metric]))
+        out.append(
+            {
+                "feature": feature,
+                "importance": float(np.mean(drops)),
+                "metric": metric,
+            }
+        )
+    out.sort(key=lambda d: -d["importance"])
+    return out
+
+
+def structure_importances(model) -> Dict[str, List[Dict]]:
+    """NUM_NODES and INV_MEAN_MIN_DEPTH from the flattened forest arrays."""
+    f = model.forest
+    feature = np.asarray(f.feature)  # [T, N]
+    is_leaf = np.asarray(f.is_leaf)
+    left = np.asarray(f.left)
+    right = np.asarray(f.right)
+    names = model.input_feature_names()
+    F = len(names)
+
+    split_mask = (~is_leaf) & (feature >= 0)
+    counts = np.bincount(feature[split_mask].ravel(), minlength=F)[:F]
+
+    # min depth of each feature per tree (BFS over the node arrays).
+    T, N = feature.shape
+    min_depth_sum = np.zeros(F)
+    min_depth_cnt = np.zeros(F)
+    for t in range(T):
+        depth = np.full(N, -1, np.int64)
+        depth[0] = 0
+        order = [0]
+        seen_depth: Dict[int, int] = {}
+        while order:
+            nid = order.pop()
+            if is_leaf[t, nid]:
+                continue
+            ft = int(feature[t, nid])
+            if 0 <= ft < F and ft not in seen_depth:
+                seen_depth[ft] = int(depth[nid])
+            for ch in (int(left[t, nid]), int(right[t, nid])):
+                if 0 < ch < N and depth[ch] < 0:
+                    depth[ch] = depth[nid] + 1
+                    order.append(ch)
+        for ft, d in seen_depth.items():
+            min_depth_sum[ft] += d
+            min_depth_cnt[ft] += 1
+
+    inv_mean_min_depth = np.where(
+        min_depth_cnt > 0, 1.0 / (1.0 + min_depth_sum / np.maximum(min_depth_cnt, 1)), 0.0
+    )
+
+    def ranked(vals):
+        order = np.argsort(-vals)
+        return [
+            {"feature": names[i], "importance": float(vals[i])}
+            for i in order
+            if vals[i] > 0
+        ]
+
+    return {
+        "NUM_NODES": ranked(counts.astype(np.float64)),
+        "INV_MEAN_MIN_DEPTH": ranked(inv_mean_min_depth),
+    }

@@ -127,6 +127,18 @@ class Dataset:
             )
         return Dataset(cols, dataspec)
 
+    def sample(self, max_rows: int, seed: int = 1234):
+        """(subset Dataset, sorted row indices). Row order is preserved so
+        per-row outputs (e.g. SHAP values) map back to the input."""
+        if self.num_rows <= max_rows:
+            return self, np.arange(self.num_rows)
+        rng = np.random.default_rng(seed)
+        rows = np.sort(rng.choice(self.num_rows, size=max_rows, replace=False))
+        return (
+            Dataset({k: v[rows] for k, v in self.data.items()}, self.dataspec),
+            rows,
+        )
+
     # ------------------------------------------------------------------ #
     # Encoded views (model-internal representations)
     # ------------------------------------------------------------------ #

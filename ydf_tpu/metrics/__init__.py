@@ -1,3 +1,31 @@
-from ydf_tpu.metrics.metrics import Evaluation, evaluate_predictions
+from ydf_tpu.metrics.metrics import (
+    Evaluation,
+    evaluate_predictions,
+    roc_auc,
+    pr_auc,
+    roc_curve_points,
+    ndcg_at_k,
+    mrr,
+    wilson_interval,
+    hanley_mcneil_interval,
+    bootstrap_intervals,
+)
+from ydf_tpu.metrics.comparison import mcnemar_test, paired_bootstrap_test
+from ydf_tpu.metrics.cross_validation import cross_validation, fold_indices
 
-__all__ = ["Evaluation", "evaluate_predictions"]
+__all__ = [
+    "Evaluation",
+    "evaluate_predictions",
+    "roc_auc",
+    "pr_auc",
+    "roc_curve_points",
+    "ndcg_at_k",
+    "mrr",
+    "wilson_interval",
+    "hanley_mcneil_interval",
+    "bootstrap_intervals",
+    "mcnemar_test",
+    "paired_bootstrap_test",
+    "cross_validation",
+    "fold_indices",
+]

@@ -1,0 +1,78 @@
+"""Pairwise model comparison.
+
+Counterpart of the reference's one-sided McNemar test and pairwise model
+comparison (`ydf/metric/comparison.cc`): given two models' predictions on
+the same labeled examples, decide whether model 2 is significantly better
+than model 1.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict
+
+import numpy as np
+
+
+def _normal_sf(z: float) -> float:
+    """P(Z > z) for standard normal."""
+    return 0.5 * math.erfc(z / math.sqrt(2.0))
+
+
+def mcnemar_test(
+    labels: np.ndarray, pred1: np.ndarray, pred2: np.ndarray
+) -> Dict[str, float]:
+    """One-sided McNemar: is classifier 2 more accurate than classifier 1?
+
+    pred1/pred2 are hard class predictions. Returns the discordant counts
+    and the one-sided p-value (normal approximation with continuity
+    correction; exact binomial for small counts).
+    """
+    labels = np.asarray(labels)
+    c1 = np.asarray(pred1) == labels
+    c2 = np.asarray(pred2) == labels
+    n01 = int(np.sum(~c1 & c2))  # model 2 right where model 1 wrong
+    n10 = int(np.sum(c1 & ~c2))
+    n = n01 + n10
+    if n == 0:
+        p = 1.0
+    elif n < 50:
+        # Exact one-sided binomial: P(X >= n01 | X ~ Bin(n, 0.5)).
+        p = sum(
+            math.comb(n, k) for k in range(n01, n + 1)
+        ) * 0.5**n
+    else:
+        z = (n01 - n10 - 1.0) / math.sqrt(n)
+        p = _normal_sf(z)
+    return {"n01": n01, "n10": n10, "p_value": float(min(max(p, 0.0), 1.0))}
+
+
+def paired_bootstrap_test(
+    labels: np.ndarray,
+    score1: np.ndarray,
+    score2: np.ndarray,
+    metric_fn,
+    num_bootstrap: int = 1000,
+    seed: int = 1234,
+) -> Dict[str, float]:
+    """P(metric(model2) <= metric(model1)) under paired example resampling —
+    the generic comparison for non-accuracy metrics (AUC, RMSE-negated...).
+    metric_fn(labels, scores) -> float, higher = better."""
+    labels = np.asarray(labels)
+    rng = np.random.default_rng(seed)
+    n = len(labels)
+    wins = 0
+    total = 0
+    for _ in range(num_bootstrap):
+        idx = rng.integers(0, n, size=n)
+        m1 = metric_fn(labels[idx], np.asarray(score1)[idx])
+        m2 = metric_fn(labels[idx], np.asarray(score2)[idx])
+        if np.isfinite(m1) and np.isfinite(m2):
+            total += 1
+            if m2 <= m1:
+                wins += 1
+    return {
+        "p_value": wins / max(total, 1),
+        "metric1": float(metric_fn(labels, np.asarray(score1))),
+        "metric2": float(metric_fn(labels, np.asarray(score2))),
+    }

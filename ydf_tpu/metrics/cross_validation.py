@@ -1,0 +1,145 @@
+"""Cross-validation driver.
+
+Counterpart of the reference's `EvaluateLearner`
+(`ydf/learner/abstract_learner.h:250-278`) with its fold generator
+(`ydf/utils/fold_generator.h:30-41`): train the learner on k-1 folds,
+evaluate on the held-out fold, pool the out-of-fold predictions into one
+evaluation.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import List, Optional
+
+import numpy as np
+
+from ydf_tpu.dataset.dataset import Dataset
+from ydf_tpu.metrics.metrics import Evaluation, evaluate_predictions
+
+
+def fold_indices(
+    n: int,
+    num_folds: int,
+    seed: int = 1234,
+    labels: Optional[np.ndarray] = None,
+    groups: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """int32 [n] fold id per example. With `labels`, folds are stratified
+    (round-robin inside each class after shuffling). With `groups`
+    (ranking query ids), whole groups go to one fold — splitting a query
+    across folds would leak train/test and make within-group scores come
+    from different models."""
+    rng = np.random.default_rng(seed)
+    folds = np.zeros((n,), np.int32)
+    if groups is not None:
+        groups = np.asarray(groups)
+        uniq = np.unique(groups)
+        gf = np.zeros(len(uniq), np.int32)
+        perm = rng.permutation(len(uniq))
+        gf[perm] = np.arange(len(uniq)) % num_folds
+        gmap = {g: f for g, f in zip(uniq, gf)}
+        folds[:] = [gmap[g] for g in groups]
+    elif labels is None:
+        perm = rng.permutation(n)
+        folds[perm] = np.arange(n) % num_folds
+    else:
+        labels = np.asarray(labels)
+        for v in np.unique(labels):
+            rows = np.flatnonzero(labels == v)
+            rng.shuffle(rows)
+            folds[rows] = np.arange(len(rows)) % num_folds
+    return folds
+
+
+def cross_validation(
+    learner,
+    data,
+    num_folds: int = 10,
+    seed: int = 1234,
+    confidence_intervals: bool = False,
+) -> Evaluation:
+    """Out-of-fold pooled evaluation (the reference pools fold predictions
+    into a single EvaluationResults, abstract_learner.h:267-270)."""
+    from ydf_tpu.config import Task
+
+    ds = Dataset.from_data(data)
+    raw = {k: np.asarray(v) for k, v in ds.data.items()}
+    n = ds.num_rows
+    label_col = learner.label
+
+    strat = None
+    groups_col = None
+    if label_col is not None and learner.task == Task.CLASSIFICATION:
+        strat = raw[label_col]
+    if learner.task == Task.RANKING:
+        groups_col = raw[learner.ranking_group]
+    folds = fold_indices(
+        n, num_folds, seed=seed, labels=strat, groups=groups_col
+    )
+
+    pooled_preds: Optional[np.ndarray] = None
+    pooled_labels: Optional[np.ndarray] = None
+    model = None
+    canonical_classes: Optional[List[str]] = None
+    for f in range(num_folds):
+        te = folds == f
+        tr = ~te
+        train_data = {k: v[tr] for k, v in raw.items()}
+        test_data = {k: v[te] for k, v in raw.items()}
+        model = copy.copy(learner).train(train_data)
+        preds = model.predict(test_data)
+        test_ds = Dataset.from_data(test_data, dataspec=model.dataspec)
+        lab = test_ds.encoded_label(label_col, learner.task)
+        # Class dictionaries are per-fold (frequency order can differ):
+        # remap every fold to the first fold's class order before pooling.
+        # A class can be entirely absent from a fold's training split
+        # (rarer than num_folds examples): its probability column is 0.
+        if model.classes is not None:
+            if canonical_classes is None:
+                canonical_classes = model.classes
+            elif model.classes != canonical_classes:
+                idx_of = {c: i for i, c in enumerate(model.classes)}
+                perm = [idx_of.get(c, -1) for c in canonical_classes]
+                if preds.ndim == 1:
+                    if len(canonical_classes) != 2 or -1 in perm:
+                        raise ValueError(
+                            "Fold class dictionaries are incompatible for "
+                            f"binary pooling: {model.classes} vs "
+                            f"{canonical_classes}"
+                        )
+                    if perm != [0, 1]:
+                        preds = 1.0 - preds  # binary order flip
+                else:
+                    cols = [
+                        preds[:, j] if j >= 0 else np.zeros(len(preds))
+                        for j in perm
+                    ]
+                    preds = np.stack(cols, axis=1)
+                # labels: fold-dictionary index -> canonical index by name.
+                canon_of = {
+                    c: i for i, c in enumerate(canonical_classes)
+                }
+                lab = np.array(
+                    [canon_of[model.classes[v]] for v in lab], np.int64
+                )
+        if pooled_preds is None:
+            shape = (n,) + preds.shape[1:]
+            pooled_preds = np.zeros(shape, preds.dtype)
+            pooled_labels = np.zeros((n,), lab.dtype)
+        pooled_preds[te] = preds
+        pooled_labels[te] = lab
+
+    weights = None
+    wcol = getattr(learner, "weights", None)
+    if wcol:
+        weights = raw[wcol].astype(np.float64)
+    return evaluate_predictions(
+        learner.task,
+        pooled_labels,
+        pooled_preds,
+        classes=canonical_classes,
+        weights=weights,
+        groups=groups_col,
+        confidence_intervals=confidence_intervals,
+    )

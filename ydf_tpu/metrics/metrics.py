@@ -8,9 +8,12 @@ batched):
 
   * classification: accuracy, confusion matrix, logloss, ROC-AUC & PR-AUC
     (binary; exact rank statistics like the reference's ROC builder
-    `metric.h:98`)
+    `metric.h:98`), precision/recall/F1, ROC curve points
   * regression: RMSE, MAE, R²
-  * ranking: NDCG@5 (reference ranking_ndcg.cc)
+  * ranking: NDCG@k (reference ranking_ndcg.cc), MRR (ranking_mrr.cc)
+  * confidence intervals: closed-form (Wilson for accuracy, Hanley-McNeil
+    for AUC — reference metric.h:160-169) and nonparametric bootstrap over
+    examples (reference metric.h:170-177) for every scalar metric
 """
 
 from __future__ import annotations
@@ -33,6 +36,10 @@ class Evaluation:
     metrics: Dict[str, float]
     confusion: Optional[np.ndarray] = None
     classes: Optional[List[str]] = None
+    # metric name -> (lo, hi) 95% interval, when requested.
+    confidence_intervals: Optional[Dict[str, tuple]] = None
+    # (fpr, tpr, thresholds) arrays for binary classification.
+    roc_curve: Optional[tuple] = None
 
     def __getattr__(self, name):
         m = object.__getattribute__(self, "metrics")
@@ -43,7 +50,9 @@ class Evaluation:
     def __str__(self) -> str:
         lines = [f"Evaluation ({self.task}, {self.num_examples} examples)"]
         for k, v in self.metrics.items():
-            lines.append(f"  {k}: {v:.6g}")
+            ci = (self.confidence_intervals or {}).get(k)
+            tail = f"  CI95 [{ci[0]:.6g}, {ci[1]:.6g}]" if ci else ""
+            lines.append(f"  {k}: {v:.6g}{tail}")
         if self.confusion is not None and self.classes is not None:
             lines.append("  confusion (rows=label, cols=prediction):")
             header = "    " + " ".join(f"{c:>10}" for c in self.classes)
@@ -65,18 +74,13 @@ def roc_auc(labels: np.ndarray, scores: np.ndarray) -> float:
     if n_pos == 0 or n_neg == 0:
         return float("nan")
     order = np.argsort(scores, kind="mergesort")
-    ranks = np.empty(len(scores), dtype=np.float64)
-    ranks[order] = np.arange(1, len(scores) + 1)
-    # average ranks for ties
     sorted_scores = scores[order]
-    i = 0
-    while i < len(sorted_scores):
-        j = i
-        while j + 1 < len(sorted_scores) and sorted_scores[j + 1] == sorted_scores[i]:
-            j += 1
-        if j > i:
-            ranks[order[i : j + 1]] = (i + 1 + j + 1) / 2.0
-        i = j + 1
+    # average ranks for ties, vectorized: one segment per distinct score
+    starts = np.r_[0, np.flatnonzero(np.diff(sorted_scores) != 0) + 1]
+    ends = np.r_[starts[1:], len(sorted_scores)]
+    seg_rank = (starts + 1 + ends) / 2.0  # mean of ranks start+1..end
+    ranks = np.empty(len(scores), dtype=np.float64)
+    ranks[order] = np.repeat(seg_rank, ends - starts)
     sum_pos = ranks[labels == 1].sum()
     return float((sum_pos - n_pos * (n_pos + 1) / 2.0) / (n_pos * n_neg))
 
@@ -93,6 +97,100 @@ def pr_auc(labels: np.ndarray, scores: np.ndarray) -> float:
     recall = tp / n_pos
     # step-wise interpolation (trapezoid over recall)
     return float(np.sum(np.diff(np.concatenate([[0.0], recall])) * precision))
+
+
+def roc_curve_points(labels: np.ndarray, scores: np.ndarray):
+    """(fpr, tpr, thresholds), one point per distinct score, descending
+    threshold — the reference's ROC representation (`metric.h:98`)."""
+    labels = np.asarray(labels).astype(np.int64)
+    scores = np.asarray(scores, dtype=np.float64)
+    order = np.argsort(-scores, kind="mergesort")
+    y = labels[order]
+    s = scores[order]
+    distinct = np.r_[np.diff(s) != 0, True]
+    tp = np.cumsum(y)[distinct]
+    fp = np.cumsum(1 - y)[distinct]
+    n_pos = max(int(labels.sum()), 1)
+    n_neg = max(len(labels) - int(labels.sum()), 1)
+    fpr = np.r_[0.0, fp / n_neg]
+    tpr = np.r_[0.0, tp / n_pos]
+    thr = np.r_[np.inf, s[distinct]]
+    return fpr, tpr, thr
+
+
+def mrr(labels, scores, groups) -> float:
+    """Mean reciprocal rank over groups: 1/rank of the first relevant item
+    (reference ranking_mrr.cc; relevant = label >= 1)."""
+    labels = np.asarray(labels, dtype=np.float64)
+    scores = np.asarray(scores, dtype=np.float64)
+    groups = np.asarray(groups)
+    total, count = 0.0, 0
+    for gid in np.unique(groups):
+        m = groups == gid
+        rel = labels[m] >= 1.0
+        if not rel.any():
+            continue
+        order = np.argsort(-scores[m], kind="mergesort")
+        first = int(np.argmax(rel[order])) + 1
+        total += 1.0 / first
+        count += 1
+    return float(total / max(count, 1))
+
+
+def wilson_interval(p: float, n: float, z: float = 1.959964) -> tuple:
+    """Closed-form 95% CI for a proportion (accuracy) — the reference's
+    closed-form CI family (`metric.h:160-169`)."""
+    if n == 0 or not np.isfinite(p):
+        return (float("nan"), float("nan"))
+    denom = 1 + z * z / n
+    center = (p + z * z / (2 * n)) / denom
+    half = z * np.sqrt(p * (1 - p) / n + z * z / (4 * n * n)) / denom
+    return (float(center - half), float(center + half))
+
+
+def hanley_mcneil_interval(auc: float, n_pos: int, n_neg: int,
+                           z: float = 1.959964) -> tuple:
+    """Closed-form AUC CI (Hanley & McNeil 1982)."""
+    if not np.isfinite(auc) or n_pos == 0 or n_neg == 0:
+        return (float("nan"), float("nan"))
+    q1 = auc / (2 - auc)
+    q2 = 2 * auc * auc / (1 + auc)
+    var = (
+        auc * (1 - auc)
+        + (n_pos - 1) * (q1 - auc * auc)
+        + (n_neg - 1) * (q2 - auc * auc)
+    ) / (n_pos * n_neg)
+    half = z * np.sqrt(max(var, 0.0))
+    return (float(auc - half), float(auc + half))
+
+
+def bootstrap_intervals(
+    metric_fn,
+    n: int,
+    num_bootstrap: int = 2000,
+    seed: int = 1234,
+    alpha: float = 0.05,
+) -> Dict[str, tuple]:
+    """Percentile bootstrap over example resamples (`metric.h:170-177`).
+    metric_fn(row_indices) -> dict of scalar metrics."""
+    rng = np.random.default_rng(seed)
+    samples: Dict[str, list] = {}
+    for _ in range(num_bootstrap):
+        idx = rng.integers(0, n, size=n)
+        for k, v in metric_fn(idx).items():
+            samples.setdefault(k, []).append(v)
+    out = {}
+    for k, vs in samples.items():
+        vs = np.asarray(vs, dtype=np.float64)
+        vs = vs[np.isfinite(vs)]
+        if len(vs) == 0:
+            out[k] = (float("nan"), float("nan"))
+        else:
+            out[k] = (
+                float(np.quantile(vs, alpha / 2)),
+                float(np.quantile(vs, 1 - alpha / 2)),
+            )
+    return out
 
 
 def ndcg_at_k(labels, scores, groups, k: int = 5) -> float:
@@ -128,6 +226,9 @@ def evaluate_predictions(
     weights: Optional[np.ndarray] = None,
     groups: Optional[np.ndarray] = None,
     ndcg_truncation: int = 5,
+    confidence_intervals: bool = False,
+    num_bootstrap: int = 2000,
+    seed: int = 1234,
 ) -> Evaluation:
     from ydf_tpu.config import Task
 
@@ -141,40 +242,134 @@ def evaluate_predictions(
             proba = np.stack([1 - predictions, predictions], axis=1)
         else:
             proba = predictions
-        pred_cls = np.argmax(proba, axis=1)
-        acc = float(np.sum(w * (pred_cls == labels)) / w.sum())
-        p_true = np.clip(proba[np.arange(n), labels.astype(int)], _EPS, 1.0)
-        logloss = float(-np.sum(w * np.log(p_true)) / w.sum())
         C = proba.shape[1]
+
+        def cls_metrics(idx, rank_metrics=True):
+            pb, lb, ww = proba[idx], labels[idx].astype(int), w[idx]
+            pred_cls = np.argmax(pb, axis=1)
+            m = {
+                "accuracy": float(np.sum(ww * (pred_cls == lb)) / ww.sum()),
+                "loss": float(
+                    -np.sum(
+                        ww
+                        * np.log(
+                            np.clip(pb[np.arange(len(lb)), lb], _EPS, 1.0)
+                        )
+                    )
+                    / ww.sum()
+                ),
+            }
+            if C == 2:
+                if rank_metrics:
+                    # auc is skipped inside the bootstrap (its closed-form
+                    # interval overrides the bootstrap one anyway).
+                    m["auc"] = roc_auc(lb, pb[:, 1])
+                m["pr_auc"] = pr_auc(lb, pb[:, 1])
+                tp = float(np.sum(ww * ((pred_cls == 1) & (lb == 1))))
+                fp = float(np.sum(ww * ((pred_cls == 1) & (lb == 0))))
+                fn = float(np.sum(ww * ((pred_cls == 0) & (lb == 1))))
+                m["precision"] = tp / max(tp + fp, _EPS)
+                m["recall"] = tp / max(tp + fn, _EPS)
+                m["f1"] = 2 * tp / max(2 * tp + fp + fn, _EPS)
+            return m
+
+        metrics = cls_metrics(np.arange(n))
+        pred_cls = np.argmax(proba, axis=1)
         conf = np.zeros((C, C), dtype=np.int64)
         np.add.at(conf, (labels.astype(int), pred_cls), 1)
-        metrics = {"accuracy": acc, "loss": logloss}
-        if C == 2:
-            metrics["auc"] = roc_auc(labels, proba[:, 1])
-            metrics["pr_auc"] = pr_auc(labels, proba[:, 1])
+        roc = roc_curve_points(labels, proba[:, 1]) if C == 2 else None
+        cis = None
+        if confidence_intervals:
+            cis = bootstrap_intervals(
+                lambda idx: cls_metrics(idx, rank_metrics=False),
+                n, num_bootstrap=num_bootstrap, seed=seed,
+            )
+            # Closed-form intervals override the bootstrap where they exist
+            # (the reference reports both families; metric.h:160-169).
+            # Weighted data: use the effective sample size (Kish).
+            n_eff = float(w.sum() ** 2 / np.sum(w**2))
+            cis["accuracy"] = wilson_interval(metrics["accuracy"], n_eff)
+            if C == 2:
+                pos_frac = float(w[labels == 1].sum() / w.sum())
+                cis["auc"] = hanley_mcneil_interval(
+                    metrics["auc"],
+                    max(int(n_eff * pos_frac), 1),
+                    max(int(n_eff * (1 - pos_frac)), 1),
+                )
         return Evaluation(
             task=task.value, num_examples=n, metrics=metrics,
-            confusion=conf, classes=classes,
+            confusion=conf, classes=classes, confidence_intervals=cis,
+            roc_curve=roc,
         )
 
     if task == Task.REGRESSION:
-        err = predictions.reshape(-1) - labels
-        rmse = float(np.sqrt(np.sum(w * err**2) / w.sum()))
-        mae = float(np.sum(w * np.abs(err)) / w.sum())
-        var = float(np.sum(w * (labels - np.average(labels, weights=w)) ** 2) / w.sum())
-        r2 = 1.0 - (rmse**2 / var) if var > 0 else float("nan")
+        preds1 = predictions.reshape(-1)
+
+        def reg_metrics(idx):
+            err = preds1[idx] - labels[idx]
+            ww = w[idx]
+            rmse = float(np.sqrt(np.sum(ww * err**2) / ww.sum()))
+            mae = float(np.sum(ww * np.abs(err)) / ww.sum())
+            var = float(
+                np.sum(ww * (labels[idx] - np.average(labels[idx], weights=ww)) ** 2)
+                / ww.sum()
+            )
+            return {
+                "rmse": rmse,
+                "mae": mae,
+                "r2": 1.0 - (rmse**2 / var) if var > 0 else float("nan"),
+            }
+
+        metrics = reg_metrics(np.arange(n))
+        cis = (
+            bootstrap_intervals(
+                reg_metrics, n, num_bootstrap=num_bootstrap, seed=seed
+            )
+            if confidence_intervals
+            else None
+        )
         return Evaluation(
-            task=task.value, num_examples=n,
-            metrics={"rmse": rmse, "mae": mae, "r2": r2},
+            task=task.value, num_examples=n, metrics=metrics,
+            confidence_intervals=cis,
         )
 
     if task == Task.RANKING:
         assert groups is not None, "Ranking evaluation needs group ids"
+        preds1 = predictions.reshape(-1)
         key = f"ndcg@{ndcg_truncation}"
+        metrics = {
+            key: ndcg_at_k(labels, preds1, groups, ndcg_truncation),
+            "mrr": mrr(labels, preds1, groups),
+        }
+        cis = None
+        if confidence_intervals:
+            # Resample query groups, not rows (groups are the i.i.d. unit).
+            uniq = np.unique(np.asarray(groups))
+            rows_of = {g: np.flatnonzero(np.asarray(groups) == g) for g in uniq}
+
+            def rank_metrics(idx_groups):
+                gs = uniq[np.asarray(idx_groups) % len(uniq)]
+                rows = np.concatenate([rows_of[g] for g in gs])
+                # Re-label each drawn group uniquely so a group sampled
+                # twice counts twice instead of merging into one
+                # double-sized group.
+                gids = np.repeat(
+                    np.arange(len(gs)), [len(rows_of[g]) for g in gs]
+                )
+                return {
+                    key: ndcg_at_k(
+                        labels[rows], preds1[rows], gids, ndcg_truncation
+                    ),
+                    "mrr": mrr(labels[rows], preds1[rows], gids),
+                }
+
+            cis = bootstrap_intervals(
+                rank_metrics, len(uniq), num_bootstrap=min(num_bootstrap, 500),
+                seed=seed,
+            )
         return Evaluation(
-            task=task.value, num_examples=n,
-            metrics={key: ndcg_at_k(labels, predictions.reshape(-1), groups,
-                                    ndcg_truncation)},
+            task=task.value, num_examples=n, metrics=metrics,
+            confidence_intervals=cis,
         )
 
     if task == Task.ANOMALY_DETECTION:

@@ -36,6 +36,10 @@ class Forest(NamedTuple):
     # na_value=true routes to the positive=right child).
     na_left: jax.Array
     leaf_value: jax.Array     # [T, N, V] f32
+    # [T, N] f32: weighted count of training examples that reached the node
+    # (the reference's NodeCondition.num_training_examples_with_weight /
+    # leaf distribution sums) — drives TreeSHAP path weights.
+    cover: jax.Array
     num_nodes: jax.Array      # [T] i32
 
     @property
@@ -58,6 +62,8 @@ class Forest(NamedTuple):
         d = dict(d)
         if "na_left" not in d:  # saves from before the na_left field
             d["na_left"] = np.zeros(np.shape(d["feature"]), bool)
+        if "cover" not in d:  # saves from before the cover field
+            d["cover"] = np.ones(np.shape(d["feature"]), np.float32)
         return Forest(**{f: jnp.asarray(d[f]) for f in Forest._fields})
 
 
@@ -86,5 +92,8 @@ def forest_from_stacked_trees(
         is_leaf=jnp.asarray(stacked_trees.is_leaf),
         na_left=jnp.zeros(feature.shape, jnp.bool_),
         leaf_value=jnp.asarray(leaf_value),
+        # leaf_stats' last column is the weighted example count (see
+        # ops/grower.py stats layout: [..., sum_weights]).
+        cover=jnp.asarray(stacked_trees.leaf_stats[..., -1]),
         num_nodes=jnp.asarray(stacked_trees.num_nodes),
     )

@@ -86,6 +86,38 @@ class GenericModel:
         return "\n".join(lines)
 
     # ------------------------------------------------------------------ #
+    # Analysis (reference: model.analyze / model.predict_shap /
+    # model.analyze_prediction, generic_model.py:674-1271)
+    # ------------------------------------------------------------------ #
+
+    def analyze(self, data: InputData, **kwargs):
+        from ydf_tpu.analysis import analyze as _analyze
+
+        return _analyze(self, data, **kwargs)
+
+    def predict_shap(self, data: InputData, max_rows: int = 200):
+        """(phi [n, F, V], bias [V], rows [n]) SHAP values of the raw
+        score; `rows` are the input row indices scored (subsampled and
+        sorted when the input exceeds max_rows)."""
+        from ydf_tpu.analysis import tree_shap
+
+        return tree_shap(self, data, max_rows=max_rows)
+
+    def analyze_prediction(self, single_example: InputData) -> str:
+        """Per-example SHAP breakdown (reference analyze_prediction)."""
+        from ydf_tpu.analysis import tree_shap
+
+        phi, bias, _ = tree_shap(self, single_example, max_rows=1)
+        names = self.input_feature_names()
+        contrib = phi[0, :, 0]
+        order = np.argsort(-np.abs(contrib))
+        lines = [f"bias: {float(np.atleast_1d(bias)[0]):+.5f}"]
+        for i in order:
+            if abs(contrib[i]) > 1e-9:
+                lines.append(f"{names[i]:>30}: {contrib[i]:+.5f}")
+        return "\n".join(lines)
+
+    # ------------------------------------------------------------------ #
     # Serving
     # ------------------------------------------------------------------ #
 
@@ -137,7 +169,13 @@ class GenericModel:
     # Evaluation
     # ------------------------------------------------------------------ #
 
-    def evaluate(self, data: InputData, weights: Optional[str] = None) -> Evaluation:
+    def evaluate(
+        self,
+        data: InputData,
+        weights: Optional[str] = None,
+        confidence_intervals: bool = False,
+        num_bootstrap: int = 2000,
+    ) -> Evaluation:
         ds = Dataset.from_data(data, dataspec=self.dataspec)
         preds = self.predict(ds)
         labels = ds.encoded_label(self.label, self.task)
@@ -151,6 +189,8 @@ class GenericModel:
         return evaluate_predictions(
             self.task, labels, preds, classes=self.classes, weights=w,
             groups=groups, ndcg_truncation=ndcg_truncation,
+            confidence_intervals=confidence_intervals,
+            num_bootstrap=num_bootstrap,
         )
 
     # ------------------------------------------------------------------ #

@@ -162,7 +162,7 @@ def parse_dataspec(buf: bytes) -> Tuple[DataSpecification, List[_YdfColumn]]:
 class _Node:
     __slots__ = (
         "is_leaf", "attribute", "cond_type", "cond", "na_value",
-        "leaf", "neg", "pos",
+        "leaf", "neg", "pos", "cover",
     )
 
     def __init__(self):
@@ -174,6 +174,7 @@ class _Node:
         self.leaf: Optional[pw.Message] = None
         self.neg: Optional["_Node"] = None
         self.pos: Optional["_Node"] = None
+        self.cover = 0.0
 
 
 def _parse_node(buf: bytes) -> _Node:
@@ -185,6 +186,8 @@ def _parse_node(buf: bytes) -> _Node:
         node.is_leaf = False
         node.na_value = pw.get_bool(cond, 1)  # na_value = 1
         node.attribute = pw.get_sint(cond, 2, -1)  # attribute = 2
+        # num_training_examples_with_weight = 5 (cover for TreeSHAP).
+        node.cover = pw.get_double(cond, 5, 0.0)
         inner = pw.get_msg(cond, 3)  # condition = 3 (Condition, :86-176)
         if inner is None:
             raise ValueError("non-leaf node without condition type")
@@ -197,7 +200,33 @@ def _parse_node(buf: bytes) -> _Node:
         else:
             raise ValueError("unknown condition type")
     node.leaf = msg  # leaf payload read lazily by the model-specific reader
+    if node.is_leaf:
+        node.cover = _leaf_cover(msg)
     return node
+
+
+def _leaf_cover(msg: pw.Message) -> float:
+    """Weighted example count of a leaf, from whichever output it carries:
+    classifier distribution sum (distribution.proto:35), regressor
+    sum_weights / distribution count (decision_tree.proto:39-41), anomaly
+    num_examples_without_weight (:81)."""
+    cls = pw.get_msg(msg, 1)
+    if cls is not None:
+        dist = pw.get_msg(cls, 2)
+        if dist is not None:
+            return pw.get_double(dist, 2, 0.0)
+    reg = pw.get_msg(msg, 2)
+    if reg is not None:
+        sw = pw.get_double(reg, 5, 0.0)
+        if sw > 0:
+            return sw
+        dist = pw.get_msg(reg, 2)
+        if dist is not None:
+            return pw.get_double(dist, 3, 0.0)
+    ad = pw.get_msg(msg, 6)
+    if ad is not None:
+        return float(pw.get_sint(ad, 1, 0))
+    return 1.0
 
 
 def _read_tree(records: Iterator[bytes]) -> _Node:
@@ -331,6 +360,7 @@ def trees_to_forest(
                 left=0, right=0, is_leaf=node.is_leaf,
                 na_left=not node.na_value,
                 leaf_value=np.zeros((leaf_dim,), np.float32),
+                cover=max(float(node.cover), 1.0),
             )
             rows.append(row)
             if node.is_leaf:
@@ -411,6 +441,7 @@ def trees_to_forest(
         is_leaf=stack("is_leaf", np.bool_),
         na_left=stack("na_left", np.bool_),
         leaf_value=stack("leaf_value", np.float32, (leaf_dim,)),
+        cover=stack("cover", np.float32),
         num_nodes=np.array([len(r) for r in per_tree], np.int32),
     )
     return forest, max(max_depth, 1)
