@@ -66,6 +66,12 @@ class GradientBoostedTreesLearner(GenericLearner):
         ranking_group: Optional[str] = None,
         ndcg_truncation: int = 5,
         max_frontier: int = 1024,
+        sampling_method: str = "RANDOM",
+        goss_alpha: float = 0.2,
+        goss_beta: float = 0.1,
+        selective_gradient_boosting_ratio: float = 0.01,
+        apply_link_function: bool = True,
+        dart_dropout: float = 0.0,
         features: Optional[Sequence[str]] = None,
         weights: Optional[str] = None,
         random_seed: int = 123456,
@@ -91,6 +97,24 @@ class GradientBoostedTreesLearner(GenericLearner):
         self.ranking_group = ranking_group
         self.ndcg_truncation = ndcg_truncation
         self.max_frontier = max_frontier
+        # Sampling per iteration (reference :1488-1522): RANDOM (stochastic
+        # GBM via `subsample`), GOSS, or SELGB (ranking only).
+        if sampling_method not in ("RANDOM", "GOSS", "SELGB"):
+            raise ValueError(
+                f"Unknown sampling_method {sampling_method!r}; expected "
+                "RANDOM, GOSS or SELGB"
+            )
+        if sampling_method == "SELGB" and task != Task.RANKING:
+            # Reference: "Selective Gradient Boosting is only applicable to
+            # ranking" (gradient_boosted_trees.cc:3053-3056).
+            raise ValueError("sampling_method=SELGB requires task=RANKING")
+        self.sampling_method = sampling_method
+        self.goss_alpha = goss_alpha
+        self.goss_beta = goss_beta
+        self.selective_gradient_boosting_ratio = selective_gradient_boosting_ratio
+        self.apply_link_function = apply_link_function
+        # DART dropout rate over past iterations (reference :1468-1474).
+        self.dart_dropout = dart_dropout
         # jax.sharding.Mesh with axes (data, feature): distributes training
         # via GSPMD sharding annotations (see ydf_tpu/parallel/mesh.py — the
         # TPU-native replacement of the reference's gRPC worker protocol).
@@ -245,6 +269,11 @@ class GradientBoostedTreesLearner(GenericLearner):
             # pad columns; per-node feature sampling must ignore them.
             num_valid_features=F if bins_tr.shape[1] > F else None,
             seed=self.random_seed,
+            sampling=self.sampling_method,
+            goss_alpha=self.goss_alpha,
+            goss_beta=self.goss_beta,
+            selgb_ratio=self.selective_gradient_boosting_ratio,
+            dart_dropout=self.dart_dropout,
         )
 
         train_losses = np.asarray(logs["train_loss"])
@@ -291,6 +320,7 @@ class GradientBoostedTreesLearner(GenericLearner):
             num_trees_per_iter=K,
             max_depth=self.max_depth,
             loss_name=loss_obj.name,
+            apply_link_function=self.apply_link_function,
             training_logs={
                 "train_loss": train_losses[:num_iters].tolist(),
                 "valid_loss": valid_losses[:num_iters].tolist()
@@ -314,6 +344,8 @@ class GradientBoostedTreesLearner(GenericLearner):
 def _make_boost_fn(
     loss_obj, rule, tree_cfg: TreeConfig, num_trees, shrinkage, subsample,
     candidate_features, num_numerical, num_valid_features, seed, n, nv,
+    sampling="RANDOM", goss_alpha=0.2, goss_beta=0.1, selgb_ratio=0.01,
+    dart_dropout=0.0,
 ):
     """Builds (and caches) the jitted boosting loop for one static config.
 
@@ -325,6 +357,8 @@ def _make_boost_fn(
     K = loss_obj.num_dims
     N = tree_cfg.max_nodes
 
+    use_dart = dart_dropout > 0.0
+
     @jax.jit
     def run(bins_tr, y_tr, w_tr, bins_va, y_va, w_va):
         y_f = y_tr.astype(jnp.float32)
@@ -333,18 +367,82 @@ def _make_boost_fn(
         vpreds0 = jnp.broadcast_to(init_pred[None, :], (nv, K)).astype(jnp.float32)
         key0 = jax.random.PRNGKey(seed)
 
-        def boost_step(carry, it):
-            preds, vpreds, key = carry
-            key, k_sub = jax.random.split(jax.random.fold_in(key, it))
-            g, h = loss_obj.grad_hess(y_tr, preds)  # [n, K]
-
+        def sample_mask(k_sub, g, preds):
+            """Per-example training-weight multiplier for this iteration —
+            the reference's SampleTrainingExamples / GOSS / SelGB switch
+            (gradient_boosted_trees.cc:1488-1522)."""
+            if sampling == "GOSS":
+                # Gradient one-side sampling (Ke et al. 2017): keep the
+                # goss_alpha fraction with the largest |g|, sample
+                # goss_beta of the rest, re-weighted by (1-alpha)/beta.
+                gmag = jnp.sum(jnp.abs(g), axis=1)
+                k_top = max(int(goss_alpha * n), 1)
+                thr = jax.lax.top_k(gmag, k_top)[0][-1]
+                top = gmag >= thr
+                rest_p = min(goss_beta / max(1.0 - goss_alpha, 1e-6), 1.0)
+                keep = jax.random.bernoulli(k_sub, rest_p, (n,))
+                upw = (1.0 - goss_alpha) / max(goss_beta, 1e-9)
+                return jnp.where(top, 1.0, jnp.where(keep, upw, 0.0))
+            if sampling == "SELGB":
+                # Selective Gradient Boosting (Lucchese et al. 2018,
+                # ranking; reference SampleTrainingExamplesWithSelGB,
+                # gradient_boosted_trees.cc:3067-3092): PER QUERY GROUP,
+                # keep every positive example and the selgb_ratio fraction
+                # of that group's negatives scored highest by the current
+                # model (the "hard" negatives).
+                rows, _ = loss_obj._rows_for("train", n)  # [G, Gmax]
+                pad = rows >= n  # trash-row padding
+                s_g = jnp.where(pad, -jnp.inf, preds[rows.clip(0, n - 1), 0])
+                pos_g = (y_f[rows.clip(0, n - 1)] > 0) & ~pad
+                neg_g = ~pos_g & ~pad
+                neg_score = jnp.where(neg_g, s_g, -jnp.inf)
+                # Rank of each negative inside its group, by descending
+                # score: rank r kept iff r < ceil(ratio * #negatives).
+                order = jnp.argsort(-neg_score, axis=1)
+                rank = jnp.argsort(order, axis=1)
+                n_neg = jnp.sum(neg_g, axis=1, keepdims=True)
+                keep_neg = neg_g & (rank < jnp.ceil(selgb_ratio * n_neg))
+                keep_g = pos_g | keep_neg
+                mask = jnp.zeros((n + 1,), jnp.float32)
+                mask = mask.at[jnp.where(pad, n, rows).reshape(-1)].set(
+                    keep_g.reshape(-1).astype(jnp.float32)
+                )
+                return mask[:n]
             if subsample < 1.0:
-                m = jax.random.bernoulli(k_sub, subsample, (n,)).astype(jnp.float32)
+                return jax.random.bernoulli(
+                    k_sub, subsample, (n,)
+                ).astype(jnp.float32)
+            return jnp.ones((n,), jnp.float32)
+
+        def boost_step(carry, it):
+            if use_dart:
+                preds, vpreds, key, contrib, vcontrib, tree_scale = carry
+                key, k_sub, k_drop = jax.random.split(
+                    jax.random.fold_in(key, it), 3
+                )
+                # Drop a random subset of past iterations (DART, Vinayak &
+                # Gilad-Bachrach 2015; reference :1468-1474): gradients are
+                # computed on the ensemble without the dropped trees.
+                drop = jax.random.bernoulli(
+                    k_drop, dart_dropout, (num_trees,)
+                ) & (jnp.arange(num_trees) < it)
+                nd = jnp.sum(drop.astype(jnp.float32))
+                dropped_sum = jnp.einsum(
+                    "t,tnk->nk", drop * tree_scale, contrib
+                )
+                preds_used = preds - dropped_sum
             else:
-                m = jnp.ones((n,), jnp.float32)
+                preds, vpreds, key = carry
+                key, k_sub = jax.random.split(jax.random.fold_in(key, it))
+                preds_used = preds
+
+            g, h = loss_obj.grad_hess(y_tr, preds_used)  # [n, K]
+            m = sample_mask(k_sub, g, preds_used)
             w_eff = w_tr * m
 
             trees_k, leaves_k = [], []
+            new_contrib = jnp.zeros((n, K), jnp.float32)
+            new_vcontrib = jnp.zeros((nv, K), jnp.float32)
             for k in range(K):
                 kk = jax.random.fold_in(key, k)
                 stats = jnp.stack(
@@ -365,14 +463,46 @@ def _make_boost_fn(
                 # Leaf values scaled by shrinkage at storage time, like the
                 # reference (set_leaf applies shrinkage).
                 lv = rule.leaf_value(res.tree.leaf_stats, None) * shrinkage
-                preds = preds.at[:, k].add(lv[res.leaf_id, 0])
+                new_contrib = new_contrib.at[:, k].set(lv[res.leaf_id, 0])
                 if nv > 0:
                     vleaves = route_tree_bins(
                         res.tree, bins_va, tree_cfg.max_depth
                     )
-                    vpreds = vpreds.at[:, k].add(lv[vleaves, 0])
+                    new_vcontrib = new_vcontrib.at[:, k].set(lv[vleaves, 0])
                 trees_k.append(res.tree)
                 leaves_k.append(lv)
+
+            if use_dart:
+                # New tree enters at weight 1/(nd+1); dropped trees shrink
+                # by nd/(nd+1) (reference :1558-1573).
+                factor = 1.0 / (nd + 1.0)
+                tree_scale_old = tree_scale
+                tree_scale = jnp.where(drop, tree_scale * nd * factor, tree_scale)
+                tree_scale = tree_scale.at[it].set(factor)
+                contrib = jax.lax.dynamic_update_index_in_dim(
+                    contrib, new_contrib, it, 0
+                )
+                preds = preds_used + dropped_sum * nd * factor + new_contrib * factor
+                if nv > 0:
+                    # Same incremental form as the train preds: only the
+                    # dropped-trees contraction is O(T); recomputing the
+                    # full ensemble each step would be O(T^2) overall.
+                    vdropped = jnp.einsum(
+                        "t,tnk->nk", drop * tree_scale_old, vcontrib
+                    )
+                    vcontrib = jax.lax.dynamic_update_index_in_dim(
+                        vcontrib, new_vcontrib, it, 0
+                    )
+                    vpreds = (
+                        vpreds
+                        - vdropped
+                        + vdropped * nd * factor
+                        + new_vcontrib * factor
+                    )
+            else:
+                preds = preds + new_contrib
+                if nv > 0:
+                    vpreds = vpreds + new_vcontrib
 
             trees = jax.tree.map(lambda *xs: jnp.stack(xs), *trees_k)
             lvs = jnp.stack(leaves_k)  # [K, N, 1]
@@ -382,11 +512,30 @@ def _make_boost_fn(
                 if nv > 0
                 else jnp.float32(0)
             )
-            return (preds, vpreds, key), (trees, lvs, tl, vl)
+            if use_dart:
+                new_carry = (preds, vpreds, key, contrib, vcontrib, tree_scale)
+            else:
+                new_carry = (preds, vpreds, key)
+            return new_carry, (trees, lvs, tl, vl)
 
-        (_, _, _), (trees, lvs, tls, vls) = jax.lax.scan(
-            boost_step, (preds0, vpreds0, key0), jnp.arange(num_trees)
-        )
+        if use_dart:
+            carry0 = (
+                preds0, vpreds0, key0,
+                jnp.zeros((num_trees, n, K), jnp.float32),
+                jnp.zeros((num_trees, nv, K), jnp.float32),
+                jnp.zeros((num_trees,), jnp.float32),
+            )
+            carry_end, (trees, lvs, tls, vls) = jax.lax.scan(
+                boost_step, carry0, jnp.arange(num_trees)
+            )
+            # Bake each iteration's final DART weight into its stored leaf
+            # values so serving needs no extra state. lvs: [T, K, N, 1].
+            tree_scale = carry_end[5]
+            lvs = lvs * tree_scale[:, None, None, None]
+        else:
+            (_, _, _), (trees, lvs, tls, vls) = jax.lax.scan(
+                boost_step, (preds0, vpreds0, key0), jnp.arange(num_trees)
+            )
         return trees, lvs, tls, vls, init_pred
 
     return run
@@ -396,6 +545,8 @@ def _train_gbt(
     bins_tr, y_tr, w_tr, bins_va, y_va, w_va, *,
     loss_obj, rule, tree_cfg: TreeConfig, num_trees, shrinkage, subsample,
     candidate_features, num_numerical, num_valid_features, seed,
+    sampling="RANDOM", goss_alpha=0.2, goss_beta=0.1, selgb_ratio=0.01,
+    dart_dropout=0.0,
 ):
     """The jitted boosting loop. Returns stacked trees [T, K, ...], leaf
     values [T, K, N, 1] and per-iteration logs."""
@@ -411,6 +562,7 @@ def _train_gbt(
         loss_obj, rule, tree_cfg, num_trees, shrinkage, subsample,
         candidate_features, num_numerical, num_valid_features, seed,
         bins_tr.shape[0], bins_va.shape[0],
+        sampling, goss_alpha, goss_beta, selgb_ratio, dart_dropout,
     )
     trees, lvs, tls, vls, init_pred = run(
         bins_tr, y_tr, w_tr, bins_va, y_va, w_va

@@ -43,6 +43,7 @@ class GradientBoostedTreesModel(GenericModel):
         training_logs: Optional[Dict[str, Any]] = None,
         extra_metadata=None,
         native_missing: bool = False,
+        apply_link_function: bool = True,
     ):
         super().__init__(
             task=task, label=label, classes=classes, dataspec=dataspec,
@@ -53,6 +54,9 @@ class GradientBoostedTreesModel(GenericModel):
         self.num_trees_per_iter = num_trees_per_iter
         self.loss_name = loss_name
         self.training_logs = training_logs or {}
+        # False → predict() returns raw scores (margins), the reference's
+        # apply_link_function=False behavior.
+        self.apply_link_function = apply_link_function
 
     # ------------------------------------------------------------------ #
 
@@ -61,8 +65,12 @@ class GradientBoostedTreesModel(GenericModel):
         if K == 1:
             scores = self._raw_scores(data, combine="sum")[:, 0]
             scores = scores + self.initial_predictions[0]
+            if not self.apply_link_function:
+                return scores
             if self.task == Task.CLASSIFICATION:
                 return _sigmoid(scores)  # P(classes[1])
+            if self.loss_name == "POISSON":
+                return np.exp(scores)  # log link
             return scores
         # Multi-dim: route each dim's trees separately.
         from ydf_tpu.models.forest import Forest
@@ -80,7 +88,7 @@ class GradientBoostedTreesModel(GenericModel):
                 self.forest = sub_model_forest
             per_dim.append(s + self.initial_predictions[k])
         scores = np.stack(per_dim, axis=1)
-        if self.task == Task.CLASSIFICATION:
+        if self.task == Task.CLASSIFICATION and self.apply_link_function:
             return _softmax(scores)
         return scores
 
@@ -90,6 +98,7 @@ class GradientBoostedTreesModel(GenericModel):
             "num_trees_per_iter": self.num_trees_per_iter,
             "loss_name": self.loss_name,
             "training_logs": self.training_logs,
+            "apply_link_function": self.apply_link_function,
         }
 
     @classmethod
@@ -101,5 +110,6 @@ class GradientBoostedTreesModel(GenericModel):
             num_trees_per_iter=specific["num_trees_per_iter"],
             loss_name=specific["loss_name"],
             training_logs=specific.get("training_logs"),
+            apply_link_function=specific.get("apply_link_function", True),
             **common,
         )
