@@ -1,0 +1,64 @@
+"""Sparse-oblique splits: training (per-tree projection matmul) and
+import of the reference's oblique models (decision_tree.proto:114-131)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+
+D = "/root/reference/yggdrasil_decision_forests/test_data/dataset"
+MD = "/root/reference/yggdrasil_decision_forests/test_data/model"
+
+
+def test_oblique_helps_on_rotated_data():
+    """A linearly separable rotated boundary needs many axis-aligned splits
+    but one oblique split — oblique must beat axis-aligned at tiny depth."""
+    rng = np.random.RandomState(0)
+    n = 4000
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    y = ((x1 + x2) > 0).astype(np.int64)
+    data = {"x1": x1, "x2": x2, "y": y}
+    kw = dict(num_trees=5, max_depth=3, validation_ratio=0.0,
+              early_stopping="NONE", random_seed=17)
+    axis = ydf.GradientBoostedTreesLearner(label="y", **kw).train(data)
+    obl = ydf.GradientBoostedTreesLearner(
+        label="y", split_axis="SPARSE_OBLIQUE",
+        sparse_oblique_num_projections_exponent=2.0, **kw
+    ).train(data)
+    acc_axis = axis.evaluate(data).accuracy
+    acc_obl = obl.evaluate(data).accuracy
+    assert acc_obl > acc_axis, (acc_obl, acc_axis)
+    assert acc_obl > 0.97
+
+
+def test_oblique_adult(adult_train, adult_test):
+    m = ydf.GradientBoostedTreesLearner(
+        label="income", num_trees=20, split_axis="SPARSE_OBLIQUE",
+    ).train(adult_train.head(4000))
+    assert m.evaluate(adult_test).auc > 0.89
+    assert m.forest.oblique_weights.shape[1] > 0
+
+
+def test_oblique_save_load_roundtrip(adult_train, adult_test, tmp_path):
+    m = ydf.GradientBoostedTreesLearner(
+        label="income", num_trees=5, split_axis="SPARSE_OBLIQUE",
+        validation_ratio=0.0, early_stopping="NONE",
+    ).train(adult_train.head(1000))
+    m.save(str(tmp_path / "m"))
+    m2 = ydf.load_model(str(tmp_path / "m"))
+    te = adult_test.head(300)
+    np.testing.assert_array_equal(m.predict(te), m2.predict(te))
+
+
+def test_import_ydf_oblique_gbdt(adult_test):
+    m = ydf.load_ydf_model(f"{MD}/adult_binary_class_gbdt_oblique")
+    assert m.forest.oblique_weights.shape[1] > 0
+    assert m.evaluate(adult_test).accuracy > 0.86
+
+
+def test_shap_oblique_raises(adult_test):
+    m = ydf.load_ydf_model(f"{MD}/adult_binary_class_gbdt_oblique")
+    with pytest.raises(NotImplementedError, match="oblique"):
+        m.predict_shap(adult_test.head(5))

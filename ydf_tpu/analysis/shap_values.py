@@ -163,6 +163,10 @@ def tree_shap(
     V = 1 for regression / binary GBT, num_classes for RF classification /
     multiclass GBT.
     """
+    if int(np.prod(model.forest.oblique_weights.shape[1:])) > 0:
+        raise NotImplementedError(
+            "TreeSHAP over oblique splits is not supported yet"
+        )
     ds = Dataset.from_data(data, dataspec=model.dataspec)
     ds, rows_used = ds.sample(max_rows, seed=seed)
     x_num, x_cat = model._encode_inputs(ds)

@@ -72,6 +72,11 @@ class GradientBoostedTreesLearner(GenericLearner):
         selective_gradient_boosting_ratio: float = 0.01,
         apply_link_function: bool = True,
         dart_dropout: float = 0.0,
+        split_axis: str = "AXIS_ALIGNED",
+        sparse_oblique_num_projections_exponent: float = 1.0,
+        sparse_oblique_projection_density_factor: float = 2.0,
+        sparse_oblique_weights: str = "BINARY",
+        sparse_oblique_max_num_projections: int = 64,
         features: Optional[Sequence[str]] = None,
         weights: Optional[str] = None,
         random_seed: int = 123456,
@@ -115,6 +120,27 @@ class GradientBoostedTreesLearner(GenericLearner):
         self.apply_link_function = apply_link_function
         # DART dropout rate over past iterations (reference :1468-1474).
         self.dart_dropout = dart_dropout
+        # Sparse-oblique splits (Tomita et al. JMLR'20; reference
+        # ydf/learner/decision_tree/oblique.cc). TPU-first formulation:
+        # per TREE (not per node-candidate), sample P random sparse
+        # projections, compute them as ONE [n, Fn] x [Fn, P] matmul on the
+        # MXU, quantile-bin the projected values, and let the histogram
+        # split search treat them as P extra numerical columns.
+        if split_axis not in ("AXIS_ALIGNED", "SPARSE_OBLIQUE"):
+            raise ValueError(f"Unknown split_axis {split_axis!r}")
+        if sparse_oblique_weights not in ("BINARY", "CONTINUOUS"):
+            raise ValueError(
+                f"Unknown sparse_oblique_weights {sparse_oblique_weights!r}"
+            )
+        self.split_axis = split_axis
+        self.sparse_oblique_num_projections_exponent = (
+            sparse_oblique_num_projections_exponent
+        )
+        self.sparse_oblique_projection_density_factor = (
+            sparse_oblique_projection_density_factor
+        )
+        self.sparse_oblique_weights = sparse_oblique_weights
+        self.sparse_oblique_max_num_projections = sparse_oblique_max_num_projections
         # jax.sharding.Mesh with axes (data, feature): distributes training
         # via GSPMD sharding annotations (see ydf_tpu/parallel/mesh.py — the
         # TPU-native replacement of the reference's gRPC worker protocol).
@@ -250,6 +276,45 @@ class GradientBoostedTreesLearner(GenericLearner):
         )
         rule = HessianGainRule(l2=self.l2_regularization)
 
+        # --- sparse-oblique projections: encode raw numerical features
+        # (imputed) split the same way as the bins; the boosting loop
+        # projects them per tree with one MXU matmul.
+        obl_P = 0
+        x_tr_raw = x_va_raw = None
+        if self.split_axis == "SPARSE_OBLIQUE" and binner.num_numerical > 0:
+            if self.mesh is not None:
+                raise NotImplementedError(
+                    "SPARSE_OBLIQUE under a mesh is not supported yet"
+                )
+            obl_P = int(
+                np.ceil(
+                    binner.num_numerical
+                    ** self.sparse_oblique_num_projections_exponent
+                )
+            )
+            obl_P = min(max(obl_P, 2), self.sparse_oblique_max_num_projections)
+
+            def enc_raw(ds):
+                m = np.zeros((ds.num_rows, binner.num_numerical), np.float32)
+                for i, name in enumerate(
+                    binner.feature_names[: binner.num_numerical]
+                ):
+                    if ds.dataspec.has_column(name) and name in ds.data:
+                        m[:, i] = ds.encoded_numerical(name)
+                    else:
+                        m[:, i] = binner.impute_values[i]
+                return m
+
+            x_all = enc_raw(prep["dataset"])
+            if "valid_bins" in prep:
+                x_tr_raw = x_all
+                x_va_raw = enc_raw(prep["valid_dataset"])
+            elif bins_va.shape[0] > 0:
+                x_tr_raw, x_va_raw = x_all[tr_idx], x_all[va_idx]
+            else:
+                x_tr_raw = x_all
+                x_va_raw = np.zeros((0, binner.num_numerical), np.float32)
+
         forest_stacked, leaf_values, logs = _train_gbt(
             jnp.asarray(bins_tr),
             jnp.asarray(y_tr),
@@ -274,6 +339,11 @@ class GradientBoostedTreesLearner(GenericLearner):
             goss_beta=self.goss_beta,
             selgb_ratio=self.selective_gradient_boosting_ratio,
             dart_dropout=self.dart_dropout,
+            oblique_P=obl_P,
+            oblique_density=self.sparse_oblique_projection_density_factor,
+            oblique_weight_type=self.sparse_oblique_weights,
+            x_tr_raw=None if x_tr_raw is None else jnp.asarray(x_tr_raw),
+            x_va_raw=None if x_va_raw is None else jnp.asarray(x_va_raw),
         )
 
         train_losses = np.asarray(logs["train_loss"])
@@ -304,9 +374,35 @@ class GradientBoostedTreesLearner(GenericLearner):
             leaf_stats=flatten(forest_stacked.leaf_stats),
             num_nodes=flatten(forest_stacked.num_nodes[..., None])[:, 0],
         )
-        forest = forest_from_stacked_trees(
-            stacked, flatten(leaf_values), binner.boundaries
-        )
+        if obl_P > 0:
+            # Tree features: [0, Fn) numerical, [Fn, Fn+P) projections,
+            # [Fn+P, ...) categorical. Remap to the Forest convention
+            # (projections after ALL real features) and attach each tree's
+            # projection matrix + per-projection bin cutpoints.
+            Fn = binner.num_numerical
+            Freal = binner.num_features
+            feat = np.asarray(stacked.feature)
+            is_obl = (feat >= Fn) & (feat < Fn + obl_P)
+            remapped = np.where(
+                is_obl,
+                Freal + (feat - Fn),
+                np.where(feat >= Fn + obl_P, feat - obl_P, feat),
+            )
+            stacked = stacked._replace(feature=remapped.astype(np.int32))
+            ow = np.repeat(np.asarray(logs["oblique_w"]), K, axis=0)[
+                : num_iters * K
+            ]
+            ob = np.repeat(np.asarray(logs["oblique_b"]), K, axis=0)[
+                : num_iters * K
+            ]
+            forest = forest_from_stacked_trees(
+                stacked, flatten(leaf_values), binner.boundaries,
+                oblique_weights=ow, oblique_boundaries=ob,
+            )
+        else:
+            forest = forest_from_stacked_trees(
+                stacked, flatten(leaf_values), binner.boundaries
+            )
 
         initial_predictions = np.asarray(logs["initial_predictions"])
         model = GradientBoostedTreesModel(
@@ -345,7 +441,8 @@ def _make_boost_fn(
     loss_obj, rule, tree_cfg: TreeConfig, num_trees, shrinkage, subsample,
     candidate_features, num_numerical, num_valid_features, seed, n, nv,
     sampling="RANDOM", goss_alpha=0.2, goss_beta=0.1, selgb_ratio=0.01,
-    dart_dropout=0.0,
+    dart_dropout=0.0, oblique_P=0, oblique_density=2.0,
+    oblique_weight_type="BINARY",
 ):
     """Builds (and caches) the jitted boosting loop for one static config.
 
@@ -356,11 +453,14 @@ def _make_boost_fn(
     arrays make cross-call reuse incorrect anyway)."""
     K = loss_obj.num_dims
     N = tree_cfg.max_nodes
+    B = tree_cfg.num_bins
 
     use_dart = dart_dropout > 0.0
+    P = oblique_P
 
     @jax.jit
-    def run(bins_tr, y_tr, w_tr, bins_va, y_va, w_va):
+    def run(bins_tr, y_tr, w_tr, bins_va, y_va, w_va,
+            x_tr_raw=None, x_va_raw=None):
         y_f = y_tr.astype(jnp.float32)
         init_pred = loss_obj.initial_predictions(y_f, w_tr)  # [K]
         preds0 = jnp.broadcast_to(init_pred[None, :], (n, K)).astype(jnp.float32)
@@ -414,6 +514,55 @@ def _make_boost_fn(
                 ).astype(jnp.float32)
             return jnp.ones((n,), jnp.float32)
 
+        def make_projections(k_proj):
+            """P sparse random projections as one MXU matmul + quantile
+            binning (reference oblique.cc SampleProjection, recast per-tree
+            and batched). Returns (W [P, Fn], boundaries [P, B-1],
+            aug_tr [n, F+P], aug_va [nv, F+P])."""
+            Fn = x_tr_raw.shape[1]
+            k_m, k_s = jax.random.split(k_proj)
+            p_incl = min(oblique_density / max(Fn, 1), 1.0)
+            mask = jax.random.bernoulli(k_m, p_incl, (P, Fn))
+            # Every projection touches at least one feature.
+            forced = jax.nn.one_hot(
+                jnp.arange(P) % Fn, Fn, dtype=jnp.bool_
+            )
+            mask = mask | (~mask.any(axis=1, keepdims=True) & forced)
+            if oblique_weight_type == "BINARY":
+                wts = jnp.where(
+                    jax.random.bernoulli(k_s, 0.5, (P, Fn)), 1.0, -1.0
+                )
+            else:
+                wts = jax.random.uniform(
+                    k_s, (P, Fn), minval=-1.0, maxval=1.0
+                )
+            W = (wts * mask).astype(jnp.float32)
+            z_tr = x_tr_raw @ W.T  # [n, P] — the MXU hot op
+            qs = jnp.linspace(1.0 / B, 1.0 - 1.0 / B, B - 1)
+            bnd = jnp.quantile(z_tr, qs, axis=0).T  # [P, B-1]
+            binize = jax.vmap(
+                lambda b, zz: jnp.searchsorted(b, zz, side="right")
+            )
+            zb_tr = binize(bnd, z_tr.T).astype(jnp.uint8).T  # [n, P]
+            aug_tr = jnp.concatenate(
+                [bins_tr[:, :num_numerical], zb_tr, bins_tr[:, num_numerical:]],
+                axis=1,
+            )
+            if nv > 0:
+                z_va = x_va_raw @ W.T
+                zb_va = binize(bnd, z_va.T).astype(jnp.uint8).T
+                aug_va = jnp.concatenate(
+                    [
+                        bins_va[:, :num_numerical],
+                        zb_va,
+                        bins_va[:, num_numerical:],
+                    ],
+                    axis=1,
+                )
+            else:
+                aug_va = bins_va
+            return W, bnd, aug_tr, aug_va
+
         def boost_step(carry, it):
             if use_dart:
                 preds, vpreds, key, contrib, vcontrib, tree_scale = carry
@@ -440,6 +589,24 @@ def _make_boost_fn(
             m = sample_mask(k_sub, g, preds_used)
             w_eff = w_tr * m
 
+            if P > 0:
+                key, k_proj = jax.random.split(key)
+                obl_w, obl_b, grow_bins, grow_bins_va = make_projections(
+                    k_proj
+                )
+                grow_num_numerical = num_numerical + P
+                grow_num_valid = (
+                    None
+                    if num_valid_features is None
+                    else num_valid_features + P
+                )
+            else:
+                obl_w = jnp.zeros((0, 0), jnp.float32)
+                obl_b = jnp.zeros((0, B - 1), jnp.float32)
+                grow_bins, grow_bins_va = bins_tr, bins_va
+                grow_num_numerical = num_numerical
+                grow_num_valid = num_valid_features
+
             trees_k, leaves_k = [], []
             new_contrib = jnp.zeros((n, K), jnp.float32)
             new_vcontrib = jnp.zeros((nv, K), jnp.float32)
@@ -449,16 +616,16 @@ def _make_boost_fn(
                     [g[:, k] * w_eff, h[:, k] * w_eff, w_eff], axis=1
                 )
                 res = grower.grow_tree(
-                    bins_tr, stats, kk,
+                    grow_bins, stats, kk,
                     rule=rule,
                     max_depth=tree_cfg.max_depth,
                     frontier=tree_cfg.frontier,
                     max_nodes=N,
                     num_bins=tree_cfg.num_bins,
-                    num_numerical=num_numerical,
+                    num_numerical=grow_num_numerical,
                     min_examples=tree_cfg.min_examples,
                     candidate_features=candidate_features,
-                    num_valid_features=num_valid_features,
+                    num_valid_features=grow_num_valid,
                 )
                 # Leaf values scaled by shrinkage at storage time, like the
                 # reference (set_leaf applies shrinkage).
@@ -466,7 +633,7 @@ def _make_boost_fn(
                 new_contrib = new_contrib.at[:, k].set(lv[res.leaf_id, 0])
                 if nv > 0:
                     vleaves = route_tree_bins(
-                        res.tree, bins_va, tree_cfg.max_depth
+                        res.tree, grow_bins_va, tree_cfg.max_depth
                     )
                     new_vcontrib = new_vcontrib.at[:, k].set(lv[vleaves, 0])
                 trees_k.append(res.tree)
@@ -516,7 +683,7 @@ def _make_boost_fn(
                 new_carry = (preds, vpreds, key, contrib, vcontrib, tree_scale)
             else:
                 new_carry = (preds, vpreds, key)
-            return new_carry, (trees, lvs, tl, vl)
+            return new_carry, (trees, lvs, tl, vl, obl_w, obl_b)
 
         if use_dart:
             carry0 = (
@@ -525,7 +692,7 @@ def _make_boost_fn(
                 jnp.zeros((num_trees, nv, K), jnp.float32),
                 jnp.zeros((num_trees,), jnp.float32),
             )
-            carry_end, (trees, lvs, tls, vls) = jax.lax.scan(
+            carry_end, (trees, lvs, tls, vls, obl_ws, obl_bs) = jax.lax.scan(
                 boost_step, carry0, jnp.arange(num_trees)
             )
             # Bake each iteration's final DART weight into its stored leaf
@@ -533,10 +700,10 @@ def _make_boost_fn(
             tree_scale = carry_end[5]
             lvs = lvs * tree_scale[:, None, None, None]
         else:
-            (_, _, _), (trees, lvs, tls, vls) = jax.lax.scan(
+            (_, _, _), (trees, lvs, tls, vls, obl_ws, obl_bs) = jax.lax.scan(
                 boost_step, (preds0, vpreds0, key0), jnp.arange(num_trees)
             )
-        return trees, lvs, tls, vls, init_pred
+        return trees, lvs, tls, vls, init_pred, obl_ws, obl_bs
 
     return run
 
@@ -546,7 +713,8 @@ def _train_gbt(
     loss_obj, rule, tree_cfg: TreeConfig, num_trees, shrinkage, subsample,
     candidate_features, num_numerical, num_valid_features, seed,
     sampling="RANDOM", goss_alpha=0.2, goss_beta=0.1, selgb_ratio=0.01,
-    dart_dropout=0.0,
+    dart_dropout=0.0, oblique_P=0, oblique_density=2.0,
+    oblique_weight_type="BINARY", x_tr_raw=None, x_va_raw=None,
 ):
     """The jitted boosting loop. Returns stacked trees [T, K, ...], leaf
     values [T, K, N, 1] and per-iteration logs."""
@@ -563,13 +731,21 @@ def _train_gbt(
         candidate_features, num_numerical, num_valid_features, seed,
         bins_tr.shape[0], bins_va.shape[0],
         sampling, goss_alpha, goss_beta, selgb_ratio, dart_dropout,
+        oblique_P, oblique_density, oblique_weight_type,
     )
-    trees, lvs, tls, vls, init_pred = run(
-        bins_tr, y_tr, w_tr, bins_va, y_va, w_va
-    )
+    if oblique_P > 0:
+        trees, lvs, tls, vls, init_pred, obl_w, obl_b = run(
+            bins_tr, y_tr, w_tr, bins_va, y_va, w_va, x_tr_raw, x_va_raw
+        )
+    else:
+        trees, lvs, tls, vls, init_pred, obl_w, obl_b = run(
+            bins_tr, y_tr, w_tr, bins_va, y_va, w_va
+        )
     logs = {
         "train_loss": tls,
         "valid_loss": vls,
         "initial_predictions": init_pred,
+        "oblique_w": obl_w,
+        "oblique_b": obl_b,
     }
     return trees, lvs, logs

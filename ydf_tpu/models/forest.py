@@ -40,6 +40,16 @@ class Forest(NamedTuple):
     # (the reference's NodeCondition.num_training_examples_with_weight /
     # leaf distribution sums) — drives TreeSHAP path weights.
     cover: jax.Array
+    # [T, P, Fn] f32 sparse-oblique projection weights (P = 0 when the
+    # forest has no oblique splits). A node with feature >= num_features
+    # is oblique: projection p = feature - num_features, condition
+    # dot(x_num, oblique_weights[t, p]) < threshold → left.
+    # Reference: decision_tree.proto:114-131 Oblique conditions.
+    oblique_weights: jax.Array
+    # [T, P, Fn] f32 replacement values for missing attributes inside a
+    # projection (decision_tree.proto Oblique.na_replacements, field 4);
+    # NaN = no replacement → the whole condition evaluates to na_left.
+    oblique_na_repl: jax.Array
     num_nodes: jax.Array      # [T] i32
 
     @property
@@ -64,16 +74,29 @@ class Forest(NamedTuple):
             d["na_left"] = np.zeros(np.shape(d["feature"]), bool)
         if "cover" not in d:  # saves from before the cover field
             d["cover"] = np.ones(np.shape(d["feature"]), np.float32)
+        if "oblique_weights" not in d:
+            T = np.shape(d["feature"])[0]
+            d["oblique_weights"] = np.zeros((T, 0, 0), np.float32)
+        if "oblique_na_repl" not in d:
+            d["oblique_na_repl"] = np.full(
+                np.shape(d["oblique_weights"]), np.nan, np.float32
+            )
         return Forest(**{f: jnp.asarray(d[f]) for f in Forest._fields})
 
 
 def forest_from_stacked_trees(
-    stacked_trees, leaf_value: jax.Array, boundaries: np.ndarray
+    stacked_trees, leaf_value: jax.Array, boundaries: np.ndarray,
+    oblique_weights=None, oblique_boundaries=None, oblique_na_repl=None,
 ) -> Forest:
     """stacked TreeArrays (leading T axis) + leaf values → Forest.
 
     `boundaries` is the binner's [F, B-1] float array; value-space thresholds
     are boundaries[feature, threshold_bin] (bin <= t  ⇔  v < boundaries[t]).
+
+    With oblique splits, `oblique_weights` [T, P, Fn] and
+    `oblique_boundaries` [T, P, B-1] give each tree's projection vectors and
+    per-projection bin cutpoints; nodes whose feature index lies in the
+    projection block carry thresholds from their own tree's boundaries.
     """
     feature = jnp.asarray(stacked_trees.feature)
     tbin = jnp.asarray(stacked_trees.threshold_bin)
@@ -81,6 +104,26 @@ def forest_from_stacked_trees(
     f_safe = jnp.maximum(feature, 0)
     t_safe = jnp.clip(tbin, 0, bnd.shape[1] - 1)
     threshold = bnd[f_safe, t_safe]
+    if oblique_weights is None:
+        oblique_weights = jnp.zeros((feature.shape[0], 0, 0), jnp.float32)
+    else:
+        # Per-tree projected-value thresholds: feature index in
+        # [F, F + P) selects projection f - F of its own tree.
+        ow = jnp.asarray(oblique_weights)
+        ob = jnp.asarray(oblique_boundaries)  # [T, P, B-1]
+        F = bnd.shape[0]
+        is_obl = feature >= F
+        p_safe = jnp.clip(feature - F, 0, max(ow.shape[1] - 1, 0))
+        tt = jnp.clip(tbin, 0, ob.shape[2] - 1)
+        obl_thr = jnp.take_along_axis(
+            jnp.take_along_axis(
+                ob, p_safe[:, :, None].repeat(ob.shape[2], 2), axis=1
+            ),
+            tt[:, :, None],
+            axis=2,
+        )[:, :, 0]
+        threshold = jnp.where(is_obl, obl_thr, threshold)
+        oblique_weights = ow
     return Forest(
         feature=feature,
         threshold=threshold.astype(jnp.float32),
@@ -95,5 +138,11 @@ def forest_from_stacked_trees(
         # leaf_stats' last column is the weighted example count (see
         # ops/grower.py stats layout: [..., sum_weights]).
         cover=jnp.asarray(stacked_trees.leaf_stats[..., -1]),
+        oblique_weights=oblique_weights,
+        oblique_na_repl=(
+            jnp.full(jnp.shape(oblique_weights), jnp.nan, jnp.float32)
+            if oblique_na_repl is None
+            else jnp.asarray(oblique_na_repl)
+        ),
         num_nodes=jnp.asarray(stacked_trees.num_nodes),
     )

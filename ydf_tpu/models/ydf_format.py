@@ -346,11 +346,15 @@ def trees_to_forest(
     """
     W = max((fmap.max_vocab + 31) // 32, 1)
     T = len(trees)
+    F_total = len(fmap.col_to_feature)
+    Fn = fmap.num_numerical
 
     per_tree = []
+    per_tree_proj: List[List[np.ndarray]] = []
     max_nodes, max_depth = 1, 1
     for root in trees:
         rows: List[dict] = []
+        projs: List[np.ndarray] = []
 
         def walk(node: _Node, depth: int) -> int:
             idx = len(rows)
@@ -397,10 +401,24 @@ def trees_to_forest(
                     fmap.spec.columns[ci].type == ColumnType.CATEGORICAL
                 )
                 row["na_left"] = False
-            elif ct == 7:
-                raise NotImplementedError(
-                    "oblique conditions not supported yet"
-                )
+            elif ct == 7:  # Oblique (:114-131): Σ w_i·x_i >= threshold
+                attrs = pw.get_packed_varints(c, 1)
+                wts = pw.get_packed_floats(c, 2)
+                na_repls = pw.get_packed_floats(c, 4)  # positional, opt.
+                wvec = np.zeros((Fn,), np.float32)
+                rvec = np.full((Fn,), np.nan, np.float32)
+                for j, (a, wv) in enumerate(zip(attrs, wts)):
+                    fi = fmap.col_to_feature[a]
+                    if fi >= Fn:
+                        raise ValueError(
+                            "oblique condition on non-numerical column"
+                        )
+                    wvec[fi] = wv
+                    if j < len(na_repls):
+                        rvec[fi] = na_repls[j]
+                row["feature"] = F_total + len(projs)
+                row["threshold"] = pw.get_float(c, 3)
+                projs.append((wvec, rvec))
             else:
                 raise NotImplementedError(f"condition type {ct}")
             # Negative child → left, positive child → right (our routing:
@@ -416,8 +434,21 @@ def trees_to_forest(
 
         walk(root, 0)
         per_tree.append(rows)
+        per_tree_proj.append(projs)
         max_nodes = max(max_nodes, len(rows))
         max_depth = max(max_depth, depth_of(root))
+
+    max_P = max((len(p) for p in per_tree_proj), default=0)
+    if max_P > 0:
+        obl = np.zeros((T, max_P, Fn), np.float32)
+        obl_r = np.full((T, max_P, Fn), np.nan, np.float32)
+        for t, projs in enumerate(per_tree_proj):
+            for pi, (wvec, rvec) in enumerate(projs):
+                obl[t, pi] = wvec
+                obl_r[t, pi] = rvec
+    else:
+        obl = np.zeros((T, 0, 0), np.float32)
+        obl_r = np.zeros((T, 0, 0), np.float32)
 
     def stack(field, dtype, shape=()):
         out = np.zeros((T, max_nodes) + shape, dtype)
@@ -442,6 +473,8 @@ def trees_to_forest(
         na_left=stack("na_left", np.bool_),
         leaf_value=stack("leaf_value", np.float32, (leaf_dim,)),
         cover=stack("cover", np.float32),
+        oblique_weights=obl,
+        oblique_na_repl=obl_r,
         num_nodes=np.array([len(r) for r in per_tree], np.int32),
     )
     return forest, max(max_depth, 1)

@@ -31,7 +31,16 @@ i32 = jnp.int32
 
 
 def route_tree_bins(tree, bins: jax.Array, max_depth: int) -> jax.Array:
-    """Leaf node id per example. tree: TreeArrays-like (single tree)."""
+    """Leaf node id per example. tree: TreeArrays-like (single tree).
+
+    Does NOT support oblique nodes (projections are not part of the input
+    bin matrix) — oblique forests must route in value mode."""
+    ow = getattr(tree, "oblique_weights", None)
+    if ow is not None and ow.size > 0:
+        raise NotImplementedError(
+            "binned routing over oblique forests is not supported; use "
+            "value-mode routing (forest_predict_values)"
+        )
     n = bins.shape[0]
 
     def body(_, node):
@@ -61,6 +70,10 @@ def route_tree_values(
 ) -> jax.Array:
     """Leaf node id per example, value mode. tree.threshold is float."""
     n = x_num.shape[0] if x_num.size else x_cat.shape[0]
+    ow = getattr(tree, "oblique_weights", None)
+    onr = getattr(tree, "oblique_na_repl", None)
+    P = 0 if ow is None else ow.shape[0]
+    F_total = x_num.shape[1] + x_cat.shape[1]
 
     def body(_, node):
         f = jnp.maximum(tree.feature[node], 0)
@@ -75,6 +88,23 @@ def route_tree_values(
             c = jnp.take_along_axis(x_cat, fc[:, None], axis=1)[:, 0]
         else:
             c = jnp.zeros((n,), i32)
+        if P > 0:
+            # Oblique node: feature index in [F, F+P) selects a projection;
+            # compare dot(x_num, w_p) to the threshold. Features with zero
+            # projection weight must not poison the dot with their NaNs;
+            # missing features INSIDE the projection use their stored
+            # na_replacement when present (decision_tree.proto Oblique
+            # field 4), else the NaN propagates → na_left.
+            p_id = jnp.clip(f - F_total, 0, P - 1)
+            w_vec = ow[p_id]  # [n, Fn]
+            repl = onr[p_id]  # [n, Fn], NaN = no replacement
+            x_eff = jnp.where(
+                jnp.isnan(x_num) & ~jnp.isnan(repl), repl, x_num
+            )
+            x_eff = jnp.where(w_vec != 0, x_eff, 0.0)
+            v = jnp.where(
+                f >= F_total, jnp.sum(x_eff * w_vec, axis=1), v
+            )
         go_left = jnp.where(
             is_cat,
             unpack_mask_bit(tree.cat_mask[node], jnp.maximum(c, 0)),
