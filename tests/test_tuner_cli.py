@@ -1,0 +1,90 @@
+"""Tuner + CLI tests (reference: pydf tuner.py RandomSearchTuner;
+cli/*.cc binaries via cli_test.sh smoke test)."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import ydf_tpu as ydf
+
+
+def _data(n=1200, seed=4):
+    rng = np.random.RandomState(seed)
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    y = (x1 - x2 + rng.normal(scale=0.4, size=n) > 0).astype(np.int64)
+    return {"x1": x1, "x2": x2, "y": y}
+
+
+def test_random_search_tuner():
+    data = _data()
+    tuner = ydf.RandomSearchTuner(num_trials=4, seed=3)
+    tuner.choice("max_depth", [2, 4])
+    tuner.choice("shrinkage", [0.05, 0.2])
+    learner = ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=10, validation_ratio=0.0, early_stopping="NONE"
+    )
+    model = tuner.train(learner, data)
+    assert len(tuner.logs) >= 2
+    logs = model.extra_metadata["tuner_logs"]
+    assert logs["best_score"] == max(t["score"] for t in logs["trials"])
+    assert model.evaluate(data).accuracy > 0.8
+
+
+def test_tuner_empty_space_raises():
+    with pytest.raises(ValueError, match="search space"):
+        ydf.RandomSearchTuner(num_trials=2).train(
+            ydf.GradientBoostedTreesLearner(label="y", num_trees=2), _data(100)
+        )
+
+
+def test_hyperparameter_templates():
+    t = ydf.GradientBoostedTreesLearner.hyperparameter_templates()
+    assert "better_defaultv1" in t and "benchmark_rank1v1" in t
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=5, validation_ratio=0.0, early_stopping="NONE",
+        **t["benchmark_rank1v1"],
+    ).train(_data(500))
+    assert m.forest.oblique_weights.shape[1] > 0  # template enables oblique
+
+
+def _cli(tmp_path, *argv):
+    return subprocess.run(
+        [sys.executable, "-m", "ydf_tpu.cli", *argv],
+        capture_output=True, text=True, cwd="/root/repo",
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": "/root/repo",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+             "HOME": "/root"},
+    )
+
+
+def test_cli_end_to_end(tmp_path):
+    syn = tmp_path / "syn.csv"
+    model_dir = tmp_path / "model"
+    r = _cli(tmp_path, "synthetic_dataset", "--output", str(syn),
+             "--num_examples", "800")
+    assert r.returncode == 0, r.stderr
+    r = _cli(tmp_path, "train", "--dataset", f"csv:{syn}", "--label",
+             "label", "--output", str(model_dir), "--cpu",
+             "--hyperparameters",
+             json.dumps({"num_trees": 5, "max_depth": 3}))
+    assert r.returncode == 0, r.stderr
+    r = _cli(tmp_path, "evaluate", "--model", str(model_dir), "--dataset",
+             f"csv:{syn}", "--cpu")
+    assert r.returncode == 0, r.stderr
+    assert "accuracy" in r.stdout
+    r = _cli(tmp_path, "predict", "--model", str(model_dir), "--dataset",
+             f"csv:{syn}", "--cpu")
+    assert r.returncode == 0, r.stderr
+    assert len(r.stdout.strip().splitlines()) == 800
+    r = _cli(tmp_path, "show_model", "--model", str(model_dir), "--cpu")
+    assert r.returncode == 0 and "GRADIENT_BOOSTED_TREES" in r.stdout
+    r = _cli(tmp_path, "infer_dataspec", "--dataset", f"csv:{syn}")
+    assert r.returncode == 0 and "NUMERICAL" in r.stdout
+    r = _cli(tmp_path, "benchmark_inference", "--model", str(model_dir),
+             "--dataset", f"csv:{syn}", "--num_runs", "3", "--cpu")
+    assert r.returncode == 0, r.stderr
+    assert "ns_per_example" in r.stdout

@@ -28,6 +28,8 @@ from ydf_tpu.learners.gbt import GradientBoostedTreesLearner
 from ydf_tpu.learners.random_forest import RandomForestLearner
 from ydf_tpu.learners.cart import CartLearner
 from ydf_tpu.learners.isolation_forest import IsolationForestLearner
+from ydf_tpu.learners.tuner import RandomSearchTuner
+from ydf_tpu.metrics import cross_validation
 from ydf_tpu.models.io import load_model
 from ydf_tpu.models.ydf_format import load_ydf_model
 from ydf_tpu.config import Task
@@ -46,5 +48,7 @@ __all__ = [
     "IsolationForestLearner",
     "load_model",
     "load_ydf_model",
+    "RandomSearchTuner",
+    "cross_validation",
     "Task",
 ]

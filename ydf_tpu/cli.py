@@ -1,0 +1,242 @@
+"""Command-line tools.
+
+Counterparts of the reference CLI binaries (`ydf/cli/`: train.cc,
+predict.cc, evaluate.cc, infer_dataspec.cc, show_dataspec.cc,
+show_model.cc, benchmark_inference.cc, utils/synthetic_dataset.cc) as one
+argparse entry point:
+
+    python -m ydf_tpu.cli train --dataset csv:train.csv --label y \
+        --learner GRADIENT_BOOSTED_TREES --output /tmp/model
+    python -m ydf_tpu.cli predict --model /tmp/model --dataset csv:test.csv
+    python -m ydf_tpu.cli evaluate --model /tmp/model --dataset csv:test.csv
+    python -m ydf_tpu.cli show_model --model /tmp/model
+    python -m ydf_tpu.cli infer_dataspec --dataset csv:train.csv
+    python -m ydf_tpu.cli benchmark_inference --model m --dataset csv:d.csv
+    python -m ydf_tpu.cli synthetic_dataset --output csv:/tmp/syn.csv
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _force_cpu_if_requested(args):
+    if getattr(args, "cpu", False):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+_LEARNERS = {
+    "GRADIENT_BOOSTED_TREES": "GradientBoostedTreesLearner",
+    "RANDOM_FOREST": "RandomForestLearner",
+    "CART": "CartLearner",
+    "ISOLATION_FOREST": "IsolationForestLearner",
+}
+
+
+def cmd_train(args):
+    _force_cpu_if_requested(args)
+    import ydf_tpu as ydf
+    from ydf_tpu.config import Task
+
+    cls = getattr(ydf, _LEARNERS[args.learner])
+    kwargs = json.loads(args.hyperparameters) if args.hyperparameters else {}
+    if args.learner == "ISOLATION_FOREST":
+        learner = cls(**kwargs)
+    else:
+        if not args.label:
+            sys.exit(
+                f"error: --label is required for learner {args.learner}"
+            )
+        learner = cls(label=args.label, task=Task(args.task), **kwargs)
+    t0 = time.time()
+    model = learner.train(args.dataset)
+    print(f"Trained in {time.time() - t0:.2f}s", file=sys.stderr)
+    model.save(args.output)
+    print(f"Model saved to {args.output}")
+
+
+def cmd_predict(args):
+    _force_cpu_if_requested(args)
+    import numpy as np
+
+    import ydf_tpu as ydf
+
+    model = ydf.load_model(args.model)
+    preds = model.predict(args.dataset)
+    out = args.output
+    preds = np.asarray(preds)
+    if out:
+        np.savetxt(out, preds.reshape(len(preds), -1), delimiter=",")
+        print(f"Predictions written to {out}")
+    else:
+        for row in preds.reshape(len(preds), -1):
+            print(",".join(f"{v:.6g}" for v in row))
+
+
+def cmd_evaluate(args):
+    _force_cpu_if_requested(args)
+    import ydf_tpu as ydf
+
+    model = ydf.load_model(args.model)
+    ev = model.evaluate(
+        args.dataset, confidence_intervals=args.confidence_intervals
+    )
+    print(ev)
+
+
+def cmd_infer_dataspec(args):
+    import ydf_tpu as ydf
+
+    ds = ydf.Dataset.from_data(args.dataset)
+    print(ds.dataspec)
+
+
+def cmd_show_dataspec(args):
+    import ydf_tpu as ydf
+
+    model = ydf.load_model(args.model)
+    print(model.dataspec)
+
+
+def cmd_show_model(args):
+    _force_cpu_if_requested(args)
+    import ydf_tpu as ydf
+
+    model = ydf.load_model(args.model)
+    print(model.describe())
+
+
+def cmd_benchmark_inference(args):
+    _force_cpu_if_requested(args)
+    import numpy as np
+
+    import ydf_tpu as ydf
+    from ydf_tpu.dataset.dataset import Dataset
+
+    model = ydf.load_model(args.model)
+    ds = Dataset.from_data(args.dataset, dataspec=model.dataspec)
+    model.predict(ds)  # warmup + compile
+    times = []
+    for _ in range(args.num_runs):
+        t0 = time.perf_counter()
+        model.predict(ds)
+        times.append(time.perf_counter() - t0)
+    per_example_ns = 1e9 * min(times) / ds.num_rows
+    print(
+        json.dumps(
+            {
+                "num_examples": ds.num_rows,
+                "num_runs": args.num_runs,
+                "best_wall_s": min(times),
+                "ns_per_example": round(per_example_ns, 1),
+            }
+        )
+    )
+
+
+def cmd_synthetic_dataset(args):
+    """Config-driven generator (reference dataset/synthetic_dataset.cc)."""
+    import numpy as np
+
+    rng = np.random.RandomState(args.seed)
+    n, fnum, fcat = args.num_examples, args.num_numerical, args.num_categorical
+    cols = {}
+    logit = np.zeros(n)
+    for i in range(fnum):
+        x = rng.normal(size=n)
+        cols[f"num_{i}"] = x
+        if i % 2 == 0:
+            logit += x * (1.0 / (i + 1))
+        else:
+            logit += np.sin(2 * x) * 0.5
+    for i in range(fcat):
+        vocab = [f"v{j}" for j in range(args.categorical_vocab_size)]
+        c = rng.randint(0, len(vocab), size=n)
+        cols[f"cat_{i}"] = np.array(vocab)[c]
+        logit += (c == 0) * 0.5
+    if args.task == "CLASSIFICATION":
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logit))).astype(np.int64)
+        cols["label"] = np.where(y == 1, "pos", "neg")
+    else:
+        cols["label"] = logit + rng.normal(scale=0.2, size=n)
+
+    import pandas as pd
+
+    path = args.output
+    if path.startswith("csv:"):
+        path = path[4:]
+    pd.DataFrame(cols).to_csv(path, index=False)
+    print(f"Wrote {n} examples to {path}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="ydf_tpu", description=__doc__)
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("train")
+    p.add_argument("--dataset", required=True)
+    p.add_argument("--label")
+    p.add_argument("--task", default="CLASSIFICATION")
+    p.add_argument("--learner", default="GRADIENT_BOOSTED_TREES",
+                   choices=sorted(_LEARNERS))
+    p.add_argument("--output", required=True)
+    p.add_argument("--hyperparameters", help="JSON dict of learner kwargs")
+    p.add_argument("--cpu", action="store_true")
+    p.set_defaults(fn=cmd_train)
+
+    p = sub.add_parser("predict")
+    p.add_argument("--model", required=True)
+    p.add_argument("--dataset", required=True)
+    p.add_argument("--output")
+    p.add_argument("--cpu", action="store_true")
+    p.set_defaults(fn=cmd_predict)
+
+    p = sub.add_parser("evaluate")
+    p.add_argument("--model", required=True)
+    p.add_argument("--dataset", required=True)
+    p.add_argument("--confidence_intervals", action="store_true")
+    p.add_argument("--cpu", action="store_true")
+    p.set_defaults(fn=cmd_evaluate)
+
+    p = sub.add_parser("infer_dataspec")
+    p.add_argument("--dataset", required=True)
+    p.set_defaults(fn=cmd_infer_dataspec)
+
+    p = sub.add_parser("show_dataspec")
+    p.add_argument("--model", required=True)
+    p.set_defaults(fn=cmd_show_dataspec)
+
+    p = sub.add_parser("show_model")
+    p.add_argument("--model", required=True)
+    p.add_argument("--cpu", action="store_true")
+    p.set_defaults(fn=cmd_show_model)
+
+    p = sub.add_parser("benchmark_inference")
+    p.add_argument("--model", required=True)
+    p.add_argument("--dataset", required=True)
+    p.add_argument("--num_runs", type=int, default=10)
+    p.add_argument("--cpu", action="store_true")
+    p.set_defaults(fn=cmd_benchmark_inference)
+
+    p = sub.add_parser("synthetic_dataset")
+    p.add_argument("--output", required=True)
+    p.add_argument("--num_examples", type=int, default=10000)
+    p.add_argument("--num_numerical", type=int, default=8)
+    p.add_argument("--num_categorical", type=int, default=2)
+    p.add_argument("--categorical_vocab_size", type=int, default=10)
+    p.add_argument("--task", default="CLASSIFICATION",
+                   choices=["CLASSIFICATION", "REGRESSION"])
+    p.add_argument("--seed", type=int, default=1234)
+    p.set_defaults(fn=cmd_synthetic_dataset)
+
+    args = ap.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

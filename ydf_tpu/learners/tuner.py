@@ -1,0 +1,135 @@
+"""Hyperparameter tuning.
+
+Counterpart of the reference's HyperParameterOptimizerLearner with the
+RandomOptimizer plugin (`ydf/learner/hyperparameters_optimizer/
+hyperparameters_optimizer.cc`, `optimizers/random.h:37-98`) and the PYDF
+RandomSearchTuner API (`pydf/learner/tuner.py:329`):
+
+    tuner = RandomSearchTuner(num_trials=30)
+    tuner.choice("max_depth", [3, 4, 6, 8])
+    tuner.choice("shrinkage", [0.02, 0.05, 0.1])
+    model = tuner.train(ydf.GradientBoostedTreesLearner(label=...), data)
+
+Each trial trains a candidate on a shared train split and scores it on a
+shared holdout; the winner's hyperparameters retrain on the full data.
+Trials reuse the jitted training executable whenever the static config
+repeats (the lru-cached boosting closure), which is the TPU analogue of
+the reference's trial-parallel worker pool.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ydf_tpu.dataset.dataset import Dataset
+
+
+@dataclasses.dataclass
+class TrialLog:
+    params: Dict[str, Any]
+    score: float  # higher = better
+
+
+class RandomSearchTuner:
+    def __init__(
+        self,
+        num_trials: int = 20,
+        automatic_search_space: bool = False,
+        holdout_ratio: float = 0.2,
+        seed: int = 1234,
+    ):
+        self.num_trials = num_trials
+        self.automatic_search_space = automatic_search_space
+        self.holdout_ratio = holdout_ratio
+        self.seed = seed
+        self.space: Dict[str, List[Any]] = {}
+        self.logs: List[TrialLog] = []
+
+    def choice(self, name: str, values: List[Any]) -> "RandomSearchTuner":
+        self.space[name] = list(values)
+        return self
+
+    # ------------------------------------------------------------------ #
+
+    def _auto_space(self, learner) -> Dict[str, List[Any]]:
+        """Default GBT search space (subset of the reference's default
+        hyperparameter space, hyperparameters_optimizer.proto:25-100)."""
+        return {
+            "max_depth": [3, 4, 6, 8],
+            "shrinkage": [0.02, 0.05, 0.1],
+            "subsample": [0.6, 0.8, 1.0],
+            "num_candidate_attributes_ratio": [0.5, 0.9, 1.0],
+            "min_examples": [5, 10, 20],
+        }
+
+    def train(self, learner, data):
+        """Runs the search and returns the best model retrained on all of
+        `data`; per-trial logs are in self.logs and in the returned
+        model's extra_metadata["tuner_logs"]."""
+        from ydf_tpu.analysis.importance import _primary_metric
+
+        if self.num_trials < 1:
+            raise ValueError("num_trials must be >= 1")
+        space = dict(self.space)
+        if not space:
+            if not self.automatic_search_space:
+                raise ValueError(
+                    "Empty search space: call tuner.choice(...) or set "
+                    "automatic_search_space=True"
+                )
+            space = self._auto_space(learner)
+        unknown = [k for k in space if not hasattr(learner, k)]
+        if unknown:
+            raise ValueError(
+                f"Search-space parameters {unknown} are not hyperparameters "
+                f"of {type(learner).__name__}"
+            )
+
+        ds = Dataset.from_data(data)
+        raw = {k: np.asarray(v) for k, v in ds.data.items()}
+        n = ds.num_rows
+        rng = np.random.default_rng(self.seed)
+        nv = max(int(n * self.holdout_ratio), 1)
+        perm = rng.permutation(n)
+        va_idx, tr_idx = perm[:nv], perm[nv:]
+        train_data = {k: v[tr_idx] for k, v in raw.items()}
+        hold_data = {k: v[va_idx] for k, v in raw.items()}
+
+        self.logs = []
+        seen = set()
+        best: Optional[TrialLog] = None
+        for _ in range(self.num_trials):
+            params = {
+                k: v[rng.integers(0, len(v))] for k, v in space.items()
+            }
+            key = tuple(sorted((k, repr(v)) for k, v in params.items()))
+            if key in seen:
+                continue
+            seen.add(key)
+            cand = copy.copy(learner)
+            for k, v in params.items():
+                setattr(cand, k, v)
+            model = cand.train(train_data)
+            ev = model.evaluate(hold_data)
+            metric, value, sign = _primary_metric(model, ev)
+            score = sign * value
+            self.logs.append(TrialLog(params=params, score=float(score)))
+            if best is None or score > best.score:
+                best = self.logs[-1]
+
+        final = copy.copy(learner)
+        for k, v in best.params.items():
+            setattr(final, k, v)
+        model = final.train(data)
+        model.extra_metadata["tuner_logs"] = {
+            "best_params": best.params,
+            "best_score": best.score,
+            "trials": [
+                {"params": t.params, "score": t.score} for t in self.logs
+            ],
+        }
+        return model

@@ -1,0 +1,84 @@
+"""Crash-safe training snapshots.
+
+Counterpart of the reference's snapshot-index protocol
+(`ydf/utils/snapshot.h:16-49` AddSnapshot/GetGreatestSnapshot +
+`max_kept_snapshots`): a snapshot payload file is written FIRST, and only
+then is its index appended to the `snapshot` index file — a crash between
+the two leaves the previous snapshot as the recoverable latest. Stale
+payloads beyond `max_kept` are pruned.
+
+Payloads are npz archives of flat arrays plus a JSON metadata blob.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class Snapshots:
+    def __init__(self, directory: str, max_kept: int = 3):
+        self.directory = directory
+        self.max_kept = max_kept
+        os.makedirs(directory, exist_ok=True)
+
+    # ------------------------------------------------------------------ #
+
+    def _index_path(self) -> str:
+        return os.path.join(self.directory, "snapshot")
+
+    def _payload_path(self, idx: int) -> str:
+        return os.path.join(self.directory, f"snapshot_{idx}.npz")
+
+    def indices(self) -> List[int]:
+        if not os.path.isfile(self._index_path()):
+            return []
+        with open(self._index_path()) as f:
+            out = []
+            for line in f:
+                line = line.strip()
+                if line.isdigit():
+                    out.append(int(line))
+        return sorted(set(out))
+
+    # ------------------------------------------------------------------ #
+
+    def save(self, idx: int, arrays: Dict[str, np.ndarray],
+             meta: Optional[dict] = None) -> None:
+        """Write payload, then record the index (crash-safe order)."""
+        payload = dict(arrays)
+        payload["__meta__"] = np.frombuffer(
+            json.dumps(meta or {}).encode("utf-8"), dtype=np.uint8
+        )
+        tmp = self._payload_path(idx) + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, self._payload_path(idx))
+        idxs = [i for i in self.indices() if i != idx] + [idx]
+        with open(self._index_path() + ".tmp", "w") as f:
+            f.write("\n".join(str(i) for i in idxs) + "\n")
+        os.replace(self._index_path() + ".tmp", self._index_path())
+        # Prune old payloads (keep the newest max_kept).
+        for old in idxs[: -self.max_kept]:
+            try:
+                os.remove(self._payload_path(old))
+            except OSError:
+                pass
+
+    def latest(self) -> Optional[Tuple[int, Dict[str, np.ndarray], dict]]:
+        """(index, arrays, meta) of the greatest readable snapshot."""
+        for idx in reversed(self.indices()):
+            path = self._payload_path(idx)
+            if not os.path.isfile(path):
+                continue
+            try:
+                with np.load(path) as z:
+                    arrays = {k: z[k] for k in z.files if k != "__meta__"}
+                    meta = json.loads(bytes(z["__meta__"]).decode("utf-8"))
+                return idx, arrays, meta
+            except Exception:
+                continue  # partially written / corrupt → try older
+        return None
