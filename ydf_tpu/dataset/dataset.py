@@ -32,11 +32,17 @@ InputData = Union["Dataset", Dict[str, Any], str, "pandas.DataFrame"]  # noqa: F
 def _read_csv(path: str) -> Dict[str, np.ndarray]:
     """Reads a CSV into columns, with light type sniffing.
 
-    The reference ships its own CSV reader (`ydf/dataset/csv_example_reader.cc`
-    and `ydf/utils/csv.cc`); here pandas (baked into the image) does the
-    parsing and we normalize dtypes: numeric → float32/float64, everything
-    else → object (string) columns.
+    IO is native first, like the reference
+    (`ydf/dataset/csv_example_reader.cc`): the C++ loader in
+    native/csv_loader.cc parses column-wise into numeric arrays + string
+    dictionaries through ctypes; pandas is the fallback when the native
+    library is unavailable (no toolchain) or the file defeats it.
     """
+    from ydf_tpu.dataset import native_csv
+
+    cols = native_csv.read_csv(path)
+    if cols is not None:
+        return cols
     import pandas as pd
 
     df = pd.read_csv(path)

@@ -1,0 +1,135 @@
+"""ctypes bridge to the native C++ CSV loader (native/csv_loader.cc).
+
+The native loader is compiled on first use (g++ -O3 -shared) into
+native/build/ and cached; any build or load failure silently falls back
+to the pandas reader, so the package works without a toolchain. This is
+the runtime counterpart of the reference's C++ dataset IO
+(ydf/dataset/csv_example_reader.cc) — IO stays native, compute stays XLA.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+_SRC = os.path.join(_REPO_ROOT, "native", "csv_loader.cc")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libydfcsv.so")
+
+_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+
+def _load_library():
+    global _lib, _lib_failed
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            have_src = os.path.isfile(_SRC)
+            stale = (
+                have_src
+                and os.path.isfile(_LIB_PATH)
+                and os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
+            )
+            if not os.path.isfile(_LIB_PATH) or stale:
+                if not have_src:
+                    raise FileNotFoundError(_SRC)
+                os.makedirs(_BUILD_DIR, exist_ok=True)
+                # Per-process temp name: concurrent cold builds must not
+                # os.replace each other's half-written objects.
+                tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
+                subprocess.run(
+                    [
+                        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                        _SRC, "-o", tmp,
+                    ],
+                    check=True, capture_output=True, timeout=120,
+                )
+                os.replace(tmp, _LIB_PATH)
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.ydf_csv_load.restype = ctypes.c_void_p
+            lib.ydf_csv_load.argtypes = [ctypes.c_char_p]
+            lib.ydf_csv_free.argtypes = [ctypes.c_void_p]
+            lib.ydf_csv_error.restype = ctypes.c_char_p
+            lib.ydf_csv_error.argtypes = [ctypes.c_void_p]
+            lib.ydf_csv_num_rows.restype = ctypes.c_int64
+            lib.ydf_csv_num_rows.argtypes = [ctypes.c_void_p]
+            lib.ydf_csv_num_cols.restype = ctypes.c_int32
+            lib.ydf_csv_num_cols.argtypes = [ctypes.c_void_p]
+            lib.ydf_csv_col_name.restype = ctypes.c_char_p
+            lib.ydf_csv_col_name.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+            lib.ydf_csv_col_is_numeric.restype = ctypes.c_int32
+            lib.ydf_csv_col_is_numeric.argtypes = [
+                ctypes.c_void_p, ctypes.c_int32,
+            ]
+            lib.ydf_csv_col_numeric.restype = ctypes.POINTER(ctypes.c_double)
+            lib.ydf_csv_col_numeric.argtypes = [
+                ctypes.c_void_p, ctypes.c_int32,
+            ]
+            lib.ydf_csv_col_codes.restype = ctypes.POINTER(ctypes.c_int32)
+            lib.ydf_csv_col_codes.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+            lib.ydf_csv_col_dict_size.restype = ctypes.c_int32
+            lib.ydf_csv_col_dict_size.argtypes = [
+                ctypes.c_void_p, ctypes.c_int32,
+            ]
+            lib.ydf_csv_col_dict_value.restype = ctypes.c_char_p
+            lib.ydf_csv_col_dict_value.argtypes = [
+                ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+            ]
+            _lib = lib
+        except Exception:
+            _lib_failed = True
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return _load_library() is not None
+
+
+def read_csv(path: str) -> Optional[Dict[str, np.ndarray]]:
+    """name → column array (float64 with NaN missing, or object strings
+    with '' missing). None if the native loader is unavailable or the
+    file cannot be parsed (caller falls back to pandas)."""
+    lib = _load_library()
+    if lib is None:
+        return None
+    handle = lib.ydf_csv_load(path.encode("utf-8"))
+    if not handle:
+        return None
+    try:
+        err = lib.ydf_csv_error(handle)
+        if err:
+            return None
+        n = lib.ydf_csv_num_rows(handle)
+        out: Dict[str, np.ndarray] = {}
+        for i in range(lib.ydf_csv_num_cols(handle)):
+            name = lib.ydf_csv_col_name(handle, i).decode("utf-8")
+            if lib.ydf_csv_col_is_numeric(handle, i):
+                buf = lib.ydf_csv_col_numeric(handle, i)
+                out[name] = np.ctypeslib.as_array(buf, shape=(n,)).copy()
+            else:
+                codes_buf = lib.ydf_csv_col_codes(handle, i)
+                codes = np.ctypeslib.as_array(codes_buf, shape=(n,)).copy()
+                vocab = np.array(
+                    [
+                        lib.ydf_csv_col_dict_value(handle, i, j).decode(
+                            "utf-8"
+                        )
+                        for j in range(lib.ydf_csv_col_dict_size(handle, i))
+                    ]
+                    + [""],  # code -1 (missing) indexes the sentinel
+                    dtype=object,
+                )
+                out[name] = vocab[codes]
+        return out
+    finally:
+        lib.ydf_csv_free(handle)

@@ -72,15 +72,21 @@ class GradientBoostedTreesModel(GenericModel):
             if self.loss_name == "POISSON":
                 return np.exp(scores)  # log link
             return scores
-        # Multi-dim: route each dim's trees separately.
+        # Multi-dim: route each dim's trees separately. Sub-forests are
+        # cached so repeated predicts reuse identical array objects (the
+        # fast-engine cache keys on identity).
         from ydf_tpu.models.forest import Forest
 
         per_dim = []
-        fo = self.forest.to_numpy()
+        subs = getattr(self, "_dim_forests", None)
+        if subs is None or len(subs) != K:
+            fo = self.forest.to_numpy()
+            subs = self._dim_forests = [
+                Forest.from_numpy({f: a[k::K] for f, a in fo.items()})
+                for k in range(K)
+            ]
         for k in range(K):
-            sub = Forest.from_numpy(
-                {f: a[k::K] for f, a in fo.items()}
-            )
+            sub = subs[k]
             sub_model_forest, self.forest = self.forest, sub
             try:
                 s = self._raw_scores(data, combine="sum")[:, 0]

@@ -149,9 +149,45 @@ class GenericModel:
                     x_cat[:, j] = -1
         return x_num, x_cat
 
+    def _fast_engine(self):
+        """QuickScorer engine for the CURRENT forest, or None. Compiled
+        engines only pay off on TPU; the CPU interpreter fallback is for
+        tests (YDF_TPU_FORCE_QUICKSCORER=1). Cached per forest object —
+        multiclass predict temporarily swaps self.forest per output dim."""
+        import os
+
+        import jax
+
+        force = os.environ.get("YDF_TPU_FORCE_QUICKSCORER") == "1"
+        if not force and jax.default_backend() != "tpu":
+            return None
+        cache = getattr(self, "_qs_cache", None)
+        if cache is None:
+            cache = self._qs_cache = {}
+        key = id(self.forest.feature)
+        hit = cache.get(key)
+        # Entries pin the keyed array (id() is only unique among live
+        # objects) and are verified by identity before use.
+        if hit is None or hit[0] is not self.forest.feature:
+            from ydf_tpu.serving import build_quickscorer
+
+            if len(cache) > 8:
+                cache.clear()
+            cache[key] = (
+                self.forest.feature,
+                build_quickscorer(
+                    self, interpret=force and jax.default_backend() != "tpu"
+                ),
+            )
+        return cache[key][1]
+
     def _raw_scores(self, data: InputData, combine: str) -> np.ndarray:
         ds = Dataset.from_data(data, dataspec=self.dataspec)
         x_num, x_cat = self._encode_inputs(ds)
+        if combine == "sum" and not self.native_missing:
+            eng = self._fast_engine()
+            if eng is not None:
+                return np.asarray(eng(jnp.asarray(x_num)))[:, None]
         out = forest_predict_values(
             self.forest,
             jnp.asarray(x_num),

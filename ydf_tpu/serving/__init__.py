@@ -1,0 +1,6 @@
+from ydf_tpu.serving.quickscorer import (
+    QuickScorerEngine,
+    build_quickscorer,
+)
+
+__all__ = ["QuickScorerEngine", "build_quickscorer"]
