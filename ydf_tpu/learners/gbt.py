@@ -78,6 +78,7 @@ class GradientBoostedTreesLearner(GenericLearner):
         sparse_oblique_projection_density_factor: float = 2.0,
         sparse_oblique_weights: str = "BINARY",
         sparse_oblique_max_num_projections: int = 64,
+        monotonic_constraints: Optional[dict] = None,
         working_dir: Optional[str] = None,
         resume_training: bool = False,
         resume_training_snapshot_interval_trees: int = 50,
@@ -145,6 +146,11 @@ class GradientBoostedTreesLearner(GenericLearner):
         )
         self.sparse_oblique_weights = sparse_oblique_weights
         self.sparse_oblique_max_num_projections = sparse_oblique_max_num_projections
+        # Monotonic constraints: {feature_name: +1|-1} (reference
+        # training.h:160-168 ApplyConstraintOnNode). Split search rejects
+        # order-violating cuts; a post-training pass clamps leaf values to
+        # propagated bounds, guaranteeing global monotonicity.
+        self.monotonic_constraints = dict(monotonic_constraints or {})
         # Checkpoint/resume (reference DeploymentConfig.cache_path +
         # resume_training, abstract_learner.proto:52-64): with a
         # working_dir, the boosting loop snapshots its full state every
@@ -309,6 +315,31 @@ class GradientBoostedTreesLearner(GenericLearner):
         )
         rule = HessianGainRule(l2=self.l2_regularization)
 
+        monotone = None
+        if self.monotonic_constraints:
+            if self.split_axis == "SPARSE_OBLIQUE":
+                raise NotImplementedError(
+                    "monotonic constraints with oblique splits"
+                )
+            if K > 1:
+                # Clamping (the guarantee) is single-output only so far.
+                raise NotImplementedError(
+                    "monotonic constraints with multi-dim losses"
+                )
+            dirs = [0] * binner.num_features
+            for name, d in self.monotonic_constraints.items():
+                if name not in binner.feature_names:
+                    raise ValueError(f"Unknown monotonic feature {name!r}")
+                idx = binner.feature_names.index(name)
+                if idx >= binner.num_numerical:
+                    raise ValueError(
+                        f"Monotonic constraint on non-numerical {name!r}"
+                    )
+                dirs[idx] = int(np.sign(d))
+            # Feature-parallel padding appends zero columns; extend.
+            dirs += [0] * (bins_tr.shape[1] - len(dirs))
+            monotone = tuple(dirs)
+
         # --- sparse-oblique projections: encode raw numerical features
         # (imputed) split the same way as the bins; the boosting loop
         # projects them per tree with one MXU matmul.
@@ -375,6 +406,7 @@ class GradientBoostedTreesLearner(GenericLearner):
             oblique_P=obl_P,
             oblique_density=self.sparse_oblique_projection_density_factor,
             oblique_weight_type=self.sparse_oblique_weights,
+            monotone=monotone,
             x_tr_raw=None if x_tr_raw is None else jnp.asarray(x_tr_raw),
             x_va_raw=None if x_va_raw is None else jnp.asarray(x_va_raw),
             cache_dir=self.working_dir,
@@ -441,6 +473,11 @@ class GradientBoostedTreesLearner(GenericLearner):
                 stacked, flatten(leaf_values), binner.boundaries
             )
 
+        if self.monotonic_constraints and K == 1:
+            forest = _clamp_monotone_leaves(
+                forest, binner, self.monotonic_constraints
+            )
+
         initial_predictions = np.asarray(logs["initial_predictions"])
         model = GradientBoostedTreesModel(
             task=self.task,
@@ -479,7 +516,7 @@ def _make_boost_fn(
     candidate_features, num_numerical, num_valid_features, seed, n, nv,
     sampling="RANDOM", goss_alpha=0.2, goss_beta=0.1, selgb_ratio=0.01,
     dart_dropout=0.0, oblique_P=0, oblique_density=2.0,
-    oblique_weight_type="BINARY",
+    oblique_weight_type="BINARY", monotone=None,
 ):
     """Builds (and caches) the jitted boosting loop for one static config.
 
@@ -675,6 +712,7 @@ def _make_boost_fn(
                     min_examples=tree_cfg.min_examples,
                     candidate_features=candidate_features,
                     num_valid_features=grow_num_valid,
+                    monotone=monotone,
                 )
                 # Leaf values scaled by shrinkage at storage time, like the
                 # reference (set_leaf applies shrinkage).
@@ -783,7 +821,8 @@ def _train_gbt(
     candidate_features, num_numerical, num_valid_features, seed,
     sampling="RANDOM", goss_alpha=0.2, goss_beta=0.1, selgb_ratio=0.01,
     dart_dropout=0.0, oblique_P=0, oblique_density=2.0,
-    oblique_weight_type="BINARY", x_tr_raw=None, x_va_raw=None,
+    oblique_weight_type="BINARY", monotone=None,
+    x_tr_raw=None, x_va_raw=None,
     cache_dir=None, resume=False, snapshot_interval=50,
     abort_after_chunks=None,
 ):
@@ -802,7 +841,7 @@ def _train_gbt(
         candidate_features, num_numerical, num_valid_features, seed,
         bins_tr.shape[0], bins_va.shape[0],
         sampling, goss_alpha, goss_beta, selgb_ratio, dart_dropout,
-        oblique_P, oblique_density, oblique_weight_type,
+        oblique_P, oblique_density, oblique_weight_type, monotone,
     )
     data_args = (bins_tr, y_tr, w_tr, bins_va, y_va, w_va) + (
         (x_tr_raw, x_va_raw) if oblique_P > 0 else ()
@@ -972,3 +1011,42 @@ class _TrainingAborted(RuntimeError):
     the same way: MaybeSimulateFailure, worker.cc:415-452)."""
 
 
+
+
+def _clamp_monotone_leaves(forest, binner, constraints):
+    """Propagates [lower, upper] bounds down each tree and clamps leaf
+    values — the reference's ApplyConstraintOnNode (training.h:160-168):
+    at a monotone split, the midpoint of the two children's value
+    estimates bounds the opposite sides, which guarantees monotonicity
+    of the final piecewise-constant function."""
+    from ydf_tpu.models.forest import Forest
+
+    f = forest.to_numpy()
+    dirs = np.zeros((binner.num_features,), np.int8)
+    for name, d in constraints.items():
+        dirs[binner.feature_names.index(name)] = np.sign(d)
+    lv = f["leaf_value"].copy()  # [T, N, 1]
+    T = lv.shape[0]
+    for t in range(T):
+        stack = [(0, -np.inf, np.inf)]
+        while stack:
+            nid, lo, hi = stack.pop()
+            if f["is_leaf"][t, nid]:
+                lv[t, nid, 0] = np.clip(lv[t, nid, 0], lo, hi)
+                continue
+            left, right = int(f["left"][t, nid]), int(f["right"][t, nid])
+            feat = int(f["feature"][t, nid])
+            d = dirs[feat] if 0 <= feat < len(dirs) else 0
+            if d == 0:
+                stack.append((left, lo, hi))
+                stack.append((right, lo, hi))
+            else:
+                mid = 0.5 * (lv[t, left, 0] + lv[t, right, 0])
+                mid = float(np.clip(mid, lo, hi))
+                if d > 0:
+                    stack.append((left, lo, mid))
+                    stack.append((right, mid, hi))
+                else:
+                    stack.append((left, mid, hi))
+                    stack.append((right, lo, mid))
+    return Forest.from_numpy({**f, "leaf_value": lv})

@@ -47,7 +47,15 @@ class GenericLearner:
     ) -> Dict:
         """Common ingestion: dataset, binning, encoded label/weights."""
         column_types = {}
-        if self.label is not None and self.task == Task.CLASSIFICATION:
+        treat_col = getattr(self, "uplift_treatment", None)
+        if treat_col:
+            # Treatment groups are dictionary-encoded: index 1 = control
+            # (most frequent), index 2 = treated — the reference's
+            # convention (decision_tree.proto:66-69).
+            column_types[treat_col] = ColumnType.CATEGORICAL
+        if self.label is not None and self.task in (
+            Task.CLASSIFICATION, Task.CATEGORICAL_UPLIFT,
+        ):
             # Classification labels are always dictionary-encoded, whatever
             # their raw dtype (reference: label goes through a categorical
             # guide) — the shared dictionary makes label encoding consistent
@@ -66,6 +74,7 @@ class GenericLearner:
                 self.label,
                 self.weights,
                 getattr(self, "ranking_group", None),
+                getattr(self, "uplift_treatment", None),
             } - {None}
             feature_names = [
                 c.name
@@ -88,8 +97,17 @@ class GenericLearner:
             "bins": binned.bins,
         }
         if self.label is not None:
-            out["labels"] = ds.encoded_label(self.label, self.task)
-            if self.task == Task.CLASSIFICATION:
+            # CATEGORICAL_UPLIFT outcomes are dictionary-encoded like
+            # classification labels.
+            label_task = (
+                Task.CLASSIFICATION
+                if self.task == Task.CATEGORICAL_UPLIFT
+                else self.task
+            )
+            if self.task == Task.NUMERICAL_UPLIFT:
+                label_task = Task.REGRESSION
+            out["labels"] = ds.encoded_label(self.label, label_task)
+            if label_task == Task.CLASSIFICATION:
                 out["classes"] = ds.label_classes(self.label)
         if self.weights is not None:
             out["sample_weights"] = ds.data[self.weights].astype(np.float32)

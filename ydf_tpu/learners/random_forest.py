@@ -25,7 +25,11 @@ from ydf_tpu.learners.generic import GenericLearner
 from ydf_tpu.models.forest import forest_from_stacked_trees
 from ydf_tpu.models.rf_model import RandomForestModel
 from ydf_tpu.ops import grower
-from ydf_tpu.ops.split_rules import ClassificationRule, RegressionRule
+from ydf_tpu.ops.split_rules import (
+    ClassificationRule,
+    RegressionRule,
+    UpliftEuclideanRule,
+)
 
 
 class RandomForestLearner(GenericLearner):
@@ -45,6 +49,7 @@ class RandomForestLearner(GenericLearner):
         num_candidate_attributes_ratio: float = -1.0,
         winner_take_all: bool = True,
         max_frontier: int = 1024,
+        uplift_treatment: Optional[str] = None,
         features: Optional[Sequence[str]] = None,
         weights: Optional[str] = None,
         random_seed: int = 123456,
@@ -63,6 +68,7 @@ class RandomForestLearner(GenericLearner):
         self.num_candidate_attributes_ratio = num_candidate_attributes_ratio
         self.winner_take_all = winner_take_all
         self.max_frontier = max_frontier
+        self.uplift_treatment = uplift_treatment
 
     # ------------------------------------------------------------------ #
 
@@ -87,7 +93,46 @@ class RandomForestLearner(GenericLearner):
         w_base = jnp.asarray(prep["sample_weights"])
         n, F = bins.shape
 
-        if self.task == Task.CLASSIFICATION:
+        if self.task in (Task.CATEGORICAL_UPLIFT, Task.NUMERICAL_UPLIFT):
+            # Treatment-effect trees (reference uplift.h; RF uplift as in
+            # sim_pte_categorical_uplift_rf): binary treatment, binary or
+            # numerical outcome, Euclidean-divergence splits.
+            if not self.uplift_treatment:
+                raise ValueError("Uplift tasks require uplift_treatment=")
+            rule = UpliftEuclideanRule()
+            ds = prep["dataset"]
+            tcodes = ds.encoded_categorical(self.uplift_treatment)
+            tcol = ds.dataspec.column_by_name(self.uplift_treatment)
+            if tcol.vocab_size > 3:
+                raise NotImplementedError(
+                    "Only binary treatments are supported"
+                )
+            t01 = jnp.asarray((tcodes == 2).astype(np.float32))
+            # OOV/missing treatment (code <= 0) is excluded entirely —
+            # the reference ignores the treatment OOV item
+            # (decision_tree.proto:66-69).
+            t_known = jnp.asarray((tcodes >= 1).astype(np.float32))
+            if self.task == Task.CATEGORICAL_UPLIFT:
+                classes = prep["classes"]
+                if len(classes) != 2:
+                    raise NotImplementedError(
+                        "Only binary outcomes are supported"
+                    )
+                # Positive outcome = second dictionary item (reference:
+                # outcome categorical value 2).
+                y = jnp.asarray(
+                    (prep["labels"] == 1).astype(np.float32)
+                )
+            else:
+                classes = None
+                y = jnp.asarray(prep["labels"].astype(np.float32))
+
+            def stats_fn(w):
+                w = w * t_known
+                wc = w * (1.0 - t01)
+                wt = w * t01
+                return jnp.stack([wc, wc * y, wt, wt * y, w], axis=1)
+        elif self.task == Task.CLASSIFICATION:
             classes = prep["classes"]
             C = len(classes)
             rule = ClassificationRule(num_classes=C)
@@ -139,6 +184,11 @@ class RandomForestLearner(GenericLearner):
             forest=forest,
             max_depth=self.max_depth,
             winner_take_all=self.winner_take_all,
+            extra_metadata=(
+                {"uplift_treatment": self.uplift_treatment}
+                if self.uplift_treatment
+                else None
+            ),
         )
 
 

@@ -63,6 +63,10 @@ def cross_validation(
     into a single EvaluationResults, abstract_learner.h:267-270)."""
     from ydf_tpu.config import Task
 
+    if learner.task in (Task.CATEGORICAL_UPLIFT, Task.NUMERICAL_UPLIFT):
+        raise NotImplementedError(
+            "cross_validation does not support uplift tasks yet"
+        )
     ds = Dataset.from_data(data)
     raw = {k: np.asarray(v) for k, v in ds.data.items()}
     n = ds.num_rows

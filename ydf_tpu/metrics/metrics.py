@@ -218,6 +218,39 @@ def ndcg_at_k(labels, scores, groups, k: int = 5) -> float:
     return float(total / max(count, 1))
 
 
+def qini_curve(uplift_pred, outcome, treatment, weights=None):
+    """Qini curve points + areas (reference metric/uplift.cc AUUC/Qini).
+
+    outcome: 1 = positive; treatment: 1 = treated, 0 = control.
+    Returns dict with qini (area above random) and auuc.
+    """
+    n = len(uplift_pred)
+    w = np.ones(n) if weights is None else np.asarray(weights, np.float64)
+    order = np.argsort(-np.asarray(uplift_pred, np.float64), kind="mergesort")
+    y = np.asarray(outcome, np.float64)[order]
+    t = np.asarray(treatment, np.float64)[order]
+    ww = w[order]
+    cum_w = np.cumsum(ww)
+    yt = np.cumsum(ww * y * t)
+    yc = np.cumsum(ww * y * (1 - t))
+    nt = np.cumsum(ww * t)
+    nc = np.cumsum(ww * (1 - t))
+    # Qini: incremental positives among treated minus scaled control.
+    q = yt - yc * nt / np.maximum(nc, _EPS)
+    frac = cum_w / cum_w[-1]
+    # Normalized per example (the reference metric/uplift.cc reports the
+    # curve areas relative to dataset size).
+    qn = q / cum_w[-1]
+    auuc = float(np.trapezoid(qn, frac))
+    random_area = 0.5 * qn[-1]
+    return {
+        "qini": float(auuc - random_area),
+        "auuc": auuc,
+        "curve_fraction": frac,
+        "curve_uplift": qn,
+    }
+
+
 def evaluate_predictions(
     task,
     labels: np.ndarray,
@@ -229,6 +262,7 @@ def evaluate_predictions(
     confidence_intervals: bool = False,
     num_bootstrap: int = 2000,
     seed: int = 1234,
+    treatments: Optional[np.ndarray] = None,
 ) -> Evaluation:
     from ydf_tpu.config import Task
 
@@ -370,6 +404,14 @@ def evaluate_predictions(
         return Evaluation(
             task=task.value, num_examples=n, metrics=metrics,
             confidence_intervals=cis,
+        )
+
+    if task in (Task.CATEGORICAL_UPLIFT, Task.NUMERICAL_UPLIFT):
+        assert treatments is not None, "Uplift evaluation needs treatments"
+        r = qini_curve(predictions.reshape(-1), labels, treatments, w)
+        return Evaluation(
+            task=task.value, num_examples=n,
+            metrics={"qini": r["qini"], "auuc": r["auuc"]},
         )
 
     if task == Task.ANOMALY_DETECTION:

@@ -214,8 +214,28 @@ class GenericModel:
     ) -> Evaluation:
         ds = Dataset.from_data(data, dataspec=self.dataspec)
         preds = self.predict(ds)
-        labels = ds.encoded_label(self.label, self.task)
         w = ds.data[weights].astype(np.float32) if weights else None
+        if self.task in (Task.CATEGORICAL_UPLIFT, Task.NUMERICAL_UPLIFT):
+            tcol = self.extra_metadata.get("uplift_treatment")
+            if not tcol:
+                raise ValueError("Uplift model lacks uplift_treatment metadata")
+            tcodes = ds.encoded_categorical(tcol)
+            keep = tcodes >= 1  # drop OOV/missing treatments, like training
+            treatments = (tcodes[keep] == 2).astype(np.int64)
+            if self.task == Task.CATEGORICAL_UPLIFT:
+                labels = (
+                    ds.encoded_categorical(self.label)[keep] == 2
+                ).astype(np.int64)
+            else:
+                labels = np.asarray(ds.data[self.label], np.float64)[keep]
+            return evaluate_predictions(
+                self.task,
+                labels,
+                np.asarray(preds)[keep],
+                weights=None if w is None else w[keep],
+                treatments=treatments,
+            )
+        labels = ds.encoded_label(self.label, self.task)
         groups = None
         ndcg_truncation = 5
         if self.task == Task.RANKING:

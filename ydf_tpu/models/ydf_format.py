@@ -226,6 +226,9 @@ def _leaf_cover(msg: pw.Message) -> float:
     ad = pw.get_msg(msg, 6)
     if ad is not None:
         return float(pw.get_sint(ad, 1, 0))
+    up = pw.get_msg(msg, 5)  # uplift leaf: sum_weights = 1
+    if up is not None:
+        return pw.get_double(up, 1, 1.0)
     return 1.0
 
 
@@ -512,6 +515,14 @@ def _make_leaf_classifier(num_classes: int):
     return leaf
 
 
+def _leaf_uplift(leaf_msg: pw.Message, depth: int) -> np.ndarray:
+    up = pw.get_msg(leaf_msg, 5)  # Node.uplift = 5 (NodeUpliftOutput, :49)
+    if up is None:
+        return np.zeros((1,), np.float32)
+    eff = pw.get_packed_floats(up, 4)  # treatment_effect = 4
+    return np.array([eff[0] if len(eff) else 0.0], np.float32)
+
+
 def _make_leaf_anomaly():
     from ydf_tpu.models.if_model import average_path_length
 
@@ -595,6 +606,11 @@ def load_ydf_model(path: str):
     label_col_idx = pw.get_sint(header, 3, -1)
     input_features = pw.get_packed_varints(header, 5)
 
+    uplift_col_idx = pw.get_sint(header, 9, -1)  # uplift_treatment_col_idx
+    uplift_treatment = None
+    if 0 <= uplift_col_idx < len(spec.columns):
+        uplift_treatment = spec.columns[uplift_col_idx].name
+
     label = None
     classes = None
     if 0 <= label_col_idx < len(spec.columns):
@@ -647,6 +663,8 @@ def load_ydf_model(path: str):
         if task == Task.CLASSIFICATION:
             ncls = len(classes) if classes else 2
             leaf_fn, leaf_dim = _make_leaf_classifier(ncls), ncls
+        elif task in (Task.CATEGORICAL_UPLIFT, Task.NUMERICAL_UPLIFT):
+            leaf_fn, leaf_dim = _leaf_uplift, 1
         else:
             leaf_fn, leaf_dim = _leaf_regressor_top_value, 1
         forest, max_depth = trees_to_forest(trees, fmap, leaf_fn, leaf_dim)
@@ -654,7 +672,15 @@ def load_ydf_model(path: str):
             task=task, label=label, classes=classes, dataspec=spec,
             binner=binner, forest=forest, max_depth=max_depth,
             winner_take_all=winner_take_all, native_missing=True,
-            extra_metadata={"imported_from": "ydf", "name": name},
+            extra_metadata={
+                "imported_from": "ydf",
+                "name": name,
+                **(
+                    {"uplift_treatment": uplift_treatment}
+                    if uplift_treatment
+                    else {}
+                ),
+            },
         )
 
     if os.path.isfile(if_path):

@@ -36,6 +36,7 @@ from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ydf_tpu.ops.histogram import histogram
 
@@ -80,6 +81,7 @@ def unpack_mask_bit(packed: jax.Array, bit: jax.Array) -> jax.Array:
         "rule", "max_depth", "frontier", "max_nodes", "num_bins",
         "num_numerical", "min_examples", "min_split_gain",
         "candidate_features", "num_valid_features", "hist_impl",
+        "monotone",
     ),
 )
 def grow_tree(
@@ -99,6 +101,12 @@ def grow_tree(
     num_valid_features: Optional[int] = None,  # real (unpadded) columns
     hist_impl: str = "auto",
     rule_ctx: Any = None,
+    # Per-feature monotone directions (+1 / -1 / 0), static tuple of
+    # length F or None. A cut on a +1 feature is only valid when the
+    # right (greater-value) child's leaf estimate is >= the left's
+    # (reference: monotonic constraints, training.h:160-168; bound
+    # clamping happens post-training on the finished trees).
+    monotone: Optional[tuple] = None,
 ) -> GrowResult:
     n, F = bins.shape
     S = stats.shape[1]
@@ -178,6 +186,10 @@ def grow_tree(
             & (right_all[..., -1] >= min_examples)
             & active[:, None, None]
         )
+        if hasattr(rule, "split_valid"):
+            # Rule-specific validity (e.g. uplift's per-treatment-arm
+            # minimum example counts).
+            valid &= rule.split_valid(left_all, right_all)
         if candidate_features > 0 and candidate_features < F:
             # Exact per-node sampling of `candidate_features` features
             # without replacement (reference: per-node attribute sampling,
@@ -192,6 +204,14 @@ def grow_tree(
                 )
             kth = jax.lax.top_k(scores, candidate_features)[0][:, -1]
             valid &= (scores >= kth[:, None])[:, :, None]
+        if monotone is not None and any(monotone):
+            dirs = jnp.asarray(np.array(monotone, np.float32))  # [F]
+            leaf_l = rule.leaf_value(left_all, rule_ctx)[..., 0]
+            leaf_r = rule.leaf_value(right_all, rule_ctx)[..., 0]
+            mono_ok = (dirs[None, :, None] == 0) | (
+                dirs[None, :, None] * (leaf_r - leaf_l) >= 0
+            )
+            valid &= mono_ok
         gain = jnp.where(valid, gain, -jnp.inf)
 
         # ---- best cut per frontier slot --------------------------------- #
