@@ -1,0 +1,94 @@
+"""Export to the reference's model-directory format: exact prediction
+roundtrips through our own reader (write → read → predict), plus a
+re-export of a reference golden model."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+
+D = "/root/reference/yggdrasil_decision_forests/test_data/dataset"
+MD = "/root/reference/yggdrasil_decision_forests/test_data/model"
+
+
+def _roundtrip(model, data, tmp_path, atol=0.0):
+    model.save_ydf(str(tmp_path / "m"))
+    m2 = ydf.load_ydf_model(str(tmp_path / "m"))
+    np.testing.assert_allclose(model.predict(data), m2.predict(data),
+                               atol=atol)
+    return m2
+
+
+def test_export_gbt_classification(adult_train, adult_test, tmp_path):
+    m = ydf.GradientBoostedTreesLearner(
+        label="income", num_trees=10, max_depth=4
+    ).train(adult_train.head(3000))
+    m2 = _roundtrip(m, adult_test.head(1500), tmp_path)
+    assert m2.classes == m.classes
+
+
+def test_export_rf(adult_train, adult_test, tmp_path):
+    m = ydf.RandomForestLearner(
+        label="income", num_trees=8, max_depth=6
+    ).train(adult_train.head(3000))
+    _roundtrip(m, adult_test.head(1500), tmp_path)
+
+
+def test_export_regression(abalone, tmp_path):
+    m = ydf.GradientBoostedTreesLearner(
+        label="Rings", task=Task.REGRESSION, num_trees=10,
+        validation_ratio=0.0, early_stopping="NONE",
+    ).train(abalone)
+    _roundtrip(m, abalone.head(1000), tmp_path)
+
+
+def test_export_oblique(adult_train, adult_test, tmp_path):
+    m = ydf.GradientBoostedTreesLearner(
+        label="income", num_trees=6, split_axis="SPARSE_OBLIQUE",
+        validation_ratio=0.0, early_stopping="NONE",
+    ).train(adult_train.head(2000))
+    _roundtrip(m, adult_test.head(1000), tmp_path)
+
+
+def test_reexport_golden_model(adult_test, tmp_path):
+    g = ydf.load_ydf_model(f"{MD}/adult_binary_class_gbdt")
+    _roundtrip(g, adult_test, tmp_path)
+
+
+def test_export_isolation_forest(abalone, tmp_path):
+    feats = [c for c in abalone.columns if c != "Rings"]
+    m = ydf.IsolationForestLearner(num_trees=10).train(abalone[feats])
+    m.save_ydf(str(tmp_path / "m"))
+    m2 = ydf.load_ydf_model(str(tmp_path / "m"))
+    p1, p2 = m.predict(abalone[feats]), m2.predict(abalone[feats])
+    np.testing.assert_allclose(p1, p2, atol=1e-5)
+
+
+def test_export_multiclass_gbt(iris_df, tmp_path):
+    m = ydf.GradientBoostedTreesLearner(
+        label="class", num_trees=5, max_depth=3,
+        validation_ratio=0.0, early_stopping="NONE",
+    ).train(iris_df)
+    m2 = _roundtrip(m, iris_df, tmp_path)
+    assert m2.num_trees_per_iter == 3
+
+
+def test_export_uplift(tmp_path):
+    tr = pd.read_csv(f"{D}/sim_pte_train.csv")
+    m = ydf.RandomForestLearner(
+        label="y", task=Task.CATEGORICAL_UPLIFT, uplift_treatment="treat",
+        num_trees=8, max_depth=4,
+    ).train(tr)
+    m2 = _roundtrip(m, tr, tmp_path)
+    assert m2.extra_metadata["uplift_treatment"] == "treat"
+
+
+def test_export_ranking(tmp_path):
+    tr = pd.read_csv(f"{D}/synthetic_ranking_train.csv")
+    m = ydf.GradientBoostedTreesLearner(
+        label="LABEL", task=Task.RANKING, ranking_group="GROUP",
+        num_trees=6,
+    ).train(tr)
+    _roundtrip(m, tr, tmp_path)

@@ -253,6 +253,13 @@ class GenericModel:
     # Persistence (see models/io.py)
     # ------------------------------------------------------------------ #
 
+    def save_ydf(self, path: str) -> None:
+        """Exports in the reference implementation's model-directory
+        format (readable by the reference's LoadModel / pip ydf)."""
+        from ydf_tpu.models.ydf_format import export_ydf_model
+
+        export_ydf_model(self, path)
+
     def save(self, path: str) -> None:
         from ydf_tpu.models import io
 

@@ -610,6 +610,10 @@ def load_ydf_model(path: str):
     uplift_treatment = None
     if 0 <= uplift_col_idx < len(spec.columns):
         uplift_treatment = spec.columns[uplift_col_idx].name
+    ranking_idx = pw.get_sint(header, 6, -1)  # ranking_group_col_idx
+    ranking_group = None
+    if 0 <= ranking_idx < len(spec.columns):
+        ranking_group = spec.columns[ranking_idx].name
 
     label = None
     classes = None
@@ -647,7 +651,13 @@ def load_ydf_model(path: str):
             initial_predictions=np.asarray(init_preds, np.float32),
             num_trees_per_iter=K, max_depth=max_depth, loss_name=loss_name,
             native_missing=True,
-            extra_metadata={"imported_from": "ydf", "name": name},
+            extra_metadata={
+                "imported_from": "ydf",
+                "name": name,
+                **(
+                    {"ranking_group": ranking_group} if ranking_group else {}
+                ),
+            },
         )
 
     if os.path.isfile(rf_path):
@@ -706,3 +716,325 @@ def load_ydf_model(path: str):
     raise NotImplementedError(
         f"{path}: no supported model header found (GBT/RF/IF)"
     )
+
+
+# --------------------------------------------------------------------- #
+# Export: write a reference-readable model directory
+# --------------------------------------------------------------------- #
+
+
+def write_blob_sequence(path: str, records) -> None:
+    """Writes a version-0 uncompressed blob sequence
+    (utils/blob_sequence.h:125-149)."""
+    with open(path, "wb") as f:
+        f.write(b"BS" + struct.pack("<H", 0) + b"\x00\x00\x00\x00")
+        for r in records:
+            f.write(struct.pack("<I", len(r)))
+            f.write(r)
+
+
+def _encode_column(col: Column) -> bytes:
+    """Column (data_spec.proto:88-126)."""
+    type_code = {v: k for k, v in _COLTYPE.items()}[col.type]
+    out = pw.put_int(1, type_code) + pw.put_str(2, col.name)
+    if col.type in (ColumnType.NUMERICAL, ColumnType.BOOLEAN):
+        num = (
+            pw.put_double(1, col.mean)
+            + pw.put_float(2, col.min_value)
+            + pw.put_float(3, col.max_value)
+        )
+        out += pw.put_msg(5, num)
+    if col.type == ColumnType.CATEGORICAL and col.vocabulary is not None:
+        items = b""
+        counts = col.vocab_counts or [0] * col.vocab_size
+        for idx, (key, cnt) in enumerate(zip(col.vocabulary, counts)):
+            vv = pw.put_int(1, idx) + pw.put_int(2, int(cnt))
+            entry = pw.put_bytes(1, key.encode("utf-8")) + pw.put_msg(2, vv)
+            items += pw.put_msg(7, entry)
+        cat = pw.put_int(2, col.vocab_size) + items
+        out += pw.put_msg(6, cat)
+    if col.num_missing:
+        out += pw.put_int(7, int(col.num_missing))
+    return out
+
+
+def _encode_dataspec(spec: DataSpecification) -> bytes:
+    out = b"".join(pw.put_msg(1, _encode_column(c)) for c in spec.columns)
+    if spec.created_num_rows:
+        out += pw.put_int(2, int(spec.created_num_rows))
+    return out
+
+
+def _encode_node(row: dict, leaf_payload: bytes,
+                 forest_np: dict, t: int, nid: int) -> bytes:
+    """Node (decision_tree.proto:202) from flattened Forest arrays."""
+    if row["is_leaf"]:
+        return leaf_payload
+    feat = int(row["feature"])
+    F_total = row["F_total"]
+    if feat >= F_total:
+        # Oblique projection -> Condition.Oblique (:114-131).
+        p = feat - F_total
+        w_vec = forest_np["oblique_weights"][t, p]
+        attrs = np.flatnonzero(w_vec != 0)
+        inner = (
+            pw.put_packed_varints(1, row["obl_cols"][attrs].tolist())
+            + pw.put_packed_floats(2, w_vec[attrs])
+            + pw.put_float(3, float(row["threshold"]))
+        )
+        # na_replacements (field 4, positional with attributes): without
+        # them the reference routes ANY partially-missing row by na_value,
+        # while this model imputes per attribute.
+        repl = row.get("obl_repl")
+        if repl is not None:
+            vals = repl[attrs]
+            if np.isfinite(vals).all():
+                inner += pw.put_packed_floats(4, vals)
+        cond_type = pw.put_msg(7, inner)
+        attribute = int(row["obl_cols"][attrs[0]]) if len(attrs) else 0
+    elif row["is_cat"]:
+        # go-LEFT mask -> positive-branch bitmap (complement), sized to
+        # the vocabulary (ContainsBitmap, :104-108).
+        vocab_size = row["vocab_size"]
+        mask_words = forest_np["cat_mask"][t, nid]
+        bits = np.unpackbits(
+            mask_words.view(np.uint8), bitorder="little"
+        )[:vocab_size]
+        pos_bits = 1 - bits  # our mask is "goes left" = negative branch
+        bitmap = np.packbits(pos_bits, bitorder="little").tobytes()
+        cond_type = pw.put_msg(5, pw.put_bytes(1, bitmap))
+        attribute = row["col_idx"]
+    else:
+        cond_type = pw.put_msg(2, pw.put_float(1, float(row["threshold"])))
+        attribute = row["col_idx"]
+    cond = (
+        pw.put_bool(1, not bool(row["na_left"]))  # na_value
+        + pw.put_int(2, attribute)
+        + pw.put_msg(3, cond_type)
+        + pw.put_double(5, float(row["cover"]))
+    )
+    return pw.put_msg(3, cond)
+
+
+def export_ydf_model(model, path: str) -> None:
+    """Writes `model` as a reference-format model directory (the inverse
+    of load_ydf_model): header.pb + data_spec.pb + <type>_header.pb +
+    blob-sequence node shards + done marker. Covers GBT, RF and IF
+    models with numerical/categorical/boolean/oblique conditions."""
+    from ydf_tpu.models.gbt_model import GradientBoostedTreesModel
+    from ydf_tpu.models.if_model import IsolationForestModel
+    from ydf_tpu.models.rf_model import RandomForestModel
+
+    os.makedirs(path, exist_ok=True)
+    binner = model.binner
+    for name in binner.feature_names[binner.num_numerical:]:
+        vs = model.dataspec.column_by_name(name).vocab_size
+        if vs > binner.num_bins:
+            raise NotImplementedError(
+                f"export of categorical column {name!r} with vocabulary "
+                f"{vs} > trained mask width {binner.num_bins}"
+            )
+    spec_cols = []
+    # Dataspec: input features in our serving order + label (+ group /
+    # treatment columns).
+    col_index: Dict[str, int] = {}
+    for name in binner.feature_names:
+        col = model.dataspec.column_by_name(name)
+        spec_cols.append(col)
+        col_index[name] = len(spec_cols) - 1
+    label_idx = -1
+    if model.label is not None:
+        spec_cols.append(model.dataspec.column_by_name(model.label))
+        label_idx = len(spec_cols) - 1
+    ranking_idx = -1
+    if model.task == Task.RANKING:
+        gcol = model.extra_metadata.get("ranking_group")
+        if not gcol:
+            raise NotImplementedError(
+                "export of a ranking model without ranking_group metadata"
+            )
+        spec_cols.append(model.dataspec.column_by_name(gcol))
+        ranking_idx = len(spec_cols) - 1
+    uplift_idx = -1
+    if model.task in (Task.CATEGORICAL_UPLIFT, Task.NUMERICAL_UPLIFT):
+        tcol = model.extra_metadata.get("uplift_treatment")
+        if not tcol:
+            raise NotImplementedError(
+                "export of an uplift model without uplift_treatment metadata"
+            )
+        spec_cols.append(model.dataspec.column_by_name(tcol))
+        uplift_idx = len(spec_cols) - 1
+    out_spec = DataSpecification(
+        columns=spec_cols, created_num_rows=model.dataspec.created_num_rows
+    )
+    with open(os.path.join(path, "data_spec.pb"), "wb") as f:
+        f.write(_encode_dataspec(out_spec))
+
+    task_code = {v: k for k, v in _TASK.items()}[model.task]
+    # The reference resolves the model class from this name
+    # (model_library.cc CreateEmptyModel) — it must be the registered
+    # model key, which our model_type strings mirror.
+    header = (
+        pw.put_str(1, model.model_type)
+        + pw.put_int(2, task_code)
+        + pw.put_int(3, label_idx)
+        + pw.put_packed_varints(
+            5, [col_index[n] for n in binner.feature_names]
+        )
+    )
+    if ranking_idx >= 0:
+        header += pw.put_int(6, ranking_idx)
+    if uplift_idx >= 0:
+        header += pw.put_int(9, uplift_idx)
+    with open(os.path.join(path, "header.pb"), "wb") as f:
+        f.write(header)
+
+    # --- nodes ---------------------------------------------------------
+    f_np = model.forest.to_numpy()
+    T = f_np["feature"].shape[0]
+    Fn = binner.num_numerical
+    F_total = binner.num_features
+    obl_cols = np.array(
+        [col_index[n] for n in binner.feature_names[:Fn]], np.int64
+    ) if Fn else np.zeros((0,), np.int64)
+
+    is_classification = model.task == Task.CLASSIFICATION
+    is_uplift = model.task in (Task.CATEGORICAL_UPLIFT, Task.NUMERICAL_UPLIFT)
+
+    def leaf_payload(t: int, nid: int) -> bytes:
+        v = f_np["leaf_value"][t, nid]
+        cover = float(max(f_np["cover"][t, nid], 0.0))
+        if is_uplift:
+            # NodeUpliftOutput (decision_tree.proto:49): treatment_effect
+            # carries the leaf's estimated uplift.
+            up = pw.put_double(1, cover) + pw.put_packed_floats(
+                4, [float(v[0])]
+            )
+            return pw.put_msg(5, up)
+        if isinstance(model, RandomForestModel) and is_classification:
+            counts = np.concatenate([[0.0], v * cover])  # index 0 = OOV
+            dist = pw.put_packed_doubles(1, counts) + pw.put_double(
+                2, float(counts.sum())
+            )
+            top = int(np.argmax(v)) + 1
+            cls = pw.put_int(1, top) + pw.put_msg(2, dist)
+            return pw.put_msg(1, cls)
+        if isinstance(model, IsolationForestModel):
+            ad = pw.put_int(1, int(round(cover)))
+            return pw.put_msg(6, ad)
+        reg = pw.put_float(1, float(v[0])) + pw.put_double(5, cover)
+        return pw.put_msg(2, reg)
+
+    records = []
+    import sys
+
+    old_limit = sys.getrecursionlimit()
+    sys.setrecursionlimit(max(old_limit, 100000))
+    for t in range(T):
+
+        def emit(nid: int):
+            row = {
+                "is_leaf": bool(f_np["is_leaf"][t, nid]),
+                "feature": int(f_np["feature"][t, nid]),
+                "threshold": float(f_np["threshold"][t, nid]),
+                "is_cat": bool(f_np["is_cat"][t, nid]),
+                "na_left": bool(f_np["na_left"][t, nid]),
+                "cover": float(f_np["cover"][t, nid]),
+                "F_total": F_total,
+                "obl_cols": obl_cols,
+            }
+            feat = row["feature"]
+            if not row["is_leaf"] and not model.native_missing:
+                # Our learners impute missing values at encode time; the
+                # reference routes them per-node by na_value. Bake the
+                # equivalent direction in: where the imputed value (or the
+                # OOV category) would have gone.
+                if feat >= F_total:  # oblique: dot of imputed numericals
+                    w_vec = f_np["oblique_weights"][t, feat - F_total]
+                    v = float(
+                        np.dot(binner.impute_values[:Fn], w_vec)
+                    )
+                    row["na_left"] = v < row["threshold"]
+                elif row["is_cat"]:
+                    row["na_left"] = bool(
+                        f_np["cat_mask"][t, nid, 0] & np.uint32(1)
+                    )
+                else:
+                    row["na_left"] = (
+                        float(binner.impute_values[feat]) < row["threshold"]
+                    )
+            if 0 <= feat < F_total:
+                name = binner.feature_names[feat]
+                row["col_idx"] = col_index[name]
+                col = model.dataspec.column_by_name(name)
+                row["vocab_size"] = col.vocab_size
+            if row["feature"] >= F_total and "oblique_na_repl" in f_np:
+                row["obl_repl"] = f_np["oblique_na_repl"][
+                    t, row["feature"] - F_total
+                ]
+                if not model.native_missing:
+                    # Native-missing-off models impute: replacements are
+                    # the column means.
+                    row["obl_repl"] = binner.impute_values[:Fn].astype(
+                        np.float32
+                    )
+            records.append(
+                _encode_node(row, leaf_payload(t, nid), f_np, t, nid)
+            )
+            if not row["is_leaf"]:
+                emit(int(f_np["left"][t, nid]))
+                emit(int(f_np["right"][t, nid]))
+
+        try:
+            emit(0)
+        except RecursionError:
+            sys.setrecursionlimit(old_limit)
+            raise
+    sys.setrecursionlimit(old_limit)
+
+    write_blob_sequence(
+        os.path.join(path, "nodes-00000-of-00001"), records
+    )
+
+    # --- model-type header --------------------------------------------
+    if isinstance(model, GradientBoostedTreesModel):
+        loss_code = {v: k for k, v in _GBT_LOSS.items()}.get(
+            model.loss_name, 0
+        )
+        gh = (
+            pw.put_int(1, 1)  # num_node_shards
+            + pw.put_int(2, T)
+            + pw.put_int(3, loss_code)
+            + pw.put_packed_floats(4, model.initial_predictions)
+            + pw.put_int(5, int(model.num_trees_per_iter))
+            + pw.put_str(7, "BLOB_SEQUENCE")
+        )
+        with open(
+            os.path.join(path, "gradient_boosted_trees_header.pb"), "wb"
+        ) as f:
+            f.write(gh)
+    elif isinstance(model, IsolationForestModel):
+        ih = (
+            pw.put_int(1, 1)
+            + pw.put_int(2, T)
+            + pw.put_str(3, "BLOB_SEQUENCE")
+            + pw.put_int(4, int(model.num_examples_per_tree))
+        )
+        with open(
+            os.path.join(path, "isolation_forest_header.pb"), "wb"
+        ) as f:
+            f.write(ih)
+    elif isinstance(model, RandomForestModel):
+        rh = (
+            pw.put_int(1, 1)
+            + pw.put_int(2, T)
+            + pw.put_bool(3, model.winner_take_all)
+            + pw.put_str(7, "BLOB_SEQUENCE")
+        )
+        with open(os.path.join(path, "random_forest_header.pb"), "wb") as f:
+            f.write(rh)
+    else:
+        raise NotImplementedError(type(model).__name__)
+
+    with open(os.path.join(path, "done"), "wb") as f:
+        f.write(b"")

@@ -1,4 +1,4 @@
-"""Minimal protobuf wire-format decoder (schema-less).
+"""Minimal protobuf wire-format encoder/decoder (schema-less).
 
 Clean-room implementation of the protobuf wire encoding (varint /
 fixed32 / fixed64 / length-delimited), used to read the reference's
@@ -173,3 +173,74 @@ def get_packed_varints(msg: Message, field: int) -> List[int]:
         else:
             out.append(int(v))
     return out
+
+
+# --------------------------------------------------------------------- #
+# Encoder (schema-less writers, field numbers supplied by the caller)
+# --------------------------------------------------------------------- #
+
+
+def encode_varint(value: int) -> bytes:
+    out = bytearray()
+    v = value & ((1 << 64) - 1)  # two's-complement for negative ints
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return encode_varint((field << 3) | wire)
+
+
+def put_int(field: int, value: int) -> bytes:
+    return _tag(field, _WIRE_VARINT) + encode_varint(int(value))
+
+
+def put_bool(field: int, value: bool) -> bytes:
+    return put_int(field, 1 if value else 0)
+
+
+def put_float(field: int, value: float) -> bytes:
+    return _tag(field, _WIRE_FIXED32) + np.float32(value).tobytes()
+
+
+def put_double(field: int, value: float) -> bytes:
+    return _tag(field, _WIRE_FIXED64) + np.float64(value).tobytes()
+
+
+def put_bytes(field: int, value: bytes) -> bytes:
+    return _tag(field, _WIRE_BYTES) + encode_varint(len(value)) + value
+
+
+def put_str(field: int, value: str) -> bytes:
+    return put_bytes(field, value.encode("utf-8"))
+
+
+def put_msg(field: int, body: bytes) -> bytes:
+    return put_bytes(field, body)
+
+
+def put_packed_floats(field: int, values) -> bytes:
+    arr = np.asarray(values, dtype="<f4")
+    if arr.size == 0:
+        return b""
+    return put_bytes(field, arr.tobytes())
+
+
+def put_packed_doubles(field: int, values) -> bytes:
+    arr = np.asarray(values, dtype="<f8")
+    if arr.size == 0:
+        return b""
+    return put_bytes(field, arr.tobytes())
+
+
+def put_packed_varints(field: int, values) -> bytes:
+    if len(values) == 0:
+        return b""
+    body = b"".join(encode_varint(int(v)) for v in values)
+    return put_bytes(field, body)
