@@ -1,0 +1,63 @@
+"""Multitasker learner: one sub-model per label over shared features
+(reference: learner/multitasker/multitasker.cc)."""
+
+import numpy as np
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+
+
+def _data(n=1500, seed=8):
+    rng = np.random.RandomState(seed)
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    return {
+        "x1": x1, "x2": x2,
+        "cls": (x1 + x2 > 0).astype(np.int64),
+        "reg": (2 * x1 - x2 + rng.normal(scale=0.3, size=n)).astype(
+            np.float32
+        ),
+    }
+
+
+def test_multitasker_train_eval_save_load(tmp_path):
+    data = _data()
+    learner = ydf.MultitaskerLearner(
+        tasks=[
+            {"label": "cls", "task": Task.CLASSIFICATION},
+            {"label": "reg", "task": Task.REGRESSION},
+        ],
+        num_trees=10, max_depth=4, validation_ratio=0.0,
+        early_stopping="NONE",
+    )
+    model = learner.train(data)
+    preds = model.predict(data)
+    assert set(preds) == {"cls", "reg"}
+    evs = model.evaluate(data)
+    assert evs["cls"].accuracy > 0.9
+    assert evs["reg"].rmse < 1.0
+    # labels of other tasks are not used as features
+    for m in model.models.values():
+        assert "cls" not in m.input_feature_names()
+        assert "reg" not in m.input_feature_names()
+    model.save(str(tmp_path / "mt"))
+    m2 = ydf.MultitaskerModel.load(str(tmp_path / "mt"))
+    np.testing.assert_array_equal(preds["cls"], m2.predict(data)["cls"])
+
+
+def test_rf_data_parallel_mesh():
+    import jax
+
+    from ydf_tpu.parallel import make_mesh
+
+    # n deliberately NOT divisible by the 8-device mesh: exercises the
+    # zero-weight row padding branch.
+    data = _data(1001)
+    mesh = make_mesh(jax.devices())
+    m1 = ydf.RandomForestLearner(
+        label="cls", num_trees=8, max_depth=4, random_seed=3
+    ).train(data)
+    m2 = ydf.RandomForestLearner(
+        label="cls", num_trees=8, max_depth=4, random_seed=3, mesh=mesh
+    ).train(data)
+    # Same computation, different layout (padding rows carry zero weight).
+    np.testing.assert_allclose(m1.predict(data), m2.predict(data), atol=1e-4)

@@ -28,6 +28,7 @@ from ydf_tpu.learners.gbt import GradientBoostedTreesLearner
 from ydf_tpu.learners.random_forest import RandomForestLearner
 from ydf_tpu.learners.cart import CartLearner
 from ydf_tpu.learners.isolation_forest import IsolationForestLearner
+from ydf_tpu.learners.multitasker import MultitaskerLearner, MultitaskerModel
 from ydf_tpu.learners.tuner import RandomSearchTuner
 from ydf_tpu.metrics import cross_validation
 from ydf_tpu.models.io import load_model
@@ -48,6 +49,8 @@ __all__ = [
     "IsolationForestLearner",
     "load_model",
     "load_ydf_model",
+    "MultitaskerLearner",
+    "MultitaskerModel",
     "RandomSearchTuner",
     "cross_validation",
     "Task",

@@ -1,0 +1,109 @@
+"""Multitasker: one model per label over shared features.
+
+Counterpart of the reference's multitasker learner/model
+(`ydf/learner/multitasker/multitasker.cc`, `ydf/model/multitasker/`):
+trains a sub-model per configured task on the same dataset and bundles
+them. Sub-models share the dataset ingestion; each sees every other
+task's label excluded from its features.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ydf_tpu.config import Task
+
+
+class MultitaskerModel:
+    model_type = "MULTITASKER"
+
+    def __init__(self, models: Dict[str, object]):
+        self.models = models  # label -> sub-model
+
+    def predict(self, data) -> Dict[str, np.ndarray]:
+        return {label: m.predict(data) for label, m in self.models.items()}
+
+    def evaluate(self, data) -> Dict[str, object]:
+        return {label: m.evaluate(data) for label, m in self.models.items()}
+
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "multitasker.txt"), "w") as f:
+            f.write("\n".join(self.models.keys()))
+        for label, m in self.models.items():
+            m.save(os.path.join(path, f"task_{label}"))
+
+    @staticmethod
+    def load(path: str) -> "MultitaskerModel":
+        from ydf_tpu.models.io import load_model
+
+        with open(os.path.join(path, "multitasker.txt")) as f:
+            labels = [l for l in f.read().splitlines() if l]
+        return MultitaskerModel(
+            {l: load_model(os.path.join(path, f"task_{l}")) for l in labels}
+        )
+
+
+class MultitaskerLearner:
+    """tasks: list of {"label": str, "task": Task, ...learner kwargs}.
+    Shared kwargs apply to every sub-learner."""
+
+    def __init__(
+        self,
+        tasks: List[dict],
+        base_learner: str = "GRADIENT_BOOSTED_TREES",
+        features: Optional[List[str]] = None,
+        **shared_kwargs,
+    ):
+        if not tasks:
+            raise ValueError("tasks must be non-empty")
+        self.tasks = [dict(t) for t in tasks]
+        self.base_learner = base_learner
+        self.features = features
+        self.shared_kwargs = shared_kwargs
+
+    def train(self, data) -> MultitaskerModel:
+        import ydf_tpu as ydf
+
+        cls = {
+            "GRADIENT_BOOSTED_TREES": ydf.GradientBoostedTreesLearner,
+            "RANDOM_FOREST": ydf.RandomForestLearner,
+            "CART": ydf.CartLearner,
+        }[self.base_learner]
+        from ydf_tpu.dataset.dataset import Dataset
+
+        ds = Dataset.from_data(
+            data,
+            max_vocab_count=self.shared_kwargs.get("max_vocab_count", 2000),
+            min_vocab_frequency=self.shared_kwargs.get(
+                "min_vocab_frequency", 5
+            ),
+        )
+        # Columns that must never be features of ANY sub-model: every
+        # task's label plus the special columns of every task/shared
+        # config (same exclusion set as GenericLearner._prepare).
+        excluded = {t["label"] for t in self.tasks}
+        for src in [self.shared_kwargs] + self.tasks:
+            for key in ("weights", "ranking_group", "uplift_treatment"):
+                if src.get(key):
+                    excluded.add(src[key])
+        models = {}
+        for spec in self.tasks:
+            spec = dict(spec)
+            label = spec.pop("label")
+            task = spec.pop("task", Task.CLASSIFICATION)
+            feats = self.features
+            if feats is None:
+                feats = [
+                    c for c in ds.dataspec.column_names()
+                    if c not in excluded
+                ]
+            learner = cls(
+                label=label, task=task, features=feats,
+                **{**self.shared_kwargs, **spec},
+            )
+            models[label] = learner.train(ds)
+        return MultitaskerModel(models)

@@ -50,6 +50,7 @@ class RandomForestLearner(GenericLearner):
         winner_take_all: bool = True,
         max_frontier: int = 1024,
         uplift_treatment: Optional[str] = None,
+        mesh=None,
         features: Optional[Sequence[str]] = None,
         weights: Optional[str] = None,
         random_seed: int = 123456,
@@ -69,6 +70,10 @@ class RandomForestLearner(GenericLearner):
         self.winner_take_all = winner_take_all
         self.max_frontier = max_frontier
         self.uplift_treatment = uplift_treatment
+        # jax.sharding.Mesh: data-parallel training — the per-layer
+        # histogram contraction all-reduces over the data axis via GSPMD
+        # (see ydf_tpu/parallel/mesh.py).
+        self.mesh = mesh
 
     # ------------------------------------------------------------------ #
 
@@ -92,6 +97,31 @@ class RandomForestLearner(GenericLearner):
         bins = jnp.asarray(prep["bins"])
         w_base = jnp.asarray(prep["sample_weights"])
         n, F = bins.shape
+
+        if self.mesh is not None:
+            from ydf_tpu.parallel import mesh as pmesh
+
+            if self.task in (Task.CATEGORICAL_UPLIFT, Task.NUMERICAL_UPLIFT):
+                raise NotImplementedError("mesh-distributed uplift training")
+            dp = self.mesh.shape[pmesh.DATA_AXIS]
+            if self.mesh.shape.get(pmesh.FEATURE_AXIS, 1) > 1:
+                raise NotImplementedError(
+                    "RandomForest supports data-parallel meshes only"
+                )
+            # Same pattern as the GBT mesh path (gbt.py): pad rows (zero
+            # weight → no effect on statistics), then shard everything.
+            (bins_np, w_np, labels_np), _ = pmesh.pad_rows_to_multiple(
+                [
+                    np.asarray(bins),
+                    np.asarray(w_base),
+                    np.asarray(prep["labels"]),
+                ],
+                dp,
+            )
+            bins = pmesh.shard_batch(self.mesh, bins_np)
+            w_base = pmesh.shard_batch(self.mesh, w_np)
+            prep["labels"] = pmesh.shard_batch(self.mesh, labels_np)
+            n = bins.shape[0]
 
         if self.task in (Task.CATEGORICAL_UPLIFT, Task.NUMERICAL_UPLIFT):
             # Treatment-effect trees (reference uplift.h; RF uplift as in

@@ -65,6 +65,10 @@ def save_model(model: GenericModel, path: str) -> None:
 def load_model(path: str) -> GenericModel:
     _ensure_registry()
     if not os.path.isfile(os.path.join(path, "model.json")):
+        if os.path.isfile(os.path.join(path, "multitasker.txt")):
+            from ydf_tpu.learners.multitasker import MultitaskerModel
+
+            return MultitaskerModel.load(path)
         from ydf_tpu.models import ydf_format
 
         if ydf_format.is_ydf_model_dir(path):
