@@ -90,3 +90,24 @@ def test_resume_refuses_mismatched_config(tmp_path):
             working_dir=str(tmp_path), resume_training=True,
             resume_training_snapshot_interval_trees=5,
         ).train(data)
+
+
+def test_chunked_early_stopping_saves_compute():
+    """With a working_dir, training stops between chunks once the
+    validation loss stalls (reference early_stopping.h look-ahead),
+    instead of training all requested trees."""
+    import tempfile
+
+    rng = np.random.RandomState(3)
+    n = 800
+    x = rng.normal(size=n)
+    y = (x + rng.normal(scale=2.0, size=n) > 0).astype(np.int64)  # noisy
+    data = {"x": x, "y": y}
+    with tempfile.TemporaryDirectory() as d:
+        m = ydf.GradientBoostedTreesLearner(
+            label="y", num_trees=200, max_depth=3,
+            early_stopping="LOSS_INCREASE",
+            early_stopping_num_trees_look_ahead=10,
+            working_dir=d, resume_training_snapshot_interval_trees=10,
+        ).train(data)
+    assert m.num_trees() < 200  # stopped early

@@ -1,0 +1,115 @@
+"""to_jax_function: jittable, differentiable forest inference with
+trainable leaf values (reference: pydf export_jax.py + the
+update_with_jax_params fine-tuning path, jax_model_test.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+
+
+@pytest.fixture(scope="module")
+def model_and_data(adult_train):
+    tr = adult_train.head(3000)
+    m = ydf.GradientBoostedTreesLearner(
+        label="income", num_trees=10, max_depth=4,
+        validation_ratio=0.0, early_stopping="NONE",
+    ).train(tr)
+    return m, tr
+
+
+def test_fn_matches_predict(model_and_data, adult_test):
+    m, _ = model_and_data
+    fn, params, encoder = m.to_jax_function()
+    x_num, x_cat = encoder(adult_test.head(500))
+    out = jax.jit(fn)(x_num, x_cat, params)
+    np.testing.assert_allclose(
+        np.asarray(out), m.predict(adult_test.head(500)), atol=1e-6
+    )
+
+
+def test_finetune_leaves_reduces_loss(model_and_data):
+    m, tr = model_and_data
+    fn, params, encoder = m.to_jax_function(apply_link_function=False)
+    x_num, x_cat = encoder(tr)
+    from ydf_tpu.dataset.dataset import Dataset
+
+    ds = Dataset.from_data(tr, dataspec=m.dataspec)
+    y = jnp.asarray(ds.encoded_label("income", Task.CLASSIFICATION))
+
+    def loss_fn(p):
+        logits = fn(x_num, x_cat, p)[:, 0]
+        return jnp.mean(
+            jnp.maximum(logits, 0) - logits * y + jnp.log1p(
+                jnp.exp(-jnp.abs(logits))
+            )
+        )
+
+    opt = optax.sgd(0.05)
+    state = opt.init(params)
+    l0 = float(loss_fn(params))
+    p = params
+    step = jax.jit(lambda p, s: (lambda g: opt.update(g, s, p))(
+        jax.grad(loss_fn)(p)
+    ))
+    for _ in range(10):
+        updates, state = step(p, state)
+        p = optax.apply_updates(p, updates)
+    l1 = float(loss_fn(p))
+    assert l1 < l0, (l0, l1)
+
+    # write back and check predict() reflects the tuned leaves
+    before = m.predict(tr.head(50))
+    m.update_with_jax_params(p)
+    after = m.predict(tr.head(50))
+    assert not np.allclose(before, after)
+
+
+def test_multiclass_jax_fn(iris_df):
+    m = ydf.GradientBoostedTreesLearner(
+        label="class", num_trees=4, max_depth=3,
+        validation_ratio=0.0, early_stopping="NONE",
+    ).train(iris_df)
+    fn, params, encoder = m.to_jax_function()
+    x_num, x_cat = encoder(iris_df)
+    out = np.asarray(fn(x_num, x_cat, params))
+    np.testing.assert_allclose(out, m.predict(iris_df), atol=1e-5)
+
+
+def test_update_shape_mismatch_raises(model_and_data):
+    m, _ = model_and_data
+    with pytest.raises(ValueError, match="shape"):
+        m.update_with_jax_params({"leaf_values": np.zeros((1, 2, 3))})
+
+
+def test_rf_jax_fn_matches_predict(adult_train, adult_test):
+    for wta in (True, False):
+        m = ydf.RandomForestLearner(
+            label="income", num_trees=6, max_depth=5, winner_take_all=wta
+        ).train(adult_train.head(2000))
+        fn, params, encoder = m.to_jax_function()
+        x_num, x_cat = encoder(adult_test.head(300))
+        np.testing.assert_allclose(
+            np.asarray(fn(x_num, x_cat, params)),
+            m.predict(adult_test.head(300)),
+            atol=1e-6,
+        )
+
+
+def test_poisson_jax_fn_matches_predict():
+    rng = np.random.RandomState(0)
+    x = rng.normal(size=1500)
+    y = rng.poisson(np.exp(0.5 * x)).astype(np.float32)
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", task=Task.REGRESSION, loss="POISSON", num_trees=10,
+        validation_ratio=0.0, early_stopping="NONE",
+    ).train({"x": x, "y": y})
+    fn, params, encoder = m.to_jax_function()
+    xn, xc = encoder({"x": x})
+    np.testing.assert_allclose(
+        np.asarray(fn(xn, xc, params)), m.predict({"x": x}), atol=1e-5
+    )

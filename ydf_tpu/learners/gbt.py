@@ -413,6 +413,11 @@ class GradientBoostedTreesLearner(GenericLearner):
             resume=self.resume_training,
             snapshot_interval=self.resume_training_snapshot_interval_trees,
             abort_after_chunks=self._abort_after_chunks,
+            early_stop_lookahead=(
+                self.early_stopping_num_trees_look_ahead
+                if self.early_stopping == "LOSS_INCREASE"
+                else 0
+            ),
         )
 
         train_losses = np.asarray(logs["train_loss"])
@@ -824,7 +829,7 @@ def _train_gbt(
     oblique_weight_type="BINARY", monotone=None,
     x_tr_raw=None, x_va_raw=None,
     cache_dir=None, resume=False, snapshot_interval=50,
-    abort_after_chunks=None,
+    abort_after_chunks=None, early_stop_lookahead=0,
 ):
     """The jitted boosting loop. Returns stacked trees [T, K, ...], leaf
     values [T, K, N, 1] and per-iteration logs."""
@@ -843,6 +848,7 @@ def _train_gbt(
         sampling, goss_alpha, goss_beta, selgb_ratio, dart_dropout,
         oblique_P, oblique_density, oblique_weight_type, monotone,
     )
+    nv_rows = bins_va.shape[0]
     data_args = (bins_tr, y_tr, w_tr, bins_va, y_va, w_va) + (
         (x_tr_raw, x_va_raw) if oblique_P > 0 else ()
     )
@@ -915,6 +921,16 @@ def _train_gbt(
         carry, init_pred = run.init_state(y_tr, w_tr)
 
     chunks_done = 0
+    vls_seen = []
+    if state is not None:
+        # Re-seed the validation-loss history from the completed chunks so
+        # early stopping after a resume sees the true global minimum.
+        for st in state[2].get("chunk_starts", []):
+            try:
+                with np.load(_chunk_path(st)) as z:
+                    vls_seen.append(np.asarray(z["vls"]))
+            except Exception:
+                pass
     while start < num_trees:
         # Fixed chunk length: the tail chunk intentionally overshoots so
         # a single compiled executable serves every chunk (outputs beyond
@@ -967,6 +983,17 @@ def _train_gbt(
         )
         start = start_next
         chunks_done += 1
+        if early_stop_lookahead > 0 and nv_rows > 0:
+            # True early STOPPING (the reference's look-ahead tracker,
+            # early_stopping.h:29-66): once the validation loss has not
+            # improved for `early_stop_lookahead` trees, stop training —
+            # the final model is truncated at the loss minimum anyway.
+            # vls_seen covers iterations [0, start) including pre-resume
+            # chunks (re-seeded above), so argmin is an absolute index.
+            vls_seen.append(np.asarray(vls_c))
+            vall = np.concatenate(vls_seen)[:start]
+            if start - (int(np.argmin(vall)) + 1) >= early_stop_lookahead:
+                break
         if abort_after_chunks is not None and chunks_done >= abort_after_chunks:
             raise _TrainingAborted(
                 f"aborted after {chunks_done} chunks ({start} iterations)"

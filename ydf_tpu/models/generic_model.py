@@ -13,6 +13,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -116,6 +117,114 @@ class GenericModel:
             if abs(contrib[i]) > 1e-9:
                 lines.append(f"{names[i]:>30}: {contrib[i]:+.5f}")
         return "\n".join(lines)
+
+    # ------------------------------------------------------------------ #
+    # JAX export / fine-tuning (reference: model.to_jax_function and
+    # update_with_jax_params, pydf export_jax.py:488-1150,
+    # generic_model.py:1271 — trivially native here: the forest already
+    # lives in JAX arrays)
+    # ------------------------------------------------------------------ #
+
+    def to_jax_function(self, apply_link_function: bool = True):
+        """Returns (fn, params, encoder):
+
+        * fn(x_num, x_cat, params) — jittable, differentiable in
+          params["leaf_values"] (fine-tune leaves with optax, like the
+          reference's leaves_as_params mode);
+        * params — {"leaf_values": [T, N, V] f32};
+        * encoder(data) -> (x_num, x_cat) host-side feature encoding.
+        """
+        from ydf_tpu.ops.routing import forest_predict_values
+
+        forest = self.forest
+        num_numerical = self.binner.num_numerical
+        max_depth = self.max_depth
+        combine = "mean" if self.model_type == "RANDOM_FOREST" else "sum"
+        init = np.asarray(
+            getattr(self, "initial_predictions", np.zeros(1)), np.float32
+        )
+        task = self.task
+        K = int(getattr(self, "num_trees_per_iter", 1) or 1)
+        link = apply_link_function
+
+        is_rf = self.model_type == "RANDOM_FOREST"
+        wta = bool(getattr(self, "winner_take_all", False))
+        loss_name = getattr(self, "loss_name", "")
+        multi_gbt = K > 1 and forest.leaf_value.shape[-1] == 1
+
+        def fn(x_num, x_cat, params):
+            f = forest._replace(
+                leaf_value=jnp.asarray(params["leaf_values"])
+            )
+            if is_rf and task == Task.CLASSIFICATION and wta:
+                # Winner-take-all voting: leaves become one-hot votes
+                # (matches RandomForestModel.predict; the argmax makes
+                # this branch non-differentiable in the leaf values, as
+                # in the reference's voting engines).
+                lv = f.leaf_value
+                votes = jax.nn.one_hot(
+                    jnp.argmax(lv, axis=-1), lv.shape[-1], dtype=lv.dtype
+                )
+                f = f._replace(leaf_value=votes)
+            if multi_gbt:
+                # Multiclass GBT: tree t contributes to dim t % K.
+                outs = []
+                for k in range(K):
+                    sub = jax.tree.map(lambda a: a[k::K], f)
+                    outs.append(
+                        forest_predict_values(
+                            sub, x_num, x_cat,
+                            num_numerical=num_numerical,
+                            max_depth=max_depth, combine=combine,
+                        )[:, 0]
+                    )
+                raw = jnp.stack(outs, axis=1)
+            else:
+                raw = forest_predict_values(
+                    f, x_num, x_cat, num_numerical=num_numerical,
+                    max_depth=max_depth, combine=combine,
+                )
+            scores = raw + jnp.asarray(init)[None, :raw.shape[-1]]
+            if is_rf:
+                # RF outputs are already probabilities / means — no link.
+                if task == Task.CLASSIFICATION:
+                    if scores.shape[-1] == 2:
+                        return scores[:, 1]
+                    return scores
+                return scores[:, 0] if scores.shape[-1] == 1 else scores
+            if not link:
+                return scores
+            if task == Task.CLASSIFICATION:
+                if scores.shape[-1] == 1:
+                    return jax.nn.sigmoid(scores[:, 0])
+                return jax.nn.softmax(scores, axis=-1)
+            if loss_name == "POISSON":
+                return jnp.exp(scores[:, 0])  # log link
+            return scores[:, 0] if scores.shape[-1] == 1 else scores
+
+        params = {"leaf_values": jnp.asarray(forest.leaf_value)}
+
+        def encoder(data):
+            ds = Dataset.from_data(data, dataspec=self.dataspec)
+            x_num, x_cat = self._encode_inputs(ds)
+            return jnp.asarray(x_num), jnp.asarray(x_cat)
+
+        return fn, params, encoder
+
+    def update_with_jax_params(self, params) -> None:
+        """Writes fine-tuned leaf values back into the model (reference
+        update_with_jax_params)."""
+        lv = jnp.asarray(params["leaf_values"], jnp.float32)
+        if lv.shape != self.forest.leaf_value.shape:
+            raise ValueError(
+                f"leaf_values shape {lv.shape} != "
+                f"{self.forest.leaf_value.shape}"
+            )
+        self.forest = self.forest._replace(leaf_value=lv)
+        # Invalidate serving caches derived from the old arrays.
+        self._qs_cache = {}
+        if hasattr(self, "_dim_forests"):
+            del self._dim_forests
 
     # ------------------------------------------------------------------ #
     # Serving
