@@ -61,3 +61,22 @@ def test_rf_data_parallel_mesh():
     ).train(data)
     # Same computation, different layout (padding rows carry zero weight).
     np.testing.assert_allclose(m1.predict(data), m2.predict(data), atol=1e-4)
+
+
+def test_honest_trees():
+    """Honest RF: structure and leaf values come from disjoint halves;
+    accuracy stays reasonable and leaf covers shrink accordingly."""
+    data = _data(3000)
+    m = ydf.RandomForestLearner(
+        label="cls", num_trees=20, max_depth=5, honest=True,
+    ).train(data)
+    assert m.evaluate(data).accuracy > 0.9
+    plain = ydf.RandomForestLearner(
+        label="cls", num_trees=20, max_depth=5,
+    ).train(data)
+    # honest leaf covers come from ~half the examples
+    import numpy as np
+
+    h = np.asarray(m.forest.cover)[np.asarray(m.forest.is_leaf)].sum()
+    p = np.asarray(plain.forest.cover)[np.asarray(plain.forest.is_leaf)].sum()
+    assert h < 0.7 * p

@@ -37,3 +37,15 @@ def test_ranking_requires_group():
         ydf.GradientBoostedTreesLearner(
             label="LABEL", task=Task.RANKING, num_trees=2
         ).train(f"csv:{D}/synthetic_ranking_train.csv")
+
+
+def test_xe_ndcg_loss():
+    model = ydf.GradientBoostedTreesLearner(
+        label="LABEL",
+        task=Task.RANKING,
+        ranking_group="GROUP",
+        loss="XE_NDCG_MART",
+        num_trees=40,
+    ).train(f"csv:{D}/synthetic_ranking_train.csv")
+    ev = model.evaluate(f"csv:{D}/synthetic_ranking_test.csv")
+    assert ev.metrics["ndcg@5"] > 0.65, str(ev)

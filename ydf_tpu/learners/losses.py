@@ -238,6 +238,10 @@ def make_loss(name: str, task, num_classes: int):
         from ydf_tpu.learners.ranking_loss import LambdaMartNdcg
 
         return LambdaMartNdcg()
+    if name == "XE_NDCG_MART":
+        from ydf_tpu.learners.ranking_loss import XeNdcg
+
+        return XeNdcg()
     if name == "POISSON":
         return PoissonLoss()
     if name == "MEAN_AVERAGE_ERROR":

@@ -50,6 +50,8 @@ class RandomForestLearner(GenericLearner):
         winner_take_all: bool = True,
         max_frontier: int = 1024,
         uplift_treatment: Optional[str] = None,
+        honest: bool = False,
+        honest_ratio_leaf_examples: float = 0.5,
         mesh=None,
         features: Optional[Sequence[str]] = None,
         weights: Optional[str] = None,
@@ -70,6 +72,12 @@ class RandomForestLearner(GenericLearner):
         self.winner_take_all = winner_take_all
         self.max_frontier = max_frontier
         self.uplift_treatment = uplift_treatment
+        # Honest trees (reference honest-split partitioning,
+        # training.cc:4836-4860): per tree, a random half of the examples
+        # grows the STRUCTURE and the other half estimates the LEAF
+        # values — decoupling selection from estimation (Wager & Athey).
+        self.honest = honest
+        self.honest_ratio_leaf_examples = honest_ratio_leaf_examples
         # jax.sharding.Mesh: data-parallel training — the per-layer
         # histogram contraction all-reduces over the data axis via GSPMD
         # (see ydf_tpu/parallel/mesh.py).
@@ -200,6 +208,9 @@ class RandomForestLearner(GenericLearner):
             candidate_features=cand,
             num_numerical=binner.num_numerical,
             seed=self.random_seed,
+            honest_ratio=(
+                self.honest_ratio_leaf_examples if self.honest else 0.0
+            ),
         )
 
         forest = forest_from_stacked_trees(
@@ -225,6 +236,7 @@ class RandomForestLearner(GenericLearner):
 def _train_rf(
     bins, w_base, *, stats_fn, rule, tree_cfg: TreeConfig, max_nodes,
     num_trees, bootstrap, candidate_features, num_numerical, seed,
+    honest_ratio=0.0,
 ):
     n = bins.shape[0]
 
@@ -232,15 +244,22 @@ def _train_rf(
     def run(bins, w_base):
         def one_tree(carry, t):
             key = jax.random.fold_in(jax.random.PRNGKey(seed), t)
-            k_boot, k_grow = jax.random.split(key)
+            k_boot, k_grow, k_honest = jax.random.split(key, 3)
             if bootstrap:
                 w = w_base * jax.random.poisson(
                     k_boot, 1.0, (n,)
                 ).astype(jnp.float32)
             else:
                 w = w_base
+            if honest_ratio > 0.0:
+                # Honest split: structure half vs leaf-estimation half.
+                est = jax.random.bernoulli(k_honest, honest_ratio, (n,))
+                w_grow = w * (1.0 - est)
+                w_leaf = w * est
+            else:
+                w_grow = w
             res = grower.grow_tree(
-                bins, stats_fn(w), k_grow,
+                bins, stats_fn(w_grow), k_grow,
                 rule=rule,
                 max_depth=tree_cfg.max_depth,
                 frontier=tree_cfg.frontier,
@@ -250,6 +269,24 @@ def _train_rf(
                 min_examples=tree_cfg.min_examples,
                 candidate_features=candidate_features,
             )
+            if honest_ratio > 0.0:
+                # Re-estimate every LEAF's statistics from the held-out
+                # half, routed through the grown structure. Internal nodes
+                # keep their grow-half stats (they feed cover/SHAP), and a
+                # leaf that drew no estimation examples falls back to its
+                # grow-half stats instead of an all-zero value.
+                est_stats = stats_fn(w_leaf)
+                seg = jax.ops.segment_sum(
+                    est_stats, res.leaf_id,
+                    num_segments=res.tree.leaf_stats.shape[0],
+                )
+                use_est = (
+                    res.tree.is_leaf & (seg[..., -1] > 0)
+                )[:, None]
+                leaf_stats = jnp.where(use_est, seg, res.tree.leaf_stats)
+                tree = res.tree._replace(leaf_stats=leaf_stats)
+                lv = rule.leaf_value(leaf_stats, None)
+                return carry, (tree, lv)
             lv = rule.leaf_value(res.tree.leaf_stats, None)
             return carry, (res.tree, lv)
 
