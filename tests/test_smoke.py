@@ -61,3 +61,12 @@ def test_isolation_forest_synthetic():
     scores = model.predict(data)
     # outliers should score higher on average
     assert scores[500:].mean() > scores[:500].mean() + 0.05
+
+
+def test_plot_training_logs(adult_train):
+    m = ydf.GradientBoostedTreesLearner(
+        label="income", num_trees=5
+    ).train(adult_train.head(1000))
+    svg = m.plot_training_logs()
+    assert svg.startswith("<svg") and "polyline" in svg
+    assert "validation" in svg  # default validation split present
