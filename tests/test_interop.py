@@ -1,0 +1,131 @@
+"""Interop: custom losses (reference pydf custom_loss.py) and sklearn
+model import (reference export_sklearn.py from_sklearn)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+
+
+def _reg_data(n=2000, seed=0):
+    rng = np.random.RandomState(seed)
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    y = 2 * x1 - x2 + rng.normal(scale=0.3, size=n)
+    return {"x1": x1, "x2": x2, "y": y.astype(np.float32)}
+
+
+def test_custom_loss_matches_builtin_mse():
+    data = _reg_data()
+    custom = ydf.CustomLoss(
+        initial_predictions_fn=lambda y, w: jnp.sum(w * y) / jnp.sum(w),
+        gradient_and_hessian_fn=lambda y, s: (s - y, jnp.ones_like(s)),
+        loss_fn=lambda y, s: jnp.sqrt(jnp.mean((s - y) ** 2)),
+    )
+    kw = dict(
+        label="y", task=Task.REGRESSION, num_trees=10, max_depth=4,
+        validation_ratio=0.0, early_stopping="NONE",
+    )
+    m_custom = ydf.GradientBoostedTreesLearner(loss=custom, **kw).train(data)
+    m_mse = ydf.GradientBoostedTreesLearner(loss="SQUARED_ERROR", **kw).train(
+        data
+    )
+    np.testing.assert_allclose(
+        m_custom.predict(data), m_mse.predict(data), atol=1e-5
+    )
+
+
+def test_custom_asymmetric_loss_changes_predictions():
+    data = _reg_data()
+    # Heavily penalize under-prediction: quantile-style pinball gradients.
+    tau = 0.9
+    custom = ydf.CustomLoss(
+        initial_predictions_fn=lambda y, w: jnp.quantile(y, 0.9),
+        gradient_and_hessian_fn=lambda y, s: (
+            jnp.where(s < y, -tau, 1 - tau), jnp.ones_like(s)
+        ),
+        loss_fn=lambda y, s: jnp.mean(
+            jnp.maximum(tau * (y - s), (tau - 1) * (y - s))
+        ),
+    )
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", task=Task.REGRESSION, loss=custom, num_trees=30,
+        validation_ratio=0.0, early_stopping="NONE",
+    ).train(data)
+    preds = m.predict(data)
+    # A 0.9-quantile model over-predicts ~90% of targets.
+    frac_over = float(np.mean(preds > data["y"]))
+    assert frac_over > 0.75, frac_over
+
+
+def _xy(n=1500, seed=1, classes=2):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, 4))
+    logits = X[:, 0] - X[:, 1] + 0.5 * X[:, 2]
+    if classes == 2:
+        y = (logits > 0).astype(int)
+    else:
+        y = np.digitize(logits, [-0.7, 0.7])
+    return X, y
+
+
+def test_from_sklearn_rf_classifier():
+    from sklearn.ensemble import RandomForestClassifier
+
+    X, y = _xy()
+    skl = RandomForestClassifier(n_estimators=10, max_depth=6,
+                                 random_state=0).fit(X, y)
+    m = ydf.from_sklearn(skl)
+    data = {f"feature_{i}": X[:, i] for i in range(4)}
+    ours = m.predict(data)
+    theirs = skl.predict_proba(X)[:, 1]
+    np.testing.assert_allclose(ours, theirs, atol=1e-6)
+
+
+def test_from_sklearn_rf_regressor():
+    from sklearn.ensemble import RandomForestRegressor
+
+    X, y = _xy()
+    skl = RandomForestRegressor(n_estimators=8, max_depth=6,
+                                random_state=0).fit(X, y.astype(float))
+    m = ydf.from_sklearn(skl)
+    data = {f"feature_{i}": X[:, i] for i in range(4)}
+    np.testing.assert_allclose(m.predict(data), skl.predict(X), atol=1e-5)
+
+
+def test_from_sklearn_gbt_classifier():
+    from sklearn.ensemble import GradientBoostingClassifier
+
+    X, y = _xy()
+    skl = GradientBoostingClassifier(n_estimators=15, max_depth=3,
+                                     random_state=0).fit(X, y)
+    m = ydf.from_sklearn(skl)
+    data = {f"feature_{i}": X[:, i] for i in range(4)}
+    np.testing.assert_allclose(
+        m.predict(data), skl.predict_proba(X)[:, 1], atol=1e-5
+    )
+
+
+def test_from_sklearn_gbt_regressor():
+    from sklearn.ensemble import GradientBoostingRegressor
+
+    X, y = _xy()
+    skl = GradientBoostingRegressor(n_estimators=15, max_depth=3,
+                                    random_state=0).fit(X, y.astype(float))
+    m = ydf.from_sklearn(skl)
+    data = {f"feature_{i}": X[:, i] for i in range(4)}
+    np.testing.assert_allclose(m.predict(data), skl.predict(X), atol=1e-5)
+
+
+def test_from_sklearn_multiclass_gbt():
+    from sklearn.ensemble import GradientBoostingClassifier
+
+    X, y = _xy(classes=3)
+    skl = GradientBoostingClassifier(n_estimators=8, max_depth=3,
+                                     random_state=0).fit(X, y)
+    m = ydf.from_sklearn(skl)
+    data = {f"feature_{i}": X[:, i] for i in range(4)}
+    np.testing.assert_allclose(
+        m.predict(data), skl.predict_proba(X), atol=1e-5
+    )

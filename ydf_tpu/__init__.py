@@ -25,6 +25,7 @@ from ydf_tpu.dataset.dataspec import (
 )
 from ydf_tpu.dataset.dataset import Dataset
 from ydf_tpu.learners.gbt import GradientBoostedTreesLearner
+from ydf_tpu.learners.losses import CustomLoss
 from ydf_tpu.learners.random_forest import RandomForestLearner
 from ydf_tpu.learners.cart import CartLearner
 from ydf_tpu.learners.isolation_forest import IsolationForestLearner
@@ -32,6 +33,7 @@ from ydf_tpu.learners.multitasker import MultitaskerLearner, MultitaskerModel
 from ydf_tpu.learners.tuner import RandomSearchTuner
 from ydf_tpu.metrics import cross_validation
 from ydf_tpu.models.io import load_model
+from ydf_tpu.models.sklearn_import import from_sklearn
 from ydf_tpu.models.ydf_format import load_ydf_model
 from ydf_tpu.config import Task
 
@@ -44,11 +46,13 @@ __all__ = [
     "Dataset",
     "infer_dataspec",
     "GradientBoostedTreesLearner",
+    "CustomLoss",
     "RandomForestLearner",
     "CartLearner",
     "IsolationForestLearner",
     "load_model",
     "load_ydf_model",
+    "from_sklearn",
     "MultitaskerLearner",
     "MultitaskerModel",
     "RandomSearchTuner",

@@ -284,7 +284,12 @@ class GradientBoostedTreesLearner(GenericLearner):
             y_va = pmesh.shard_batch(self.mesh, y_va)
             w_va = pmesh.shard_batch(self.mesh, w_va)
 
-        loss_obj = make_loss(self.loss, self.task, num_classes)
+        from ydf_tpu.learners.losses import CustomLoss
+
+        if isinstance(self.loss, CustomLoss):
+            loss_obj = self.loss
+        else:
+            loss_obj = make_loss(self.loss, self.task, num_classes)
         from ydf_tpu.learners.ranking_loss import LambdaMartNdcg, build_group_rows
 
         if isinstance(loss_obj, LambdaMartNdcg):
@@ -836,9 +841,12 @@ def _train_gbt(
     # Identity-hashed losses (LambdaMartNdcg carries per-dataset group
     # arrays) can never hit the cache — bypass it so dead entries don't pin
     # device memory or evict the reusable frozen-dataclass ones.
+    from ydf_tpu.learners.losses import CustomLoss
+
     builder = (
         _make_boost_fn
         if type(loss_obj).__hash__ is not object.__hash__
+        and not isinstance(loss_obj, CustomLoss)  # identity-hashed fields
         else _make_boost_fn.__wrapped__
     )
     run = builder(
@@ -876,6 +884,8 @@ def _train_gbt(
     from ydf_tpu.utils.snapshot import Snapshots
 
     fp = hashlib.sha1()
+    if hasattr(loss_obj, "fingerprint"):
+        fp.update(loss_obj.fingerprint())
     fp.update(
         repr(
             (

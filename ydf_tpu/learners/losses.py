@@ -249,3 +249,63 @@ def make_loss(name: str, task, num_classes: int):
     if name == "BINARY_FOCAL_LOSS":
         return BinaryFocalLoss()
     raise ValueError(f"Unknown loss {name!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class CustomLoss:
+    """User-supplied loss (reference: pydf custom_loss.py + the C++
+    custom-loss bridges, learner/custom_loss.cc): three JAX-traceable
+    callables over batched arrays.
+
+        CustomLoss(
+            initial_predictions_fn=lambda y, w: jnp.zeros((1,)),
+            gradient_and_hessian_fn=lambda y, s: (g, h),  # s: [n] scores
+            loss_fn=lambda y, s: scalar,       # or (y, s, w) for weighted
+        )
+
+    Hashable by field identity, so the jitted boosting loop caches per
+    CustomLoss instance. Single-output only (num_dims = 1).
+    """
+
+    initial_predictions_fn: object
+    gradient_and_hessian_fn: object
+    loss_fn: object
+    name: str = "CUSTOM"
+
+    num_dims = 1
+
+    def initial_predictions(self, labels, weights):
+        out = jnp.asarray(self.initial_predictions_fn(labels, weights))
+        return out.reshape((1,)).astype(jnp.float32)
+
+    def grad_hess(self, labels, preds):
+        g, h = self.gradient_and_hessian_fn(labels, preds[:, 0])
+        return (
+            jnp.asarray(g).reshape(-1, 1),
+            jnp.maximum(jnp.asarray(h).reshape(-1, 1), _EPS),
+        )
+
+    def loss(self, labels, preds, weights, tag: str = "train"):
+        import inspect
+
+        params = inspect.signature(self.loss_fn).parameters
+        if len(params) >= 3:
+            return jnp.asarray(self.loss_fn(labels, preds[:, 0], weights))
+        return jnp.asarray(self.loss_fn(labels, preds[:, 0]))
+
+    def predict_proba(self, preds):
+        return preds
+
+    def fingerprint(self) -> bytes:
+        """Stable content hash for checkpoint-resume validation: the
+        compiled bytecode of each user callable (a changed lambda body
+        changes the fingerprint; an identical redefinition does not)."""
+        out = []
+        for fn in (
+            self.initial_predictions_fn,
+            self.gradient_and_hessian_fn,
+            self.loss_fn,
+        ):
+            code = getattr(fn, "__code__", None)
+            out.append(code.co_code if code is not None else repr(fn).encode())
+        return b"|".join(out)

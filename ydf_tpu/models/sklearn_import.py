@@ -1,0 +1,213 @@
+"""Import scikit-learn forest models.
+
+Counterpart of the reference's sklearn converter
+(`pydf/model/export_sklearn.py:455` from_sklearn): converts fitted
+sklearn RandomForest / ExtraTrees / GradientBoosting (classifier or
+regressor) estimators into ydf_tpu models over the same flattened Forest
+arrays every engine here consumes. Conversion is vectorized straight off
+sklearn's tree_ numpy arrays (no per-node Python objects).
+
+sklearn conditions are `x <= threshold -> left`; ours are
+`x < threshold -> left`. Thresholds are float64 in sklearn: we round DOWN
+to the nearest float32 (so the f32 value never crosses a feature value)
+then bump one ulp up, making `x < t32'` exactly equivalent to
+`x <= t64` for every float32 x.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ydf_tpu.config import Task
+from ydf_tpu.dataset.binning import Binner
+from ydf_tpu.dataset.dataspec import Column, ColumnType, DataSpecification
+from ydf_tpu.models.forest import Forest
+
+_F32_NINF = np.float32(-np.inf)
+_F32_PINF = np.float32(np.inf)
+
+
+def _feature_names(skl, n_features: int) -> List[str]:
+    names = getattr(skl, "feature_names_in_", None)
+    if names is not None:
+        return [str(n) for n in names]
+    return [f"feature_{i}" for i in range(n_features)]
+
+
+def _stack_forest(trees, leaf_values: List[np.ndarray],
+                  leaf_dim: int) -> Forest:
+    """trees: list of sklearn tree_ objects; leaf_values[i]: [n_nodes_i,
+    leaf_dim] (values for non-leaves ignored)."""
+    T = len(trees)
+    N = max(t.node_count for t in trees)
+    f = dict(
+        feature=np.full((T, N), -1, np.int32),
+        threshold=np.full((T, N), np.inf, np.float32),
+        threshold_bin=np.zeros((T, N), np.int32),
+        is_cat=np.zeros((T, N), np.bool_),
+        cat_mask=np.full((T, N, 1), 0xFFFFFFFF, np.uint32),
+        left=np.zeros((T, N), np.int32),
+        right=np.zeros((T, N), np.int32),
+        is_leaf=np.ones((T, N), np.bool_),
+        na_left=np.zeros((T, N), np.bool_),
+        leaf_value=np.zeros((T, N, leaf_dim), np.float32),
+        cover=np.ones((T, N), np.float32),
+        oblique_weights=np.zeros((T, 0, 0), np.float32),
+        oblique_na_repl=np.zeros((T, 0, 0), np.float32),
+        num_nodes=np.array([t.node_count for t in trees], np.int32),
+    )
+    for t, (tree, lv) in enumerate(zip(trees, leaf_values)):
+        n = tree.node_count
+        left = tree.children_left[:n]
+        is_leaf = left == -1
+        f["is_leaf"][t, :n] = is_leaf
+        f["feature"][t, :n] = np.where(is_leaf, -1, tree.feature[:n])
+        thr64 = tree.threshold[:n]
+        t32 = thr64.astype(np.float32)
+        # Round toward -inf where f32 rounding went above the f64 value,
+        # then one ulp up: x < t32' (f32) == x <= t64 for all f32 x.
+        t32 = np.where(t32 > thr64, np.nextafter(t32, _F32_NINF), t32)
+        t32 = np.nextafter(t32, _F32_PINF)
+        f["threshold"][t, :n] = np.where(is_leaf, np.inf, t32)
+        f["left"][t, :n] = np.where(is_leaf, 0, left)
+        f["right"][t, :n] = np.where(is_leaf, 0, tree.children_right[:n])
+        f["cover"][t, :n] = tree.weighted_n_node_samples[:n]
+        f["leaf_value"][t, :n] = np.where(is_leaf[:, None], lv, 0.0)
+    return Forest.from_numpy(f)
+
+
+def _serving_binner(names: List[str]) -> Binner:
+    F = len(names)
+    return Binner(
+        feature_names=list(names),
+        num_numerical=F,
+        num_bins=256,
+        boundaries=np.full((F, 1), np.inf, np.float32),
+        impute_values=np.zeros((F,), np.float32),
+        feature_num_bins=np.full((F,), 2, np.int32),
+    )
+
+
+def _numeric_dataspec(names: List[str], label: str,
+                      classes: Optional[List[str]]) -> DataSpecification:
+    cols = [Column(name=n, type=ColumnType.NUMERICAL) for n in names]
+    if classes is not None:
+        cols.append(
+            Column(
+                name=label, type=ColumnType.CATEGORICAL,
+                vocabulary=["<OOD>"] + list(classes),
+                vocab_counts=[0] * (len(classes) + 1),
+            )
+        )
+    else:
+        cols.append(Column(name=label, type=ColumnType.NUMERICAL))
+    return DataSpecification(columns=cols)
+
+
+def _gbt_initial_predictions(skl, is_cls: bool, K: int) -> np.ndarray:
+    if skl.init_ == "zero" or skl.init_ is None:
+        return np.zeros((max(K, 1),), np.float32)
+    dummy = np.zeros((1, skl.n_features_in_))
+    if is_cls:
+        if not hasattr(skl.init_, "predict_proba"):
+            raise NotImplementedError(
+                f"unsupported init_ estimator {type(skl.init_).__name__}"
+            )
+        p = np.clip(skl.init_.predict_proba(dummy)[0], 1e-12, 1 - 1e-12)
+        if K == 1:
+            return np.array([np.log(p[1] / p[0])], np.float32)
+        return np.log(p).astype(np.float32)
+    if not hasattr(skl.init_, "predict"):
+        raise NotImplementedError(
+            f"unsupported init_ estimator {type(skl.init_).__name__}"
+        )
+    return np.asarray(skl.init_.predict(dummy), np.float32).reshape(1)
+
+
+def from_sklearn(skl, label: str = "label"):
+    """Converts a fitted sklearn forest into the equivalent ydf_tpu model."""
+    from sklearn.ensemble import (
+        ExtraTreesClassifier,
+        ExtraTreesRegressor,
+        GradientBoostingClassifier,
+        GradientBoostingRegressor,
+        RandomForestClassifier,
+        RandomForestRegressor,
+    )
+
+    from ydf_tpu.models.gbt_model import GradientBoostedTreesModel
+    from ydf_tpu.models.rf_model import RandomForestModel
+
+    names = _feature_names(skl, skl.n_features_in_)
+
+    if isinstance(
+        skl,
+        (RandomForestClassifier, ExtraTreesClassifier,
+         RandomForestRegressor, ExtraTreesRegressor),
+    ):
+        is_cls = isinstance(
+            skl, (RandomForestClassifier, ExtraTreesClassifier)
+        )
+        trees = [e.tree_ for e in skl.estimators_]
+        if is_cls:
+            classes = [str(c) for c in skl.classes_]
+            C = len(classes)
+            lvs = []
+            for t in trees:
+                counts = t.value[:, 0, :]
+                lvs.append(
+                    counts / np.maximum(counts.sum(1, keepdims=True), 1e-12)
+                )
+        else:
+            classes, C = None, 1
+            lvs = [t.value[:, 0, 0:1] for t in trees]
+        return RandomForestModel(
+            task=Task.CLASSIFICATION if is_cls else Task.REGRESSION,
+            label=label, classes=classes,
+            dataspec=_numeric_dataspec(names, label, classes),
+            binner=_serving_binner(names),
+            forest=_stack_forest(trees, lvs, C),
+            max_depth=max(max(t.max_depth for t in trees), 1),
+            winner_take_all=False,  # sklearn averages probabilities
+            extra_metadata={"imported_from": "sklearn"},
+        )
+
+    if isinstance(
+        skl, (GradientBoostingClassifier, GradientBoostingRegressor)
+    ):
+        is_cls = isinstance(skl, GradientBoostingClassifier)
+        K = len(skl.classes_) if is_cls and len(skl.classes_) > 2 else 1
+        lr = skl.learning_rate
+        trees = [
+            est.tree_
+            for stage in skl.estimators_
+            for est in np.atleast_1d(stage)
+        ]
+        lvs = [lr * t.value[:, 0, 0:1] for t in trees]
+        init = _gbt_initial_predictions(skl, is_cls, K)
+        classes = [str(c) for c in skl.classes_] if is_cls else None
+        if is_cls:
+            loss_name = (
+                "MULTINOMIAL_LOG_LIKELIHOOD" if K > 1
+                else "BINOMIAL_LOG_LIKELIHOOD"
+            )
+        else:
+            loss_name = "SQUARED_ERROR"
+        return GradientBoostedTreesModel(
+            task=Task.CLASSIFICATION if is_cls else Task.REGRESSION,
+            label=label, classes=classes,
+            dataspec=_numeric_dataspec(names, label, classes),
+            binner=_serving_binner(names),
+            forest=_stack_forest(trees, lvs, 1),
+            initial_predictions=init,
+            num_trees_per_iter=max(K, 1),
+            max_depth=max(max(t.max_depth for t in trees), 1),
+            loss_name=loss_name,
+            extra_metadata={"imported_from": "sklearn"},
+        )
+
+    raise NotImplementedError(
+        f"from_sklearn does not support {type(skl).__name__}"
+    )
