@@ -241,11 +241,13 @@ def _read_tree(records: Iterator[bytes]) -> _Node:
     return node
 
 
-def read_trees(model_dir: str, num_shards: int, num_trees: int) -> List[_Node]:
+def read_trees(model_dir: str, num_shards: int, num_trees: int,
+               prefix: str = "") -> List[_Node]:
     def record_iter():
         for shard in range(num_shards):
             path = os.path.join(
-                model_dir, f"nodes-{shard:05d}-of-{num_shards:05d}"
+                model_dir,
+                f"{prefix}nodes-{shard:05d}-of-{num_shards:05d}",
             )
             yield from read_blob_sequence(path)
 
@@ -582,23 +584,48 @@ def _read_file(path: str) -> bytes:
         return f.read()
 
 
+def _detect_prefix(path: str, strict: bool = False) -> Optional[str]:
+    """Models can share a directory under distinct filename prefixes
+    (reference model_library.cc LoadModel's `file_prefix`). Returns the
+    prefix ("" for none) or None if no model is present. With strict=True,
+    several candidate prefixes raise instead of silently picking one
+    (the reference's DetectFilePrefix ambiguity error)."""
+    if not os.path.isdir(path):
+        return None
+    found = []
+    for fname in sorted(os.listdir(path)):
+        if fname.endswith("data_spec.pb"):
+            prefix = fname[: -len("data_spec.pb")]
+            if os.path.isfile(os.path.join(path, prefix + "header.pb")):
+                found.append(prefix)
+    if strict and len(found) > 1:
+        raise ValueError(
+            f"{path} contains several models (prefixes {found}); pass "
+            "prefix= explicitly"
+        )
+    return found[0] if found else None
+
+
 def is_ydf_model_dir(path: str) -> bool:
-    return os.path.isfile(os.path.join(path, "data_spec.pb")) and os.path.isfile(
-        os.path.join(path, "header.pb")
-    )
+    return _detect_prefix(path) is not None
 
 
-def load_ydf_model(path: str):
+def load_ydf_model(path: str, prefix: Optional[str] = None):
     """Loads a model saved by the reference implementation.
 
     Supports GBT, RF and Isolation Forest with numerical / categorical /
-    boolean / discretized-numerical conditions. Returns the matching
-    ydf_tpu model class, predicting through the standard Forest engines.
+    boolean / discretized-numerical / oblique conditions, including
+    prefixed filenames (several models per directory). Returns the
+    matching ydf_tpu model class, predicting through the standard Forest
+    engines.
     """
-    if not is_ydf_model_dir(path):
+    if prefix is None:
+        prefix = _detect_prefix(path, strict=True)
+    if prefix is None:
         raise ValueError(f"{path} is not a YDF model directory")
-    header = pw.decode(_read_file(os.path.join(path, "header.pb")))
-    spec, ycols = parse_dataspec(_read_file(os.path.join(path, "data_spec.pb")))
+    join = lambda name: os.path.join(path, prefix + name)
+    header = pw.decode(_read_file(join("header.pb")))
+    spec, ycols = parse_dataspec(_read_file(join("data_spec.pb")))
 
     # AbstractModel (abstract_model.proto:66-116)
     name = pw.get_str(header, 1)
@@ -626,9 +653,9 @@ def load_ydf_model(path: str):
     fmap = _FeatureMap(spec, ycols, input_features)
     binner = fmap.make_binner()
 
-    gbt_path = os.path.join(path, "gradient_boosted_trees_header.pb")
-    rf_path = os.path.join(path, "random_forest_header.pb")
-    if_path = os.path.join(path, "isolation_forest_header.pb")
+    gbt_path = join("gradient_boosted_trees_header.pb")
+    rf_path = join("random_forest_header.pb")
+    if_path = join("isolation_forest_header.pb")
 
     if os.path.isfile(gbt_path):
         from ydf_tpu.models.gbt_model import GradientBoostedTreesModel
@@ -640,7 +667,7 @@ def load_ydf_model(path: str):
         _check_node_format(pw.get_str(gh, 7, ""), path)
         loss_name = _GBT_LOSS.get(pw.get_int(gh, 3, 0), "DEFAULT")
         init_preds = pw.get_packed_floats(gh, 4)
-        trees = read_trees(path, num_shards, num_trees)
+        trees = read_trees(path, num_shards, num_trees, prefix)
         forest, max_depth = trees_to_forest(
             trees, fmap, _leaf_regressor_top_value, 1
         )
@@ -669,7 +696,7 @@ def load_ydf_model(path: str):
         num_trees = pw.get_sint(rh, 2, 0)
         _check_node_format(pw.get_str(rh, 7, ""), path)
         winner_take_all = pw.get_bool(rh, 3, True)
-        trees = read_trees(path, num_shards, num_trees)
+        trees = read_trees(path, num_shards, num_trees, prefix)
         if task == Task.CLASSIFICATION:
             ncls = len(classes) if classes else 2
             leaf_fn, leaf_dim = _make_leaf_classifier(ncls), ncls
@@ -702,7 +729,7 @@ def load_ydf_model(path: str):
         num_trees = pw.get_sint(ih, 2, 0)
         _check_node_format(pw.get_str(ih, 3, ""), path)
         num_examples_per_tree = pw.get_sint(ih, 4, 256)
-        trees = read_trees(path, num_shards, num_trees)
+        trees = read_trees(path, num_shards, num_trees, prefix)
         forest, max_depth = trees_to_forest(
             trees, fmap, _make_leaf_anomaly(), 1
         )
