@@ -70,3 +70,12 @@ def test_plot_training_logs(adult_train):
     svg = m.plot_training_logs()
     assert svg.startswith("<svg") and "polyline" in svg
     assert "validation" in svg  # default validation split present
+
+
+def test_model_benchmark(adult_train):
+    m = ydf.GradientBoostedTreesLearner(
+        label="income", num_trees=5, validation_ratio=0.0,
+        early_stopping="NONE",
+    ).train(adult_train.head(1000))
+    r = m.benchmark(adult_train.head(1000), num_runs=3)
+    assert r["num_examples"] == 1000 and r["ns_per_example"] > 0

@@ -113,30 +113,12 @@ def cmd_show_model(args):
 
 def cmd_benchmark_inference(args):
     _force_cpu_if_requested(args)
-    import numpy as np
-
     import ydf_tpu as ydf
-    from ydf_tpu.dataset.dataset import Dataset
 
     model = ydf.load_model(args.model)
-    ds = Dataset.from_data(args.dataset, dataspec=model.dataspec)
-    model.predict(ds)  # warmup + compile
-    times = []
-    for _ in range(args.num_runs):
-        t0 = time.perf_counter()
-        model.predict(ds)
-        times.append(time.perf_counter() - t0)
-    per_example_ns = 1e9 * min(times) / ds.num_rows
-    print(
-        json.dumps(
-            {
-                "num_examples": ds.num_rows,
-                "num_runs": args.num_runs,
-                "best_wall_s": min(times),
-                "ns_per_example": round(per_example_ns, 1),
-            }
-        )
-    )
+    r = model.benchmark(args.dataset, num_runs=args.num_runs)
+    r["ns_per_example"] = round(r["ns_per_example"], 1)
+    print(json.dumps(r))
 
 
 def cmd_synthetic_dataset(args):

@@ -310,6 +310,29 @@ class GenericModel:
     def predict(self, data: InputData) -> np.ndarray:
         raise NotImplementedError
 
+    def benchmark(self, data: InputData, num_runs: int = 10) -> dict:
+        """Inference speed on `data` (reference model.benchmark /
+        cli/benchmark_inference.cc): best wall time over `num_runs`
+        batched predicts, compile excluded."""
+        import time
+
+        if num_runs < 1:
+            raise ValueError("num_runs must be >= 1")
+        ds = Dataset.from_data(data, dataspec=self.dataspec)
+        self.predict(ds)  # warmup + compile
+        times = []
+        for _ in range(num_runs):
+            t0 = time.perf_counter()
+            self.predict(ds)
+            times.append(time.perf_counter() - t0)
+        best = min(times)
+        return {
+            "num_examples": ds.num_rows,
+            "num_runs": num_runs,
+            "best_wall_s": best,
+            "ns_per_example": 1e9 * best / max(ds.num_rows, 1),
+        }
+
     # ------------------------------------------------------------------ #
     # Evaluation
     # ------------------------------------------------------------------ #
