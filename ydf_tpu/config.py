@@ -12,6 +12,28 @@ import dataclasses
 import enum
 
 
+def is_tpu_backend() -> bool:
+    """True when JAX is running on TPU hardware.
+
+    The axon TPU tunnel registers its PJRT plugin under the platform name
+    "axon", so `jax.default_backend() == "tpu"` is NOT a sufficient check —
+    round 1's TPU-default code paths (matmul histogram, compiled
+    QuickScorer) silently deselected themselves on the real benchmark
+    environment because of it.
+    """
+    import jax
+
+    try:
+        if jax.default_backend() in ("tpu", "axon"):
+            return True
+        return any(
+            getattr(d, "platform", "") in ("tpu", "axon")
+            for d in jax.devices()
+        )
+    except Exception:
+        return False
+
+
 class Task(enum.Enum):
     """Modeling task. Reference: ydf/model/abstract_model.proto:Task."""
 

@@ -507,6 +507,10 @@ class GradientBoostedTreesLearner(GenericLearner):
                 if has_valid
                 else None,
                 "num_trees": num_iters,
+                # Iterations the boosting loop actually ran — less than the
+                # requested num_trees when in-loop early stopping fired
+                # (reference early_stopping.h:29-66).
+                "num_trees_trained": int(train_losses.shape[0]),
             },
             extra_metadata=(
                 {
@@ -825,6 +829,60 @@ def _make_boost_fn(
     return run
 
 
+def _chunk_len(clen: int, start: int, num_trees: int, use_dart: bool) -> int:
+    """Fixed chunk length so ONE compiled executable serves every chunk;
+    the tail overshoots and is sliced off at merge. DART is the exception —
+    extra iterations would rescale kept trees — and pays one extra compile
+    for an exact tail."""
+    return min(clen, num_trees - start) if use_dart else clen
+
+
+def _chunk_arrays_from_ys(ys) -> dict:
+    """run_chunk outputs → the flat dict layout shared by the in-memory
+    early-stop path and the on-disk snapshot payloads."""
+    trees_c, lvs_c, tls_c, vls_c, ow_c, ob_c = ys
+    d = {f"trees_{j}": np.asarray(a) for j, a in enumerate(trees_c)}
+    d["lvs"] = np.asarray(lvs_c)
+    d["tls"] = np.asarray(tls_c)
+    d["vls"] = np.asarray(vls_c)
+    d["ow"] = np.asarray(ow_c)
+    d["ob"] = np.asarray(ob_c)
+    return d
+
+
+def _early_stop_hit(vls_seen, done: int, lookahead: int) -> bool:
+    """Look-ahead early stopping (reference early_stopping.h:29-66): stop
+    once the validation loss has not improved for `lookahead` trees.
+    `vls_seen` covers iterations [0, done) so argmin is an absolute index."""
+    if lookahead <= 0:
+        return False
+    vall = np.concatenate(vls_seen)[:done]
+    return done - (int(np.argmin(vall)) + 1) >= lookahead
+
+
+def _merge_chunk_parts(parts, num_trees, use_dart, carry):
+    """Concatenates per-chunk payload dicts and slices off the tail
+    overshoot. Bakes final DART weights (the single-scan path does this
+    in-jit)."""
+    from ydf_tpu.ops.grower import TreeArrays
+
+    n_tree_fields = sum(1 for k in parts[0] if k.startswith("trees_"))
+    trees_np = [
+        np.concatenate([p[f"trees_{j}"] for p in parts], axis=0)[:num_trees]
+        for j in range(n_tree_fields)
+    ]
+    lvs = np.concatenate([p["lvs"] for p in parts], axis=0)[:num_trees]
+    tls = np.concatenate([p["tls"] for p in parts], axis=0)[:num_trees]
+    vls = np.concatenate([p["vls"] for p in parts], axis=0)[:num_trees]
+    obl_w = np.concatenate([p["ow"] for p in parts], axis=0)[:num_trees]
+    obl_b = np.concatenate([p["ob"] for p in parts], axis=0)[:num_trees]
+    if use_dart:
+        tree_scale = np.asarray(jax.tree.leaves(carry)[5])
+        lvs = lvs * tree_scale[: lvs.shape[0], None, None, None]
+    trees = TreeArrays(*[jnp.asarray(a) for a in trees_np])
+    return trees, jnp.asarray(lvs), tls, vls, obl_w, obl_b
+
+
 def _train_gbt(
     bins_tr, y_tr, w_tr, bins_va, y_va, w_va, *,
     loss_obj, rule, tree_cfg: TreeConfig, num_trees, shrinkage, subsample,
@@ -861,6 +919,48 @@ def _train_gbt(
         (x_tr_raw, x_va_raw) if oblique_P > 0 else ()
     )
     if cache_dir is None:
+        if (
+            early_stop_lookahead > 0
+            and nv_rows > 0
+            # Stopping can only ever fire when the loop outlives the
+            # look-ahead window; otherwise the fused single scan is cheaper.
+            and num_trees > early_stop_lookahead
+        ):
+            # In-loop early STOPPING without a working_dir: drive the same
+            # run_chunk executable in memory and break once the validation
+            # loss has not improved for `early_stop_lookahead` trees — the
+            # reference stops its boosting loop the same way
+            # (early_stopping.h:29-66) instead of training all num_trees
+            # and truncating post-hoc.
+            use_dart = getattr(run, "use_dart", False)
+            carry, init_pred = run.init_state(y_tr, w_tr)
+            clen = max(1, min(early_stop_lookahead, 25))
+            parts = []
+            vls_seen = []
+            start = 0
+            while start < num_trees:
+                c = _chunk_len(clen, start, num_trees, use_dart)
+                carry, ys = run.run_chunk(
+                    carry, jnp.asarray(start), c, *data_args
+                )
+                parts.append(_chunk_arrays_from_ys(ys))
+                start += c
+                vls_seen.append(parts[-1]["vls"])
+                if _early_stop_hit(
+                    vls_seen, min(start, num_trees), early_stop_lookahead
+                ):
+                    break
+            trees, lvs, tls, vls, obl_w, obl_b = _merge_chunk_parts(
+                parts, num_trees, use_dart, carry
+            )
+            logs = {
+                "train_loss": tls,
+                "valid_loss": vls,
+                "initial_predictions": init_pred,
+                "oblique_w": obl_w,
+                "oblique_b": obl_b,
+            }
+            return trees, lvs, logs
         trees, lvs, tls, vls, init_pred, obl_w, obl_b = run(*data_args)
         logs = {
             "train_loss": tls,
@@ -942,28 +1042,11 @@ def _train_gbt(
             except Exception:
                 pass
     while start < num_trees:
-        # Fixed chunk length: the tail chunk intentionally overshoots so
-        # a single compiled executable serves every chunk (outputs beyond
-        # num_trees are sliced off below). DART is the exception — extra
-        # iterations would rescale kept trees — and pays the one extra
-        # compile for an exact tail.
-        clen = (
-            min(snapshot_interval, num_trees - start)
-            if use_dart
-            else snapshot_interval
-        )
+        clen = _chunk_len(snapshot_interval, start, num_trees, use_dart)
         carry, ys = run.run_chunk(
             carry, jnp.asarray(start), clen, *data_args
         )
-        trees_c, lvs_c, tls_c, vls_c, ow_c, ob_c = ys
-        chunk_arrays = {}
-        for j, a in enumerate(trees_c):
-            chunk_arrays[f"trees_{j}"] = np.asarray(a)
-        chunk_arrays["lvs"] = np.asarray(lvs_c)
-        chunk_arrays["tls"] = np.asarray(tls_c)
-        chunk_arrays["vls"] = np.asarray(vls_c)
-        chunk_arrays["ow"] = np.asarray(ow_c)
-        chunk_arrays["ob"] = np.asarray(ob_c)
+        chunk_arrays = _chunk_arrays_from_ys(ys)
         tmp = _chunk_path(start) + ".tmp"
         with open(tmp, "wb") as f:
             np.savez(f, **chunk_arrays)
@@ -994,15 +1077,10 @@ def _train_gbt(
         start = start_next
         chunks_done += 1
         if early_stop_lookahead > 0 and nv_rows > 0:
-            # True early STOPPING (the reference's look-ahead tracker,
-            # early_stopping.h:29-66): once the validation loss has not
-            # improved for `early_stop_lookahead` trees, stop training —
-            # the final model is truncated at the loss minimum anyway.
             # vls_seen covers iterations [0, start) including pre-resume
             # chunks (re-seeded above), so argmin is an absolute index.
-            vls_seen.append(np.asarray(vls_c))
-            vall = np.concatenate(vls_seen)[:start]
-            if start - (int(np.argmin(vall)) + 1) >= early_stop_lookahead:
+            vls_seen.append(chunk_arrays["vls"])
+            if _early_stop_hit(vls_seen, start, early_stop_lookahead):
                 break
         if abort_after_chunks is not None and chunks_done >= abort_after_chunks:
             raise _TrainingAborted(
@@ -1016,23 +1094,9 @@ def _train_gbt(
     for st in all_starts:
         with np.load(_chunk_path(st)) as z:
             parts.append({k: z[k] for k in z.files})
-    n_tree_fields = sum(1 for k in parts[0] if k.startswith("trees_"))
-    trees_np = [
-        np.concatenate([p[f"trees_{j}"] for p in parts], axis=0)[:num_trees]
-        for j in range(n_tree_fields)
-    ]
-    lvs = np.concatenate([p["lvs"] for p in parts], axis=0)[:num_trees]
-    tls = np.concatenate([p["tls"] for p in parts], axis=0)[:num_trees]
-    vls = np.concatenate([p["vls"] for p in parts], axis=0)[:num_trees]
-    obl_w = np.concatenate([p["ow"] for p in parts], axis=0)[:num_trees]
-    obl_b = np.concatenate([p["ob"] for p in parts], axis=0)[:num_trees]
-    if use_dart:
-        # Bake final DART weights (the non-chunked path does this in-jit).
-        tree_scale = np.asarray(jax.tree.leaves(carry)[5])
-        lvs = lvs * tree_scale[: lvs.shape[0], None, None, None]
-    from ydf_tpu.ops.grower import TreeArrays
-
-    trees = TreeArrays(*[jnp.asarray(a) for a in trees_np])
+    trees, lvs, tls, vls, obl_w, obl_b = _merge_chunk_parts(
+        parts, num_trees, use_dart, carry
+    )
     logs = {
         "train_loss": tls,
         "valid_loss": vls,
@@ -1040,7 +1104,7 @@ def _train_gbt(
         "oblique_w": obl_w,
         "oblique_b": obl_b,
     }
-    return trees, jnp.asarray(lvs), logs
+    return trees, lvs, logs
 
 
 class _TrainingAborted(RuntimeError):

@@ -265,10 +265,11 @@ class GenericModel:
         multiclass predict temporarily swaps self.forest per output dim."""
         import os
 
-        import jax
+        from ydf_tpu.config import is_tpu_backend
 
         force = os.environ.get("YDF_TPU_FORCE_QUICKSCORER") == "1"
-        if not force and jax.default_backend() != "tpu":
+        on_tpu = is_tpu_backend()
+        if not force and not on_tpu:
             return None
         cache = getattr(self, "_qs_cache", None)
         if cache is None:
@@ -284,9 +285,7 @@ class GenericModel:
                 cache.clear()
             cache[key] = (
                 self.forest.feature,
-                build_quickscorer(
-                    self, interpret=force and jax.default_backend() != "tpu"
-                ),
+                build_quickscorer(self, interpret=force and not on_tpu),
             )
         return cache[key][1]
 

@@ -116,7 +116,9 @@ def histogram(
 ) -> jax.Array:
     """Returns hist[num_slots, F, num_bins, S] = Σ_examples stats."""
     if impl == "auto":
-        impl = "matmul" if jax.default_backend() == "tpu" else "segment"
+        from ydf_tpu.config import is_tpu_backend
+
+        impl = "matmul" if is_tpu_backend() else "segment"
     if impl == "segment":
         return _histogram_segment(bins, slot, stats, num_slots, num_bins)
     if impl == "matmul":

@@ -274,7 +274,9 @@ def build_quickscorer(model, interpret: Optional[bool] = None):
     if qsm is None:
         return None
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from ydf_tpu.config import is_tpu_backend
+
+        interpret = not is_tpu_backend()
     return QuickScorerEngine(
         qsm, model.binner.num_numerical, interpret=interpret
     )
