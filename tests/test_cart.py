@@ -1,0 +1,107 @@
+"""CART validation-set pruning (reference learner/cart/cart.cc:307-455
+PruneNode; validation eval stored in the OOB field, cart.cc:352-358)."""
+
+import numpy as np
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+
+
+def _noisy_classification(n, seed):
+    rng = np.random.RandomState(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    noise = rng.normal(size=n)  # pure-noise feature: splits on it overfit
+    y = (x1 + 0.7 * x2 + rng.normal(scale=1.2, size=n) > 0).astype(np.int64)
+    return {"x1": x1, "x2": x2, "noise": noise, "y": y}
+
+
+def test_cart_pruning_shrinks_and_does_not_hurt():
+    train = _noisy_classification(3000, seed=0)
+    test = _noisy_classification(3000, seed=1)
+
+    unpruned = ydf.CartLearner(
+        label="y", max_depth=10, min_examples=2, validation_ratio=0.0,
+    ).train(train)
+    pruned = ydf.CartLearner(
+        label="y", max_depth=10, min_examples=2, validation_ratio=0.15,
+    ).train(train)
+
+    assert pruned.extra_metadata["num_pruned_nodes"] > 0
+    assert pruned.num_nodes() < unpruned.num_nodes()
+    acc_unpruned = unpruned.evaluate(test).accuracy
+    acc_pruned = pruned.evaluate(test).accuracy
+    # Reduced-error pruning must not hurt generalization (it usually helps
+    # on a noisy fit like this one).
+    assert acc_pruned >= acc_unpruned - 0.005
+
+    # The validation evaluation lands in the OOB slot (cart.cc:352).
+    ev = pruned.self_evaluation()
+    assert ev is not None and ev["source"] == "cart_validation"
+    assert 0.5 < ev["metrics"]["accuracy"] <= 1.0
+
+
+def test_cart_pruning_regression():
+    rng = np.random.RandomState(2)
+    n = 2500
+    x = rng.normal(size=n)
+    noise = rng.normal(size=n)
+    y = np.sin(2 * x) + rng.normal(scale=0.8, size=n)
+    train = {"x": x, "noise": noise, "y": y}
+    xt = rng.normal(size=n)
+    test = {
+        "x": xt,
+        "noise": rng.normal(size=n),
+        "y": np.sin(2 * xt) + rng.normal(scale=0.8, size=n),
+    }
+
+    unpruned = ydf.CartLearner(
+        label="y", task=Task.REGRESSION, max_depth=10, min_examples=2,
+        validation_ratio=0.0,
+    ).train(train)
+    pruned = ydf.CartLearner(
+        label="y", task=Task.REGRESSION, max_depth=10, min_examples=2,
+        validation_ratio=0.15,
+    ).train(train)
+
+    assert pruned.extra_metadata["num_pruned_nodes"] > 0
+    rmse_unpruned = unpruned.evaluate(test).rmse
+    rmse_pruned = pruned.evaluate(test).rmse
+    assert rmse_pruned <= rmse_unpruned + 0.01
+
+
+def test_cart_pruned_model_roundtrips(tmp_path):
+    train = _noisy_classification(1200, seed=3)
+    m = ydf.CartLearner(
+        label="y", max_depth=8, min_examples=2, validation_ratio=0.2,
+    ).train(train)
+    m.save(str(tmp_path / "cart"))
+    m2 = ydf.load_model(str(tmp_path / "cart"))
+    np.testing.assert_array_equal(m.predict(train), m2.predict(train))
+    assert m2.self_evaluation()["source"] == "cart_validation"
+
+
+def test_cart_rare_class_only_in_holdout():
+    """The label dictionary must come from the FULL dataset: a class whose
+    few rows all land in the pruning holdout used to crash encoded_label
+    mid-training (seed-dependent)."""
+    rng = np.random.RandomState(0)
+    n = 200
+    x = rng.normal(size=n)
+    y = (x > 0).astype(np.int64)
+    y[rng.randint(0, n)] = 2  # a single row of a third class
+    for seed in range(5):
+        m = ydf.CartLearner(
+            label="y", max_depth=4, validation_ratio=0.3, random_seed=seed
+        ).train({"x": x, "y": y})
+        assert len(m.classes) == 3
+
+
+def test_cart_adult_accuracy():
+    """Pruned CART in the reference's accuracy neighborhood on adult
+    (reference cart_test.cc expects ~0.853 OOB accuracy)."""
+    D = "/root/reference/yggdrasil_decision_forests/test_data/dataset"
+    m = ydf.CartLearner(label="income").train(f"csv:{D}/adult_train.csv")
+    acc = m.evaluate(f"csv:{D}/adult_test.csv").accuracy
+    assert acc > 0.82, acc
+    assert m.extra_metadata["num_pruned_nodes"] > 0

@@ -1,0 +1,77 @@
+"""RF out-of-bag evaluation + OOB permutation importances (reference
+random_forest.cc:544-590 / UpdateOOBPredictionsWithNewTree:1082 /
+ComputeVariableImportancesFromAccumulatedPredictions:1240)."""
+
+import numpy as np
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+
+
+def _cls_data(n, seed):
+    rng = np.random.RandomState(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    noise = rng.normal(size=n)
+    y = (x1 + 0.6 * x2 + rng.normal(scale=0.8, size=n) > 0).astype(np.int64)
+    return {"x1": x1, "x2": x2, "noise": noise, "y": y}
+
+
+def test_oob_evaluation_tracks_test_accuracy():
+    train = _cls_data(2500, seed=0)
+    test = _cls_data(2500, seed=1)
+    m = ydf.RandomForestLearner(label="y", num_trees=40, max_depth=8).train(
+        train
+    )
+    ev = m.self_evaluation()
+    assert ev is not None and ev["source"] == "oob"
+    assert ev["num_examples"] > 2000  # nearly every row is OOB somewhere
+    oob_acc = ev["metrics"]["accuracy"]
+    test_acc = m.evaluate(test).accuracy
+    # OOB is an unbiased estimate of held-out accuracy.
+    assert abs(oob_acc - test_acc) < 0.04, (oob_acc, test_acc)
+
+
+def test_oob_regression():
+    rng = np.random.RandomState(2)
+    n = 2000
+    x = rng.normal(size=n)
+    y = np.sin(2 * x) + rng.normal(scale=0.4, size=n)
+    m = ydf.RandomForestLearner(
+        label="y", task=Task.REGRESSION, num_trees=40, max_depth=8
+    ).train({"x": x, "y": y})
+    ev = m.self_evaluation()
+    assert ev is not None
+    assert 0.3 < ev["metrics"]["rmse"] < 0.8
+
+
+def test_oob_permutation_importances_rank_features():
+    train = _cls_data(2000, seed=3)
+    m = ydf.RandomForestLearner(
+        label="y", num_trees=40, max_depth=8,
+        compute_oob_variable_importances=True,
+    ).train(train)
+    vi = m.oob_variable_importances["MEAN_DECREASE_IN_ACCURACY"]
+    by_name = {d["feature"]: d["importance"] for d in vi}
+    # The informative feature dominates; the pure-noise one is ~0.
+    assert by_name["x1"] > by_name["noise"] + 0.02
+    assert by_name["x1"] > 0.05
+    assert abs(by_name["noise"]) < 0.02
+    # analyze() surfaces the OOB importances.
+    rep = m.analyze(train, max_rows=500)
+    assert "MEAN_DECREASE_IN_ACCURACY" in rep.variable_importances()
+
+
+def test_oob_disabled_without_bootstrap_and_roundtrip(tmp_path):
+    train = _cls_data(800, seed=4)
+    no_boot = ydf.RandomForestLearner(
+        label="y", num_trees=5, bootstrap_training_dataset=False
+    ).train(train)
+    assert no_boot.self_evaluation() is None
+
+    m = ydf.RandomForestLearner(label="y", num_trees=10, max_depth=6).train(
+        train
+    )
+    m.save(str(tmp_path / "rf"))
+    m2 = ydf.load_model(str(tmp_path / "rf"))
+    assert m2.self_evaluation() == m.self_evaluation()

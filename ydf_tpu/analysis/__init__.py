@@ -1,4 +1,7 @@
-from ydf_tpu.analysis.partial_dependence import partial_dependence
+from ydf_tpu.analysis.partial_dependence import (
+    conditional_expectation,
+    partial_dependence,
+)
 from ydf_tpu.analysis.importance import (
     permutation_importance,
     structure_importances,
@@ -7,6 +10,7 @@ from ydf_tpu.analysis.shap_values import tree_shap
 from ydf_tpu.analysis.analysis import Analysis, analyze
 
 __all__ = [
+    "conditional_expectation",
     "partial_dependence",
     "permutation_importance",
     "structure_importances",

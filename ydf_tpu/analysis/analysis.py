@@ -18,7 +18,10 @@ from ydf_tpu.analysis.importance import (
     permutation_importance,
     structure_importances,
 )
-from ydf_tpu.analysis.partial_dependence import partial_dependence
+from ydf_tpu.analysis.partial_dependence import (
+    conditional_expectation,
+    partial_dependence,
+)
 
 
 @dataclasses.dataclass
@@ -28,6 +31,11 @@ class Analysis:
     permutation_importances: List[Dict]
     structure_importances: Dict[str, List[Dict]]
     partial_dependences: List[Dict]
+    # Conditional Expectation Plots (reference
+    # partial_dependence_plot.h:57-74) for the same top features.
+    conditional_expectations: List[Dict] = dataclasses.field(
+        default_factory=list
+    )
 
     def variable_importances(self) -> Dict[str, List[Dict]]:
         out = dict(self.structure_importances)
@@ -93,16 +101,32 @@ def analyze(
         seed=seed,
     )
     struct = structure_importances(model)
+    # RF models trained with compute_oob_variable_importances carry
+    # precomputed OOB permutation importances (random_forest.cc:981).
+    oob_vi = getattr(model, "oob_variable_importances", None)
+    if oob_vi:
+        struct = {**struct, **oob_vi}
     top = [d["feature"] for d in perm[:num_pdp_features]]
     pdps = [
         partial_dependence(model, data, f, max_rows=min(max_rows, 1000),
                            seed=seed)
         for f in top
     ]
+    ceps = []
+    from ydf_tpu.config import Task
+
+    if model.task in (Task.CLASSIFICATION, Task.REGRESSION):
+        ceps = [
+            conditional_expectation(
+                model, data, f, max_rows=min(max_rows, 1000), seed=seed
+            )
+            for f in top
+        ]
     return Analysis(
         model_type=model.model_type,
         task=model.task.value,
         permutation_importances=perm,
         structure_importances=struct,
         partial_dependences=pdps,
+        conditional_expectations=ceps,
     )

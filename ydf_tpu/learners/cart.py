@@ -1,16 +1,33 @@
-"""CART learner: a single decision tree.
+"""CART learner: a single decision tree with validation-set pruning.
 
 Counterpart of `ydf/learner/cart/cart.cc`: one tree, no bagging, all
-attributes considered per node. Like the reference, the produced model is a
-single-tree Random Forest model (the reference's CART also returns a
-RandomForestModel). Validation-set pruning (`cart.cc:307-389`) is not yet
-implemented — the tree is grown with the same gain/min_examples stopping
-rules. TODO(round 2): reduced-error pruning on the flattened arrays.
+attributes considered per node; like the reference, the produced model is a
+single-tree Random Forest model. A validation fraction (default 10%, the
+reference's `validation_ratio`) is held out, and the grown tree is pruned
+bottom-up: an internal node becomes a leaf whenever that does not degrade
+the validation score — weighted accuracy for classification, -MSE for
+regression (`cart.cc:307-455` PruneNode / PruneTreeClassification /
+PruneTreeRegression). The validation evaluation is stored in the model's
+OOB-evaluation field, as the reference does (`cart.cc:352-358`).
+
+TPU shape of the computation: the reference prunes with a recursive
+example-partitioning DFS; here validation examples are routed on device in
+one batched pass (leaf ids for all rows at once), per-node aggregates come
+from a numpy scatter-add over leaves plus ONE bottom-up sweep — children
+always have larger node ids than their parent (BFS allocation order,
+ops/grower.py) — and the prune decision is a linear host pass over the
+node arrays. Uplift trees are trained but not pruned yet
+(PruneTreeUpliftCategorical has no counterpart here).
 """
 
 from __future__ import annotations
 
+from typing import Optional
+
+import numpy as np
+
 from ydf_tpu.config import Task
+from ydf_tpu.dataset.dataset import Dataset, InputData
 from ydf_tpu.learners.random_forest import RandomForestLearner
 
 
@@ -21,6 +38,7 @@ class CartLearner(RandomForestLearner):
         task: Task = Task.CLASSIFICATION,
         max_depth: int = 16,
         min_examples: int = 5,
+        validation_ratio: float = 0.1,
         **kwargs,
     ):
         kwargs.setdefault("num_trees", 1)
@@ -31,3 +49,177 @@ class CartLearner(RandomForestLearner):
             label=label, task=task, max_depth=max_depth,
             min_examples=min_examples, **kwargs,
         )
+        self.validation_ratio = validation_ratio
+
+    def train(self, data: InputData, valid: Optional[InputData] = None):
+        prunable = self.task in (Task.CLASSIFICATION, Task.REGRESSION)
+        if not prunable or (valid is None and self.validation_ratio <= 0):
+            return super().train(data)
+
+        # Infer the dataspec on the FULL data first (the reference receives
+        # a dataset whose spec predates its internal split, cart.cc:255) —
+        # otherwise a class or category occurring only in held-out rows
+        # would be missing from the training dictionary.
+        full = self._prepare(data)["dataset"]
+        if valid is None:
+            cols = full.data
+            n = full.num_rows
+            rng = np.random.RandomState(self.random_seed)
+            mask = rng.uniform(size=n) < self.validation_ratio
+            if not mask.any() or mask.all():
+                return super().train(data)
+            train_part = {k: v[~mask] for k, v in cols.items()}
+            valid_part = {k: v[mask] for k, v in cols.items()}
+        else:
+            train_part, valid_part = data, valid
+
+        self._forced_dataspec = full.dataspec
+        try:
+            model = super().train(train_part)
+        finally:
+            del self._forced_dataspec
+        num_pruned = prune_single_tree(
+            model, valid_part, weights_col=self.weights, task=self.task
+        )
+        model.extra_metadata["num_pruned_nodes"] = num_pruned
+        ev = model.evaluate(valid_part, weights=self.weights)
+        model.oob_evaluation = {
+            "source": "cart_validation",
+            "num_examples": ev.num_examples,
+            "metrics": {k: float(v) for k, v in ev.metrics.items()},
+        }
+        return model
+
+
+def prune_single_tree(model, valid_data, *, weights_col, task) -> int:
+    """Reduced-error pruning of tree 0 of `model.forest`, in place on the
+    model. Returns the number of pruned nodes (reference
+    set_num_pruned_nodes, cart.cc:305)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ydf_tpu.ops.routing import route_tree_values
+
+    forest = model.forest
+    ds = Dataset.from_data(valid_data, dataspec=model.dataspec)
+    x_num, x_cat = model._encode_inputs(ds)
+    tree0 = jax.tree.map(lambda a: a[0], forest)
+    leaves = np.asarray(
+        route_tree_values(
+            tree0,
+            jnp.asarray(x_num),
+            jnp.asarray(x_cat),
+            model.binner.num_numerical,
+            model.max_depth,
+        )
+    )
+    nv = leaves.shape[0]
+    w = (
+        ds.data[weights_col].astype(np.float64)
+        if weights_col
+        else np.ones((nv,), np.float64)
+    )
+
+    feature = np.asarray(forest.feature[0])
+    left = np.asarray(forest.left[0])
+    right = np.asarray(forest.right[0])
+    is_leaf = np.asarray(forest.is_leaf[0])
+    lv = np.asarray(forest.leaf_value[0])  # [N, V]
+    N = feature.shape[0]
+
+    # ---- per-node validation score when predicting this node's value ---- #
+    if task == Task.CLASSIFICATION:
+        y = ds.encoded_label(model.label, Task.CLASSIFICATION)
+        C = lv.shape[1]
+        hist = np.zeros((N, C), np.float64)
+        np.add.at(hist, (leaves, y), w)
+        agg = hist
+        pred = lv.argmax(axis=1)
+        # Weighted correct count — same denominator as-leaf vs as-subtree,
+        # so comparing counts is comparing the reference's accuracies.
+        score_of = lambda a: a[np.arange(N), pred]
+    else:
+        y = np.asarray(ds.encoded_label(model.label, Task.REGRESSION), np.float64)
+        agg = np.zeros((N, 3), np.float64)
+        np.add.at(agg, leaves, np.stack([w, w * y, w * y * y], axis=1))
+        mean = lv[:, 0].astype(np.float64)
+        # -SSE with the node's training mean as the prediction.
+        score_of = lambda a: -(
+            a[:, 2] - 2.0 * mean * a[:, 1] + np.square(mean) * a[:, 0]
+        )
+
+    # Bottom-up accumulation: examples land on leaves; children have larger
+    # ids than their parent, so one reverse pass fills internal nodes.
+    for v in range(N - 1, -1, -1):
+        if not is_leaf[v]:
+            agg[v] += agg[left[v]] + agg[right[v]]
+    score_leaf = score_of(agg)
+
+    # ---- bottom-up prune decision (reference PruneNode, cart.cc:368) ---- #
+    # A node with no validation examples scores 0 both ways and is pruned —
+    # the reference's 0/0 accuracy comparison does the same.
+    new_is_leaf = is_leaf.copy()
+    subtree = score_leaf.copy()
+    for v in range(N - 1, -1, -1):
+        if is_leaf[v]:
+            continue
+        as_subtree = subtree[left[v]] + subtree[right[v]]
+        if score_leaf[v] >= as_subtree:
+            new_is_leaf[v] = True
+        else:
+            subtree[v] = as_subtree
+
+    old_count = int(np.asarray(forest.num_nodes)[0])
+    if np.array_equal(new_is_leaf, is_leaf):
+        return 0
+
+    # ---- compact: BFS renumber the reachable nodes ---------------------- #
+    order = []
+    mapping = np.zeros((N,), np.int64)
+    queue = [0]
+    while queue:
+        v = queue.pop(0)
+        mapping[v] = len(order)
+        order.append(v)
+        if not new_is_leaf[v]:
+            queue.append(int(left[v]))
+            queue.append(int(right[v]))
+    order = np.asarray(order)
+    M = order.shape[0]
+
+    def remap(old, fill, transform=None):
+        vals = old[order]
+        if transform is not None:
+            vals = transform(vals)
+        new = np.full_like(old, fill)
+        new[:M] = vals
+        return new
+
+    kept_leaf = new_is_leaf[order]
+    new_forest = forest._replace(
+        feature=jnp.asarray(
+            remap(feature, -1, lambda v: np.where(kept_leaf, -1, v))[None]
+        ),
+        threshold=jnp.asarray(remap(np.asarray(forest.threshold[0]), 0.0)[None]),
+        threshold_bin=jnp.asarray(remap(np.asarray(forest.threshold_bin[0]), 0)[None]),
+        is_cat=jnp.asarray(
+            remap(np.asarray(forest.is_cat[0]), False, lambda v: v & ~kept_leaf)[None]
+        ),
+        cat_mask=jnp.asarray(
+            remap(np.asarray(forest.cat_mask[0]), 0)[None]
+        ),
+        left=jnp.asarray(
+            remap(left, 0, lambda v: np.where(kept_leaf, 0, mapping[v]))[None]
+        ),
+        right=jnp.asarray(
+            remap(right, 0, lambda v: np.where(kept_leaf, 0, mapping[v]))[None]
+        ),
+        is_leaf=jnp.asarray(remap(new_is_leaf, True)[None]),
+        na_left=jnp.asarray(remap(np.asarray(forest.na_left[0]), False)[None]),
+        leaf_value=jnp.asarray(remap(lv, 0.0)[None]),
+        cover=jnp.asarray(remap(np.asarray(forest.cover[0]), 0.0)[None]),
+        num_nodes=jnp.asarray([M], np.int32),
+    )
+    model.forest = new_forest
+    model._qs_cache = {}
+    return old_count - M

@@ -43,6 +43,22 @@ from ydf_tpu.ops.routing import route_tree_bins
 from ydf_tpu.ops.split_rules import HessianGainRule
 
 
+def _bool_column(values: np.ndarray) -> np.ndarray:
+    """Boolean event indicator from a raw column (bool/int/float/strings).
+    Missing values (NaN) are an error — silently treating them as observed
+    events would corrupt Cox gradients and the C-index."""
+    v = np.asarray(values)
+    if v.dtype.kind in ("O", "U", "S"):
+        return np.isin(
+            np.char.lower(v.astype(str)), ("1", "true", "t", "yes", "y")
+        )
+    if v.dtype.kind == "f" and np.isnan(v).any():
+        raise ValueError(
+            "event-observed column contains missing values (NaN)"
+        )
+    return v.astype(bool)
+
+
 class GradientBoostedTreesLearner(GenericLearner):
     """API-compatible with the reference PYDF learner
     (`specialized_learners_pre_generated.py:1290`); hyperparameter names and
@@ -66,6 +82,9 @@ class GradientBoostedTreesLearner(GenericLearner):
         loss: str = "DEFAULT",
         ranking_group: Optional[str] = None,
         ndcg_truncation: int = 5,
+        ranking_max_group_size: int = 2048,
+        label_event_observed: Optional[str] = None,
+        label_entry_age: Optional[str] = None,
         max_frontier: int = 1024,
         sampling_method: str = "RANDOM",
         goss_alpha: float = 0.2,
@@ -106,6 +125,14 @@ class GradientBoostedTreesLearner(GenericLearner):
         self.loss = loss
         self.ranking_group = ranking_group
         self.ndcg_truncation = ndcg_truncation
+        # Cap on documents per query group in the dense [G, Gmax] layout;
+        # larger groups are truncated with a warning (build_group_rows).
+        self.ranking_max_group_size = ranking_max_group_size
+        # Survival analysis (reference train config label_event_observed /
+        # label_entry_age, Cox loss loss_imp_cox.cc): the label column is
+        # the departure age.
+        self.label_event_observed = label_event_observed
+        self.label_entry_age = label_entry_age
         self.max_frontier = max_frontier
         # Sampling per iteration (reference :1488-1522): RANDOM (stochastic
         # GBM via `subsample`), GOSS, or SELGB (ranking only).
@@ -204,6 +231,22 @@ class GradientBoostedTreesLearner(GenericLearner):
                 raise ValueError("Task.RANKING requires ranking_group=")
             group_values = np.asarray(prep["dataset"].data[self.ranking_group])
 
+        ev_all = en_all = None
+        if self.task == Task.SURVIVAL_ANALYSIS:
+            if self.label_event_observed is None:
+                raise ValueError(
+                    "Task.SURVIVAL_ANALYSIS requires label_event_observed="
+                )
+            if self.mesh is not None:
+                raise NotImplementedError("mesh-distributed survival training")
+            ev_all = _bool_column(
+                prep["dataset"].data[self.label_event_observed]
+            )
+            if self.label_entry_age is not None:
+                en_all = np.asarray(
+                    prep["dataset"].data[self.label_entry_age], np.float64
+                )
+
         # --- validation extraction (reference :1243): deterministic split
         # of the training set, unless an explicit valid dataset is given.
         # Ranking splits whole query groups, like the reference.
@@ -298,11 +341,46 @@ class GradientBoostedTreesLearner(GenericLearner):
             if self.task != Task.RANKING:
                 raise ValueError("LAMBDA_MART_NDCG requires task=Task.RANKING")
             loss_obj.ndcg_truncation = self.ndcg_truncation
-            rows_tr, _ = build_group_rows(tr_groups)
+            rows_tr, _ = build_group_rows(
+                tr_groups, max_group_size=self.ranking_max_group_size
+            )
             loss_obj.register_groups("train", len(y_tr), rows_tr)
             if bins_va.shape[0] > 0:
-                rows_va, _ = build_group_rows(va_groups)
+                rows_va, _ = build_group_rows(
+                    va_groups, max_group_size=self.ranking_max_group_size
+                )
                 loss_obj.register_groups("valid", len(y_va), rows_va)
+        from ydf_tpu.learners.survival_loss import CoxProportionalHazardLoss
+
+        if isinstance(loss_obj, CoxProportionalHazardLoss):
+            if self.task != Task.SURVIVAL_ANALYSIS:
+                raise ValueError(
+                    "COX_PROPORTIONAL_HAZARD requires "
+                    "task=Task.SURVIVAL_ANALYSIS"
+                )
+            if "valid_bins" in prep:
+                ev_tr, en_tr = ev_all, en_all
+                vds = prep["valid_dataset"]
+                ev_va = _bool_column(vds.data[self.label_event_observed])
+                en_va = (
+                    np.asarray(vds.data[self.label_entry_age], np.float64)
+                    if self.label_entry_age
+                    else None
+                )
+            elif bins_va.shape[0] > 0:
+                ev_tr = ev_all[tr_idx]
+                ev_va = ev_all[va_idx]
+                en_tr = None if en_all is None else en_all[tr_idx]
+                en_va = None if en_all is None else en_all[va_idx]
+            else:
+                ev_tr, en_tr, ev_va, en_va = ev_all, en_all, None, None
+            loss_obj.register_survival(
+                "train", np.asarray(y_tr), ev_tr, en_tr
+            )
+            if bins_va.shape[0] > 0:
+                loss_obj.register_survival(
+                    "valid", np.asarray(y_va), ev_va, en_va
+                )
         K = loss_obj.num_dims
         F = binner.num_features
         if self.num_candidate_attributes_ratio > 0:
@@ -512,16 +590,20 @@ class GradientBoostedTreesLearner(GenericLearner):
                 # (reference early_stopping.h:29-66).
                 "num_trees_trained": int(train_losses.shape[0]),
             },
-            extra_metadata=(
-                {
-                    "ranking_group": self.ranking_group,
-                    "ndcg_truncation": self.ndcg_truncation,
-                }
-                if self.ranking_group
-                else None
-            ),
+            extra_metadata=self._model_metadata(),
         )
         return model
+
+    def _model_metadata(self) -> Optional[dict]:
+        md = {}
+        if self.ranking_group:
+            md["ranking_group"] = self.ranking_group
+            md["ndcg_truncation"] = self.ndcg_truncation
+        if self.label_event_observed:
+            md["label_event_observed"] = self.label_event_observed
+            if self.label_entry_age:
+                md["label_entry_age"] = self.label_entry_age
+        return md or None
 
 
 @functools.lru_cache(maxsize=16)

@@ -64,6 +64,10 @@ class GenericLearner:
         ds = Dataset.from_data(
             data,
             label=self.label,
+            # A learner that pre-splits its input (CART's pruning holdout)
+            # pins the FULL dataset's dataspec here so the label dictionary
+            # covers classes that only occur in held-out rows.
+            dataspec=getattr(self, "_forced_dataspec", None),
             max_vocab_count=self.max_vocab_count,
             min_vocab_frequency=self.min_vocab_frequency,
             column_types=column_types,
@@ -75,6 +79,8 @@ class GenericLearner:
                 self.weights,
                 getattr(self, "ranking_group", None),
                 getattr(self, "uplift_treatment", None),
+                getattr(self, "label_event_observed", None),
+                getattr(self, "label_entry_age", None),
             } - {None}
             feature_names = [
                 c.name
@@ -104,7 +110,8 @@ class GenericLearner:
                 if self.task == Task.CATEGORICAL_UPLIFT
                 else self.task
             )
-            if self.task == Task.NUMERICAL_UPLIFT:
+            if self.task in (Task.NUMERICAL_UPLIFT, Task.SURVIVAL_ANALYSIS):
+                # Survival labels are departure ages — plain numericals.
                 label_task = Task.REGRESSION
             out["labels"] = ds.encoded_label(self.label, label_task)
             if label_task == Task.CLASSIFICATION:

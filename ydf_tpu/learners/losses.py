@@ -226,6 +226,8 @@ def make_loss(name: str, task, num_classes: int):
             name = "SQUARED_ERROR"
         elif task == Task.RANKING:
             name = "LAMBDA_MART_NDCG"
+        elif task == Task.SURVIVAL_ANALYSIS:
+            name = "COX_PROPORTIONAL_HAZARD"
         else:
             raise ValueError(f"No default GBT loss for task {task}")
     if name == "BINOMIAL_LOG_LIKELIHOOD":
@@ -248,6 +250,10 @@ def make_loss(name: str, task, num_classes: int):
         return MeanAverageError()
     if name == "BINARY_FOCAL_LOSS":
         return BinaryFocalLoss()
+    if name == "COX_PROPORTIONAL_HAZARD":
+        from ydf_tpu.learners.survival_loss import CoxProportionalHazardLoss
+
+        return CoxProportionalHazardLoss()
     raise ValueError(f"Unknown loss {name!r}")
 
 

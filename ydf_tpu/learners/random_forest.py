@@ -24,7 +24,7 @@ from ydf_tpu.dataset.dataset import InputData
 from ydf_tpu.learners.generic import GenericLearner
 from ydf_tpu.models.forest import forest_from_stacked_trees
 from ydf_tpu.models.rf_model import RandomForestModel
-from ydf_tpu.ops import grower
+from ydf_tpu.ops import grower, routing
 from ydf_tpu.ops.split_rules import (
     ClassificationRule,
     RegressionRule,
@@ -48,6 +48,8 @@ class RandomForestLearner(GenericLearner):
         num_candidate_attributes: int = 0,
         num_candidate_attributes_ratio: float = -1.0,
         winner_take_all: bool = True,
+        compute_oob_performances: bool = True,
+        compute_oob_variable_importances: bool = False,
         max_frontier: int = 1024,
         uplift_treatment: Optional[str] = None,
         honest: bool = False,
@@ -70,6 +72,12 @@ class RandomForestLearner(GenericLearner):
         self.num_candidate_attributes = num_candidate_attributes
         self.num_candidate_attributes_ratio = num_candidate_attributes_ratio
         self.winner_take_all = winner_take_all
+        # OOB evaluation / permutation importances (reference
+        # random_forest.proto compute_oob_performances — default true — and
+        # compute_oob_variable_importances; both require bootstrapping,
+        # random_forest.cc:566-571).
+        self.compute_oob_performances = compute_oob_performances
+        self.compute_oob_variable_importances = compute_oob_variable_importances
         self.max_frontier = max_frontier
         self.uplift_treatment = uplift_treatment
         # Honest trees (reference honest-split partitioning,
@@ -129,6 +137,10 @@ class RandomForestLearner(GenericLearner):
             bins = pmesh.shard_batch(self.mesh, bins_np)
             w_base = pmesh.shard_batch(self.mesh, w_np)
             prep["labels"] = pmesh.shard_batch(self.mesh, labels_np)
+            # OOB bookkeeping indexes labels and weights together — keep
+            # the padded row count consistent (pad rows carry zero weight,
+            # so they never enter the OOB accumulators).
+            prep["sample_weights"] = w_np
             n = bins.shape[0]
 
         if self.task in (Task.CATEGORICAL_UPLIFT, Task.NUMERICAL_UPLIFT):
@@ -200,7 +212,12 @@ class RandomForestLearner(GenericLearner):
         max_nodes = min(tree_cfg.max_nodes, 2 * n + 3)
         cand = self._candidate_features(F)
 
-        stacked, leaf_values = _train_rf(
+        oob_enabled = (
+            self.compute_oob_performances
+            and self.bootstrap_training_dataset
+            and self.task in (Task.CLASSIFICATION, Task.REGRESSION)
+        )
+        stacked, leaf_values, oob = _train_rf(
             bins, w_base,
             stats_fn=stats_fn, rule=rule, tree_cfg=tree_cfg,
             max_nodes=max_nodes, num_trees=self.num_trees,
@@ -211,12 +228,19 @@ class RandomForestLearner(GenericLearner):
             honest_ratio=(
                 self.honest_ratio_leaf_examples if self.honest else 0.0
             ),
+            winner_take_all=(
+                self.winner_take_all and self.task == Task.CLASSIFICATION
+            ),
+            compute_oob=oob_enabled,
+            oob_importances=(
+                oob_enabled and self.compute_oob_variable_importances
+            ),
         )
 
         forest = forest_from_stacked_trees(
             stacked, leaf_values, binner.boundaries
         )
-        return RandomForestModel(
+        model = RandomForestModel(
             task=self.task,
             label=self.label,
             classes=classes,
@@ -231,14 +255,96 @@ class RandomForestLearner(GenericLearner):
                 else None
             ),
         )
+        if oob is not None:
+            self._attach_oob(model, oob, prep, binner)
+        return model
+
+    def _attach_oob(self, model, oob, prep, binner):
+        """OOB evaluation + optional permutation importances from the
+        accumulated per-example OOB votes (reference
+        EvaluateOOBPredictions / ComputeVariableImportancesFrom-
+        AccumulatedPredictions, random_forest.cc:1147-1283)."""
+        from ydf_tpu.metrics import evaluate_predictions
+
+        labels = np.asarray(prep["labels"])
+        w_all = np.asarray(prep["sample_weights"])
+        cnt = np.asarray(oob["count"])
+        # Rows the mesh path padded in carry zero weight and zero count.
+        idx = cnt > 0
+
+        def finalize(sums):
+            sums = np.asarray(sums, np.float64)
+            if self.task == Task.CLASSIFICATION:
+                proba = sums[idx] / np.maximum(
+                    sums[idx].sum(axis=1, keepdims=True), 1e-12
+                )
+                return proba
+            return sums[idx, 0] / cnt[idx]
+
+        def oob_eval(sums):
+            return evaluate_predictions(
+                self.task,
+                labels[idx],
+                finalize(sums),
+                classes=prep.get("classes"),
+                weights=w_all[idx],
+            )
+
+        base = oob_eval(oob["sum"])
+        model.oob_evaluation = {
+            "source": "oob",
+            "num_examples": int(idx.sum()),
+            "num_trees": self.num_trees,
+            "metrics": {k: float(v) for k, v in base.metrics.items()},
+        }
+        if "sum_shuffled" not in oob:
+            return
+        # MEAN_DECREASE_IN_* / MEAN_INCREASE_IN_RMSE — the reference's
+        # ComputePermutationFeatureImportance naming (variable_importance.h).
+        decrease_acc, increase_rmse = [], []
+        for f, name in enumerate(binner.feature_names):
+            ev = oob_eval(oob["sum_shuffled"][f])
+            if self.task == Task.CLASSIFICATION:
+                decrease_acc.append(
+                    {
+                        "feature": name,
+                        "importance": float(base.accuracy - ev.accuracy),
+                    }
+                )
+            else:
+                increase_rmse.append(
+                    {
+                        "feature": name,
+                        "importance": float(ev.rmse - base.rmse),
+                    }
+                )
+        vi = {}
+        if decrease_acc:
+            decrease_acc.sort(key=lambda d: -d["importance"])
+            vi["MEAN_DECREASE_IN_ACCURACY"] = decrease_acc
+        if increase_rmse:
+            increase_rmse.sort(key=lambda d: -d["importance"])
+            vi["MEAN_INCREASE_IN_RMSE"] = increase_rmse
+        model.oob_variable_importances = vi
 
 
 def _train_rf(
     bins, w_base, *, stats_fn, rule, tree_cfg: TreeConfig, max_nodes,
     num_trees, bootstrap, candidate_features, num_numerical, seed,
-    honest_ratio=0.0,
+    honest_ratio=0.0, winner_take_all=False, compute_oob=False,
+    oob_importances=False,
 ):
-    n = bins.shape[0]
+    n, F = bins.shape
+    V = rule.num_outputs
+
+    def tree_vote(lv, leaves):
+        """Per-example vote of one tree (reference
+        AddClassificationLeafToAccumulator: winner-take-all → one-hot of
+        the top class, else the leaf distribution)."""
+        v = lv[leaves]  # [n, V]
+        if winner_take_all:
+            v = jax.nn.one_hot(jnp.argmax(v, axis=1), V, dtype=jnp.float32)
+        return v
 
     @jax.jit
     def run(bins, w_base):
@@ -246,9 +352,10 @@ def _train_rf(
             key = jax.random.fold_in(jax.random.PRNGKey(seed), t)
             k_boot, k_grow, k_honest = jax.random.split(key, 3)
             if bootstrap:
-                w = w_base * jax.random.poisson(
-                    k_boot, 1.0, (n,)
-                ).astype(jnp.float32)
+                draws = jax.random.poisson(k_boot, 1.0, (n,)).astype(
+                    jnp.float32
+                )
+                w = w_base * draws
             else:
                 w = w_base
             if honest_ratio > 0.0:
@@ -286,11 +393,65 @@ def _train_rf(
                 leaf_stats = jnp.where(use_est, seg, res.tree.leaf_stats)
                 tree = res.tree._replace(leaf_stats=leaf_stats)
                 lv = rule.leaf_value(leaf_stats, None)
-                return carry, (tree, lv)
-            lv = rule.leaf_value(res.tree.leaf_stats, None)
-            return carry, (res.tree, lv)
+            else:
+                tree = res.tree
+                lv = rule.leaf_value(res.tree.leaf_stats, None)
 
-        _, (trees, lvs) = jax.lax.scan(one_tree, 0, jnp.arange(num_trees))
-        return trees, lvs
+            if compute_oob:
+                # Out-of-bag accumulation (reference
+                # UpdateOOBPredictionsWithNewTree, random_forest.cc:1082):
+                # examples the bootstrap did NOT draw vote on this tree.
+                oob = (draws == 0.0) & (w_base > 0.0)
+                oob_f = oob.astype(jnp.float32)
+                oob_sum, oob_cnt, oob_shuf = carry
+                oob_sum = oob_sum + tree_vote(lv, res.leaf_id) * oob_f[:, None]
+                oob_cnt = oob_cnt + oob_f
+                if oob_importances:
+                    # Per-feature shuffled accumulators: the value of
+                    # feature f is taken from a random other row before
+                    # routing (reference GetLeafWithSwappedAttribute via a
+                    # per-tree permutation). One routed pass per feature,
+                    # vmapped.
+                    def shuffled_vote(f, k_f):
+                        perm = jax.random.permutation(k_f, n)
+                        col = bins[perm, f]
+                        b2 = jnp.where(
+                            jnp.arange(F)[None, :] == f, col[:, None], bins
+                        )
+                        leaves = routing.route_tree_bins(
+                            tree, b2, tree_cfg.max_depth
+                        )
+                        return tree_vote(lv, leaves)
 
-    return run(bins, w_base)
+                    k_shuf = jax.random.split(
+                        jax.random.fold_in(key, 3), F
+                    )
+                    votes = jax.vmap(shuffled_vote)(
+                        jnp.arange(F), k_shuf
+                    )  # [F, n, V]
+                    oob_shuf = oob_shuf + votes * oob_f[None, :, None]
+                carry = (oob_sum, oob_cnt, oob_shuf)
+            return carry, (tree, lv)
+
+        if compute_oob:
+            carry0 = (
+                jnp.zeros((n, V), jnp.float32),
+                jnp.zeros((n,), jnp.float32),
+                jnp.zeros(
+                    (F if oob_importances else 0, n, V), jnp.float32
+                ),
+            )
+        else:
+            carry0 = 0
+        carry, (trees, lvs) = jax.lax.scan(
+            one_tree, carry0, jnp.arange(num_trees)
+        )
+        return trees, lvs, carry
+
+    trees, lvs, carry = run(bins, w_base)
+    oob_out = None
+    if compute_oob:
+        oob_out = {"sum": carry[0], "count": carry[1]}
+        if oob_importances:
+            oob_out["sum_shuffled"] = carry[2]
+    return trees, lvs, oob_out

@@ -33,13 +33,27 @@ def build_group_rows(
     """Group column → dense row-index matrix [num_groups, G], padded with -1.
 
     Over-long groups are truncated to `max_group_size` (with the kept items
-    chosen in dataset order)."""
+    chosen in dataset order); truncation warns, because dropped documents
+    get zero gradient and leave NDCG — raise the learner's
+    `ranking_max_group_size` to keep them."""
     codes, _ = _factorize(group_values)
     order = np.argsort(codes, kind="stable")
     sorted_codes = codes[order]
     boundaries = np.flatnonzero(np.diff(sorted_codes)) + 1
     groups = np.split(order, boundaries)
-    G = min(max(len(g) for g in groups), max_group_size)
+    largest = max(len(g) for g in groups)
+    G = min(largest, max_group_size)
+    if largest > max_group_size:
+        import warnings
+
+        n_trunc = sum(1 for g in groups if len(g) > max_group_size)
+        warnings.warn(
+            f"{n_trunc} query group(s) exceed max_group_size="
+            f"{max_group_size} (largest: {largest}); excess documents are "
+            "dropped from training and NDCG. Raise ranking_max_group_size "
+            "to keep them.",
+            stacklevel=3,
+        )
     rows = np.full((len(groups), G), -1, np.int64)
     for gi, g in enumerate(groups):
         g = g[:G]

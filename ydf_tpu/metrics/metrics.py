@@ -193,6 +193,58 @@ def bootstrap_intervals(
     return out
 
 
+def mean_average_precision(labels, scores, groups, k: int = 5) -> float:
+    """Mean AP@k over query groups (reference ranking_ap.cc APCalculator:
+    relevant = label > 0.5; AP = mean over relevant ranks r<=k of
+    precision@r; groups with no relevant item in the top-k score 0)."""
+    labels = np.asarray(labels, dtype=np.float64)
+    scores = np.asarray(scores, dtype=np.float64)
+    groups = np.asarray(groups)
+    total, count = 0.0, 0
+    for gid in np.unique(groups):
+        m = groups == gid
+        rel = labels[m] > 0.5
+        order = np.argsort(-scores[m], kind="mergesort")
+        kk = min(k, len(order))
+        hits = rel[order[:kk]]
+        num_rel = np.cumsum(hits)
+        ap_terms = np.where(hits, num_rel / np.arange(1, kk + 1), 0.0)
+        total += float(ap_terms.sum() / num_rel[-1]) if num_rel[-1] > 0 else 0.0
+        count += 1
+    return float(total / max(count, 1))
+
+
+def concordance_index(
+    times, risk_scores, events, weights=None, max_pairs_rows: int = 8000,
+    seed: int = 7,
+) -> float:
+    """Harrell's C-index: among comparable pairs (i observed an event
+    before j's departure), the fraction where the higher-risk prediction
+    belongs to i (ties count half). Subsamples rows beyond
+    `max_pairs_rows` to bound the O(n²) pair matrix."""
+    times = np.asarray(times, np.float64)
+    risk = np.asarray(risk_scores, np.float64)
+    events = np.asarray(events).astype(bool)
+    n = len(times)
+    w = np.ones(n) if weights is None else np.asarray(weights, np.float64)
+    if n > max_pairs_rows:
+        idx = np.random.RandomState(seed).choice(n, max_pairs_rows, False)
+        times, risk, events, w = times[idx], risk[idx], events[idx], w[idx]
+        n = max_pairs_rows
+    num = den = 0.0
+    # Chunk the i axis so peak memory stays at chunk×n, not n².
+    chunk = max(1, (1 << 22) // max(n, 1))
+    for lo in range(0, n, chunk):
+        hi = min(lo + chunk, n)
+        comparable = events[lo:hi, None] & (times[lo:hi, None] < times[None, :])
+        pair_w = comparable * (w[lo:hi, None] * w[None, :])
+        conc = np.where(risk[lo:hi, None] > risk[None, :], 1.0, 0.0)
+        conc = np.where(risk[lo:hi, None] == risk[None, :], 0.5, conc)
+        num += float((pair_w * conc).sum())
+        den += float(pair_w.sum())
+    return float(num / den) if den > 0 else float("nan")
+
+
 def ndcg_at_k(labels, scores, groups, k: int = 5) -> float:
     """Mean NDCG@k over query groups with exponential gains
     (reference ranking_ndcg.cc: gain = 2^rel - 1)."""
@@ -263,6 +315,7 @@ def evaluate_predictions(
     num_bootstrap: int = 2000,
     seed: int = 1234,
     treatments: Optional[np.ndarray] = None,
+    events: Optional[np.ndarray] = None,
 ) -> Evaluation:
     from ydf_tpu.config import Task
 
@@ -348,11 +401,21 @@ def evaluate_predictions(
                 np.sum(ww * (labels[idx] - np.average(labels[idx], weights=ww)) ** 2)
                 / ww.sum()
             )
-            return {
+            out = {
                 "rmse": rmse,
                 "mae": mae,
                 "r2": 1.0 - (rmse**2 / var) if var > 0 else float("nan"),
             }
+            if np.all(labels[idx] >= 0):
+                # MSLE/RMSLE (reference metric.cc:1030: negative predictions
+                # clamp to 0; negative labels are an error — here the
+                # metrics are simply omitted).
+                lerr = np.log1p(np.maximum(preds1[idx], 0.0)) - np.log1p(
+                    labels[idx]
+                )
+                out["msle"] = float(np.sum(ww * lerr**2) / ww.sum())
+                out["rmsle"] = float(np.sqrt(out["msle"]))
+            return out
 
         metrics = reg_metrics(np.arange(n))
         cis = (
@@ -374,6 +437,9 @@ def evaluate_predictions(
         metrics = {
             key: ndcg_at_k(labels, preds1, groups, ndcg_truncation),
             "mrr": mrr(labels, preds1, groups),
+            f"map@{ndcg_truncation}": mean_average_precision(
+                labels, preds1, groups, ndcg_truncation
+            ),
         }
         cis = None
         if confidence_intervals:
@@ -412,6 +478,18 @@ def evaluate_predictions(
         return Evaluation(
             task=task.value, num_examples=n,
             metrics={"qini": r["qini"], "auuc": r["auuc"]},
+        )
+
+    if task == Task.SURVIVAL_ANALYSIS:
+        assert events is not None, "Survival evaluation needs event flags"
+        return Evaluation(
+            task=task.value,
+            num_examples=n,
+            metrics={
+                "concordance": concordance_index(
+                    labels, predictions.reshape(-1), events, w
+                )
+            },
         )
 
     if task == Task.ANOMALY_DETECTION:

@@ -366,6 +366,21 @@ class GenericModel:
                 weights=None if w is None else w[keep],
                 treatments=treatments,
             )
+        if self.task == Task.SURVIVAL_ANALYSIS:
+            from ydf_tpu.learners.gbt import _bool_column
+
+            ecol = self.extra_metadata.get("label_event_observed")
+            if not ecol:
+                raise ValueError(
+                    "Survival model lacks label_event_observed metadata"
+                )
+            return evaluate_predictions(
+                self.task,
+                np.asarray(ds.data[self.label], np.float64),
+                preds,
+                weights=w,
+                events=_bool_column(np.asarray(ds.data[ecol])),
+            )
         labels = ds.encoded_label(self.label, self.task)
         groups = None
         ndcg_truncation = 5

@@ -22,10 +22,20 @@ class RandomForestModel(GenericModel):
     model_type = "RANDOM_FOREST"
 
     def __init__(self, *, winner_take_all: bool = True, oob_evaluation=None,
-                 **kwargs):
+                 oob_variable_importances=None, **kwargs):
         super().__init__(**kwargs)
         self.winner_take_all = winner_take_all
         self.oob_evaluation = oob_evaluation
+        # {"MEAN_DECREASE_IN_ACCURACY": [{feature, importance}, ...], ...}
+        # (reference precomputed_variable_importances from OOB permutation,
+        # random_forest.cc:981).
+        self.oob_variable_importances = oob_variable_importances
+
+    def self_evaluation(self):
+        """Out-of-bag evaluation (RF) or held-out validation evaluation
+        (CART) — the reference's model.self_evaluation() /
+        out_of_bag_evaluations (random_forest.cc:544, cart.cc:352)."""
+        return self.oob_evaluation
 
     def predict(self, data) -> np.ndarray:
         if self.task == Task.CLASSIFICATION and self.winner_take_all:
@@ -54,6 +64,7 @@ class RandomForestModel(GenericModel):
         return {
             "winner_take_all": self.winner_take_all,
             "oob_evaluation": self.oob_evaluation,
+            "oob_variable_importances": self.oob_variable_importances,
         }
 
     @classmethod
@@ -61,5 +72,6 @@ class RandomForestModel(GenericModel):
         return cls(
             winner_take_all=specific.get("winner_take_all", True),
             oob_evaluation=specific.get("oob_evaluation"),
+            oob_variable_importances=specific.get("oob_variable_importances"),
             **common,
         )
