@@ -97,11 +97,10 @@ def test_cart_rare_class_only_in_holdout():
         assert len(m.classes) == 3
 
 
-def test_cart_adult_accuracy():
+def test_cart_adult_accuracy(adult_train, adult_test):
     """Pruned CART in the reference's accuracy neighborhood on adult
     (reference cart_test.cc expects ~0.853 OOB accuracy)."""
-    D = "/root/reference/yggdrasil_decision_forests/test_data/dataset"
-    m = ydf.CartLearner(label="income").train(f"csv:{D}/adult_train.csv")
-    acc = m.evaluate(f"csv:{D}/adult_test.csv").accuracy
+    m = ydf.CartLearner(label="income").train(adult_train)
+    acc = m.evaluate(adult_test).accuracy
     assert acc > 0.82, acc
     assert m.extra_metadata["num_pruned_nodes"] > 0

@@ -52,3 +52,87 @@ def test_json_roundtrip():
     spec2 = DataSpecification.from_json(spec.to_json())
     assert spec2.column_by_name("c").vocabulary == spec.column_by_name("c").vocabulary
     assert spec2.column_by_name("f").mean == spec.column_by_name("f").mean
+
+
+def test_discretized_numerical_boundaries():
+    """DISCRETIZED_NUMERICAL stores bin boundaries in the dataspec
+    (data_spec.proto:267); few uniques → lossless midpoints."""
+    col = infer_column(
+        "d", np.array([1.0, 2.0, 2.0, 4.0]),
+        force_type=ColumnType.DISCRETIZED_NUMERICAL,
+    )
+    assert col.discretized_boundaries == [1.5, 3.0]
+    # Many uniques → capped at max_bins-1 boundaries.
+    col2 = infer_column(
+        "d", np.linspace(0, 1, 1000),
+        force_type=ColumnType.DISCRETIZED_NUMERICAL,
+        discretized_max_bins=64,
+    )
+    assert len(col2.discretized_boundaries) <= 63
+
+
+def test_detect_numerical_as_discretized():
+    data = {"f": np.arange(100.0), "y": np.array([0, 1] * 50)}
+    spec = infer_dataspec(data, label="y", detect_numerical_as_discretized=True)
+    assert spec.column_by_name("f").type == ColumnType.DISCRETIZED_NUMERICAL
+    # The label is never discretized.
+    assert spec.column_by_name("y").type == ColumnType.NUMERICAL
+    # JSON roundtrip keeps boundaries.
+    spec2 = DataSpecification.from_json(spec.to_json())
+    assert (
+        spec2.column_by_name("f").discretized_boundaries
+        == spec.column_by_name("f").discretized_boundaries
+    )
+
+
+def test_hash_column():
+    from ydf_tpu.dataset.dataspec import fingerprint64
+    from ydf_tpu.dataset.dataset import Dataset
+
+    data = {"g": np.array(["q1", "q2", "q1"]), "f": np.arange(3.0)}
+    spec = infer_dataspec(data, column_types={"g": ColumnType.HASH})
+    assert spec.column_by_name("g").type == ColumnType.HASH
+    ds = Dataset(data, spec)
+    h = ds.encoded_hash("g")
+    assert h.dtype == np.uint64
+    assert h[0] == h[2] != h[1]
+    assert h[0] == fingerprint64("q1")
+
+
+def test_categorical_set_inference():
+    vals = np.array(
+        [["a", "b"], ["b"], ["a", "c"], [], ["b", "a"]], dtype=object
+    )
+    col = infer_column("s", vals, min_vocab_frequency=1)
+    assert col.type == ColumnType.CATEGORICAL_SET
+    assert col.vocabulary[0] == "<OOD>"
+    assert set(col.vocabulary[1:]) == {"a", "b", "c"}
+    # Most frequent first: a=3, b=3, c=1 (ties lexicographic).
+    assert col.vocabulary[1] == "a"
+
+
+def test_categorical_set_string_tokenization():
+    """Strings tokenize on the reference's default separators " ;,"."""
+    col = infer_column(
+        "s", np.array(["a b", "b;c", "a,b"], dtype=object),
+        force_type=ColumnType.CATEGORICAL_SET, min_vocab_frequency=1,
+    )
+    assert set(col.vocabulary[1:]) == {"a", "b", "c"}
+
+
+def test_categorical_set_multihot_encoding():
+    from ydf_tpu.dataset.dataset import Dataset
+
+    train = np.array([["a", "b"], ["a"], ["b"]], dtype=object)
+    spec = infer_dataspec({"s": train}, min_vocab_frequency=1)
+    vals = np.array([["a", "b"], [], None, ["zzz"]], dtype=object)
+    ds = Dataset({"s": vals}, spec)
+    bits = ds.encoded_categorical_set("s", 1)
+    a = spec.column_by_name("s").vocabulary.index("a")
+    b = spec.column_by_name("s").vocabulary.index("b")
+    assert bits[0, 0] == (1 << a) | (1 << b)
+    assert bits[1, 0] == 0          # empty set
+    assert bits[2, 0] == 0          # missing -> empty
+    assert bits[3, 0] == 1          # unknown item -> OOV bit 0
+    miss = ds.categorical_set_missing_mask("s")
+    assert miss.tolist() == [False, False, True, False]

@@ -92,3 +92,44 @@ def test_export_ranking(tmp_path):
         num_trees=6,
     ).train(tr)
     _roundtrip(m, tr, tmp_path)
+
+
+def test_export_discretized(adult_train, adult_test, tmp_path):
+    """discretize_numerical_columns trains on dataspec-stored boundaries
+    (data_spec.proto:267) and exports DiscretizedHigher conditions
+    (decision_tree.proto:110-113) that round-trip exactly."""
+    m = ydf.GradientBoostedTreesLearner(
+        label="income", num_trees=8, max_depth=4,
+        discretize_numerical_columns=True,
+        validation_ratio=0.0, early_stopping="NONE",
+    ).train(adult_train.head(3000))
+    from ydf_tpu.dataset.dataspec import ColumnType
+    assert (
+        m.dataspec.column_by_name("age").type
+        == ColumnType.DISCRETIZED_NUMERICAL
+    )
+    m2 = _roundtrip(m, adult_test.head(1500), tmp_path)
+    assert (
+        m2.dataspec.column_by_name("age").type
+        == ColumnType.DISCRETIZED_NUMERICAL
+    )
+    # Discretized training should cost little accuracy vs plain numerical.
+    assert m.evaluate(adult_test).accuracy > 0.80
+
+
+def test_export_ranking_hash_group(tmp_path):
+    rng = np.random.RandomState(7)
+    n = 800
+    data = {
+        "f0": rng.normal(size=n).astype(np.float32),
+        "f1": rng.normal(size=n).astype(np.float32),
+        "rel": rng.randint(0, 5, size=n).astype(np.float32),
+        "q": np.array([f"query-{i % 40}" for i in range(n)]),
+    }
+    m = ydf.GradientBoostedTreesLearner(
+        label="rel", task=Task.RANKING, ranking_group="q",
+        num_trees=5, validation_ratio=0.0, early_stopping="NONE",
+    ).train(data)
+    from ydf_tpu.dataset.dataspec import ColumnType
+    assert m.dataspec.column_by_name("q").type == ColumnType.HASH
+    _roundtrip(m, data, tmp_path)

@@ -112,17 +112,31 @@ class Binner:
 
         for i, name in enumerate(numericals):
             col = spec.column_by_name(name)
-            vals = dataset.encoded_numerical(name)
-            uniq = np.unique(vals)
-            if len(uniq) <= max_boundaries:
-                b = ((uniq[:-1] + uniq[1:]) / 2).astype(np.float32)
+            if (
+                col.type == ColumnType.DISCRETIZED_NUMERICAL
+                and col.discretized_boundaries is not None
+            ):
+                # First-class DISCRETIZED_NUMERICAL: the dataspec's stored
+                # boundaries ARE the training bins (data_spec.proto:267),
+                # so trained cuts map 1:1 onto DiscretizedHigher conditions
+                # at export. Dataspec boundaries beyond the bin budget are
+                # subsampled evenly (keeps coverage of the value range).
+                b = np.asarray(col.discretized_boundaries, np.float32)
+                if len(b) > max_boundaries:
+                    idx = np.linspace(0, len(b) - 1, max_boundaries)
+                    b = b[np.round(idx).astype(int)]
             else:
-                qs = np.quantile(
-                    vals.astype(np.float64),
-                    np.linspace(0, 1, num_bins + 1)[1:-1],
-                    method="linear",
-                )
-                b = np.unique(qs).astype(np.float32)
+                vals = dataset.encoded_numerical(name)
+                uniq = np.unique(vals)
+                if len(uniq) <= max_boundaries:
+                    b = ((uniq[:-1] + uniq[1:]) / 2).astype(np.float32)
+                else:
+                    qs = np.quantile(
+                        vals.astype(np.float64),
+                        np.linspace(0, 1, num_bins + 1)[1:-1],
+                        method="linear",
+                    )
+                    b = np.unique(qs).astype(np.float32)
             boundaries[i, : len(b)] = b
             impute[i] = np.float32(col.mean)
             fnb[i] = len(b) + 1

@@ -85,6 +85,8 @@ class Dataset:
         max_vocab_count: int = 2000,
         min_vocab_frequency: int = 5,
         column_types: Optional[Dict[str, ColumnType]] = None,
+        detect_numerical_as_discretized: bool = False,
+        discretized_max_bins: int = 255,
     ) -> "Dataset":
         if isinstance(data, Dataset):
             if dataspec is not None:
@@ -130,6 +132,8 @@ class Dataset:
                 max_vocab_count=max_vocab_count,
                 min_vocab_frequency=min_vocab_frequency,
                 column_types=column_types,
+                detect_numerical_as_discretized=detect_numerical_as_discretized,
+                discretized_max_bins=discretized_max_bins,
             )
         return Dataset(cols, dataspec)
 
@@ -183,6 +187,67 @@ class Dataset:
         return np.array(
             [missing_code if k == "" else lookup.get(k, 0) for k in keys],
             dtype=np.int32,
+        )
+
+    def encoded_hash(self, name: str) -> np.ndarray:
+        """uint64 stable hashes (fingerprint64); missing → 0.
+
+        HASH columns carry no dictionary (data_spec.proto:85) — they are
+        grouping keys (ranking queries), never split candidates."""
+        from ydf_tpu.dataset.dataspec import fingerprint64
+
+        raw = self.data[name]
+        if np.issubdtype(raw.dtype, np.number) and raw.dtype != np.bool_:
+            fv = raw.astype(np.float64)
+            keys = [
+                None if np.isnan(v)
+                else (str(int(v)) if float(v).is_integer() else str(v))
+                for v in fv
+            ]
+        else:
+            missing = _string_missing_mask(np.asarray(raw, dtype=object))
+            keys = [None if m else str(v) for v, m in zip(raw.tolist(), missing)]
+        return np.array(
+            [0 if k is None else fingerprint64(k) for k in keys],
+            dtype=np.uint64,
+        )
+
+    def encoded_categorical_set(
+        self, name: str, width_words: int
+    ) -> np.ndarray:
+        """Packed multi-hot membership, uint32 [n, width_words].
+
+        Bit v of row e is set iff example e's set contains vocabulary item v
+        (OOV items collapse onto bit 0; items beyond 32*width_words drop to
+        OOV). Missing rows are all-zero with bit pattern of an empty set —
+        our learners treat missing-as-empty (global imputation analogue);
+        imported models route missing by na_value using the separate
+        missing mask from `categorical_set_missing_mask`."""
+        from ydf_tpu.dataset.dataspec import tokenize_set_value
+
+        col = self.dataspec.column_by_name(name)
+        assert col.vocabulary is not None
+        lookup = {item: i for i, item in enumerate(col.vocabulary)}
+        out = np.zeros((len(self.data[name]), width_words), np.uint32)
+        cap = width_words * 32
+        for e, v in enumerate(self.data[name].tolist()):
+            items = tokenize_set_value(v)
+            if not items:
+                continue
+            for it in items:
+                idx = lookup.get(str(it), 0)
+                if idx >= cap:
+                    idx = 0
+                out[e, idx >> 5] |= np.uint32(1) << np.uint32(idx & 31)
+        return out
+
+    def categorical_set_missing_mask(self, name: str) -> np.ndarray:
+        """bool [n]: True where the set cell is missing (not merely empty)."""
+        from ydf_tpu.dataset.dataspec import tokenize_set_value
+
+        return np.array(
+            [tokenize_set_value(v) is None for v in self.data[name].tolist()],
+            dtype=bool,
         )
 
     def encoded_label(self, name: str, task) -> np.ndarray:

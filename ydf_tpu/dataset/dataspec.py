@@ -24,6 +24,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import math
+import re
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -46,6 +47,22 @@ class ColumnType(enum.Enum):
 OOV_ITEM = "<OOD>"
 
 
+def fingerprint64(s: str) -> int:
+    """Stable 64-bit FNV-1a hash of a string.
+
+    The reference hashes HASH columns with farmhash::Fingerprint64
+    (`ydf/dataset/data_spec.cc` HashColumnString); the exact hash function is
+    an implementation detail (hash values never cross the model boundary —
+    HASH columns carry no dictionary and no conditions are trained on them),
+    so this build uses FNV-1a: stable, documented, dependency-free.
+    """
+    h = 0xCBF29CE484222325
+    for b in s.encode("utf-8"):
+        h ^= b
+        h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
 @dataclasses.dataclass
 class Column:
     """Schema + statistics of one column."""
@@ -58,10 +75,14 @@ class Column:
     max_value: float = 0.0
     num_values: int = 0
     num_missing: int = 0
-    # --- categorical ---
+    # --- categorical / categorical-set ---
     # vocabulary[0] == OOV_ITEM always; items sorted by decreasing frequency.
     vocabulary: Optional[List[str]] = None
     vocab_counts: Optional[List[int]] = None
+    # --- discretized numerical ---
+    # Ascending bin boundaries (data_spec.proto:267 DiscretizedNumericalSpec):
+    # len(boundaries)+1 bins; value v lands in bin #{b : boundary_b <= v}.
+    discretized_boundaries: Optional[List[float]] = None
 
     @property
     def vocab_size(self) -> int:
@@ -126,8 +147,11 @@ class DataSpecification:
                     f" mean:{c.mean:.6g} min:{c.min_value:.6g} "
                     f"max:{c.max_value:.6g}"
                 )
-            elif c.type == ColumnType.CATEGORICAL:
+            elif c.type in (ColumnType.CATEGORICAL, ColumnType.CATEGORICAL_SET):
                 extra = f" vocab-size:{c.vocab_size}"
+            elif c.type == ColumnType.DISCRETIZED_NUMERICAL:
+                nb = len(c.discretized_boundaries or []) + 1
+                extra = f" mean:{c.mean:.6g} bins:{nb}"
             if c.num_missing:
                 extra += f" num-missing:{c.num_missing}"
             lines.append(f'  {i}: "{c.name}" {c.type.value}{extra}')
@@ -151,12 +175,33 @@ def _string_missing_mask(values: np.ndarray) -> np.ndarray:
     return out
 
 
+def _discretized_boundaries(
+    ok: np.ndarray, max_bins: int
+) -> List[float]:
+    """Bin boundaries of a DISCRETIZED_NUMERICAL column.
+
+    Reference semantics (data_spec.proto:267 DiscretizedNumericalSpec,
+    default maximum_num_bins=255): ≤ max_bins-1 boundaries; when the column
+    has few uniques, boundaries are midpoints between consecutive unique
+    values (lossless); otherwise quantile cut points (deduplicated).
+    """
+    uniq = np.unique(ok)
+    if len(uniq) <= max_bins:
+        b = (uniq[:-1] + uniq[1:]) / 2
+    else:
+        qs = np.quantile(ok, np.linspace(0, 1, max_bins + 1)[1:-1],
+                         method="linear")
+        b = np.unique(qs)
+    return [float(v) for v in b]
+
+
 def infer_column(
     name: str,
     values: np.ndarray,
     max_vocab_count: int = 2000,
     min_vocab_frequency: int = 5,
     force_type: Optional[ColumnType] = None,
+    discretized_max_bins: int = 255,
 ) -> Column:
     """Infers one column's type + stats.
 
@@ -176,6 +221,11 @@ def infer_column(
             ctype = ColumnType.BOOLEAN
         elif _is_numeric_dtype(values):
             ctype = ColumnType.NUMERICAL
+        elif values.dtype == object and len(values) and any(
+            isinstance(v, (list, tuple, np.ndarray, set, frozenset))
+            for v in values[: min(len(values), 100)].tolist()
+        ):
+            ctype = ColumnType.CATEGORICAL_SET
         else:
             ctype = ColumnType.CATEGORICAL
 
@@ -186,6 +236,9 @@ def infer_column(
         ok = fvals[~missing]
         if ok.size == 0:
             return Column(name=name, type=ctype, num_missing=int(missing.sum()))
+        boundaries = None
+        if ctype == ColumnType.DISCRETIZED_NUMERICAL:
+            boundaries = _discretized_boundaries(ok, discretized_max_bins)
         return Column(
             name=name,
             type=ctype,
@@ -194,6 +247,55 @@ def infer_column(
             max_value=float(ok.max()),
             num_values=int(ok.size),
             num_missing=int(missing.sum()),
+            discretized_boundaries=boundaries,
+        )
+
+    if ctype == ColumnType.HASH:
+        # HASH columns keep no dictionary and no stats beyond counts
+        # (data_spec.proto:85 — "cannot be used as input feature"; they
+        # serve as ranking-group keys). Values hash via fingerprint64.
+        missing = (
+            np.isnan(values.astype(np.float64))
+            if _is_numeric_dtype(values)
+            else _string_missing_mask(values)
+        )
+        return Column(
+            name=name,
+            type=ctype,
+            num_values=int(len(values) - missing.sum()),
+            num_missing=int(missing.sum()),
+        )
+
+    if ctype == ColumnType.CATEGORICAL_SET:
+        # Multi-valued categorical (data_spec.proto:67): each row is a
+        # list/set of items (or a tokenizable string). The dictionary is
+        # built over item occurrences with the same OOV / frequency-pruning
+        # rules as CATEGORICAL.
+        tokens: List[str] = []
+        num_missing = 0
+        for v in values.tolist():
+            items = tokenize_set_value(v)
+            if items is None:
+                num_missing += 1
+            else:
+                tokens.extend(items)
+        uniq, counts = np.unique(np.array(tokens, dtype=object).astype(str),
+                                 return_counts=True) if tokens else (
+            np.array([], dtype=str), np.array([], dtype=np.int64))
+        order = np.lexsort((uniq, -counts)) if len(uniq) else []
+        uniq, counts = uniq[order], counts[order]
+        keep = counts >= max(min_vocab_frequency, 1)
+        kept, kept_counts = uniq[keep], counts[keep]
+        if max_vocab_count > 0 and len(kept) > max_vocab_count:
+            kept, kept_counts = kept[:max_vocab_count], kept_counts[:max_vocab_count]
+        oov_count = int(counts.sum() - kept_counts.sum())
+        return Column(
+            name=name,
+            type=ctype,
+            vocabulary=[OOV_ITEM] + [str(x) for x in kept],
+            vocab_counts=[oov_count] + [int(c) for c in kept_counts],
+            num_values=int(len(values) - num_missing),
+            num_missing=num_missing,
         )
 
     if ctype == ColumnType.CATEGORICAL:
@@ -229,18 +331,47 @@ def infer_column(
     raise NotImplementedError(f"Column type {ctype} not yet supported")
 
 
+def tokenize_set_value(v: Any) -> Optional[List[str]]:
+    """One raw CATEGORICAL_SET cell → list of string items, None if missing.
+
+    Accepts list/tuple/ndarray/set of items, or a string tokenized on the
+    reference's default separators " ;," (data_spec.proto Tokenizer,
+    splitter=SEPARATOR, separator=" ;,"). An empty set is a valid value
+    (routes as "matches nothing"), distinct from missing.
+    """
+    if v is None or (isinstance(v, float) and math.isnan(v)):
+        return None
+    if isinstance(v, (list, tuple, set, frozenset)):
+        return [str(x) for x in v]
+    if isinstance(v, np.ndarray):
+        return [str(x) for x in v.tolist()]
+    if isinstance(v, str):
+        if v in _MISSING_STRINGS:
+            return None
+        out = [t for t in re.split(r"[ ;,]", v) if t]
+        return out
+    return [str(v)]
+
+
 def infer_dataspec(
     data: Dict[str, np.ndarray],
     label: Optional[str] = None,
     max_vocab_count: int = 2000,
     min_vocab_frequency: int = 5,
     column_types: Optional[Dict[str, ColumnType]] = None,
+    detect_numerical_as_discretized: bool = False,
+    discretized_max_bins: int = 255,
 ) -> DataSpecification:
     """Infers the dataspec of a columnar mapping name → 1-D array.
 
     The label column (if given) is inferred with `min_vocab_frequency=1` and
     no vocab cap so every class survives — the reference does the same by
     routing the label through a guide (`data_spec.proto:348-483`).
+
+    `detect_numerical_as_discretized` mirrors the reference guide option
+    `detect_numerical_as_discretized_numerical` (data_spec.proto:361):
+    numerical feature columns become DISCRETIZED_NUMERICAL with stored bin
+    boundaries (≤ discretized_max_bins bins).
     """
     column_types = column_types or {}
     cols = []
@@ -248,20 +379,29 @@ def infer_dataspec(
     for name, values in data.items():
         values = np.asarray(values)
         n = len(values)
+        force = column_types.get(name)
         if name == label:
             cols.append(
                 infer_column(
                     name, values, max_vocab_count=-1, min_vocab_frequency=1,
-                    force_type=column_types.get(name),
+                    force_type=force,
                 )
             )
         else:
+            if (
+                force is None
+                and detect_numerical_as_discretized
+                and values.dtype != np.bool_
+                and _is_numeric_dtype(values)
+            ):
+                force = ColumnType.DISCRETIZED_NUMERICAL
             cols.append(
                 infer_column(
                     name, values,
                     max_vocab_count=max_vocab_count,
                     min_vocab_frequency=min_vocab_frequency,
-                    force_type=column_types.get(name),
+                    force_type=force,
+                    discretized_max_bins=discretized_max_bins,
                 )
             )
     return DataSpecification(columns=cols, created_num_rows=n)

@@ -60,7 +60,23 @@ class CartLearner(RandomForestLearner):
         # a dataset whose spec predates its internal split, cart.cc:255) —
         # otherwise a class or category occurring only in held-out rows
         # would be missing from the training dictionary.
-        full = self._prepare(data)["dataset"]
+        # Dataset.from_data (not the full _prepare): only the dataspec and
+        # raw columns are needed here — binning/encoding happen once, on
+        # the train split, inside super().train().
+        from ydf_tpu.dataset.dataset import Dataset
+        from ydf_tpu.dataset.dataspec import ColumnType
+
+        column_types = dict(self.column_types)
+        if self.task == Task.CLASSIFICATION:
+            column_types[self.label] = ColumnType.CATEGORICAL
+        full = Dataset.from_data(
+            data, label=self.label,
+            max_vocab_count=self.max_vocab_count,
+            min_vocab_frequency=self.min_vocab_frequency,
+            column_types=column_types,
+            detect_numerical_as_discretized=self.discretize_numerical_columns,
+            discretized_max_bins=self.num_discretized_numerical_bins,
+        )
         if valid is None:
             cols = full.data
             n = full.num_rows

@@ -49,9 +49,17 @@ def _bool_column(values: np.ndarray) -> np.ndarray:
     events would corrupt Cox gradients and the C-index."""
     v = np.asarray(values)
     if v.dtype.kind in ("O", "U", "S"):
-        return np.isin(
-            np.char.lower(v.astype(str)), ("1", "true", "t", "yes", "y")
-        )
+        low = np.char.lower(v.astype(str))
+        truthy = np.isin(low, ("1", "true", "t", "yes", "y"))
+        falsy = np.isin(low, ("0", "false", "f", "no", "n"))
+        if not (truthy | falsy).all():
+            bad = v[~(truthy | falsy)][:3]
+            raise ValueError(
+                "event-observed column contains missing or unrecognized "
+                f"values (e.g. {bad.tolist()!r}); expected true/false "
+                "indicators"
+            )
+        return truthy
     if v.dtype.kind == "f" and np.isnan(v).any():
         raise ValueError(
             "event-observed column contains missing values (NaN)"

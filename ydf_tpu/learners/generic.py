@@ -30,6 +30,9 @@ class GenericLearner:
         min_vocab_frequency: int = 5,
         num_bins: int = 256,
         random_seed: int = 123456,
+        column_types: Optional[Dict[str, ColumnType]] = None,
+        discretize_numerical_columns: bool = False,
+        num_discretized_numerical_bins: int = 255,
     ):
         self.label = label
         self.task = task
@@ -39,6 +42,13 @@ class GenericLearner:
         self.min_vocab_frequency = min_vocab_frequency
         self.num_bins = num_bins
         self.random_seed = random_seed
+        # User-forced column types (reference: DataSpecificationGuide) and
+        # the PYDF discretize_numerical_columns / num_discretized_numerical_
+        # bins pair (data_spec.proto:361 detect_numerical_as_discretized_
+        # numerical).
+        self.column_types = dict(column_types) if column_types else {}
+        self.discretize_numerical_columns = discretize_numerical_columns
+        self.num_discretized_numerical_bins = num_discretized_numerical_bins
 
     # ------------------------------------------------------------------ #
 
@@ -46,7 +56,14 @@ class GenericLearner:
         self, data: InputData, valid: Optional[InputData] = None
     ) -> Dict:
         """Common ingestion: dataset, binning, encoded label/weights."""
-        column_types = {}
+        column_types = dict(self.column_types)
+        group_col = getattr(self, "ranking_group", None)
+        if group_col:
+            # Ranking query-group keys default to HASH columns (the
+            # reference's convention, data_spec.proto:85): no dictionary,
+            # never a split candidate; learners group on the raw values.
+            # An explicit user-supplied type wins.
+            column_types.setdefault(group_col, ColumnType.HASH)
         treat_col = getattr(self, "uplift_treatment", None)
         if treat_col:
             # Treatment groups are dictionary-encoded: index 1 = control
@@ -71,6 +88,8 @@ class GenericLearner:
             max_vocab_count=self.max_vocab_count,
             min_vocab_frequency=self.min_vocab_frequency,
             column_types=column_types,
+            detect_numerical_as_discretized=self.discretize_numerical_columns,
+            discretized_max_bins=self.num_discretized_numerical_bins,
         )
         feature_names = self.features
         if feature_names is None:

@@ -481,7 +481,10 @@ def evaluate_predictions(
         )
 
     if task == Task.SURVIVAL_ANALYSIS:
-        assert events is not None, "Survival evaluation needs event flags"
+        if events is None:
+            raise ValueError(
+                "Task.SURVIVAL_ANALYSIS evaluation requires events="
+            )
         return Evaluation(
             task=task.value,
             num_examples=n,

@@ -106,6 +106,7 @@ def _parse_column(msg: pw.Message) -> _YdfColumn:
     disc = pw.get_msg(msg, 8)  # discretized_numerical = 8 (:267-279)
     if disc is not None:
         disc_boundaries = pw.get_packed_floats(disc, 1)
+        col.discretized_boundaries = [float(v) for v in disc_boundaries]
 
     cat = pw.get_msg(msg, 6)  # categorical = 6 (CategoricalSpec, :150-208)
     if cat is not None:
@@ -764,14 +765,30 @@ def _encode_column(col: Column) -> bytes:
     """Column (data_spec.proto:88-126)."""
     type_code = {v: k for k, v in _COLTYPE.items()}[col.type]
     out = pw.put_int(1, type_code) + pw.put_str(2, col.name)
-    if col.type in (ColumnType.NUMERICAL, ColumnType.BOOLEAN):
+    if col.type in (
+        ColumnType.NUMERICAL,
+        ColumnType.BOOLEAN,
+        ColumnType.DISCRETIZED_NUMERICAL,
+    ):
         num = (
             pw.put_double(1, col.mean)
             + pw.put_float(2, col.min_value)
             + pw.put_float(3, col.max_value)
         )
         out += pw.put_msg(5, num)
-    if col.type == ColumnType.CATEGORICAL and col.vocabulary is not None:
+    if (
+        col.type == ColumnType.DISCRETIZED_NUMERICAL
+        and col.discretized_boundaries is not None
+    ):
+        # DiscretizedNumericalSpec (data_spec.proto:267): boundaries = 1,
+        # maximum_num_bins = 3.
+        disc = pw.put_packed_floats(1, col.discretized_boundaries)
+        disc += pw.put_int(3, len(col.discretized_boundaries) + 1)
+        out += pw.put_msg(8, disc)
+    if (
+        col.type in (ColumnType.CATEGORICAL, ColumnType.CATEGORICAL_SET)
+        and col.vocabulary is not None
+    ):
         items = b""
         counts = col.vocab_counts or [0] * col.vocab_size
         for idx, (key, cnt) in enumerate(zip(col.vocabulary, counts)):
@@ -830,6 +847,15 @@ def _encode_node(row: dict, leaf_payload: bytes,
         pos_bits = 1 - bits  # our mask is "goes left" = negative branch
         bitmap = np.packbits(pos_bits, bitorder="little").tobytes()
         cond_type = pw.put_msg(5, pw.put_bytes(1, bitmap))
+        attribute = row["col_idx"]
+    elif row.get("disc_boundaries") is not None:
+        # Split on a DISCRETIZED_NUMERICAL column → DiscretizedHigher
+        # (decision_tree.proto:110-113): disc_index >= threshold ⇔
+        # v >= boundaries[threshold-1] = our value-space threshold (binner
+        # boundaries are a subset of the dataspec's, so the lookup is exact).
+        b = np.asarray(row["disc_boundaries"], np.float32)
+        k = int(np.searchsorted(b, np.float32(row["threshold"]), side="left"))
+        cond_type = pw.put_msg(6, pw.put_int(1, k + 1))
         attribute = row["col_idx"]
     else:
         cond_type = pw.put_msg(2, pw.put_float(1, float(row["threshold"])))
@@ -995,6 +1021,8 @@ def export_ydf_model(model, path: str) -> None:
                 row["col_idx"] = col_index[name]
                 col = model.dataspec.column_by_name(name)
                 row["vocab_size"] = col.vocab_size
+                if col.type == ColumnType.DISCRETIZED_NUMERICAL:
+                    row["disc_boundaries"] = col.discretized_boundaries
             if row["feature"] >= F_total and "oblique_na_repl" in f_np:
                 row["obl_repl"] = f_np["oblique_na_repl"][
                     t, row["feature"] - F_total
