@@ -150,7 +150,7 @@ def hardware_extras(model, data, record):
 
         sample = {k: v[:4096] for k, v in data.items()}
         ds = Dataset.from_data(sample, dataspec=model.dataspec)
-        x_num, x_cat = model._encode_inputs(ds)
+        x_num, x_cat, _ = model._encode_inputs(ds)
         eng = model._fast_engine()
         if eng is None:
             record["quickscorer_extra_error"] = "engine unavailable on this backend"

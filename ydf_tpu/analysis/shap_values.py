@@ -89,8 +89,20 @@ def _unwind(p: _Path, i: int) -> None:
 
 
 def _go_left(tree, nid: int, x_num, x_cat, num_numerical: int,
-             na_left) -> bool:
+             na_left, x_set=None, set_missing=None) -> bool:
     f = int(tree["feature"][nid])
+    if tree["is_set"][nid]:
+        # Contains condition: set ∩ selected-items mask ≠ ∅ → RIGHT.
+        Fs = x_set.shape[0] if x_set is not None else 0
+        fs = f - (len(x_num) + len(x_cat))
+        if x_set is None or not (0 <= fs < Fs):
+            return True
+        if set_missing is not None and set_missing[fs]:
+            # Missing set cell → the node's stored na direction (matches
+            # _raw_scores' set_missing routing of imported models).
+            return bool(na_left[nid])
+        mask = tree["cat_mask"][nid][: x_set.shape[1]]
+        return not bool(np.any(x_set[fs] & mask))
     if tree["is_cat"][nid]:
         c = int(x_cat[f - num_numerical])
         if c < 0:
@@ -110,6 +122,8 @@ def _shap_one_tree(
     num_numerical: int,
     phi: np.ndarray,  # [F, V] accumulated in place
     scale: float,
+    x_set: np.ndarray = None,  # u32 [Fs, W] packed set features
+    set_missing: np.ndarray = None,  # bool [Fs]
 ) -> None:
     V = tree["leaf_value"].shape[-1]
     max_depth_cap = 128
@@ -126,7 +140,8 @@ def _shap_one_tree(
         f = int(tree["feature"][nid])
         left, right = int(tree["left"][nid]), int(tree["right"][nid])
         goes_left = _go_left(
-            tree, nid, x_num, x_cat, num_numerical, tree["na_left"]
+            tree, nid, x_num, x_cat, num_numerical, tree["na_left"],
+            x_set=x_set, set_missing=set_missing,
         )
         hot, cold = (left, right) if goes_left else (right, left)
         cover = max(float(tree["cover"][nid]), 1e-9)
@@ -169,7 +184,10 @@ def tree_shap(
         )
     ds = Dataset.from_data(data, dataspec=model.dataspec)
     ds, rows_used = ds.sample(max_rows, seed=seed)
-    x_num, x_cat = model._encode_inputs(ds)
+    x_num, x_cat, x_set = model._encode_inputs(ds)
+    set_missing = (
+        model._encode_set_missing(ds) if model.native_missing else None
+    )
     n = ds.num_rows
     Fn = model.binner.num_numerical
     F = model.binner.num_features
@@ -217,5 +235,11 @@ def tree_shap(
     for i in range(n):
         for t in range(T):
             out = phi[i, :, tree_dim[t] : tree_dim[t] + 1] if multi_gbt else phi[i]
-            _shap_one_tree(trees[t], x_num[i], x_cat[i], Fn, out, scale)
+            _shap_one_tree(
+                trees[t], x_num[i], x_cat[i], Fn, out, scale,
+                x_set=None if x_set is None else x_set[i],
+                set_missing=(
+                    None if set_missing is None else set_missing[i]
+                ),
+            )
     return phi, bias, rows_used

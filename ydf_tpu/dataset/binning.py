@@ -60,16 +60,34 @@ class Binner:
     # [F] imputation value for missing numericals (column mean).
     impute_values: np.ndarray
     # [F] number of "real" bins per feature (numerical: #boundaries+1,
-    # categorical: min(vocab_size, num_bins)).
+    # categorical: min(vocab_size, num_bins), set: capped vocab).
     feature_num_bins: np.ndarray
+    # Number of trailing CATEGORICAL_SET features. Layout is
+    # [numericals..., categoricals..., sets...]; set features are not part
+    # of the uint8 bin matrix — they encode as packed multi-hot uint32
+    # words (transform_sets), one fixed width for all set features.
+    num_set: int = 0
 
     @property
     def num_features(self) -> int:
         return len(self.feature_names)
 
     @property
+    def num_scalar(self) -> int:
+        """Features carried by the uint8 bin matrix (all but sets)."""
+        return self.num_features - self.num_set
+
+    @property
     def num_categorical(self) -> int:
-        return self.num_features - self.num_numerical
+        return self.num_features - self.num_numerical - self.num_set
+
+    @property
+    def set_width_words(self) -> int:
+        """uint32 words per set feature in the packed multi-hot encoding."""
+        if self.num_set == 0:
+            return 0
+        vmax = int(self.feature_num_bins[self.num_scalar:].max())
+        return (vmax + 31) // 32
 
     # ------------------------------------------------------------------ #
 
@@ -98,12 +116,18 @@ class Binner:
             f for f in features
             if spec.column_by_name(f).type == ColumnType.CATEGORICAL
         ]
-        unsupported = set(features) - set(numericals) - set(categoricals)
+        sets = [
+            f for f in features
+            if spec.column_by_name(f).type == ColumnType.CATEGORICAL_SET
+        ]
+        unsupported = (
+            set(features) - set(numericals) - set(categoricals) - set(sets)
+        )
         if unsupported:
             raise NotImplementedError(
                 f"Unsupported feature columns for binning: {sorted(unsupported)}"
             )
-        ordered = numericals + categoricals
+        ordered = numericals + categoricals + sets
         F = len(ordered)
         max_boundaries = num_bins - 1
         boundaries = np.full((F, max_boundaries), np.inf, dtype=np.float32)
@@ -145,6 +169,16 @@ class Binner:
             col = spec.column_by_name(name)
             fnb[len(numericals) + j] = min(col.vocab_size, num_bins)
 
+        for j, name in enumerate(sets):
+            # Set vocabularies are NOT capped at num_bins (text columns
+            # routinely carry 2k items; the dictionary is already pruned
+            # by max_vocab_count). The node mask widens to cover them;
+            # only candidate cut positions are bounded by num_bins.
+            col = spec.column_by_name(name)
+            fnb[len(numericals) + len(categoricals) + j] = max(
+                col.vocab_size, 1
+            )
+
         return Binner(
             feature_names=ordered,
             num_numerical=len(numericals),
@@ -152,15 +186,17 @@ class Binner:
             boundaries=boundaries,
             impute_values=impute,
             feature_num_bins=fnb,
+            num_set=len(sets),
         )
 
     # ------------------------------------------------------------------ #
 
     def transform(self, dataset: Dataset) -> np.ndarray:
-        """Returns the uint8 bin matrix [num_rows, num_features]."""
+        """Returns the uint8 bin matrix [num_rows, num_scalar] (set
+        features are packed separately by transform_sets)."""
         n = dataset.num_rows
-        out = np.zeros((n, self.num_features), dtype=np.uint8)
-        for i, name in enumerate(self.feature_names):
+        out = np.zeros((n, self.num_scalar), dtype=np.uint8)
+        for i, name in enumerate(self.feature_names[: self.num_scalar]):
             if i < self.num_numerical:
                 vals = dataset.encoded_numerical(name)
                 nb = int(self.feature_num_bins[i]) - 1
@@ -171,6 +207,18 @@ class Binner:
                 idx = dataset.encoded_categorical(name)
                 idx = np.where(idx >= self.num_bins, 0, idx)
                 out[:, i] = idx.astype(np.uint8)
+        return out
+
+    def transform_sets(self, dataset: Dataset) -> Optional[np.ndarray]:
+        """Packed multi-hot set features, uint32 [n, num_set, W]; None when
+        the binner has no set features."""
+        if self.num_set == 0:
+            return None
+        W = self.set_width_words
+        out = np.zeros((dataset.num_rows, self.num_set, W), np.uint32)
+        for j, name in enumerate(self.feature_names[self.num_scalar:]):
+            if dataset.dataspec.has_column(name) and name in dataset.data:
+                out[:, j, :] = dataset.encoded_categorical_set(name, W)
         return out
 
     def threshold_value(self, feature_index: int, threshold_bin: int) -> float:
@@ -186,6 +234,7 @@ class Binner:
             "boundaries": self.boundaries.tolist(),
             "impute_values": self.impute_values.tolist(),
             "feature_num_bins": self.feature_num_bins.tolist(),
+            "num_set": self.num_set,
         }
 
     @staticmethod
@@ -197,15 +246,17 @@ class Binner:
             boundaries=np.array(d["boundaries"], dtype=np.float32),
             impute_values=np.array(d["impute_values"], dtype=np.float32),
             feature_num_bins=np.array(d["feature_num_bins"], dtype=np.int32),
+            num_set=int(d.get("num_set", 0)),
         )
 
 
 @dataclasses.dataclass
 class BinnedDataset:
-    """A bin matrix + the Binner that produced it."""
+    """A bin matrix (+ packed set features) + the Binner that produced it."""
 
-    bins: np.ndarray  # uint8 [n, F]
+    bins: np.ndarray  # uint8 [n, num_scalar]
     binner: Binner
+    set_bits: Optional[np.ndarray] = None  # uint32 [n, num_set, W]
 
     @property
     def num_rows(self) -> int:
@@ -216,4 +267,8 @@ class BinnedDataset:
         dataset: Dataset, features: Sequence[str], num_bins: int = 256
     ) -> "BinnedDataset":
         binner = Binner.fit(dataset, features, num_bins=num_bins)
-        return BinnedDataset(bins=binner.transform(dataset), binner=binner)
+        return BinnedDataset(
+            bins=binner.transform(dataset),
+            binner=binner,
+            set_bits=binner.transform_sets(dataset),
+        )

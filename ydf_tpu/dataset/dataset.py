@@ -227,18 +227,36 @@ class Dataset:
 
         col = self.dataspec.column_by_name(name)
         assert col.vocabulary is not None
-        lookup = {item: i for i, item in enumerate(col.vocabulary)}
-        out = np.zeros((len(self.data[name]), width_words), np.uint32)
-        cap = width_words * 32
+        n = len(self.data[name])
+        # Tokenize (Python, unavoidable over object cells), then vectorize
+        # the vocabulary lookup + bit packing: sorted-vocab searchsorted and
+        # one bitwise_or.at scatter instead of a per-token dict loop.
+        rows: List[int] = []
+        tokens: List[str] = []
         for e, v in enumerate(self.data[name].tolist()):
             items = tokenize_set_value(v)
-            if not items:
-                continue
-            for it in items:
-                idx = lookup.get(str(it), 0)
-                if idx >= cap:
-                    idx = 0
-                out[e, idx >> 5] |= np.uint32(1) << np.uint32(idx & 31)
+            if items:
+                rows.extend([e] * len(items))
+                tokens.extend(items)
+        out = np.zeros((n, width_words), np.uint32)
+        if not tokens:
+            return out
+        vocab = np.asarray(col.vocabulary, dtype=object).astype(str)
+        order = np.argsort(vocab)
+        svocab = vocab[order]
+        tok = np.asarray(tokens, dtype=object).astype(str)
+        pos = np.searchsorted(svocab, tok)
+        pos = np.minimum(pos, len(svocab) - 1)
+        found = svocab[pos] == tok
+        idx = np.where(found, order[pos], 0)
+        idx = np.where(idx >= width_words * 32, 0, idx)
+        rows_arr = np.asarray(rows, np.int64)
+        flat = out.reshape(-1)
+        np.bitwise_or.at(
+            flat,
+            rows_arr * width_words + (idx >> 5),
+            (np.uint32(1) << (idx & 31).astype(np.uint32)),
+        )
         return out
 
     def categorical_set_missing_mask(self, name: str) -> np.ndarray:

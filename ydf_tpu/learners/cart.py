@@ -60,23 +60,10 @@ class CartLearner(RandomForestLearner):
         # a dataset whose spec predates its internal split, cart.cc:255) —
         # otherwise a class or category occurring only in held-out rows
         # would be missing from the training dictionary.
-        # Dataset.from_data (not the full _prepare): only the dataspec and
+        # _infer_dataset (not the full _prepare): only the dataspec and
         # raw columns are needed here — binning/encoding happen once, on
         # the train split, inside super().train().
-        from ydf_tpu.dataset.dataset import Dataset
-        from ydf_tpu.dataset.dataspec import ColumnType
-
-        column_types = dict(self.column_types)
-        if self.task == Task.CLASSIFICATION:
-            column_types[self.label] = ColumnType.CATEGORICAL
-        full = Dataset.from_data(
-            data, label=self.label,
-            max_vocab_count=self.max_vocab_count,
-            min_vocab_frequency=self.min_vocab_frequency,
-            column_types=column_types,
-            detect_numerical_as_discretized=self.discretize_numerical_columns,
-            discretized_max_bins=self.num_discretized_numerical_bins,
-        )
+        full = self._infer_dataset(data)
         if valid is None:
             cols = full.data
             n = full.num_rows
@@ -118,7 +105,7 @@ def prune_single_tree(model, valid_data, *, weights_col, task) -> int:
 
     forest = model.forest
     ds = Dataset.from_data(valid_data, dataspec=model.dataspec)
-    x_num, x_cat = model._encode_inputs(ds)
+    x_num, x_cat, x_set = model._encode_inputs(ds)
     tree0 = jax.tree.map(lambda a: a[0], forest)
     leaves = np.asarray(
         route_tree_values(
@@ -127,6 +114,7 @@ def prune_single_tree(model, valid_data, *, weights_col, task) -> int:
             jnp.asarray(x_cat),
             model.binner.num_numerical,
             model.max_depth,
+            x_set=None if x_set is None else jnp.asarray(x_set),
         )
     )
     nv = leaves.shape[0]
@@ -220,6 +208,9 @@ def prune_single_tree(model, valid_data, *, weights_col, task) -> int:
         threshold_bin=jnp.asarray(remap(np.asarray(forest.threshold_bin[0]), 0)[None]),
         is_cat=jnp.asarray(
             remap(np.asarray(forest.is_cat[0]), False, lambda v: v & ~kept_leaf)[None]
+        ),
+        is_set=jnp.asarray(
+            remap(np.asarray(forest.is_set[0]), False, lambda v: v & ~kept_leaf)[None]
         ),
         cat_mask=jnp.asarray(
             remap(np.asarray(forest.cat_mask[0]), 0)[None]

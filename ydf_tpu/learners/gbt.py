@@ -228,6 +228,7 @@ class GradientBoostedTreesLearner(GenericLearner):
         prep = self._prepare(data, valid=valid)
         binner = prep["binner"]
         bins_all = prep["bins"]
+        set_all = prep.get("set_bits")
         labels_all = prep["labels"]
         w_all = prep["sample_weights"]
         n = bins_all.shape[0]
@@ -259,6 +260,7 @@ class GradientBoostedTreesLearner(GenericLearner):
         # of the training set, unless an explicit valid dataset is given.
         # Ranking splits whole query groups, like the reference.
         tr_groups = va_groups = None
+        set_tr = set_va = None
         if "valid_bins" in prep:
             bins_tr, y_tr, w_tr = bins_all, labels_all, w_all
             bins_va = prep["valid_bins"]
@@ -266,6 +268,7 @@ class GradientBoostedTreesLearner(GenericLearner):
             w_va = prep.get(
                 "valid_weights", np.ones((bins_va.shape[0],), np.float32)
             )
+            set_tr, set_va = set_all, prep.get("valid_set_bits")
             tr_groups = group_values
             if self.task == Task.RANKING:
                 va_groups = np.asarray(
@@ -296,11 +299,18 @@ class GradientBoostedTreesLearner(GenericLearner):
                 tr_idx = np.arange(n)
             bins_tr, y_tr, w_tr = bins_all[tr_idx], labels_all[tr_idx], w_all[tr_idx]
             bins_va, y_va, w_va = bins_all[va_idx], labels_all[va_idx], w_all[va_idx]
+            if set_all is not None:
+                set_tr, set_va = set_all[tr_idx], set_all[va_idx]
         else:
             bins_tr, y_tr, w_tr = bins_all, labels_all, w_all
             bins_va = np.zeros((0, bins_all.shape[1]), np.uint8)
             y_va = np.zeros((0,), labels_all.dtype)
             w_va = np.zeros((0,), np.float32)
+            if set_all is not None:
+                set_tr = set_all
+                set_va = np.zeros(
+                    (0,) + set_all.shape[1:], set_all.dtype
+                )
             tr_groups = group_values
 
         if self.mesh is not None:
@@ -311,13 +321,21 @@ class GradientBoostedTreesLearner(GenericLearner):
             # Padding rows carry zero weight → no effect on stats/losses.
             # Done BEFORE ranking-group registration so group row indices
             # and registered sizes refer to the final (padded) arrays.
-            (bins_tr, y_tr, w_tr), _ = pmesh.pad_rows_to_multiple(
-                [bins_tr, y_tr, w_tr], dp
+            tr_arrays = [bins_tr, y_tr, w_tr] + (
+                [set_tr] if set_tr is not None else []
             )
+            tr_arrays, _ = pmesh.pad_rows_to_multiple(tr_arrays, dp)
+            bins_tr, y_tr, w_tr = tr_arrays[:3]
+            if set_tr is not None:
+                set_tr = tr_arrays[3]
             if bins_va.shape[0] > 0:
-                (bins_va, y_va, w_va), _ = pmesh.pad_rows_to_multiple(
-                    [bins_va, y_va, w_va], dp
+                va_arrays = [bins_va, y_va, w_va] + (
+                    [set_va] if set_va is not None else []
                 )
+                va_arrays, _ = pmesh.pad_rows_to_multiple(va_arrays, dp)
+                bins_va, y_va, w_va = va_arrays[:3]
+                if set_va is not None:
+                    set_va = va_arrays[3]
             if fp > 1:
                 # Pad the feature axis too: constant-zero columns can never
                 # yield a valid split (their right-side count is 0).
@@ -334,6 +352,13 @@ class GradientBoostedTreesLearner(GenericLearner):
             bins_va = shard_bins(self.mesh, bins_va)
             y_va = pmesh.shard_batch(self.mesh, y_va)
             w_va = pmesh.shard_batch(self.mesh, w_va)
+            if set_tr is not None:
+                # Set features ride the data axis only (replicated over the
+                # feature axis — their per-item stats all-reduce via the
+                # same GSPMD contraction as the scalar histogram).
+                set_tr = pmesh.shard_batch(self.mesh, set_tr)
+                if set_va is not None and set_va.shape[0] > 0:
+                    set_va = pmesh.shard_batch(self.mesh, set_va)
 
         from ydf_tpu.learners.losses import CustomLoss
 
@@ -487,7 +512,11 @@ class GradientBoostedTreesLearner(GenericLearner):
             num_numerical=binner.num_numerical,
             # Under feature parallelism the bin matrix gains constant-zero
             # pad columns; per-node feature sampling must ignore them.
-            num_valid_features=F if bins_tr.shape[1] > F else None,
+            num_valid_features=(
+                binner.num_scalar
+                if bins_tr.shape[1] > binner.num_scalar
+                else None
+            ),
             seed=self.random_seed,
             sampling=self.sampling_method,
             goss_alpha=self.goss_alpha,
@@ -500,6 +529,8 @@ class GradientBoostedTreesLearner(GenericLearner):
             monotone=monotone,
             x_tr_raw=None if x_tr_raw is None else jnp.asarray(x_tr_raw),
             x_va_raw=None if x_va_raw is None else jnp.asarray(x_va_raw),
+            set_tr=None if set_tr is None else jnp.asarray(set_tr),
+            set_va=None if set_va is None else jnp.asarray(set_va),
             cache_dir=self.working_dir,
             resume=self.resume_training,
             snapshot_interval=self.resume_training_snapshot_interval_trees,
@@ -532,6 +563,7 @@ class GradientBoostedTreesLearner(GenericLearner):
             feature=flatten(forest_stacked.feature),
             threshold_bin=flatten(forest_stacked.threshold_bin),
             is_cat=flatten(forest_stacked.is_cat),
+            is_set=flatten(forest_stacked.is_set),
             cat_mask=flatten(forest_stacked.cat_mask),
             left=flatten(forest_stacked.left),
             right=flatten(forest_stacked.right),
@@ -654,7 +686,7 @@ def _make_boost_fn(
         return carry0, init_pred
 
     def _make_step(bins_tr, y_tr, w_tr, bins_va, y_va, w_va,
-                   x_tr_raw=None, x_va_raw=None):
+                   x_tr_raw=None, x_va_raw=None, set_tr=None, set_va=None):
         y_f = y_tr.astype(jnp.float32)
 
         def sample_mask(k_sub, g, preds):
@@ -817,6 +849,7 @@ def _make_boost_fn(
                     candidate_features=candidate_features,
                     num_valid_features=grow_num_valid,
                     monotone=monotone,
+                    set_bits=set_tr,
                 )
                 # Leaf values scaled by shrinkage at storage time, like the
                 # reference (set_leaf applies shrinkage).
@@ -824,7 +857,11 @@ def _make_boost_fn(
                 new_contrib = new_contrib.at[:, k].set(lv[res.leaf_id, 0])
                 if nv > 0:
                     vleaves = route_tree_bins(
-                        res.tree, grow_bins_va, tree_cfg.max_depth
+                        res.tree, grow_bins_va, tree_cfg.max_depth,
+                        x_set=set_va,
+                        # Stored set-feature ids are offset by the UNPADDED
+                        # scalar count (see grow_tree best_f_store).
+                        num_scalar=grow_num_valid,
                     )
                     new_vcontrib = new_vcontrib.at[:, k].set(lv[vleaves, 0])
                 trees_k.append(res.tree)
@@ -884,10 +921,11 @@ def _make_boost_fn(
 
     @jax.jit
     def run(bins_tr, y_tr, w_tr, bins_va, y_va, w_va,
-            x_tr_raw=None, x_va_raw=None):
+            x_tr_raw=None, x_va_raw=None, set_tr=None, set_va=None):
         carry0, init_pred = _init(y_tr, w_tr)
         step = _make_step(
-            bins_tr, y_tr, w_tr, bins_va, y_va, w_va, x_tr_raw, x_va_raw
+            bins_tr, y_tr, w_tr, bins_va, y_va, w_va, x_tr_raw, x_va_raw,
+            set_tr, set_va,
         )
         carry_end, (trees, lvs, tls, vls, obl_ws, obl_bs) = jax.lax.scan(
             step, carry0, jnp.arange(num_trees)
@@ -901,13 +939,15 @@ def _make_boost_fn(
 
     @functools.partial(jax.jit, static_argnames=("chunk_len",))
     def run_chunk(carry, start, chunk_len, bins_tr, y_tr, w_tr,
-                  bins_va, y_va, w_va, x_tr_raw=None, x_va_raw=None):
+                  bins_va, y_va, w_va, x_tr_raw=None, x_va_raw=None,
+                  set_tr=None, set_va=None):
         """One checkpointable slice of the boosting loop: iterations
         [start, start + chunk_len). Chunking is invisible to the result —
         the per-iteration RNG folds the iteration index into the carried
         key, so any chunk boundary reproduces the single-scan run."""
         step = _make_step(
-            bins_tr, y_tr, w_tr, bins_va, y_va, w_va, x_tr_raw, x_va_raw
+            bins_tr, y_tr, w_tr, bins_va, y_va, w_va, x_tr_raw, x_va_raw,
+            set_tr, set_va,
         )
         return jax.lax.scan(
             step, carry, start + jnp.arange(chunk_len)
@@ -980,7 +1020,7 @@ def _train_gbt(
     sampling="RANDOM", goss_alpha=0.2, goss_beta=0.1, selgb_ratio=0.01,
     dart_dropout=0.0, oblique_P=0, oblique_density=2.0,
     oblique_weight_type="BINARY", monotone=None,
-    x_tr_raw=None, x_va_raw=None,
+    x_tr_raw=None, x_va_raw=None, set_tr=None, set_va=None,
     cache_dir=None, resume=False, snapshot_interval=50,
     abort_after_chunks=None, early_stop_lookahead=0,
 ):
@@ -1008,6 +1048,9 @@ def _train_gbt(
     data_args = (bins_tr, y_tr, w_tr, bins_va, y_va, w_va) + (
         (x_tr_raw, x_va_raw) if oblique_P > 0 else ()
     )
+    data_kwargs = {}
+    if set_tr is not None:
+        data_kwargs = {"set_tr": set_tr, "set_va": set_va}
     if cache_dir is None:
         if (
             early_stop_lookahead > 0
@@ -1031,7 +1074,7 @@ def _train_gbt(
             while start < num_trees:
                 c = _chunk_len(clen, start, num_trees, use_dart)
                 carry, ys = run.run_chunk(
-                    carry, jnp.asarray(start), c, *data_args
+                    carry, jnp.asarray(start), c, *data_args, **data_kwargs
                 )
                 parts.append(_chunk_arrays_from_ys(ys))
                 start += c
@@ -1051,7 +1094,7 @@ def _train_gbt(
                 "oblique_b": obl_b,
             }
             return trees, lvs, logs
-        trees, lvs, tls, vls, init_pred, obl_w, obl_b = run(*data_args)
+        trees, lvs, tls, vls, init_pred, obl_w, obl_b = run(*data_args, **data_kwargs)
         logs = {
             "train_loss": tls,
             "valid_loss": vls,
@@ -1089,6 +1132,9 @@ def _train_gbt(
     )
     fp.update(np.asarray(bins_tr.shape, np.int64).tobytes())
     fp.update(np.asarray(bins_va.shape, np.int64).tobytes())
+    if set_tr is not None:
+        fp.update(np.asarray(set_tr.shape, np.int64).tobytes())
+        fp.update(np.asarray(set_tr[: min(1000, set_tr.shape[0])]).tobytes())
     fp.update(np.asarray(bins_tr[: min(1000, bins_tr.shape[0])]).tobytes())
     fp.update(np.asarray(y_tr[: min(1000, y_tr.shape[0])]).tobytes())
     fingerprint = fp.hexdigest()
@@ -1134,7 +1180,7 @@ def _train_gbt(
     while start < num_trees:
         clen = _chunk_len(snapshot_interval, start, num_trees, use_dart)
         carry, ys = run.run_chunk(
-            carry, jnp.asarray(start), clen, *data_args
+            carry, jnp.asarray(start), clen, *data_args, **data_kwargs
         )
         chunk_arrays = _chunk_arrays_from_ys(ys)
         tmp = _chunk_path(start) + ".tmp"

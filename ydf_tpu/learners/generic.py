@@ -52,10 +52,12 @@ class GenericLearner:
 
     # ------------------------------------------------------------------ #
 
-    def _prepare(
-        self, data: InputData, valid: Optional[InputData] = None
-    ) -> Dict:
-        """Common ingestion: dataset, binning, encoded label/weights."""
+    def _infer_dataset(self, data: InputData) -> Dataset:
+        """Dataset ingestion with this learner's type policy: forced label /
+        group / treatment column types + user column_types + discretization
+        flags. Shared by _prepare and learners that need the dataspec of
+        the FULL dataset before an internal split (CART's pruning holdout).
+        """
         column_types = dict(self.column_types)
         group_col = getattr(self, "ranking_group", None)
         if group_col:
@@ -78,12 +80,12 @@ class GenericLearner:
             # guide) — the shared dictionary makes label encoding consistent
             # across train/valid/test datasets.
             column_types[self.label] = ColumnType.CATEGORICAL
-        ds = Dataset.from_data(
+        return Dataset.from_data(
             data,
             label=self.label,
-            # A learner that pre-splits its input (CART's pruning holdout)
-            # pins the FULL dataset's dataspec here so the label dictionary
-            # covers classes that only occur in held-out rows.
+            # A learner that pre-splits its input pins the FULL dataset's
+            # dataspec here so the label dictionary covers classes that
+            # only occur in held-out rows.
             dataspec=getattr(self, "_forced_dataspec", None),
             max_vocab_count=self.max_vocab_count,
             min_vocab_frequency=self.min_vocab_frequency,
@@ -91,6 +93,12 @@ class GenericLearner:
             detect_numerical_as_discretized=self.discretize_numerical_columns,
             discretized_max_bins=self.num_discretized_numerical_bins,
         )
+
+    def _prepare(
+        self, data: InputData, valid: Optional[InputData] = None
+    ) -> Dict:
+        """Common ingestion: dataset, binning, encoded label/weights."""
+        ds = self._infer_dataset(data)
         feature_names = self.features
         if feature_names is None:
             exclude = {
@@ -101,17 +109,20 @@ class GenericLearner:
                 getattr(self, "label_event_observed", None),
                 getattr(self, "label_entry_age", None),
             } - {None}
+            supported = {
+                ColumnType.NUMERICAL,
+                ColumnType.CATEGORICAL,
+                ColumnType.BOOLEAN,
+                ColumnType.DISCRETIZED_NUMERICAL,
+            }
+            if getattr(self, "_supports_set_features", True):
+                # Isolation forests opt out (the reference trains IF on
+                # numerical splits only, isolation_forest.cc).
+                supported.add(ColumnType.CATEGORICAL_SET)
             feature_names = [
                 c.name
                 for c in ds.dataspec.columns
-                if c.name not in exclude
-                and c.type
-                in (
-                    ColumnType.NUMERICAL,
-                    ColumnType.CATEGORICAL,
-                    ColumnType.BOOLEAN,
-                    ColumnType.DISCRETIZED_NUMERICAL,
-                )
+                if c.name not in exclude and c.type in supported
             ]
         binned = BinnedDataset.create(ds, feature_names, num_bins=self.num_bins)
 
@@ -120,6 +131,7 @@ class GenericLearner:
             "binned": binned,
             "binner": binned.binner,
             "bins": binned.bins,
+            "set_bits": binned.set_bits,  # None without CATEGORICAL_SET cols
         }
         if self.label is not None:
             # CATEGORICAL_UPLIFT outcomes are dictionary-encoded like
@@ -144,6 +156,7 @@ class GenericLearner:
             vds = Dataset.from_data(valid, label=self.label, dataspec=ds.dataspec)
             out["valid_dataset"] = vds
             out["valid_bins"] = binned.binner.transform(vds)
+            out["valid_set_bits"] = binned.binner.transform_sets(vds)
             if self.label is not None:
                 out["valid_labels"] = vds.encoded_label(self.label, self.task)
             if self.weights is not None:

@@ -34,6 +34,10 @@ class IsolationForestLearner(GenericLearner):
     """API shape of the reference PYDF IsolationForestLearner
     (`specialized_learners_pre_generated.py:892`)."""
 
+    # The reference IF trains on numerical/categorical splits only — no
+    # categorical-set conditions (isolation_forest.cc).
+    _supports_set_features = False
+
     def __init__(
         self,
         label: Optional[str] = None,  # unsupervised: label optional

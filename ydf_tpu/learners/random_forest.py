@@ -111,6 +111,9 @@ class RandomForestLearner(GenericLearner):
         prep = self._prepare(data)
         binner = prep["binner"]
         bins = jnp.asarray(prep["bins"])
+        set_bits = prep.get("set_bits")
+        if set_bits is not None:
+            set_bits = jnp.asarray(set_bits)
         w_base = jnp.asarray(prep["sample_weights"])
         n, F = bins.shape
 
@@ -126,17 +129,20 @@ class RandomForestLearner(GenericLearner):
                 )
             # Same pattern as the GBT mesh path (gbt.py): pad rows (zero
             # weight → no effect on statistics), then shard everything.
-            (bins_np, w_np, labels_np), _ = pmesh.pad_rows_to_multiple(
-                [
-                    np.asarray(bins),
-                    np.asarray(w_base),
-                    np.asarray(prep["labels"]),
-                ],
-                dp,
-            )
+            arrays = [
+                np.asarray(bins),
+                np.asarray(w_base),
+                np.asarray(prep["labels"]),
+            ]
+            if set_bits is not None:
+                arrays.append(np.asarray(set_bits))
+            arrays, _ = pmesh.pad_rows_to_multiple(arrays, dp)
+            bins_np, w_np, labels_np = arrays[:3]
             bins = pmesh.shard_batch(self.mesh, bins_np)
             w_base = pmesh.shard_batch(self.mesh, w_np)
             prep["labels"] = pmesh.shard_batch(self.mesh, labels_np)
+            if set_bits is not None:
+                set_bits = pmesh.shard_batch(self.mesh, arrays[3])
             # OOB bookkeeping indexes labels and weights together — keep
             # the padded row count consistent (pad rows carry zero weight,
             # so they never enter the OOB accumulators).
@@ -210,7 +216,7 @@ class RandomForestLearner(GenericLearner):
         # n//min_examples would under-size with weights), hence ≤ 2n-1
         # nodes; the grower additionally guards allocation overflow.
         max_nodes = min(tree_cfg.max_nodes, 2 * n + 3)
-        cand = self._candidate_features(F)
+        cand = self._candidate_features(binner.num_features)
 
         oob_enabled = (
             self.compute_oob_performances
@@ -219,6 +225,7 @@ class RandomForestLearner(GenericLearner):
         )
         stacked, leaf_values, oob = _train_rf(
             bins, w_base,
+            set_bits=set_bits,
             stats_fn=stats_fn, rule=rule, tree_cfg=tree_cfg,
             max_nodes=max_nodes, num_trees=self.num_trees,
             bootstrap=self.bootstrap_training_dataset,
@@ -332,9 +339,10 @@ def _train_rf(
     bins, w_base, *, stats_fn, rule, tree_cfg: TreeConfig, max_nodes,
     num_trees, bootstrap, candidate_features, num_numerical, seed,
     honest_ratio=0.0, winner_take_all=False, compute_oob=False,
-    oob_importances=False,
+    oob_importances=False, set_bits=None,
 ):
     n, F = bins.shape
+    Fs = 0 if set_bits is None else set_bits.shape[1]
     V = rule.num_outputs
 
     def tree_vote(lv, leaves):
@@ -375,6 +383,7 @@ def _train_rf(
                 num_numerical=num_numerical,
                 min_examples=tree_cfg.min_examples,
                 candidate_features=candidate_features,
+                set_bits=set_bits,
             )
             if honest_ratio > 0.0:
                 # Re-estimate every LEAF's statistics from the held-out
@@ -414,21 +423,30 @@ def _train_rf(
                     # vmapped.
                     def shuffled_vote(f, k_f):
                         perm = jax.random.permutation(k_f, n)
-                        col = bins[perm, f]
+                        col = bins[perm, jnp.minimum(f, F - 1)]
                         b2 = jnp.where(
                             jnp.arange(F)[None, :] == f, col[:, None], bins
                         )
+                        if Fs > 0:
+                            # Set features (index block [F, F+Fs)): shuffle
+                            # the whole packed row of the chosen feature.
+                            s2 = jnp.where(
+                                (jnp.arange(Fs)[None, :, None] + F) == f,
+                                set_bits[perm], set_bits,
+                            )
+                        else:
+                            s2 = None
                         leaves = routing.route_tree_bins(
-                            tree, b2, tree_cfg.max_depth
+                            tree, b2, tree_cfg.max_depth, x_set=s2
                         )
                         return tree_vote(lv, leaves)
 
                     k_shuf = jax.random.split(
-                        jax.random.fold_in(key, 3), F
+                        jax.random.fold_in(key, 3), F + Fs
                     )
                     votes = jax.vmap(shuffled_vote)(
-                        jnp.arange(F), k_shuf
-                    )  # [F, n, V]
+                        jnp.arange(F + Fs), k_shuf
+                    )  # [F+Fs, n, V]
                     oob_shuf = oob_shuf + votes * oob_f[None, :, None]
                 carry = (oob_sum, oob_cnt, oob_shuf)
             return carry, (tree, lv)
@@ -438,7 +456,7 @@ def _train_rf(
                 jnp.zeros((n, V), jnp.float32),
                 jnp.zeros((n,), jnp.float32),
                 jnp.zeros(
-                    (F if oob_importances else 0, n, V), jnp.float32
+                    (F + Fs if oob_importances else 0, n, V), jnp.float32
                 ),
             )
         else:

@@ -25,7 +25,12 @@ class Forest(NamedTuple):
     threshold: jax.Array      # [T, N] f32 value-space: v <  threshold → left
     threshold_bin: jax.Array  # [T, N] i32 bin-space:  bin <= t        → left
     is_cat: jax.Array         # [T, N] bool
-    cat_mask: jax.Array       # [T, N, W] u32: bit(vocab idx) → left
+    # [T, N] bool: categorical-set node (Contains conditions,
+    # decision_tree.proto:98-108). cat_mask bit v = item v selected; an
+    # example whose set intersects the selection goes RIGHT (positive).
+    is_set: jax.Array
+    cat_mask: jax.Array       # [T, N, W] u32: is_cat → bit(vocab idx) left;
+                              #                is_set → bit = selected item
     left: jax.Array           # [T, N] i32
     right: jax.Array          # [T, N] i32
     is_leaf: jax.Array        # [T, N] bool
@@ -72,6 +77,8 @@ class Forest(NamedTuple):
         d = dict(d)
         if "na_left" not in d:  # saves from before the na_left field
             d["na_left"] = np.zeros(np.shape(d["feature"]), bool)
+        if "is_set" not in d:  # saves from before the is_set field
+            d["is_set"] = np.zeros(np.shape(d["feature"]), bool)
         if "cover" not in d:  # saves from before the cover field
             d["cover"] = np.ones(np.shape(d["feature"]), np.float32)
         if "oblique_weights" not in d:
@@ -129,6 +136,13 @@ def forest_from_stacked_trees(
         threshold=threshold.astype(jnp.float32),
         threshold_bin=tbin,
         is_cat=jnp.asarray(stacked_trees.is_cat),
+        is_set=jnp.asarray(
+            getattr(
+                stacked_trees,
+                "is_set",
+                jnp.zeros(feature.shape, jnp.bool_),
+            )
+        ),
         cat_mask=jnp.asarray(stacked_trees.cat_mask),
         left=jnp.asarray(stacked_trees.left),
         right=jnp.asarray(stacked_trees.right),

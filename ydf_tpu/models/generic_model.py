@@ -136,6 +136,13 @@ class GenericModel:
         """
         from ydf_tpu.ops.routing import forest_predict_values
 
+        if self.binner.num_set > 0:
+            raise NotImplementedError(
+                "to_jax_function over CATEGORICAL_SET features is not "
+                "supported yet (the exported fn signature carries only "
+                "x_num/x_cat)"
+            )
+
         forest = self.forest
         num_numerical = self.binner.num_numerical
         max_depth = self.max_depth
@@ -206,7 +213,7 @@ class GenericModel:
 
         def encoder(data):
             ds = Dataset.from_data(data, dataspec=self.dataspec)
-            x_num, x_cat = self._encode_inputs(ds)
+            x_num, x_cat, _ = self._encode_inputs(ds)
             return jnp.asarray(x_num), jnp.asarray(x_cat)
 
         return fn, params, encoder
@@ -231,12 +238,13 @@ class GenericModel:
     # ------------------------------------------------------------------ #
 
     def _encode_inputs(self, ds: Dataset):
-        """Raw features → (x_num f32 [n, Fn] imputed, x_cat i32 [n, Fc])."""
+        """Raw features → (x_num f32 [n, Fn] imputed, x_cat i32 [n, Fc],
+        x_set u32 [n, Fs, W] packed sets or None)."""
         b = self.binner
         n = ds.num_rows
         x_num = np.zeros((n, b.num_numerical), np.float32)
         x_cat = np.zeros((n, b.num_categorical), np.int32)
-        for i, name in enumerate(b.feature_names):
+        for i, name in enumerate(b.feature_names[: b.num_scalar]):
             if i < b.num_numerical:
                 if ds.dataspec.has_column(name) and name in ds.data:
                     x_num[:, i] = ds.encoded_numerical(
@@ -256,7 +264,30 @@ class GenericModel:
                     x_cat[:, j] = np.where(idx >= b.num_bins, 0, idx)
                 elif self.native_missing:
                     x_cat[:, j] = -1
-        return x_num, x_cat
+        x_set = None
+        if b.num_set > 0:
+            # Mask width follows the trained forest (imported models keep
+            # the full reference vocabulary; native ones the binner cap).
+            W = int(np.shape(self.forest.cat_mask)[-1])
+            x_set = np.zeros((n, b.num_set, W), np.uint32)
+            for j, name in enumerate(b.feature_names[b.num_scalar:]):
+                if ds.dataspec.has_column(name) and name in ds.data:
+                    x_set[:, j, :] = ds.encoded_categorical_set(name, W)
+        return x_num, x_cat, x_set
+
+    def _encode_set_missing(self, ds: Dataset):
+        """bool [n, Fs] per-cell missing mask for set features (drives
+        na_value routing of imported models); None when no set features."""
+        b = self.binner
+        if b.num_set == 0:
+            return None
+        out = np.zeros((ds.num_rows, b.num_set), bool)
+        for j, name in enumerate(b.feature_names[b.num_scalar:]):
+            if ds.dataspec.has_column(name) and name in ds.data:
+                out[:, j] = ds.categorical_set_missing_mask(name)
+            else:
+                out[:, j] = True
+        return out
 
     def _fast_engine(self):
         """QuickScorer engine for the CURRENT forest, or None. Compiled
@@ -291,11 +322,14 @@ class GenericModel:
 
     def _raw_scores(self, data: InputData, combine: str) -> np.ndarray:
         ds = Dataset.from_data(data, dataspec=self.dataspec)
-        x_num, x_cat = self._encode_inputs(ds)
-        if combine == "sum" and not self.native_missing:
+        x_num, x_cat, x_set = self._encode_inputs(ds)
+        if combine == "sum" and not self.native_missing and x_set is None:
             eng = self._fast_engine()
             if eng is not None:
                 return np.asarray(eng(jnp.asarray(x_num)))[:, None]
+        set_missing = (
+            self._encode_set_missing(ds) if self.native_missing else None
+        )
         out = forest_predict_values(
             self.forest,
             jnp.asarray(x_num),
@@ -303,6 +337,10 @@ class GenericModel:
             num_numerical=self.binner.num_numerical,
             max_depth=self.max_depth,
             combine=combine,
+            x_set=None if x_set is None else jnp.asarray(x_set),
+            set_missing=(
+                None if set_missing is None else jnp.asarray(set_missing)
+            ),
         )
         return np.asarray(out)
 

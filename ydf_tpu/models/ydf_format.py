@@ -267,11 +267,13 @@ class _FeatureMap:
 
     def __init__(self, spec: DataSpecification, ycols: List[_YdfColumn],
                  input_features: List[int]):
-        num_like, cat_like = [], []
+        num_like, cat_like, set_like = [], [], []
         for ci in input_features:
             t = spec.columns[ci].type
             if t == ColumnType.CATEGORICAL:
                 cat_like.append(ci)
+            elif t == ColumnType.CATEGORICAL_SET:
+                set_like.append(ci)
             elif t in (
                 ColumnType.NUMERICAL,
                 ColumnType.BOOLEAN,
@@ -284,8 +286,9 @@ class _FeatureMap:
                 )
         self.num_cols = num_like
         self.cat_cols = cat_like
+        self.set_cols = set_like
         self.col_to_feature: Dict[int, int] = {}
-        for i, ci in enumerate(num_like + cat_like):
+        for i, ci in enumerate(num_like + cat_like + set_like):
             self.col_to_feature[ci] = i
         self.num_numerical = len(num_like)
         self.ycols = ycols
@@ -294,12 +297,16 @@ class _FeatureMap:
     @property
     def feature_names(self) -> List[str]:
         return [
-            self.spec.columns[ci].name for ci in self.num_cols + self.cat_cols
+            self.spec.columns[ci].name
+            for ci in self.num_cols + self.cat_cols + self.set_cols
         ]
 
     @property
     def max_vocab(self) -> int:
-        vs = [self.spec.columns[ci].vocab_size for ci in self.cat_cols]
+        vs = [
+            self.spec.columns[ci].vocab_size
+            for ci in self.cat_cols + self.set_cols
+        ]
         return max(vs, default=1)
 
     def make_binner(self) -> Binner:
@@ -310,33 +317,48 @@ class _FeatureMap:
         impute = np.zeros((F,), np.float32)
         for i, ci in enumerate(self.num_cols):
             impute[i] = self.spec.columns[ci].mean
+        fnb = np.full((F,), 2, np.int32)
+        for j, ci in enumerate(self.set_cols):
+            # Imported set features keep the FULL reference vocabulary
+            # (the packed-set encoding width follows the forest's mask).
+            fnb[len(self.num_cols) + len(self.cat_cols) + j] = max(
+                self.spec.columns[ci].vocab_size, 1
+            )
         return Binner(
             feature_names=self.feature_names,
             num_numerical=self.num_numerical,
             num_bins=num_bins,
             boundaries=np.full((F, 1), np.inf, np.float32),
             impute_values=impute,
-            feature_num_bins=np.full((F,), 2, np.int32),
+            feature_num_bins=fnb,
+            num_set=len(self.set_cols),
         )
 
 
-def _bitmap_to_mask(bitmap: bytes, width_words: int) -> np.ndarray:
+def _bitmap_to_mask(
+    bitmap: bytes, width_words: int, invert: bool = True
+) -> np.ndarray:
     """ContainsBitmap bytes (bit i = category i matches → POSITIVE branch)
-    → our uint32 go-LEFT mask = complement (left is the negative child)."""
+    → our uint32 mask. For CATEGORICAL nodes the stored mask means
+    "goes LEFT" (negative child), so the bitmap is complemented; for
+    CATEGORICAL_SET nodes (invert=False) the mask IS the positive
+    selection (intersect → right)."""
     bits = np.frombuffer(bitmap, dtype=np.uint8)
     words = np.zeros((width_words,), np.uint32)
     as_u32 = np.zeros((width_words * 4,), np.uint8)
     as_u32[: len(bits)] = bits[: width_words * 4]
     words[:] = as_u32.view("<u4")
-    return ~words
+    return ~words if invert else words
 
 
-def _elements_to_mask(elements: List[int], width_words: int) -> np.ndarray:
+def _elements_to_mask(
+    elements: List[int], width_words: int, invert: bool = True
+) -> np.ndarray:
     words = np.zeros((width_words,), np.uint32)
     for e in elements:
         if 0 <= e < width_words * 32:
             words[e >> 5] |= np.uint32(1) << np.uint32(e & 31)
-    return ~words
+    return ~words if invert else words
 
 
 def trees_to_forest(
@@ -365,7 +387,7 @@ def trees_to_forest(
         def walk(node: _Node, depth: int) -> int:
             idx = len(rows)
             row = dict(
-                feature=-1, threshold=np.inf, is_cat=False,
+                feature=-1, threshold=np.inf, is_cat=False, is_set=False,
                 cat_mask=np.full((W,), 0xFFFFFFFF, np.uint32),
                 left=0, right=0, is_leaf=node.is_leaf,
                 na_left=not node.na_value,
@@ -384,13 +406,21 @@ def trees_to_forest(
             elif ct == 3:  # TrueValue on BOOLEAN (:91)
                 row["threshold"] = 0.5
             elif ct == 4:  # ContainsVector (:98-101)
-                row["is_cat"] = True
+                on_set = (
+                    fmap.spec.columns[ci].type == ColumnType.CATEGORICAL_SET
+                )
+                row["is_set" if on_set else "is_cat"] = True
                 row["cat_mask"] = _elements_to_mask(
-                    pw.get_packed_varints(c, 1), W
+                    pw.get_packed_varints(c, 1), W, invert=not on_set
                 )
             elif ct == 5:  # ContainsBitmap (:104-108)
-                row["is_cat"] = True
-                row["cat_mask"] = _bitmap_to_mask(pw.get_bytes(c, 1), W)
+                on_set = (
+                    fmap.spec.columns[ci].type == ColumnType.CATEGORICAL_SET
+                )
+                row["is_set" if on_set else "is_cat"] = True
+                row["cat_mask"] = _bitmap_to_mask(
+                    pw.get_bytes(c, 1), W, invert=not on_set
+                )
             elif ct == 6:  # DiscretizedHigher (:110-113)
                 t = pw.get_sint(c, 1)
                 b = fmap.ycols[ci].disc_boundaries
@@ -399,13 +429,15 @@ def trees_to_forest(
                 row["threshold"] = float(b[min(max(t - 1, 0), len(b) - 1)])
             elif ct == 1:  # NA: value is missing → positive (:89)
                 # Non-missing always goes left (v < inf / every mask bit
-                # set), missing follows na_left=False → right. Categorical
-                # attributes must route through the is_cat path so the
-                # missing code (-1) is recognized.
+                # set / empty set selection), missing follows na_left=False
+                # → right. Categorical/set attributes must route through
+                # their own paths so their missing encoding is recognized.
                 row["threshold"] = np.inf
-                row["is_cat"] = (
-                    fmap.spec.columns[ci].type == ColumnType.CATEGORICAL
-                )
+                t_col = fmap.spec.columns[ci].type
+                row["is_cat"] = t_col == ColumnType.CATEGORICAL
+                if t_col == ColumnType.CATEGORICAL_SET:
+                    row["is_set"] = True
+                    row["cat_mask"] = np.zeros((W,), np.uint32)
                 row["na_left"] = False
             elif ct == 7:  # Oblique (:114-131): Σ w_i·x_i >= threshold
                 attrs = pw.get_packed_varints(c, 1)
@@ -472,6 +504,7 @@ def trees_to_forest(
         threshold=stack("threshold", np.float32),
         threshold_bin=np.zeros((T, max_nodes), np.int32),
         is_cat=stack("is_cat", np.bool_),
+        is_set=stack("is_set", np.bool_),
         cat_mask=stack("cat_mask", np.uint32, (W,)),
         left=stack("left", np.int32),
         right=stack("right", np.int32),
@@ -836,6 +869,17 @@ def _encode_node(row: dict, leaf_payload: bytes,
                 inner += pw.put_packed_floats(4, vals)
         cond_type = pw.put_msg(7, inner)
         attribute = int(row["obl_cols"][attrs[0]]) if len(attrs) else 0
+    elif row["is_set"]:
+        # Set-selection mask IS the positive-branch bitmap (intersect →
+        # positive; ContainsBitmap, :104-108) — no complement.
+        vocab_size = row["vocab_size"]
+        mask_words = forest_np["cat_mask"][t, nid]
+        bits = np.unpackbits(
+            mask_words.view(np.uint8), bitorder="little"
+        )[:vocab_size]
+        bitmap = np.packbits(bits, bitorder="little").tobytes()
+        cond_type = pw.put_msg(5, pw.put_bytes(1, bitmap))
+        attribute = row["col_idx"]
     elif row["is_cat"]:
         # go-LEFT mask -> positive-branch bitmap (complement), sized to
         # the vocabulary (ContainsBitmap, :104-108).
@@ -880,12 +924,20 @@ def export_ydf_model(model, path: str) -> None:
 
     os.makedirs(path, exist_ok=True)
     binner = model.binner
-    for name in binner.feature_names[binner.num_numerical:]:
+    mask_bits = int(np.shape(model.forest.cat_mask)[-1]) * 32
+    for name in binner.feature_names[binner.num_numerical: binner.num_scalar]:
         vs = model.dataspec.column_by_name(name).vocab_size
         if vs > binner.num_bins:
             raise NotImplementedError(
                 f"export of categorical column {name!r} with vocabulary "
                 f"{vs} > trained mask width {binner.num_bins}"
+            )
+    for name in binner.feature_names[binner.num_scalar:]:
+        vs = model.dataspec.column_by_name(name).vocab_size
+        if vs > mask_bits:
+            raise NotImplementedError(
+                f"export of set column {name!r} with vocabulary {vs} > "
+                f"trained mask width {mask_bits}"
             )
     spec_cols = []
     # Dataspec: input features in our serving order + label (+ group /
@@ -991,6 +1043,7 @@ def export_ydf_model(model, path: str) -> None:
                 "feature": int(f_np["feature"][t, nid]),
                 "threshold": float(f_np["threshold"][t, nid]),
                 "is_cat": bool(f_np["is_cat"][t, nid]),
+                "is_set": bool(f_np["is_set"][t, nid]),
                 "na_left": bool(f_np["na_left"][t, nid]),
                 "cover": float(f_np["cover"][t, nid]),
                 "F_total": F_total,
@@ -1008,6 +1061,10 @@ def export_ydf_model(model, path: str) -> None:
                         np.dot(binner.impute_values[:Fn], w_vec)
                     )
                     row["na_left"] = v < row["threshold"]
+                elif row["is_set"]:
+                    # Native learners encode missing sets as empty →
+                    # no intersection → negative branch (left).
+                    row["na_left"] = True
                 elif row["is_cat"]:
                     row["na_left"] = bool(
                         f_np["cat_mask"][t, nid, 0] & np.uint32(1)

@@ -45,6 +45,12 @@ class TreeArrays(NamedTuple):
     feature: jax.Array
     threshold_bin: jax.Array
     is_cat: jax.Array
+    # Categorical-set split (reference Contains conditions,
+    # decision_tree.proto:98-108): cat_mask bit v set → item v is in the
+    # selected subset; an example whose set INTERSECTS the subset goes
+    # RIGHT (the reference's positive branch). is_cat and is_set are
+    # mutually exclusive.
+    is_set: jax.Array
     cat_mask: jax.Array
     left: jax.Array
     right: jax.Array
@@ -85,7 +91,7 @@ def unpack_mask_bit(packed: jax.Array, bit: jax.Array) -> jax.Array:
     ),
 )
 def grow_tree(
-    bins: jax.Array,        # uint8 [n, F]
+    bins: jax.Array,        # uint8 [n, F] scalar features
     stats: jax.Array,       # f32 [n, S] weighted per-example statistics
     key: jax.Array,
     *,
@@ -107,13 +113,30 @@ def grow_tree(
     # (reference: monotonic constraints, training.h:160-168; bound
     # clamping happens post-training on the finished trees).
     monotone: Optional[tuple] = None,
+    # CATEGORICAL_SET features: packed multi-hot uint32 [n, Fs, Ws]
+    # (bit v of word block = example's set contains item v). Candidate
+    # splits are prefixes of the per-node sorted item order (the same
+    # one-pass reduction as categorical bins, made exact over overlapping
+    # memberships by the per-example min-rank histogram); the reference's
+    # greedy forward selection (training.cc categorical-set splits)
+    # explores the same sorted-order family sequentially.
+    set_bits: Optional[jax.Array] = None,
 ) -> GrowResult:
     n, F = bins.shape
     S = stats.shape[1]
     L, B, N = frontier, num_bins, max_nodes
-    W = (B + 31) // 32
     Fn = F if num_numerical is None else num_numerical
     Fc = F - Fn
+    # Set features occupy the feature index block [F, F + Fs). Their item
+    # vocabulary Vs may exceed num_bins — the node mask then widens to
+    # cover it, while candidate CUT positions stay capped at B (only the
+    # top-B items of either direction's order can enter a selection; the
+    # tail of a 2k-item text vocabulary never carries a whole split).
+    Fs = 0 if set_bits is None else set_bits.shape[1]
+    Ws = 0 if set_bits is None else set_bits.shape[2]
+    Vs = 32 * Ws
+    Tc = min(Vs, B)  # set-prefix cut positions
+    W = (max(B, Vs) + 31) // 32
 
     f32 = jnp.float32
     i32 = jnp.int32
@@ -123,6 +146,7 @@ def grow_tree(
         feature=jnp.full((N + 1,), -1, i32),
         threshold_bin=jnp.zeros((N + 1,), i32),
         is_cat=jnp.zeros((N + 1,), jnp.bool_),
+        is_set=jnp.zeros((N + 1,), jnp.bool_),
         cat_mask=jnp.zeros((N + 1, W), jnp.uint32),
         left=jnp.zeros((N + 1,), i32),
         right=jnp.zeros((N + 1,), i32),
@@ -141,6 +165,14 @@ def grow_tree(
 
     cut_ids = jnp.arange(B, dtype=i32)
 
+    if Fs > 0:
+        # Unpacked multi-hot membership, bool [n, Fs, Vs] — input-derived,
+        # computed once for the whole build.
+        shifts = jnp.arange(32, dtype=jnp.uint32)
+        multi = (
+            ((set_bits[..., None] >> shifts) & jnp.uint32(1)) > 0
+        ).reshape(n, Fs, Vs)
+
     for depth in range(max_depth):
         key, k_gain, k_feat = jax.random.split(jax.random.fold_in(key, depth), 3)
         children_in_frontier = depth + 1 < max_depth
@@ -149,10 +181,6 @@ def grow_tree(
         # capacity (a large constant-factor win at shallow depths).
         Ld = min(2**depth, L)
 
-        hist = histogram(
-            bins, slot, stats, num_slots=Ld, num_bins=B, impl=hist_impl
-        )  # [Ld, F, B, S]
-
         parent = node_stats[:Ld]  # [Ld, S]
         active = frontier_id[:Ld] < N
 
@@ -160,8 +188,20 @@ def grow_tree(
         # Numerical features: cut t ⇒ left = bins <= t (prefix over bin id).
         # Categorical: cut t ⇒ left = t+1 smallest bins in cat_sort_key
         # order (prefix over the sorted order).
-        csum_num = jnp.cumsum(hist[:, :Fn], axis=2)  # [Ld, Fn, B, S]
-        if Fc > 0:
+        if F == 0:
+            # Set-features-only dataset (e.g. a single tokenized text
+            # column): the candidate tensor is built from the set blocks
+            # alone below.
+            left_all = jnp.zeros((Ld, 0, B, S), f32)
+            hist = None
+        else:
+            hist = histogram(
+                bins, slot, stats, num_slots=Ld, num_bins=B, impl=hist_impl
+            )  # [Ld, F, B, S]
+            csum_num = jnp.cumsum(hist[:, :Fn], axis=2)  # [Ld, Fn, B, S]
+        if F == 0:
+            pass
+        elif Fc > 0:
             hist_cat = hist[:, Fn:]  # [Ld, Fc, B, S]
             cat_key = rule.cat_sort_key(hist_cat, rule_ctx)  # [Ld, Fc, B]
             # Empty bins sort last → they land on the right side, so unseen
@@ -176,7 +216,67 @@ def grow_tree(
             left_all = jnp.concatenate([csum_num, csum_cat], axis=1)
         else:
             left_all = csum_num
-        right_all = parent[:, None, None, :] - left_all  # [Ld, F, B, S]
+
+        if Fs > 0:
+            # ---- categorical-set candidates ------------------------- #
+            # Per-(slot, feature, item) stats in one contraction. Unlike
+            # categorical bins, memberships overlap, so prefix stats of a
+            # sorted item order come from the per-example MIN-RANK
+            # histogram (exact): example ∈ prefix-t ⇔ min over its items
+            # of rank(item) <= t. Contains ⇒ RIGHT (positive), so the
+            # left-side stats are parent − prefix. BOTH sort directions
+            # are explored (the informative items may sit at either end
+            # of the rule's item score; the reference's greedy forward
+            # selection effectively walks the descending end) — candidate
+            # columns [F, F+Fs) ascending, [F+Fs, F+2Fs) descending.
+            oh = (slot[:, None] == jnp.arange(Ld)).astype(f32)  # [n, Ld]
+            per_item = jnp.einsum(
+                "nfv,nl,ns->lfvs", multi.astype(f32), oh, stats
+            )  # [Ld, Fs, Vs, S]
+            skey = rule.cat_sort_key(per_item, rule_ctx)  # [Ld, Fs, Vs]
+            # Items absent from the node sort last IN BOTH DIRECTIONS →
+            # never selected (unseen items route to the negative branch).
+            present = per_item[..., -1] > 0
+            sranks_dirs, rank_min_dirs, left_set_blocks = [], [], []
+            for dkey in (
+                jnp.where(present, skey, jnp.inf),
+                jnp.where(present, -skey, jnp.inf),
+            ):
+                sorder = jnp.argsort(dkey, axis=-1)
+                sranks = jnp.argsort(sorder, axis=-1).astype(i32)
+                ranks_pad = jnp.concatenate(
+                    [sranks, jnp.full((L + 1 - Ld, Fs, Vs), Vs, i32)], 0
+                )
+                rank_min_cols, pos_hists = [], []
+                for f in range(Fs):
+                    rs = ranks_pad[:, f][slot]  # [n, Vs]
+                    rm = jnp.min(jnp.where(multi[:, f], rs, Vs), axis=1)
+                    rank_min_cols.append(rm)
+                    # Examples whose best item rank lies beyond the cut
+                    # budget Tc can never enter a selection → excluded.
+                    in_cut = (rm < Tc).astype(f32)
+                    h = histogram(
+                        jnp.minimum(rm, Tc - 1)[:, None], slot,
+                        stats * in_cut[:, None],
+                        num_slots=Ld, num_bins=Tc, impl=hist_impl,
+                    )  # [Ld, 1, Tc, S]
+                    pos_hists.append(h[:, 0])
+                sranks_dirs.append(sranks)
+                rank_min_dirs.append(jnp.stack(rank_min_cols, 1))
+                pos_prefix = jnp.cumsum(jnp.stack(pos_hists, 1), axis=2)
+                left_set = parent[:, None, None, :] - pos_prefix
+                if Tc < B:
+                    # Pad count = -1 ⇒ fails the min_examples check,
+                    # never chosen.
+                    left_set = jnp.pad(
+                        left_set, ((0, 0), (0, 0), (0, B - Tc), (0, 0)),
+                        constant_values=-1.0,
+                    )
+                left_set_blocks.append(left_set)
+            left_all = jnp.concatenate([left_all] + left_set_blocks, axis=1)
+
+        Fa = F + 2 * Fs  # total candidate columns
+        right_all = parent[:, None, None, :] - left_all  # [Ld, Fa, B, S]
 
         gain = rule.gain(left_all, right_all, parent[:, None, None, :],
                          k_gain, rule_ctx)  # [Ld, F, B]
@@ -190,22 +290,34 @@ def grow_tree(
             # Rule-specific validity (e.g. uplift's per-treatment-arm
             # minimum example counts).
             valid &= rule.split_valid(left_all, right_all)
-        if candidate_features > 0 and candidate_features < F:
+        if candidate_features > 0 and candidate_features < F + Fs:
             # Exact per-node sampling of `candidate_features` features
             # without replacement (reference: per-node attribute sampling,
             # ydf/learner/decision_tree/training.cc FindBestCondition).
-            scores = jax.random.uniform(k_feat, (Ld, F))
+            # Each set feature is ONE candidate — its two direction
+            # columns share a score.
+            base = jax.random.uniform(k_feat, (Ld, F + Fs))
             if num_valid_features is not None and num_valid_features < F:
                 # Constant-zero pad columns (feature-parallel padding) must
                 # not consume sample slots — they'd dilute the real
                 # candidate set relative to the unpadded configuration.
-                scores = jnp.where(
-                    jnp.arange(F) < num_valid_features, scores, -1.0
+                # Set features (always real) keep their scores.
+                col_real = jnp.concatenate(
+                    [
+                        jnp.arange(F) < num_valid_features,
+                        jnp.ones((Fs,), jnp.bool_),
+                    ]
                 )
-            kth = jax.lax.top_k(scores, candidate_features)[0][:, -1]
+                base = jnp.where(col_real, base, -1.0)
+            kth = jax.lax.top_k(base, candidate_features)[0][:, -1]
+            scores = (
+                jnp.concatenate([base, base[:, F:]], axis=1) if Fs else base
+            )
             valid &= (scores >= kth[:, None])[:, :, None]
         if monotone is not None and any(monotone):
-            dirs = jnp.asarray(np.array(monotone, np.float32))  # [F]
+            dirs_np = np.zeros((Fa,), np.float32)
+            dirs_np[: len(monotone)] = np.array(monotone, np.float32)
+            dirs = jnp.asarray(dirs_np)  # [Fa]; set features always 0
             leaf_l = rule.leaf_value(left_all, rule_ctx)[..., 0]
             leaf_r = rule.leaf_value(right_all, rule_ctx)[..., 0]
             mono_ok = (dirs[None, :, None] == 0) | (
@@ -215,7 +327,7 @@ def grow_tree(
         gain = jnp.where(valid, gain, -jnp.inf)
 
         # ---- best cut per frontier slot --------------------------------- #
-        flat = gain.reshape(Ld, F * B)
+        flat = gain.reshape(Ld, Fa * B)
         best_idx = jnp.argmax(flat, axis=1)
         best_gain = jnp.take_along_axis(flat, best_idx[:, None], 1)[:, 0]
         best_f = (best_idx // B).astype(i32)
@@ -253,12 +365,16 @@ def grow_tree(
         )[:, 0]  # [Ld, S]
         right_stats = parent - left_stats
 
-        is_cat_split = best_f >= Fn
+        is_set_split = best_f >= F
+        # Direction column → (direction, real set-feature index).
+        set_dir = (best_f - F) >= Fs          # False = asc, True = desc
+        fset = jnp.where(set_dir, best_f - F - Fs, best_f - F)
+        is_cat_split = (best_f >= Fn) & ~is_set_split
         # Per-slot routing mask over bins: numerical → prefix of bin ids,
         # categorical → prefix of the sorted order (rank <= cut).
         if Fc > 0:
             chosen_rank = jnp.take_along_axis(
-                ranks, jnp.maximum(best_f - Fn, 0)[:, None, None], axis=1
+                ranks, jnp.clip(best_f - Fn, 0, Fc - 1)[:, None, None], axis=1
             )[:, 0]  # [Ld, B]
             go_left_bins = jnp.where(
                 is_cat_split[:, None],
@@ -267,11 +383,36 @@ def grow_tree(
             )  # [Ld, B]
         else:
             go_left_bins = cut_ids[None, :] <= best_t[:, None]
+        if Fs > 0:
+            # Stored set mask: bit = item in the selected subset
+            # (rank <= cut in the chosen direction); intersecting
+            # examples go RIGHT.
+            fclip = jnp.clip(fset, 0, Fs - 1)[:, None, None]
+            cs0 = jnp.take_along_axis(sranks_dirs[0], fclip, axis=1)[:, 0]
+            cs1 = jnp.take_along_axis(sranks_dirs[1], fclip, axis=1)[:, 0]
+            chosen_srank = jnp.where(set_dir[:, None], cs1, cs0)  # [Ld, Vs]
+            sel = chosen_srank <= best_t[:, None]
+            Wb = 32 * W
+            if Vs < Wb:
+                sel = jnp.pad(sel, ((0, 0), (0, Wb - Vs)))
+            glb = go_left_bins
+            if B < Wb:
+                glb = jnp.pad(glb, ((0, 0), (0, Wb - B)))
+            store_mask = jnp.where(is_set_split[:, None], sel, glb)
+        else:
+            store_mask = go_left_bins
 
-        tree["feature"] = tree["feature"].at[wid].set(best_f)
+        # The stored feature id collapses the two direction columns back
+        # onto the real feature block — offset by the UNPADDED scalar
+        # count (feature-parallel padding appends zero columns to `bins`;
+        # serving decodes set ids against the unpadded layout).
+        nvf = F if num_valid_features is None else num_valid_features
+        best_f_store = jnp.where(is_set_split, nvf + fset, best_f)
+        tree["feature"] = tree["feature"].at[wid].set(best_f_store)
         tree["threshold_bin"] = tree["threshold_bin"].at[wid].set(best_t)
         tree["is_cat"] = tree["is_cat"].at[wid].set(is_cat_split)
-        tree["cat_mask"] = tree["cat_mask"].at[wid].set(_pack_mask(go_left_bins))
+        tree["is_set"] = tree["is_set"].at[wid].set(is_set_split)
+        tree["cat_mask"] = tree["cat_mask"].at[wid].set(_pack_mask(store_mask))
         tree["left"] = tree["left"].at[wid].set(left_id)
         tree["right"] = tree["right"].at[wid].set(right_id)
         tree["is_leaf"] = tree["is_leaf"].at[wid].set(False)
@@ -287,13 +428,26 @@ def grow_tree(
         )
         split_e = pad(do_split, False)[slot]
         bf_e = pad(best_f, 0)[slot]
-        bin_e = jnp.take_along_axis(
-            bins, bf_e[:, None].astype(i32), axis=1
-        )[:, 0].astype(i32)
-        # Flat 1-D gather — do NOT index [slot] then [bin]: that would
-        # materialize an [n, B] intermediate.
-        glb_flat = pad(go_left_bins, False).reshape(-1)
-        go_left_e = glb_flat[slot * B + bin_e]
+        if F > 0:
+            bin_e = jnp.take_along_axis(
+                bins, jnp.clip(bf_e, 0, F - 1)[:, None].astype(i32), axis=1
+            )[:, 0].astype(i32)
+            # Flat 1-D gather — do NOT index [slot] then [bin]: that would
+            # materialize an [n, B] intermediate.
+            glb_flat = pad(go_left_bins, False).reshape(-1)
+            go_left_e = glb_flat[slot * B + bin_e]
+        else:
+            go_left_e = jnp.zeros((n,), jnp.bool_)
+        if Fs > 0:
+            is_set_e = pad(is_set_split, False)[slot]
+            fset_e = jnp.clip(pad(fset, 0)[slot], 0, Fs - 1)[:, None]
+            dir_e = pad(set_dir, False)[slot]
+            rm0 = jnp.take_along_axis(rank_min_dirs[0], fset_e, axis=1)[:, 0]
+            rm1 = jnp.take_along_axis(rank_min_dirs[1], fset_e, axis=1)[:, 0]
+            rm_e = jnp.where(dir_e, rm1, rm0)
+            t_e = pad(best_t, 0)[slot]
+            # Not-contains (min rank beyond the cut) → LEFT.
+            go_left_e = jnp.where(is_set_e, rm_e > t_e, go_left_e)
         child_id_e = jnp.where(
             go_left_e, pad(left_id, N)[slot], pad(right_id, N)[slot]
         )
@@ -322,6 +476,7 @@ def grow_tree(
         feature=tree["feature"][:N],
         threshold_bin=tree["threshold_bin"][:N],
         is_cat=tree["is_cat"][:N],
+        is_set=tree["is_set"][:N],
         cat_mask=tree["cat_mask"][:N],
         left=tree["left"][:N],
         right=tree["right"][:N],

@@ -30,8 +30,30 @@ from ydf_tpu.ops.grower import TreeArrays, unpack_mask_bit
 i32 = jnp.int32
 
 
-def route_tree_bins(tree, bins: jax.Array, max_depth: int) -> jax.Array:
+def _set_intersects(tree, node, x_set: jax.Array, f: jax.Array) -> jax.Array:
+    """bool [n]: does each example's packed set (feature f - offset, offset
+    = number of scalar features) intersect the node's selected subset?
+    Contains ⇒ the reference's positive branch ⇒ RIGHT."""
+    Fs = x_set.shape[1]
+    Wm = min(x_set.shape[2], tree.cat_mask.shape[-1])
+    fs = jnp.clip(f, 0, Fs - 1)
+    words = jnp.take_along_axis(
+        x_set, fs[:, None, None].astype(i32), axis=1
+    )[:, 0, :Wm]
+    mask = tree.cat_mask[node][:, :Wm]
+    return jnp.any((words & mask) != 0, axis=1)
+
+
+def route_tree_bins(
+    tree, bins: jax.Array, max_depth: int,
+    x_set: Optional[jax.Array] = None,
+    num_scalar: Optional[int] = None,
+) -> jax.Array:
     """Leaf node id per example. tree: TreeArrays-like (single tree).
+    `x_set`: packed multi-hot set features uint32 [n, Fs, W]. Set features
+    sit after the scalar features in the node feature-id space —
+    `num_scalar` gives that offset when the bins matrix carries trailing
+    pad columns (feature-parallel padding); default = bins.shape[1].
 
     Does NOT support oblique nodes (projections are not part of the input
     bin matrix) — oblique forests must route in value mode."""
@@ -41,17 +63,27 @@ def route_tree_bins(tree, bins: jax.Array, max_depth: int) -> jax.Array:
             "binned routing over oblique forests is not supported; use "
             "value-mode routing (forest_predict_values)"
         )
-    n = bins.shape[0]
+    n, Fb = bins.shape
 
     def body(_, node):
         f = jnp.maximum(tree.feature[node], 0)
-        b = jnp.take_along_axis(bins, f[:, None].astype(i32), axis=1)[:, 0]
+        b = jnp.take_along_axis(
+            bins, jnp.clip(f, 0, Fb - 1)[:, None].astype(i32), axis=1
+        )[:, 0]
         b = b.astype(i32)
         go_left = jnp.where(
             tree.is_cat[node],
             unpack_mask_bit(tree.cat_mask[node], b),
             b <= tree.threshold_bin[node],
         )
+        is_set = getattr(tree, "is_set", None)
+        if is_set is not None and x_set is not None and x_set.size:
+            offset = Fb if num_scalar is None else num_scalar
+            go_left = jnp.where(
+                is_set[node],
+                ~_set_intersects(tree, node, x_set, f - offset),
+                go_left,
+            )
         nxt = jnp.where(go_left, tree.left[node], tree.right[node])
         return jnp.where(tree.is_leaf[node], node, nxt)
 
@@ -67,13 +99,19 @@ def route_tree_values(
     x_cat: jax.Array,  # i32 [n, Fc] vocabulary indices (OOV/overflow → 0)
     num_numerical: int,
     max_depth: int,
+    x_set: Optional[jax.Array] = None,       # u32 [n, Fs, W] packed sets
+    set_missing: Optional[jax.Array] = None,  # bool [n, Fs] missing cells
 ) -> jax.Array:
-    """Leaf node id per example, value mode. tree.threshold is float."""
+    """Leaf node id per example, value mode. tree.threshold is float.
+    Feature index space: [0, Fn) numerical, [Fn, Fn+Fc) categorical,
+    [Fn+Fc, Fn+Fc+Fs) categorical-set, [F_total, F_total+P) oblique."""
     n = x_num.shape[0] if x_num.size else x_cat.shape[0]
     ow = getattr(tree, "oblique_weights", None)
     onr = getattr(tree, "oblique_na_repl", None)
     P = 0 if ow is None else ow.shape[0]
-    F_total = x_num.shape[1] + x_cat.shape[1]
+    Fs = 0 if x_set is None else x_set.shape[1]
+    F_total = x_num.shape[1] + x_cat.shape[1] + Fs
+    num_scalar = F_total - Fs
 
     def body(_, node):
         f = jnp.maximum(tree.feature[node], 0)
@@ -114,6 +152,21 @@ def route_tree_values(
         # the node's stored direction — the reference's NodeCondition
         # na_value (decision_tree.proto:182), inverted to "goes left".
         missing = jnp.where(is_cat, c < 0, jnp.isnan(v))
+        is_set = getattr(tree, "is_set", None)
+        if is_set is not None and Fs > 0:
+            fs = f - num_scalar
+            go_left = jnp.where(
+                is_set[node],
+                ~_set_intersects(tree, node, x_set, fs),
+                go_left,
+            )
+            if set_missing is not None:
+                sm = jnp.take_along_axis(
+                    set_missing, jnp.clip(fs, 0, Fs - 1)[:, None], axis=1
+                )[:, 0]
+                missing = jnp.where(is_set[node], sm, missing)
+            else:
+                missing = jnp.where(is_set[node], False, missing)
         go_left = jnp.where(missing, tree.na_left[node], go_left)
         nxt = jnp.where(go_left, tree.left[node], tree.right[node])
         return jnp.where(tree.is_leaf[node], node, nxt)
@@ -128,13 +181,14 @@ def forest_predict_bins(
     bins: jax.Array,
     max_depth: int,
     combine: str = "sum",
+    x_set: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Σ (or mean) over trees of routed leaf values. Returns [n, V]."""
     T = forest.leaf_value.shape[0]
     n = bins.shape[0]
 
     def body(acc, tree):
-        leaves = route_tree_bins(tree, bins, max_depth)
+        leaves = route_tree_bins(tree, bins, max_depth, x_set=x_set)
         return acc + tree.leaf_value[leaves], None
 
     init = jnp.zeros((n, forest.leaf_value.shape[-1]), jnp.float32)
@@ -152,12 +206,17 @@ def forest_predict_values(
     num_numerical: int,
     max_depth: int,
     combine: str = "sum",
+    x_set: Optional[jax.Array] = None,
+    set_missing: Optional[jax.Array] = None,
 ) -> jax.Array:
     T = forest.leaf_value.shape[0]
     n = x_num.shape[0] if x_num.size else x_cat.shape[0]
 
     def body(acc, tree):
-        leaves = route_tree_values(tree, x_num, x_cat, num_numerical, max_depth)
+        leaves = route_tree_values(
+            tree, x_num, x_cat, num_numerical, max_depth,
+            x_set=x_set, set_missing=set_missing,
+        )
         return acc + tree.leaf_value[leaves], None
 
     init = jnp.zeros((n, forest.leaf_value.shape[-1]), jnp.float32)

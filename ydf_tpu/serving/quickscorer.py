@@ -58,7 +58,7 @@ def compile_forest(forest, num_numerical: int) -> Optional[QuickScorerModel]:
     f = {k: np.asarray(v) for k, v in forest.to_numpy().items()}
     if f["oblique_weights"].size > 0 or f["leaf_value"].shape[-1] != 1:
         return None
-    if f["is_cat"][~f["is_leaf"]].any():
+    if f["is_cat"][~f["is_leaf"]].any() or f["is_set"][~f["is_leaf"]].any():
         return None
     T = f["feature"].shape[0]
 
