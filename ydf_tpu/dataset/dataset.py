@@ -155,12 +155,16 @@ class Dataset:
 
     def encoded_numerical(self, name: str, impute: bool = True) -> np.ndarray:
         """float32 values; missing → column-mean global imputation, or kept
-        as NaN when impute=False (native na_value routing)."""
+        as NaN when impute=False (native na_value routing). Returns a VIEW
+        of the stored column when no conversion is needed — callers never
+        mutate encodings."""
         col = self.dataspec.column_by_name(name)
         raw = self.data[name]
-        vals = raw.astype(np.float32) if raw.dtype != np.float32 else raw.copy()
-        if impute:
-            vals = np.where(np.isnan(vals), np.float32(col.mean), vals)
+        vals = raw if raw.dtype == np.float32 else raw.astype(np.float32)
+        if impute and raw.dtype.kind not in "iub":  # ints/bools carry no NaN
+            nan = np.isnan(vals)
+            if nan.any():
+                vals = np.where(nan, np.float32(col.mean), vals)
         return vals
 
     def encoded_categorical(

@@ -185,6 +185,9 @@ def _discretized_boundaries(
     has few uniques, boundaries are midpoints between consecutive unique
     values (lossless); otherwise quantile cut points (deduplicated).
     """
+    # float64 throughout: native int dtypes overflow the midpoint sum and
+    # float16 overflows to inf.
+    ok = np.asarray(ok, dtype=np.float64)
     uniq = np.unique(ok)
     if len(uniq) <= max_bins:
         b = (uniq[:-1] + uniq[1:]) / 2
@@ -231,22 +234,33 @@ def infer_column(
 
     if ctype in (ColumnType.NUMERICAL, ColumnType.BOOLEAN,
                  ColumnType.DISCRETIZED_NUMERICAL):
-        fvals = values.astype(np.float64)
-        missing = np.isnan(fvals)
-        ok = fvals[~missing]
+        if values.dtype.kind in "iub":
+            # Integer/bool columns carry no NaN: single-pass stats, no
+            # float64 copy.
+            n_missing = 0
+            ok = values
+        else:
+            fvals = (
+                values
+                if values.dtype.kind == "f"
+                else values.astype(np.float64)
+            )
+            missing = np.isnan(fvals)
+            n_missing = int(missing.sum())
+            ok = fvals if n_missing == 0 else fvals[~missing]
         if ok.size == 0:
-            return Column(name=name, type=ctype, num_missing=int(missing.sum()))
+            return Column(name=name, type=ctype, num_missing=n_missing)
         boundaries = None
         if ctype == ColumnType.DISCRETIZED_NUMERICAL:
             boundaries = _discretized_boundaries(ok, discretized_max_bins)
         return Column(
             name=name,
             type=ctype,
-            mean=float(ok.mean()),
+            mean=float(ok.mean(dtype=np.float64)),
             min_value=float(ok.min()),
             max_value=float(ok.max()),
             num_values=int(ok.size),
-            num_missing=int(missing.sum()),
+            num_missing=n_missing,
             discretized_boundaries=boundaries,
         )
 
