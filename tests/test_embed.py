@@ -1,0 +1,124 @@
+"""Embed codegen: model → dependency-free C++ (reference
+serving/embed/embed.h:27-30, cpp_target_lowering.cc). The generated
+header is compiled with g++ and must reproduce predictions bit-for-bit."""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+from ydf_tpu.dataset.dataset import Dataset
+from ydf_tpu.serving.embed import EmbedUnsupported, _ident
+
+
+def _compile_and_run(tmp_path, model, df, name="m"):
+    """Generates <name>.h, compiles a driver that reads encoded features
+    from stdin, and returns its predictions."""
+    files = model.to_standalone_cc(name=name)
+    hdr = files[f"{name}.h"]
+    (tmp_path / f"{name}.h").write_text(hdr)
+
+    b = model.binner
+    Fn = b.num_numerical
+    sets = []
+    for i, fname in enumerate(b.feature_names):
+        cid = _ident(fname)
+        if i < Fn:
+            sets.append(f"    in >> v; instance.{cid} = v;")
+        else:
+            sets.append(
+                f"    in >> u; instance.{cid} = "
+                f"static_cast<{name}::Feature{cid}>(u);"
+            )
+    driver = f"""
+#include <cstdio>
+#include <iostream>
+#include "{name}.h"
+int main() {{
+  int n; std::cin >> n;
+  for (int e = 0; e < n; ++e) {{
+    {name}::Instance instance;
+    float v; uint32_t u; auto& in = std::cin;
+{os.linesep.join(sets)}
+    std::printf("%.9g\\n", {name}::Predict(instance));
+  }}
+  return 0;
+}}
+"""
+    (tmp_path / "driver.cc").write_text(driver)
+    exe = tmp_path / "driver"
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-o", str(exe), "driver.cc"],
+        cwd=tmp_path, check=True, capture_output=True,
+    )
+
+    ds = Dataset.from_data(df, dataspec=model.dataspec)
+    x_num, x_cat, _ = model._encode_inputs(ds)
+    n = x_num.shape[0] if x_num.size else x_cat.shape[0]
+    rows = [str(n)]
+    for e in range(n):
+        vals = [f"{float(v):.9g}" for v in x_num[e]] + [
+            str(int(v)) for v in x_cat[e]
+        ]
+        rows.append(" ".join(vals))
+    out = subprocess.run(
+        [str(exe)], input="\n".join(rows), capture_output=True,
+        text=True, check=True,
+    )
+    return np.array([float(x) for x in out.stdout.split()], np.float32)
+
+
+def test_gbt_regression_bit_exact(tmp_path, abalone):
+    feats = [c for c in abalone.columns if c not in ("Rings",)]
+    m = ydf.GradientBoostedTreesLearner(
+        label="Rings", task=Task.REGRESSION, features=feats,
+        num_trees=15, max_depth=4, validation_ratio=0.0,
+        early_stopping="NONE",
+    ).train(abalone)
+    head = abalone.head(300)
+    got = _compile_and_run(tmp_path, m, head)
+    want = m.predict(head).astype(np.float32)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_gbt_binary_classification_with_categoricals(tmp_path, adult_train):
+    m = ydf.GradientBoostedTreesLearner(
+        label="income", num_trees=10, max_depth=5, validation_ratio=0.0,
+        early_stopping="NONE",
+    ).train(adult_train.head(3000))
+    head = adult_train.head(300)
+    got = _compile_and_run(tmp_path, m, head)
+    want = m.predict(head).astype(np.float32)
+    # sigmoid(expf) vs jax sigmoid may differ in the last ulp.
+    np.testing.assert_allclose(got, want, atol=2e-7)
+
+
+def test_rf_regression(tmp_path):
+    rng = np.random.RandomState(3)
+    n = 800
+    data = {
+        "x1": rng.normal(size=n),
+        "x2": rng.normal(size=n),
+    }
+    data["y"] = (data["x1"] - data["x2"] + rng.normal(scale=0.2, size=n))
+    m = ydf.RandomForestLearner(
+        label="y", task=Task.REGRESSION, num_trees=20, max_depth=6,
+        compute_oob_performances=False,
+    ).train(data)
+    got = _compile_and_run(tmp_path, m, data)
+    want = m.predict(data).astype(np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_embed_rejects_oblique(abalone):
+    feats = [c for c in abalone.columns if c not in ("Rings", "Type")]
+    m = ydf.GradientBoostedTreesLearner(
+        label="Rings", task=Task.REGRESSION, features=feats,
+        num_trees=3, split_axis="SPARSE_OBLIQUE", validation_ratio=0.0,
+        early_stopping="NONE",
+    ).train(abalone)
+    with pytest.raises(EmbedUnsupported):
+        m.to_standalone_cc()

@@ -36,7 +36,7 @@ def test_engine_matches_routed(abalone):
     from ydf_tpu.dataset.dataset import Dataset
 
     ds = Dataset.from_data(abalone, dataspec=m.dataspec)
-    x_num, _ = m._encode_inputs(ds)
+    x_num, _, _ = m._encode_inputs(ds)
     raw = np.asarray(eng(x_num))
     ref = m.predict(abalone) - float(m.initial_predictions[0])
     np.testing.assert_allclose(raw, ref, atol=2e-5)
@@ -52,12 +52,64 @@ def test_predict_uses_engine_when_forced(abalone, force_qs):
     np.testing.assert_allclose(p, m2.predict(abalone.head(300)), atol=2e-5)
 
 
-def test_engine_rejects_categorical(adult_train):
+def test_engine_categorical_matches_routed(adult_train):
+    """Categorical contains-conditions in the kernel
+    (quick_scorer_extended.h:63-81): engine == routed predictions on a
+    model with mixed numerical + categorical splits."""
     m = ydf.GradientBoostedTreesLearner(
-        label="income", num_trees=3, validation_ratio=0.0,
+        label="income", num_trees=6, max_depth=5, validation_ratio=0.0,
         early_stopping="NONE",
-    ).train(adult_train.head(800))
-    assert build_quickscorer(m) is None  # categorical conditions
+    ).train(adult_train.head(2000))
+    eng = build_quickscorer(m, interpret=True)
+    assert eng is not None
+    # The compiled model really contains categorical conditions.
+    assert eng.qsm.cond_is_cat.any()
+    from ydf_tpu.dataset.dataset import Dataset
+
+    head = adult_train.head(500)
+    ds = Dataset.from_data(head, dataspec=m.dataspec)
+    x_num, x_cat, _ = m._encode_inputs(ds)
+    raw = np.asarray(eng(x_num, x_cat)) + float(m.initial_predictions[0])
+    p = m.predict(head)
+    logit = np.log(p / (1 - p))
+    np.testing.assert_allclose(raw, logit, atol=1e-4)
+
+
+def test_engine_equivalence_sweep(abalone, adult_train):
+    """Engine-equivalence sweep (reference TestGenericEngine,
+    test_utils.h:254-331): for every in-envelope config, the QuickScorer
+    must reproduce the routed engine's raw scores."""
+    from ydf_tpu.dataset.dataset import Dataset
+
+    configs = [
+        ("abalone-reg", lambda: _num_only_model(
+            abalone, num_trees=12, max_depth=5), abalone),
+        ("abalone-shallow", lambda: _num_only_model(
+            abalone, num_trees=30, max_depth=3), abalone),
+        ("adult-mixed", lambda: ydf.GradientBoostedTreesLearner(
+            label="income", num_trees=8, max_depth=4, validation_ratio=0.0,
+            early_stopping="NONE").train(adult_train.head(3000)),
+         adult_train),
+    ]
+    for name, make, df in configs:
+        m = make()
+        eng = build_quickscorer(m, interpret=True)
+        assert eng is not None, name
+        head = df.head(400)
+        ds = Dataset.from_data(head, dataspec=m.dataspec)
+        x_num, x_cat, _ = m._encode_inputs(ds)
+        raw = np.asarray(eng(x_num, x_cat))
+        from ydf_tpu.ops.routing import forest_predict_values
+        import jax.numpy as jnp
+
+        ref = np.asarray(
+            forest_predict_values(
+                m.forest, jnp.asarray(x_num), jnp.asarray(x_cat),
+                num_numerical=m.binner.num_numerical,
+                max_depth=m.max_depth,
+            )
+        )[:, 0]
+        np.testing.assert_allclose(raw, ref, atol=2e-5, err_msg=name)
 
 
 def test_engine_rejects_deep_trees(abalone):
@@ -81,7 +133,7 @@ def test_engine_on_imported_only_num_model(adult_test):
     from ydf_tpu.dataset.dataset import Dataset
 
     ds = Dataset.from_data(adult_test.head(500), dataspec=m.dataspec)
-    x_num, _ = m._encode_inputs(ds)
+    x_num, _, _ = m._encode_inputs(ds)
     raw = np.asarray(qsm_engine(x_num)) + float(m.initial_predictions[0])
     p = m.predict(adult_test.head(500))
     logit = np.log(p / (1 - p))

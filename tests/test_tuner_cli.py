@@ -33,6 +33,58 @@ def test_random_search_tuner():
     assert model.evaluate(data).accuracy > 0.8
 
 
+def test_hp_optimizer_learner_parallel_matches_serial():
+    """The meta-learner (reference hyperparameters_optimizer.cc:908) runs
+    trials round-robin over devices from a thread pool; the winner must be
+    identical to a serial run (trial list is drawn up-front)."""
+    data = _data(n=800, seed=6)
+
+    def make():
+        return ydf.HyperParameterOptimizerLearner(
+            base_learner=ydf.GradientBoostedTreesLearner(
+                label="y", num_trees=8, validation_ratio=0.0,
+                early_stopping="NONE",
+            ),
+            search_space={
+                "max_depth": [2, 3, 4],
+                "shrinkage": [0.05, 0.1, 0.2],
+            },
+            num_trials=6,
+            random_seed=9,
+        )
+
+    serial = make()
+    serial.parallel_trials = 1
+    m1 = serial.train(data)
+    parallel = make()
+    parallel.parallel_trials = 4
+    m2 = parallel.train(data)
+    logs1 = m1.extra_metadata["tuner_logs"]
+    logs2 = m2.extra_metadata["tuner_logs"]
+    assert logs1["best_params"] == logs2["best_params"]
+    assert [t["params"] for t in logs1["trials"]] == [
+        t["params"] for t in logs2["trials"]
+    ]
+    np.testing.assert_allclose(m1.predict(data), m2.predict(data), atol=1e-5)
+    assert m2.evaluate(data).accuracy > 0.8
+
+
+def test_hp_optimizer_auto_space_and_valid():
+    data = _data(n=700, seed=8)
+    hold = _data(n=300, seed=9)
+    opt = ydf.HyperParameterOptimizerLearner(
+        base_learner=ydf.GradientBoostedTreesLearner(
+            label="y", num_trees=6, validation_ratio=0.0,
+            early_stopping="NONE",
+        ),
+        num_trials=3,
+        random_seed=2,
+    )
+    m = opt.train(data, valid=hold)
+    assert len(opt.logs) >= 1
+    assert "best_params" in m.extra_metadata["tuner_logs"]
+
+
 def test_tuner_empty_space_raises():
     with pytest.raises(ValueError, match="search space"):
         ydf.RandomSearchTuner(num_trials=2).train(

@@ -1,0 +1,243 @@
+"""NUMERICAL_VECTOR_SEQUENCE features (reference data_spec.proto:73-84,
+vector_sequence.cc, gpu.cu.cc) — kernel oracle tests + end-to-end training,
+serving, and format interop."""
+
+import numpy as np
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+from ydf_tpu.dataset.dataspec import ColumnType
+from ydf_tpu.ops import vector_sequence as vsops
+
+
+def _random_vs(rng, n, D, max_len, p_empty=0.1):
+    seqs = []
+    for _ in range(n):
+        if rng.uniform() < p_empty:
+            seqs.append(np.zeros((0, D), np.float32))
+        else:
+            seqs.append(
+                rng.normal(size=(rng.randint(1, max_len + 1), D)).astype(
+                    np.float32
+                )
+            )
+    return seqs
+
+
+def _closer_task(rng, n=1200, D=4):
+    """Label = does any vector fall within distance of a fixed center?"""
+    center = np.linspace(-0.8, 0.8, D).astype(np.float32)
+    seqs = _random_vs(rng, n, D, 6)
+    y = np.array(
+        [
+            int(
+                len(s) > 0
+                and np.sum((s - center) ** 2, axis=1).min() < 1.0
+            )
+            for s in seqs
+        ]
+    )
+    return {"seq": seqs, "noise": rng.normal(size=n), "y": y}
+
+
+# ------------------------------------------------------------------ #
+# Kernel vs oracle
+# ------------------------------------------------------------------ #
+
+
+def _oracle_case(seed=0, n=200, L=9, D=5, A=12):
+    rng = np.random.RandomState(seed)
+    lengths = rng.randint(0, L + 1, n).astype(np.int32)
+    values = np.zeros((n, L, D), np.float32)
+    for e in range(n):
+        values[e, : lengths[e]] = rng.normal(size=(lengths[e], D))
+    anchors = rng.normal(size=(A, D)).astype(np.float32)
+    is_closer = rng.uniform(size=A) > 0.5
+    return values, lengths, anchors, is_closer
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas_interpret"])
+def test_scores_match_oracle(impl):
+    values, lengths, anchors, is_closer = _oracle_case()
+    oracle = vsops.vs_scores_oracle(values, lengths, anchors, is_closer)
+    got = np.asarray(
+        vsops.vs_scores(values, lengths, anchors, is_closer, impl=impl)
+    )
+    m = oracle > -1e30
+    np.testing.assert_allclose(got[m], oracle[m], rtol=1e-4, atol=1e-4)
+    # Empty sequences pin to the CUDA kernel's -FLT_MAX sentinel
+    # (gpu.cu.cc: the running min stays FLT_MAX and is negated).
+    assert np.array_equal(got[~m], oracle[~m])
+
+
+def test_scores_all_empty_column():
+    values = np.zeros((8, 4, 3), np.float32)
+    lengths = np.zeros((8,), np.int32)
+    anchors = np.ones((5, 3), np.float32)
+    closer = np.array([True, False, True, False, True])
+    out = np.asarray(vsops.vs_scores(values, lengths, anchors, closer,
+                                     impl="xla"))
+    assert (out == vsops.NEG_INF_SCORE).all()
+
+
+# ------------------------------------------------------------------ #
+# Dataspec / dataset plumbing
+# ------------------------------------------------------------------ #
+
+
+def test_dataspec_detects_vector_sequence():
+    rng = np.random.RandomState(3)
+    seqs = _random_vs(rng, 50, 3, 4)
+    spec = ydf.infer_dataspec({"seq": seqs, "y": rng.randint(0, 2, 50)})
+    col = spec.column_by_name("seq")
+    assert col.type == ColumnType.NUMERICAL_VECTOR_SEQUENCE
+    assert col.vector_length == 3
+    assert col.max_num_vectors >= 1
+
+
+def test_set_column_not_mistaken_for_vs():
+    spec = ydf.infer_dataspec(
+        {
+            "tags": [["a", "b"], ["b"], [], ["a", "c", "b"]] * 10,
+            "y": np.arange(40) % 2,
+        },
+        min_vocab_frequency=1,
+    )
+    assert spec.column_by_name("tags").type == ColumnType.CATEGORICAL_SET
+
+
+def test_encoded_vector_sequence_padding():
+    from ydf_tpu.dataset.dataset import Dataset
+
+    seqs = [
+        np.ones((2, 3), np.float32),
+        np.zeros((0, 3), np.float32),
+        None,  # missing
+        np.full((5, 3), 2.0, np.float32),
+    ]
+    ds = Dataset.from_data({"seq": seqs, "y": np.zeros(4)})
+    v, l, m = ds.encoded_vector_sequence("seq")
+    assert v.shape == (4, 5, 3)
+    assert l.tolist() == [2, 0, 0, 5]
+    assert m.tolist() == [False, False, True, False]
+    assert (v[0, :2] == 1).all() and (v[0, 2:] == 0).all()
+
+
+# ------------------------------------------------------------------ #
+# End-to-end training
+# ------------------------------------------------------------------ #
+
+
+def test_gbt_closer_than_classification():
+    data = _closer_task(np.random.RandomState(7))
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=20, max_depth=5, validation_ratio=0.1
+    ).train(data)
+    ev = m.evaluate(data)
+    assert ev.accuracy > 0.9, str(ev)
+    # The forest actually contains vector-sequence conditions.
+    F = m.binner.num_features
+    P = np.asarray(m.forest.oblique_weights).shape[1]
+    feats = np.asarray(m.forest.feature)
+    assert (feats >= F + P).any()
+
+
+def test_gbt_projected_more_than_regression():
+    rng = np.random.RandomState(11)
+    n, D = 1000, 3
+    direction = np.array([1.0, -1.0, 0.5], np.float32)
+    seqs = _random_vs(rng, n, D, 5)
+    y = np.array(
+        [
+            (s @ direction).max() if len(s) else -3.0
+            for s in seqs
+        ],
+        np.float32,
+    )
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", task=Task.REGRESSION, num_trees=30, max_depth=4,
+        validation_ratio=0.0, early_stopping="NONE",
+        numerical_vector_sequence_enable_closer_than=False,
+    ).train({"seq": seqs, "y": y})
+    pred = m.predict({"seq": seqs, "y": y})
+    corr = np.corrcoef(pred, y)[0, 1]
+    assert corr > 0.9, corr
+
+
+def test_anchor_kinds_can_be_disabled():
+    data = _closer_task(np.random.RandomState(5), n=400)
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=4, max_depth=3, validation_ratio=0.0,
+        early_stopping="NONE",
+        numerical_vector_sequence_enable_closer_than=False,
+        numerical_vector_sequence_enable_projected_more_than=False,
+    ).train(data)
+    # No anchors sampled → no VS nodes; model falls back to the noise col.
+    assert np.asarray(m.forest.vs_anchor).size == 0
+
+
+def test_save_load_roundtrip(tmp_path):
+    data = _closer_task(np.random.RandomState(13), n=500)
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=8, max_depth=4, validation_ratio=0.0,
+        early_stopping="NONE",
+    ).train(data)
+    m.save(str(tmp_path / "m"))
+    m2 = ydf.load_model(str(tmp_path / "m"))
+    np.testing.assert_allclose(
+        m.predict(data), m2.predict(data), atol=1e-6
+    )
+
+
+def test_ydf_format_roundtrip(tmp_path):
+    from ydf_tpu.models.ydf_format import export_ydf_model, load_ydf_model
+
+    data = _closer_task(np.random.RandomState(17), n=600)
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=10, max_depth=4, validation_ratio=0.0,
+        early_stopping="NONE",
+    ).train(data)
+    export_ydf_model(m, str(tmp_path / "ydf"))
+    m2 = load_ydf_model(str(tmp_path / "ydf"))
+    np.testing.assert_allclose(
+        m.predict(data), m2.predict(data), atol=2e-5
+    )
+    col = m2.dataspec.column_by_name("seq")
+    assert col.type == ColumnType.NUMERICAL_VECTOR_SEQUENCE
+    assert col.vector_length == 4
+
+
+def test_gbt_vs_on_mesh():
+    import jax
+
+    from ydf_tpu.parallel import make_mesh
+
+    data = _closer_task(np.random.RandomState(19), n=1001)
+    mesh = make_mesh(jax.devices())  # 8-way data parallel
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=8, max_depth=4, mesh=mesh,
+        validation_ratio=0.0, early_stopping="NONE",
+    ).train(data)
+    ev = m.evaluate(data)
+    assert ev.accuracy > 0.85, str(ev)
+
+
+def test_empty_and_missing_sequences_route_negative():
+    """Empty sequences can never satisfy an 'exists vector' condition —
+    they must land on the negative side of every VS split; our learners
+    treat missing as empty (global-imputation analogue)."""
+    data = _closer_task(np.random.RandomState(23), n=700)
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=10, max_depth=4, validation_ratio=0.0,
+        early_stopping="NONE",
+    ).train(data)
+    test = {
+        "seq": [np.zeros((0, 4), np.float32), None],
+        "noise": np.zeros(2),
+        "y": np.zeros(2, np.int64),
+    }
+    p = m.predict(test)
+    # Missing predicts exactly like empty.
+    assert p[0] == p[1]
+    assert p[0] < 0.5  # nothing near the center → class 0

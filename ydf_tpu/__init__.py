@@ -31,8 +31,12 @@ from ydf_tpu.learners.cart import CartLearner
 from ydf_tpu.learners.isolation_forest import IsolationForestLearner
 from ydf_tpu.learners.multitasker import MultitaskerLearner, MultitaskerModel
 from ydf_tpu.learners.tuner import RandomSearchTuner
+from ydf_tpu.learners.hyperparameter_optimizer import (
+    HyperParameterOptimizerLearner,
+)
 from ydf_tpu.metrics import cross_validation
 from ydf_tpu.models.io import load_model
+from ydf_tpu.parallel.mesh import init_distributed, make_mesh
 from ydf_tpu.models.sklearn_import import from_sklearn
 from ydf_tpu.models.ydf_format import load_ydf_model
 from ydf_tpu.config import Task
@@ -56,6 +60,9 @@ __all__ = [
     "MultitaskerLearner",
     "MultitaskerModel",
     "RandomSearchTuner",
+    "HyperParameterOptimizerLearner",
     "cross_validation",
     "Task",
+    "init_distributed",
+    "make_mesh",
 ]

@@ -182,6 +182,10 @@ def tree_shap(
         raise NotImplementedError(
             "TreeSHAP over oblique splits is not supported yet"
         )
+    if int(np.prod(model.forest.vs_anchor.shape[1:])) > 0:
+        raise NotImplementedError(
+            "TreeSHAP over vector-sequence splits is not supported yet"
+        )
     ds = Dataset.from_data(data, dataspec=model.dataspec)
     ds, rows_used = ds.sample(max_rows, seed=seed)
     x_num, x_cat, x_set = model._encode_inputs(ds)

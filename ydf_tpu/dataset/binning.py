@@ -67,6 +67,23 @@ class Binner:
     # of the uint8 bin matrix — they encode as packed multi-hot uint32
     # words (transform_sets), one fixed width for all set features.
     num_set: int = 0
+    # NUMERICAL_VECTOR_SEQUENCE features (data_spec.proto:73-84). Not part
+    # of the bin matrix or of `feature_names`: their candidate splits are
+    # per-tree sampled anchor projections (ops/vector_sequence.py), binned
+    # on the fly. All VS features share one dense padded encoding
+    # [n, Fv, vs_max_len, vs_dim] (transform_vs).
+    vs_names: List[str] = dataclasses.field(default_factory=list)
+    vs_dims: List[int] = dataclasses.field(default_factory=list)
+    vs_max_len: int = 0
+
+    @property
+    def num_vs(self) -> int:
+        return len(self.vs_names)
+
+    @property
+    def vs_dim(self) -> int:
+        """Common (max) vector dimensionality of the padded encoding."""
+        return max(self.vs_dims, default=0)
 
     @property
     def num_features(self) -> int:
@@ -120,8 +137,14 @@ class Binner:
             f for f in features
             if spec.column_by_name(f).type == ColumnType.CATEGORICAL_SET
         ]
+        vs = [
+            f for f in features
+            if spec.column_by_name(f).type
+            == ColumnType.NUMERICAL_VECTOR_SEQUENCE
+        ]
         unsupported = (
             set(features) - set(numericals) - set(categoricals) - set(sets)
+            - set(vs)
         )
         if unsupported:
             raise NotImplementedError(
@@ -203,6 +226,12 @@ class Binner:
             impute_values=impute,
             feature_num_bins=fnb,
             num_set=len(sets),
+            vs_names=vs,
+            vs_dims=[spec.column_by_name(f).vector_length for f in vs],
+            vs_max_len=max(
+                (max(spec.column_by_name(f).max_num_vectors, 1) for f in vs),
+                default=0,
+            ),
         )
 
     # ------------------------------------------------------------------ #
@@ -237,6 +266,29 @@ class Binner:
                 out[:, j, :] = dataset.encoded_categorical_set(name, W)
         return out
 
+    def transform_vs(self, dataset: Dataset):
+        """Dense padded vector-sequence encoding, or None without VS
+        features: (values f32 [n, Fv, Lmax, Dmax], lengths i32 [n, Fv],
+        missing bool [n, Fv]). Missing cells encode as empty sequences
+        (missing-as-empty, the global-imputation analogue); the mask is
+        kept for imported models' na_value routing."""
+        if self.num_vs == 0:
+            return None
+        n = dataset.num_rows
+        L, D = self.vs_max_len, self.vs_dim
+        values = np.zeros((n, self.num_vs, L, D), np.float32)
+        lengths = np.zeros((n, self.num_vs), np.int32)
+        missing = np.zeros((n, self.num_vs), bool)
+        for j, name in enumerate(self.vs_names):
+            if dataset.dataspec.has_column(name) and name in dataset.data:
+                v, l, m = dataset.encoded_vector_sequence(
+                    name, max_len=L, dim=D
+                )
+                values[:, j], lengths[:, j], missing[:, j] = v, l, m
+            else:
+                missing[:, j] = True
+        return values, lengths, missing
+
     def threshold_value(self, feature_index: int, threshold_bin: int) -> float:
         """Float threshold of a numerical split "bin <= threshold_bin goes
         left" ⇔ "value >= boundaries[threshold_bin] goes right"."""
@@ -251,6 +303,9 @@ class Binner:
             "impute_values": self.impute_values.tolist(),
             "feature_num_bins": self.feature_num_bins.tolist(),
             "num_set": self.num_set,
+            "vs_names": self.vs_names,
+            "vs_dims": self.vs_dims,
+            "vs_max_len": self.vs_max_len,
         }
 
     @staticmethod
@@ -263,6 +318,9 @@ class Binner:
             impute_values=np.array(d["impute_values"], dtype=np.float32),
             feature_num_bins=np.array(d["feature_num_bins"], dtype=np.int32),
             num_set=int(d.get("num_set", 0)),
+            vs_names=list(d.get("vs_names", [])),
+            vs_dims=[int(x) for x in d.get("vs_dims", [])],
+            vs_max_len=int(d.get("vs_max_len", 0)),
         )
 
 
@@ -273,6 +331,8 @@ class BinnedDataset:
     bins: np.ndarray  # uint8 [n, num_scalar]
     binner: Binner
     set_bits: Optional[np.ndarray] = None  # uint32 [n, num_set, W]
+    # (values, lengths, missing) from Binner.transform_vs, or None.
+    vs: Optional[tuple] = None
 
     @property
     def num_rows(self) -> int:
@@ -287,4 +347,5 @@ class BinnedDataset:
             bins=binner.transform(dataset),
             binner=binner,
             set_bits=binner.transform_sets(dataset),
+            vs=binner.transform_vs(dataset),
         )

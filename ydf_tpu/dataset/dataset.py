@@ -23,6 +23,7 @@ from ydf_tpu.dataset.dataspec import (
     ColumnType,
     DataSpecification,
     _string_missing_mask,
+    column_array as _column_array,
     infer_dataspec,
 )
 
@@ -121,7 +122,7 @@ class Dataset:
         elif hasattr(data, "to_dict") and hasattr(data, "columns"):  # DataFrame
             cols = {c: data[c].to_numpy() for c in data.columns}
         elif isinstance(data, dict):
-            cols = {k: np.asarray(v) for k, v in data.items()}
+            cols = {k: _column_array(v) for k, v in data.items()}
         else:
             raise TypeError(f"Unsupported dataset type: {type(data)}")
 
@@ -271,6 +272,37 @@ class Dataset:
             [tokenize_set_value(v) is None for v in self.data[name].tolist()],
             dtype=bool,
         )
+
+    def encoded_vector_sequence(
+        self, name: str, max_len: int = 0, dim: int = 0
+    ) -> tuple:
+        """NUMERICAL_VECTOR_SEQUENCE cells → dense padded arrays.
+
+        Returns (values f32 [n, Lmax, D] zero-padded, lengths i32 [n],
+        missing bool [n]). Missing cells encode as empty (length 0) with
+        the missing flag set — our learners treat missing-as-empty (the
+        global-imputation analogue); imported reference models route
+        missing by their stored na_value using the flag. Sequences longer
+        than `max_len` (when given, e.g. serving with a model trained on
+        shorter data) are truncated."""
+        from ydf_tpu.dataset.dataspec import vector_sequence_cell
+
+        col = self.dataspec.column_by_name(name)
+        D = dim or col.vector_length
+        cells = [vector_sequence_cell(v) for v in self.data[name].tolist()]
+        n = len(cells)
+        lengths = np.array(
+            [0 if c is None else c.shape[0] for c in cells], np.int32
+        )
+        Lmax = max_len or max(int(lengths.max(initial=0)), 1)
+        lengths = np.minimum(lengths, Lmax)
+        values = np.zeros((n, Lmax, D), np.float32)
+        for e, c in enumerate(cells):
+            if c is not None and c.size:
+                L = min(c.shape[0], Lmax)
+                values[e, :L, : c.shape[1]] = c[:L, :D]
+        missing = np.array([c is None for c in cells], bool)
+        return values, lengths, missing
 
     def encoded_label(self, name: str, task) -> np.ndarray:
         """Label encoding: classification → int32 in [0, C) (dictionary order,

@@ -83,6 +83,14 @@ class Column:
     # Ascending bin boundaries (data_spec.proto:267 DiscretizedNumericalSpec):
     # len(boundaries)+1 bins; value v lands in bin #{b : boundary_b <= v}.
     discretized_boundaries: Optional[List[float]] = None
+    # --- numerical vector sequence ---
+    # Fixed per-dataset vector dimensionality and observed sequence-length
+    # range (data_spec.proto:237 NumericalVectorSequenceSpec). A cell is a
+    # variable-length sequence of D-dim float vectors; empty is a valid
+    # value, distinct from missing.
+    vector_length: int = 0
+    min_num_vectors: int = 0
+    max_num_vectors: int = 0
 
     @property
     def vocab_size(self) -> int:
@@ -228,7 +236,13 @@ def infer_column(
             isinstance(v, (list, tuple, np.ndarray, set, frozenset))
             for v in values[: min(len(values), 100)].tolist()
         ):
+            # Nested sequences of numeric vectors → NUMERICAL_VECTOR_SEQUENCE
+            # (data_spec.proto:73-84); flat item collections → CATEGORICAL_SET.
             ctype = ColumnType.CATEGORICAL_SET
+            for v in values[: min(len(values), 100)].tolist():
+                if _is_vector_sequence_cell(v):
+                    ctype = ColumnType.NUMERICAL_VECTOR_SEQUENCE
+                    break
         else:
             ctype = ColumnType.CATEGORICAL
 
@@ -278,6 +292,40 @@ def infer_column(
             type=ctype,
             num_values=int(len(values) - missing.sum()),
             num_missing=int(missing.sum()),
+        )
+
+    if ctype == ColumnType.NUMERICAL_VECTOR_SEQUENCE:
+        # Variable-length sequences of fixed-dim vectors
+        # (data_spec.proto:237 NumericalVectorSequenceSpec). The vector
+        # dimensionality must be constant across the dataset.
+        vector_length = 0
+        num_missing = 0
+        count_values = 0
+        min_nv, max_nv = None, 0
+        for v in values.tolist():
+            seq = vector_sequence_cell(v)
+            if seq is None:
+                num_missing += 1
+                continue
+            if seq.size:
+                if vector_length == 0:
+                    vector_length = seq.shape[1]
+                elif seq.shape[1] != vector_length:
+                    raise ValueError(
+                        f"Column {name!r}: inconsistent vector lengths "
+                        f"{vector_length} vs {seq.shape[1]}"
+                    )
+            count_values += int(seq.size)
+            min_nv = seq.shape[0] if min_nv is None else min(min_nv, seq.shape[0])
+            max_nv = max(max_nv, seq.shape[0])
+        return Column(
+            name=name,
+            type=ctype,
+            vector_length=vector_length,
+            min_num_vectors=int(min_nv or 0),
+            max_num_vectors=int(max_nv),
+            num_values=count_values,
+            num_missing=num_missing,
         )
 
     if ctype == ColumnType.CATEGORICAL_SET:
@@ -345,6 +393,57 @@ def infer_column(
     raise NotImplementedError(f"Column type {ctype} not yet supported")
 
 
+def column_array(v: Any) -> np.ndarray:
+    """One raw column → 1-D ndarray. Ragged values (lists of per-example
+    sequences, e.g. NUMERICAL_VECTOR_SEQUENCE cells) become an object
+    array — np.asarray alone raises on inhomogeneous nesting."""
+    try:
+        arr = np.asarray(v)
+    except ValueError:
+        arr = None
+    if arr is not None and arr.ndim <= 1:
+        return arr
+    out = np.empty((len(v),), dtype=object)
+    for i, x in enumerate(v):
+        out[i] = x
+    return out
+
+
+def _is_vector_sequence_cell(v: Any) -> bool:
+    """Is this raw cell a sequence of numeric vectors (vs a flat item set)?"""
+    if isinstance(v, np.ndarray):
+        return v.ndim == 2
+    if isinstance(v, (list, tuple)) and len(v):
+        first = v[0]
+        if isinstance(first, np.ndarray):
+            return first.ndim == 1 and first.dtype.kind in "fiu"
+        return isinstance(first, (list, tuple)) and len(first) > 0 and all(
+            isinstance(x, (int, float, np.floating, np.integer))
+            for x in first
+        )
+    return False
+
+
+def vector_sequence_cell(v: Any) -> Optional[np.ndarray]:
+    """One raw NUMERICAL_VECTOR_SEQUENCE cell → float32 [L, D] array,
+    None if missing. An empty sequence ([] or shape (0, D)) is a valid
+    value, distinct from missing (None/NaN) — data_spec.proto:73-84."""
+    if v is None or (isinstance(v, float) and math.isnan(v)):
+        return None
+    arr = np.asarray(v, dtype=np.float32)
+    if arr.size == 0:
+        return arr.reshape(0, arr.shape[1] if arr.ndim == 2 else 0)
+    if arr.ndim == 1:
+        # A single vector is a length-1 sequence.
+        arr = arr[None, :]
+    if arr.ndim != 2:
+        raise ValueError(
+            f"Vector-sequence cell must be [num_vectors, dim], got shape "
+            f"{arr.shape}"
+        )
+    return arr
+
+
 def tokenize_set_value(v: Any) -> Optional[List[str]]:
     """One raw CATEGORICAL_SET cell → list of string items, None if missing.
 
@@ -391,7 +490,7 @@ def infer_dataspec(
     cols = []
     n = 0
     for name, values in data.items():
-        values = np.asarray(values)
+        values = column_array(values)
         n = len(values)
         force = column_types.get(name)
         if name == label:

@@ -105,6 +105,9 @@ class GradientBoostedTreesLearner(GenericLearner):
         sparse_oblique_projection_density_factor: float = 2.0,
         sparse_oblique_weights: str = "BINARY",
         sparse_oblique_max_num_projections: int = 64,
+        numerical_vector_sequence_num_anchors: int = 16,
+        numerical_vector_sequence_enable_closer_than: bool = True,
+        numerical_vector_sequence_enable_projected_more_than: bool = True,
         monotonic_constraints: Optional[dict] = None,
         working_dir: Optional[str] = None,
         resume_training: bool = False,
@@ -181,6 +184,23 @@ class GradientBoostedTreesLearner(GenericLearner):
         )
         self.sparse_oblique_weights = sparse_oblique_weights
         self.sparse_oblique_max_num_projections = sparse_oblique_max_num_projections
+        # NUMERICAL_VECTOR_SEQUENCE anchor splits (reference
+        # vector_sequence.cc; decision_tree.proto numerical_vector_sequence
+        # config, defaults :433-442). The reference samples
+        # num_random_selected_anchors per (node, feature); the TPU
+        # formulation samples `num_anchors` per kind per (tree, feature)
+        # and evaluates them as extra binned candidate columns — the same
+        # per-tree recast as the sparse-oblique projections.
+        self.numerical_vector_sequence_num_anchors = (
+            numerical_vector_sequence_num_anchors
+        )
+        self.numerical_vector_sequence_enable_closer_than = (
+            numerical_vector_sequence_enable_closer_than
+        )
+        self.numerical_vector_sequence_enable_projected_more_than = (
+            numerical_vector_sequence_enable_projected_more_than
+        )
+        self._supports_vs_features = True
         # Monotonic constraints: {feature_name: +1|-1} (reference
         # training.h:160-168 ApplyConstraintOnNode). Split search rejects
         # order-violating cuts; a post-training pass clamps leaf values to
@@ -261,6 +281,10 @@ class GradientBoostedTreesLearner(GenericLearner):
         # Ranking splits whole query groups, like the reference.
         tr_groups = va_groups = None
         set_tr = set_va = None
+        vs_all = prep.get("vs")  # (values, lengths, missing) or None
+        vs_tr = vs_va = None  # (values, lengths) pairs
+        if vs_all is not None:
+            vs_all = (vs_all[0], vs_all[1])
         if "valid_bins" in prep:
             bins_tr, y_tr, w_tr = bins_all, labels_all, w_all
             bins_va = prep["valid_bins"]
@@ -269,6 +293,10 @@ class GradientBoostedTreesLearner(GenericLearner):
                 "valid_weights", np.ones((bins_va.shape[0],), np.float32)
             )
             set_tr, set_va = set_all, prep.get("valid_set_bits")
+            if vs_all is not None:
+                vs_tr = vs_all
+                vv = prep.get("valid_vs")
+                vs_va = (vv[0], vv[1]) if vv is not None else None
             tr_groups = group_values
             if self.task == Task.RANKING:
                 va_groups = np.asarray(
@@ -301,6 +329,9 @@ class GradientBoostedTreesLearner(GenericLearner):
             bins_va, y_va, w_va = bins_all[va_idx], labels_all[va_idx], w_all[va_idx]
             if set_all is not None:
                 set_tr, set_va = set_all[tr_idx], set_all[va_idx]
+            if vs_all is not None:
+                vs_tr = (vs_all[0][tr_idx], vs_all[1][tr_idx])
+                vs_va = (vs_all[0][va_idx], vs_all[1][va_idx])
         else:
             bins_tr, y_tr, w_tr = bins_all, labels_all, w_all
             bins_va = np.zeros((0, bins_all.shape[1]), np.uint8)
@@ -310,6 +341,12 @@ class GradientBoostedTreesLearner(GenericLearner):
                 set_tr = set_all
                 set_va = np.zeros(
                     (0,) + set_all.shape[1:], set_all.dtype
+                )
+            if vs_all is not None:
+                vs_tr = vs_all
+                vs_va = (
+                    np.zeros((0,) + vs_all[0].shape[1:], np.float32),
+                    np.zeros((0,) + vs_all[1].shape[1:], np.int32),
                 )
             tr_groups = group_values
 
@@ -359,6 +396,26 @@ class GradientBoostedTreesLearner(GenericLearner):
                 set_tr = pmesh.shard_batch(self.mesh, set_tr)
                 if set_va is not None and set_va.shape[0] > 0:
                     set_va = pmesh.shard_batch(self.mesh, set_va)
+            if vs_tr is not None:
+                # Vector sequences ride the data axis; per-tree anchor
+                # sampling gathers across shards, the projection kernel is
+                # row-local.
+                def _pad_shard_vs(pair, target_rows):
+                    v, l = np.asarray(pair[0]), np.asarray(pair[1])
+                    v = np.pad(
+                        v,
+                        [(0, target_rows - v.shape[0])]
+                        + [(0, 0)] * (v.ndim - 1),
+                    )
+                    l = np.pad(l, [(0, target_rows - l.shape[0]), (0, 0)])
+                    return (
+                        pmesh.shard_batch(self.mesh, v),
+                        pmesh.shard_batch(self.mesh, l),
+                    )
+
+                vs_tr = _pad_shard_vs(vs_tr, bins_tr.shape[0])
+                if vs_va is not None and vs_va[0].shape[0] > 0:
+                    vs_va = _pad_shard_vs(vs_va, bins_va.shape[0])
 
         from ydf_tpu.learners.losses import CustomLoss
 
@@ -462,10 +519,6 @@ class GradientBoostedTreesLearner(GenericLearner):
         obl_P = 0
         x_tr_raw = x_va_raw = None
         if self.split_axis == "SPARSE_OBLIQUE" and binner.num_numerical > 0:
-            if self.mesh is not None:
-                raise NotImplementedError(
-                    "SPARSE_OBLIQUE under a mesh is not supported yet"
-                )
             obl_P = int(
                 np.ceil(
                     binner.num_numerical
@@ -494,6 +547,38 @@ class GradientBoostedTreesLearner(GenericLearner):
             else:
                 x_tr_raw = x_all
                 x_va_raw = np.zeros((0, binner.num_numerical), np.float32)
+            if self.mesh is not None:
+                # Match the row padding applied to bins_tr/bins_va above
+                # (pad rows carry zero weight; their raw values only enter
+                # the unweighted projection quantiles, a <dp/n perturbation
+                # of the bin boundaries), then ride the data axis. The
+                # per-tree projection matmul and quantile reduce over the
+                # sharded example axis — GSPMD inserts the collectives.
+                x_tr_raw = np.pad(
+                    x_tr_raw,
+                    ((0, bins_tr.shape[0] - x_tr_raw.shape[0]), (0, 0)),
+                )
+                x_tr_raw = pmesh.shard_batch(self.mesh, x_tr_raw)
+                if x_va_raw.shape[0] > 0:
+                    x_va_raw = np.pad(
+                        x_va_raw,
+                        ((0, bins_va.shape[0] - x_va_raw.shape[0]), (0, 0)),
+                    )
+                    x_va_raw = pmesh.shard_batch(self.mesh, x_va_raw)
+
+        # --- vector-sequence anchor candidates per tree (reference
+        # vector_sequence.cc; see ops/vector_sequence.py).
+        vs_Ac = vs_Ap = 0
+        if vs_tr is not None and binner.num_vs > 0:
+            if self.numerical_vector_sequence_enable_closer_than:
+                vs_Ac = self.numerical_vector_sequence_num_anchors
+            if self.numerical_vector_sequence_enable_projected_more_than:
+                vs_Ap = self.numerical_vector_sequence_num_anchors
+            if vs_Ac + vs_Ap == 0:
+                vs_tr = vs_va = None
+        else:
+            vs_tr = vs_va = None
+        vs_Pv = (vs_Ac + vs_Ap) * binner.num_vs if vs_tr is not None else 0
 
         forest_stacked, leaf_values, logs = _train_gbt(
             jnp.asarray(bins_tr),
@@ -531,6 +616,18 @@ class GradientBoostedTreesLearner(GenericLearner):
             x_va_raw=None if x_va_raw is None else jnp.asarray(x_va_raw),
             set_tr=None if set_tr is None else jnp.asarray(set_tr),
             set_va=None if set_va is None else jnp.asarray(set_va),
+            vs_tr=(
+                None
+                if vs_tr is None
+                else (jnp.asarray(vs_tr[0]), jnp.asarray(vs_tr[1]))
+            ),
+            vs_va=(
+                None
+                if vs_va is None
+                else (jnp.asarray(vs_va[0]), jnp.asarray(vs_va[1]))
+            ),
+            vs_Ac=vs_Ac,
+            vs_Ap=vs_Ap,
             cache_dir=self.working_dir,
             resume=self.resume_training,
             snapshot_interval=self.resume_training_snapshot_interval_trees,
@@ -571,30 +668,52 @@ class GradientBoostedTreesLearner(GenericLearner):
             leaf_stats=flatten(forest_stacked.leaf_stats),
             num_nodes=flatten(forest_stacked.num_nodes[..., None])[:, 0],
         )
-        if obl_P > 0:
-            # Tree features: [0, Fn) numerical, [Fn, Fn+P) projections,
-            # [Fn+P, ...) categorical. Remap to the Forest convention
-            # (projections after ALL real features) and attach each tree's
-            # projection matrix + per-projection bin cutpoints.
+        if obl_P > 0 or vs_Pv > 0:
+            # Tree features: [0, Fn) numerical, [Fn, Fn+P) oblique
+            # projections, [Fn+P, Fn+P+Pv) vector-sequence anchors,
+            # [Fn+P+Pv, ...) categorical(+set). Remap to the Forest
+            # convention (projection blocks after ALL real features, same
+            # order) and attach each tree's per-projection data + bin
+            # cutpoints. Both blocks shift by the same Freal - Fn.
             Fn = binner.num_numerical
             Freal = binner.num_features
+            PB = obl_P + vs_Pv
             feat = np.asarray(stacked.feature)
-            is_obl = (feat >= Fn) & (feat < Fn + obl_P)
+            in_block = (feat >= Fn) & (feat < Fn + PB)
             remapped = np.where(
-                is_obl,
+                in_block,
                 Freal + (feat - Fn),
-                np.where(feat >= Fn + obl_P, feat - obl_P, feat),
+                np.where(feat >= Fn + PB, feat - PB, feat),
             )
             stacked = stacked._replace(feature=remapped.astype(np.int32))
-            ow = np.repeat(np.asarray(logs["oblique_w"]), K, axis=0)[
-                : num_iters * K
-            ]
-            ob = np.repeat(np.asarray(logs["oblique_b"]), K, axis=0)[
-                : num_iters * K
-            ]
+
+            def per_iter(key):
+                return np.repeat(np.asarray(logs[key]), K, axis=0)[
+                    : num_iters * K
+                ]
+
+            kwargs = {}
+            if obl_P > 0:
+                kwargs["oblique_weights"] = per_iter("oblique_w")
+                kwargs["oblique_boundaries"] = per_iter("oblique_b")
+            if vs_Pv > 0:
+                Tn = num_iters * K
+                per_kind = [True] * vs_Ac + [False] * vs_Ap
+                kwargs["vs_anchors"] = per_iter("vs_a")
+                kwargs["vs_boundaries"] = per_iter("vs_b")
+                kwargs["vs_feat"] = np.broadcast_to(
+                    np.repeat(
+                        np.arange(binner.num_vs, dtype=np.int32),
+                        vs_Ac + vs_Ap,
+                    ),
+                    (Tn, vs_Pv),
+                )
+                kwargs["vs_is_closer"] = np.broadcast_to(
+                    np.tile(np.array(per_kind, bool), binner.num_vs),
+                    (Tn, vs_Pv),
+                )
             forest = forest_from_stacked_trees(
-                stacked, flatten(leaf_values), binner.boundaries,
-                oblique_weights=ow, oblique_boundaries=ob,
+                stacked, flatten(leaf_values), binner.boundaries, **kwargs
             )
         else:
             forest = forest_from_stacked_trees(
@@ -652,7 +771,7 @@ def _make_boost_fn(
     candidate_features, num_numerical, num_valid_features, seed, n, nv,
     sampling="RANDOM", goss_alpha=0.2, goss_beta=0.1, selgb_ratio=0.01,
     dart_dropout=0.0, oblique_P=0, oblique_density=2.0,
-    oblique_weight_type="BINARY", monotone=None,
+    oblique_weight_type="BINARY", monotone=None, vs_Ac=0, vs_Ap=0,
 ):
     """Builds (and caches) the jitted boosting loop for one static config.
 
@@ -686,7 +805,8 @@ def _make_boost_fn(
         return carry0, init_pred
 
     def _make_step(bins_tr, y_tr, w_tr, bins_va, y_va, w_va,
-                   x_tr_raw=None, x_va_raw=None, set_tr=None, set_va=None):
+                   x_tr_raw=None, x_va_raw=None, set_tr=None, set_va=None,
+                   vs_tr=None, vs_va=None):
         y_f = y_tr.astype(jnp.float32)
 
         def sample_mask(k_sub, g, preds):
@@ -785,6 +905,79 @@ def _make_boost_fn(
                 aug_va = bins_va
             return W, bnd, aug_tr, aug_va
 
+        def make_vs_projections(k_vs):
+            """Per-tree NUMERICAL_VECTOR_SEQUENCE anchor candidates
+            (reference vector_sequence.cc:265-326 recast per-tree): for
+            each VS feature, closer_than anchors are random vectors drawn
+            from the data, projected_more_than anchors are differences of
+            two random vectors; each anchor's per-example score (kernel in
+            ops/vector_sequence.py) becomes one quantile-binned candidate
+            column. Returns (anchors [Pv, D], boundaries [Pv, B-1],
+            cols_tr u8 [n, Pv], cols_va u8 [nv, Pv])."""
+            from ydf_tpu.ops.vector_sequence import vs_scores
+
+            vals_all, len_all = vs_tr
+            Fv = vals_all.shape[1]
+            closer_mask = jnp.asarray([True] * vs_Ac + [False] * vs_Ap)
+            qs = jnp.linspace(1.0 / B, 1.0 - 1.0 / B, B - 1)
+            binize = jax.vmap(
+                lambda b, zz: jnp.searchsorted(b, zz, side="right")
+            )
+            anchors_list, bnd_list, cols_tr, cols_va = [], [], [], []
+            for fv in range(Fv):
+                vals_f = vals_all[:, fv]  # [n, L, D]
+                len_f = len_all[:, fv]
+                ne = (len_f > 0).astype(jnp.float32)
+                tot = jnp.sum(ne)
+                # Uniform over non-empty examples (the reference's
+                # rejection loop, vector_sequence.cc:255-276); degenerate
+                # all-empty columns fall back to uniform (their scores are
+                # all -FLT_MAX — no split will validate anyway).
+                p = jnp.where(tot > 0, ne / jnp.maximum(tot, 1.0), 1.0 / n)
+
+                def samp(kk):
+                    k1, k2 = jax.random.split(kk)
+                    idx = jax.random.choice(k1, n, p=p)
+                    li = jax.random.randint(
+                        k2, (), 0, jnp.maximum(len_f[idx], 1)
+                    )
+                    return vals_f[idx, li]
+
+                ks = jax.random.split(
+                    jax.random.fold_in(k_vs, fv), vs_Ac + 2 * vs_Ap
+                )
+                parts = []
+                if vs_Ac:
+                    parts.append(jax.vmap(samp)(ks[:vs_Ac]))
+                if vs_Ap:
+                    v1 = jax.vmap(samp)(ks[vs_Ac: vs_Ac + vs_Ap])
+                    v2 = jax.vmap(samp)(ks[vs_Ac + vs_Ap:])
+                    parts.append(v1 - v2)
+                anchors_f = jnp.concatenate(parts, axis=0)  # [A, D]
+                scores = vs_scores(vals_f, len_f, anchors_f, closer_mask)
+                bnd = jnp.quantile(scores, qs, axis=0).T  # [A, B-1]
+                # Keep empty-sequence scores (-FLT_MAX) strictly below
+                # every learnable threshold: an "exists vector" condition
+                # can never hold on an empty sequence.
+                bnd = jnp.maximum(bnd, -1e29)
+                cols_tr.append(binize(bnd, scores.T).astype(jnp.uint8).T)
+                if nv > 0:
+                    sva = vs_scores(
+                        vs_va[0][:, fv], vs_va[1][:, fv], anchors_f,
+                        closer_mask,
+                    )
+                    cols_va.append(
+                        binize(bnd, sva.T).astype(jnp.uint8).T
+                    )
+                anchors_list.append(anchors_f)
+                bnd_list.append(bnd)
+            return (
+                jnp.concatenate(anchors_list, axis=0),
+                jnp.concatenate(bnd_list, axis=0),
+                jnp.concatenate(cols_tr, axis=1),
+                jnp.concatenate(cols_va, axis=1) if nv > 0 else bins_va,
+            )
+
         def boost_step(carry, it):
             if use_dart:
                 preds, vpreds, key, contrib, vcontrib, tree_scale = carry
@@ -828,6 +1021,35 @@ def _make_boost_fn(
                 grow_bins, grow_bins_va = bins_tr, bins_va
                 grow_num_numerical = num_numerical
                 grow_num_valid = num_valid_features
+
+            if vs_tr is not None and vs_Ac + vs_Ap > 0:
+                key, k_vs = jax.random.split(key)
+                vs_a, vs_b, vs_cols, vs_cols_va = make_vs_projections(k_vs)
+                Pv = vs_a.shape[0]
+                # Insert after the oblique block: [num, obl, vs, cat].
+                grow_bins = jnp.concatenate(
+                    [
+                        grow_bins[:, :grow_num_numerical],
+                        vs_cols,
+                        grow_bins[:, grow_num_numerical:],
+                    ],
+                    axis=1,
+                )
+                if nv > 0:
+                    grow_bins_va = jnp.concatenate(
+                        [
+                            grow_bins_va[:, :grow_num_numerical],
+                            vs_cols_va,
+                            grow_bins_va[:, grow_num_numerical:],
+                        ],
+                        axis=1,
+                    )
+                grow_num_numerical += Pv
+                if grow_num_valid is not None:
+                    grow_num_valid += Pv
+            else:
+                vs_a = jnp.zeros((0, 0), jnp.float32)
+                vs_b = jnp.zeros((0, B - 1), jnp.float32)
 
             trees_k, leaves_k = [], []
             new_contrib = jnp.zeros((n, K), jnp.float32)
@@ -911,7 +1133,7 @@ def _make_boost_fn(
                 new_carry = (preds, vpreds, key, contrib, vcontrib, tree_scale)
             else:
                 new_carry = (preds, vpreds, key)
-            return new_carry, (trees, lvs, tl, vl, obl_w, obl_b)
+            return new_carry, (trees, lvs, tl, vl, obl_w, obl_b, vs_a, vs_b)
 
         return boost_step
 
@@ -921,33 +1143,34 @@ def _make_boost_fn(
 
     @jax.jit
     def run(bins_tr, y_tr, w_tr, bins_va, y_va, w_va,
-            x_tr_raw=None, x_va_raw=None, set_tr=None, set_va=None):
+            x_tr_raw=None, x_va_raw=None, set_tr=None, set_va=None,
+            vs_tr=None, vs_va=None):
         carry0, init_pred = _init(y_tr, w_tr)
         step = _make_step(
             bins_tr, y_tr, w_tr, bins_va, y_va, w_va, x_tr_raw, x_va_raw,
-            set_tr, set_va,
+            set_tr, set_va, vs_tr, vs_va,
         )
-        carry_end, (trees, lvs, tls, vls, obl_ws, obl_bs) = jax.lax.scan(
-            step, carry0, jnp.arange(num_trees)
+        carry_end, (trees, lvs, tls, vls, obl_ws, obl_bs, vs_as, vs_bs) = (
+            jax.lax.scan(step, carry0, jnp.arange(num_trees))
         )
         if use_dart:
             # Bake each iteration's final DART weight into its stored leaf
             # values so serving needs no extra state. lvs: [T, K, N, 1].
             tree_scale = carry_end[5]
             lvs = lvs * tree_scale[:, None, None, None]
-        return trees, lvs, tls, vls, init_pred, obl_ws, obl_bs
+        return trees, lvs, tls, vls, init_pred, obl_ws, obl_bs, vs_as, vs_bs
 
     @functools.partial(jax.jit, static_argnames=("chunk_len",))
     def run_chunk(carry, start, chunk_len, bins_tr, y_tr, w_tr,
                   bins_va, y_va, w_va, x_tr_raw=None, x_va_raw=None,
-                  set_tr=None, set_va=None):
+                  set_tr=None, set_va=None, vs_tr=None, vs_va=None):
         """One checkpointable slice of the boosting loop: iterations
         [start, start + chunk_len). Chunking is invisible to the result —
         the per-iteration RNG folds the iteration index into the carried
         key, so any chunk boundary reproduces the single-scan run."""
         step = _make_step(
             bins_tr, y_tr, w_tr, bins_va, y_va, w_va, x_tr_raw, x_va_raw,
-            set_tr, set_va,
+            set_tr, set_va, vs_tr, vs_va,
         )
         return jax.lax.scan(
             step, carry, start + jnp.arange(chunk_len)
@@ -970,13 +1193,15 @@ def _chunk_len(clen: int, start: int, num_trees: int, use_dart: bool) -> int:
 def _chunk_arrays_from_ys(ys) -> dict:
     """run_chunk outputs → the flat dict layout shared by the in-memory
     early-stop path and the on-disk snapshot payloads."""
-    trees_c, lvs_c, tls_c, vls_c, ow_c, ob_c = ys
+    trees_c, lvs_c, tls_c, vls_c, ow_c, ob_c, va_c, vb_c = ys
     d = {f"trees_{j}": np.asarray(a) for j, a in enumerate(trees_c)}
     d["lvs"] = np.asarray(lvs_c)
     d["tls"] = np.asarray(tls_c)
     d["vls"] = np.asarray(vls_c)
     d["ow"] = np.asarray(ow_c)
     d["ob"] = np.asarray(ob_c)
+    d["vsa"] = np.asarray(va_c)
+    d["vsb"] = np.asarray(vb_c)
     return d
 
 
@@ -1006,11 +1231,21 @@ def _merge_chunk_parts(parts, num_trees, use_dart, carry):
     vls = np.concatenate([p["vls"] for p in parts], axis=0)[:num_trees]
     obl_w = np.concatenate([p["ow"] for p in parts], axis=0)[:num_trees]
     obl_b = np.concatenate([p["ob"] for p in parts], axis=0)[:num_trees]
+    def _vs_part(p, key):
+        # Chunk payloads written before the vector-sequence fields.
+        return p.get(key, np.zeros((p["lvs"].shape[0], 0, 0), np.float32))
+
+    vs_a = np.concatenate([_vs_part(p, "vsa") for p in parts], axis=0)[
+        :num_trees
+    ]
+    vs_b = np.concatenate([_vs_part(p, "vsb") for p in parts], axis=0)[
+        :num_trees
+    ]
     if use_dart:
         tree_scale = np.asarray(jax.tree.leaves(carry)[5])
         lvs = lvs * tree_scale[: lvs.shape[0], None, None, None]
     trees = TreeArrays(*[jnp.asarray(a) for a in trees_np])
-    return trees, jnp.asarray(lvs), tls, vls, obl_w, obl_b
+    return trees, jnp.asarray(lvs), tls, vls, obl_w, obl_b, vs_a, vs_b
 
 
 def _train_gbt(
@@ -1021,6 +1256,7 @@ def _train_gbt(
     dart_dropout=0.0, oblique_P=0, oblique_density=2.0,
     oblique_weight_type="BINARY", monotone=None,
     x_tr_raw=None, x_va_raw=None, set_tr=None, set_va=None,
+    vs_tr=None, vs_va=None, vs_Ac=0, vs_Ap=0,
     cache_dir=None, resume=False, snapshot_interval=50,
     abort_after_chunks=None, early_stop_lookahead=0,
 ):
@@ -1043,6 +1279,8 @@ def _train_gbt(
         bins_tr.shape[0], bins_va.shape[0],
         sampling, goss_alpha, goss_beta, selgb_ratio, dart_dropout,
         oblique_P, oblique_density, oblique_weight_type, monotone,
+        vs_Ac if vs_tr is not None else 0,
+        vs_Ap if vs_tr is not None else 0,
     )
     nv_rows = bins_va.shape[0]
     data_args = (bins_tr, y_tr, w_tr, bins_va, y_va, w_va) + (
@@ -1051,6 +1289,9 @@ def _train_gbt(
     data_kwargs = {}
     if set_tr is not None:
         data_kwargs = {"set_tr": set_tr, "set_va": set_va}
+    if vs_tr is not None:
+        data_kwargs["vs_tr"] = vs_tr
+        data_kwargs["vs_va"] = vs_va
     if cache_dir is None:
         if (
             early_stop_lookahead > 0
@@ -1083,8 +1324,8 @@ def _train_gbt(
                     vls_seen, min(start, num_trees), early_stop_lookahead
                 ):
                     break
-            trees, lvs, tls, vls, obl_w, obl_b = _merge_chunk_parts(
-                parts, num_trees, use_dart, carry
+            trees, lvs, tls, vls, obl_w, obl_b, vs_a, vs_b = (
+                _merge_chunk_parts(parts, num_trees, use_dart, carry)
             )
             logs = {
                 "train_loss": tls,
@@ -1092,15 +1333,21 @@ def _train_gbt(
                 "initial_predictions": init_pred,
                 "oblique_w": obl_w,
                 "oblique_b": obl_b,
+                "vs_a": vs_a,
+                "vs_b": vs_b,
             }
             return trees, lvs, logs
-        trees, lvs, tls, vls, init_pred, obl_w, obl_b = run(*data_args, **data_kwargs)
+        trees, lvs, tls, vls, init_pred, obl_w, obl_b, vs_a, vs_b = run(
+            *data_args, **data_kwargs
+        )
         logs = {
             "train_loss": tls,
             "valid_loss": vls,
             "initial_predictions": init_pred,
             "oblique_w": obl_w,
             "oblique_b": obl_b,
+            "vs_a": vs_a,
+            "vs_b": vs_b,
         }
         return trees, lvs, logs
 
@@ -1126,7 +1373,7 @@ def _train_gbt(
                 shrinkage, subsample, candidate_features, num_numerical,
                 num_valid_features, seed, sampling, goss_alpha, goss_beta,
                 selgb_ratio, dart_dropout, oblique_P, oblique_density,
-                oblique_weight_type,
+                oblique_weight_type, vs_Ac, vs_Ap,
             )
         ).encode()
     )
@@ -1230,7 +1477,7 @@ def _train_gbt(
     for st in all_starts:
         with np.load(_chunk_path(st)) as z:
             parts.append({k: z[k] for k in z.files})
-    trees, lvs, tls, vls, obl_w, obl_b = _merge_chunk_parts(
+    trees, lvs, tls, vls, obl_w, obl_b, vs_a, vs_b = _merge_chunk_parts(
         parts, num_trees, use_dart, carry
     )
     logs = {
@@ -1239,6 +1486,8 @@ def _train_gbt(
         "initial_predictions": init_pred,
         "oblique_w": obl_w,
         "oblique_b": obl_b,
+        "vs_a": vs_a,
+        "vs_b": vs_b,
     }
     return trees, lvs, logs
 

@@ -119,12 +119,26 @@ class GenericLearner:
                 # Isolation forests opt out (the reference trains IF on
                 # numerical splits only, isolation_forest.cc).
                 supported.add(ColumnType.CATEGORICAL_SET)
+            if getattr(self, "_supports_vs_features", False):
+                # Anchor-projection splits (reference vector_sequence.cc);
+                # GBT-only for now.
+                supported.add(ColumnType.NUMERICAL_VECTOR_SEQUENCE)
             feature_names = [
                 c.name
                 for c in ds.dataspec.columns
                 if c.name not in exclude and c.type in supported
             ]
         binned = BinnedDataset.create(ds, feature_names, num_bins=self.num_bins)
+        if binned.binner.num_vs > 0 and not getattr(
+            self, "_supports_vs_features", False
+        ):
+            # An explicitly requested VS feature must not silently train
+            # as a no-op column.
+            raise NotImplementedError(
+                f"{type(self).__name__} does not support "
+                f"NUMERICAL_VECTOR_SEQUENCE features "
+                f"{binned.binner.vs_names}"
+            )
 
         out = {
             "dataset": ds,
@@ -132,6 +146,7 @@ class GenericLearner:
             "binner": binned.binner,
             "bins": binned.bins,
             "set_bits": binned.set_bits,  # None without CATEGORICAL_SET cols
+            "vs": binned.vs,  # None without NUMERICAL_VECTOR_SEQUENCE cols
         }
         if self.label is not None:
             # CATEGORICAL_UPLIFT outcomes are dictionary-encoded like
@@ -157,6 +172,7 @@ class GenericLearner:
             out["valid_dataset"] = vds
             out["valid_bins"] = binned.binner.transform(vds)
             out["valid_set_bits"] = binned.binner.transform_sets(vds)
+            out["valid_vs"] = binned.binner.transform_vs(vds)
             if self.label is not None:
                 out["valid_labels"] = vds.encoded_label(self.label, self.task)
             if self.weights is not None:

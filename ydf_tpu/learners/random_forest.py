@@ -86,9 +86,10 @@ class RandomForestLearner(GenericLearner):
         # values — decoupling selection from estimation (Wager & Athey).
         self.honest = honest
         self.honest_ratio_leaf_examples = honest_ratio_leaf_examples
-        # jax.sharding.Mesh: data-parallel training — the per-layer
-        # histogram contraction all-reduces over the data axis via GSPMD
-        # (see ydf_tpu/parallel/mesh.py).
+        # jax.sharding.Mesh: data-parallel (rows over the data axis) and/or
+        # feature-parallel (columns over the feature axis) training — the
+        # per-layer histogram contraction all-reduces over the data axis
+        # via GSPMD (see ydf_tpu/parallel/mesh.py).
         self.mesh = mesh
 
     # ------------------------------------------------------------------ #
@@ -123,10 +124,7 @@ class RandomForestLearner(GenericLearner):
             if self.task in (Task.CATEGORICAL_UPLIFT, Task.NUMERICAL_UPLIFT):
                 raise NotImplementedError("mesh-distributed uplift training")
             dp = self.mesh.shape[pmesh.DATA_AXIS]
-            if self.mesh.shape.get(pmesh.FEATURE_AXIS, 1) > 1:
-                raise NotImplementedError(
-                    "RandomForest supports data-parallel meshes only"
-                )
+            fp = self.mesh.shape.get(pmesh.FEATURE_AXIS, 1)
             # Same pattern as the GBT mesh path (gbt.py): pad rows (zero
             # weight → no effect on statistics), then shard everything.
             arrays = [
@@ -138,7 +136,18 @@ class RandomForestLearner(GenericLearner):
                 arrays.append(np.asarray(set_bits))
             arrays, _ = pmesh.pad_rows_to_multiple(arrays, dp)
             bins_np, w_np, labels_np = arrays[:3]
-            bins = pmesh.shard_batch(self.mesh, bins_np)
+            if fp > 1:
+                # Feature-parallel: pad the feature axis with constant-zero
+                # columns (never a valid split — their right-side count is
+                # 0) and shard [n, F] over (data, feature). Per-node
+                # candidate sampling skips the pad columns via
+                # num_valid_features below.
+                fpad = (-bins_np.shape[1]) % fp
+                if fpad:
+                    bins_np = np.pad(bins_np, ((0, 0), (0, fpad)))
+                bins = pmesh.shard_batch_and_features(self.mesh, bins_np)
+            else:
+                bins = pmesh.shard_batch(self.mesh, bins_np)
             w_base = pmesh.shard_batch(self.mesh, w_np)
             prep["labels"] = pmesh.shard_batch(self.mesh, labels_np)
             if set_bits is not None:
@@ -231,6 +240,11 @@ class RandomForestLearner(GenericLearner):
             bootstrap=self.bootstrap_training_dataset,
             candidate_features=cand,
             num_numerical=binner.num_numerical,
+            num_valid_features=(
+                binner.num_scalar
+                if bins.shape[1] > binner.num_scalar
+                else None
+            ),
             seed=self.random_seed,
             honest_ratio=(
                 self.honest_ratio_leaf_examples if self.honest else 0.0
@@ -339,9 +353,13 @@ def _train_rf(
     bins, w_base, *, stats_fn, rule, tree_cfg: TreeConfig, max_nodes,
     num_trees, bootstrap, candidate_features, num_numerical, seed,
     honest_ratio=0.0, winner_take_all=False, compute_oob=False,
-    oob_importances=False, set_bits=None,
+    oob_importances=False, set_bits=None, num_valid_features=None,
 ):
     n, F = bins.shape
+    # Real (unpadded) scalar columns — under feature-parallel padding the
+    # bins matrix carries trailing constant-zero columns that are neither
+    # split candidates nor permutation-importance targets.
+    Fr = F if num_valid_features is None else num_valid_features
     Fs = 0 if set_bits is None else set_bits.shape[1]
     V = rule.num_outputs
 
@@ -383,6 +401,7 @@ def _train_rf(
                 num_numerical=num_numerical,
                 min_examples=tree_cfg.min_examples,
                 candidate_features=candidate_features,
+                num_valid_features=num_valid_features,
                 set_bits=set_bits,
             )
             if honest_ratio > 0.0:
@@ -428,25 +447,26 @@ def _train_rf(
                             jnp.arange(F)[None, :] == f, col[:, None], bins
                         )
                         if Fs > 0:
-                            # Set features (index block [F, F+Fs)): shuffle
-                            # the whole packed row of the chosen feature.
+                            # Set features (index block [Fr, Fr+Fs)):
+                            # shuffle the whole packed row of the feature.
                             s2 = jnp.where(
-                                (jnp.arange(Fs)[None, :, None] + F) == f,
+                                (jnp.arange(Fs)[None, :, None] + Fr) == f,
                                 set_bits[perm], set_bits,
                             )
                         else:
                             s2 = None
                         leaves = routing.route_tree_bins(
-                            tree, b2, tree_cfg.max_depth, x_set=s2
+                            tree, b2, tree_cfg.max_depth, x_set=s2,
+                            num_scalar=num_valid_features,
                         )
                         return tree_vote(lv, leaves)
 
                     k_shuf = jax.random.split(
-                        jax.random.fold_in(key, 3), F + Fs
+                        jax.random.fold_in(key, 3), Fr + Fs
                     )
                     votes = jax.vmap(shuffled_vote)(
-                        jnp.arange(F + Fs), k_shuf
-                    )  # [F+Fs, n, V]
+                        jnp.arange(Fr + Fs), k_shuf
+                    )  # [Fr+Fs, n, V]
                     oob_shuf = oob_shuf + votes * oob_f[None, :, None]
                 carry = (oob_sum, oob_cnt, oob_shuf)
             return carry, (tree, lv)
@@ -456,7 +476,7 @@ def _train_rf(
                 jnp.zeros((n, V), jnp.float32),
                 jnp.zeros((n,), jnp.float32),
                 jnp.zeros(
-                    (F + Fs if oob_importances else 0, n, V), jnp.float32
+                    (Fr + Fs if oob_importances else 0, n, V), jnp.float32
                 ),
             )
         else:

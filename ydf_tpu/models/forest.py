@@ -55,6 +55,15 @@ class Forest(NamedTuple):
     # projection (decision_tree.proto Oblique.na_replacements, field 4);
     # NaN = no replacement → the whole condition evaluates to na_left.
     oblique_na_repl: jax.Array
+    # NUMERICAL_VECTOR_SEQUENCE anchor conditions (decision_tree.proto:
+    # 133-177). A node with feature >= num_features + P is a VS node:
+    # anchor slot q = feature - num_features - P; the routed value is
+    # max_dot(seq, anchor) or -min_sqdist(seq, anchor) (vs_is_closer),
+    # compared as `v < threshold → left` like every numerical condition
+    # (closer_than threshold2 = -threshold). Pv = 0 without VS splits.
+    vs_anchor: jax.Array      # [T, Pv, D] f32
+    vs_feat: jax.Array        # [T, Pv] i32 index into the VS feature list
+    vs_is_closer: jax.Array   # [T, Pv] bool
     num_nodes: jax.Array      # [T] i32
 
     @property
@@ -88,12 +97,34 @@ class Forest(NamedTuple):
             d["oblique_na_repl"] = np.full(
                 np.shape(d["oblique_weights"]), np.nan, np.float32
             )
+        if "vs_anchor" not in d:
+            T = np.shape(d["feature"])[0]
+            d["vs_anchor"] = np.zeros((T, 0, 0), np.float32)
+            d["vs_feat"] = np.zeros((T, 0), np.int32)
+            d["vs_is_closer"] = np.zeros((T, 0), bool)
         return Forest(**{f: jnp.asarray(d[f]) for f in Forest._fields})
+
+
+def _per_tree_block_thresholds(feature, tbin, block_bnd, lo):
+    """Thresholds for nodes whose feature falls in a per-tree projection
+    block starting at index `lo`: block_bnd [T, P, B-1] holds each tree's
+    per-projection cutpoints."""
+    p_safe = jnp.clip(feature - lo, 0, max(block_bnd.shape[1] - 1, 0))
+    tt = jnp.clip(tbin, 0, block_bnd.shape[2] - 1)
+    return jnp.take_along_axis(
+        jnp.take_along_axis(
+            block_bnd, p_safe[:, :, None].repeat(block_bnd.shape[2], 2),
+            axis=1,
+        ),
+        tt[:, :, None],
+        axis=2,
+    )[:, :, 0]
 
 
 def forest_from_stacked_trees(
     stacked_trees, leaf_value: jax.Array, boundaries: np.ndarray,
     oblique_weights=None, oblique_boundaries=None, oblique_na_repl=None,
+    vs_anchors=None, vs_boundaries=None, vs_feat=None, vs_is_closer=None,
 ) -> Forest:
     """stacked TreeArrays (leading T axis) + leaf values → Forest.
 
@@ -103,34 +134,47 @@ def forest_from_stacked_trees(
     With oblique splits, `oblique_weights` [T, P, Fn] and
     `oblique_boundaries` [T, P, B-1] give each tree's projection vectors and
     per-projection bin cutpoints; nodes whose feature index lies in the
-    projection block carry thresholds from their own tree's boundaries.
+    projection block [F, F+P) carry thresholds from their own tree's
+    boundaries. Vector-sequence anchors occupy the next block
+    [F+P, F+P+Pv) the same way (`vs_anchors` [T, Pv, D], `vs_boundaries`
+    [T, Pv, B-1], `vs_feat` [T, Pv], `vs_is_closer` [T, Pv]).
     """
     feature = jnp.asarray(stacked_trees.feature)
     tbin = jnp.asarray(stacked_trees.threshold_bin)
     bnd = jnp.asarray(boundaries)  # [F, B-1]
-    f_safe = jnp.maximum(feature, 0)
-    t_safe = jnp.clip(tbin, 0, bnd.shape[1] - 1)
-    threshold = bnd[f_safe, t_safe]
-    if oblique_weights is None:
-        oblique_weights = jnp.zeros((feature.shape[0], 0, 0), jnp.float32)
+    if bnd.shape[0] == 0:
+        # No scalar features (e.g. a pure vector-sequence model): every
+        # threshold comes from a projection block below.
+        threshold = jnp.zeros(feature.shape, jnp.float32)
     else:
-        # Per-tree projected-value thresholds: feature index in
-        # [F, F + P) selects projection f - F of its own tree.
+        f_safe = jnp.clip(feature, 0, bnd.shape[0] - 1)
+        t_safe = jnp.clip(tbin, 0, bnd.shape[1] - 1)
+        threshold = bnd[f_safe, t_safe]
+    F = bnd.shape[0]
+    T = feature.shape[0]
+    if oblique_weights is None:
+        oblique_weights = jnp.zeros((T, 0, 0), jnp.float32)
+    else:
         ow = jnp.asarray(oblique_weights)
         ob = jnp.asarray(oblique_boundaries)  # [T, P, B-1]
-        F = bnd.shape[0]
-        is_obl = feature >= F
-        p_safe = jnp.clip(feature - F, 0, max(ow.shape[1] - 1, 0))
-        tt = jnp.clip(tbin, 0, ob.shape[2] - 1)
-        obl_thr = jnp.take_along_axis(
-            jnp.take_along_axis(
-                ob, p_safe[:, :, None].repeat(ob.shape[2], 2), axis=1
-            ),
-            tt[:, :, None],
-            axis=2,
-        )[:, :, 0]
+        P = ow.shape[1]
+        is_obl = (feature >= F) & (feature < F + P)
+        obl_thr = _per_tree_block_thresholds(feature, tbin, ob, F)
         threshold = jnp.where(is_obl, obl_thr, threshold)
         oblique_weights = ow
+    P = oblique_weights.shape[1]
+    if vs_anchors is None:
+        vs_anchors = jnp.zeros((T, 0, 0), jnp.float32)
+        vs_feat = jnp.zeros((T, 0), jnp.int32)
+        vs_is_closer = jnp.zeros((T, 0), jnp.bool_)
+    else:
+        vs_anchors = jnp.asarray(vs_anchors)
+        vb = jnp.asarray(vs_boundaries)  # [T, Pv, B-1]
+        is_vs = feature >= F + P
+        vs_thr = _per_tree_block_thresholds(feature, tbin, vb, F + P)
+        threshold = jnp.where(is_vs, vs_thr, threshold)
+        vs_feat = jnp.asarray(vs_feat, jnp.int32)
+        vs_is_closer = jnp.asarray(vs_is_closer, jnp.bool_)
     return Forest(
         feature=feature,
         threshold=threshold.astype(jnp.float32),
@@ -158,5 +202,8 @@ def forest_from_stacked_trees(
             if oblique_na_repl is None
             else jnp.asarray(oblique_na_repl)
         ),
+        vs_anchor=vs_anchors,
+        vs_feat=vs_feat,
+        vs_is_closer=vs_is_closer,
         num_nodes=jnp.asarray(stacked_trees.num_nodes),
     )

@@ -125,6 +125,14 @@ class GenericModel:
     # lives in JAX arrays)
     # ------------------------------------------------------------------ #
 
+    def to_standalone_cc(self, name: str = "ydf_model") -> dict:
+        """Dependency-free C++ header reproducing this model's predictions
+        bit-for-bit (reference embed subsystem, serving/embed/embed.h:
+        27-30). Returns {filename: source}."""
+        from ydf_tpu.serving.embed import to_standalone_cc
+
+        return to_standalone_cc(self, name=name)
+
     def to_jax_function(self, apply_link_function: bool = True):
         """Returns (fn, params, encoder):
 
@@ -275,6 +283,14 @@ class GenericModel:
                     x_set[:, j, :] = ds.encoded_categorical_set(name, W)
         return x_num, x_cat, x_set
 
+    def _encode_vs(self, ds: Dataset):
+        """(values [n, Fv, L, D], lengths [n, Fv], missing [n, Fv]) padded
+        vector-sequence inputs, or None when the model has none."""
+        b = self.binner
+        if getattr(b, "num_vs", 0) == 0:
+            return None
+        return b.transform_vs(ds)
+
     def _encode_set_missing(self, ds: Dataset):
         """bool [n, Fs] per-cell missing mask for set features (drives
         na_value routing of imported models); None when no set features."""
@@ -323,10 +339,18 @@ class GenericModel:
     def _raw_scores(self, data: InputData, combine: str) -> np.ndarray:
         ds = Dataset.from_data(data, dataspec=self.dataspec)
         x_num, x_cat, x_set = self._encode_inputs(ds)
-        if combine == "sum" and not self.native_missing and x_set is None:
+        vs = self._encode_vs(ds)
+        if (
+            combine == "sum"
+            and not self.native_missing
+            and x_set is None
+            and vs is None
+        ):
             eng = self._fast_engine()
             if eng is not None:
-                return np.asarray(eng(jnp.asarray(x_num)))[:, None]
+                return np.asarray(
+                    eng(jnp.asarray(x_num), jnp.asarray(x_cat))
+                )[:, None]
         set_missing = (
             self._encode_set_missing(ds) if self.native_missing else None
         )
@@ -340,6 +364,13 @@ class GenericModel:
             x_set=None if x_set is None else jnp.asarray(x_set),
             set_missing=(
                 None if set_missing is None else jnp.asarray(set_missing)
+            ),
+            x_vs_vals=None if vs is None else jnp.asarray(vs[0]),
+            x_vs_len=None if vs is None else jnp.asarray(vs[1]),
+            vs_missing=(
+                jnp.asarray(vs[2])
+                if vs is not None and self.native_missing
+                else None
             ),
         )
         return np.asarray(out)

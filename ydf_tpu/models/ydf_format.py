@@ -136,6 +136,12 @@ def _parse_column(msg: pw.Message) -> _YdfColumn:
             ]
             col.vocab_counts = [0] * max(n_unique, 1)
 
+    vseq = pw.get_msg(msg, 13)  # numerical_vector_sequence = 13 (:237-248)
+    if vseq is not None:
+        col.vector_length = pw.get_sint(vseq, 1, 0)
+        col.min_num_vectors = pw.get_sint(vseq, 3, 0)
+        col.max_num_vectors = pw.get_sint(vseq, 4, 0)
+
     booln = pw.get_msg(msg, 9)  # boolean = 9 (BooleanSpec, :232-235)
     if booln is not None:
         ct = pw.get_sint(booln, 1, 0)
@@ -267,13 +273,15 @@ class _FeatureMap:
 
     def __init__(self, spec: DataSpecification, ycols: List[_YdfColumn],
                  input_features: List[int]):
-        num_like, cat_like, set_like = [], [], []
+        num_like, cat_like, set_like, vs_like = [], [], [], []
         for ci in input_features:
             t = spec.columns[ci].type
             if t == ColumnType.CATEGORICAL:
                 cat_like.append(ci)
             elif t == ColumnType.CATEGORICAL_SET:
                 set_like.append(ci)
+            elif t == ColumnType.NUMERICAL_VECTOR_SEQUENCE:
+                vs_like.append(ci)
             elif t in (
                 ColumnType.NUMERICAL,
                 ColumnType.BOOLEAN,
@@ -287,9 +295,15 @@ class _FeatureMap:
         self.num_cols = num_like
         self.cat_cols = cat_like
         self.set_cols = set_like
+        self.vs_cols = vs_like
         self.col_to_feature: Dict[int, int] = {}
         for i, ci in enumerate(num_like + cat_like + set_like):
             self.col_to_feature[ci] = i
+        # Vector-sequence columns live in their own index space (the
+        # forest's per-tree anchor block), not in col_to_feature.
+        self.col_to_vs: Dict[int, int] = {
+            ci: j for j, ci in enumerate(vs_like)
+        }
         self.num_numerical = len(num_like)
         self.ycols = ycols
         self.spec = spec
@@ -332,6 +346,18 @@ class _FeatureMap:
             impute_values=impute,
             feature_num_bins=fnb,
             num_set=len(self.set_cols),
+            vs_names=[self.spec.columns[ci].name for ci in self.vs_cols],
+            vs_dims=[
+                max(self.spec.columns[ci].vector_length, 1)
+                for ci in self.vs_cols
+            ],
+            vs_max_len=max(
+                (
+                    max(self.spec.columns[ci].max_num_vectors, 1)
+                    for ci in self.vs_cols
+                ),
+                default=0,
+            ),
         )
 
 
@@ -379,10 +405,13 @@ def trees_to_forest(
 
     per_tree = []
     per_tree_proj: List[List[np.ndarray]] = []
+    per_tree_vs: List[List[tuple]] = []
+    _VS_BASE = 1 << 20  # sentinel block remapped once max_P is known
     max_nodes, max_depth = 1, 1
     for root in trees:
         rows: List[dict] = []
         projs: List[np.ndarray] = []
+        vs_list: List[tuple] = []
 
         def walk(node: _Node, depth: int) -> int:
             idx = len(rows)
@@ -399,7 +428,9 @@ def trees_to_forest(
                 row["leaf_value"] = leaf_fn(node.leaf, depth)
                 return idx
             ci = node.attribute
-            row["feature"] = fmap.col_to_feature[ci]
+            # VS columns have no scalar feature slot; the ct==8 branch
+            # assigns their sentinel-block index.
+            row["feature"] = fmap.col_to_feature.get(ci, -1)
             ct, c = node.cond_type, node.cond
             if ct == 2:  # Higher: value >= threshold → positive (:93-96)
                 row["threshold"] = pw.get_float(c, 1)
@@ -457,6 +488,35 @@ def trees_to_forest(
                 row["feature"] = F_total + len(projs)
                 row["threshold"] = pw.get_float(c, 3)
                 projs.append((wvec, rvec))
+            elif ct == 8:  # NumericalVectorSequence (:133-177)
+                fv = fmap.col_to_vs.get(ci)
+                if fv is None:
+                    raise ValueError(
+                        "vector-sequence condition on a non-VS column"
+                    )
+                closer = pw.get_msg(c, 1)
+                projm = pw.get_msg(c, 2)
+                if closer is not None:
+                    anc_msg = pw.get_msg(closer, 1)
+                    anchor = np.asarray(
+                        pw.get_packed_floats(anc_msg, 1), np.float32
+                    )
+                    # closer_than: min|v-a|^2 <= threshold2 ⇔ routed value
+                    # -min|v-a|^2 >= -threshold2 (vector_sequence.cc:92-99
+                    # negates the same way).
+                    row["threshold"] = -pw.get_float(closer, 2)
+                    is_closer = True
+                elif projm is not None:
+                    anc_msg = pw.get_msg(projm, 1)
+                    anchor = np.asarray(
+                        pw.get_packed_floats(anc_msg, 1), np.float32
+                    )
+                    row["threshold"] = pw.get_float(projm, 2)
+                    is_closer = False
+                else:
+                    raise ValueError("empty vector-sequence condition")
+                row["feature"] = _VS_BASE + len(vs_list)
+                vs_list.append((fv, anchor, is_closer))
             else:
                 raise NotImplementedError(f"condition type {ct}")
             # Negative child → left, positive child → right (our routing:
@@ -473,10 +533,48 @@ def trees_to_forest(
         walk(root, 0)
         per_tree.append(rows)
         per_tree_proj.append(projs)
+        per_tree_vs.append(vs_list)
         max_nodes = max(max_nodes, len(rows))
         max_depth = max(max_depth, depth_of(root))
 
     max_P = max((len(p) for p in per_tree_proj), default=0)
+    max_Pv = max((len(v) for v in per_tree_vs), default=0)
+    if max_Pv > 0:
+        # Anchor width must match the serving-side input padding, which
+        # covers EVERY declared VS column (binner.vs_dim) — not just the
+        # dims of anchors that happen to appear in trees.
+        Dv = max(
+            (len(a) for vl in per_tree_vs for (_, a, _c) in vl), default=1
+        )
+        Dv = max(
+            Dv,
+            max(
+                (
+                    fmap.spec.columns[ci].vector_length
+                    for ci in fmap.vs_cols
+                ),
+                default=1,
+            ),
+        )
+        vs_anchor = np.zeros((T, max_Pv, Dv), np.float32)
+        vs_feat = np.zeros((T, max_Pv), np.int32)
+        vs_is_closer = np.zeros((T, max_Pv), bool)
+        for t, vl in enumerate(per_tree_vs):
+            for q, (fv, anchor, is_c) in enumerate(vl):
+                vs_anchor[t, q, : len(anchor)] = anchor
+                vs_feat[t, q] = fv
+                vs_is_closer[t, q] = is_c
+        # Sentinel block → [F_total + max_P, F_total + max_P + max_Pv).
+        for rows in per_tree:
+            for row in rows:
+                if row["feature"] >= _VS_BASE:
+                    row["feature"] = (
+                        F_total + max_P + (row["feature"] - _VS_BASE)
+                    )
+    else:
+        vs_anchor = np.zeros((T, 0, 0), np.float32)
+        vs_feat = np.zeros((T, 0), np.int32)
+        vs_is_closer = np.zeros((T, 0), bool)
     if max_P > 0:
         obl = np.zeros((T, max_P, Fn), np.float32)
         obl_r = np.full((T, max_P, Fn), np.nan, np.float32)
@@ -514,6 +612,9 @@ def trees_to_forest(
         cover=stack("cover", np.float32),
         oblique_weights=obl,
         oblique_na_repl=obl_r,
+        vs_anchor=vs_anchor,
+        vs_feat=vs_feat,
+        vs_is_closer=vs_is_closer,
         num_nodes=np.array([len(r) for r in per_tree], np.int32),
     )
     return forest, max(max_depth, 1)
@@ -830,6 +931,13 @@ def _encode_column(col: Column) -> bytes:
             items += pw.put_msg(7, entry)
         cat = pw.put_int(2, col.vocab_size) + items
         out += pw.put_msg(6, cat)
+    if col.type == ColumnType.NUMERICAL_VECTOR_SEQUENCE:
+        vseq = (
+            pw.put_int(1, int(col.vector_length))
+            + pw.put_int(3, int(col.min_num_vectors))
+            + pw.put_int(4, int(col.max_num_vectors))
+        )
+        out += pw.put_msg(13, vseq)
     if col.num_missing:
         out += pw.put_int(7, int(col.num_missing))
     return out
@@ -849,7 +957,27 @@ def _encode_node(row: dict, leaf_payload: bytes,
         return leaf_payload
     feat = int(row["feature"])
     F_total = row["F_total"]
-    if feat >= F_total:
+    P_obl = forest_np["oblique_weights"].shape[1]
+    if feat >= F_total + P_obl:
+        # Vector-sequence anchor -> Condition.NumericalVectorSequence
+        # (:133-177). Routed value v = max_dot or -min_sqdist; our
+        # "v >= threshold -> positive" maps to threshold (projected) /
+        # threshold2 = -threshold (closer).
+        q = feat - F_total - P_obl
+        anchor = np.asarray(forest_np["vs_anchor"][t, q], np.float32)
+        anchor = anchor[: row.get("vs_dim", len(anchor))]
+        anc = pw.put_msg(1, pw.put_packed_floats(1, anchor))
+        if bool(forest_np["vs_is_closer"][t, q]):
+            inner = pw.put_msg(
+                1, anc + pw.put_float(2, -float(row["threshold"]))
+            )
+        else:
+            inner = pw.put_msg(
+                2, anc + pw.put_float(2, float(row["threshold"]))
+            )
+        cond_type = pw.put_msg(8, inner)
+        attribute = row["col_idx"]
+    elif feat >= F_total:
         # Oblique projection -> Condition.Oblique (:114-131).
         p = feat - F_total
         w_vec = forest_np["oblique_weights"][t, p]
@@ -943,7 +1071,9 @@ def export_ydf_model(model, path: str) -> None:
     # Dataspec: input features in our serving order + label (+ group /
     # treatment columns).
     col_index: Dict[str, int] = {}
-    for name in binner.feature_names:
+    for name in list(binner.feature_names) + list(
+        getattr(binner, "vs_names", [])
+    ):
         col = model.dataspec.column_by_name(name)
         spec_cols.append(col)
         col_index[name] = len(spec_cols) - 1
@@ -984,7 +1114,12 @@ def export_ydf_model(model, path: str) -> None:
         + pw.put_int(2, task_code)
         + pw.put_int(3, label_idx)
         + pw.put_packed_varints(
-            5, [col_index[n] for n in binner.feature_names]
+            5,
+            [
+                col_index[n]
+                for n in list(binner.feature_names)
+                + list(getattr(binner, "vs_names", []))
+            ],
         )
     )
     if ranking_idx >= 0:
@@ -1050,12 +1185,17 @@ def export_ydf_model(model, path: str) -> None:
                 "obl_cols": obl_cols,
             }
             feat = row["feature"]
+            P_obl = f_np["oblique_weights"].shape[1]
             if not row["is_leaf"] and not model.native_missing:
                 # Our learners impute missing values at encode time; the
                 # reference routes them per-node by na_value. Bake the
                 # equivalent direction in: where the imputed value (or the
                 # OOV category) would have gone.
-                if feat >= F_total:  # oblique: dot of imputed numericals
+                if feat >= F_total + P_obl:
+                    # VS: missing encodes as empty -> score -FLT_MAX ->
+                    # below any learned threshold -> negative branch.
+                    row["na_left"] = True
+                elif feat >= F_total:  # oblique: dot of imputed numericals
                     w_vec = f_np["oblique_weights"][t, feat - F_total]
                     v = float(
                         np.dot(binner.impute_values[:Fn], w_vec)
@@ -1080,7 +1220,16 @@ def export_ydf_model(model, path: str) -> None:
                 row["vocab_size"] = col.vocab_size
                 if col.type == ColumnType.DISCRETIZED_NUMERICAL:
                     row["disc_boundaries"] = col.discretized_boundaries
-            if row["feature"] >= F_total and "oblique_na_repl" in f_np:
+            if feat >= F_total + P_obl:
+                fv = int(f_np["vs_feat"][t, feat - F_total - P_obl])
+                vs_name = binner.vs_names[fv]
+                row["col_idx"] = col_index[vs_name]
+                row["vs_dim"] = model.dataspec.column_by_name(
+                    vs_name
+                ).vector_length or None
+            if F_total <= row["feature"] < F_total + P_obl and (
+                "oblique_na_repl" in f_np
+            ):
                 row["obl_repl"] = f_np["oblique_na_repl"][
                     t, row["feature"] - F_total
                 ]

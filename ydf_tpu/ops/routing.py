@@ -63,6 +63,12 @@ def route_tree_bins(
             "binned routing over oblique forests is not supported; use "
             "value-mode routing (forest_predict_values)"
         )
+    va = getattr(tree, "vs_anchor", None)
+    if va is not None and va.size > 0:
+        raise NotImplementedError(
+            "binned routing over vector-sequence forests is not supported; "
+            "use value-mode routing (forest_predict_values)"
+        )
     n, Fb = bins.shape
 
     def body(_, node):
@@ -93,6 +99,32 @@ def route_tree_bins(
     return jax.lax.fori_loop(0, max_depth, body, jnp.zeros((n,), i32))
 
 
+def _vs_tree_projections(tree, x_vs_vals, x_vs_len):
+    """Per-example projection values of one tree's VS anchors: [n, Pv].
+
+    Anchors live per tree (vs_anchor [Pv, D], vs_feat [Pv], vs_is_closer
+    [Pv]); scores are computed per VS feature against ALL anchors, then
+    each anchor selects its own feature's column (Fv is small, the
+    redundant factor is cheap and keeps the kernel batched)."""
+    from ydf_tpu.ops.vector_sequence import vs_scores
+
+    Fv = x_vs_vals.shape[1]
+    per_feat = [
+        vs_scores(
+            x_vs_vals[:, fv], x_vs_len[:, fv], tree.vs_anchor,
+            tree.vs_is_closer,
+        )
+        for fv in range(Fv)
+    ]
+    stacked = jnp.stack(per_feat, axis=1)  # [n, Fv, Pv]
+    fsel = jnp.clip(tree.vs_feat, 0, Fv - 1)  # [Pv]
+    n = stacked.shape[0]
+    return jnp.take_along_axis(
+        stacked, jnp.broadcast_to(fsel[None, None, :], (n, 1, fsel.shape[0])),
+        axis=1,
+    )[:, 0, :]
+
+
 def route_tree_values(
     tree,
     x_num: jax.Array,  # f32 [n, Fn] (missing already imputed)
@@ -101,10 +133,14 @@ def route_tree_values(
     max_depth: int,
     x_set: Optional[jax.Array] = None,       # u32 [n, Fs, W] packed sets
     set_missing: Optional[jax.Array] = None,  # bool [n, Fs] missing cells
+    x_vs_vals: Optional[jax.Array] = None,   # f32 [n, Fv, L, D] sequences
+    x_vs_len: Optional[jax.Array] = None,    # i32 [n, Fv]
+    vs_missing: Optional[jax.Array] = None,  # bool [n, Fv] missing cells
 ) -> jax.Array:
     """Leaf node id per example, value mode. tree.threshold is float.
     Feature index space: [0, Fn) numerical, [Fn, Fn+Fc) categorical,
-    [Fn+Fc, Fn+Fc+Fs) categorical-set, [F_total, F_total+P) oblique."""
+    [Fn+Fc, Fn+Fc+Fs) categorical-set, [F_total, F_total+P) oblique,
+    [F_total+P, F_total+P+Pv) vector-sequence anchors."""
     n = x_num.shape[0] if x_num.size else x_cat.shape[0]
     ow = getattr(tree, "oblique_weights", None)
     onr = getattr(tree, "oblique_na_repl", None)
@@ -112,6 +148,13 @@ def route_tree_values(
     Fs = 0 if x_set is None else x_set.shape[1]
     F_total = x_num.shape[1] + x_cat.shape[1] + Fs
     num_scalar = F_total - Fs
+    va = getattr(tree, "vs_anchor", None)
+    Pv = 0 if va is None else va.shape[0]
+    if Pv > 0 and x_vs_vals is not None:
+        # One batched kernel pass per tree, outside the depth loop.
+        vs_proj = _vs_tree_projections(tree, x_vs_vals, x_vs_len)
+    else:
+        vs_proj = None
 
     def body(_, node):
         f = jnp.maximum(tree.feature[node], 0)
@@ -141,7 +184,16 @@ def route_tree_values(
             )
             x_eff = jnp.where(w_vec != 0, x_eff, 0.0)
             v = jnp.where(
-                f >= F_total, jnp.sum(x_eff * w_vec, axis=1), v
+                (f >= F_total) & (f < F_total + P),
+                jnp.sum(x_eff * w_vec, axis=1),
+                v,
+            )
+        if vs_proj is not None:
+            q_id = jnp.clip(f - F_total - P, 0, vs_proj.shape[1] - 1)
+            v = jnp.where(
+                f >= F_total + P,
+                jnp.take_along_axis(vs_proj, q_id[:, None], axis=1)[:, 0],
+                v,
             )
         go_left = jnp.where(
             is_cat,
@@ -167,6 +219,21 @@ def route_tree_values(
                 missing = jnp.where(is_set[node], sm, missing)
             else:
                 missing = jnp.where(is_set[node], False, missing)
+        if vs_proj is not None:
+            # A VS projection value is never NaN (empty → -FLT_MAX), so
+            # missing-ness comes from the per-cell mask when provided.
+            is_vs_node = f >= F_total + P
+            if vs_missing is not None:
+                q_id = jnp.clip(f - F_total - P, 0, vs_proj.shape[1] - 1)
+                fv = jnp.clip(
+                    tree.vs_feat[q_id], 0, vs_missing.shape[1] - 1
+                )
+                vm = jnp.take_along_axis(
+                    vs_missing, fv[:, None], axis=1
+                )[:, 0]
+                missing = jnp.where(is_vs_node, vm, missing)
+            else:
+                missing = jnp.where(is_vs_node, False, missing)
         go_left = jnp.where(missing, tree.na_left[node], go_left)
         nxt = jnp.where(go_left, tree.left[node], tree.right[node])
         return jnp.where(tree.is_leaf[node], node, nxt)
@@ -208,6 +275,9 @@ def forest_predict_values(
     combine: str = "sum",
     x_set: Optional[jax.Array] = None,
     set_missing: Optional[jax.Array] = None,
+    x_vs_vals: Optional[jax.Array] = None,
+    x_vs_len: Optional[jax.Array] = None,
+    vs_missing: Optional[jax.Array] = None,
 ) -> jax.Array:
     T = forest.leaf_value.shape[0]
     n = x_num.shape[0] if x_num.size else x_cat.shape[0]
@@ -216,6 +286,7 @@ def forest_predict_values(
         leaves = route_tree_values(
             tree, x_num, x_cat, num_numerical, max_depth,
             x_set=x_set, set_missing=set_missing,
+            x_vs_vals=x_vs_vals, x_vs_len=x_vs_len, vs_missing=vs_missing,
         )
         return acc + tree.leaf_value[leaves], None
 

@@ -1,4 +1,5 @@
 from ydf_tpu.parallel.mesh import (
+    init_distributed,
     make_mesh,
     shard_batch,
     shard_batch_and_features,
@@ -7,6 +8,7 @@ from ydf_tpu.parallel.mesh import (
 )
 
 __all__ = [
+    "init_distributed",
     "make_mesh",
     "shard_batch",
     "shard_batch_and_features",

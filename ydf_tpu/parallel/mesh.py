@@ -41,6 +41,50 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "data"
 FEATURE_AXIS = "feature"
 
+_distributed_initialized = False
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids=None,
+) -> int:
+    """Multi-host entry point — the TPU-native replacement for the
+    reference's gRPC worker bring-up (`utils/distribute/implementations/
+    grpc/grpc_worker_main.cc`, `grpc_manager.cc`).
+
+    Call once per host process before building a mesh. On Cloud TPU pods
+    (and other managed environments) all arguments are auto-detected from
+    the environment and may be omitted; on a hand-rolled cluster pass the
+    coordinator's `host:port`, the world size, and this process's rank —
+    the same three facts the reference's `socket_addresses` config
+    carries (`grpc.proto:26`).
+
+    After this returns, `jax.devices()` spans every host's chips,
+    `make_mesh()` lays the data axis across DCN, and the SAME sharded
+    training code runs unchanged — histogram all-reduces ride ICI within
+    a slice and DCN across slices; there is no separate multi-host code
+    path in the learners. Returns this process's index.
+
+    Idempotent: repeated calls (e.g. from tests) are no-ops.
+    """
+    global _distributed_initialized
+    if _distributed_initialized:
+        return jax.process_index()
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = local_device_ids
+    jax.distributed.initialize(**kwargs)
+    _distributed_initialized = True
+    return jax.process_index()
+
 
 def make_mesh(
     devices: Optional[Sequence] = None,

@@ -1,0 +1,283 @@
+"""Embed codegen: compile a trained forest to dependency-free C++.
+
+Counterpart of the reference's embed subsystem
+(`ydf/serving/embed/embed.h:27-30`: "generate the code to run a model with
+minimal dependency", C++ lowering in
+`embed/cpp/cpp_target_lowering.cc`): the generated header is standalone —
+no ydf_tpu, no JAX, nothing beyond <cstdint>/<cmath> — and reproduces the
+model's predictions bit-for-bit (same f32 comparisons, same f32
+accumulation order as ops/routing.py's tree scan).
+
+Like the reference's `Algorithm::IF_ELSE` mode, every tree lowers to an
+if-else chain; categorical contains-conditions test a bit in a static
+per-node uint32 mask bank. The entry points mirror embed.h's generated
+API shape:
+
+    struct Instance { float f1; ...; FeatureBlah blah; ... };
+    float PredictRaw(const Instance&);   // margin / score
+    float Predict(const Instance&);      // link applied (proba / value)
+
+Unsupported (falls back to serving the model normally): oblique and
+vector-sequence conditions, categorical-set features, multi-output
+forests.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+def _ident(name: str) -> str:
+    """C++ identifier from an arbitrary column / item name."""
+    s = re.sub(r"[^0-9a-zA-Z_]", "_", name)
+    if not s or s[0].isdigit():
+        s = "_" + s
+    return s
+
+
+def _f32(v: float) -> str:
+    """Shortest float literal that round-trips through float32."""
+    f = np.float32(v)
+    if np.isinf(f):
+        return "INFINITY" if f > 0 else "-INFINITY"
+    # %.9g round-trips binary32 exactly.
+    s = f"{float(f):.9g}"
+    if "." not in s and "e" not in s and "inf" not in s and "nan" not in s:
+        s += ".0"
+    return s + "f"
+
+
+class EmbedUnsupported(Exception):
+    pass
+
+
+def to_standalone_cc(
+    model, name: str = "ydf_model", namespace: Optional[str] = None
+) -> Dict[str, str]:
+    """Returns {"<name>.h": header_source}. Raises EmbedUnsupported for
+    models outside the envelope."""
+    from ydf_tpu.config import Task
+    from ydf_tpu.models.gbt_model import GradientBoostedTreesModel
+    from ydf_tpu.models.rf_model import RandomForestModel
+
+    namespace = namespace or name
+    f = model.forest.to_numpy()
+    binner = model.binner
+    if f["oblique_weights"].size > 0:
+        raise EmbedUnsupported("oblique conditions")
+    if f.get("vs_anchor") is not None and f["vs_anchor"].size > 0:
+        raise EmbedUnsupported("vector-sequence conditions")
+    if getattr(binner, "num_set", 0) > 0:
+        raise EmbedUnsupported("categorical-set features")
+    if f["leaf_value"].shape[-1] != 1:
+        raise EmbedUnsupported("multi-output forest")
+    if getattr(model, "num_trees_per_iter", 1) > 1:
+        # Multi-class GBT stores K single-output trees per iteration and
+        # softmaxes per-class sub-forests — one accumulator can't
+        # reproduce it.
+        raise EmbedUnsupported("multi-class forest")
+    if getattr(model, "native_missing", False):
+        # Imported models route missing values per node (na_left); the
+        # generated code bakes imputation instead.
+        raise EmbedUnsupported("imported model with native missing-value "
+                               "routing")
+
+    is_gbt = isinstance(model, GradientBoostedTreesModel)
+    is_rf = isinstance(model, RandomForestModel)
+    if not (is_gbt or is_rf):
+        raise EmbedUnsupported(type(model).__name__)
+
+    Fn = binner.num_numerical
+    names = binner.feature_names
+    T = f["feature"].shape[0]
+
+    # --- Instance struct + categorical enums ---------------------------
+    lines: List[str] = []
+    enums: List[str] = []
+    fields: List[str] = []
+    for i, fname in enumerate(names):
+        cid = _ident(fname)
+        if i < Fn:
+            fields.append(
+                f"  float {cid} = {_f32(binner.impute_values[i])};"
+                f"  // NUMERICAL; default = training mean"
+            )
+        else:
+            col = model.dataspec.column_by_name(fname)
+            items = []
+            seen = set()
+            for idx, item in enumerate(col.vocabulary or []):
+                base = _ident(item) if idx else "kOutOfVocabulary"
+                cand, k = base, 1
+                while cand in seen:
+                    k += 1
+                    cand = f"{base}_{k}"
+                seen.add(cand)
+                items.append(f"    {cand} = {idx},")
+            enums.append(
+                f"enum class Feature{cid} : uint32_t {{\n"
+                + "\n".join(items)
+                + "\n};"
+            )
+            fields.append(
+                f"  Feature{cid} {cid} = Feature{cid}::kOutOfVocabulary;"
+            )
+
+    # --- categorical mask bank -----------------------------------------
+    mask_bank: List[str] = []
+    mask_index: Dict[tuple, int] = {}
+
+    def mask_id(t: int, nid: int, width_bits: int) -> int:
+        words = tuple(
+            int(w) for w in f["cat_mask"][t, nid][: (width_bits + 31) // 32]
+        )
+        if words not in mask_index:
+            mask_index[words] = len(mask_bank)
+            mask_bank.append(
+                "{" + ", ".join(f"0x{w:08x}u" for w in words) + "}"
+            )
+        return mask_index[words]
+
+    max_words = int(np.shape(f["cat_mask"])[-1])
+
+    # --- per-tree if-else lowering -------------------------------------
+    def lower_tree(t: int) -> str:
+        out: List[str] = []
+
+        def emit(nid: int, indent: str):
+            if f["is_leaf"][t, nid]:
+                out.append(
+                    f"{indent}acc += {_f32(f['leaf_value'][t, nid, 0])};"
+                )
+                return
+            feat = int(f["feature"][t, nid])
+            cid = _ident(names[feat])
+            if bool(f["is_cat"][t, nid]):
+                col = model.dataspec.column_by_name(names[feat])
+                m = mask_id(t, nid, max(col.vocab_size, 1))
+                cond = (
+                    f"BitSet(kMasks[{m}], "
+                    f"static_cast<uint32_t>(instance.{cid}))"
+                )
+            else:
+                thr = _f32(f["threshold"][t, nid])
+                mean = _f32(binner.impute_values[feat])
+                cond = f"Imp(instance.{cid}, {mean}) < {thr}"
+            out.append(f"{indent}if ({cond}) {{")
+            emit(int(f["left"][t, nid]), indent + "  ")
+            out.append(f"{indent}}} else {{")
+            emit(int(f["right"][t, nid]), indent + "  ")
+            out.append(f"{indent}}}")
+
+        emit(0, "  ")
+        return "\n".join(out)
+
+    trees_src = []
+    for t in range(T):
+        trees_src.append(
+            f"inline void AddTree{t}(const Instance& instance, float& acc)"
+            f" {{\n{lower_tree(t)}\n}}"
+        )
+
+    # --- prediction wrapper --------------------------------------------
+    init = 0.0
+    link = "raw"
+    if is_gbt:
+        init = float(np.asarray(model.initial_predictions).reshape(-1)[0])
+        if model.apply_link_function:
+            if model.task == Task.CLASSIFICATION:
+                link = "sigmoid"
+            elif getattr(model, "loss_name", "") == "POISSON":
+                link = "exp"  # log link (gbt_model.py predict)
+    combine_mean = is_rf
+    # Same f32 operation order as the routed engine (ops/routing.py):
+    # trees accumulate from zero in scan order; the initial prediction
+    # (GBT) / the mean division (RF) applies at the end — this is what
+    # makes the generated code bit-exact against model.predict().
+    pred_body = [
+        "  float acc = 0.0f;",
+        *(f"  AddTree{t}(instance, acc);" for t in range(T)),
+    ]
+    if combine_mean:
+        pred_body.append(f"  acc /= {T}.0f;")
+    if init != 0.0:
+        pred_body.append(f"  acc += {_f32(init)};")
+    pred_body.append("  return acc;")
+
+    if link == "sigmoid":
+        predict_fn = (
+            "inline float Predict(const Instance& instance) {\n"
+            "  // Binary classification: probability of the positive "
+            "class.\n"
+            "  return 1.0f / (1.0f + std::exp(-PredictRaw(instance)));\n"
+            "}"
+        )
+    elif link == "exp":
+        predict_fn = (
+            "inline float Predict(const Instance& instance) {\n"
+            "  // Poisson log link.\n"
+            "  return std::exp(PredictRaw(instance));\n"
+            "}"
+        )
+    else:
+        predict_fn = (
+            "inline float Predict(const Instance& instance) {\n"
+            "  return PredictRaw(instance);\n"
+            "}"
+        )
+
+    label_doc = f"// Label: {model.label!r}; task: {model.task.value}."
+    header = f"""// Generated by ydf_tpu embed codegen — dependency-free standalone model.
+// (Counterpart of the reference's serving/embed C++ target,
+//  ydf/serving/embed/embed.h:27-30.)
+{label_doc}
+#ifndef YDF_TPU_EMBED_{_ident(name).upper()}_H_
+#define YDF_TPU_EMBED_{_ident(name).upper()}_H_
+
+#include <cmath>
+#include <cstdint>
+
+namespace {_ident(namespace)} {{
+
+{chr(10).join(enums)}
+
+struct Instance {{
+{chr(10).join(fields)}
+}};
+
+namespace internal {{
+
+// Missing numericals impute with the training mean — both the field
+// default (absent feature) and an explicit NaN resolve to it, matching
+// the routed engine's encode-time global imputation.
+inline float Imp(float v, float mean) {{
+  return std::isnan(v) ? mean : v;
+}}
+
+inline bool BitSet(const uint32_t* mask, uint32_t idx) {{
+  return (mask[idx >> 5] >> (idx & 31u)) & 1u;
+}}
+
+inline constexpr uint32_t kMasks[{max(len(mask_bank), 1)}][{max_words}] = {{
+  {", ".join(mask_bank) if mask_bank else "{0u}"}
+}};
+
+{chr(10).join(trees_src)}
+
+}}  // namespace internal
+
+inline float PredictRaw(const Instance& instance) {{
+  using namespace internal;
+{chr(10).join(pred_body)}
+}}
+
+{predict_fn}
+
+}}  // namespace {_ident(namespace)}
+
+#endif  // YDF_TPU_EMBED_{_ident(name).upper()}_H_
+"""
+    return {f"{name}.h": header}

@@ -16,11 +16,17 @@ formulation with AVX2 registers over examples). The kernel keeps the
 example block, the live masks and the leaf values in VMEM; conditions are
 scalar-prefetched into SMEM.
 
+Categorical "contains" conditions (quick_scorer_extended.h:63-81) are
+supported: each carries a per-category go-left bitmap; the kernel tests
+the example's category bit with a static unroll over the bitmap words
+(8 broadcast+shift steps for 256 categories) — still branch- and
+gather-free over the example lanes.
+
 Constraints (mirroring quick_scorer_extended.h:44-62): <= 64 leaves per
-tree, numerical (axis-aligned) conditions only, missing values imputed at
-encode time. Models outside the envelope fall back to the generic routed
-engine (`ops/routing.py`), like the reference's engine-ranking registry
-(`register_engines.cc:172-875`).
+tree, axis-aligned numerical/boolean/categorical conditions, missing
+values imputed at encode time. Models outside the envelope fall back to
+the generic routed engine (`ops/routing.py`), like the reference's
+engine-ranking registry (`register_engines.cc:172-875`).
 """
 
 from __future__ import annotations
@@ -42,28 +48,36 @@ MAX_LEAVES = 64
 class QuickScorerModel(NamedTuple):
     """Host-compiled model: conditions sorted by tree, leaves in-order."""
 
-    cond_feature: np.ndarray  # i32 [C] numerical feature index
+    cond_feature: np.ndarray  # i32 [C] feature row in the engine input
     cond_thresh: np.ndarray   # f32 [C]
     cond_mask_lo: np.ndarray  # u32 [C] survivors bits 0..31 when triggered
     cond_mask_hi: np.ndarray  # u32 [C] survivors bits 32..63
     cond_tree: np.ndarray     # i32 [C] tree index
+    cond_is_cat: np.ndarray   # i32 [C] 1 = categorical contains-condition
+    cond_bitmap: np.ndarray   # u32 [C, W] go-LEFT category bitmap
     leaf_values: np.ndarray   # f32 [T, 64]
     num_trees: int
 
 
-def compile_forest(forest, num_numerical: int) -> Optional[QuickScorerModel]:
+def compile_forest(
+    forest, num_numerical: int, num_features: Optional[int] = None
+) -> Optional[QuickScorerModel]:
     """Flattened Forest arrays → QuickScorerModel, or None if any tree is
-    outside the engine envelope (too many leaves / categorical / oblique
-    condition)."""
+    outside the engine envelope (too many leaves / set / vector-sequence /
+    oblique condition)."""
     f = {k: np.asarray(v) for k, v in forest.to_numpy().items()}
     if f["oblique_weights"].size > 0 or f["leaf_value"].shape[-1] != 1:
         return None
-    if f["is_cat"][~f["is_leaf"]].any() or f["is_set"][~f["is_leaf"]].any():
+    if f.get("vs_anchor") is not None and f["vs_anchor"].size > 0:
+        return None
+    if f["is_set"][~f["is_leaf"]].any():
         return None
     T = f["feature"].shape[0]
+    W = int(f["cat_mask"].shape[-1])
 
     cond_feature, cond_thresh = [], []
     cond_lo, cond_hi, cond_tree = [], [], []
+    cond_is_cat, cond_bitmap = [], []
     leaf_values = np.zeros((T, MAX_LEAVES), np.float32)
 
     old_limit = sys.getrecursionlimit()
@@ -71,7 +85,8 @@ def compile_forest(forest, num_numerical: int) -> Optional[QuickScorerModel]:
     try:
         _compile_trees(
             f, T, cond_feature, cond_thresh, cond_lo, cond_hi, cond_tree,
-            leaf_values, num_numerical,
+            leaf_values, num_features or num_numerical,
+            cond_is_cat, cond_bitmap, W,
         )
     except _Unsupported:
         return None
@@ -84,6 +99,14 @@ def compile_forest(forest, num_numerical: int) -> Optional[QuickScorerModel]:
         cond_mask_lo=np.asarray(cond_lo, np.uint32),
         cond_mask_hi=np.asarray(cond_hi, np.uint32),
         cond_tree=np.asarray(cond_tree, np.int32),
+        cond_is_cat=np.asarray(cond_is_cat, np.int32),
+        # Purely numerical models get a zero-width bitmap — the kernel
+        # then compiles without the categorical unroll at all.
+        cond_bitmap=(
+            np.asarray(cond_bitmap, np.uint32).reshape(-1, W)
+            if any(cond_is_cat)
+            else np.zeros((len(cond_feature), 0), np.uint32)
+        ),
         leaf_values=leaf_values,
         num_trees=T,
     )
@@ -94,7 +117,8 @@ class _Unsupported(Exception):
 
 
 def _compile_trees(f, T, cond_feature, cond_thresh, cond_lo, cond_hi,
-                   cond_tree, leaf_values, num_numerical):
+                   cond_tree, leaf_values, num_features,
+                   cond_is_cat, cond_bitmap, W):
     for t in range(T):
         # In-order leaf numbering + left-subtree leaf ranges per internal
         # node (iterative DFS; left child first = leaf order is the
@@ -116,6 +140,8 @@ def _compile_trees(f, T, cond_feature, cond_thresh, cond_lo, cond_hi,
                 (
                     int(f["feature"][t, nid]),
                     float(f["threshold"][t, nid]),
+                    bool(f["is_cat"][t, nid]),
+                    f["cat_mask"][t, nid],
                     llo,
                     lhi,
                 )
@@ -125,9 +151,9 @@ def _compile_trees(f, T, cond_feature, cond_thresh, cond_lo, cond_hi,
         visit(0)
         if n_leaves > MAX_LEAVES:
             raise _Unsupported
-        for feat, thr, lo, hi in conds:
-            if feat >= num_numerical:
-                raise _Unsupported  # non-numerical (shouldn't happen)
+        for feat, thr, is_cat, bitmap, lo, hi in conds:
+            if feat >= num_features:
+                raise _Unsupported  # oblique/VS block (shouldn't happen)
             full = (1 << 64) - 1
             left_bits = ((1 << hi) - 1) ^ ((1 << lo) - 1)
             mask = full ^ left_bits  # survivors when condition triggers
@@ -136,6 +162,12 @@ def _compile_trees(f, T, cond_feature, cond_thresh, cond_lo, cond_hi,
             cond_lo.append(mask & 0xFFFFFFFF)
             cond_hi.append(mask >> 32)
             cond_tree.append(t)
+            cond_is_cat.append(int(is_cat))
+            cond_bitmap.append(
+                np.asarray(bitmap, np.uint32)
+                if is_cat
+                else np.zeros((W,), np.uint32)
+            )
 
 
 # --------------------------------------------------------------------- #
@@ -156,6 +188,7 @@ def _ctz32(v):
 def _qs_kernel(
     # scalar-prefetch (SMEM)
     cond_feature, cond_thresh, cond_mask_lo, cond_mask_hi, cond_tree,
+    cond_is_cat, cond_bitmap,
     # VMEM inputs
     x_ref,        # [F, BN] feature-major example block
     values_ref,   # [T, 64]
@@ -167,6 +200,7 @@ def _qs_kernel(
     C = cond_feature.shape[0]
     T = values_ref.shape[0]
     BN = x_ref.shape[1]
+    W = cond_bitmap.shape[1]
 
     live_lo[:] = jnp.full((T, BN), 0xFFFFFFFF, jnp.uint32)
     live_hi[:] = jnp.full((T, BN), 0xFFFFFFFF, jnp.uint32)
@@ -177,6 +211,25 @@ def _qs_kernel(
         t = cond_tree[c]
         xrow = x_ref[feat, :]  # [BN]
         trig = xrow >= thr
+        if W > 0:
+            # Categorical contains-condition (quick_scorer_extended.h:
+            # 63-81): category index rides the same float row; the go-left
+            # bit is gathered by a static unroll over bitmap words —
+            # per-lane shifts of broadcast scalars, no vector gather.
+            idx = xrow.astype(jnp.int32)
+            bit = jnp.zeros((BN,), jnp.uint32)
+            for w in range(W):
+                word = cond_bitmap[c, w]
+                sel = (idx >> 5) == w
+                bit = bit | jnp.where(
+                    sel,
+                    (word >> (idx.astype(jnp.uint32) & 31))
+                    & jnp.uint32(1),
+                    jnp.uint32(0),
+                )
+            # Bit set → category goes LEFT; trigger prunes the left
+            # subtree, so trigger = bit NOT set.
+            trig = jnp.where(cond_is_cat[c] == 1, bit == 0, trig)
         mlo = cond_mask_lo[c]
         mhi = cond_mask_hi[c]
         row_lo = live_lo[t, :]
@@ -207,7 +260,9 @@ def _qs_kernel(
 
 
 class QuickScorerEngine:
-    """Callable engine: x_num f32 [n, Fn] → raw scores [n]."""
+    """Callable engine: x_num f32 [n, Fn] (+ x_cat i32 [n, Fc]) → raw
+    scores [n]. Categorical columns ride the same feature-major float
+    block (vocab indices < 2^24 are exact in f32)."""
 
     def __init__(self, qsm: QuickScorerModel, num_numerical: int,
                  block_examples: int = 1024, interpret: bool = False):
@@ -216,14 +271,17 @@ class QuickScorerEngine:
         self.block = block_examples
         self.interpret = interpret
 
-    def __call__(self, x_num) -> jnp.ndarray:
+    def __call__(self, x_num, x_cat=None) -> jnp.ndarray:
         qsm = self.qsm
-        n = x_num.shape[0]
+        x_all = jnp.asarray(x_num, jnp.float32)
+        if x_cat is not None and np.shape(x_cat)[1] > 0:
+            x_all = jnp.concatenate(
+                [x_all, jnp.asarray(x_cat, jnp.float32)], axis=1
+            )
+        n = x_all.shape[0]
         BN = self.block
         pad = (-n) % BN
-        xT = jnp.pad(
-            jnp.asarray(x_num, jnp.float32), ((0, pad), (0, 0))
-        ).T  # [F, n_pad]
+        xT = jnp.pad(x_all, ((0, pad), (0, 0))).T  # [F, n_pad]
         n_pad = n + pad
         T = qsm.num_trees
 
@@ -231,7 +289,7 @@ class QuickScorerEngine:
         out = pl.pallas_call(
             _qs_kernel,
             grid_spec=pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=5,
+                num_scalar_prefetch=7,
                 grid=grid,
                 in_specs=[
                     pl.BlockSpec(
@@ -259,6 +317,8 @@ class QuickScorerEngine:
             jnp.asarray(qsm.cond_mask_lo),
             jnp.asarray(qsm.cond_mask_hi),
             jnp.asarray(qsm.cond_tree),
+            jnp.asarray(qsm.cond_is_cat),
+            jnp.asarray(qsm.cond_bitmap),
             xT,
             jnp.asarray(qsm.leaf_values),
         )
@@ -270,7 +330,10 @@ def build_quickscorer(model, interpret: Optional[bool] = None):
     when the model is outside the envelope (the caller then uses the
     generic routed engine) — the reference's IsCompatible/ranking flow
     (register_engines.cc:290-360)."""
-    qsm = compile_forest(model.forest, model.binner.num_numerical)
+    qsm = compile_forest(
+        model.forest, model.binner.num_numerical,
+        num_features=model.binner.num_scalar,
+    )
     if qsm is None:
         return None
     if interpret is None:
