@@ -63,7 +63,7 @@ def error_record(stage, err):
     }
 
 
-def probe_backend(attempts=3, timeout_s=90):
+def probe_backend(attempts=3, timeout_s=240):
     """Check whether the default JAX backend initializes, in a subprocess.
 
     The axon TPU tunnel can HANG (not error) when unreachable, so probing

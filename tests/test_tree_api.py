@@ -1,0 +1,144 @@
+"""Tree inspection / editing API (reference port/python/ydf/model/tree/)."""
+
+import numpy as np
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+from ydf_tpu.models import tree_api as ta
+
+
+def _gbt(adult_train, **kw):
+    kw.setdefault("num_trees", 5)
+    kw.setdefault("max_depth", 4)
+    return ydf.GradientBoostedTreesLearner(
+        label="income", validation_ratio=0.0, early_stopping="NONE", **kw
+    ).train(adult_train.head(3000))
+
+
+def test_get_tree_structure(adult_train):
+    m = _gbt(adult_train)
+    tree = m.get_tree(0)
+    assert isinstance(tree.root, ta.NonLeaf)
+    s = tree.pretty()
+    assert "(pos)" in s and "(neg)" in s
+    # Conditions reference real feature names.
+    names = set(m.binner.feature_names)
+
+    def check(node):
+        if isinstance(node, ta.Leaf):
+            assert isinstance(node.value, ta.RegressionValue)
+            return
+        c = node.condition
+        if isinstance(c, ta.NumericalHigherThanCondition):
+            assert c.attribute in names
+        elif isinstance(c, ta.CategoricalIsInCondition):
+            assert c.attribute in names
+            vocab = m.dataspec.column_by_name(c.attribute).vocabulary
+            assert set(c.mask) <= set(vocab)
+        check(node.pos_child)
+        check(node.neg_child)
+
+    check(tree.root)
+    assert len(m.get_all_trees()) == m.num_trees()
+
+
+def test_roundtrip_preserves_predictions(adult_train):
+    """get_tree → set_tree unchanged must not change predictions."""
+    m = _gbt(adult_train)
+    head = adult_train.head(400)
+    before = m.predict(head)
+    for i in range(m.num_trees()):
+        m.set_tree(i, m.get_tree(i))
+    np.testing.assert_allclose(m.predict(head), before, atol=1e-6)
+
+
+def test_edit_leaf_changes_prediction():
+    rng = np.random.RandomState(0)
+    n = 500
+    data = {
+        "x": rng.normal(size=n),
+        "y": rng.normal(size=n) + 2.0,
+    }
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", task=Task.REGRESSION, num_trees=1, max_depth=2,
+        validation_ratio=0.0, early_stopping="NONE", shrinkage=1.0,
+    ).train(data)
+    tree = m.get_tree(0)
+
+    def bump(node):
+        if isinstance(node, ta.Leaf):
+            node.value.value += 10.0
+            return
+        bump(node.pos_child)
+        bump(node.neg_child)
+
+    before = m.predict(data)
+    bump(tree.root)
+    m.set_tree(0, tree)
+    after = m.predict(data)
+    np.testing.assert_allclose(after - before, 10.0, atol=1e-4)
+
+
+def test_build_tree_from_scratch():
+    """Programmatic tree construction (reference model/decision_tree/
+    builder.cc role): replace a trained tree with a hand-written stump."""
+    rng = np.random.RandomState(1)
+    n = 400
+    data = {"x": rng.uniform(size=n), "y": rng.uniform(size=n)}
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", task=Task.REGRESSION, num_trees=1, max_depth=3,
+        validation_ratio=0.0, early_stopping="NONE",
+    ).train(data)
+    stump = ta.Tree(
+        ta.NonLeaf(
+            condition=ta.NumericalHigherThanCondition("x", 0.5),
+            pos_child=ta.Leaf(ta.RegressionValue(1.0)),
+            neg_child=ta.Leaf(ta.RegressionValue(-1.0)),
+        )
+    )
+    m.set_tree(0, stump)
+    init = float(m.initial_predictions[0])
+    p = m.predict({"x": np.array([0.1, 0.9]), "y": np.zeros(2)})
+    np.testing.assert_allclose(p, [init - 1.0, init + 1.0], atol=1e-6)
+
+
+def test_set_tree_grows_capacity():
+    rng = np.random.RandomState(2)
+    n = 300
+    data = {"x": rng.uniform(size=n), "y": rng.uniform(size=n)}
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", task=Task.REGRESSION, num_trees=1, max_depth=1,
+        validation_ratio=0.0, early_stopping="NONE",
+    ).train(data)
+    cap = m.forest.node_capacity
+
+    def chain(depth):
+        if depth == 0:
+            return ta.Leaf(ta.RegressionValue(0.5))
+        return ta.NonLeaf(
+            condition=ta.NumericalHigherThanCondition("x", 0.1 * depth),
+            pos_child=ta.Leaf(ta.RegressionValue(float(depth))),
+            neg_child=chain(depth - 1),
+        )
+
+    deep = ta.Tree(chain(max(cap, 8)))
+    m.set_tree(0, deep)
+    assert m.forest.node_capacity >= deep.num_nodes()
+    assert np.isfinite(m.predict(data)).all()
+
+
+def test_unknown_vocab_item_raises(adult_train):
+    m = _gbt(adult_train, num_trees=2)
+    tree = m.get_tree(0)
+    bad = ta.Tree(
+        ta.NonLeaf(
+            condition=ta.CategoricalIsInCondition(
+                "education", ["not-a-real-item"]
+            ),
+            pos_child=ta.Leaf(ta.RegressionValue(1.0)),
+            neg_child=ta.Leaf(ta.RegressionValue(-1.0)),
+        )
+    )
+    with pytest.raises(ValueError):
+        m.set_tree(0, bad)

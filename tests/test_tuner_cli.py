@@ -140,3 +140,28 @@ def test_cli_end_to_end(tmp_path):
              "--dataset", f"csv:{syn}", "--num_runs", "3", "--cpu")
     assert r.returncode == 0, r.stderr
     assert "ns_per_example" in r.stdout
+    # analyze: text + HTML report (reference analyze_model_and_dataset.cc)
+    html = tmp_path / "analysis.html"
+    r = _cli(tmp_path, "analyze", "--model", str(model_dir), "--dataset",
+             f"csv:{syn}", "--output", str(html), "--cpu")
+    assert r.returncode == 0, r.stderr
+    assert "Permutation variable importances" in html.read_text()
+    # compute_variable_importances (reference cli binary of same name)
+    r = _cli(tmp_path, "compute_variable_importances", "--model",
+             str(model_dir), "--dataset", f"csv:{syn}", "--cpu")
+    assert r.returncode == 0, r.stderr
+    assert "num_0" in r.stdout
+    # edit_model: truncate to 3 trees (reference edit_model.cc)
+    edited = tmp_path / "edited"
+    r = _cli(tmp_path, "edit_model", "--model", str(model_dir),
+             "--output", str(edited), "--keep_trees", "3",
+             "--pure_serving", "--cpu")
+    assert r.returncode == 0, r.stderr
+    r = _cli(tmp_path, "show_model", "--model", str(edited), "--cpu")
+    assert "Number of trees: 3" in r.stdout
+    # convert_dataset → binned cache (reference convert_dataset.cc)
+    r = _cli(tmp_path, "convert_dataset", "--input", f"csv:{syn}",
+             "--output", f"cache:{tmp_path / 'cache'}", "--label",
+             "label", "--cpu")
+    assert r.returncode == 0, r.stderr
+    assert (tmp_path / "cache" / "bins.npy").exists()

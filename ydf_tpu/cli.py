@@ -121,6 +121,106 @@ def cmd_benchmark_inference(args):
     print(json.dumps(r))
 
 
+def cmd_analyze(args):
+    """Reference cli/analyze_model_and_dataset.cc: PDP + permutation
+    importances, text to stdout or an HTML report file."""
+    _force_cpu_if_requested(args)
+    import ydf_tpu as ydf
+
+    model = ydf.load_model(args.model)
+    analysis = model.analyze(args.dataset)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(analysis.to_html())
+        print(f"Analysis written to {args.output}")
+    else:
+        print(analysis)
+
+
+def cmd_compute_variable_importances(args):
+    """Reference cli/compute_variable_importances.cc: permutation
+    importances on a dataset, printed per metric."""
+    _force_cpu_if_requested(args)
+    import ydf_tpu as ydf
+    from ydf_tpu.analysis.importance import permutation_importance
+
+    model = ydf.load_model(args.model)
+    vi = permutation_importance(
+        model, args.dataset, num_rounds=args.num_repetitions
+    )
+    if vi:
+        print(f"MEAN_DECREASE_IN_{vi[0]['metric'].upper()}:")
+    for e in vi:
+        print(f"  {e['importance']:+.6f}  {e['feature']}")
+
+
+def cmd_edit_model(args):
+    """Reference cli/edit_model.cc: structural edits on a saved model —
+    keep the first N trees and/or strip training metadata."""
+    _force_cpu_if_requested(args)
+    import ydf_tpu as ydf
+
+    model = ydf.load_model(args.model)
+    if args.keep_trees is not None:
+        if not 1 <= args.keep_trees <= model.num_trees():
+            sys.exit(
+                f"error: --keep_trees must be in [1, {model.num_trees()}]"
+            )
+        K = int(getattr(model, "num_trees_per_iter", 1) or 1)
+        if args.keep_trees % K != 0:
+            # Multiclass GBT stores K trees per iteration; a partial
+            # iteration would skew one class's logit.
+            sys.exit(
+                f"error: --keep_trees must be a multiple of "
+                f"num_trees_per_iter={K}"
+            )
+        model.forest = model.forest.truncated(args.keep_trees)
+        if hasattr(model, "_dim_forests"):
+            del model._dim_forests
+    if args.pure_serving:
+        # MakePureServing (abstract_model.h:433): drop training artifacts.
+        model.extra_metadata.pop("tuner_logs", None)
+        if hasattr(model, "training_logs"):
+            model.training_logs = {}
+        if hasattr(model, "oob_evaluation"):
+            model.oob_evaluation = None
+        if hasattr(model, "oob_variable_importances"):
+            model.oob_variable_importances = None
+    model.save(args.output)
+    print(f"Edited model saved to {args.output}")
+
+
+def cmd_convert_dataset(args):
+    """Reference cli/convert_dataset.cc: re-encode a dataset. Outputs:
+    csv:<path> (normalized CSV) or cache:<dir> (the out-of-core binned
+    cache, dataset/cache.py — requires --label)."""
+    _force_cpu_if_requested(args)
+    if args.output.startswith("cache:"):
+        from ydf_tpu.config import Task
+        from ydf_tpu.dataset.cache import create_dataset_cache
+
+        if not args.label:
+            sys.exit("error: cache: output requires --label")
+        cache = create_dataset_cache(
+            args.input, args.output[len("cache:"):], label=args.label,
+            task=Task(args.task),
+        )
+        print(
+            f"Cache with {cache.num_rows} rows written to {cache.path}"
+        )
+        return
+    import pandas as pd
+
+    from ydf_tpu.dataset.dataset import Dataset
+
+    ds = Dataset.from_data(args.input)
+    out = args.output
+    if out.startswith("csv:"):
+        out = out[4:]
+    pd.DataFrame(ds.data).to_csv(out, index=False)
+    print(f"Wrote {ds.num_rows} rows to {out}")
+
+
 def cmd_synthetic_dataset(args):
     """Config-driven generator (reference dataset/synthetic_dataset.cc)."""
     import numpy as np
@@ -204,6 +304,36 @@ def main(argv=None):
     p.add_argument("--num_runs", type=int, default=10)
     p.add_argument("--cpu", action="store_true")
     p.set_defaults(fn=cmd_benchmark_inference)
+
+    p = sub.add_parser("analyze")
+    p.add_argument("--model", required=True)
+    p.add_argument("--dataset", required=True)
+    p.add_argument("--output", help="write an HTML report here")
+    p.add_argument("--cpu", action="store_true")
+    p.set_defaults(fn=cmd_analyze)
+
+    p = sub.add_parser("compute_variable_importances")
+    p.add_argument("--model", required=True)
+    p.add_argument("--dataset", required=True)
+    p.add_argument("--num_repetitions", type=int, default=1)
+    p.add_argument("--cpu", action="store_true")
+    p.set_defaults(fn=cmd_compute_variable_importances)
+
+    p = sub.add_parser("edit_model")
+    p.add_argument("--model", required=True)
+    p.add_argument("--output", required=True)
+    p.add_argument("--keep_trees", type=int)
+    p.add_argument("--pure_serving", action="store_true")
+    p.add_argument("--cpu", action="store_true")
+    p.set_defaults(fn=cmd_edit_model)
+
+    p = sub.add_parser("convert_dataset")
+    p.add_argument("--input", required=True)
+    p.add_argument("--output", required=True)
+    p.add_argument("--label")
+    p.add_argument("--task", default="CLASSIFICATION")
+    p.add_argument("--cpu", action="store_true")
+    p.set_defaults(fn=cmd_convert_dataset)
 
     p = sub.add_parser("synthetic_dataset")
     p.add_argument("--output", required=True)

@@ -1,0 +1,18 @@
+"""ydf_tpu.deep — tabular neural-network learners sharing the forest API.
+
+Counterpart of the reference's `ydf.deep` subpackage
+(`ydf/port/python/ydf/deep/`): JAX/flax learners that consume the same
+dataspec/Dataset machinery and expose the same `Learner(label=...).train()`
+/ `model.predict/evaluate/save` surface as the tree learners.
+"""
+
+from ydf_tpu.deep.mlp import MultiLayerPerceptronLearner
+from ydf_tpu.deep.tabular_transformer import TabularTransformerLearner
+from ydf_tpu.deep.generic_deep import GenericDeepModel, load_deep_model
+
+__all__ = [
+    "MultiLayerPerceptronLearner",
+    "TabularTransformerLearner",
+    "GenericDeepModel",
+    "load_deep_model",
+]

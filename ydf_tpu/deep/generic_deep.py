@@ -1,0 +1,422 @@
+"""Shared machinery of the deep (neural-network) learners.
+
+Role of the reference's `deep/generic_jax.py` (GenericJAXModel /
+GenericJaxLearner, `:145,610`) and `deep/preprocessor.py:48`: feature
+preprocessing (z-scored numericals, integer-coded categoricals with
+learned embeddings), a minibatched optax training loop, and a model
+object with the same predict/evaluate/save surface as the tree models.
+
+The save format is dependency-light: `config.json` + flax params in an
+.npz (the reference uses safetensors; same role)."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ydf_tpu.config import Task
+from ydf_tpu.dataset.dataset import Dataset, InputData
+from ydf_tpu.dataset.dataspec import ColumnType, DataSpecification
+
+
+class DeepPreprocessor:
+    """Feature encoding for NN learners (reference preprocessor.py:48):
+    numericals are mean-imputed then z-scored; categoricals become
+    integer codes (0 = OOV) consumed by embedding layers."""
+
+    def __init__(self, dataspec: DataSpecification, features: List[str]):
+        self.numerical: List[str] = []
+        self.categorical: List[str] = []
+        self.cat_vocab_sizes: List[int] = []
+        self.means: List[float] = []
+        self.stds: List[float] = []
+        for name in features:
+            col = dataspec.column_by_name(name)
+            if col.type in (
+                ColumnType.NUMERICAL,
+                ColumnType.BOOLEAN,
+                ColumnType.DISCRETIZED_NUMERICAL,
+            ):
+                self.numerical.append(name)
+            elif col.type == ColumnType.CATEGORICAL:
+                self.categorical.append(name)
+                self.cat_vocab_sizes.append(max(col.vocab_size, 1))
+        self.dataspec = dataspec
+
+    def fit(self, ds: Dataset) -> None:
+        for name in self.numerical:
+            v = ds.encoded_numerical(name)
+            self.means.append(float(np.mean(v)))
+            self.stds.append(float(np.std(v) + 1e-6))
+
+    def __call__(self, ds: Dataset) -> Tuple[np.ndarray, np.ndarray]:
+        n = ds.num_rows
+        x_num = np.zeros((n, len(self.numerical)), np.float32)
+        for i, name in enumerate(self.numerical):
+            if ds.dataspec.has_column(name) and name in ds.data:
+                v = ds.encoded_numerical(name)
+            else:
+                v = np.full((n,), self.means[i], np.float32)
+            x_num[:, i] = (v - self.means[i]) / self.stds[i]
+        x_cat = np.zeros((n, len(self.categorical)), np.int32)
+        for j, name in enumerate(self.categorical):
+            if ds.dataspec.has_column(name) and name in ds.data:
+                idx = ds.encoded_categorical(name)
+                x_cat[:, j] = np.clip(idx, 0, self.cat_vocab_sizes[j] - 1)
+        return x_num, x_cat
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "numerical": self.numerical,
+            "categorical": self.categorical,
+            "cat_vocab_sizes": self.cat_vocab_sizes,
+            "means": self.means,
+            "stds": self.stds,
+        }
+
+    @staticmethod
+    def from_json(dataspec, d: Dict[str, Any]) -> "DeepPreprocessor":
+        p = DeepPreprocessor.__new__(DeepPreprocessor)
+        p.dataspec = dataspec
+        p.numerical = list(d["numerical"])
+        p.categorical = list(d["categorical"])
+        p.cat_vocab_sizes = [int(x) for x in d["cat_vocab_sizes"]]
+        p.means = [float(x) for x in d["means"]]
+        p.stds = [float(x) for x in d["stds"]]
+        return p
+
+
+class GenericDeepModel:
+    """A trained deep model: flax module + params + preprocessor."""
+
+    model_type = "DEEP"
+
+    def __init__(
+        self,
+        task: Task,
+        label: str,
+        classes: Optional[List[str]],
+        dataspec: DataSpecification,
+        preprocessor: DeepPreprocessor,
+        module,
+        params,
+        config: Dict[str, Any],
+        training_logs: Optional[Dict[str, Any]] = None,
+    ):
+        self.task = task
+        self.label = label
+        self.classes = classes
+        self.dataspec = dataspec
+        self.preprocessor = preprocessor
+        self.module = module
+        self.params = params
+        self.config = config
+        self.training_logs = training_logs or {}
+        self.extra_metadata: Dict[str, Any] = {}
+
+    # -------------------------------------------------------------- #
+
+    def input_feature_names(self) -> List[str]:
+        return self.preprocessor.numerical + self.preprocessor.categorical
+
+    def _raw(self, data: InputData) -> np.ndarray:
+        ds = Dataset.from_data(data, dataspec=self.dataspec)
+        x_num, x_cat = self.preprocessor(ds)
+
+        @jax.jit
+        def fwd(params, xn, xc):
+            return self.module.apply(
+                params, xn, xc, training=False,
+                rngs={},
+            )
+
+        outs = []
+        B = 8192
+        for s in range(0, x_num.shape[0], B):
+            outs.append(
+                np.asarray(
+                    fwd(
+                        self.params,
+                        jnp.asarray(x_num[s: s + B]),
+                        jnp.asarray(x_cat[s: s + B]),
+                    )
+                )
+            )
+        return np.concatenate(outs, axis=0)
+
+    def predict(self, data: InputData) -> np.ndarray:
+        logits = self._raw(data)
+        if self.task == Task.CLASSIFICATION:
+            if logits.shape[1] == 1:
+                return 1.0 / (1.0 + np.exp(-logits[:, 0]))
+            e = np.exp(logits - logits.max(axis=1, keepdims=True))
+            return e / e.sum(axis=1, keepdims=True)
+        return logits[:, 0]
+
+    def evaluate(self, data: InputData):
+        from ydf_tpu.metrics import evaluate_predictions
+
+        ds = Dataset.from_data(data, dataspec=self.dataspec)
+        labels = ds.encoded_label(self.label, self.task)
+        return evaluate_predictions(
+            self.task, labels, self.predict(data), classes=self.classes
+        )
+
+    def describe(self) -> str:
+        return (
+            f'Type: "{self.config.get("architecture", "DEEP")}"\n'
+            f"Task: {self.task.value}\n"
+            f'Label: "{self.label}"\n'
+            f"Input features: {self.input_feature_names()}\n"
+            f"Config: {self.config}"
+        )
+
+    # -------------------------------------------------------------- #
+
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        flat = _flatten_params(self.params)
+        np.savez(os.path.join(path, "params.npz"), **flat)
+        with open(os.path.join(path, "config.json"), "w") as f:
+            json.dump(
+                {
+                    "model_type": "DEEP",
+                    "task": self.task.value,
+                    "label": self.label,
+                    "classes": self.classes,
+                    "dataspec": self.dataspec.to_json(),
+                    "preprocessor": self.preprocessor.to_json(),
+                    "config": self.config,
+                    "training_logs": self.training_logs,
+                },
+                f,
+            )
+
+
+def _flatten_params(params, prefix="") -> Dict[str, np.ndarray]:
+    out = {}
+    for k, v in params.items():
+        key = f"{prefix}/{k}" if prefix else k
+        if isinstance(v, dict):
+            out.update(_flatten_params(v, key))
+        else:
+            out[key] = np.asarray(v)
+    return out
+
+
+def _unflatten_params(flat: Dict[str, np.ndarray]):
+    tree: Dict[str, Any] = {}
+    for k, v in flat.items():
+        parts = k.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = jnp.asarray(v)
+    return tree
+
+
+def load_deep_model(path: str) -> GenericDeepModel:
+    with open(os.path.join(path, "config.json")) as f:
+        meta = json.load(f)
+    dataspec = DataSpecification.from_json(meta["dataspec"])
+    pre = DeepPreprocessor.from_json(dataspec, meta["preprocessor"])
+    with np.load(os.path.join(path, "params.npz")) as z:
+        params = _unflatten_params({k: z[k] for k in z.files})
+    cfg = meta["config"]
+    module = _build_module(cfg, pre)
+    return GenericDeepModel(
+        task=Task(meta["task"]),
+        label=meta["label"],
+        classes=meta["classes"],
+        dataspec=dataspec,
+        preprocessor=pre,
+        module=module,
+        params=params,
+        config=cfg,
+        training_logs=meta.get("training_logs"),
+    )
+
+
+def _build_module(cfg: Dict[str, Any], pre: DeepPreprocessor):
+    arch = cfg.get("architecture")
+    if arch == "MLP":
+        from ydf_tpu.deep.mlp import MLPModule
+
+        return MLPModule(
+            num_layers=cfg["num_layers"],
+            layer_size=cfg["layer_size"],
+            drop_out=cfg["drop_out"],
+            output_dim=cfg["output_dim"],
+            cat_vocab_sizes=tuple(pre.cat_vocab_sizes),
+            cat_embedding_dim=cfg["cat_embedding_dim"],
+        )
+    if arch == "TABULAR_TRANSFORMER":
+        from ydf_tpu.deep.tabular_transformer import TransformerModule
+
+        return TransformerModule(
+            num_layers=cfg["num_layers"],
+            token_dim=cfg["token_dim"],
+            num_heads=cfg["num_heads"],
+            drop_out=cfg["drop_out"],
+            output_dim=cfg["output_dim"],
+            num_numerical=cfg["num_numerical"],
+            cat_vocab_sizes=tuple(pre.cat_vocab_sizes),
+        )
+    raise ValueError(f"Unknown deep architecture {arch!r}")
+
+
+class GenericDeepLearner:
+    """Shared minibatch training loop (reference GenericJaxLearner,
+    generic_jax.py:610)."""
+
+    def __init__(
+        self,
+        label: str,
+        task: Task = Task.CLASSIFICATION,
+        features: Optional[Sequence[str]] = None,
+        batch_size: int = 256,
+        num_epochs: int = 30,
+        learning_rate: float = 1e-3,
+        random_seed: int = 1234,
+    ):
+        self.label = label
+        self.task = task
+        self.features = features
+        self.batch_size = batch_size
+        self.num_epochs = num_epochs
+        self.learning_rate = learning_rate
+        self.random_seed = random_seed
+
+    # subclasses override ------------------------------------------------
+    def _architecture_config(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def _make_module(self, cfg, pre):
+        raise NotImplementedError
+
+    # -------------------------------------------------------------------
+    def train(self, data: InputData, valid: Optional[InputData] = None):
+        import optax
+
+        ds = Dataset.from_data(
+            data,
+            label=self.label,
+            column_types=(
+                {self.label: ColumnType.CATEGORICAL}
+                if self.task == Task.CLASSIFICATION
+                else None
+            ),
+        )
+        feature_names = self.features or [
+            c.name
+            for c in ds.dataspec.columns
+            if c.name != self.label
+            and c.type
+            in (
+                ColumnType.NUMERICAL,
+                ColumnType.BOOLEAN,
+                ColumnType.DISCRETIZED_NUMERICAL,
+                ColumnType.CATEGORICAL,
+            )
+        ]
+        pre = DeepPreprocessor(ds.dataspec, list(feature_names))
+        pre.fit(ds)
+        x_num, x_cat = pre(ds)
+        labels = ds.encoded_label(self.label, self.task)
+        classes = (
+            ds.label_classes(self.label)
+            if self.task == Task.CLASSIFICATION
+            else None
+        )
+        if self.task == Task.CLASSIFICATION:
+            C = len(classes)
+            output_dim = 1 if C == 2 else C
+            y = jnp.asarray(labels.astype(np.int32))
+        else:
+            output_dim = 1
+            y = jnp.asarray(labels.astype(np.float32))
+
+        cfg = dict(self._architecture_config())
+        cfg["output_dim"] = output_dim
+        cfg["num_numerical"] = len(pre.numerical)
+        module = self._make_module(cfg, pre)
+
+        key = jax.random.PRNGKey(self.random_seed)
+        key, k_init = jax.random.split(key)
+        params = module.init(
+            {"params": k_init, "dropout": k_init},
+            jnp.asarray(x_num[:2]),
+            jnp.asarray(x_cat[:2]),
+            training=False,
+        )
+        tx = optax.adam(self.learning_rate)
+        opt_state = tx.init(params)
+
+        if self.task == Task.CLASSIFICATION and output_dim == 1:
+
+            def loss_fn(logits, yb):
+                return jnp.mean(
+                    optax.sigmoid_binary_cross_entropy(
+                        logits[:, 0], yb.astype(jnp.float32)
+                    )
+                )
+        elif self.task == Task.CLASSIFICATION:
+
+            def loss_fn(logits, yb):
+                return jnp.mean(
+                    optax.softmax_cross_entropy_with_integer_labels(
+                        logits, yb
+                    )
+                )
+        else:
+
+            def loss_fn(logits, yb):
+                return jnp.mean(jnp.square(logits[:, 0] - yb))
+
+        @jax.jit
+        def step(params, opt_state, xn, xc, yb, k):
+            def f(p):
+                logits = module.apply(
+                    p, xn, xc, training=True, rngs={"dropout": k}
+                )
+                return loss_fn(logits, yb)
+
+            loss, grads = jax.value_and_grad(f)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        n = x_num.shape[0]
+        B = min(self.batch_size, n)
+        steps_per_epoch = max(n // B, 1)
+        logs = {"train_loss": []}
+        rng = np.random.default_rng(self.random_seed)
+        xn_all, xc_all = jnp.asarray(x_num), jnp.asarray(x_cat)
+        for epoch in range(self.num_epochs):
+            perm = rng.permutation(n)
+            epoch_loss = 0.0
+            for s in range(steps_per_epoch):
+                idx = jnp.asarray(perm[s * B: (s + 1) * B])
+                key, k_drop = jax.random.split(key)
+                params, opt_state, loss = step(
+                    params, opt_state, xn_all[idx], xc_all[idx], y[idx],
+                    k_drop,
+                )
+            epoch_loss = float(loss)
+            logs["train_loss"].append(epoch_loss)
+
+        return GenericDeepModel(
+            task=self.task,
+            label=self.label,
+            classes=classes,
+            dataspec=ds.dataspec,
+            preprocessor=pre,
+            module=module,
+            params=params,
+            config=cfg,
+            training_logs=logs,
+        )

@@ -1,0 +1,84 @@
+"""Multi-layer perceptron tabular learner.
+
+Counterpart of the reference `ydf/port/python/ydf/deep/mlp.py:120`
+(MultiLayerPerceptronLearner / MultiLayerPerceptronImpl): z-scored
+numericals and embedded categoricals feed `num_layers` Dense+ReLU+Dropout
+blocks and a linear output head."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ydf_tpu.config import Task
+from ydf_tpu.deep.generic_deep import GenericDeepLearner
+
+
+class MLPModule(nn.Module):
+    num_layers: int
+    layer_size: int
+    drop_out: float
+    output_dim: int
+    cat_vocab_sizes: Tuple[int, ...]
+    cat_embedding_dim: int
+
+    @nn.compact
+    def __call__(self, x_num, x_cat, training: bool):
+        parts = [x_num]
+        for j, vocab in enumerate(self.cat_vocab_sizes):
+            emb = nn.Embed(
+                num_embeddings=vocab,
+                features=self.cat_embedding_dim,
+                name=f"cat_embed_{j}",
+            )(x_cat[:, j])
+            parts.append(emb)
+        x = jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+        for i in range(self.num_layers - 1):
+            x = nn.Dense(self.layer_size, name=f"layer_{i}")(x)
+            x = nn.relu(x)
+            x = nn.Dropout(
+                rate=self.drop_out, deterministic=not training
+            )(x)
+        return nn.Dense(self.output_dim, name="final_layer")(x)
+
+
+class MultiLayerPerceptronLearner(GenericDeepLearner):
+    """`MultiLayerPerceptronLearner(label=...).train(ds)` — API shape of
+    the reference mlp.py:120 (hyperparameter names kept)."""
+
+    def __init__(
+        self,
+        label: str,
+        task: Task = Task.CLASSIFICATION,
+        num_layers: int = 4,
+        layer_size: int = 200,
+        drop_out: float = 0.05,
+        cat_embedding_dim: int = 16,
+        **kwargs,
+    ):
+        super().__init__(label=label, task=task, **kwargs)
+        self.num_layers = num_layers
+        self.layer_size = layer_size
+        self.drop_out = drop_out
+        self.cat_embedding_dim = cat_embedding_dim
+
+    def _architecture_config(self) -> Dict[str, Any]:
+        return {
+            "architecture": "MLP",
+            "num_layers": self.num_layers,
+            "layer_size": self.layer_size,
+            "drop_out": self.drop_out,
+            "cat_embedding_dim": self.cat_embedding_dim,
+        }
+
+    def _make_module(self, cfg, pre):
+        return MLPModule(
+            num_layers=cfg["num_layers"],
+            layer_size=cfg["layer_size"],
+            drop_out=cfg["drop_out"],
+            output_dim=cfg["output_dim"],
+            cat_vocab_sizes=tuple(pre.cat_vocab_sizes),
+            cat_embedding_dim=cfg["cat_embedding_dim"],
+        )

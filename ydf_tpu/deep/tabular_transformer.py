@@ -1,0 +1,112 @@
+"""FT-Transformer-style tabular learner.
+
+Counterpart of the reference `ydf/port/python/ydf/deep/
+tabular_transformer.py` (TabularTransformerLearner / FTTransformerTokenizer,
+Gorishniy et al. 2021): each feature becomes one token — numericals as
+value-scaled learned embeddings, categoricals as lookups — plus a CLS
+token; standard pre-LN self-attention blocks; the head reads the CLS
+token."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ydf_tpu.config import Task
+from ydf_tpu.deep.generic_deep import GenericDeepLearner
+
+
+class TransformerModule(nn.Module):
+    num_layers: int
+    token_dim: int
+    num_heads: int
+    drop_out: float
+    output_dim: int
+    num_numerical: int
+    cat_vocab_sizes: Tuple[int, ...]
+
+    @nn.compact
+    def __call__(self, x_num, x_cat, training: bool):
+        B = x_num.shape[0] if x_num.size else x_cat.shape[0]
+        D = self.token_dim
+        tokens = []
+        # Numerical tokens: value * weight + bias (FT-Transformer
+        # tokenizer; reference FTTransformerTokenizer).
+        if self.num_numerical:
+            w = self.param(
+                "num_token_w",
+                nn.initializers.normal(0.02),
+                (self.num_numerical, D),
+            )
+            b = self.param(
+                "num_token_b",
+                nn.initializers.zeros,
+                (self.num_numerical, D),
+            )
+            tokens.append(x_num[:, :, None] * w[None] + b[None])
+        for j, vocab in enumerate(self.cat_vocab_sizes):
+            emb = nn.Embed(vocab, D, name=f"cat_token_{j}")(x_cat[:, j])
+            tokens.append(emb[:, None, :])
+        cls = self.param("cls_token", nn.initializers.normal(0.02), (1, D))
+        tokens.append(jnp.broadcast_to(cls[None], (B, 1, D)))
+        x = jnp.concatenate(tokens, axis=1)  # [B, T, D]
+
+        for i in range(self.num_layers):
+            h = nn.LayerNorm(name=f"ln1_{i}")(x)
+            h = nn.MultiHeadDotProductAttention(
+                num_heads=self.num_heads,
+                dropout_rate=self.drop_out,
+                deterministic=not training,
+                name=f"attn_{i}",
+            )(h, h)
+            x = x + h
+            h = nn.LayerNorm(name=f"ln2_{i}")(x)
+            h = nn.Dense(D * 2, name=f"ff1_{i}")(h)
+            h = nn.gelu(h)
+            h = nn.Dropout(
+                rate=self.drop_out, deterministic=not training
+            )(h)
+            h = nn.Dense(D, name=f"ff2_{i}")(h)
+            x = x + h
+        x = nn.LayerNorm(name="ln_out")(x)
+        return nn.Dense(self.output_dim, name="head")(x[:, -1, :])
+
+
+class TabularTransformerLearner(GenericDeepLearner):
+    def __init__(
+        self,
+        label: str,
+        task: Task = Task.CLASSIFICATION,
+        num_layers: int = 3,
+        token_dim: int = 32,
+        num_heads: int = 4,
+        drop_out: float = 0.05,
+        **kwargs,
+    ):
+        super().__init__(label=label, task=task, **kwargs)
+        self.num_layers = num_layers
+        self.token_dim = token_dim
+        self.num_heads = num_heads
+        self.drop_out = drop_out
+
+    def _architecture_config(self) -> Dict[str, Any]:
+        return {
+            "architecture": "TABULAR_TRANSFORMER",
+            "num_layers": self.num_layers,
+            "token_dim": self.token_dim,
+            "num_heads": self.num_heads,
+            "drop_out": self.drop_out,
+        }
+
+    def _make_module(self, cfg, pre):
+        return TransformerModule(
+            num_layers=cfg["num_layers"],
+            token_dim=cfg["token_dim"],
+            num_heads=cfg["num_heads"],
+            drop_out=cfg["drop_out"],
+            output_dim=cfg["output_dim"],
+            num_numerical=cfg["num_numerical"],
+            cat_vocab_sizes=tuple(pre.cat_vocab_sizes),
+        )

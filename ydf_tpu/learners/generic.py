@@ -94,10 +94,68 @@ class GenericLearner:
             discretized_max_bins=self.num_discretized_numerical_bins,
         )
 
+    def _prepare_from_cache(self, cache) -> Dict:
+        """Ingestion from an on-disk binned DatasetCache (out-of-core
+        path, dataset/cache.py): the bins stay memmapped until the single
+        device transfer; no raw-value re-encode happens, so raw-value
+        paths (oblique, ranking groups, survival ages, VS) are
+        unavailable."""
+        from ydf_tpu.config import Task as _Task
+
+        if self.label != cache.label:
+            raise ValueError(
+                f"Cache was built for label {cache.label!r}, learner wants "
+                f"{self.label!r}"
+            )
+        if self.task not in (_Task.CLASSIFICATION, _Task.REGRESSION):
+            raise NotImplementedError(
+                f"DatasetCache training for task {self.task} (the cache "
+                "stores bins + label only)"
+            )
+        if getattr(self, "split_axis", "AXIS_ALIGNED") != "AXIS_ALIGNED":
+            raise NotImplementedError(
+                "SPARSE_OBLIQUE needs raw feature values, which the "
+                "cache does not store"
+            )
+        classes = cache.label_classes()
+        labels = np.asarray(cache.labels)
+        w = cache.sample_weights
+        out = {
+            "dataset": Dataset(
+                {cache.label: labels}, cache.dataspec
+            ),
+            "binned": None,
+            "binner": cache.binner,
+            "bins": cache.bins,  # uint8 memmap [n, F]
+            "set_bits": None,
+            "vs": None,
+            "labels": labels,
+            "sample_weights": (
+                np.asarray(w, np.float32)
+                if w is not None
+                else np.ones((cache.num_rows,), np.float32)
+            ),
+        }
+        if self.task == _Task.CLASSIFICATION:
+            if classes is None:
+                raise ValueError(
+                    "Cache label is numerical; train with a regression task"
+                )
+            out["classes"] = classes
+        return out
+
     def _prepare(
         self, data: InputData, valid: Optional[InputData] = None
     ) -> Dict:
         """Common ingestion: dataset, binning, encoded label/weights."""
+        from ydf_tpu.dataset.cache import DatasetCache
+
+        if isinstance(data, DatasetCache):
+            if valid is not None:
+                raise NotImplementedError(
+                    "explicit valid= with a DatasetCache"
+                )
+            return self._prepare_from_cache(data)
         ds = self._infer_dataset(data)
         feature_names = self.features
         if feature_names is None:

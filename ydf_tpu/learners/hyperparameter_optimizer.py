@@ -27,22 +27,14 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ydf_tpu.dataset.dataset import Dataset, InputData
-from ydf_tpu.learners.tuner import RandomSearchTuner, TrialLog
-
-
-def _draw_trials(space: Dict[str, List[Any]], num_trials: int, seed: int):
-    """The full trial list, drawn up-front (deduplicated) so execution
-    order cannot change the outcome."""
-    rng = np.random.default_rng(seed)
-    out, seen = [], set()
-    for _ in range(num_trials):
-        params = {k: v[rng.integers(0, len(v))] for k, v in space.items()}
-        key = tuple(sorted((k, repr(v)) for k, v in params.items()))
-        if key in seen:
-            continue
-        seen.add(key)
-        out.append(params)
-    return out
+from ydf_tpu.learners.tuner import (
+    RandomSearchTuner,
+    TrialLog,
+    attach_tuner_logs,
+    draw_trials,
+    holdout_split,
+    validate_space,
+)
 
 
 class HyperParameterOptimizerLearner:
@@ -84,12 +76,7 @@ class HyperParameterOptimizerLearner:
             space = dict(self.search_space)
         else:
             space = RandomSearchTuner()._auto_space(self.base_learner)
-        unknown = [k for k in space if not hasattr(self.base_learner, k)]
-        if unknown:
-            raise ValueError(
-                f"Search-space parameters {unknown} are not hyperparameters"
-                f" of {type(self.base_learner).__name__}"
-            )
+        validate_space(space, self.base_learner)
         return space
 
     def train(self, data: InputData, valid: Optional[InputData] = None):
@@ -98,7 +85,7 @@ class HyperParameterOptimizerLearner:
         from ydf_tpu.analysis.importance import _primary_metric
 
         space = self._space()
-        trials = _draw_trials(space, self.num_trials, self.random_seed)
+        trials = draw_trials(space, self.num_trials, self.random_seed)
         if not trials:
             raise ValueError("Empty trial list")
 
@@ -107,12 +94,9 @@ class HyperParameterOptimizerLearner:
         if valid is not None:
             train_data, hold_data = raw, valid
         else:
-            n = ds.num_rows
-            rng = np.random.default_rng(self.random_seed)
-            nv = max(int(n * self.holdout_ratio), 1)
-            perm = rng.permutation(n)
-            train_data = {k: v[perm[nv:]] for k, v in raw.items()}
-            hold_data = {k: v[perm[:nv]] for k, v in raw.items()}
+            train_data, hold_data = holdout_split(
+                raw, ds.num_rows, self.holdout_ratio, self.random_seed
+            )
 
         devices = jax.devices()
         workers = self.parallel_trials or len(devices)
@@ -147,11 +131,5 @@ class HyperParameterOptimizerLearner:
         model = final.train(data, valid=valid) if valid is not None else (
             final.train(data)
         )
-        model.extra_metadata["tuner_logs"] = {
-            "best_params": best.params,
-            "best_score": best.score,
-            "trials": [
-                {"params": t.params, "score": t.score} for t in self.logs
-            ],
-        }
+        attach_tuner_logs(model, self.logs, best)
         return model

@@ -34,6 +34,53 @@ class TrialLog:
     score: float  # higher = better
 
 
+def draw_trials(
+    space: Dict[str, List[Any]], num_trials: int, seed: int
+) -> List[Dict[str, Any]]:
+    """Samples the full (deduplicated) trial list up-front from a seeded
+    RNG, so execution order can never change the search outcome
+    (reference RandomOptimizer, optimizers/random.h:37-98)."""
+    rng = np.random.default_rng(seed)
+    out, seen = [], set()
+    for _ in range(num_trials):
+        params = {k: v[rng.integers(0, len(v))] for k, v in space.items()}
+        key = tuple(sorted((k, repr(v)) for k, v in params.items()))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(params)
+    return out
+
+
+def validate_space(space: Dict[str, List[Any]], learner) -> None:
+    unknown = [k for k in space if not hasattr(learner, k)]
+    if unknown:
+        raise ValueError(
+            f"Search-space parameters {unknown} are not hyperparameters "
+            f"of {type(learner).__name__}"
+        )
+
+
+def holdout_split(raw: Dict[str, np.ndarray], n: int, holdout_ratio: float,
+                  seed: int):
+    """(train_data, hold_data) row split shared by both tuners."""
+    rng = np.random.default_rng(seed)
+    nv = max(int(n * holdout_ratio), 1)
+    perm = rng.permutation(n)
+    return (
+        {k: v[perm[nv:]] for k, v in raw.items()},
+        {k: v[perm[:nv]] for k, v in raw.items()},
+    )
+
+
+def attach_tuner_logs(model, logs: List[TrialLog], best: TrialLog) -> None:
+    model.extra_metadata["tuner_logs"] = {
+        "best_params": best.params,
+        "best_score": best.score,
+        "trials": [{"params": t.params, "score": t.score} for t in logs],
+    }
+
+
 class RandomSearchTuner:
     def __init__(
         self,
@@ -82,34 +129,17 @@ class RandomSearchTuner:
                     "automatic_search_space=True"
                 )
             space = self._auto_space(learner)
-        unknown = [k for k in space if not hasattr(learner, k)]
-        if unknown:
-            raise ValueError(
-                f"Search-space parameters {unknown} are not hyperparameters "
-                f"of {type(learner).__name__}"
-            )
+        validate_space(space, learner)
 
         ds = Dataset.from_data(data)
         raw = {k: np.asarray(v) for k, v in ds.data.items()}
-        n = ds.num_rows
-        rng = np.random.default_rng(self.seed)
-        nv = max(int(n * self.holdout_ratio), 1)
-        perm = rng.permutation(n)
-        va_idx, tr_idx = perm[:nv], perm[nv:]
-        train_data = {k: v[tr_idx] for k, v in raw.items()}
-        hold_data = {k: v[va_idx] for k, v in raw.items()}
+        train_data, hold_data = holdout_split(
+            raw, ds.num_rows, self.holdout_ratio, self.seed
+        )
 
         self.logs = []
-        seen = set()
         best: Optional[TrialLog] = None
-        for _ in range(self.num_trials):
-            params = {
-                k: v[rng.integers(0, len(v))] for k, v in space.items()
-            }
-            key = tuple(sorted((k, repr(v)) for k, v in params.items()))
-            if key in seen:
-                continue
-            seen.add(key)
+        for params in draw_trials(space, self.num_trials, self.seed):
             cand = copy.copy(learner)
             for k, v in params.items():
                 setattr(cand, k, v)
@@ -125,11 +155,5 @@ class RandomSearchTuner:
         for k, v in best.params.items():
             setattr(final, k, v)
         model = final.train(data)
-        model.extra_metadata["tuner_logs"] = {
-            "best_params": best.params,
-            "best_score": best.score,
-            "trials": [
-                {"params": t.params, "score": t.score} for t in self.logs
-            ],
-        }
+        attach_tuner_logs(model, self.logs, best)
         return model

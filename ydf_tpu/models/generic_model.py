@@ -125,6 +125,38 @@ class GenericModel:
     # lives in JAX arrays)
     # ------------------------------------------------------------------ #
 
+    # --- tree inspection / editing (reference port/python/ydf/model/tree/)
+    def get_tree(self, tree_idx: int):
+        """Tree `tree_idx` as editable Python node objects
+        (models/tree_api.py; reference model/tree/tree.py)."""
+        from ydf_tpu.models.tree_api import forest_tree_to_python
+
+        if not 0 <= tree_idx < self.num_trees():
+            raise ValueError(
+                f"tree_idx {tree_idx} out of range [0, {self.num_trees()})"
+            )
+        return forest_tree_to_python(self, tree_idx)
+
+    def get_all_trees(self):
+        return [self.get_tree(i) for i in range(self.num_trees())]
+
+    def iter_trees(self):
+        for i in range(self.num_trees()):
+            yield self.get_tree(i)
+
+    def set_tree(self, tree_idx: int, tree) -> None:
+        """Replaces tree `tree_idx` with an edited Python tree."""
+        from ydf_tpu.models.tree_api import set_forest_tree
+
+        if not 0 <= tree_idx < self.num_trees():
+            raise ValueError(
+                f"tree_idx {tree_idx} out of range [0, {self.num_trees()})"
+            )
+        set_forest_tree(self, tree_idx, tree)
+
+    def print_tree(self, tree_idx: int = 0) -> None:
+        print(self.get_tree(tree_idx).pretty())
+
     def to_standalone_cc(self, name: str = "ydf_model") -> dict:
         """Dependency-free C++ header reproducing this model's predictions
         bit-for-bit (reference embed subsystem, serving/embed/embed.h:

@@ -1,0 +1,413 @@
+"""User-facing tree inspection & editing.
+
+Counterpart of the reference Python tree API
+(`ydf/port/python/ydf/model/tree/`: condition.py, node.py, value.py,
+tree.py): models expose their forests as plain Python objects —
+`model.get_tree(i)` / `model.iter_trees()` return `Tree`s of
+`Leaf`/`NonLeaf` nodes with typed conditions and leaf values, editable
+and writable back with `model.set_tree(i, tree)`.
+
+Branch convention matches the reference: a condition that evaluates TRUE
+routes to `pos_child`, FALSE to `neg_child` (our Forest stores the same
+split as "value < threshold goes left" — the converters flip as needed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+# --------------------------------------------------------------------- #
+# Values (reference value.py)
+# --------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass
+class RegressionValue:
+    """Leaf output of regression / GBT trees (reference value.py:46)."""
+
+    value: float
+    num_examples: float = 0.0
+
+    def pretty(self) -> str:
+        return f"value={self.value:g}"
+
+
+@dataclasses.dataclass
+class ProbabilityValue:
+    """Per-class distribution leaf of RF classification
+    (reference value.py:70)."""
+
+    probability: List[float]
+    num_examples: float = 0.0
+
+    def pretty(self) -> str:
+        return f"value={[round(p, 5) for p in self.probability]}"
+
+
+# --------------------------------------------------------------------- #
+# Conditions (reference condition.py)
+# --------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass
+class NumericalHigherThanCondition:
+    """value >= threshold → positive (reference condition.py:81)."""
+
+    attribute: str
+    threshold: float
+
+    def pretty(self) -> str:
+        return f"{self.attribute!r} >= {self.threshold:g}"
+
+
+@dataclasses.dataclass
+class CategoricalIsInCondition:
+    """value in mask → positive (reference condition.py:121).
+    `mask` holds vocabulary item strings."""
+
+    attribute: str
+    mask: List[str]
+
+    def pretty(self) -> str:
+        return f"{self.attribute!r} in {self.mask}"
+
+
+@dataclasses.dataclass
+class CategoricalSetContainsCondition:
+    """set intersects mask → positive (reference condition.py:143)."""
+
+    attribute: str
+    mask: List[str]
+
+    def pretty(self) -> str:
+        return f"{self.attribute!r} intersects {self.mask}"
+
+
+@dataclasses.dataclass
+class NumericalSparseObliqueCondition:
+    """Σ weights·attributes >= threshold → positive
+    (reference condition.py:165)."""
+
+    attributes: List[str]
+    weights: List[float]
+    threshold: float
+
+    def pretty(self) -> str:
+        terms = " + ".join(
+            f"{w:g}*{a!r}" for a, w in zip(self.attributes, self.weights)
+        )
+        return f"{terms} >= {self.threshold:g}"
+
+
+@dataclasses.dataclass
+class NumericalVectorSequenceCloserThanCondition:
+    """∃ v in sequence: |v - anchor|² <= threshold2 → positive
+    (reference condition.py:190)."""
+
+    attribute: str
+    anchor: List[float]
+    threshold2: float
+
+    def pretty(self) -> str:
+        return (
+            f"{self.attribute!r} closer_than(anchor={self.anchor}, "
+            f"d2<={self.threshold2:g})"
+        )
+
+
+@dataclasses.dataclass
+class NumericalVectorSequenceProjectedMoreThanCondition:
+    """∃ v in sequence: <v, anchor> >= threshold → positive
+    (reference condition.py:211)."""
+
+    attribute: str
+    anchor: List[float]
+    threshold: float
+
+    def pretty(self) -> str:
+        return (
+            f"{self.attribute!r} projected_more_than(anchor={self.anchor}, "
+            f"dot>={self.threshold:g})"
+        )
+
+
+# --------------------------------------------------------------------- #
+# Nodes / trees (reference node.py, tree.py)
+# --------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass
+class Leaf:
+    value: object  # RegressionValue | ProbabilityValue
+
+
+@dataclasses.dataclass
+class NonLeaf:
+    condition: object
+    pos_child: object  # condition true
+    neg_child: object  # condition false
+
+
+@dataclasses.dataclass
+class Tree:
+    root: object
+
+    def pretty(self) -> str:
+        out: List[str] = []
+
+        def rec(node, prefix: str, marker: str):
+            if isinstance(node, Leaf):
+                out.append(f"{prefix}{marker}{node.value.pretty()}")
+                return
+            out.append(f"{prefix}{marker}{node.condition.pretty()}")
+            child_prefix = prefix + ("    " if marker else "")
+            rec(node.pos_child, child_prefix, "├─(pos)─ ")
+            rec(node.neg_child, child_prefix, "└─(neg)─ ")
+
+        rec(self.root, "", "")
+        return "\n".join(out)
+
+    def num_nodes(self) -> int:
+        def rec(n):
+            if isinstance(n, Leaf):
+                return 1
+            return 1 + rec(n.pos_child) + rec(n.neg_child)
+
+        return rec(self.root)
+
+
+# --------------------------------------------------------------------- #
+# Forest arrays ⇄ Tree objects
+# --------------------------------------------------------------------- #
+
+
+def _unpack_items(mask_words: np.ndarray, vocab: Sequence[str],
+                  invert: bool) -> List[str]:
+    bits = np.unpackbits(
+        np.ascontiguousarray(mask_words).view(np.uint8), bitorder="little"
+    )[: len(vocab)]
+    if invert:
+        bits = 1 - bits
+    return [vocab[i] for i in np.flatnonzero(bits)]
+
+
+def _pack_items(items: Sequence[str], vocab: Sequence[str], width: int,
+                invert: bool) -> np.ndarray:
+    idx = {v: i for i, v in enumerate(vocab)}
+    bits = np.zeros((width * 32,), np.uint8)
+    for it in items:
+        if it not in idx:
+            raise ValueError(f"Unknown vocabulary item {it!r}")
+        bits[idx[it]] = 1
+    if invert:
+        bits[: len(vocab)] = 1 - bits[: len(vocab)]
+    return np.packbits(bits, bitorder="little").view(np.uint32)
+
+
+def forest_tree_to_python(model, t: int) -> Tree:
+    """Tree `t` of the model's forest as Python node objects."""
+    f = model.forest.to_numpy()
+    b = model.binner
+    names = b.feature_names
+    F = b.num_features
+    P = f["oblique_weights"].shape[1]
+    is_classification_dist = f["leaf_value"].shape[-1] > 1
+
+    def leaf(nid):
+        v = f["leaf_value"][t, nid]
+        cover = float(f["cover"][t, nid])
+        if is_classification_dist:
+            return Leaf(ProbabilityValue([float(x) for x in v], cover))
+        return Leaf(RegressionValue(float(v[0]), cover))
+
+    def rec(nid: int):
+        if f["is_leaf"][t, nid]:
+            return leaf(nid)
+        feat = int(f["feature"][t, nid])
+        if feat >= F + P:  # vector-sequence anchor block
+            q = feat - F - P
+            fv = int(f["vs_feat"][t, q])
+            anchor = [float(x) for x in f["vs_anchor"][t, q]]
+            thr = float(f["threshold"][t, nid])
+            if bool(f["vs_is_closer"][t, q]):
+                cond = NumericalVectorSequenceCloserThanCondition(
+                    b.vs_names[fv], anchor, -thr
+                )
+            else:
+                cond = NumericalVectorSequenceProjectedMoreThanCondition(
+                    b.vs_names[fv], anchor, thr
+                )
+        elif feat >= F:  # oblique block
+            w = f["oblique_weights"][t, feat - F]
+            nz = np.flatnonzero(w != 0)
+            cond = NumericalSparseObliqueCondition(
+                [names[i] for i in nz],
+                [float(w[i]) for i in nz],
+                float(f["threshold"][t, nid]),
+            )
+        elif bool(f["is_set"][t, nid]):
+            vocab = model.dataspec.column_by_name(names[feat]).vocabulary
+            cond = CategoricalSetContainsCondition(
+                names[feat],
+                _unpack_items(f["cat_mask"][t, nid], vocab, invert=False),
+            )
+        elif bool(f["is_cat"][t, nid]):
+            vocab = model.dataspec.column_by_name(names[feat]).vocabulary
+            # Stored mask = "goes left" = negative branch → positive
+            # items are the complement.
+            cond = CategoricalIsInCondition(
+                names[feat],
+                _unpack_items(f["cat_mask"][t, nid], vocab, invert=True),
+            )
+        else:
+            cond = NumericalHigherThanCondition(
+                names[feat], float(f["threshold"][t, nid])
+            )
+        # left = negative (v < threshold), right = positive.
+        return NonLeaf(
+            condition=cond,
+            pos_child=rec(int(f["right"][t, nid])),
+            neg_child=rec(int(f["left"][t, nid])),
+        )
+
+    import sys
+
+    old = sys.getrecursionlimit()
+    sys.setrecursionlimit(max(old, 10000))
+    try:
+        return Tree(rec(0))
+    finally:
+        sys.setrecursionlimit(old)
+
+
+def python_tree_to_forest_rows(model, tree: Tree) -> dict:
+    """Flattens a Python Tree back into per-field node arrays (BFS-free:
+    preorder ids like the forest import path). Editable condition types:
+    numerical, categorical, categorical-set. Returns a dict of arrays
+    sized to the tree's node count."""
+    b = model.binner
+    names = b.feature_names
+    W = int(np.shape(model.forest.cat_mask)[-1])
+    V = int(np.shape(model.forest.leaf_value)[-1])
+    rows: List[dict] = []
+
+    def rec(node) -> int:
+        idx = len(rows)
+        row = dict(
+            feature=-1, threshold=np.inf, threshold_bin=0, is_cat=False,
+            is_set=False, cat_mask=np.zeros((W,), np.uint32), left=0,
+            right=0, is_leaf=True, na_left=False,
+            leaf_value=np.zeros((V,), np.float32), cover=1.0,
+        )
+        rows.append(row)
+        if isinstance(node, Leaf):
+            v = node.value
+            if isinstance(v, ProbabilityValue):
+                if len(v.probability) != V:
+                    raise ValueError(
+                        f"Leaf has {len(v.probability)} probabilities, "
+                        f"model expects {V}"
+                    )
+                row["leaf_value"] = np.asarray(v.probability, np.float32)
+            else:
+                row["leaf_value"] = np.asarray([v.value], np.float32)
+            row["cover"] = float(v.num_examples) or 1.0
+            return idx
+        cond = node.condition
+        row["is_leaf"] = False
+        if isinstance(cond, NumericalHigherThanCondition):
+            feat = names.index(cond.attribute)
+            if feat >= b.num_numerical:
+                raise ValueError(
+                    f"{cond.attribute!r} is not a numerical feature"
+                )
+            row["feature"] = feat
+            row["threshold"] = np.float32(cond.threshold)
+        elif isinstance(cond, CategoricalIsInCondition):
+            feat = names.index(cond.attribute)
+            vocab = model.dataspec.column_by_name(cond.attribute).vocabulary
+            row["feature"] = feat
+            row["is_cat"] = True
+            row["cat_mask"] = _pack_items(cond.mask, vocab, W, invert=True)
+        elif isinstance(cond, CategoricalSetContainsCondition):
+            feat = names.index(cond.attribute)
+            vocab = model.dataspec.column_by_name(cond.attribute).vocabulary
+            row["feature"] = feat
+            row["is_set"] = True
+            row["cat_mask"] = _pack_items(cond.mask, vocab, W, invert=False)
+        else:
+            raise NotImplementedError(
+                f"set_tree with condition type {type(cond).__name__}"
+            )
+        # positive → right, negative → left.
+        row["right"] = rec(node.pos_child)
+        row["left"] = rec(node.neg_child)
+        return idx
+
+    rec(tree.root)
+    return {
+        k: np.stack([r[k] for r in rows])
+        for k in rows[0]
+    }
+
+
+def set_forest_tree(model, t: int, tree: Tree) -> None:
+    """Replaces tree `t` in the model's forest (in place on the model)."""
+    from ydf_tpu.models.forest import Forest
+
+    rows = python_tree_to_forest_rows(model, tree)
+    # to_numpy() views the device arrays read-only — copy before editing.
+    f = {k: np.array(v) for k, v in model.forest.to_numpy().items()}
+    n_new = rows["feature"].shape[0]
+    N = f["feature"].shape[1]
+    if n_new > N:
+        # Grow node capacity to fit the edited tree.
+        pad = n_new - N
+        for k, v in f.items():
+            if v.ndim >= 2 and v.shape[1] == N and k not in (
+                "oblique_weights", "oblique_na_repl", "vs_anchor",
+                "vs_feat", "vs_is_closer",
+            ):
+                widths = [(0, 0)] * v.ndim
+                widths[1] = (0, pad)
+                f[k] = np.pad(v, widths)
+        f["is_leaf"][:, N:] = True
+        N = n_new
+    field_map = {
+        "feature": "feature", "threshold": "threshold",
+        "threshold_bin": "threshold_bin", "is_cat": "is_cat",
+        "is_set": "is_set", "cat_mask": "cat_mask", "left": "left",
+        "right": "right", "is_leaf": "is_leaf", "na_left": "na_left",
+        "leaf_value": "leaf_value", "cover": "cover",
+    }
+    for src, dst in field_map.items():
+        arr = f[dst]
+        arr[t] = 0
+        if dst == "feature":
+            arr[t] = -1
+        if dst == "is_leaf":
+            arr[t] = True
+        if dst == "threshold":
+            arr[t] = np.inf
+        arr[t, :n_new] = rows[src]
+    f["num_nodes"][t] = n_new
+    model.forest = Forest.from_numpy(f)
+
+    # Routing iterates model.max_depth steps — deepened trees must widen it.
+    def depth_of(node) -> int:
+        if isinstance(node, Leaf):
+            return 0
+        return 1 + max(depth_of(node.pos_child), depth_of(node.neg_child))
+
+    model.max_depth = max(model.max_depth, depth_of(tree.root))
+    # Invalidate every forest-derived cache: the fast engine (keyed by
+    # forest identity) and multiclass GBT's per-dim sub-forest split
+    # (gbt_model.predict reuses it whenever its length still matches).
+    if hasattr(model, "_qs_cache"):
+        model._qs_cache = {}
+    if hasattr(model, "_dim_forests"):
+        del model._dim_forests

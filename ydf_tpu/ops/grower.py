@@ -127,6 +127,10 @@ def grow_tree(
     L, B, N = frontier, num_bins, max_nodes
     Fn = F if num_numerical is None else num_numerical
     Fc = F - Fn
+    # Sorted-order count per categorical feature (multiclass: one per
+    # label class; see the Fc block below).
+    O = int(getattr(rule, "num_cat_orderings", 1)) if Fc > 0 else 1
+    Fcand = Fn + Fc * O  # scalar candidate columns after expansion
     # Set features occupy the feature index block [F, F + Fs). Their item
     # vocabulary Vs may exceed num_bins — the node mask then widens to
     # cover it, while candidate CUT positions stay capped at B (only the
@@ -203,16 +207,29 @@ def grow_tree(
             pass
         elif Fc > 0:
             hist_cat = hist[:, Fn:]  # [Ld, Fc, B, S]
-            cat_key = rule.cat_sort_key(hist_cat, rule_ctx)  # [Ld, Fc, B]
-            # Empty bins sort last → they land on the right side, so unseen
-            # categories at serving time route right.
-            cat_key = jnp.where(hist_cat[..., -1] > 0, cat_key, jnp.inf)
-            order = jnp.argsort(cat_key, axis=-1)  # [Ld, Fc, B]
+            # O orderings per categorical feature (reference
+            # FindSplitLabelClassificationFeatureCategorical,
+            # training.cc:3933-3975: multiclass scans one sorted order PER
+            # label class — "one label value vs others"); binary and
+            # non-classification rules keep the single exact order. Each
+            # ordering becomes its own candidate column.
+            if O > 1:
+                cat_key = rule.cat_sort_keys(hist_cat, rule_ctx)
+            else:
+                cat_key = rule.cat_sort_key(hist_cat, rule_ctx)[:, :, None]
+            # [Ld, Fc, O, B]. Empty bins sort last → they land on the
+            # right side, so unseen categories at serving time route right.
+            cat_key = jnp.where(
+                (hist_cat[..., -1] > 0)[:, :, None, :], cat_key, jnp.inf
+            )
+            order = jnp.argsort(cat_key, axis=-1)  # [Ld, Fc, O, B]
             ranks = jnp.argsort(order, axis=-1)    # rank of each bin
             sorted_hist = jnp.take_along_axis(
-                hist_cat, order[..., None], axis=2
+                hist_cat[:, :, None], order[..., None], axis=3
+            )  # [Ld, Fc, O, B, S]
+            csum_cat = jnp.cumsum(sorted_hist, axis=3).reshape(
+                Ld, Fc * O, B, S
             )
-            csum_cat = jnp.cumsum(sorted_hist, axis=2)
             left_all = jnp.concatenate([csum_num, csum_cat], axis=1)
         else:
             left_all = csum_num
@@ -275,7 +292,7 @@ def grow_tree(
                 left_set_blocks.append(left_set)
             left_all = jnp.concatenate([left_all] + left_set_blocks, axis=1)
 
-        Fa = F + 2 * Fs  # total candidate columns
+        Fa = Fcand + 2 * Fs  # total candidate columns
         right_all = parent[:, None, None, :] - left_all  # [Ld, Fa, B, S]
 
         gain = rule.gain(left_all, right_all, parent[:, None, None, :],
@@ -310,9 +327,18 @@ def grow_tree(
                 )
                 base = jnp.where(col_real, base, -1.0)
             kth = jax.lax.top_k(base, candidate_features)[0][:, -1]
-            scores = (
-                jnp.concatenate([base, base[:, F:]], axis=1) if Fs else base
-            )
+            # Expand per-FEATURE scores onto candidate columns: the O
+            # orderings of one categorical (and a set feature's two
+            # direction columns) share a single sampling score.
+            scores = jnp.concatenate(
+                [
+                    base[:, :Fn],
+                    jnp.repeat(base[:, Fn:F], O, axis=1),
+                    base[:, F:],
+                    base[:, F:],
+                ],
+                axis=1,
+            ) if (Fs or O > 1) else base
             valid &= (scores >= kth[:, None])[:, :, None]
         if monotone is not None and any(monotone):
             dirs_np = np.zeros((Fa,), np.float32)
@@ -365,16 +391,20 @@ def grow_tree(
         )[:, 0]  # [Ld, S]
         right_stats = parent - left_stats
 
-        is_set_split = best_f >= F
+        is_set_split = best_f >= Fcand
         # Direction column → (direction, real set-feature index).
-        set_dir = (best_f - F) >= Fs          # False = asc, True = desc
-        fset = jnp.where(set_dir, best_f - F - Fs, best_f - F)
+        set_dir = (best_f - Fcand) >= Fs      # False = asc, True = desc
+        fset = jnp.where(set_dir, best_f - Fcand - Fs, best_f - Fcand)
         is_cat_split = (best_f >= Fn) & ~is_set_split
         # Per-slot routing mask over bins: numerical → prefix of bin ids,
-        # categorical → prefix of the sorted order (rank <= cut).
+        # categorical → prefix of the sorted order (rank <= cut) in the
+        # CHOSEN ordering's column.
         if Fc > 0:
+            ranks_flat = ranks.reshape(Ld, Fc * O, B)
             chosen_rank = jnp.take_along_axis(
-                ranks, jnp.clip(best_f - Fn, 0, Fc - 1)[:, None, None], axis=1
+                ranks_flat,
+                jnp.clip(best_f - Fn, 0, Fc * O - 1)[:, None, None],
+                axis=1,
             )[:, 0]  # [Ld, B]
             go_left_bins = jnp.where(
                 is_cat_split[:, None],
@@ -407,7 +437,12 @@ def grow_tree(
         # count (feature-parallel padding appends zero columns to `bins`;
         # serving decodes set ids against the unpadded layout).
         nvf = F if num_valid_features is None else num_valid_features
-        best_f_store = jnp.where(is_set_split, nvf + fset, best_f)
+        # Collapse ordering columns back onto the real categorical id and
+        # the set direction columns onto the real set id.
+        best_f_scalar = jnp.where(
+            is_cat_split, Fn + (best_f - Fn) // O, best_f
+        )
+        best_f_store = jnp.where(is_set_split, nvf + fset, best_f_scalar)
         tree["feature"] = tree["feature"].at[wid].set(best_f_store)
         tree["threshold_bin"] = tree["threshold_bin"].at[wid].set(best_t)
         tree["is_cat"] = tree["is_cat"].at[wid].set(is_cat_split)

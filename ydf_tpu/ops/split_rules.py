@@ -101,11 +101,24 @@ class ClassificationRule:
         return counts / (stats[..., -1] + _EPS)[..., None]
 
     def cat_sort_key(self, hist, ctx):
-        # Order categories by P(class 1 | category): exact for binary labels
-        # (the reference's CART categorical ordering); a one-vs-rest
-        # heuristic for multiclass.
+        # Order categories by P(class 1 | category): exact for binary
+        # labels (the reference's CART categorical ordering).
         c = hist[..., min(1, self.num_classes - 1)]
         return c / (hist[..., -1] + _EPS)
+
+    @property
+    def num_cat_orderings(self) -> int:
+        # Multiclass: one sorted order per label class ("one label value
+        # vs others", reference training.cc:3933-3975) — the grower scans
+        # every ordering and keeps the best split. Binary needs only one
+        # (the two per-class orders are reverses of each other).
+        return self.num_classes if self.num_classes > 2 else 1
+
+    def cat_sort_keys(self, hist, ctx):
+        # [Ld, Fc, B, S] → [Ld, Fc, C, B]: ordering c sorts categories by
+        # P(class c | category).
+        p = hist[..., : self.num_classes] / (hist[..., -1:] + _EPS)
+        return jnp.moveaxis(p, -1, -2)
 
 
 @dataclasses.dataclass(frozen=True)
