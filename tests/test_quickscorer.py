@@ -138,3 +138,34 @@ def test_engine_on_imported_only_num_model(adult_test):
     p = m.predict(adult_test.head(500))
     logit = np.log(p / (1 - p))
     np.testing.assert_allclose(raw, logit, atol=1e-4)
+
+
+def test_binned_engine_matches_float_engine(abalone):
+    """8-bit engine (reference 8bits_numerical_features.h): scoring the
+    uint8 bin matrix must reproduce the float engine exactly — the bin
+    thresholds compile from the same boundaries the binner cut on."""
+    from ydf_tpu.serving.quickscorer import build_binned_quickscorer
+
+    m = _num_only_model(abalone, num_trees=10, max_depth=5)
+    feng = build_quickscorer(m, interpret=True)
+    beng = build_binned_quickscorer(m, interpret=True)
+    assert feng is not None and beng is not None
+    from ydf_tpu.dataset.dataset import Dataset
+
+    head = abalone.head(400)
+    ds = Dataset.from_data(head, dataspec=m.dataspec)
+    x_num, x_cat, _ = m._encode_inputs(ds)
+    bins = m.binner.transform(ds)
+    f_raw = np.asarray(feng(x_num, x_cat))
+    b_raw = np.asarray(beng(bins[:, : m.binner.num_numerical]))
+    np.testing.assert_allclose(b_raw, f_raw, atol=2e-5)
+
+
+def test_binned_engine_refuses_imported_models(adult_test):
+    """Imported models carry a serving-only binner with placeholder
+    boundaries — a binned engine compiled from it would silently score
+    every example through the leftmost leaves."""
+    from ydf_tpu.serving.quickscorer import build_binned_quickscorer
+
+    m = ydf.load_ydf_model(f"{MD}/adult_binary_class_gbdt_only_num")
+    assert build_binned_quickscorer(m, interpret=True) is None

@@ -209,12 +209,20 @@ def cmd_convert_dataset(args):
             f"Cache with {cache.num_rows} rows written to {cache.path}"
         )
         return
-    import pandas as pd
-
     from ydf_tpu.dataset.dataset import Dataset
 
     ds = Dataset.from_data(args.input)
     out = args.output
+    if out.startswith(("tfrecord:", "tfrecord-nocompression:")):
+        from ydf_tpu.dataset.tfrecord import write_tfrecord_columns
+
+        compressed = out.startswith("tfrecord:")
+        path = out.partition(":")[2]
+        write_tfrecord_columns(path, ds.data, compressed=compressed)
+        print(f"Wrote {ds.num_rows} rows to {path}")
+        return
+    import pandas as pd
+
     if out.startswith("csv:"):
         out = out[4:]
     pd.DataFrame(ds.data).to_csv(out, index=False)

@@ -32,7 +32,12 @@ import numpy as np
 
 from ydf_tpu.config import Task
 from ydf_tpu.dataset.binning import Binner
-from ydf_tpu.dataset.dataset import Dataset, _read_csv, _resolve_typed_path
+from ydf_tpu.dataset.dataset import (
+    Dataset,
+    _read_csv,
+    _resolve_typed_path,
+    _split_typed_path,
+)
 from ydf_tpu.dataset.dataspec import (
     Column,
     ColumnType,
@@ -171,6 +176,12 @@ def create_dataset_cache(
     min_vocab_frequency: int = 5,
 ) -> DatasetCache:
     """Builds an on-disk binned cache from (sharded) CSV input."""
+    fmt, _ = _split_typed_path(data_path)
+    if fmt != "csv":
+        raise NotImplementedError(
+            f"create_dataset_cache streams CSV input only (got {fmt!r}); "
+            "convert other formats to CSV first"
+        )
     files = _resolve_typed_path(data_path)
     os.makedirs(cache_dir, exist_ok=True)
 

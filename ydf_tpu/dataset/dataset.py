@@ -50,13 +50,32 @@ def _read_csv(path: str) -> Dict[str, np.ndarray]:
     return {c: df[c].to_numpy() for c in df.columns}
 
 
-def _resolve_typed_path(path: str) -> List[str]:
-    """Resolves "csv:/p/a*.csv" typed+sharded/glob paths to a file list."""
+_TFRECORD_PREFIXES = (
+    # Reference format registry prefixes (formats.cc:56-81).
+    "tfrecord",
+    "tfrecordv2+gz+tfe",
+    "tfrecord-nocompression",
+    "tfrecordv2+tfe",
+)
+
+
+def _split_typed_path(path: str):
+    """"prefix:path" → (format, path). Format defaults to csv."""
     if ":" in path and not os.path.exists(path):
         prefix, _, rest = path.partition(":")
-        if prefix not in ("csv",):
-            raise ValueError(f"Unsupported dataset format prefix {prefix!r}")
-        path = rest
+        if prefix == "csv":
+            return "csv", rest
+        if prefix in _TFRECORD_PREFIXES:
+            return "tfrecord", rest
+        if prefix == "avro":
+            return "avro", rest
+        raise ValueError(f"Unsupported dataset format prefix {prefix!r}")
+    return "csv", path
+
+
+def _resolve_typed_path(path: str) -> List[str]:
+    """Resolves "csv:/p/a*.csv" typed+sharded/glob paths to a file list."""
+    _, path = _split_typed_path(path)
     files = sorted(glob.glob(path)) if any(c in path for c in "*?[") else [path]
     if not files:
         raise FileNotFoundError(path)
@@ -114,11 +133,27 @@ class Dataset:
                     )
             return data
         if isinstance(data, str):
-            files = _resolve_typed_path(data)
-            parts = [_read_csv(f) for f in files]
-            cols: Dict[str, np.ndarray] = {}
-            for k in parts[0]:
-                cols[k] = np.concatenate([p[k] for p in parts])
+            fmt, raw_path = _split_typed_path(data)
+            if fmt == "tfrecord":
+                from ydf_tpu.dataset.tfrecord import (
+                    read_tfrecord_columns,
+                    resolve_tfrecord_path,
+                )
+
+                cols = read_tfrecord_columns(
+                    resolve_tfrecord_path(raw_path)
+                )
+            elif fmt == "avro":
+                from ydf_tpu.dataset.avro import read_avro_columns
+                from ydf_tpu.dataset.tfrecord import resolve_tfrecord_path
+
+                cols = read_avro_columns(resolve_tfrecord_path(raw_path))
+            else:
+                files = _resolve_typed_path(data)
+                parts = [_read_csv(f) for f in files]
+                cols = {}
+                for k in parts[0]:
+                    cols[k] = np.concatenate([p[k] for p in parts])
         elif hasattr(data, "to_dict") and hasattr(data, "columns"):  # DataFrame
             cols = {c: data[c].to_numpy() for c in data.columns}
         elif isinstance(data, dict):

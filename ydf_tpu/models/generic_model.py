@@ -69,22 +69,100 @@ class GenericModel:
     def num_nodes(self) -> int:
         return int(np.asarray(self.forest.num_nodes).sum())
 
-    def describe(self) -> str:
+    def describe(self, output_format: str = "text") -> str:
+        """Model card (reference describe.cc / pydf model.describe()):
+        structure stats, input features with types, structure variable
+        importances, training logs and self-evaluation when present.
+        output_format: "text" or "html"."""
+        f = self.forest.to_numpy()
+        nn = np.asarray(f["num_nodes"])
+        is_leaf = np.asarray(f["is_leaf"])
+        # Per-tree leaf counts over the real node range.
+        leaf_counts = [
+            int(is_leaf[t, : nn[t]].sum()) for t in range(len(nn))
+        ]
+        feats = self.input_feature_names()
         lines = [
             f'Type: "{self.model_type}"',
             f"Task: {self.task.value}",
             f'Label: "{self.label}"',
+        ]
+        if self.classes:
+            lines.append(f"Classes: {self.classes}")
+        lines += [
             "",
-            f"Input features ({len(self.input_feature_names())}):"
-            f" {' '.join(self.input_feature_names())}",
+            f"Input features ({len(feats)}):",
+        ]
+        for name in feats:
+            col = self.dataspec.column_by_name(name)
+            extra = (
+                f" vocab={col.vocab_size}"
+                if col.vocabulary is not None
+                else f" mean={col.mean:.4g}"
+            )
+            lines.append(f"  {name}: {col.type.value}{extra}")
+        for name in getattr(self.binner, "vs_names", []):
+            col = self.dataspec.column_by_name(name)
+            lines.append(
+                f"  {name}: {col.type.value} dim={col.vector_length}"
+            )
+        lines += [
             "",
             f"Number of trees: {self.num_trees()}",
             f"Total number of nodes: {self.num_nodes()}",
-            "",
-            "Dataspec:",
-            str(self.dataspec),
+            f"Number of leaves: {sum(leaf_counts)}",
+            (
+                f"Nodes per tree: min {int(nn.min())} / mean "
+                f"{float(nn.mean()):.1f} / max {int(nn.max())}"
+            )
+            if len(nn)
+            else "",
+            f"Maximum depth: {self.max_depth}",
         ]
-        return "\n".join(lines)
+        # Structure variable importances (reference describe.cc section).
+        try:
+            from ydf_tpu.analysis.importance import structure_importances
+
+            si = structure_importances(self)
+            top = si.get("NUM_NODES") or next(iter(si.values()), [])
+            if top:
+                lines += ["", "Variable importances (NUM_NODES):"]
+                for d in top[:10]:
+                    lines.append(
+                        f"  {d['feature']:>25}: {d['importance']:.5g}"
+                    )
+        except Exception:
+            pass
+        logs = getattr(self, "training_logs", None)
+        if logs and logs.get("train_loss"):
+            tl = logs["train_loss"]
+            lines += [
+                "",
+                f"Training: {len(tl)} iterations, final train loss "
+                f"{tl[-1]:.5f}"
+                + (
+                    f", final valid loss {logs['valid_loss'][-1]:.5f}"
+                    if logs.get("valid_loss")
+                    else ""
+                ),
+            ]
+        oob = getattr(self, "oob_evaluation", None)
+        if oob:
+            m = ", ".join(
+                f"{k}={v:.4f}" for k, v in list(oob["metrics"].items())[:4]
+            )
+            lines += ["", f"Self-evaluation (OOB): {m}"]
+        lines += ["", "Dataspec:", str(self.dataspec)]
+        text = "\n".join(l for l in lines if l is not None)
+        if output_format == "html":
+            import html as _html
+
+            return (
+                "<html><body><pre>"
+                + _html.escape(text)
+                + "</pre></body></html>"
+            )
+        return text
 
     # ------------------------------------------------------------------ #
     # Analysis (reference: model.analyze / model.predict_shap /

@@ -1,6 +1,13 @@
 from ydf_tpu.serving.quickscorer import (
+    BinnedQuickScorerEngine,
     QuickScorerEngine,
+    build_binned_quickscorer,
     build_quickscorer,
 )
 
-__all__ = ["QuickScorerEngine", "build_quickscorer"]
+__all__ = [
+    "BinnedQuickScorerEngine",
+    "QuickScorerEngine",
+    "build_binned_quickscorer",
+    "build_quickscorer",
+]

@@ -325,6 +325,64 @@ class QuickScorerEngine:
         return out[:n]
 
 
+class BinnedQuickScorerEngine:
+    """8-bit engine (reference 8bits_numerical_features.h:18-40): the
+    same leaf-bitmask algorithm over uint8-BUCKETIZED features. Numerical
+    thresholds compile to bin ids (value < boundaries[t]  ⇔  bin <= t),
+    so serving consumes the binner's uint8 matrix directly — the cheapest
+    input path when examples are already bucketized (e.g. training-time
+    scoring or a preprocessed feature store)."""
+
+    def __init__(self, engine: QuickScorerEngine, bin_thresh: np.ndarray):
+        self._engine = engine
+        self._bin_thresh = bin_thresh
+
+    def __call__(self, bins_u8, x_cat=None) -> jnp.ndarray:
+        # Reuse the float kernel with bin ids as the feature values and
+        # the compiled per-condition bin cut: trig = bin >= t_bin.
+        qsm = self._engine.qsm._replace(cond_thresh=self._bin_thresh)
+        eng = QuickScorerEngine(
+            qsm, self._engine.num_numerical,
+            block_examples=self._engine.block,
+            interpret=self._engine.interpret,
+        )
+        return eng(jnp.asarray(bins_u8, jnp.float32), x_cat)
+
+
+def build_binned_quickscorer(model, interpret: Optional[bool] = None):
+    """8-bit engine over the model's own binner, or None when outside the
+    envelope. Input = binner.transform(ds) uint8 matrix (numerical block;
+    categorical columns ride along as bin ids like the float engine)."""
+    eng = build_quickscorer(model, interpret=interpret)
+    if eng is None:
+        return None
+    b = model.binner
+    qsm = eng.qsm
+    has_numerical_cond = bool((qsm.cond_is_cat == 0).any())
+    if has_numerical_cond and not np.isfinite(b.boundaries).any():
+        # Serving-only binner (imported reference / sklearn models):
+        # boundaries are +inf placeholders and transform() yields all-zero
+        # bins — a binned engine compiled from them would silently route
+        # every example to the leftmost leaf.
+        return None
+    bin_thresh = np.zeros_like(qsm.cond_thresh)
+    for c in range(len(qsm.cond_feature)):
+        fi = int(qsm.cond_feature[c])
+        if qsm.cond_is_cat[c]:
+            continue  # categorical conditions use bitmaps, not thresholds
+        if fi >= b.num_numerical:
+            return None  # boolean-as-categorical edge: bail to float
+        nb = int(b.feature_num_bins[fi]) - 1
+        t = np.searchsorted(
+            b.boundaries[fi, :nb], qsm.cond_thresh[c], side="left"
+        )
+        # Forest thresholds are boundary values by construction:
+        # v >= boundaries[t]  ⇔  bin(v) >= t+1 (bin counts boundaries
+        # <= v), so the bin-space trigger is "bin id >= t+1".
+        bin_thresh[c] = np.float32(t + 1)
+    return BinnedQuickScorerEngine(eng, bin_thresh)
+
+
 def build_quickscorer(model, interpret: Optional[bool] = None):
     """Builds a QuickScorer engine for a trained/imported model, or None
     when the model is outside the envelope (the caller then uses the
