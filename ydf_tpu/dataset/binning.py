@@ -275,7 +275,21 @@ class Binner:
         if self.num_vs == 0:
             return None
         n = dataset.num_rows
-        L, D = self.vs_max_len, self.vs_dim
+        # Pad to the larger of the training-time max length and THIS batch's
+        # max length: max_num_vectors in the reference dataspec is a
+        # statistic, not a cap, and the engines score the full sequence —
+        # truncating a serving batch to the training max would silently
+        # drop vectors that could satisfy a closer_than condition.
+        batch_max = 0
+        for name in self.vs_names:
+            if dataset.dataspec.has_column(name) and name in dataset.data:
+                from ydf_tpu.dataset.dataspec import vector_sequence_cell
+
+                for v in dataset.data[name].tolist():
+                    c = vector_sequence_cell(v)
+                    if c is not None:
+                        batch_max = max(batch_max, c.shape[0])
+        L, D = max(self.vs_max_len, batch_max), self.vs_dim
         values = np.zeros((n, self.num_vs, L, D), np.float32)
         lengths = np.zeros((n, self.num_vs), np.int32)
         missing = np.zeros((n, self.num_vs), bool)

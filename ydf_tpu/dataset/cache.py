@@ -191,32 +191,49 @@ def create_dataset_cache(
     cat_missing: Dict[str, int] = {}
     col_order: List[str] = []
     num_rows = 0
+
+    def _count_categorical(name: str, vals: np.ndarray) -> None:
+        cnt = cat_counts.setdefault(name, {})
+        sv = vals.astype(str)
+        miss = (sv == "") | (sv == "nan")
+        cat_missing[name] = cat_missing.get(name, 0) + int(miss.sum())
+        uniq, c = np.unique(sv[~miss], return_counts=True)
+        for u, k in zip(uniq.tolist(), c.tolist()):
+            cnt[u] = cnt.get(u, 0) + k
+
     for chunk in _iter_chunks(files, chunk_rows):
         if not col_order:
             col_order = list(chunk.keys())
         num_rows += len(next(iter(chunk.values())))
         for name, vals in chunk.items():
             vals = np.asarray(vals)
-            if vals.dtype.kind in "fiub" and name != label:
-                num_sketch.setdefault(name, _NumSketch()).update(
-                    vals.astype(np.float64)
-                )
-            elif vals.dtype.kind in "fiub" and name == label and (
-                task != Task.CLASSIFICATION
-            ):
+            numeric_chunk = vals.dtype.kind in "fiub" and (
+                name != label or task != Task.CLASSIFICATION
+            )
+            if numeric_chunk and name not in cat_counts:
                 num_sketch.setdefault(name, _NumSketch()).update(
                     vals.astype(np.float64)
                 )
             else:
-                cnt = cat_counts.setdefault(name, {})
-                sv = vals.astype(str)
-                miss = (sv == "") | (sv == "nan")
-                cat_missing[name] = cat_missing.get(name, 0) + int(
-                    miss.sum()
-                )
-                uniq, c = np.unique(sv[~miss], return_counts=True)
-                for u, k in zip(uniq.tolist(), c.tolist()):
-                    cnt[u] = cnt.get(u, 0) + k
+                _count_categorical(name, vals)
+
+    # A column can be inferred numeric on one chunk and object on another
+    # (pandas types each chunk independently). One type per column is
+    # resolved here: any non-numeric chunk demotes the column to
+    # categorical, and its partial stats from both passes are discarded in
+    # favor of a targeted string recount over the affected columns only —
+    # otherwise the numeric chunks' values would be silently coerced to
+    # NaN in pass 2.
+    mixed = [n for n in col_order if n in num_sketch and n in cat_counts]
+    if mixed:
+        for name in mixed:
+            del num_sketch[name]
+            cat_counts[name] = {}
+            cat_missing[name] = 0
+        for chunk in _iter_chunks(files, chunk_rows):
+            for name in mixed:
+                if name in chunk:
+                    _count_categorical(name, np.asarray(chunk[name]))
 
     cols: List[Column] = []
     for name in col_order:

@@ -124,17 +124,24 @@ class GenericDeepModel:
     def input_feature_names(self) -> List[str]:
         return self.preprocessor.numerical + self.preprocessor.categorical
 
+    def _forward(self):
+        # One jitted forward per model instance: defining the closure
+        # inside _raw would re-trace (and re-compile) on every predict().
+        fwd = getattr(self, "_fwd_cache", None)
+        if fwd is None:
+            def fwd_impl(params, xn, xc):
+                return self.module.apply(
+                    params, xn, xc, training=False, rngs={}
+                )
+
+            fwd = jax.jit(fwd_impl)
+            self._fwd_cache = fwd
+        return fwd
+
     def _raw(self, data: InputData) -> np.ndarray:
         ds = Dataset.from_data(data, dataspec=self.dataspec)
         x_num, x_cat = self.preprocessor(ds)
-
-        @jax.jit
-        def fwd(params, xn, xc):
-            return self.module.apply(
-                params, xn, xc, training=False,
-                rngs={},
-            )
-
+        fwd = self._forward()
         outs = []
         B = 8192
         for s in range(0, x_num.shape[0], B):

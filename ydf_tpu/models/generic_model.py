@@ -260,6 +260,12 @@ class GenericModel:
                 "supported yet (the exported fn signature carries only "
                 "x_num/x_cat)"
             )
+        if getattr(self.forest, "vs_anchor", np.zeros(0)).size > 0:
+            raise NotImplementedError(
+                "to_jax_function over NUMERICAL_VECTOR_SEQUENCE conditions "
+                "is not supported yet (the exported fn signature carries "
+                "only x_num/x_cat, so VS nodes would silently misroute)"
+            )
 
         forest = self.forest
         num_numerical = self.binner.num_numerical

@@ -278,6 +278,16 @@ class QuickScorerEngine:
             x_all = jnp.concatenate(
                 [x_all, jnp.asarray(x_cat, jnp.float32)], axis=1
             )
+        if qsm.cond_feature.size and int(qsm.cond_feature.max()) >= int(
+            x_all.shape[1]
+        ):
+            raise ValueError(
+                "QuickScorer model references feature column "
+                f"{int(qsm.cond_feature.max())} but only {int(x_all.shape[1])} "
+                "input columns were provided — pass x_cat when the model "
+                "contains categorical conditions (out-of-range rows would "
+                "otherwise read past the input block in the kernel)"
+            )
         n = x_all.shape[0]
         BN = self.block
         pad = (-n) % BN
