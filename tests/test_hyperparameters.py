@@ -1,0 +1,154 @@
+"""Hyperparameter spec + validation layer (reference
+generic_parameters.cc / abstract_learner.h SetHyperParameters /
+wrapper_generator.cc)."""
+
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+from ydf_tpu.hyperparameters import (
+    format_documentation,
+    hyperparameter_spec,
+)
+
+
+def test_spec_contents():
+    spec = hyperparameter_spec(ydf.GradientBoostedTreesLearner)
+    assert "num_trees" in spec and "shrinkage" in spec
+    hp = spec["shrinkage"]
+    assert hp.type == "float" and hp.default == 0.1
+    assert hp.min_value == 0.0 and hp.max_value == 1.0
+    assert spec["loss"].type == "enum"
+    assert "DEFAULT" in spec["loss"].choices
+    # Inherited GenericLearner params are part of the spec.
+    assert "num_bins" in spec
+    # Config params are marked as such.
+    assert spec["label"].kind == "config"
+    assert spec["num_trees"].kind == "hyperparameter"
+
+
+def test_unknown_kwarg_rejected_with_suggestion():
+    with pytest.raises(TypeError, match="num_trees"):
+        ydf.GradientBoostedTreesLearner(label="y", num_treees=5)
+    with pytest.raises(TypeError, match="unknown hyperparameter"):
+        ydf.RandomForestLearner(label="y", definitely_not_a_param=1)
+
+
+def test_range_validation():
+    with pytest.raises(ValueError, match="below the minimum"):
+        ydf.GradientBoostedTreesLearner(label="y", num_trees=0)
+    with pytest.raises(ValueError, match="above the maximum"):
+        ydf.GradientBoostedTreesLearner(label="y", shrinkage=1.5)
+    with pytest.raises(ValueError, match="expected one of"):
+        ydf.GradientBoostedTreesLearner(label="y", early_stopping="NOPE")
+
+
+def test_valid_construction_passes():
+    l = ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=7, shrinkage=0.3, loss="SQUARED_ERROR",
+        task=Task.REGRESSION, num_bins=64,
+    )
+    assert l.num_trees == 7 and l.num_bins == 64
+    ydf.CartLearner(label="y", max_depth=4)
+    ydf.IsolationForestLearner(num_trees=10)
+
+
+def test_spec_on_all_learners():
+    for cls in (
+        ydf.GradientBoostedTreesLearner,
+        ydf.RandomForestLearner,
+        ydf.CartLearner,
+        ydf.IsolationForestLearner,
+    ):
+        spec = cls.hyperparameter_spec()
+        assert "random_seed" in spec
+        for hp in spec.values():
+            assert hp.name and hp.type
+
+
+def test_tuner_space_validation():
+    from ydf_tpu.learners.tuner import validate_space
+
+    l = ydf.GradientBoostedTreesLearner(label="y")
+    validate_space({"max_depth": [3, 4], "shrinkage": [0.05, 0.1]}, l)
+    with pytest.raises(ValueError, match="not hyperparameters"):
+        validate_space({"nope": [1]}, l)
+    with pytest.raises(ValueError, match="above the maximum"):
+        validate_space({"shrinkage": [2.0]}, l)
+
+
+def test_documentation_renders():
+    doc = format_documentation()
+    assert "# Hyperparameters" in doc
+    assert "GradientBoostedTreesLearner" in doc
+    assert "`shrinkage`" in doc
+    assert "max 1.0" in doc
+
+
+def test_deep_learner_validation():
+    from ydf_tpu.deep import MultiLayerPerceptronLearner
+
+    with pytest.raises(TypeError, match="unknown hyperparameter"):
+        MultiLayerPerceptronLearner(label="y", layersize=3)
+    spec = MultiLayerPerceptronLearner.hyperparameter_spec()
+    assert "layer_size" in spec and "learning_rate" in spec
+
+
+def test_hpo_validation():
+    from ydf_tpu.learners.hyperparameter_optimizer import (
+        HyperParameterOptimizerLearner,
+    )
+
+    base = ydf.GradientBoostedTreesLearner(label="y", num_trees=5)
+    with pytest.raises(ValueError, match="below the minimum"):
+        HyperParameterOptimizerLearner(base_learner=base, num_trials=0)
+
+
+def test_wrong_type_rejected():
+    with pytest.raises(TypeError, match="expects one of"):
+        ydf.GradientBoostedTreesLearner(label="y", loss=5)
+    with pytest.raises(TypeError, match="expects a number"):
+        ydf.GradientBoostedTreesLearner(label="y", shrinkage="0.5")
+    with pytest.raises(TypeError, match="expects an int"):
+        ydf.GradientBoostedTreesLearner(label="y", num_trees=2.5)
+    with pytest.raises(TypeError, match="expects a bool"):
+        ydf.RandomForestLearner(label="y", winner_take_all=1)
+
+
+def test_spec_json_serializable():
+    import json
+
+    spec = hyperparameter_spec(ydf.GradientBoostedTreesLearner)
+    json.dumps({k: v.to_json() for k, v in spec.items()})
+
+
+def test_hpo_cross_validation_scoring():
+    import numpy as np
+
+    from ydf_tpu.learners.hyperparameter_optimizer import (
+        HyperParameterOptimizerLearner,
+    )
+
+    rng = np.random.default_rng(0)
+    n = 400
+    x = rng.normal(size=(n, 3))
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(int)
+    data = {f"f{i}": x[:, i] for i in range(3)}
+    data["y"] = np.where(y == 1, "a", "b")
+
+    base = ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=5, validation_ratio=0.0, max_depth=3
+    )
+    opt = HyperParameterOptimizerLearner(
+        base_learner=base,
+        search_space={"max_depth": [2, 3]},
+        num_trials=2,
+        cross_validation_folds=3,
+        parallel_trials=1,
+    )
+    model = opt.train(data)
+    # draw_trials dedups colliding draws, so 1 or 2 trials survive.
+    assert 1 <= len(opt.logs) <= 2
+    assert model.extra_metadata["tuner_logs"]["best_params"]
+    with pytest.raises(ValueError, match="pass one or the other"):
+        opt.train(data, valid=data)

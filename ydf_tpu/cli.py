@@ -264,9 +264,39 @@ def cmd_synthetic_dataset(args):
     print(f"Wrote {n} examples to {path}")
 
 
+def cmd_hyperparameters(args):
+    """Machine-readable spec of one learner (JSON) or the generated doc
+    page for all learners (reference learner/export_doc.cc +
+    wrapper_generator.cc)."""
+    from ydf_tpu.hyperparameters import (
+        default_learner_classes,
+        format_documentation,
+        hyperparameter_spec,
+    )
+
+    if args.learner:
+        import ydf_tpu as ydf
+
+        cls = getattr(ydf, _LEARNERS[args.learner])
+        spec = hyperparameter_spec(cls)
+        print(json.dumps(
+            {name: hp.to_json() for name, hp in spec.items()}, indent=2
+        ))
+    else:
+        print(format_documentation(default_learner_classes()))
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="ydf_tpu", description=__doc__)
     sub = ap.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser(
+        "hyperparameters",
+        help="print a learner's hyperparameter spec (JSON) or, with no "
+             "--learner, the full generated markdown doc page",
+    )
+    p.add_argument("--learner", choices=sorted(_LEARNERS))
+    p.set_defaults(fn=cmd_hyperparameters)
 
     p = sub.add_parser("train")
     p.add_argument("--dataset", required=True)

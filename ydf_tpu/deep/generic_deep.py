@@ -22,6 +22,7 @@ import numpy as np
 from ydf_tpu.config import Task
 from ydf_tpu.dataset.dataset import Dataset, InputData
 from ydf_tpu.dataset.dataspec import ColumnType, DataSpecification
+from ydf_tpu.hyperparameters import HyperparameterValidationMixin
 
 
 class DeepPreprocessor:
@@ -277,7 +278,7 @@ def _build_module(cfg: Dict[str, Any], pre: DeepPreprocessor):
     raise ValueError(f"Unknown deep architecture {arch!r}")
 
 
-class GenericDeepLearner:
+class GenericDeepLearner(HyperparameterValidationMixin):
     """Shared minibatch training loop (reference GenericJaxLearner,
     generic_jax.py:610)."""
 

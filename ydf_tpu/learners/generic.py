@@ -17,9 +17,16 @@ from ydf_tpu.config import Task
 from ydf_tpu.dataset.binning import BinnedDataset, Binner
 from ydf_tpu.dataset.dataset import Dataset, InputData
 from ydf_tpu.dataset.dataspec import ColumnType
+from ydf_tpu.hyperparameters import HyperparameterValidationMixin
 
 
-class GenericLearner:
+class GenericLearner(HyperparameterValidationMixin):
+    # Every learner constructor validates its kwargs against the
+    # machine-readable hyperparameter spec (ydf_tpu/hyperparameters.py —
+    # counterpart of the reference's SetHyperParameters validation,
+    # abstract_learner.h): unknown names are rejected at construction
+    # time with a suggestion instead of being silently absorbed.
+
     def __init__(
         self,
         label: Optional[str],

@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ydf_tpu.dataset.dataset import Dataset, InputData
+from ydf_tpu.hyperparameters import HyperparameterValidationMixin
 from ydf_tpu.learners.tuner import (
     RandomSearchTuner,
     TrialLog,
@@ -37,7 +38,7 @@ from ydf_tpu.learners.tuner import (
 )
 
 
-class HyperParameterOptimizerLearner:
+class HyperParameterOptimizerLearner(HyperparameterValidationMixin):
     """`HyperParameterOptimizerLearner(base_learner=...).train(ds)`.
 
     Mirrors the reference meta-learner shape: the search space is either an
@@ -53,6 +54,7 @@ class HyperParameterOptimizerLearner:
         tuner: Optional[RandomSearchTuner] = None,
         num_trials: int = 20,
         holdout_ratio: float = 0.2,
+        cross_validation_folds: int = 0,
         parallel_trials: int = 0,  # 0 = one per visible device
         random_seed: int = 1234,
     ):
@@ -63,6 +65,11 @@ class HyperParameterOptimizerLearner:
         self.search_space = search_space
         self.num_trials = tuner.num_trials if tuner is not None else num_trials
         self.holdout_ratio = holdout_ratio
+        # Trial scoring: single holdout (default), or k-fold
+        # cross-validation when cross_validation_folds >= 2 (reference:
+        # trial evaluation via cross-validation,
+        # hyperparameters_optimizer.cc evaluation modes).
+        self.cross_validation_folds = cross_validation_folds
         self.parallel_trials = parallel_trials
         self.random_seed = tuner.seed if tuner is not None else random_seed
         self.logs: List[TrialLog] = []
@@ -84,6 +91,12 @@ class HyperParameterOptimizerLearner:
 
         from ydf_tpu.analysis.importance import _primary_metric
 
+        if valid is not None and self.cross_validation_folds >= 2:
+            raise ValueError(
+                "cross_validation_folds scores trials by k-fold CV over "
+                "`data`; a `valid` dataset would be silently ignored for "
+                "trial scoring — pass one or the other"
+            )
         space = self._space()
         trials = draw_trials(space, self.num_trials, self.random_seed)
         if not trials:
@@ -91,16 +104,41 @@ class HyperParameterOptimizerLearner:
 
         ds = Dataset.from_data(data)
         raw = {k: np.asarray(v) for k, v in ds.data.items()}
-        if valid is not None:
-            train_data, hold_data = raw, valid
-        else:
-            train_data, hold_data = holdout_split(
-                raw, ds.num_rows, self.holdout_ratio, self.random_seed
-            )
+        train_data = hold_data = None
+        if self.cross_validation_folds < 2:
+            if valid is not None:
+                train_data, hold_data = raw, valid
+            else:
+                train_data, hold_data = holdout_split(
+                    raw, ds.num_rows, self.holdout_ratio, self.random_seed
+                )
 
         devices = jax.devices()
         workers = self.parallel_trials or len(devices)
         workers = max(1, min(workers, len(trials)))
+
+        cv_folds = None
+        if self.cross_validation_folds >= 2:
+            from ydf_tpu.config import Task
+            from ydf_tpu.metrics.cross_validation import fold_indices
+
+            n = ds.num_rows
+            labels = None
+            groups = None
+            if getattr(self.base_learner, "ranking_group", None):
+                groups = raw[self.base_learner.ranking_group]
+            elif self.base_learner.task == Task.CLASSIFICATION:
+                labels = raw[self.base_learner.label]
+            cv_folds = fold_indices(
+                n, self.cross_validation_folds, self.random_seed,
+                labels=labels, groups=groups,
+            )
+
+        def score_once(cand, tr, ho):
+            model = cand.train(tr)
+            ev = model.evaluate(ho)
+            metric, value, sign = _primary_metric(model, ev)
+            return float(sign * value)
 
         def run_trial(i_params):
             i, params = i_params
@@ -112,10 +150,19 @@ class HyperParameterOptimizerLearner:
             # (hyperparameters_optimizer.cc trial dispatch), with chips
             # instead of worker processes.
             with jax.default_device(devices[i % len(devices)]):
-                model = cand.train(train_data)
-                ev = model.evaluate(hold_data)
-            metric, value, sign = _primary_metric(model, ev)
-            return TrialLog(params=params, score=float(sign * value))
+                if cv_folds is None:
+                    score = score_once(cand, train_data, hold_data)
+                else:
+                    # k-fold CV: mean out-of-fold score. All trials share
+                    # one fold assignment so scores are comparable.
+                    scores = []
+                    for f in range(self.cross_validation_folds):
+                        mask = cv_folds == f
+                        tr = {k: v[~mask] for k, v in raw.items()}
+                        ho = {k: v[mask] for k, v in raw.items()}
+                        scores.append(score_once(copy.copy(cand), tr, ho))
+                    score = float(np.mean(scores))
+            return TrialLog(params=params, score=score)
 
         if workers == 1:
             self.logs = [run_trial(t) for t in enumerate(trials)]

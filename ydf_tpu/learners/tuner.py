@@ -53,12 +53,27 @@ def draw_trials(
 
 
 def validate_space(space: Dict[str, List[Any]], learner) -> None:
-    unknown = [k for k in space if not hasattr(learner, k)]
+    """Validates a search space against the learner's machine-readable
+    hyperparameter spec: names must exist and every candidate value must
+    satisfy the spec's type/range/choice constraints."""
+    from ydf_tpu.hyperparameters import (
+        _check_value,
+        hyperparameter_spec,
+    )
+
+    spec = hyperparameter_spec(type(learner))
+    unknown = [k for k in space if k not in spec and not hasattr(learner, k)]
     if unknown:
         raise ValueError(
             f"Search-space parameters {unknown} are not hyperparameters "
             f"of {type(learner).__name__}"
         )
+    for name, values in space.items():
+        hp = spec.get(name)
+        if hp is None:
+            continue
+        for v in values:
+            _check_value(hp, v, type(learner).__name__)
 
 
 def holdout_split(raw: Dict[str, np.ndarray], n: int, holdout_ratio: float,
