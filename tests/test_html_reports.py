@@ -1,0 +1,77 @@
+"""Rich HTML reports: describe / analyze / evaluation (reference
+describe.cc, model_analysis.cc CreateHtmlReport, display_metric.py)."""
+
+import numpy as np
+
+import ydf_tpu as ydf
+
+
+def _toy_model():
+    rng = np.random.RandomState(0)
+    n = 500
+    data = {
+        "num_a": rng.normal(size=n),
+        "num_b": rng.normal(size=n),
+        "cat_c": rng.choice(["x", "y", "z"], size=n),
+        "label": np.where(rng.normal(size=n) > 0, "pos", "neg"),
+    }
+    data["label"] = np.where(
+        data["num_a"] + (data["cat_c"] == "x") > 0.3, "pos", data["label"]
+    )
+    model = ydf.GradientBoostedTreesLearner(
+        label="label", num_trees=8, validation_ratio=0.2
+    ).train(data)
+    return model, data
+
+
+def test_describe_html_sections():
+    model, _ = _toy_model()
+    html = model.describe(output_format="html")
+    assert "<!doctype html>" in html
+    assert "ydf-tabs" in html  # tabbed layout, not an escaped <pre> dump
+    assert "<svg" in html  # training-log chart rendered
+    assert "Dataspec" in html and "Variable importances" in html
+    assert "num_a" in html and "cat_c" in html
+    assert "<pre>" not in html.split("</style>")[-1]
+    # text format still works
+    text = model.describe()
+    assert "Input features" in text
+
+
+def test_analysis_html_charts():
+    model, data = _toy_model()
+    ana = model.analyze(data, num_pdp_features=2, max_rows=300)
+    html = ana.to_html()
+    assert "<!doctype html>" in html
+    assert html.count("<svg") >= 2  # importance bars + at least one curve
+    assert "Partial dependence" in html
+    assert "Conditional expectation" in html
+    # Repeated renders get unique tab-group ids (so two reports can share
+    # a notebook page) but identical content otherwise.
+    html2 = ana._repr_html_()
+    import re
+
+    strip = lambda h: re.sub(r"(name|id|for)='[a-z]+g\d+\d*'", "", h)
+    assert strip(html2) == strip(html)
+
+
+def test_evaluation_html_with_roc():
+    model, data = _toy_model()
+    ev = model.evaluate(data)
+    html = ev.to_html()
+    assert "<!doctype html>" in html
+    assert "accuracy" in html
+    if ev.roc_curve is not None:
+        assert "ROC" in html and "<polyline" in html
+    assert "Confusion" in html
+
+
+def test_regression_describe_html(abalone):
+    from ydf_tpu.config import Task
+
+    model = ydf.RandomForestLearner(
+        label="Rings", task=Task.REGRESSION, num_trees=5,
+        compute_oob_performances=True,
+    ).train(abalone.iloc[:800])
+    html = model.describe(output_format="html")
+    assert "OOB" in html or "Training" in html

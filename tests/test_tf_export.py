@@ -1,0 +1,101 @@
+"""TF SavedModel export (reference port/python/ydf/model/export_tf.py):
+the SavedModel must reproduce model.predict from RAW feature tensors."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+
+tf = pytest.importorskip("tensorflow")
+
+
+def _tf_inputs(df, feature_names, dataspec):
+    from ydf_tpu.dataset.dataspec import ColumnType
+
+    feeds = {}
+    for name in feature_names:
+        col = dataspec.column_by_name(name)
+        v = df[name].to_numpy()
+        if col.type == ColumnType.CATEGORICAL:
+            feeds[name] = tf.constant(v.astype(str))
+        else:
+            feeds[name] = tf.constant(v.astype(np.float32))
+    return feeds
+
+
+def test_gbt_adult_saved_model(tmp_path, adult_train, adult_test):
+    tr = adult_train.iloc[:4000]
+    te = adult_test.iloc[:1000]
+    model = ydf.GradientBoostedTreesLearner(
+        label="income", num_trees=15, validation_ratio=0.0
+    ).train(tr)
+
+    path = str(tmp_path / "tf_model")
+    model.to_tensorflow_saved_model(path, servo_api=True)
+
+    loaded = tf.saved_model.load(path)
+    feeds = _tf_inputs(te, model.input_feature_names(), model.dataspec)
+    got = np.asarray(loaded.serve(**feeds))
+    want = np.asarray(model.predict(te))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    # serving_default signature path too
+    sig = loaded.signatures["serving_default"]
+    got2 = np.asarray(list(sig(**feeds).values())[0])
+    np.testing.assert_allclose(got2, want, atol=1e-5)
+
+
+def test_regression_and_missing_values(tmp_path, abalone):
+    df = abalone.iloc[:2000].copy()
+    model = ydf.GradientBoostedTreesLearner(
+        label="Rings", task=Task.REGRESSION, num_trees=10,
+        validation_ratio=0.0,
+    ).train(df)
+    path = str(tmp_path / "tf_model_reg")
+    model.to_tensorflow_saved_model(path)
+    loaded = tf.saved_model.load(path)
+
+    te = df.iloc[:300].copy()
+    # Inject missing values: NaN numerical + unseen and empty categorical.
+    te.loc[te.index[:50], "LongestShell"] = np.nan
+    te.loc[te.index[:30], "Type"] = ""
+    te.loc[te.index[30:60], "Type"] = "UNSEEN_VALUE"
+    feeds = _tf_inputs(te, model.input_feature_names(), model.dataspec)
+    got = np.asarray(loaded.serve(**feeds))
+    want = np.asarray(model.predict(te))
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
+def test_multiclass_rf(tmp_path, iris_df):
+    model = ydf.RandomForestLearner(
+        label="class", num_trees=10, compute_oob_performances=False
+    ).train(iris_df)
+    path = str(tmp_path / "tf_model_iris")
+    model.to_tensorflow_saved_model(path)
+    loaded = tf.saved_model.load(path)
+    feeds = _tf_inputs(iris_df, model.input_feature_names(), model.dataspec)
+    got = np.asarray(loaded.serve(**feeds))
+    want = np.asarray(model.predict(iris_df))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_set_features_rejected(tmp_path):
+    n = 200
+    rng = np.random.RandomState(0)
+    data = {
+        "tags": np.array(
+            [" ".join(rng.choice(["a", "b", "c"], size=2)) for _ in range(n)],
+            object,
+        ),
+        "x": rng.normal(size=n),
+        "y": rng.randint(0, 2, size=n),
+    }
+    from ydf_tpu.dataset.dataspec import ColumnType
+
+    model = ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=3, validation_ratio=0.0,
+        column_types={"tags": ColumnType.CATEGORICAL_SET},
+    ).train(data)
+    with pytest.raises(NotImplementedError, match="CATEGORICAL_SET"):
+        model.to_tensorflow_saved_model(str(tmp_path / "nope"))

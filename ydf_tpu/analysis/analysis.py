@@ -60,32 +60,92 @@ class Analysis:
             lines.append(f"Partial dependence computed for: {feats}")
         return "\n".join(lines)
 
+    def _curve_chart(self, p: Dict, kind: str) -> str:
+        """One PDP/CEP curve as a line chart (numerical features) or a
+        per-category bar chart (categorical features)."""
+        from ydf_tpu.utils import html_report as H
+
+        ys = np.asarray(p["mean_prediction"]).reshape(len(p["values"]), -1)
+        title = f"{kind}: {p['feature']}"
+        if p.get("type") in ("CATEGORICAL", "BOOLEAN", "CATEGORICAL_SET"):
+            return H.bar_chart_h(
+                [(str(v), float(y[0])) for v, y in zip(p["values"], ys)],
+                title=title, max_items=20,
+            )
+        xs = [float(v) for v in p["values"]]
+        series = [("mean prediction", xs, [float(y[0]) for y in ys])]
+        if ys.shape[1] > 1:
+            # Multiclass: first three class curves (validated palette cap).
+            series = [
+                (f"class {k}", xs, [float(y[k]) for y in ys])
+                for k in range(min(ys.shape[1], 3))
+            ]
+        return H.line_chart(
+            series, title=title, x_label=p["feature"],
+            y_label="prediction",
+        )
+
     def to_html(self) -> str:
-        """Self-contained HTML report (reference CreateHtmlReport,
+        """Self-contained sectioned HTML report with importance bar charts
+        and PDP/CEP curves (reference CreateHtmlReport,
         model_analysis.h:46)."""
-        rows = "".join(
-            f"<tr><td>{d['feature']}</td><td>{d['importance']:+.5f}</td></tr>"
-            for d in self.permutation_importances
-        )
-        pdp_divs = []
-        for p in self.partial_dependences:
-            ys = np.asarray(p["mean_prediction"]).reshape(len(p["values"]), -1)
-            pts = ", ".join(
-                f"[{v!r}, {float(y[0]):.5f}]"
-                for v, y in zip(p["values"], ys)
+        from ydf_tpu.utils import html_report as H
+
+        vi_panes = []
+        if self.permutation_importances:
+            vi_panes.append((
+                "Permutation (metric decrease)",
+                H.bar_chart_h(
+                    [
+                        (d["feature"], d["importance"])
+                        for d in self.permutation_importances
+                    ],
+                    title=(
+                        f"Mean decrease in "
+                        f"{self.permutation_importances[0].get('metric', '')}"
+                    ),
+                )
+                + H.data_table(
+                    ("feature", "importance", "metric"),
+                    [
+                        (d["feature"], f"{d['importance']:+.5f}",
+                         d.get("metric", ""))
+                        for d in self.permutation_importances
+                    ],
+                ),
+            ))
+        for kind, vals in self.structure_importances.items():
+            if vals:
+                vi_panes.append((kind, H.bar_chart_h(
+                    [(d["feature"], d["importance"]) for d in vals],
+                    title=kind,
+                )))
+        vi_html = H.tabs(vi_panes, group="avi") if vi_panes else ""
+
+        pdp_html = "".join(
+            self._curve_chart(p, "PDP") for p in self.partial_dependences
+        ) or "<div class='sub'>(none computed)</div>"
+        cep_html = "".join(
+            self._curve_chart(p, "CEP")
+            for p in self.conditional_expectations
+        ) or "<div class='sub'>(none computed)</div>"
+
+        body = (
+            f"<h1>Model analysis — {H.esc(self.model_type)}</h1>"
+            f"<div class='sub'>task: {H.esc(self.task)}</div>"
+            + H.tabs(
+                [
+                    ("Variable importances", vi_html),
+                    ("Partial dependence", pdp_html),
+                    ("Conditional expectation", cep_html),
+                ],
+                group="ana",
             )
-            pdp_divs.append(
-                f"<h3>PDP: {p['feature']} ({p['type']})</h3>"
-                f"<pre data-pdp='{p['feature']}'>[{pts}]</pre>"
-            )
-        return (
-            "<html><body>"
-            f"<h1>Model analysis — {self.model_type} ({self.task})</h1>"
-            "<h2>Permutation variable importances</h2>"
-            f"<table border=1><tr><th>feature</th><th>importance</th></tr>{rows}</table>"
-            + "".join(pdp_divs)
-            + "</body></html>"
         )
+        return H.document(f"Analysis — {self.model_type}", body)
+
+    def _repr_html_(self) -> str:  # notebook display
+        return self.to_html()
 
 
 def analyze(

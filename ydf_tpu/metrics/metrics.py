@@ -64,6 +64,66 @@ class Evaluation:
                 )
         return "\n".join(lines)
 
+    def to_html(self) -> str:
+        """Rich metric display (reference metric/display_metric.py /
+        metric/report.cc HTML): metric table with CIs, confusion matrix,
+        ROC curve."""
+        from ydf_tpu.utils import html_report as H
+
+        rows = []
+        for k, v in self.metrics.items():
+            ci = (self.confidence_intervals or {}).get(k)
+            rows.append(
+                (k, f"{v:.6g}",
+                 f"[{ci[0]:.6g}, {ci[1]:.6g}]" if ci else "")
+            )
+        panes = [(
+            "Metrics",
+            f"<div class='card'>{H.kv_table([('Task', self.task), ('Examples', self.num_examples)])}</div>"
+            + H.data_table(("metric", "value", "CI95"), rows),
+        )]
+        if self.confusion is not None and self.classes is not None:
+            crows = [
+                [self.classes[i]] + [int(v) for v in row]
+                for i, row in enumerate(self.confusion)
+            ]
+            panes.append((
+                "Confusion",
+                "<div class='sub'>rows = label, cols = prediction</div>"
+                + H.data_table(["label \\ pred"] + list(self.classes),
+                               crows),
+            ))
+        if self.roc_curve is not None:
+            fpr, tpr = (
+                np.asarray(self.roc_curve[0], np.float64),
+                np.asarray(self.roc_curve[1], np.float64),
+            )
+            # Thin dense curves for a compact artifact.
+            if len(fpr) > 400:
+                idx = np.linspace(0, len(fpr) - 1, 400).astype(int)
+                fpr, tpr = fpr[idx], tpr[idx]
+            panes.append((
+                "ROC",
+                H.line_chart(
+                    [
+                        ("model", fpr.tolist(), tpr.tolist()),
+                        ("chance", [0.0, 1.0], [0.0, 1.0]),
+                    ],
+                    title=f"ROC (AUC={self.metrics.get('auc', float('nan')):.4f})",
+                    x_label="false positive rate",
+                    y_label="true positive rate",
+                ),
+            ))
+        body = (
+            f"<h1>Evaluation — {H.esc(self.task)}</h1>" + H.tabs(
+                panes, group="ev"
+            )
+        )
+        return H.document("Evaluation", body)
+
+    def _repr_html_(self) -> str:  # notebook display
+        return self.to_html()
+
 
 def roc_auc(labels: np.ndarray, scores: np.ndarray) -> float:
     """Exact ROC-AUC via the rank statistic (ties get average rank)."""

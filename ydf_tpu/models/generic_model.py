@@ -74,6 +74,8 @@ class GenericModel:
         structure stats, input features with types, structure variable
         importances, training logs and self-evaluation when present.
         output_format: "text" or "html"."""
+        if output_format == "html":
+            return self._describe_html()
         f = self.forest.to_numpy()
         nn = np.asarray(f["num_nodes"])
         is_leaf = np.asarray(f["is_leaf"])
@@ -153,16 +155,113 @@ class GenericModel:
             )
             lines += ["", f"Self-evaluation (OOB): {m}"]
         lines += ["", "Dataspec:", str(self.dataspec)]
-        text = "\n".join(l for l in lines if l is not None)
-        if output_format == "html":
-            import html as _html
+        return "\n".join(l for l in lines if l is not None)
 
-            return (
-                "<html><body><pre>"
-                + _html.escape(text)
-                + "</pre></body></html>"
+    def _describe_html(self) -> str:
+        """Sectioned, self-contained HTML model card (reference
+        describe.cc:742 tabbed output: model / dataspec / training /
+        variable importances / structure)."""
+        from ydf_tpu.utils import html_report as H
+
+        f = self.forest.to_numpy()
+        nn = np.asarray(f["num_nodes"])
+        is_leaf = np.asarray(f["is_leaf"])
+        leaf_counts = [
+            int(is_leaf[t, : nn[t]].sum()) for t in range(len(nn))
+        ]
+        summary = [
+            ("Type", self.model_type),
+            ("Task", self.task.value),
+            ("Label", self.label),
+        ]
+        if self.classes:
+            summary.append(("Classes", ", ".join(map(str, self.classes))))
+        summary += [
+            ("Trees", self.num_trees()),
+            ("Nodes", self.num_nodes()),
+            ("Leaves", sum(leaf_counts)),
+            ("Max depth", self.max_depth),
+        ]
+        if getattr(self, "loss_name", ""):
+            summary.append(("Loss", self.loss_name))
+        model_pane = f"<div class='card'>{H.kv_table(summary)}</div>"
+
+        feat_rows = []
+        for name in self.input_feature_names():
+            col = self.dataspec.column_by_name(name)
+            extra = (
+                f"vocab={col.vocab_size}"
+                if col.vocabulary is not None
+                else f"mean={col.mean:.4g}"
             )
-        return text
+            feat_rows.append((name, col.type.value, extra,
+                              col.num_missing or 0))
+        for name in getattr(self.binner, "vs_names", []):
+            col = self.dataspec.column_by_name(name)
+            feat_rows.append(
+                (name, col.type.value, f"dim={col.vector_length}", 0)
+            )
+        dataspec_pane = H.data_table(
+            ("feature", "type", "stats", "missing"), feat_rows
+        )
+
+        train_pane = "<div class='sub'>(no training logs)</div>"
+        logs = getattr(self, "training_logs", None)
+        if logs and logs.get("train_loss"):
+            tl = [float(v) for v in logs["train_loss"]]
+            series = [("train loss", list(range(1, len(tl) + 1)), tl)]
+            if logs.get("valid_loss"):
+                vl = [float(v) for v in logs["valid_loss"]]
+                series.append(
+                    ("valid loss", list(range(1, len(vl) + 1)), vl)
+                )
+            train_pane = (
+                H.line_chart(series, title="Training loss",
+                             x_label="iteration (trees)", y_label="loss")
+                + H.kv_table([
+                    ("Iterations", len(tl)),
+                    ("Final train loss", f"{tl[-1]:.5f}"),
+                ] + ([
+                    ("Final valid loss", f"{logs['valid_loss'][-1]:.5f}")
+                ] if logs.get("valid_loss") else []))
+            )
+        oob = getattr(self, "oob_evaluation", None)
+        if oob:
+            train_pane += "<h3>Self-evaluation (OOB)</h3>" + H.kv_table(
+                [(k, f"{v:.5f}") for k, v in oob["metrics"].items()]
+            )
+
+        vi_pane = "<div class='sub'>(unavailable)</div>"
+        try:
+            from ydf_tpu.analysis.importance import structure_importances
+
+            si = structure_importances(self)
+            panes = []
+            for kind, vals in si.items():
+                if vals:
+                    panes.append((kind, H.bar_chart_h(
+                        [(d["feature"], d["importance"]) for d in vals],
+                        title=kind,
+                    )))
+            if panes:
+                vi_pane = H.tabs(panes, group="vi")
+        except Exception:
+            pass
+
+        body = (
+            f"<h1>{H.esc(self.model_type)} — {H.esc(str(self.label))}</h1>"
+            "<div class='sub'>ydf_tpu model card</div>"
+            + H.tabs(
+                [
+                    ("Model", model_pane),
+                    ("Dataspec", dataspec_pane),
+                    ("Training", train_pane),
+                    ("Variable importances", vi_pane),
+                ],
+                group="desc",
+            )
+        )
+        return H.document(f"{self.model_type} {self.label}", body)
 
     # ------------------------------------------------------------------ #
     # Analysis (reference: model.analyze / model.predict_shap /
@@ -341,6 +440,20 @@ class GenericModel:
             return jnp.asarray(x_num), jnp.asarray(x_cat)
 
         return fn, params, encoder
+
+    def to_tensorflow_saved_model(
+        self, path: str, servo_api: bool = False,
+        feature_dtypes: Optional[dict] = None,
+    ) -> None:
+        """Exports a standalone TF SavedModel reproducing predict()
+        (reference port/python/ydf/model/export_tf.py): raw named feature
+        tensors in, predictions out; the forest runs through the jax2tf
+        bridge and the feature encoding is mirrored in the TF graph."""
+        from ydf_tpu.models.export_tf import to_tensorflow_saved_model
+
+        to_tensorflow_saved_model(
+            self, path, servo_api=servo_api, feature_dtypes=feature_dtypes
+        )
 
     def update_with_jax_params(self, params) -> None:
         """Writes fine-tuned leaf values back into the model (reference

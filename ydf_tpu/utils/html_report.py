@@ -1,0 +1,348 @@
+"""Self-contained HTML report framework: layout, tabs, and SVG charts.
+
+Shared by ``model.describe(output_format="html")``, ``Analysis.to_html()``
+and ``Evaluation.to_html()`` — the counterpart of the reference's HTML
+plumbing (`ydf/utils/html.h`, `model/describe.cc:742`,
+`utils/model_analysis.cc` CreateHtmlReport, `metric/report.cc`): one
+dependency-free artifact per report — inline CSS + inline SVG, no external
+scripts, dark-mode aware.
+
+Charts follow the repo's viz conventions: categorical hues in fixed slot
+order (blue, orange, aqua — a validated palette), text in text tokens (not
+series colors), 2px line marks, recessive grid, native SVG tooltips via
+<title>, a legend only at >= 2 series.
+"""
+
+from __future__ import annotations
+
+import html as _html
+from typing import List, Optional, Sequence, Tuple
+
+# Validated palette (light, dark) per categorical slot; see dataviz notes.
+_SERIES = [
+    ("#2a78d6", "#3987e5"),  # blue
+    ("#eb6834", "#d95926"),  # orange
+    ("#1baf7a", "#199e70"),  # aqua
+]
+
+_CSS = """
+<style>
+.ydf-root {
+  color-scheme: light;
+  --surface-1: #fcfcfb; --surface-2: #f1f0ee;
+  --text-primary: #0b0b0b; --text-secondary: #52514e;
+  --grid: #e3e2df; --axis: #b9b8b4;
+  --series-1: #2a78d6; --series-2: #eb6834; --series-3: #1baf7a;
+  font-family: system-ui, -apple-system, sans-serif;
+  background: var(--surface-1); color: var(--text-primary);
+  max-width: 1080px; margin: 0 auto; padding: 16px 24px;
+}
+@media (prefers-color-scheme: dark) {
+  :root:where(:not([data-theme="light"])) .ydf-root {
+    color-scheme: dark;
+    --surface-1: #1a1a19; --surface-2: #242422;
+    --text-primary: #ffffff; --text-secondary: #c3c2b7;
+    --grid: #333330; --axis: #55544f;
+    --series-1: #3987e5; --series-2: #d95926; --series-3: #199e70;
+  }
+}
+.ydf-root h1 { font-size: 1.35rem; margin: 8px 0 2px; }
+.ydf-root h2 { font-size: 1.05rem; margin: 18px 0 6px; }
+.ydf-root h3 { font-size: .92rem; margin: 12px 0 4px;
+               color: var(--text-secondary); }
+.ydf-root .sub { color: var(--text-secondary); font-size: .86rem; }
+.ydf-root table.kv, .ydf-root table.data {
+  border-collapse: collapse; font-size: .86rem; margin: 6px 0;
+}
+.ydf-root table.kv td, .ydf-root table.data td, .ydf-root table.data th {
+  padding: 3px 10px; border-bottom: 1px solid var(--grid);
+  text-align: left;
+}
+.ydf-root table.data th { color: var(--text-secondary);
+  font-weight: 600; border-bottom: 1px solid var(--axis); }
+.ydf-root table.kv td:first-child { color: var(--text-secondary); }
+.ydf-root .num { text-align: right !important;
+  font-variant-numeric: tabular-nums; }
+.ydf-root .card { background: var(--surface-2); border-radius: 8px;
+  padding: 10px 14px; margin: 8px 0; }
+.ydf-root svg text { fill: var(--text-primary); font-size: 11px; }
+.ydf-root svg .sub { fill: var(--text-secondary); }
+.ydf-root svg .grid { stroke: var(--grid); stroke-width: 1; }
+.ydf-root svg .axis { stroke: var(--axis); stroke-width: 1; }
+/* CSS-only tabs */
+.ydf-tabs { margin-top: 12px; }
+.ydf-tabs > input { display: none; }
+.ydf-tabs > label {
+  display: inline-block; padding: 6px 14px; cursor: pointer;
+  border-radius: 6px 6px 0 0; font-size: .9rem;
+  color: var(--text-secondary); border: 1px solid transparent;
+}
+.ydf-tabs > .ydf-pane { display: none; border-top: 1px solid var(--grid);
+  padding-top: 8px; }
+""" + "".join(
+    f"""
+.ydf-tabs > input:nth-of-type({i}):checked ~ label:nth-of-type({i}) {{
+  color: var(--text-primary); background: var(--surface-2);
+  border: 1px solid var(--grid); border-bottom-color: var(--surface-2);
+}}
+.ydf-tabs > input:nth-of-type({i}):checked ~ .ydf-pane:nth-of-type({i}) {{
+  display: block;
+}}"""
+    for i in range(1, 9)
+) + """
+</style>
+"""
+
+
+def esc(s) -> str:
+    return _html.escape(str(s))
+
+
+def document(title: str, body: str) -> str:
+    return (
+        "<!doctype html><html><head><meta charset='utf-8'>"
+        f"<title>{esc(title)}</title>{_CSS}</head>"
+        f"<body><div class='ydf-root'>{body}</div></body></html>"
+    )
+
+
+_TAB_COUNTER = [0]
+
+
+def tabs(panes: List[Tuple[str, str]], group: str = "t") -> str:
+    """CSS-only tab strip; panes = [(label, inner_html)]. Group ids get a
+    process-unique suffix so several reports can share one page (two
+    Evaluation reports in one notebook must not couple their radios)."""
+    if len(panes) == 1:
+        return panes[0][1]
+    _TAB_COUNTER[0] += 1
+    group = f"{group}g{_TAB_COUNTER[0]}"
+    inputs, labels, divs = [], [], []
+    for i, (label, _) in enumerate(panes):
+        checked = " checked" if i == 0 else ""
+        inputs.append(
+            f"<input type='radio' name='{group}' id='{group}{i}'{checked}>"
+        )
+        labels.append(f"<label for='{group}{i}'>{esc(label)}</label>")
+    for _, inner in panes:
+        divs.append(f"<div class='ydf-pane'>{inner}</div>")
+    return (
+        f"<div class='ydf-tabs'>{''.join(inputs)}{''.join(labels)}"
+        f"{''.join(divs)}</div>"
+    )
+
+
+def kv_table(pairs: Sequence[Tuple[str, object]]) -> str:
+    rows = "".join(
+        f"<tr><td>{esc(k)}</td><td class='num'>{esc(v)}</td></tr>"
+        for k, v in pairs
+    )
+    return f"<table class='kv'>{rows}</table>"
+
+
+def data_table(
+    header: Sequence[str], rows: Sequence[Sequence[object]],
+    numeric_from: int = 1,
+) -> str:
+    head = "".join(f"<th>{esc(h)}</th>" for h in header)
+    body = "".join(
+        "<tr>"
+        + "".join(
+            f"<td{' class=num' if j >= numeric_from else ''}>{esc(c)}</td>"
+            for j, c in enumerate(r)
+        )
+        + "</tr>"
+        for r in rows
+    )
+    return f"<table class='data'><tr>{head}</tr>{body}</table>"
+
+
+def _fmt(v: float) -> str:
+    if v == 0:
+        return "0"
+    a = abs(v)
+    if a >= 1000 or a < 0.001:
+        return f"{v:.2e}"
+    return f"{v:.4g}"
+
+
+def _ticks(lo: float, hi: float, n: int = 5) -> List[float]:
+    import math
+
+    if hi <= lo:
+        hi = lo + 1.0
+    raw = (hi - lo) / max(n, 1)
+    mag = 10 ** math.floor(math.log10(raw))
+    for m in (1, 2, 2.5, 5, 10):
+        if raw <= m * mag:
+            step = m * mag
+            break
+    t0 = math.ceil(lo / step) * step
+    out = []
+    t = t0
+    while t <= hi + 1e-12 * abs(hi):
+        out.append(round(t, 12))
+        t += step
+    return out or [lo, hi]
+
+
+def line_chart(
+    series: List[Tuple[str, Sequence[float], Sequence[float]]],
+    title: str = "",
+    x_label: str = "",
+    y_label: str = "",
+    width: int = 520,
+    height: int = 260,
+    categorical_x: Optional[Sequence[str]] = None,
+) -> str:
+    """Inline-SVG line chart. series = [(name, xs, ys)], <=3 series;
+    a legend renders only at >=2 series."""
+    series = [
+        (n, list(map(float, xs)), list(map(float, ys)))
+        for n, xs, ys in series
+        if len(xs)
+    ]
+    if not series:
+        return "<div class='sub'>(no data)</div>"
+    ml, mr, mt, mb = 56, 14, 26 if title else 12, 40
+    pw, ph = width - ml - mr, height - mt - mb
+    all_x = [x for _, xs, _ in series for x in xs]
+    all_y = [y for _, _, ys in series for y in ys]
+    x0, x1 = min(all_x), max(all_x)
+    y0, y1 = min(all_y), max(all_y)
+    if y0 == y1:
+        y0, y1 = y0 - 0.5, y1 + 0.5
+    pad = 0.04 * (y1 - y0)
+    y0, y1 = y0 - pad, y1 + pad
+    if x0 == x1:
+        x0, x1 = x0 - 0.5, x1 + 0.5
+
+    def X(v):
+        return ml + (v - x0) / (x1 - x0) * pw
+
+    def Y(v):
+        return mt + ph - (v - y0) / (y1 - y0) * ph
+
+    parts = [
+        f"<svg viewBox='0 0 {width} {height}' width='{width}' "
+        f"height='{height}' role='img'>"
+    ]
+    if title:
+        parts.append(f"<text x='{ml}' y='15' font-weight='600'>"
+                     f"{esc(title)}</text>")
+    for t in _ticks(y0 + pad, y1 - pad):
+        if y0 <= t <= y1:
+            parts.append(
+                f"<line class='grid' x1='{ml}' y1='{Y(t):.1f}' "
+                f"x2='{ml + pw}' y2='{Y(t):.1f}'/>"
+                f"<text class='sub' x='{ml - 6}' y='{Y(t) + 4:.1f}' "
+                f"text-anchor='end'>{_fmt(t)}</text>"
+            )
+    if categorical_x:
+        # Tick each category (thinned to <=8 labels).
+        step = max(1, len(categorical_x) // 8)
+        for i, name in enumerate(categorical_x):
+            if i % step == 0:
+                parts.append(
+                    f"<text class='sub' x='{X(i):.1f}' y='{mt + ph + 16}' "
+                    f"text-anchor='middle'>{esc(str(name)[:10])}</text>"
+                )
+    else:
+        for t in _ticks(x0, x1):
+            if x0 <= t <= x1:
+                parts.append(
+                    f"<text class='sub' x='{X(t):.1f}' y='{mt + ph + 16}' "
+                    f"text-anchor='middle'>{_fmt(t)}</text>"
+                )
+    parts.append(
+        f"<line class='axis' x1='{ml}' y1='{mt + ph}' x2='{ml + pw}' "
+        f"y2='{mt + ph}'/><line class='axis' x1='{ml}' y1='{mt}' "
+        f"x2='{ml}' y2='{mt + ph}'/>"
+    )
+    for si, (name, xs, ys) in enumerate(series[:3]):
+        color = f"var(--series-{si + 1})"
+        pts = " ".join(f"{X(x):.1f},{Y(y):.1f}" for x, y in zip(xs, ys))
+        parts.append(
+            f"<polyline points='{pts}' fill='none' stroke='{color}' "
+            f"stroke-width='2'><title>{esc(name)}</title></polyline>"
+        )
+        if len(xs) <= 60:
+            for x, y in zip(xs, ys):
+                parts.append(
+                    f"<circle cx='{X(x):.1f}' cy='{Y(y):.1f}' r='3' "
+                    f"fill='{color}'><title>{esc(name)}: "
+                    f"({_fmt(x)}, {_fmt(y)})</title></circle>"
+                )
+    if len(series) >= 2:
+        lx = ml + 8
+        for si, (name, _, _) in enumerate(series[:3]):
+            parts.append(
+                f"<rect x='{lx}' y='{mt + 4}' width='10' height='10' rx='2' "
+                f"fill='var(--series-{si + 1})'/>"
+                f"<text x='{lx + 14}' y='{mt + 13}'>{esc(name)}</text>"
+            )
+            lx += 14 + 8 * len(name) + 18
+    if y_label:
+        parts.append(
+            f"<text class='sub' x='12' y='{mt - 6}'>{esc(y_label)}</text>"
+        )
+    if x_label:
+        parts.append(
+            f"<text class='sub' x='{ml + pw / 2:.0f}' y='{height - 6}' "
+            f"text-anchor='middle'>{esc(x_label)}</text>"
+        )
+    parts.append("</svg>")
+    return "".join(parts)
+
+
+def bar_chart_h(
+    items: Sequence[Tuple[str, float]],
+    title: str = "",
+    width: int = 520,
+    max_items: int = 15,
+) -> str:
+    """Horizontal bar chart, single hue, value-labeled ends (importances)."""
+    items = list(items)[:max_items]
+    if not items:
+        return "<div class='sub'>(no data)</div>"
+    bar_h, gap = 18, 6
+    mt = 26 if title else 8
+    ml = 10 + max(6 * max(len(str(k)) for k, _ in items), 40)
+    ml = min(ml, 220)
+    mr = 70
+    height = mt + len(items) * (bar_h + gap) + 10
+    vmax = max(abs(v) for _, v in items) or 1.0
+    pw = width - ml - mr
+    has_neg = any(v < 0 for _, v in items)
+    # Zero baseline: negatives draw leftward so polarity is visible in
+    # the geometry, not only in the end label.
+    zero_x = ml + (pw * 0.35 if has_neg else 0)
+    parts = [
+        f"<svg viewBox='0 0 {width} {height}' width='{width}' "
+        f"height='{height}' role='img'>"
+    ]
+    if title:
+        parts.append(
+            f"<text x='{ml}' y='15' font-weight='600'>{esc(title)}</text>"
+        )
+    y = mt
+    for name, v in items:
+        w = abs(v) / vmax * (pw - (zero_x - ml))
+        bx = zero_x - w if v < 0 else zero_x
+        label_x = zero_x + w + 5 if v >= 0 else zero_x + 5
+        parts.append(
+            f"<text class='sub' x='{ml - 6}' y='{y + bar_h - 5}' "
+            f"text-anchor='end'>{esc(str(name)[:32])}</text>"
+            f"<rect x='{bx:.1f}' y='{y}' width='{w:.1f}' height='{bar_h}' "
+            f"rx='4' fill='var(--series-1)'>"
+            f"<title>{esc(name)}: {_fmt(v)}</title></rect>"
+            f"<text x='{label_x:.1f}' y='{y + bar_h - 5}'>{_fmt(v)}"
+            "</text>"
+        )
+        y += bar_h + gap
+    parts.append(
+        f"<line class='axis' x1='{zero_x:.1f}' y1='{mt}' "
+        f"x2='{zero_x:.1f}' y2='{y}'/>"
+    )
+    parts.append("</svg>")
+    return "".join(parts)
