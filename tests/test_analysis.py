@@ -117,7 +117,8 @@ def test_analyze_end_to_end(adult_gbt, adult_test):
     text = str(a)
     assert "Permutation variable importances" in text
     html = a.to_html()
-    assert html.startswith("<html>") and "PDP" in html
+    assert html.lstrip().lower().startswith("<!doctype html>")
+    assert "<html>" in html and "PDP" in html
     vi = a.variable_importances()
     assert "MEAN_DECREASE_IN_METRIC" in vi and "NUM_NODES" in vi
 

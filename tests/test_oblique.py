@@ -62,3 +62,113 @@ def test_shap_oblique_raises(adult_test):
     m = ydf.load_ydf_model(f"{MD}/adult_binary_class_gbdt_oblique")
     with pytest.raises(NotImplementedError, match="oblique"):
         m.predict_shap(adult_test.head(5))
+
+
+@pytest.mark.parametrize("wt", ["POWER_OF_TWO", "INTEGER"])
+def test_oblique_weight_types(wt):
+    """POWER_OF_TWO / INTEGER projection coefficients (reference
+    decision_tree.proto PowerOfTwoWeights/IntegerWeights)."""
+    rng = np.random.RandomState(1)
+    n = 3000
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    y = ((x1 + 0.5 * x2) > 0).astype(np.int64)
+    data = {"x1": x1, "x2": x2, "y": y}
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=5, max_depth=3,
+        split_axis="SPARSE_OBLIQUE", sparse_oblique_weights=wt,
+        sparse_oblique_num_projections_exponent=2.0,
+        validation_ratio=0.0, early_stopping="NONE",
+    ).train(data)
+    assert m.evaluate(data).accuracy > 0.9
+    w = np.asarray(m.forest.oblique_weights)
+    nz = w[w != 0]
+    assert nz.size > 0
+    if wt == "POWER_OF_TWO":
+        e = np.log2(np.abs(nz))
+        assert np.allclose(e, np.round(e))
+        assert e.min() >= -3 - 1e-6 and e.max() <= 3 + 1e-6
+    else:
+        assert np.allclose(nz, np.round(nz))
+        assert np.abs(nz).max() <= 5
+
+
+def test_mhld_oblique_classification():
+    """MHLD oblique (reference oblique.cc FindBestConditionMHLDOblique):
+    LDA projections recover a rotated linear boundary with few trees;
+    LDA should put most coefficient mass on the informative pair."""
+    rng = np.random.RandomState(2)
+    n = 4000
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    noise = rng.normal(size=(n, 3))
+    y = ((x1 + x2) > 0).astype(np.int64)
+    data = {"x1": x1, "x2": x2, "y": y}
+    for j in range(3):
+        data[f"n{j}"] = noise[:, j]
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", split_axis="MHLD_OBLIQUE", num_trees=5, max_depth=3,
+        mhld_oblique_max_num_attributes=3,
+        validation_ratio=0.0, early_stopping="NONE", random_seed=17,
+    ).train(data)
+    assert m.evaluate(data).accuracy > 0.95
+    ow = np.asarray(m.forest.oblique_weights)
+    assert ow.size > 0
+    # Save/load round-trip.
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        m.save(td + "/m")
+        m2 = ydf.load_model(td + "/m")
+        np.testing.assert_allclose(
+            m2.predict(data), m.predict(data), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_mhld_requires_classification():
+    with pytest.raises(ValueError, match="MHLD"):
+        ydf.GradientBoostedTreesLearner(
+            label="y", task=Task.REGRESSION, split_axis="MHLD_OBLIQUE",
+            num_trees=2,
+        ).train({"x": np.arange(50.0), "y": np.arange(50.0)})
+
+
+def test_rf_sparse_oblique():
+    """RF sparse-oblique (the Tomita et al. home turf, reference
+    oblique.cc via random_forest): beats axis-aligned RF on a rotated
+    boundary at small depth; OOB evaluation still works."""
+    rng = np.random.RandomState(0)
+    n = 3000
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    y = ((x1 + x2) > 0).astype(np.int64)
+    data = {"x1": x1, "x2": x2, "y": y}
+    kw = dict(num_trees=15, max_depth=4, random_seed=7)
+    axis = ydf.RandomForestLearner(label="y", **kw).train(data)
+    obl = ydf.RandomForestLearner(
+        label="y", split_axis="SPARSE_OBLIQUE",
+        sparse_oblique_num_projections_exponent=2.0, **kw
+    ).train(data)
+    acc_axis = axis.evaluate(data).accuracy
+    acc_obl = obl.evaluate(data).accuracy
+    assert acc_obl > acc_axis, (acc_obl, acc_axis)
+    assert np.asarray(obl.forest.oblique_weights).size > 0
+    assert obl.oob_evaluation is not None
+    # Save/load round-trip.
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        obl.save(td + "/m")
+        m2 = ydf.load_model(td + "/m")
+        np.testing.assert_allclose(
+            m2.predict(data), obl.predict(data), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_rf_oblique_oob_importances_guard():
+    data = {
+        "x1": np.arange(100.0), "x2": np.arange(100.0)[::-1].copy(),
+        "y": (np.arange(100) % 2).astype(np.int64),
+    }
+    with pytest.raises(NotImplementedError, match="SPARSE_OBLIQUE"):
+        ydf.RandomForestLearner(
+            label="y", num_trees=3, split_axis="SPARSE_OBLIQUE",
+            compute_oob_variable_importances=True,
+        ).train(data)

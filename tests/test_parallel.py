@@ -156,6 +156,60 @@ def test_large_shard_exceeds_single_device_share():
     )
 
 
+def test_rf_uplift_on_mesh():
+    """mesh×uplift (VERDICT r2 weak #7): treatment codes ride the padded/
+    sharded data axis; pad rows carry treatment code 0 = excluded."""
+    import pandas as pd
+
+    D = "/root/reference/yggdrasil_decision_forests/test_data/dataset"
+    train = pd.read_csv(f"{D}/sim_pte_train.csv")
+    from ydf_tpu.config import Task
+
+    kwargs = dict(
+        label="y", task=Task.CATEGORICAL_UPLIFT, uplift_treatment="treat",
+        num_trees=10, max_depth=4, random_seed=5,
+    )
+    m1 = ydf.RandomForestLearner(**kwargs).train(train)
+    mesh = make_mesh(jax.devices())
+    m2 = ydf.RandomForestLearner(mesh=mesh, **kwargs).train(train)
+    p1, p2 = m1.predict(train), m2.predict(train)
+    np.testing.assert_allclose(p1, p2, atol=1e-4)
+
+
+def test_gbt_survival_on_mesh():
+    """mesh×survival (VERDICT r2 weak #7): Cox risk-set prefix sums over
+    the padded+sharded example axis; pad rows are censored before every
+    real update time and contribute exactly nothing."""
+    from ydf_tpu.config import Task
+
+    rng = np.random.RandomState(19)
+    n = 997  # not a multiple of the 8-way data axis
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    hazard = np.exp(0.8 * x1 - 0.5 * x2)
+    age = rng.exponential(1.0 / hazard) + 0.1
+    censor = rng.exponential(2.0, size=n) + 0.1
+    observed = age <= censor
+    data = {
+        "x1": x1, "x2": x2,
+        "age": np.minimum(age, censor).astype(np.float32),
+        "observed": observed,
+    }
+    kwargs = dict(
+        label="age", task=Task.SURVIVAL_ANALYSIS,
+        label_event_observed="observed", num_trees=8, max_depth=3,
+        validation_ratio=0.0, early_stopping="NONE", random_seed=19,
+    )
+    m1 = ydf.GradientBoostedTreesLearner(**kwargs).train(data)
+    mesh = make_mesh(jax.devices())
+    m2 = ydf.GradientBoostedTreesLearner(mesh=mesh, **kwargs).train(data)
+    p1, p2 = m1.predict(data), m2.predict(data)
+    assert np.isfinite(p2).all()
+    np.testing.assert_allclose(p1, p2, atol=1e-3)
+    # Higher risk scores for higher true hazard (sanity).
+    c = np.corrcoef(p2, 0.8 * x1 - 0.5 * x2)[0, 1]
+    assert c > 0.5, c
+
+
 def test_init_distributed_smoke(monkeypatch):
     """init_distributed forwards cluster facts to jax.distributed and is
     idempotent (the real multi-host bring-up needs real hosts; here the

@@ -145,7 +145,9 @@ def test_cli_end_to_end(tmp_path):
     r = _cli(tmp_path, "analyze", "--model", str(model_dir), "--dataset",
              f"csv:{syn}", "--output", str(html), "--cpu")
     assert r.returncode == 0, r.stderr
-    assert "Permutation variable importances" in html.read_text()
+    html_text = html.read_text()
+    # Rich sectioned report (utils/html_report.py): importance tab + PDPs.
+    assert "Variable importances" in html_text and "PDP" in html_text
     # compute_variable_importances (reference cli binary of same name)
     r = _cli(tmp_path, "compute_variable_importances", "--model",
              str(model_dir), "--dataset", f"csv:{syn}", "--cpu")

@@ -286,9 +286,69 @@ def cmd_hyperparameters(args):
         print(format_documentation(default_learner_classes()))
 
 
+def cmd_distribute(args):
+    """Fan a list of shell commands out over a worker pool — the
+    reference's distribute_cli (utils/distribute_cli/distribute_cli.h:
+    15-31: "distribute the execution of command lines"). Workers here are
+    local processes (one per --workers slot); on a multi-host TPU pod the
+    same file is run once per host with --shard i/--num_shards N so each
+    host takes every N-th command. Failed commands are reported at the
+    end and set a non-zero exit code; --keep_going controls whether the
+    pool drains after a failure (the reference's behavior)."""
+    import subprocess
+    import sys
+    from concurrent.futures import ThreadPoolExecutor
+
+    with open(args.commands) as f:
+        commands = [
+            ln.strip() for ln in f
+            if ln.strip() and not ln.strip().startswith("#")
+        ]
+    commands = commands[args.shard:: args.num_shards]
+    if not commands:
+        print("no commands to run")
+        return
+    failures = []
+    stop = {"flag": False}
+
+    def run_one(item):
+        i, cmd = item
+        if stop["flag"]:
+            return
+        r = subprocess.run(cmd, shell=True)
+        if r.returncode != 0:
+            failures.append((i, cmd, r.returncode))
+            if not args.keep_going:
+                stop["flag"] = True
+
+    with ThreadPoolExecutor(max_workers=max(args.workers, 1)) as pool:
+        list(pool.map(run_one, enumerate(commands)))
+    done = len(commands) - len(failures)
+    print(f"distribute: {done}/{len(commands)} commands succeeded")
+    for i, cmd, rc in failures:
+        print(f"  FAILED [{i}] rc={rc}: {cmd}")
+    if failures:
+        sys.exit(1)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="ydf_tpu", description=__doc__)
     sub = ap.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser(
+        "distribute",
+        help="run a file of shell commands over a local worker pool "
+             "(reference utils/distribute_cli)",
+    )
+    p.add_argument("--commands", required=True,
+                   help="file with one shell command per line; # comments")
+    p.add_argument("--workers", type=int, default=4)
+    p.add_argument("--shard", type=int, default=0,
+                   help="this host's index (multi-host: run once per host)")
+    p.add_argument("--num_shards", type=int, default=1)
+    p.add_argument("--keep_going", action="store_true",
+                   help="keep scheduling after a failure")
+    p.set_defaults(fn=cmd_distribute)
 
     p = sub.add_parser(
         "hyperparameters",

@@ -140,6 +140,9 @@ class DatasetCache:
         self.num_rows = int(meta["num_rows"])
         self.label = meta["label"]
         self.weights = meta.get("weights")
+        #: Task-plumbing columns stored beside the bins (ranking groups,
+        #: uplift treatment, survival event/entry) — name → dtype kind.
+        self.extra_columns: List[str] = list(meta.get("extra_columns", []))
         self._meta = meta
 
     @property
@@ -155,6 +158,32 @@ class DatasetCache:
     def sample_weights(self) -> Optional[np.ndarray]:
         p = os.path.join(self.path, "weights.npy")
         return np.load(p, mmap_mode="r") if os.path.exists(p) else None
+
+    @property
+    def raw_numerical(self) -> Optional[np.ndarray]:
+        """float32 [n, num_numerical] imputed raw feature values
+        (memmapped) — present when created with store_raw_numerical=True;
+        required for SPARSE_OBLIQUE training from a cache."""
+        p = os.path.join(self.path, "raw_numerical.npy")
+        return np.load(p, mmap_mode="r") if os.path.exists(p) else None
+
+    def extra_column(self, name: str) -> np.ndarray:
+        """One stored task column. Categorical columns come back as their
+        decoded string values (via the dataspec vocabulary), numerical as
+        float — either way directly usable as Dataset data."""
+        p = os.path.join(self.path, f"col_{name}.npy")
+        if not os.path.exists(p):
+            raise KeyError(
+                f"Column {name!r} was not stored in the cache; recreate it "
+                f"with the column listed (extra columns: "
+                f"{self.extra_columns})"
+            )
+        vals = np.load(p, mmap_mode="r")
+        col = self.dataspec.column_by_name(name)
+        if col.type == ColumnType.CATEGORICAL:
+            vocab = np.asarray(col.vocabulary, object)
+            return vocab[np.asarray(vals)]
+        return np.asarray(vals)
 
     def label_classes(self) -> Optional[List[str]]:
         col = self.dataspec.column_by_name(self.label)
@@ -174,8 +203,21 @@ def create_dataset_cache(
     chunk_rows: int = 500_000,
     max_vocab_count: int = 2000,
     min_vocab_frequency: int = 5,
+    ranking_group: Optional[str] = None,
+    uplift_treatment: Optional[str] = None,
+    label_event_observed: Optional[str] = None,
+    label_entry_age: Optional[str] = None,
+    store_raw_numerical: bool = False,
 ) -> DatasetCache:
-    """Builds an on-disk binned cache from (sharded) CSV input."""
+    """Builds an on-disk binned cache from (sharded) CSV input.
+
+    Task plumbing columns (ranking_group / uplift_treatment /
+    label_event_observed / label_entry_age) are stored beside the bins so
+    ranking, uplift and survival learners can train straight from the
+    cache; `store_raw_numerical=True` additionally memmaps the imputed
+    float32 feature matrix, which SPARSE_OBLIQUE training needs (the
+    reference's dataset cache keeps raw numericals for the same reason,
+    dataset_cache.proto:42-58)."""
     fmt, _ = _split_typed_path(data_path)
     if fmt != "csv":
         raise NotImplementedError(
@@ -201,14 +243,31 @@ def create_dataset_cache(
         for u, k in zip(uniq.tolist(), c.tolist()):
             cnt[u] = cnt.get(u, 0) + k
 
+    extra_cols = [
+        c
+        for c in (
+            ranking_group, uplift_treatment, label_event_observed,
+            label_entry_age,
+        )
+        if c is not None
+    ]
+    # Dictionary-encoded special columns keep their full vocabulary: a
+    # pruned ranking-group or treatment dictionary would silently merge
+    # groups/arms into OOV.
+    no_prune = {label, ranking_group, uplift_treatment} - {None}
+
     for chunk in _iter_chunks(files, chunk_rows):
         if not col_order:
             col_order = list(chunk.keys())
         num_rows += len(next(iter(chunk.values())))
         for name, vals in chunk.items():
             vals = np.asarray(vals)
-            numeric_chunk = vals.dtype.kind in "fiub" and (
-                name != label or task != Task.CLASSIFICATION
+            numeric_chunk = (
+                vals.dtype.kind in "fiub"
+                and (name != label or task != Task.CLASSIFICATION)
+                # Treatment groups are always dictionary-encoded (index 1 =
+                # control, 2 = treated — learners/generic.py convention).
+                and name != uplift_treatment
             )
             if numeric_chunk and name not in cat_counts:
                 num_sketch.setdefault(name, _NumSketch()).update(
@@ -241,14 +300,14 @@ def create_dataset_cache(
             cols.append(num_sketch[name].column(name))
         else:
             cnt = cat_counts[name]
-            minf = 1 if name == label else min_vocab_frequency
+            minf = 1 if name in no_prune else min_vocab_frequency
             items = sorted(
                 cnt.items(), key=lambda kv: (-kv[1], kv[0])
             )
             kept = [
                 (k, v) for k, v in items if v >= max(minf, 1)
             ]
-            if name != label and max_vocab_count > 0:
+            if name not in no_prune and max_vocab_count > 0:
                 kept = kept[:max_vocab_count]
             oov = sum(cnt.values()) - sum(v for _, v in kept)
             cols.append(
@@ -267,7 +326,7 @@ def create_dataset_cache(
     feature_names = features or [
         c.name
         for c in cols
-        if c.name not in {label, weights}
+        if c.name not in ({label, weights} | set(extra_cols))
         and c.type
         in (
             ColumnType.NUMERICAL,
@@ -323,6 +382,27 @@ def create_dataset_cache(
             dtype=np.float32,
             shape=(num_rows,),
         )
+    extra_mm: Dict[str, np.ndarray] = {}
+    for name in extra_cols:
+        col = spec.column_by_name(name)
+        extra_mm[name] = np.lib.format.open_memmap(
+            os.path.join(cache_dir, f"col_{name}.npy"),
+            mode="w+",
+            dtype=(
+                np.int32
+                if col.type == ColumnType.CATEGORICAL
+                else np.float64
+            ),
+            shape=(num_rows,),
+        )
+    raw_mm = None
+    if store_raw_numerical and binner.num_numerical > 0:
+        raw_mm = np.lib.format.open_memmap(
+            os.path.join(cache_dir, "raw_numerical.npy"),
+            mode="w+",
+            dtype=np.float32,
+            shape=(num_rows, binner.num_numerical),
+        )
     row = 0
     label_task = (
         Task.CLASSIFICATION
@@ -338,11 +418,29 @@ def create_dataset_cache(
             weights_mm[row: row + k] = np.asarray(
                 chunk[weights], np.float32
             )
+        for name, mm in extra_mm.items():
+            if mm.dtype == np.int32:
+                mm[row: row + k] = ds.encoded_categorical(name)
+            else:
+                mm[row: row + k] = np.asarray(chunk[name], np.float64)
+        if raw_mm is not None:
+            for i, fname in enumerate(
+                binner.feature_names[: binner.num_numerical]
+            ):
+                raw_mm[row: row + k, i] = (
+                    ds.encoded_numerical(fname)
+                    if fname in ds.data
+                    else binner.impute_values[i]
+                )
         row += k
     bins_mm.flush()
     labels_mm.flush()
     if weights_mm is not None:
         weights_mm.flush()
+    for mm in extra_mm.values():
+        mm.flush()
+    if raw_mm is not None:
+        raw_mm.flush()
 
     with open(os.path.join(cache_dir, "cache_meta.json"), "w") as f:
         json.dump(
@@ -352,6 +450,8 @@ def create_dataset_cache(
                 "num_rows": num_rows,
                 "label": label,
                 "weights": weights,
+                "extra_columns": extra_cols,
+                "store_raw_numerical": bool(raw_mm is not None),
                 "source": data_path,
             },
             f,

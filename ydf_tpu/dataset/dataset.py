@@ -98,6 +98,21 @@ class Dataset:
     # ------------------------------------------------------------------ #
 
     @staticmethod
+    def from_examples(
+        examples,
+        dataspec: Optional[DataSpecification] = None,
+        **kwargs,
+    ) -> "Dataset":
+        """Row-wise ingestion: a sequence of {column: value} dicts
+        (reference dataset/example.proto path; see dataset/example.py).
+        Missing columns in a row become missing cells."""
+        from ydf_tpu.dataset.example import examples_to_columns
+
+        return Dataset.from_data(
+            examples_to_columns(examples), dataspec=dataspec, **kwargs
+        )
+
+    @staticmethod
     def from_data(
         data: InputData,
         label: Optional[str] = None,

@@ -205,6 +205,14 @@ _PARAM_INFO: Dict[str, _Info] = {
         choices=("BINARY", "CONTINUOUS", "POWER_OF_TWO", "INTEGER")),
     "sparse_oblique_max_num_projections": _Info(
         "Upper bound on projections per tree.", min_value=1),
+    "sparse_oblique_weights_power_of_two_min_exponent": _Info(
+        "POWER_OF_TWO weights: minimum exponent (weight = ±2^e)."),
+    "sparse_oblique_weights_power_of_two_max_exponent": _Info(
+        "POWER_OF_TWO weights: maximum exponent (weight = ±2^e)."),
+    "sparse_oblique_weights_integer_minimum": _Info(
+        "INTEGER weights: minimum coefficient value."),
+    "sparse_oblique_weights_integer_maximum": _Info(
+        "INTEGER weights: maximum coefficient value."),
     "mhld_oblique_max_num_attributes": _Info(
         "MHLD oblique: max attributes entering the LDA projection.",
         min_value=1),
@@ -359,6 +367,12 @@ def _check_value(hp: HyperParameter, value: Any, cls_name: str) -> None:
         return
     if hp.choices is not None:
         if not isinstance(value, str):
+            if hp.name == "loss" and hasattr(value, "grad_hess"):
+                # CustomLoss objects are a documented alternative to the
+                # enum names (reference custom-loss bridges,
+                # learner/custom_loss.cc) — the duck-type check mirrors
+                # what the boosting loop requires of them.
+                return
             raise TypeError(
                 f"{cls_name}: hyperparameter {hp.name!r} expects one of "
                 f"{list(hp.choices)}, got {type(value).__name__} {value!r}"

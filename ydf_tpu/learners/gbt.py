@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 from typing import Optional, Sequence
 
 import jax
@@ -104,7 +105,12 @@ class GradientBoostedTreesLearner(GenericLearner):
         sparse_oblique_num_projections_exponent: float = 1.0,
         sparse_oblique_projection_density_factor: float = 2.0,
         sparse_oblique_weights: str = "BINARY",
+        sparse_oblique_weights_power_of_two_min_exponent: int = -3,
+        sparse_oblique_weights_power_of_two_max_exponent: int = 3,
+        sparse_oblique_weights_integer_minimum: int = -5,
+        sparse_oblique_weights_integer_maximum: int = 5,
         sparse_oblique_max_num_projections: int = 64,
+        mhld_oblique_max_num_attributes: int = 4,
         numerical_vector_sequence_num_anchors: int = 16,
         numerical_vector_sequence_enable_closer_than: bool = True,
         numerical_vector_sequence_enable_projected_more_than: bool = True,
@@ -169,13 +175,39 @@ class GradientBoostedTreesLearner(GenericLearner):
         # projections, compute them as ONE [n, Fn] x [Fn, P] matmul on the
         # MXU, quantile-bin the projected values, and let the histogram
         # split search treat them as P extra numerical columns.
-        if split_axis not in ("AXIS_ALIGNED", "SPARSE_OBLIQUE"):
+        # MHLD_OBLIQUE (reference oblique.h Canete-Sifuentes et al.;
+        # oblique.cc FindBestConditionMHLDObliqueTemplate): projections
+        # from Linear Discriminant Analysis instead of random sampling.
+        # TPU recast: per-tree batched LDA — scatter matrices via MXU
+        # matmuls, masked feature subsets, Cholesky + eigh (the
+        # TPU-supported symmetric form of the reference's
+        # SW⁻¹·SB eigenproblem, oblique.cc SolveLDA).
+        if split_axis not in (
+            "AXIS_ALIGNED", "SPARSE_OBLIQUE", "MHLD_OBLIQUE"
+        ):
             raise ValueError(f"Unknown split_axis {split_axis!r}")
-        if sparse_oblique_weights not in ("BINARY", "CONTINUOUS"):
+        self.mhld_oblique_max_num_attributes = mhld_oblique_max_num_attributes
+        if sparse_oblique_weights not in (
+            "BINARY", "CONTINUOUS", "POWER_OF_TWO", "INTEGER"
+        ):
             raise ValueError(
                 f"Unknown sparse_oblique_weights {sparse_oblique_weights!r}"
             )
         self.split_axis = split_axis
+        # POWER_OF_TWO / INTEGER coefficient ranges (reference
+        # decision_tree.proto PowerOfTwoWeights/IntegerWeights defaults).
+        self.sparse_oblique_weights_power_of_two_min_exponent = (
+            sparse_oblique_weights_power_of_two_min_exponent
+        )
+        self.sparse_oblique_weights_power_of_two_max_exponent = (
+            sparse_oblique_weights_power_of_two_max_exponent
+        )
+        self.sparse_oblique_weights_integer_minimum = (
+            sparse_oblique_weights_integer_minimum
+        )
+        self.sparse_oblique_weights_integer_maximum = (
+            sparse_oblique_weights_integer_maximum
+        )
         self.sparse_oblique_num_projections_exponent = (
             sparse_oblique_num_projections_exponent
         )
@@ -204,7 +236,11 @@ class GradientBoostedTreesLearner(GenericLearner):
         # Monotonic constraints: {feature_name: +1|-1} (reference
         # training.h:160-168 ApplyConstraintOnNode). Split search rejects
         # order-violating cuts; a post-training pass clamps leaf values to
-        # propagated bounds, guaranteeing global monotonicity.
+        # propagated bounds, guaranteeing global monotonicity. For
+        # multiclass the guarantee is per-CLASS RAW SCORE monotonicity
+        # (each of the K trees per iteration is constrained — the
+        # reference's semantics); softmax probabilities are ratios of
+        # monotone quantities and are NOT individually monotone.
         self.monotonic_constraints = dict(monotonic_constraints or {})
         # Checkpoint/resume (reference DeploymentConfig.cache_path +
         # resume_training, abstract_learner.proto:52-64): with a
@@ -245,7 +281,11 @@ class GradientBoostedTreesLearner(GenericLearner):
     def train(
         self, data: InputData, valid: Optional[InputData] = None
     ) -> GradientBoostedTreesModel:
-        prep = self._prepare(data, valid=valid)
+        from ydf_tpu.utils.profiling import StageTimer, maybe_trace
+
+        timer = StageTimer()
+        with timer.stage("ingest_bin"):
+            prep = self._prepare(data, valid=valid)
         binner = prep["binner"]
         bins_all = prep["bins"]
         set_all = prep.get("set_bits")
@@ -266,8 +306,6 @@ class GradientBoostedTreesLearner(GenericLearner):
                 raise ValueError(
                     "Task.SURVIVAL_ANALYSIS requires label_event_observed="
                 )
-            if self.mesh is not None:
-                raise NotImplementedError("mesh-distributed survival training")
             ev_all = _bool_column(
                 prep["dataset"].data[self.label_event_observed]
             )
@@ -464,12 +502,49 @@ class GradientBoostedTreesLearner(GenericLearner):
                 en_va = None if en_all is None else en_all[va_idx]
             else:
                 ev_tr, en_tr, ev_va, en_va = ev_all, en_all, None, None
+
+            def _pad_survival(y_arr, ev, en):
+                """Mesh row padding: pad rows become censored examples whose
+                entry AND departure precede every real update time, so they
+                leave every risk set before any event — their gradients and
+                loss terms are exactly zero (their zero training weight
+                already keeps them out of the tree statistics)."""
+                y_np = np.asarray(y_arr, np.float64).copy()
+                nr = len(ev)
+                p = len(y_np) - nr
+                en_full = (
+                    np.zeros((nr,), np.float64)
+                    if en is None
+                    else np.asarray(en, np.float64)
+                )
+                if p == 0:
+                    return y_np, ev, en_full, nr
+                tpad = min(
+                    float(y_np[:nr].min()), float(en_full.min())
+                ) - 1.0
+                y_np[nr:] = tpad
+                ev = np.concatenate([np.asarray(ev, bool), np.zeros(p, bool)])
+                en_full = np.concatenate([en_full, np.full((p,), tpad)])
+                return y_np, ev, en_full, nr
+
+            y_reg, ev_reg, en_reg, n_real = _pad_survival(y_tr, ev_tr, en_tr)
             loss_obj.register_survival(
-                "train", np.asarray(y_tr), ev_tr, en_tr
+                "train", y_reg, ev_reg, en_reg, num_real=n_real,
+                weights=(
+                    np.asarray(w_tr) if self.weights is not None else None
+                ),
             )
             if bins_va.shape[0] > 0:
+                yv_reg, evv_reg, env_reg, nv_real = _pad_survival(
+                    y_va, ev_va, en_va
+                )
                 loss_obj.register_survival(
-                    "valid", np.asarray(y_va), ev_va, en_va
+                    "valid", yv_reg, evv_reg, env_reg, num_real=nv_real,
+                    weights=(
+                        np.asarray(w_va)
+                        if self.weights is not None
+                        else None
+                    ),
                 )
         K = loss_obj.num_dims
         F = binner.num_features
@@ -490,15 +565,12 @@ class GradientBoostedTreesLearner(GenericLearner):
 
         monotone = None
         if self.monotonic_constraints:
-            if self.split_axis == "SPARSE_OBLIQUE":
-                raise NotImplementedError(
-                    "monotonic constraints with oblique splits"
-                )
-            if K > 1:
-                # Clamping (the guarantee) is single-output only so far.
-                raise NotImplementedError(
-                    "monotonic constraints with multi-dim losses"
-                )
+            # Multi-dim losses (multiclass) work unchanged: each of the K
+            # trees per iteration is single-output, so per-tree split
+            # rejection and leaf clamping make every class score monotone
+            # (the reference restricts monotonic GBT only to
+            # use_hessian_gain=true, gradient_boosted_trees.cc:478-483 —
+            # which is this grower's gain).
             dirs = [0] * binner.num_features
             for name, d in self.monotonic_constraints.items():
                 if name not in binner.feature_names:
@@ -518,7 +590,23 @@ class GradientBoostedTreesLearner(GenericLearner):
         # projects them per tree with one MXU matmul.
         obl_P = 0
         x_tr_raw = x_va_raw = None
-        if self.split_axis == "SPARSE_OBLIQUE" and binner.num_numerical > 0:
+        if self.split_axis == "MHLD_OBLIQUE":
+            if self.task != Task.CLASSIFICATION:
+                # The reference restriction (oblique.cc:689-692): LDA
+                # needs class labels.
+                raise ValueError(
+                    "MHLD_OBLIQUE is only available for classification; "
+                    "use SPARSE_OBLIQUE for other tasks"
+                )
+            if self.monotonic_constraints:
+                raise ValueError(
+                    "monotonic constraints are not supported with "
+                    "MHLD_OBLIQUE (LDA coefficients cannot be sign-forced)"
+                )
+        if (
+            self.split_axis in ("SPARSE_OBLIQUE", "MHLD_OBLIQUE")
+            and binner.num_numerical > 0
+        ):
             obl_P = int(
                 np.ceil(
                     binner.num_numerical
@@ -538,7 +626,12 @@ class GradientBoostedTreesLearner(GenericLearner):
                         m[:, i] = binner.impute_values[i]
                 return m
 
-            x_all = enc_raw(prep["dataset"])
+            if prep.get("raw_numerical") is not None:
+                # Out-of-core path: the cache stored the imputed float32
+                # matrix; the cache dataset carries no feature columns.
+                x_all = np.asarray(prep["raw_numerical"], np.float32)
+            else:
+                x_all = enc_raw(prep["dataset"])
             if "valid_bins" in prep:
                 x_tr_raw = x_all
                 x_va_raw = enc_raw(prep["valid_dataset"])
@@ -580,7 +673,8 @@ class GradientBoostedTreesLearner(GenericLearner):
             vs_tr = vs_va = None
         vs_Pv = (vs_Ac + vs_Ap) * binner.num_vs if vs_tr is not None else 0
 
-        forest_stacked, leaf_values, logs = _train_gbt(
+        with timer.stage("device_loop"), maybe_trace("gbt_train"):
+            forest_stacked, leaf_values, logs = _train_gbt(
             jnp.asarray(bins_tr),
             jnp.asarray(y_tr),
             jnp.asarray(w_tr),
@@ -611,6 +705,24 @@ class GradientBoostedTreesLearner(GenericLearner):
             oblique_P=obl_P,
             oblique_density=self.sparse_oblique_projection_density_factor,
             oblique_weight_type=self.sparse_oblique_weights,
+            oblique_mode=(
+                "MHLD" if self.split_axis == "MHLD_OBLIQUE" else "SPARSE"
+            ),
+            mhld_max_attributes=self.mhld_oblique_max_num_attributes,
+            num_label_classes=num_classes,
+            oblique_weight_range=(
+                (
+                    self.sparse_oblique_weights_power_of_two_min_exponent,
+                    self.sparse_oblique_weights_power_of_two_max_exponent,
+                )
+                if self.sparse_oblique_weights == "POWER_OF_TWO"
+                else (
+                    self.sparse_oblique_weights_integer_minimum,
+                    self.sparse_oblique_weights_integer_maximum,
+                )
+                if self.sparse_oblique_weights == "INTEGER"
+                else None
+            ),
             monotone=monotone,
             x_tr_raw=None if x_tr_raw is None else jnp.asarray(x_tr_raw),
             x_va_raw=None if x_va_raw is None else jnp.asarray(x_va_raw),
@@ -639,6 +751,7 @@ class GradientBoostedTreesLearner(GenericLearner):
             ),
         )
 
+        _t_fin = time.perf_counter()
         train_losses = np.asarray(logs["train_loss"])
         valid_losses = np.asarray(logs["valid_loss"])
         has_valid = bins_va.shape[0] > 0
@@ -720,7 +833,7 @@ class GradientBoostedTreesLearner(GenericLearner):
                 stacked, flatten(leaf_values), binner.boundaries
             )
 
-        if self.monotonic_constraints and K == 1:
+        if self.monotonic_constraints:
             forest = _clamp_monotone_leaves(
                 forest, binner, self.monotonic_constraints
             )
@@ -751,6 +864,10 @@ class GradientBoostedTreesLearner(GenericLearner):
             },
             extra_metadata=self._model_metadata(),
         )
+        timer.seconds["finalize"] = time.perf_counter() - _t_fin
+        # Per-stage wall breakdown (reference Monitoring per-stage logs);
+        # device_loop includes XLA compile on first call.
+        model.training_profile = timer.finish()
         return model
 
     def _model_metadata(self) -> Optional[dict]:
@@ -771,7 +888,9 @@ def _make_boost_fn(
     candidate_features, num_numerical, num_valid_features, seed, n, nv,
     sampling="RANDOM", goss_alpha=0.2, goss_beta=0.1, selgb_ratio=0.01,
     dart_dropout=0.0, oblique_P=0, oblique_density=2.0,
-    oblique_weight_type="BINARY", monotone=None, vs_Ac=0, vs_Ap=0,
+    oblique_weight_type="BINARY", oblique_weight_range=None,
+    oblique_mode="SPARSE", mhld_max_attributes=4, num_label_classes=1,
+    monotone=None, vs_Ac=0, vs_Ap=0,
 ):
     """Builds (and caches) the jitted boosting loop for one static config.
 
@@ -856,29 +975,96 @@ def _make_boost_fn(
                 ).astype(jnp.float32)
             return jnp.ones((n,), jnp.float32)
 
-        def make_projections(k_proj):
-            """P sparse random projections as one MXU matmul + quantile
+        def make_mhld_W(k_proj, w_eff):
+            """MHLD projections (reference oblique.cc SolveLDA /
+            FindBestConditionMHLDObliqueTemplate, recast per-tree and
+            batched): weighted scatter matrices SW/SB over the numerical
+            features via MXU matmuls, then per random feature subset
+            (size cycling 2..max_num_attributes — the batched analogue of
+            the reference's greedy attribute growth) the top generalized
+            eigenvector of SW⁻¹·SB through the TPU-supported symmetric
+            form: SW = L·Lᵀ, eigh(L⁻¹·SB·L⁻ᵀ), w = L⁻ᵀ·v."""
+            Fn = x_tr_raw.shape[1]
+            C = max(num_label_classes, 2)
+            oh = jax.nn.one_hot(
+                y_tr.astype(jnp.int32), C, dtype=jnp.float32
+            )
+            cw = oh * w_eff[:, None]
+            n_c = cw.sum(0)  # [C]
+            tot = jnp.maximum(w_eff.sum(), 1e-12)
+            mu_c = (cw.T @ x_tr_raw) / jnp.maximum(n_c, 1e-12)[:, None]
+            mu = (w_eff @ x_tr_raw) / tot
+            Sxx = (x_tr_raw * w_eff[:, None]).T @ x_tr_raw
+            SW = Sxx - (mu_c.T * n_c[None, :]) @ mu_c
+            d = mu_c - mu[None, :]
+            SB = (d.T * n_c[None, :]) @ d
+            smax = min(max(mhld_max_attributes, 2), Fn)
+            sizes = 2 + (jnp.arange(P) % max(smax - 1, 1))
+            k_sub = jax.random.split(k_proj, P)
+
+            def subset_mask(kk, size):
+                scores = jax.random.uniform(kk, (Fn,))
+                kth = jnp.sort(scores)[Fn - size]
+                return scores >= kth
+
+            masks = jax.vmap(subset_mask)(k_sub, sizes)  # [P, Fn]
+            reg = 1e-3 * jnp.trace(SW) / Fn + 1e-6
+
+            def solve_one(m):
+                mf = m.astype(jnp.float32)
+                MM = mf[:, None] * mf[None, :]
+                # Excluded features: identity block in SW (invertible),
+                # zero block in SB → their coefficients come out zero.
+                SWp = SW * MM + jnp.diag(1.0 - mf) + reg * jnp.eye(Fn)
+                SBp = SB * MM
+                L = jnp.linalg.cholesky(SWp)
+                A = jax.scipy.linalg.solve_triangular(L, SBp, lower=True)
+                M2 = jax.scipy.linalg.solve_triangular(
+                    L, A.T, lower=True
+                ).T
+                M2 = 0.5 * (M2 + M2.T)
+                _, evecs = jnp.linalg.eigh(M2)
+                v = evecs[:, -1]
+                wp = jax.scipy.linalg.solve_triangular(
+                    L.T, v, lower=False
+                ) * mf
+                return (
+                    wp / jnp.maximum(jnp.linalg.norm(wp), 1e-12)
+                ).astype(jnp.float32)
+
+            return jax.vmap(solve_one)(masks)
+
+        def make_projections(k_proj, w_eff=None):
+            """P oblique projections as one MXU matmul + quantile
             binning (reference oblique.cc SampleProjection, recast per-tree
-            and batched). Returns (W [P, Fn], boundaries [P, B-1],
+            and batched); MHLD mode swaps the random coefficient sampling
+            for batched LDA. Returns (W [P, Fn], boundaries [P, B-1],
             aug_tr [n, F+P], aug_va [nv, F+P])."""
             Fn = x_tr_raw.shape[1]
-            k_m, k_s = jax.random.split(k_proj)
-            p_incl = min(oblique_density / max(Fn, 1), 1.0)
-            mask = jax.random.bernoulli(k_m, p_incl, (P, Fn))
-            # Every projection touches at least one feature.
-            forced = jax.nn.one_hot(
-                jnp.arange(P) % Fn, Fn, dtype=jnp.bool_
+            if oblique_mode == "MHLD":
+                W = make_mhld_W(k_proj, w_eff)
+                return (W,) + _bin_projections(W)
+            from ydf_tpu.ops.oblique import sample_projection_coefficients
+
+            mono_vec = None
+            if monotone is not None and any(monotone[:num_numerical]):
+                # Sign-forced coefficients on constrained features
+                # (reference oblique.cc:1113-1126).
+                mono_vec = jnp.asarray(
+                    np.array(monotone[:num_numerical], np.float32)
+                )
+            W = sample_projection_coefficients(
+                k_proj, P, Fn,
+                density=oblique_density,
+                weight_type=oblique_weight_type,
+                weight_range=oblique_weight_range,
+                monotone_vec=mono_vec,
             )
-            mask = mask | (~mask.any(axis=1, keepdims=True) & forced)
-            if oblique_weight_type == "BINARY":
-                wts = jnp.where(
-                    jax.random.bernoulli(k_s, 0.5, (P, Fn)), 1.0, -1.0
-                )
-            else:
-                wts = jax.random.uniform(
-                    k_s, (P, Fn), minval=-1.0, maxval=1.0
-                )
-            W = (wts * mask).astype(jnp.float32)
+            return (W,) + _bin_projections(W)
+
+        def _bin_projections(W):
+            """Shared tail: project, quantile-bin, splice the projection
+            columns after the numerical block of the bin matrices."""
             z_tr = x_tr_raw @ W.T  # [n, P] — the MXU hot op
             qs = jnp.linspace(1.0 / B, 1.0 - 1.0 / B, B - 1)
             bnd = jnp.quantile(z_tr, qs, axis=0).T  # [P, B-1]
@@ -903,7 +1089,7 @@ def _make_boost_fn(
                 )
             else:
                 aug_va = bins_va
-            return W, bnd, aug_tr, aug_va
+            return bnd, aug_tr, aug_va
 
         def make_vs_projections(k_vs):
             """Per-tree NUMERICAL_VECTOR_SEQUENCE anchor candidates
@@ -1007,7 +1193,7 @@ def _make_boost_fn(
             if P > 0:
                 key, k_proj = jax.random.split(key)
                 obl_w, obl_b, grow_bins, grow_bins_va = make_projections(
-                    k_proj
+                    k_proj, w_eff
                 )
                 grow_num_numerical = num_numerical + P
                 grow_num_valid = (
@@ -1051,6 +1237,35 @@ def _make_boost_fn(
                 vs_a = jnp.zeros((0, 0), jnp.float32)
                 vs_b = jnp.zeros((0, B - 1), jnp.float32)
 
+            # Monotone direction vector over the per-tree candidate layout
+            # [numerical, oblique, vs]: projection columns inherit +1 when
+            # they touch any constrained feature (their coefficients were
+            # sign-forced in make_projections); vs columns are never
+            # constrained. Without extra blocks, the static tuple path in
+            # the grower is used unchanged.
+            grow_monotone = monotone
+            grow_mono_dirs = None
+            if (
+                monotone is not None
+                and any(monotone)
+                and grow_num_numerical != num_numerical
+            ):
+                mono_vec = jnp.asarray(
+                    np.array(monotone[:num_numerical], np.float32)
+                )
+                parts = [mono_vec]
+                if P > 0:
+                    parts.append(
+                        (jnp.abs(obl_w) @ jnp.abs(mono_vec) > 0).astype(
+                            jnp.float32
+                        )
+                    )
+                pad = grow_num_numerical - sum(p.shape[0] for p in parts)
+                if pad > 0:
+                    parts.append(jnp.zeros((pad,), jnp.float32))
+                grow_mono_dirs = jnp.concatenate(parts)
+                grow_monotone = None
+
             trees_k, leaves_k = [], []
             new_contrib = jnp.zeros((n, K), jnp.float32)
             new_vcontrib = jnp.zeros((nv, K), jnp.float32)
@@ -1070,7 +1285,8 @@ def _make_boost_fn(
                     min_examples=tree_cfg.min_examples,
                     candidate_features=candidate_features,
                     num_valid_features=grow_num_valid,
-                    monotone=monotone,
+                    monotone=grow_monotone,
+                    monotone_dirs=grow_mono_dirs,
                     set_bits=set_tr,
                 )
                 # Leaf values scaled by shrinkage at storage time, like the
@@ -1254,7 +1470,9 @@ def _train_gbt(
     candidate_features, num_numerical, num_valid_features, seed,
     sampling="RANDOM", goss_alpha=0.2, goss_beta=0.1, selgb_ratio=0.01,
     dart_dropout=0.0, oblique_P=0, oblique_density=2.0,
-    oblique_weight_type="BINARY", monotone=None,
+    oblique_weight_type="BINARY", oblique_weight_range=None,
+    oblique_mode="SPARSE", mhld_max_attributes=4, num_label_classes=1,
+    monotone=None,
     x_tr_raw=None, x_va_raw=None, set_tr=None, set_va=None,
     vs_tr=None, vs_va=None, vs_Ac=0, vs_Ap=0,
     cache_dir=None, resume=False, snapshot_interval=50,
@@ -1278,7 +1496,9 @@ def _train_gbt(
         candidate_features, num_numerical, num_valid_features, seed,
         bins_tr.shape[0], bins_va.shape[0],
         sampling, goss_alpha, goss_beta, selgb_ratio, dart_dropout,
-        oblique_P, oblique_density, oblique_weight_type, monotone,
+        oblique_P, oblique_density, oblique_weight_type,
+        oblique_weight_range, oblique_mode, mhld_max_attributes,
+        num_label_classes, monotone,
         vs_Ac if vs_tr is not None else 0,
         vs_Ap if vs_tr is not None else 0,
     )
@@ -1508,12 +1728,23 @@ def _clamp_monotone_leaves(forest, binner, constraints):
     from ydf_tpu.models.forest import Forest
 
     f = forest.to_numpy()
-    dirs = np.zeros((binner.num_features,), np.int8)
+    nfeat = binner.num_features
+    dirs = np.zeros((nfeat,), np.int8)
     for name, d in constraints.items():
         dirs[binner.feature_names.index(name)] = np.sign(d)
+    ow = f.get("oblique_weights")
+    P = 0 if ow is None else ow.shape[1]
     lv = f["leaf_value"].copy()  # [T, N, 1]
     T = lv.shape[0]
     for t in range(T):
+        if P > 0:
+            # A projection touching any constrained feature is monotone
+            # INCREASING by construction (coefficients were sign-forced at
+            # sampling time, cf. reference oblique.cc:1113-1126).
+            touch = np.abs(ow[t][:, : len(dirs)]) @ np.abs(
+                dirs[: ow.shape[2]].astype(np.float32)
+            )
+            proj_dirs = (touch > 0).astype(np.int8)
         stack = [(0, -np.inf, np.inf)]
         while stack:
             nid, lo, hi = stack.pop()
@@ -1522,7 +1753,12 @@ def _clamp_monotone_leaves(forest, binner, constraints):
                 continue
             left, right = int(f["left"][t, nid]), int(f["right"][t, nid])
             feat = int(f["feature"][t, nid])
-            d = dirs[feat] if 0 <= feat < len(dirs) else 0
+            if 0 <= feat < nfeat:
+                d = dirs[feat]
+            elif P > 0 and nfeat <= feat < nfeat + P:
+                d = proj_dirs[feat - nfeat]
+            else:
+                d = 0
             if d == 0:
                 stack.append((left, lo, hi))
                 stack.append((right, lo, hi))

@@ -101,12 +101,13 @@ class GenericLearner(HyperparameterValidationMixin):
             discretized_max_bins=self.num_discretized_numerical_bins,
         )
 
-    def _prepare_from_cache(self, cache) -> Dict:
+    def _prepare_from_cache(self, cache, valid=None) -> Dict:
         """Ingestion from an on-disk binned DatasetCache (out-of-core
         path, dataset/cache.py): the bins stay memmapped until the single
-        device transfer; no raw-value re-encode happens, so raw-value
-        paths (oblique, ranking groups, survival ages, VS) are
-        unavailable."""
+        device transfer. Task plumbing columns (ranking groups, uplift
+        treatment, survival event/entry) and the raw numerical matrix
+        (SPARSE_OBLIQUE) are available when the cache stored them
+        (create_dataset_cache kwargs)."""
         from ydf_tpu.config import Task as _Task
 
         if self.label != cache.label:
@@ -114,28 +115,56 @@ class GenericLearner(HyperparameterValidationMixin):
                 f"Cache was built for label {cache.label!r}, learner wants "
                 f"{self.label!r}"
             )
-        if self.task not in (_Task.CLASSIFICATION, _Task.REGRESSION):
-            raise NotImplementedError(
-                f"DatasetCache training for task {self.task} (the cache "
-                "stores bins + label only)"
+        if self.weights is not None and cache.weights != self.weights:
+            # Without this check, training would silently run unweighted
+            # (or with the cache's different weight column) while an
+            # explicit valid= dataset applies the learner's weights —
+            # inconsistently weighted early stopping.
+            raise ValueError(
+                f"Learner weights column {self.weights!r} does not match "
+                f"the cache's stored weights ({cache.weights!r}); recreate "
+                f"the cache with weights={self.weights!r}"
             )
+        # Column requirements per task — a helpful error instead of a
+        # KeyError deep in the loss.
+        def _need(col_attr: str) -> None:
+            col = getattr(self, col_attr, None)
+            if col and col not in cache.extra_columns:
+                raise ValueError(
+                    f"task {self.task} needs column {col!r} stored in the "
+                    f"cache; recreate it with create_dataset_cache(..., "
+                    f"{col_attr}={col!r})"
+                )
+
+        if self.task == _Task.RANKING:
+            _need("ranking_group")
+        elif self.task == _Task.SURVIVAL_ANALYSIS:
+            _need("label_event_observed")
+            _need("label_entry_age")
+        elif self.task in (_Task.CATEGORICAL_UPLIFT, _Task.NUMERICAL_UPLIFT):
+            _need("uplift_treatment")
+        raw = None
         if getattr(self, "split_axis", "AXIS_ALIGNED") != "AXIS_ALIGNED":
-            raise NotImplementedError(
-                "SPARSE_OBLIQUE needs raw feature values, which the "
-                "cache does not store"
-            )
+            raw = cache.raw_numerical
+            if raw is None and cache.binner.num_numerical > 0:
+                raise ValueError(
+                    "SPARSE_OBLIQUE needs raw feature values; recreate the "
+                    "cache with store_raw_numerical=True"
+                )
         classes = cache.label_classes()
         labels = np.asarray(cache.labels)
         w = cache.sample_weights
+        data = {cache.label: labels}
+        for col in cache.extra_columns:
+            data[col] = cache.extra_column(col)
         out = {
-            "dataset": Dataset(
-                {cache.label: labels}, cache.dataspec
-            ),
+            "dataset": Dataset(data, cache.dataspec),
             "binned": None,
             "binner": cache.binner,
             "bins": cache.bins,  # uint8 memmap [n, F]
             "set_bits": None,
             "vs": None,
+            "raw_numerical": raw,
             "labels": labels,
             "sample_weights": (
                 np.asarray(w, np.float32)
@@ -143,12 +172,28 @@ class GenericLearner(HyperparameterValidationMixin):
                 else np.ones((cache.num_rows,), np.float32)
             ),
         }
-        if self.task == _Task.CLASSIFICATION:
+        if self.task in (_Task.CLASSIFICATION, _Task.CATEGORICAL_UPLIFT):
             if classes is None:
                 raise ValueError(
                     "Cache label is numerical; train with a regression task"
                 )
             out["classes"] = classes
+        if valid is not None:
+            vds = Dataset.from_data(
+                valid, label=self.label, dataspec=cache.dataspec
+            )
+            out["valid_dataset"] = vds
+            out["valid_bins"] = cache.binner.transform(vds)
+            out["valid_set_bits"] = None
+            out["valid_vs"] = None
+            if self.label is not None:
+                out["valid_labels"] = vds.encoded_label(
+                    self.label, self.task
+                )
+            if self.weights is not None:
+                out["valid_weights"] = vds.data[self.weights].astype(
+                    np.float32
+                )
         return out
 
     def _prepare(
@@ -158,11 +203,7 @@ class GenericLearner(HyperparameterValidationMixin):
         from ydf_tpu.dataset.cache import DatasetCache
 
         if isinstance(data, DatasetCache):
-            if valid is not None:
-                raise NotImplementedError(
-                    "explicit valid= with a DatasetCache"
-                )
-            return self._prepare_from_cache(data)
+            return self._prepare_from_cache(data, valid=valid)
         ds = self._infer_dataset(data)
         feature_names = self.features
         if feature_names is None:

@@ -47,6 +47,11 @@ class RandomForestLearner(GenericLearner):
         bootstrap_size_ratio: float = 1.0,
         num_candidate_attributes: int = 0,
         num_candidate_attributes_ratio: float = -1.0,
+        split_axis: str = "AXIS_ALIGNED",
+        sparse_oblique_num_projections_exponent: float = 1.0,
+        sparse_oblique_projection_density_factor: float = 2.0,
+        sparse_oblique_weights: str = "BINARY",
+        sparse_oblique_max_num_projections: int = 64,
         winner_take_all: bool = True,
         compute_oob_performances: bool = True,
         compute_oob_variable_importances: bool = False,
@@ -71,6 +76,29 @@ class RandomForestLearner(GenericLearner):
         self.bootstrap_size_ratio = bootstrap_size_ratio
         self.num_candidate_attributes = num_candidate_attributes
         self.num_candidate_attributes_ratio = num_candidate_attributes_ratio
+        # Sparse-oblique splits (reference oblique.cc; RF is the paper's
+        # original home — Tomita et al. JMLR'20): same per-tree batched
+        # recast as the GBT learner — P projections per tree as one MXU
+        # matmul, quantile-binned, competing as extra candidate columns.
+        if split_axis not in ("AXIS_ALIGNED", "SPARSE_OBLIQUE"):
+            raise ValueError(f"Unknown split_axis {split_axis!r}")
+        from ydf_tpu.ops.oblique import WEIGHT_TYPES
+
+        if sparse_oblique_weights not in WEIGHT_TYPES:
+            raise ValueError(
+                f"Unknown sparse_oblique_weights {sparse_oblique_weights!r}"
+            )
+        self.split_axis = split_axis
+        self.sparse_oblique_num_projections_exponent = (
+            sparse_oblique_num_projections_exponent
+        )
+        self.sparse_oblique_projection_density_factor = (
+            sparse_oblique_projection_density_factor
+        )
+        self.sparse_oblique_weights = sparse_oblique_weights
+        self.sparse_oblique_max_num_projections = (
+            sparse_oblique_max_num_projections
+        )
         self.winner_take_all = winner_take_all
         # OOB evaluation / permutation importances (reference
         # random_forest.proto compute_oob_performances — default true — and
@@ -109,7 +137,11 @@ class RandomForestLearner(GenericLearner):
         return -1
 
     def train(self, data: InputData, valid: Optional[InputData] = None):
-        prep = self._prepare(data)
+        from ydf_tpu.utils.profiling import StageTimer, maybe_trace
+
+        timer = StageTimer()
+        with timer.stage("ingest_bin"):
+            prep = self._prepare(data)
         binner = prep["binner"]
         bins = jnp.asarray(prep["bins"])
         set_bits = prep.get("set_bits")
@@ -118,11 +150,42 @@ class RandomForestLearner(GenericLearner):
         w_base = jnp.asarray(prep["sample_weights"])
         n, F = bins.shape
 
+        Fn = binner.num_numerical
+        obl_P = 0
+        x_raw = None
+        if self.split_axis == "SPARSE_OBLIQUE" and Fn > 0:
+            obl_P = int(
+                np.ceil(Fn ** self.sparse_oblique_num_projections_exponent)
+            )
+            obl_P = min(
+                max(obl_P, 2), self.sparse_oblique_max_num_projections
+            )
+            if prep.get("raw_numerical") is not None:
+                x_raw = np.asarray(prep["raw_numerical"], np.float32)
+            else:
+                ds_r = prep["dataset"]
+                x_raw = np.zeros((n, Fn), np.float32)
+                for i, name in enumerate(binner.feature_names[:Fn]):
+                    if ds_r.dataspec.has_column(name) and name in ds_r.data:
+                        x_raw[:, i] = ds_r.encoded_numerical(name)
+                    else:
+                        x_raw[:, i] = binner.impute_values[i]
+
+        tcodes = None
+        if self.task in (Task.CATEGORICAL_UPLIFT, Task.NUMERICAL_UPLIFT):
+            if not self.uplift_treatment:
+                raise ValueError("Uplift tasks require uplift_treatment=")
+            ds = prep["dataset"]
+            tcol = ds.dataspec.column_by_name(self.uplift_treatment)
+            if tcol.vocab_size > 3:
+                raise NotImplementedError(
+                    "Only binary treatments are supported"
+                )
+            tcodes = ds.encoded_categorical(self.uplift_treatment)
+
         if self.mesh is not None:
             from ydf_tpu.parallel import mesh as pmesh
 
-            if self.task in (Task.CATEGORICAL_UPLIFT, Task.NUMERICAL_UPLIFT):
-                raise NotImplementedError("mesh-distributed uplift training")
             dp = self.mesh.shape[pmesh.DATA_AXIS]
             fp = self.mesh.shape.get(pmesh.FEATURE_AXIS, 1)
             # Same pattern as the GBT mesh path (gbt.py): pad rows (zero
@@ -134,6 +197,10 @@ class RandomForestLearner(GenericLearner):
             ]
             if set_bits is not None:
                 arrays.append(np.asarray(set_bits))
+            if tcodes is not None:
+                # Pad rows get treatment code 0 (= missing/OOV) → excluded
+                # from every per-arm statistic via t_known below.
+                arrays.append(np.asarray(tcodes))
             arrays, _ = pmesh.pad_rows_to_multiple(arrays, dp)
             bins_np, w_np, labels_np = arrays[:3]
             if fp > 1:
@@ -152,6 +219,18 @@ class RandomForestLearner(GenericLearner):
             prep["labels"] = pmesh.shard_batch(self.mesh, labels_np)
             if set_bits is not None:
                 set_bits = pmesh.shard_batch(self.mesh, arrays[3])
+            if tcodes is not None:
+                tcodes = pmesh.shard_batch(
+                    self.mesh, arrays[3 + (set_bits is not None)]
+                )
+            if x_raw is not None:
+                # Pad rows (zero weight) contribute only to the unweighted
+                # per-tree projection quantiles — a <dp/n perturbation of
+                # candidate bin boundaries (same note as the GBT path).
+                x_raw = np.pad(
+                    x_raw, ((0, bins.shape[0] - x_raw.shape[0]), (0, 0))
+                )
+                x_raw = pmesh.shard_batch(self.mesh, x_raw)
             # OOB bookkeeping indexes labels and weights together — keep
             # the padded row count consistent (pad rows carry zero weight,
             # so they never enter the OOB accumulators).
@@ -161,18 +240,11 @@ class RandomForestLearner(GenericLearner):
         if self.task in (Task.CATEGORICAL_UPLIFT, Task.NUMERICAL_UPLIFT):
             # Treatment-effect trees (reference uplift.h; RF uplift as in
             # sim_pte_categorical_uplift_rf): binary treatment, binary or
-            # numerical outcome, Euclidean-divergence splits.
-            if not self.uplift_treatment:
-                raise ValueError("Uplift tasks require uplift_treatment=")
+            # numerical outcome, Euclidean-divergence splits. tcodes was
+            # encoded (and under a mesh, padded + sharded) above.
             rule = UpliftEuclideanRule()
-            ds = prep["dataset"]
-            tcodes = ds.encoded_categorical(self.uplift_treatment)
-            tcol = ds.dataspec.column_by_name(self.uplift_treatment)
-            if tcol.vocab_size > 3:
-                raise NotImplementedError(
-                    "Only binary treatments are supported"
-                )
-            t01 = jnp.asarray((tcodes == 2).astype(np.float32))
+            tcodes = jnp.asarray(tcodes)
+            t01 = (tcodes == 2).astype(jnp.float32)
             # OOV/missing treatment (code <= 0) is excluded entirely —
             # the reference ignores the treatment OOV item
             # (decision_tree.proto:66-69).
@@ -232,7 +304,8 @@ class RandomForestLearner(GenericLearner):
             and self.bootstrap_training_dataset
             and self.task in (Task.CLASSIFICATION, Task.REGRESSION)
         )
-        stacked, leaf_values, oob = _train_rf(
+        with timer.stage("device_loop"), maybe_trace("rf_train"):
+            stacked, leaf_values, oob = _train_rf(
             bins, w_base,
             set_bits=set_bits,
             stats_fn=stats_fn, rule=rule, tree_cfg=tree_cfg,
@@ -240,6 +313,11 @@ class RandomForestLearner(GenericLearner):
             bootstrap=self.bootstrap_training_dataset,
             candidate_features=cand,
             num_numerical=binner.num_numerical,
+            x_raw=None if x_raw is None else jnp.asarray(x_raw),
+            obl_P=obl_P,
+            obl_density=self.sparse_oblique_projection_density_factor,
+            obl_weight_type=self.sparse_oblique_weights,
+            obl_weight_range=None,
             num_valid_features=(
                 binner.num_scalar
                 if bins.shape[1] > binner.num_scalar
@@ -258,9 +336,32 @@ class RandomForestLearner(GenericLearner):
             ),
         )
 
-        forest = forest_from_stacked_trees(
-            stacked, leaf_values, binner.boundaries
-        )
+        if obl_P > 0:
+            # Remap grow-time feature ids [Fn, Fn+P) (projection block)
+            # onto the Forest convention (projections after ALL real
+            # features; categoricals shift back by P) and attach per-tree
+            # projection vectors + bin cutpoints — same as the GBT path.
+            stacked_tuple, obl_w, obl_b = stacked
+            Freal = binner.num_features
+            feat = np.asarray(stacked_tuple.feature)
+            in_block = (feat >= Fn) & (feat < Fn + obl_P)
+            remapped = np.where(
+                in_block,
+                Freal + (feat - Fn),
+                np.where(feat >= Fn + obl_P, feat - obl_P, feat),
+            )
+            stacked_tuple = stacked_tuple._replace(
+                feature=remapped.astype(np.int32)
+            )
+            forest = forest_from_stacked_trees(
+                stacked_tuple, leaf_values, binner.boundaries,
+                oblique_weights=np.asarray(obl_w),
+                oblique_boundaries=np.asarray(obl_b),
+            )
+        else:
+            forest = forest_from_stacked_trees(
+                stacked, leaf_values, binner.boundaries
+            )
         model = RandomForestModel(
             task=self.task,
             label=self.label,
@@ -277,7 +378,9 @@ class RandomForestLearner(GenericLearner):
             ),
         )
         if oob is not None:
-            self._attach_oob(model, oob, prep, binner)
+            with timer.stage("oob_finalize"):
+                self._attach_oob(model, oob, prep, binner)
+        model.training_profile = timer.finish()
         return model
 
     def _attach_oob(self, model, oob, prep, binner):
@@ -354,8 +457,19 @@ def _train_rf(
     num_trees, bootstrap, candidate_features, num_numerical, seed,
     honest_ratio=0.0, winner_take_all=False, compute_oob=False,
     oob_importances=False, set_bits=None, num_valid_features=None,
+    x_raw=None, obl_P=0, obl_density=2.0, obl_weight_type="BINARY",
+    obl_weight_range=None,
 ):
     n, F = bins.shape
+    P = obl_P
+    Fn = num_numerical
+    B = tree_cfg.num_bins
+    if P > 0 and oob_importances:
+        raise NotImplementedError(
+            "compute_oob_variable_importances with SPARSE_OBLIQUE "
+            "(shuffled-attribute routing through projections is not "
+            "implemented; OOB evaluation itself works)"
+        )
     # Real (unpadded) scalar columns — under feature-parallel padding the
     # bins matrix carries trailing constant-zero columns that are neither
     # split candidates nor permutation-importance targets.
@@ -376,7 +490,7 @@ def _train_rf(
     def run(bins, w_base):
         def one_tree(carry, t):
             key = jax.random.fold_in(jax.random.PRNGKey(seed), t)
-            k_boot, k_grow, k_honest = jax.random.split(key, 3)
+            k_boot, k_grow, k_honest, k_obl = jax.random.split(key, 4)
             if bootstrap:
                 draws = jax.random.poisson(k_boot, 1.0, (n,)).astype(
                     jnp.float32
@@ -391,17 +505,53 @@ def _train_rf(
                 w_leaf = w * est
             else:
                 w_grow = w
+            if P > 0:
+                # Per-tree sparse projections (shared sampler,
+                # ops/oblique.py): one MXU matmul + quantile binning; the
+                # projection columns splice in after the numericals and
+                # compete as ordinary candidates.
+                from ydf_tpu.ops.oblique import (
+                    sample_projection_coefficients,
+                )
+
+                W = sample_projection_coefficients(
+                    k_obl, P, Fn,
+                    density=obl_density,
+                    weight_type=obl_weight_type,
+                    weight_range=obl_weight_range,
+                )
+                z = x_raw @ W.T  # [n, P]
+                qs = jnp.linspace(1.0 / B, 1.0 - 1.0 / B, B - 1)
+                bnd = jnp.quantile(z, qs, axis=0).T  # [P, B-1]
+                zb = jax.vmap(
+                    lambda b, zz: jnp.searchsorted(b, zz, side="right")
+                )(bnd, z.T).astype(jnp.uint8).T
+                grow_bins = jnp.concatenate(
+                    [bins[:, :Fn], zb, bins[:, Fn:]], axis=1
+                )
+                grow_Fn = Fn + P
+                grow_valid = (
+                    None
+                    if num_valid_features is None
+                    else num_valid_features + P
+                )
+            else:
+                W = jnp.zeros((0, 0), jnp.float32)
+                bnd = jnp.zeros((0, B - 1), jnp.float32)
+                grow_bins = bins
+                grow_Fn = num_numerical
+                grow_valid = num_valid_features
             res = grower.grow_tree(
-                bins, stats_fn(w_grow), k_grow,
+                grow_bins, stats_fn(w_grow), k_grow,
                 rule=rule,
                 max_depth=tree_cfg.max_depth,
                 frontier=tree_cfg.frontier,
                 max_nodes=max_nodes,
                 num_bins=tree_cfg.num_bins,
-                num_numerical=num_numerical,
+                num_numerical=grow_Fn,
                 min_examples=tree_cfg.min_examples,
                 candidate_features=candidate_features,
-                num_valid_features=num_valid_features,
+                num_valid_features=grow_valid,
                 set_bits=set_bits,
             )
             if honest_ratio > 0.0:
@@ -469,7 +619,7 @@ def _train_rf(
                     )  # [Fr+Fs, n, V]
                     oob_shuf = oob_shuf + votes * oob_f[None, :, None]
                 carry = (oob_sum, oob_cnt, oob_shuf)
-            return carry, (tree, lv)
+            return carry, (tree, lv, W, bnd)
 
         if compute_oob:
             carry0 = (
@@ -481,15 +631,17 @@ def _train_rf(
             )
         else:
             carry0 = 0
-        carry, (trees, lvs) = jax.lax.scan(
+        carry, (trees, lvs, Ws, bnds) = jax.lax.scan(
             one_tree, carry0, jnp.arange(num_trees)
         )
-        return trees, lvs, carry
+        return trees, lvs, (Ws, bnds), carry
 
-    trees, lvs, carry = run(bins, w_base)
+    trees, lvs, obl, carry = run(bins, w_base)
     oob_out = None
     if compute_oob:
         oob_out = {"sum": carry[0], "count": carry[1]}
         if oob_importances:
             oob_out["sum_shuffled"] = carry[2]
+    if P > 0:
+        return (trees, obl[0], obl[1]), lvs, oob_out
     return trees, lvs, oob_out

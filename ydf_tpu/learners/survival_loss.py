@@ -12,17 +12,22 @@ per-example gradients (loss_imp_cox.cc:148-220). That sweep is a pure
 prefix-sum recurrence, so the TPU formulation is exact and fully batched:
 
   sort the 2n updates ONCE at registration (host);
-  hazard before update u   = exclusive cumsum of ±exp(pred) gathers;
-  S1/S2 at update u        = inclusive cumsum of event-gated 1/hazard terms;
+  hazard before update u   = exclusive cumsum of ±w·exp(pred) gathers;
+  S1/S2 at update u        = inclusive cumsum of event-gated w/hazard terms;
   per-example ΔS1, ΔS2     = S1[removal_u(i)] − S1[arrival_u(i)].
 
-  grad_i = exp(pred_i)·ΔS1_i − event_i          (d loss / d pred)
-  hess_i = exp(pred_i)·ΔS1_i − exp(pred_i)²·ΔS2_i
+  grad_i = exp(pred_i)·ΔS1_i − event_i          (d loss / d pred, ÷ w_i)
+  hess_i = exp(pred_i)·ΔS1_i − w_i·exp(pred_i)²·ΔS2_i
 
 The reference clamps a (numerically) negative running hazard to zero
 mid-sweep; here the same guard is a pointwise maximum on the prefix sums.
-Example weights are uniform, as in the reference (its in-code TODO).
-"""
+
+Example weights: the reference leaves them unimplemented (its in-code
+TODO, uniform weights). Here the weighted partial likelihood
+L = Σ_events w_i·[log Σ_{j at risk} w_j·exp(pred_j) − pred_i] is exact:
+risk sets aggregate w·exp(pred), event terms carry their own weight, and
+the returned per-example grad/hess are PRE-division by w (the grower
+multiplies its stats by the example weight, restoring dL/dpred)."""
 
 from __future__ import annotations
 
@@ -51,7 +56,13 @@ class CoxProportionalHazardLoss:
         departure: np.ndarray,
         event: np.ndarray,
         entry: Optional[np.ndarray] = None,
+        num_real: Optional[int] = None,
+        weights: Optional[np.ndarray] = None,
     ) -> None:
+        """num_real: count of real (non-padding) examples — mesh-padded
+        rows are inert in the sweep but must not inflate the loss mean.
+        weights: per-example weights (default uniform); padded rows, if
+        any, must carry weight zero."""
         n = len(departure)
         departure = np.asarray(departure, np.float64)
         event = np.asarray(event).astype(bool)
@@ -59,6 +70,11 @@ class CoxProportionalHazardLoss:
             np.zeros((n,), np.float64)
             if entry is None
             else np.asarray(entry, np.float64)
+        )
+        w = (
+            np.ones((n,), np.float64)
+            if weights is None
+            else np.asarray(weights, np.float64)
         )
         if np.any(entry > departure):
             raise ValueError("entry age exceeds departure age")
@@ -75,6 +91,7 @@ class CoxProportionalHazardLoss:
         # Inverse maps: position of each example's arrival / removal update.
         pos = np.empty((2 * n,), np.int64)
         pos[order] = np.arange(2 * n)
+        nr = int(num_real) if num_real is not None else n
         self._structs[tag] = {
             "n": n,
             "upd_idx": jnp.asarray(upd_idx.astype(np.int32)),
@@ -83,6 +100,12 @@ class CoxProportionalHazardLoss:
             "arrival_pos": jnp.asarray(pos[:n].astype(np.int32)),
             "removal_pos": jnp.asarray(pos[n:].astype(np.int32)),
             "event": jnp.asarray(event.astype(np.float32)),
+            "weights": jnp.asarray(w.astype(np.float32)),
+            "uniform": weights is None,
+            "num_real": nr,
+            # Loss normalizer: n for uniform weights (reference's 1/n),
+            # Σw over real rows otherwise.
+            "norm": float(nr if weights is None else w[:nr].sum()),
         }
 
     def _struct_for(self, tag: str, n: int) -> dict:
@@ -102,14 +125,20 @@ class CoxProportionalHazardLoss:
         """Returns (exp_p [n], hazard-before-update [2n], S1 [2n], S2 [2n])
         — the reference sweep's running quantities, as prefix sums."""
         exp_p = jnp.exp(preds[:, 0])
+        w_exp = s["weights"] * exp_p
         delta = jnp.where(
-            s["is_arrival"], exp_p[s["upd_idx"]], -exp_p[s["upd_idx"]]
+            s["is_arrival"], w_exp[s["upd_idx"]], -w_exp[s["upd_idx"]]
         )
         csum = jnp.cumsum(delta)
         hazard = jnp.maximum(csum - delta, 0.0)  # exclusive prefix, clamped
-        inv = jnp.where(s["is_event"] & (hazard > 0), 1.0 / (hazard + _EPS), 0.0)
+        w_upd = s["weights"][s["upd_idx"]]
+        inv = jnp.where(
+            s["is_event"] & (hazard > 0), w_upd / (hazard + _EPS), 0.0
+        )
         inv2 = jnp.where(
-            s["is_event"] & (hazard > 0), 1.0 / jnp.square(hazard + _EPS), 0.0
+            s["is_event"] & (hazard > 0),
+            w_upd / jnp.square(hazard + _EPS),
+            0.0,
         )
         return exp_p, hazard, jnp.cumsum(inv), jnp.cumsum(inv2)
 
@@ -127,24 +156,29 @@ class CoxProportionalHazardLoss:
         # operations (loss_imp_cox.cc:183-186).
         dS1 = S1[s["removal_pos"]] - S1[s["arrival_pos"]]
         dS2 = S2[s["removal_pos"]] - S2[s["arrival_pos"]]
+        # Per-example derivative of the weighted loss DIVIDED by the
+        # example weight — the grower's stats multiply by w, restoring
+        # the true dL/dpred. (Uniform case: identical to the reference.)
         g = exp_p * dS1 - s["event"]
-        h = exp_p * dS1 - jnp.square(exp_p) * dS2
+        h = exp_p * dS1 - s["weights"] * jnp.square(exp_p) * dS2
         return g[:, None], jnp.maximum(h, _EPS)[:, None]
 
     def loss(self, labels, preds, weights, tag: str = "train"):
-        """Mean negative log partial likelihood:
-        (1/n) Σ_events [log hazard(t_i) − pred_i]  (loss_imp_cox.cc:120)."""
+        """Weighted mean negative log partial likelihood:
+        (1/Σw) Σ_events w_i·[log hazard(t_i) − pred_i]
+        (loss_imp_cox.cc:120; uniform weights reduce to its 1/n mean)."""
         s = self._struct_for(tag, preds.shape[0])
         _, hazard, _, _ = self._sweep(s, preds)
         # Hazard before an EVENT update still includes the example itself
         # (its removal happens after the loss term) — the exclusive prefix
         # is over *updates*, and the example arrived earlier.
+        w_upd = s["weights"][s["upd_idx"]]
         terms = jnp.where(
             s["is_event"] & (hazard > 0),
-            jnp.log(hazard + _EPS) - preds[s["upd_idx"], 0],
+            w_upd * (jnp.log(hazard + _EPS) - preds[s["upd_idx"], 0]),
             0.0,
         )
-        return jnp.sum(terms) / preds.shape[0]
+        return jnp.sum(terms) / s["norm"]
 
     def predict_proba(self, preds):
         return preds  # log relative hazard

@@ -121,6 +121,21 @@ def _per_tree_block_thresholds(feature, tbin, block_bnd, lo):
     )[:, :, 0]
 
 
+def bake_winner_take_all(leaf_values: np.ndarray) -> np.ndarray:
+    """Hard per-leaf votes: one-hot of each leaf's argmax class
+    (reference AddClassificationLeafToAccumulator with
+    winner_take_all_inference). Shared by RF predict and the
+    embed/portable exports, which promise bit-exactness against it."""
+    lv = np.asarray(leaf_values)
+    votes = np.zeros_like(lv)
+    arg = lv.argmax(axis=-1)
+    t_idx, n_idx = np.meshgrid(
+        np.arange(lv.shape[0]), np.arange(lv.shape[1]), indexing="ij"
+    )
+    votes[t_idx, n_idx, arg] = 1.0
+    return votes
+
+
 def forest_from_stacked_trees(
     stacked_trees, leaf_value: jax.Array, boundaries: np.ndarray,
     oblique_weights=None, oblique_boundaries=None, oblique_na_repl=None,

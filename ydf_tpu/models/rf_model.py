@@ -39,13 +39,9 @@ class RandomForestModel(GenericModel):
 
     def predict(self, data) -> np.ndarray:
         if self.task == Task.CLASSIFICATION and self.winner_take_all:
-            lv = np.asarray(self.forest.leaf_value)
-            votes = np.zeros_like(lv)
-            arg = lv.argmax(axis=-1)
-            t_idx, n_idx = np.meshgrid(
-                np.arange(lv.shape[0]), np.arange(lv.shape[1]), indexing="ij"
-            )
-            votes[t_idx, n_idx, arg] = 1.0
+            from ydf_tpu.models.forest import bake_winner_take_all
+
+            votes = bake_winner_take_all(self.forest.leaf_value)
             orig = self.forest
             self.forest = orig._replace(leaf_value=votes)
             try:

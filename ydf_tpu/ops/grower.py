@@ -113,6 +113,11 @@ def grow_tree(
     # (reference: monotonic constraints, training.h:160-168; bound
     # clamping happens post-training on the finished trees).
     monotone: Optional[tuple] = None,
+    # Traced alternative to `monotone` for candidate layouts whose
+    # monotone status is data-dependent (per-tree oblique projections):
+    # f32 [K] with K <= number of candidate columns; trailing columns are
+    # unconstrained. Mutually exclusive with `monotone`.
+    monotone_dirs: Optional[jax.Array] = None,
     # CATEGORICAL_SET features: packed multi-hot uint32 [n, Fs, Ws]
     # (bit v of word block = example's set contains item v). Candidate
     # splits are prefixes of the per-node sorted item order (the same
@@ -340,10 +345,16 @@ def grow_tree(
                 axis=1,
             ) if (Fs or O > 1) else base
             valid &= (scores >= kth[:, None])[:, :, None]
-        if monotone is not None and any(monotone):
+        dirs = None
+        if monotone_dirs is not None:
+            dirs = jnp.zeros((Fa,), f32).at[
+                : monotone_dirs.shape[0]
+            ].set(monotone_dirs.astype(f32))
+        elif monotone is not None and any(monotone):
             dirs_np = np.zeros((Fa,), np.float32)
             dirs_np[: len(monotone)] = np.array(monotone, np.float32)
             dirs = jnp.asarray(dirs_np)  # [Fa]; set features always 0
+        if dirs is not None:
             leaf_l = rule.leaf_value(left_all, rule_ctx)[..., 0]
             leaf_r = rule.leaf_value(right_all, rule_ctx)[..., 0]
             mono_ok = (dirs[None, :, None] == 0) | (

@@ -1,0 +1,70 @@
+"""Sparse-oblique projection sampling, shared by GBT / RF / IF.
+
+One implementation of the reference's SampleProjection
+(`ydf/learner/decision_tree/oblique.cc:944-1140`): a sparse inclusion
+mask (expected `density` nonzero coefficients per projection, at least
+one), coefficients drawn per `weight_type` (BINARY ±1 / CONTINUOUS
+U[-1,1] / POWER_OF_TWO ±2^e / INTEGER uniform ints —
+decision_tree.proto SparseObliqueSplit weights), and optional monotonic
+sign-forcing (oblique.cc:1113-1126: a coefficient on a constrained
+feature takes the constraint's sign, making the projection
+monotone-increasing w.r.t. every constrained input).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+WEIGHT_TYPES = ("BINARY", "CONTINUOUS", "POWER_OF_TWO", "INTEGER")
+
+
+def sample_projection_coefficients(
+    key: jax.Array,
+    P: int,
+    Fn: int,
+    density: float = 2.0,
+    weight_type: str = "BINARY",
+    weight_range: Optional[Tuple[int, int]] = None,
+    monotone_vec: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Returns W f32 [P, Fn]. weight_range: (min_exponent, max_exponent)
+    for POWER_OF_TWO, (minimum, maximum) for INTEGER; reference proto
+    defaults apply when None. monotone_vec: f32 [Fn] of ±1/0 constraint
+    directions (sign-forced coefficients)."""
+    k_m, k_s = jax.random.split(key)
+    p_incl = min(density / max(Fn, 1), 1.0)
+    mask = jax.random.bernoulli(k_m, p_incl, (P, Fn))
+    # Every projection touches at least one feature.
+    forced = jax.nn.one_hot(jnp.arange(P) % Fn, Fn, dtype=jnp.bool_)
+    mask = mask | (~mask.any(axis=1, keepdims=True) & forced)
+    if weight_type == "BINARY":
+        wts = jnp.where(jax.random.bernoulli(k_s, 0.5, (P, Fn)), 1.0, -1.0)
+    elif weight_type == "POWER_OF_TWO":
+        lo, hi = weight_range or (-3, 3)
+        k_e, k_sign = jax.random.split(k_s)
+        e = jax.random.randint(k_e, (P, Fn), lo, hi + 1)
+        sign = jnp.where(
+            jax.random.bernoulli(k_sign, 0.5, (P, Fn)), 1.0, -1.0
+        )
+        wts = sign * jnp.exp2(e.astype(jnp.float32))
+    elif weight_type == "INTEGER":
+        # 0 drops the feature from the projection (reference
+        # IntegerWeights).
+        lo, hi = weight_range or (-5, 5)
+        wts = jax.random.randint(k_s, (P, Fn), lo, hi + 1).astype(
+            jnp.float32
+        )
+    elif weight_type == "CONTINUOUS":
+        wts = jax.random.uniform(k_s, (P, Fn), minval=-1.0, maxval=1.0)
+    else:
+        raise ValueError(f"Unknown oblique weight type {weight_type!r}")
+    if monotone_vec is not None:
+        wts = jnp.where(
+            monotone_vec[None, :] != 0,
+            jnp.abs(wts) * monotone_vec[None, :],
+            wts,
+        )
+    return (wts * mask).astype(jnp.float32)

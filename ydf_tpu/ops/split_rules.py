@@ -166,7 +166,12 @@ class RandomSplitRule:
         # Per-feature normalization → uniform feature choice.
         norm = jax.scipy.special.logsumexp(w, axis=-1, keepdims=True)
         gumbel = jax.random.gumbel(key, shape)
-        return jnp.where(valid, w - norm + gumbel, -jnp.inf)
+        # isfinite guard: a feature disabled wholesale (log_gap = -inf on
+        # every cut, e.g. axis numericals under sparse-oblique IF) would
+        # otherwise produce NaN from (-inf) - (-inf).
+        return jnp.where(
+            valid & jnp.isfinite(w), w - norm + gumbel, -jnp.inf
+        )
 
     def leaf_value(self, stats, ctx):
         return stats[..., 0:1]
