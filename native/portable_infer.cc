@@ -1,0 +1,321 @@
+// Portable standalone inference: C-ABI library over the YDFTPU1 blob.
+//
+// The single-engine replacement for the reference's per-language
+// inference ports (port/go/, port/javascript/, port/tensorflow/ — all
+// front-ends over the same C++ engines): ydf_tpu/serving/portable.py
+// serializes a trained forest to one flat blob; this library loads it
+// and predicts. Dependency-free (libc/libm only), so any FFI-capable
+// language binds it in a dozen lines:
+//   Go:    cgo        — #include "portable_infer.h"; C.ydf_model_load(...)
+//   Node:  ffi-napi / a 30-line N-API addon
+//   Python: ctypes    — ydf_tpu/serving/portable_runtime.py (reference)
+//
+// API:
+//   void*  ydf_model_load(const char* path);         // NULL on failure
+//   const char* ydf_model_error(void* h);            // load error text
+//   void   ydf_model_free(void* h);
+//   int    ydf_model_num_numerical(void* h);
+//   int    ydf_model_num_categorical(void* h);
+//   int    ydf_model_num_outputs(void* h);           // floats per row
+//   int    ydf_model_cat_index(void* h, int cat_feature, const char* v);
+//          // vocabulary index of a raw string value (0 = out-of-vocab)
+//   void   ydf_model_predict(void* h, const float* x_num,
+//                            const int32_t* x_cat, int64_t n, float* out);
+//          // x_num row-major [n, num_numerical] (NaN = missing),
+//          // x_cat row-major [n, num_categorical] (<0 = missing),
+//          // out [n, num_outputs]
+//
+// Build: g++ -O3 -std=c++17 -shared -fPIC portable_infer.cc -o libydfportable.so
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+// output_mode (keep in sync with ydf_tpu/serving/portable.py)
+enum OutputMode {
+  kRaw = 0,
+  kSigmoid = 1,
+  kSoftmax = 2,
+  kMeanProba = 3,
+  kMeanProbaBinary = 4,
+  kExp = 5,
+};
+
+struct Model {
+  std::string error;
+
+  uint32_t output_mode = 0, D = 1, n_out = 1, K = 1, V = 1, T = 0;
+  uint32_t combine_mean = 0, impute_missing = 1;
+  std::vector<float> init;
+
+  uint32_t Fn = 0, Fc = 0;
+  std::vector<float> impute;
+  // Per categorical feature: vocabulary strings (index = code).
+  std::vector<std::vector<std::string>> vocab;
+
+  uint32_t mask_words = 0;
+  std::vector<uint32_t> masks;  // [n_masks * W]
+
+  std::vector<uint32_t> tree_offset;       // [T]
+  std::vector<int32_t> feature;            // [total]
+  std::vector<uint32_t> aux, cat_feature;  // [total]
+  std::vector<float> thresh;               // [total]
+  std::vector<uint32_t> left, right;       // [total]
+  std::vector<uint8_t> na_left;            // [total]
+  std::vector<float> leaf_values;
+  std::vector<uint32_t> proj_start;  // [n_proj + 1]
+  std::vector<uint32_t> proj_feature;
+  std::vector<float> proj_weight;
+};
+
+class Reader {
+ public:
+  Reader(const uint8_t* p, size_t n) : p_(p), n_(n) {}
+  bool ok() const { return ok_; }
+
+  bool bytes(void* dst, size_t k) {
+    if (!ok_ || pos_ + k > n_) return ok_ = false;
+    std::memcpy(dst, p_ + pos_, k);
+    pos_ += k;
+    return true;
+  }
+  uint32_t u32() {
+    uint32_t v = 0;
+    bytes(&v, 4);
+    return v;
+  }
+  template <typename T>
+  bool vec(std::vector<T>& out, size_t count) {
+    if (!ok_ || pos_ + count * sizeof(T) > n_) return ok_ = false;
+    out.resize(count);
+    if (count) std::memcpy(out.data(), p_ + pos_, count * sizeof(T));
+    pos_ += count * sizeof(T);
+    return true;
+  }
+
+ private:
+  const uint8_t* p_;
+  size_t n_, pos_ = 0;
+  bool ok_ = true;
+};
+
+Model* LoadModel(const char* path) {
+  auto* m = new Model();
+  FILE* fp = std::fopen(path, "rb");
+  if (!fp) {
+    m->error = "cannot open file";
+    return m;
+  }
+  std::fseek(fp, 0, SEEK_END);
+  long size = std::ftell(fp);
+  std::fseek(fp, 0, SEEK_SET);
+  std::vector<uint8_t> buf(size > 0 ? size : 0);
+  if (size > 0 && std::fread(buf.data(), 1, size, fp) != (size_t)size) {
+    std::fclose(fp);
+    m->error = "short read";
+    return m;
+  }
+  std::fclose(fp);
+
+  Reader r(buf.data(), buf.size());
+  char magic[8];
+  if (!r.bytes(magic, 8) || std::memcmp(magic, "YDFTPU1\x00", 8) != 0) {
+    m->error = "bad magic";
+    return m;
+  }
+  uint32_t version = r.u32();
+  if (version != 1) {
+    m->error = "unsupported version";
+    return m;
+  }
+  m->output_mode = r.u32();
+  m->D = r.u32();
+  m->n_out = r.u32();
+  m->K = r.u32();
+  m->V = r.u32();
+  m->T = r.u32();
+  m->combine_mean = r.u32();
+  m->impute_missing = r.u32();
+  r.vec(m->init, m->D);
+  m->Fn = r.u32();
+  r.vec(m->impute, m->Fn);
+  m->Fc = r.u32();
+  m->vocab.resize(m->Fc);
+  for (uint32_t i = 0; i < m->Fc && r.ok(); ++i) {
+    uint32_t count = r.u32();
+    m->vocab[i].reserve(count);
+    for (uint32_t j = 0; j < count && r.ok(); ++j) {
+      uint32_t len = r.u32();
+      std::string s(len, '\0');
+      r.bytes(s.data(), len);
+      m->vocab[i].push_back(std::move(s));
+    }
+  }
+  m->mask_words = r.u32();
+  uint32_t n_masks = r.u32();
+  r.vec(m->masks, (size_t)n_masks * m->mask_words);
+  uint32_t total = r.u32();
+  r.vec(m->tree_offset, m->T);
+  r.vec(m->feature, total);
+  r.vec(m->aux, total);
+  r.vec(m->cat_feature, total);
+  r.vec(m->thresh, total);
+  r.vec(m->left, total);
+  r.vec(m->right, total);
+  r.vec(m->na_left, total);
+  uint32_t n_leaf = r.u32();
+  r.vec(m->leaf_values, n_leaf);
+  uint32_t n_proj = r.u32();
+  r.vec(m->proj_start, (size_t)n_proj + 1);
+  uint32_t n_pf = r.u32();
+  r.vec(m->proj_feature, n_pf);
+  r.vec(m->proj_weight, n_pf);
+  if (!r.ok()) m->error = "truncated blob";
+  return m;
+}
+
+inline bool BitSet(const uint32_t* mask, uint32_t idx) {
+  return (mask[idx >> 5] >> (idx & 31u)) & 1u;
+}
+
+// Routes one example through one tree, adding its leaf contribution.
+void RouteTree(const Model& m, uint32_t t, const float* x_num,
+               const int32_t* x_cat, float* acc) {
+  const uint32_t base = m.tree_offset[t];
+  uint32_t node = 0;
+  for (;;) {
+    const uint32_t e = base + node;
+    const int32_t fid = m.feature[e];
+    if (fid == -1) {
+      if (m.V > 1) {
+        const float* lv = &m.leaf_values[(size_t)m.aux[e] * m.V];
+        for (uint32_t j = 0; j < m.V; ++j) acc[j] += lv[j];
+      } else if (m.K > 1) {
+        acc[t % m.K] += m.leaf_values[m.aux[e]];
+      } else {
+        acc[0] += m.leaf_values[m.aux[e]];
+      }
+      return;
+    }
+    bool go_left;
+    bool missing = false;
+    if (fid == -2) {
+      int32_t c = x_cat[m.cat_feature[e] - m.Fn];
+      if (c < 0) {
+        // impute_missing: missing categorical = out-of-vocabulary
+        // (encode-time convention of the TPU learners); otherwise the
+        // node's learned na_left direction applies.
+        if (m.impute_missing) c = 0; else missing = true;
+      }
+      go_left =
+          !missing &&
+          BitSet(&m.masks[(size_t)m.aux[e] * m.mask_words], (uint32_t)c);
+    } else if (fid == -3) {
+      float v = 0.0f;
+      for (uint32_t p = m.proj_start[m.aux[e]];
+           p < m.proj_start[m.aux[e] + 1]; ++p) {
+        float x = x_num[m.proj_feature[p]];
+        if (std::isnan(x)) x = m.impute[m.proj_feature[p]];
+        v += m.proj_weight[p] * x;
+      }
+      go_left = v < m.thresh[e];
+    } else {
+      float x = x_num[fid];
+      if (std::isnan(x)) {
+        if (m.impute_missing) x = m.impute[fid]; else missing = true;
+      }
+      go_left = x < m.thresh[e];
+    }
+    if (missing) go_left = m.na_left[e] != 0;
+    node = go_left ? m.left[e] : m.right[e];
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ydf_model_load(const char* path) { return LoadModel(path); }
+
+const char* ydf_model_error(void* h) {
+  auto* m = static_cast<Model*>(h);
+  return m->error.empty() ? nullptr : m->error.c_str();
+}
+
+void ydf_model_free(void* h) { delete static_cast<Model*>(h); }
+
+int ydf_model_num_numerical(void* h) {
+  return (int)static_cast<Model*>(h)->Fn;
+}
+
+int ydf_model_num_categorical(void* h) {
+  return (int)static_cast<Model*>(h)->Fc;
+}
+
+int ydf_model_num_outputs(void* h) {
+  return (int)static_cast<Model*>(h)->n_out;
+}
+
+int ydf_model_cat_index(void* h, int cat_feature, const char* value) {
+  auto* m = static_cast<Model*>(h);
+  if (cat_feature < 0 || (uint32_t)cat_feature >= m->Fc) return 0;
+  const auto& voc = m->vocab[cat_feature];
+  for (size_t i = 0; i < voc.size(); ++i) {
+    if (voc[i] == value) return (int)i;
+  }
+  return 0;  // out-of-vocabulary
+}
+
+void ydf_model_predict(void* h, const float* x_num, const int32_t* x_cat,
+                       int64_t n, float* out) {
+  auto* m = static_cast<Model*>(h);
+  const uint32_t D = m->D;
+  std::vector<float> acc(D);
+  for (int64_t e = 0; e < n; ++e) {
+    const float* xn = x_num + e * m->Fn;
+    const int32_t* xc = x_cat + e * m->Fc;
+    for (uint32_t j = 0; j < D; ++j) acc[j] = 0.0f;
+    for (uint32_t t = 0; t < m->T; ++t) {
+      RouteTree(*m, t, xn, xc, acc.data());
+    }
+    if (m->combine_mean) {
+      for (uint32_t j = 0; j < D; ++j) acc[j] /= (float)m->T;
+    }
+    for (uint32_t j = 0; j < D; ++j) acc[j] += m->init[j];
+    float* o = out + e * m->n_out;
+    switch (m->output_mode) {
+      case kSigmoid:
+        o[0] = 1.0f / (1.0f + std::exp(-acc[0]));
+        break;
+      case kExp:
+        o[0] = std::exp(acc[0]);
+        break;
+      case kSoftmax: {
+        float mx = acc[0];
+        for (uint32_t j = 1; j < D; ++j) mx = acc[j] > mx ? acc[j] : mx;
+        float s = 0.0f;
+        for (uint32_t j = 0; j < D; ++j) {
+          o[j] = std::exp(acc[j] - mx);
+          s += o[j];
+        }
+        for (uint32_t j = 0; j < D; ++j) o[j] /= s;
+        break;
+      }
+      case kMeanProbaBinary:
+        o[0] = acc[1];
+        break;
+      case kMeanProba:
+      case kRaw:
+      default:
+        for (uint32_t j = 0; j < m->n_out; ++j) o[j] = acc[j];
+        break;
+    }
+  }
+}
+
+}  // extern "C"

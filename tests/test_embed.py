@@ -14,10 +14,11 @@ from ydf_tpu.dataset.dataset import Dataset
 from ydf_tpu.serving.embed import EmbedUnsupported, _ident
 
 
-def _compile_and_run(tmp_path, model, df, name="m"):
+def _compile_and_run(tmp_path, model, df, name="m", algorithm="IF_ELSE",
+                     num_outputs=1):
     """Generates <name>.h, compiles a driver that reads encoded features
-    from stdin, and returns its predictions."""
-    files = model.to_standalone_cc(name=name)
+    from stdin, and returns its predictions ([n] or [n, num_outputs])."""
+    files = model.to_standalone_cc(name=name, algorithm=algorithm)
     hdr = files[f"{name}.h"]
     (tmp_path / f"{name}.h").write_text(hdr)
 
@@ -33,6 +34,16 @@ def _compile_and_run(tmp_path, model, df, name="m"):
                 f"    in >> u; instance.{cid} = "
                 f"static_cast<{name}::Feature{cid}>(u);"
             )
+    if num_outputs == 1:
+        call = f'    std::printf("%.9g\\n", {name}::Predict(instance));'
+    else:
+        call = (
+            f"    float proba[{num_outputs}];\n"
+            f"    {name}::PredictProba(instance, proba);\n"
+            f"    for (int j = 0; j < {num_outputs}; ++j) "
+            'std::printf("%.9g ", proba[j]);\n'
+            '    std::printf("\\n");'
+        )
     driver = f"""
 #include <cstdio>
 #include <iostream>
@@ -43,7 +54,7 @@ int main() {{
     {name}::Instance instance;
     float v; uint32_t u; auto& in = std::cin;
 {os.linesep.join(sets)}
-    std::printf("%.9g\\n", {name}::Predict(instance));
+{call}
   }}
   return 0;
 }}
@@ -68,7 +79,8 @@ int main() {{
         [str(exe)], input="\n".join(rows), capture_output=True,
         text=True, check=True,
     )
-    return np.array([float(x) for x in out.stdout.split()], np.float32)
+    vals = np.array([float(x) for x in out.stdout.split()], np.float32)
+    return vals if num_outputs == 1 else vals.reshape(-1, num_outputs)
 
 
 def test_gbt_regression_bit_exact(tmp_path, abalone):
@@ -113,12 +125,77 @@ def test_rf_regression(tmp_path):
     np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
 
 
-def test_embed_rejects_oblique(abalone):
+@pytest.mark.parametrize("algorithm", ["IF_ELSE", "ROUTING"])
+def test_embed_oblique(tmp_path, abalone, algorithm):
+    """Oblique (sparse projection) conditions lower to inline dot
+    products (IF_ELSE) / CSR projection tables (ROUTING)."""
     feats = [c for c in abalone.columns if c not in ("Rings", "Type")]
     m = ydf.GradientBoostedTreesLearner(
         label="Rings", task=Task.REGRESSION, features=feats,
-        num_trees=3, split_axis="SPARSE_OBLIQUE", validation_ratio=0.0,
+        num_trees=8, max_depth=4, split_axis="SPARSE_OBLIQUE",
+        validation_ratio=0.0, early_stopping="NONE",
+    ).train(abalone)
+    assert np.asarray(m.forest.oblique_weights).size > 0
+    head = abalone.head(300)
+    got = _compile_and_run(tmp_path, m, head, algorithm=algorithm)
+    want = m.predict(head).astype(np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_embed_routing_bit_exact(tmp_path, abalone):
+    """ROUTING (data-bank) mode is bit-exact against IF_ELSE and the
+    model (same f32 accumulation order)."""
+    feats = [c for c in abalone.columns if c not in ("Rings",)]
+    m = ydf.GradientBoostedTreesLearner(
+        label="Rings", task=Task.REGRESSION, features=feats,
+        num_trees=10, max_depth=4, validation_ratio=0.0,
         early_stopping="NONE",
     ).train(abalone)
-    with pytest.raises(EmbedUnsupported):
-        m.to_standalone_cc()
+    head = abalone.head(200)
+    got = _compile_and_run(tmp_path, m, head, algorithm="ROUTING")
+    want = m.predict(head).astype(np.float32)
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("algorithm", ["IF_ELSE", "ROUTING"])
+def test_embed_multiclass_gbt(tmp_path, algorithm):
+    """Multiclass GBT: per-class accumulators (tree t feeds class t %% K)
+    + softmax — reference embed covers multiclass the same way."""
+    rng = np.random.RandomState(4)
+    n = 2000
+    x = rng.normal(size=n)
+    z = rng.normal(size=n)
+    y = np.digitize(x + 0.3 * z, [-0.6, 0.6]).astype(np.int64)
+    data = {"x": x, "z": z, "y": y}
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=6, max_depth=3, validation_ratio=0.0,
+        early_stopping="NONE",
+    ).train(data)
+    assert m.num_trees_per_iter == 3
+    sub = {k: v[:300] for k, v in data.items()}
+    got = _compile_and_run(
+        tmp_path, m, sub, algorithm=algorithm, num_outputs=3
+    )
+    want = m.predict(sub).astype(np.float32)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+@pytest.mark.parametrize("wta", [True, False])
+def test_embed_rf_classification(tmp_path, wta):
+    """RF classification: vector leaves; winner_take_all votes are baked
+    at codegen time (rf_model.predict's argmax substitution)."""
+    rng = np.random.RandomState(6)
+    n = 1500
+    data = {
+        "x1": rng.normal(size=n),
+        "x2": rng.normal(size=n),
+    }
+    data["y"] = ((data["x1"] + 0.5 * data["x2"]) > 0).astype(np.int64)
+    m = ydf.RandomForestLearner(
+        label="y", num_trees=15, max_depth=5, winner_take_all=wta,
+        compute_oob_performances=False,
+    ).train(data)
+    sub = {k: v[:300] for k, v in data.items()}
+    got = _compile_and_run(tmp_path, m, sub)  # Predict → proba[1]
+    want = m.predict(sub).astype(np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)

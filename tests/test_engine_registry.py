@@ -1,0 +1,83 @@
+"""Speed-ranked serving-engine registry (reference
+register_engines.cc:172-875 IsCompatible + ranking; PYDF
+list_compatible_engines / force_engine)."""
+
+import numpy as np
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.serving.registry import (
+    EngineFactory,
+    best_engine,
+    compatible_engines,
+    list_engines,
+    register_engine,
+)
+
+
+def _model(n=1500, seed=0):
+    rng = np.random.RandomState(seed)
+    data = {"x1": rng.normal(size=n), "x2": rng.normal(size=n)}
+    data["y"] = ((data["x1"] + 0.5 * data["x2"]) > 0).astype(np.int64)
+    return ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=8, max_depth=4, validation_ratio=0.0,
+        early_stopping="NONE",
+    ).train(data), data
+
+
+def test_routed_always_compatible():
+    m, _ = _model()
+    names = m.list_compatible_engines()
+    assert "Routed" in names
+    assert names == [f.name for f in compatible_engines(m)]
+
+
+def test_quickscorer_ranked_first_when_forced_on(monkeypatch):
+    monkeypatch.setenv("YDF_TPU_FORCE_QUICKSCORER", "1")
+    m, data = _model()
+    names = m.list_compatible_engines()
+    assert names[0] == "QuickScorer"  # rank 300 > Routed rank 0
+    # And the automatic choice agrees with predict-by-forced-engine.
+    p_auto = m.predict(data)
+    m.force_engine("Routed")
+    p_routed = m.predict(data)
+    m.force_engine(None)
+    np.testing.assert_allclose(p_auto, p_routed, atol=1e-5)
+
+
+def test_force_engine_validates():
+    m, _ = _model()
+    with pytest.raises(ValueError, match="Unknown engine"):
+        m.force_engine("WarpDrive")
+    # Multiclass is outside the QuickScorer envelope.
+    rng = np.random.RandomState(1)
+    x = rng.normal(size=900)
+    y = np.digitize(x, [-0.5, 0.5]).astype(np.int64)
+    mc = ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=3, max_depth=3, validation_ratio=0.0,
+        early_stopping="NONE",
+    ).train({"x": x, "z": rng.normal(size=900), "y": y})
+    with pytest.raises(ValueError, match="not compatible"):
+        mc.force_engine("QuickScorer")
+
+
+def test_registry_extensible():
+    """Third-party engines slot into the ranking (the reference's
+    REGISTER_FastEngineFactory extension point)."""
+    sentinel = object()
+    f = EngineFactory(
+        name="TestTurbo", rank=9999,
+        is_compatible=lambda model: getattr(model, "_turbo_ok", False),
+        build=lambda model: sentinel,
+    )
+    register_engine(f)
+    try:
+        m, _ = _model()
+        assert "TestTurbo" not in m.list_compatible_engines()
+        m._turbo_ok = True
+        assert m.list_compatible_engines()[0] == "TestTurbo"
+        assert best_engine(m).build(m) is sentinel
+    finally:
+        from ydf_tpu.serving import registry as _r
+
+        _r._REGISTRY.remove(f)

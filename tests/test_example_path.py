@@ -1,0 +1,99 @@
+"""Row-wise example path (reference dataset/example.proto +
+single-example Predict) and the distribute CLI
+(reference utils/distribute_cli)."""
+
+import subprocess
+import sys
+
+import numpy as np
+
+import ydf_tpu as ydf
+from ydf_tpu.dataset.dataset import Dataset
+from ydf_tpu.dataset.example import (
+    columns_to_examples,
+    examples_to_columns,
+)
+
+
+def test_examples_columns_roundtrip():
+    exs = [
+        {"a": 1.5, "b": "x"},
+        {"a": 2.0},              # b missing
+        {"b": "y", "c": 3},      # a missing; c appears late
+    ]
+    cols = examples_to_columns(exs)
+    assert set(cols) == {"a", "b", "c"}
+    assert np.isnan(cols["a"][2]) and cols["b"][1] == ""
+    back = columns_to_examples(cols)
+    assert back[0] == {"a": 1.5, "b": "x"}
+    assert back[1] == {"a": 2.0}
+    assert back[2] == {"b": "y", "c": 3.0}
+
+
+def test_predict_example_matches_batch(adult_train):
+    m = ydf.GradientBoostedTreesLearner(
+        label="income", num_trees=5, max_depth=4, validation_ratio=0.0,
+        early_stopping="NONE",
+    ).train(adult_train.head(2000))
+    row = adult_train.iloc[5].to_dict()
+    got = m.predict_example(row)
+    want = m.predict(adult_train.head(10))[5]
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    # A row with a missing feature still scores (imputation semantics).
+    row2 = dict(row)
+    del row2["age"]
+    assert np.isfinite(m.predict_example(row2))
+
+
+def test_dataset_from_examples(adult_train):
+    head = adult_train.head(20)
+    exs = head.to_dict("records")
+    ds = Dataset.from_examples(exs)
+    assert ds.num_rows == 20
+
+
+def test_distribute_cli(tmp_path):
+    out = tmp_path / "o"
+    out.mkdir()
+    cmds = tmp_path / "cmds.txt"
+    cmds.write_text(
+        "\n".join(
+            [f"echo hi{i} > {out}/f{i}.txt" for i in range(6)]
+            + ["# a comment", ""]
+        )
+    )
+    r = subprocess.run(
+        [sys.executable, "-m", "ydf_tpu.cli", "distribute",
+         "--commands", str(cmds), "--workers", "3"],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "6/6 commands succeeded" in r.stdout
+    assert sorted(p.name for p in out.iterdir()) == [
+        f"f{i}.txt" for i in range(6)
+    ]
+    # Sharding: shard 0 of 2 runs every other command.
+    out2 = tmp_path / "o2"
+    out2.mkdir()
+    cmds2 = tmp_path / "c2.txt"
+    cmds2.write_text(
+        "\n".join(f"echo hi > {out2}/g{i}.txt" for i in range(4))
+    )
+    r = subprocess.run(
+        [sys.executable, "-m", "ydf_tpu.cli", "distribute",
+         "--commands", str(cmds2), "--workers", "2",
+         "--shard", "0", "--num_shards", "2"],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    assert sorted(p.name for p in out2.iterdir()) == ["g0.txt", "g2.txt"]
+    # A failing command sets a non-zero exit code.
+    bad = tmp_path / "bad.txt"
+    bad.write_text("false\ntrue\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "ydf_tpu.cli", "distribute",
+         "--commands", str(bad), "--workers", "1", "--keep_going"],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 1
+    assert "1/2 commands succeeded" in r.stdout

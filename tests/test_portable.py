@@ -1,0 +1,119 @@
+"""Portable C-ABI inference artifact (the ports story — reference
+port/go/, port/javascript/: inference front-ends over one engine).
+write_portable() → native/portable_infer.cc loads it → predictions
+match model.predict()."""
+
+import numpy as np
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+from ydf_tpu.dataset.dataset import Dataset
+from ydf_tpu.serving.portable import write_portable
+from ydf_tpu.serving import portable_runtime
+
+pytestmark = pytest.mark.skipif(
+    not portable_runtime.available(),
+    reason="portable inference library unavailable (no g++?)",
+)
+
+
+def _roundtrip(tmp_path, model, df):
+    path = str(tmp_path / "model.ydftpu")
+    write_portable(model, path)
+    pm = portable_runtime.PortableModel(path)
+    ds = Dataset.from_data(df, dataspec=model.dataspec)
+    x_num, x_cat, _ = model._encode_inputs(ds)
+    got = pm.predict(x_num, x_cat)
+    pm.close()
+    return got
+
+
+def test_portable_gbt_binary(tmp_path, adult_train):
+    m = ydf.GradientBoostedTreesLearner(
+        label="income", num_trees=10, max_depth=5, validation_ratio=0.0,
+        early_stopping="NONE",
+    ).train(adult_train.head(3000))
+    head = adult_train.head(400)
+    got = _roundtrip(tmp_path, m, head)
+    want = m.predict(head).astype(np.float32)
+    np.testing.assert_allclose(got, want, atol=2e-7)
+
+
+def test_portable_gbt_multiclass(tmp_path):
+    rng = np.random.RandomState(4)
+    n = 2000
+    x, z = rng.normal(size=n), rng.normal(size=n)
+    y = np.digitize(x + 0.3 * z, [-0.6, 0.6]).astype(np.int64)
+    data = {"x": x, "z": z, "y": y}
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=6, max_depth=3, validation_ratio=0.0,
+        early_stopping="NONE",
+    ).train(data)
+    sub = {k: v[:300] for k, v in data.items()}
+    got = _roundtrip(tmp_path, m, sub)
+    want = m.predict(sub).astype(np.float32)
+    assert got.shape == want.shape == (300, 3)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_portable_oblique(tmp_path, abalone):
+    feats = [c for c in abalone.columns if c not in ("Rings", "Type")]
+    m = ydf.GradientBoostedTreesLearner(
+        label="Rings", task=Task.REGRESSION, features=feats,
+        num_trees=8, max_depth=4, split_axis="SPARSE_OBLIQUE",
+        validation_ratio=0.0, early_stopping="NONE",
+    ).train(abalone)
+    head = abalone.head(300)
+    got = _roundtrip(tmp_path, m, head)
+    want = m.predict(head).astype(np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("wta", [True, False])
+def test_portable_rf_classification(tmp_path, wta):
+    rng = np.random.RandomState(6)
+    n = 1500
+    data = {"x1": rng.normal(size=n), "x2": rng.normal(size=n)}
+    data["y"] = ((data["x1"] + 0.5 * data["x2"]) > 0).astype(np.int64)
+    m = ydf.RandomForestLearner(
+        label="y", num_trees=15, max_depth=5, winner_take_all=wta,
+        compute_oob_performances=False,
+    ).train(data)
+    sub = {k: v[:300] for k, v in data.items()}
+    got = _roundtrip(tmp_path, m, sub)
+    want = m.predict(sub).astype(np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_portable_imported_reference_model(tmp_path, adult_test):
+    """An imported reference model (native na_left routing) round-trips
+    through the portable blob — the Go/JS ports' core use case: serve a
+    YDF model without the training stack."""
+    MD = (
+        "/root/reference/yggdrasil_decision_forests/test_data/model/"
+        "adult_binary_class_gbdt"
+    )
+    m = ydf.load_model(MD)
+    head = adult_test.head(300)
+    got = _roundtrip(tmp_path, m, head)
+    want = m.predict(head).astype(np.float32)
+    np.testing.assert_allclose(got, want, atol=2e-6)
+
+
+def test_portable_cat_index(tmp_path, adult_train):
+    m = ydf.GradientBoostedTreesLearner(
+        label="income", num_trees=3, max_depth=3, validation_ratio=0.0,
+        early_stopping="NONE",
+    ).train(adult_train.head(2000))
+    path = str(tmp_path / "m.ydftpu")
+    write_portable(m, path)
+    pm = portable_runtime.PortableModel(path)
+    # First categorical feature: vocabulary lookups match the dataspec.
+    b = m.binner
+    cat0 = b.feature_names[b.num_numerical]
+    col = m.dataspec.column_by_name(cat0)
+    for idx, item in enumerate(col.vocabulary):
+        assert pm.cat_index(0, str(item)) == idx
+    assert pm.cat_index(0, "definitely-not-a-vocab-item") == 0
+    pm.close()

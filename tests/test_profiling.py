@@ -1,0 +1,87 @@
+"""Per-stage training profile + per-engine inference benchmark
+(reference: distributed GBT Monitoring per-stage logs, utils/usage.h,
+utils/benchmark/inference.h:36-52)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+
+
+def _data(n=2000, seed=0):
+    rng = np.random.RandomState(seed)
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    y = ((x1 + 0.5 * x2) > 0).astype(np.int64)
+    return {"x1": x1, "x2": x2, "y": y}
+
+
+def test_training_profile_gbt():
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=5, max_depth=3, validation_ratio=0.0,
+        early_stopping="NONE",
+    ).train(_data())
+    p = m.training_profile
+    assert p is not None
+    for key in ("ingest_bin", "device_loop", "finalize", "total", "other"):
+        assert key in p and p[key] >= 0, (key, p)
+    assert p["total"] >= p["device_loop"]
+    from ydf_tpu.utils.profiling import format_profile
+
+    s = format_profile(p)
+    assert "device_loop=" in s and "total=" in s
+
+
+def test_training_profile_rf():
+    m = ydf.RandomForestLearner(
+        label="y", num_trees=5, max_depth=4,
+    ).train(_data())
+    p = m.training_profile
+    assert p is not None and "device_loop" in p
+
+
+def test_profiler_trace_dir(tmp_path, monkeypatch):
+    """YDF_TPU_PROFILE_DIR wraps the device loop in jax.profiler.trace."""
+    monkeypatch.setenv("YDF_TPU_PROFILE_DIR", str(tmp_path))
+    ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=2, max_depth=2, validation_ratio=0.0,
+        early_stopping="NONE",
+    ).train(_data(500))
+    trace_root = tmp_path / "gbt_train"
+    assert trace_root.exists()
+    # xprof writes something under plugins/profile/<run>/
+    found = list(trace_root.rglob("*"))
+    assert found, "empty trace dir"
+
+
+def test_benchmark_engines():
+    data = _data(3000)
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=10, max_depth=4, validation_ratio=0.0,
+        early_stopping="NONE",
+    ).train(data)
+    b = m.benchmark(data, num_runs=2, engines=True)
+    assert b["ns_per_example"] > 0
+    eng = b["engines_ns_per_example"]
+    assert "routed" in eng and eng["routed"] > 0
+    # Depth-4, 10-tree binary GBT is inside the QuickScorer envelope.
+    assert "quickscorer" in eng and eng["quickscorer"] > 0
+    assert "binned_quickscorer" in eng and eng["binned_quickscorer"] > 0
+
+
+def test_benchmark_engines_multiclass_skips_quickscorer():
+    rng = np.random.RandomState(3)
+    n = 1500
+    x = rng.normal(size=n)
+    y = np.digitize(x, [-0.5, 0.5]).astype(np.int64)
+    data = {"x": x, "z": rng.normal(size=n), "y": y}
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=6, max_depth=3, validation_ratio=0.0,
+        early_stopping="NONE",
+    ).train(data)
+    b = m.benchmark(data, num_runs=1, engines=True)
+    eng = b["engines_ns_per_example"]
+    assert "routed" in eng
+    assert "quickscorer" not in eng

@@ -55,6 +55,9 @@ class GenericModel:
         # False: global imputation at encode time (our learners' training
         # semantics, reference training.cc LocalImputation*).
         self.native_missing = native_missing
+        # Per-stage train() wall breakdown (utils/profiling.py), set by
+        # the learners; None for imported/loaded models.
+        self.training_profile: Optional[Dict[str, float]] = None
 
     # ------------------------------------------------------------------ #
     # Introspection
@@ -334,13 +337,16 @@ class GenericModel:
     def print_tree(self, tree_idx: int = 0) -> None:
         print(self.get_tree(tree_idx).pretty())
 
-    def to_standalone_cc(self, name: str = "ydf_model") -> dict:
+    def to_standalone_cc(
+        self, name: str = "ydf_model", algorithm: str = "IF_ELSE"
+    ) -> dict:
         """Dependency-free C++ header reproducing this model's predictions
         bit-for-bit (reference embed subsystem, serving/embed/embed.h:
-        27-30). Returns {filename: source}."""
+        27-30). algorithm: "IF_ELSE" (per-tree branch chains) or
+        "ROUTING" (data-bank node tables). Returns {filename: source}."""
         from ydf_tpu.serving.embed import to_standalone_cc
 
-        return to_standalone_cc(self, name=name)
+        return to_standalone_cc(self, name=name, algorithm=algorithm)
 
     def to_jax_function(self, apply_link_function: bool = True):
         """Returns (fn, params, encoder):
@@ -534,35 +540,57 @@ class GenericModel:
                 out[:, j] = True
         return out
 
+    def list_compatible_engines(self) -> List[str]:
+        """Names of serving engines compatible with this model, fastest
+        first (reference PYDF model.list_compatible_engines /
+        register_engines.cc IsCompatible ranking)."""
+        from ydf_tpu.serving.registry import compatible_engines
+
+        return [f.name for f in compatible_engines(self)]
+
+    def force_engine(self, name: Optional[str]) -> None:
+        """Pins predict() to one engine by name (reference PYDF
+        model.force_engine); None restores automatic (fastest-compatible)
+        selection. Raises for unknown or incompatible names."""
+        from ydf_tpu.serving.registry import best_engine
+
+        if name is not None:
+            best_engine(self, forced=name)  # validates
+        self._forced_engine = name
+
     def _fast_engine(self):
-        """QuickScorer engine for the CURRENT forest, or None. Compiled
-        engines only pay off on TPU; the CPU interpreter fallback is for
-        tests (YDF_TPU_FORCE_QUICKSCORER=1). Cached per forest object —
-        multiclass predict temporarily swaps self.forest per output dim."""
+        """Fastest compatible non-generic engine for the CURRENT forest,
+        or None when the registry ranks the generic routed engine first
+        (serving/registry.py — the reference's BuildFastEngine flow).
+        Cached per forest object — multiclass predict temporarily swaps
+        self.forest per output dim."""
+        from ydf_tpu.serving.registry import best_engine
+
         import os
 
-        from ydf_tpu.config import is_tpu_backend
-
-        force = os.environ.get("YDF_TPU_FORCE_QUICKSCORER") == "1"
-        on_tpu = is_tpu_backend()
-        if not force and not on_tpu:
-            return None
         cache = getattr(self, "_qs_cache", None)
         if cache is None:
             cache = self._qs_cache = {}
-        key = id(self.forest.feature)
+        forced = getattr(self, "_forced_engine", None)
+        # The env force-flag participates in compatibility gating
+        # (registry._qs_allowed) and tests toggle it mid-process — it
+        # must be part of the key or a stale selection would be served.
+        key = (
+            forced,
+            os.environ.get("YDF_TPU_FORCE_QUICKSCORER"),
+            id(self.forest.feature),
+        )
         hit = cache.get(key)
         # Entries pin the keyed array (id() is only unique among live
-        # objects) and are verified by identity before use.
+        # objects) and are verified by identity before use. Caching the
+        # whole selection (not just the build) keeps the per-predict cost
+        # at a dict lookup — the compatibility probes compile the forest.
         if hit is None or hit[0] is not self.forest.feature:
-            from ydf_tpu.serving import build_quickscorer
-
             if len(cache) > 8:
                 cache.clear()
-            cache[key] = (
-                self.forest.feature,
-                build_quickscorer(self, interpret=force and not on_tpu),
-            )
+            factory = best_engine(self, forced=forced)
+            eng = None if factory.name == "Routed" else factory.build(self)
+            cache[key] = (self.forest.feature, eng)
         return cache[key][1]
 
     def _raw_scores(self, data: InputData, combine: str) -> np.ndarray:
@@ -607,10 +635,29 @@ class GenericModel:
     def predict(self, data: InputData) -> np.ndarray:
         raise NotImplementedError
 
-    def benchmark(self, data: InputData, num_runs: int = 10) -> dict:
+    def predict_example(self, example: dict):
+        """Scores ONE {column: value} row — the reference's
+        single-example Predict overload (abstract_model.h:500-516) over
+        the row-wise example path (dataset/example.py). Missing columns
+        follow the model's missing-value semantics."""
+        ds = Dataset.from_examples([example], dataspec=self.dataspec)
+        out = self.predict(ds)
+        return out[0]
+
+    def benchmark(
+        self, data: InputData, num_runs: int = 10, engines: bool = False
+    ) -> dict:
         """Inference speed on `data` (reference model.benchmark /
         cli/benchmark_inference.cc): best wall time over `num_runs`
-        batched predicts, compile excluded."""
+        batched predicts, compile excluded.
+
+        engines=True additionally times each applicable serving engine on
+        the pre-encoded inputs (reference benchmark_inference.cc runs
+        every compatible engine): `routed` (flat-node traversal,
+        ops/routing.py), `quickscorer` (leaf-mask Pallas kernel) and
+        `binned_quickscorer` (uint8-bin-matrix variant, the 8-bit-engine
+        analogue). Engine rows exclude host-side encoding, which the
+        `predict` row includes."""
         import time
 
         if num_runs < 1:
@@ -623,12 +670,73 @@ class GenericModel:
             self.predict(ds)
             times.append(time.perf_counter() - t0)
         best = min(times)
-        return {
+        n = max(ds.num_rows, 1)
+        out = {
             "num_examples": ds.num_rows,
             "num_runs": num_runs,
             "best_wall_s": best,
-            "ns_per_example": 1e9 * best / max(ds.num_rows, 1),
+            "ns_per_example": 1e9 * best / n,
         }
+        if not engines:
+            return out
+
+        def _time_engine(fn):
+            np.asarray(fn())  # warmup + compile
+            ts = []
+            for _ in range(num_runs):
+                t0 = time.perf_counter()
+                np.asarray(fn())
+                ts.append(time.perf_counter() - t0)
+            return 1e9 * min(ts) / n
+
+        eng = {}
+        x_num, x_cat, x_set = self._encode_inputs(ds)
+        vs = self._encode_vs(ds)
+        jx_num, jx_cat = jnp.asarray(x_num), jnp.asarray(x_cat)
+        eng["routed"] = _time_engine(
+            lambda: forest_predict_values(
+                self.forest, jx_num, jx_cat,
+                num_numerical=self.binner.num_numerical,
+                max_depth=self.max_depth,
+                combine="sum",
+                x_set=None if x_set is None else jnp.asarray(x_set),
+                x_vs_vals=None if vs is None else jnp.asarray(vs[0]),
+                x_vs_len=None if vs is None else jnp.asarray(vs[1]),
+            )
+        )
+        if (
+            x_set is None
+            and vs is None
+            and not self.native_missing
+            # QuickScorer sums one scalar per tree — multiclass forests
+            # (K trees/iter) go through the routed engine per class.
+            and getattr(self, "num_trees_per_iter", 1) == 1
+        ):
+            try:
+                from ydf_tpu.serving import (
+                    build_binned_quickscorer,
+                    build_quickscorer,
+                )
+
+                qs = build_quickscorer(self)
+                if qs is not None:
+                    eng["quickscorer"] = _time_engine(
+                        lambda: qs(jx_num, jx_cat)
+                    )
+                bq = build_binned_quickscorer(self)
+                if bq is not None:
+                    bins_u8 = jnp.asarray(
+                        self.binner.transform(ds)[
+                            :, : self.binner.num_scalar
+                        ]
+                    )
+                    eng["binned_quickscorer"] = _time_engine(
+                        lambda: bq(bins_u8, jx_cat)
+                    )
+            except Exception as e:  # engine inapplicable to this forest
+                eng["quickscorer_error"] = f"{type(e).__name__}: {e}"
+        out["engines_ns_per_example"] = eng
+        return out
 
     # ------------------------------------------------------------------ #
     # Evaluation

@@ -5,21 +5,36 @@ Counterpart of the reference's embed subsystem
 minimal dependency", C++ lowering in
 `embed/cpp/cpp_target_lowering.cc`): the generated header is standalone —
 no ydf_tpu, no JAX, nothing beyond <cstdint>/<cmath> — and reproduces the
-model's predictions bit-for-bit (same f32 comparisons, same f32
-accumulation order as ops/routing.py's tree scan).
+model's predictions bit-for-bit on the raw accumulation path (same f32
+comparisons, same f32 accumulation order as ops/routing.py's tree scan).
 
-Like the reference's `Algorithm::IF_ELSE` mode, every tree lowers to an
-if-else chain; categorical contains-conditions test a bit in a static
-per-node uint32 mask bank. The entry points mirror embed.h's generated
-API shape:
+Two lowering algorithms, mirroring the reference's
+`cpp_target_lowering.cc` modes:
+
+* ``IF_ELSE`` — every tree lowers to an if-else chain (fastest for small
+  trees; the branch predictor sees the actual structure).
+* ``ROUTING`` — data-bank mode: the forest lowers to flat constant node
+  arrays (feature id, threshold, children, leaf values) plus a while
+  loop per tree — tiny code size for big forests, the analogue of the
+  reference's data-bank routing tables.
+
+Supported: GBT (binary, regression, Poisson, ranking, **multiclass** via
+per-class accumulators + softmax) and RF (regression and classification
+incl. **vector leaves** — winner_take_all votes are baked at codegen
+time); **oblique** (sparse projection) conditions; categorical
+contains-conditions via a static uint32 mask bank.
+
+Unsupported (falls back to serving the model normally): vector-sequence
+conditions, categorical-set features, imported models with native
+missing-value routing.
+
+Generated API shape (embed.h's generated-API analogue):
 
     struct Instance { float f1; ...; FeatureBlah blah; ... };
-    float PredictRaw(const Instance&);   // margin / score
-    float Predict(const Instance&);      // link applied (proba / value)
-
-Unsupported (falls back to serving the model normally): oblique and
-vector-sequence conditions, categorical-set features, multi-output
-forests.
+    float PredictRaw(const Instance&);            // margin (D == 1)
+    void  PredictRaw(const Instance&, float*);    // margins (D > 1)
+    float Predict(const Instance&);               // link applied
+    void  PredictProba(const Instance&, float*);  // D > 1 classifiers
 """
 
 from __future__ import annotations
@@ -55,30 +70,26 @@ class EmbedUnsupported(Exception):
 
 
 def to_standalone_cc(
-    model, name: str = "ydf_model", namespace: Optional[str] = None
+    model,
+    name: str = "ydf_model",
+    namespace: Optional[str] = None,
+    algorithm: str = "IF_ELSE",
 ) -> Dict[str, str]:
     """Returns {"<name>.h": header_source}. Raises EmbedUnsupported for
-    models outside the envelope."""
+    models outside the envelope. algorithm: "IF_ELSE" | "ROUTING"."""
     from ydf_tpu.config import Task
     from ydf_tpu.models.gbt_model import GradientBoostedTreesModel
     from ydf_tpu.models.rf_model import RandomForestModel
 
+    if algorithm not in ("IF_ELSE", "ROUTING"):
+        raise ValueError(f"Unknown embed algorithm {algorithm!r}")
     namespace = namespace or name
     f = model.forest.to_numpy()
     binner = model.binner
-    if f["oblique_weights"].size > 0:
-        raise EmbedUnsupported("oblique conditions")
-    if f.get("vs_anchor") is not None and f["vs_anchor"].size > 0:
+    if f.get("vs_anchor") is not None and np.size(f["vs_anchor"]) > 0:
         raise EmbedUnsupported("vector-sequence conditions")
     if getattr(binner, "num_set", 0) > 0:
         raise EmbedUnsupported("categorical-set features")
-    if f["leaf_value"].shape[-1] != 1:
-        raise EmbedUnsupported("multi-output forest")
-    if getattr(model, "num_trees_per_iter", 1) > 1:
-        # Multi-class GBT stores K single-output trees per iteration and
-        # softmaxes per-class sub-forests — one accumulator can't
-        # reproduce it.
-        raise EmbedUnsupported("multi-class forest")
     if getattr(model, "native_missing", False):
         # Imported models route missing values per node (na_left); the
         # generated code bakes imputation instead.
@@ -93,9 +104,32 @@ def to_standalone_cc(
     Fn = binner.num_numerical
     names = binner.feature_names
     T = f["feature"].shape[0]
+    nfeat = len(names)
+    ow = f.get("oblique_weights")
+    P = 0 if ow is None else int(np.shape(ow)[1])
+
+    # --- output geometry ------------------------------------------------
+    # K: GBT trees per iteration (tree t feeds accumulator t % K).
+    # V: leaf-vector width (RF classification leaves are distributions).
+    K = getattr(model, "num_trees_per_iter", 1) if is_gbt else 1
+    V = int(f["leaf_value"].shape[-1])
+    if K > 1 and V != 1:
+        raise EmbedUnsupported("multi-output leaves with trees-per-iter > 1")
+    D = max(K, V)  # output dimensionality
+
+    leaf_values = np.asarray(f["leaf_value"], np.float32)  # [T, N, V]
+    if (
+        is_rf
+        and model.task == Task.CLASSIFICATION
+        and getattr(model, "winner_take_all", False)
+    ):
+        # Bake hard votes at codegen time (the same substitution
+        # rf_model.predict applies before routing).
+        from ydf_tpu.models.forest import bake_winner_take_all
+
+        leaf_values = bake_winner_take_all(leaf_values)
 
     # --- Instance struct + categorical enums ---------------------------
-    lines: List[str] = []
     enums: List[str] = []
     fields: List[str] = []
     for i, fname in enumerate(names):
@@ -129,11 +163,10 @@ def to_standalone_cc(
     # --- categorical mask bank -----------------------------------------
     mask_bank: List[str] = []
     mask_index: Dict[tuple, int] = {}
+    max_words = int(np.shape(f["cat_mask"])[-1])
 
-    def mask_id(t: int, nid: int, width_bits: int) -> int:
-        words = tuple(
-            int(w) for w in f["cat_mask"][t, nid][: (width_bits + 31) // 32]
-        )
+    def mask_id(t: int, nid: int) -> int:
+        words = tuple(int(w) for w in f["cat_mask"][t, nid])
         if words not in mask_index:
             mask_index[words] = len(mask_bank)
             mask_bank.append(
@@ -141,29 +174,55 @@ def to_standalone_cc(
             )
         return mask_index[words]
 
-    max_words = int(np.shape(f["cat_mask"])[-1])
+    # --- oblique projection helpers ------------------------------------
+    def oblique_expr(t: int, proj: int) -> str:
+        """Sparse dot product over the projection's nonzero coefficients.
+        Inputs are imputed per feature exactly like the routed engine
+        (encode-time global imputation — NaNs never reach the dot)."""
+        w = np.asarray(ow[t, proj], np.float32)
+        terms = []
+        for i in np.flatnonzero(w != 0):
+            cid = _ident(names[int(i)])
+            mean = _f32(binner.impute_values[int(i)])
+            terms.append(f"{_f32(w[int(i)])} * Imp(instance.{cid}, {mean})")
+        return " + ".join(terms) if terms else "0.0f"
+
+    def leaf_stmts(t: int, nid: int, indent: str) -> List[str]:
+        if D == 1:
+            return [f"{indent}acc += {_f32(leaf_values[t, nid, 0])};"]
+        if V > 1:  # vector leaf: add every component
+            return [
+                f"{indent}acc[{j}] += {_f32(leaf_values[t, nid, j])};"
+                for j in range(V)
+                if np.float32(leaf_values[t, nid, j]) != 0
+            ] or [f"{indent};"]
+        # K > 1: this tree feeds accumulator t % K.
+        return [
+            f"{indent}acc[{t % K}] += {_f32(leaf_values[t, nid, 0])};"
+        ]
 
     # --- per-tree if-else lowering -------------------------------------
-    def lower_tree(t: int) -> str:
+    def lower_tree_if_else(t: int) -> str:
         out: List[str] = []
 
         def emit(nid: int, indent: str):
             if f["is_leaf"][t, nid]:
-                out.append(
-                    f"{indent}acc += {_f32(f['leaf_value'][t, nid, 0])};"
-                )
+                out.extend(leaf_stmts(t, nid, indent))
                 return
             feat = int(f["feature"][t, nid])
-            cid = _ident(names[feat])
             if bool(f["is_cat"][t, nid]):
-                col = model.dataspec.column_by_name(names[feat])
-                m = mask_id(t, nid, max(col.vocab_size, 1))
+                cid = _ident(names[feat])
+                m = mask_id(t, nid)
                 cond = (
                     f"BitSet(kMasks[{m}], "
                     f"static_cast<uint32_t>(instance.{cid}))"
                 )
+            elif feat >= nfeat:  # oblique projection
+                thr = _f32(f["threshold"][t, nid])
+                cond = f"({oblique_expr(t, feat - nfeat)}) < {thr}"
             else:
                 thr = _f32(f["threshold"][t, nid])
+                cid = _ident(names[feat])
                 mean = _f32(binner.impute_values[feat])
                 cond = f"Imp(instance.{cid}, {mean}) < {thr}"
             out.append(f"{indent}if ({cond}) {{")
@@ -175,37 +234,79 @@ def to_standalone_cc(
         emit(0, "  ")
         return "\n".join(out)
 
-    trees_src = []
-    for t in range(T):
-        trees_src.append(
-            f"inline void AddTree{t}(const Instance& instance, float& acc)"
-            f" {{\n{lower_tree(t)}\n}}"
-        )
+    acc_sig = "float& acc" if D == 1 else "float* acc"
+
+    internal_src: List[str] = []
+    if algorithm == "IF_ELSE":
+        for t in range(T):
+            internal_src.append(
+                f"inline void AddTree{t}(const Instance& instance, "
+                f"{acc_sig}) {{\n{lower_tree_if_else(t)}\n}}"
+            )
+        run_trees = [f"  AddTree{t}(instance, acc);" for t in range(T)]
+    else:
+        internal_src.append(_routing_bank(
+            f, leaf_values, names, binner, nfeat, P, ow, mask_id, T, D, K, V,
+        ))
+        run_trees = [
+            "  for (uint32_t t = 0; t < kNumTrees; ++t) "
+            "RouteTree(t, instance, acc);"
+        ]
 
     # --- prediction wrapper --------------------------------------------
-    init = 0.0
+    init = np.zeros((D,), np.float32)
     link = "raw"
     if is_gbt:
-        init = float(np.asarray(model.initial_predictions).reshape(-1)[0])
+        init = np.asarray(model.initial_predictions, np.float32).reshape(-1)
         if model.apply_link_function:
             if model.task == Task.CLASSIFICATION:
-                link = "sigmoid"
+                link = "sigmoid" if D == 1 else "softmax"
             elif getattr(model, "loss_name", "") == "POISSON":
                 link = "exp"  # log link (gbt_model.py predict)
+    elif is_rf and model.task == Task.CLASSIFICATION:
+        link = "proba"  # accumulated votes/distributions, mean over trees
     combine_mean = is_rf
     # Same f32 operation order as the routed engine (ops/routing.py):
     # trees accumulate from zero in scan order; the initial prediction
     # (GBT) / the mean division (RF) applies at the end — this is what
     # makes the generated code bit-exact against model.predict().
-    pred_body = [
-        "  float acc = 0.0f;",
-        *(f"  AddTree{t}(instance, acc);" for t in range(T)),
-    ]
-    if combine_mean:
-        pred_body.append(f"  acc /= {T}.0f;")
-    if init != 0.0:
-        pred_body.append(f"  acc += {_f32(init)};")
-    pred_body.append("  return acc;")
+    if D == 1:
+        pred_body = ["  float acc = 0.0f;", *run_trees]
+        if combine_mean:
+            pred_body.append(f"  acc /= {T}.0f;")
+        if np.float32(init[0]) != 0:
+            pred_body.append(f"  acc += {_f32(init[0])};")
+        pred_body.append("  return acc;")
+        raw_fns = (
+            "inline float PredictRaw(const Instance& instance) {\n"
+            "  using namespace internal;\n"
+            + "\n".join(pred_body)
+            + "\n}"
+        )
+    else:
+        pred_body = [
+            f"  for (int j = 0; j < {D}; ++j) acc[j] = 0.0f;",
+            *run_trees,
+        ]
+        if combine_mean:
+            pred_body.append(
+                f"  for (int j = 0; j < {D}; ++j) acc[j] /= {T}.0f;"
+            )
+        if np.any(init != 0):
+            inits = ", ".join(_f32(v) for v in init)
+            pred_body.append(
+                f"  static constexpr float kInit[{D}] = {{{inits}}};"
+            )
+            pred_body.append(
+                f"  for (int j = 0; j < {D}; ++j) acc[j] += kInit[j];"
+            )
+        raw_fns = (
+            f"// Writes the {D} raw per-class scores into acc.\n"
+            "inline void PredictRaw(const Instance& instance, float* acc) "
+            "{\n  using namespace internal;\n"
+            + "\n".join(pred_body)
+            + "\n}"
+        )
 
     if link == "sigmoid":
         predict_fn = (
@@ -222,14 +323,75 @@ def to_standalone_cc(
             "  return std::exp(PredictRaw(instance));\n"
             "}"
         )
-    else:
+    elif link == "softmax":
         predict_fn = (
-            "inline float Predict(const Instance& instance) {\n"
-            "  return PredictRaw(instance);\n"
+            f"// Softmax class probabilities ({D} classes).\n"
+            "inline void PredictProba(const Instance& instance, "
+            "float* proba) {\n"
+            "  PredictRaw(instance, proba);\n"
+            "  float m = proba[0];\n"
+            f"  for (int j = 1; j < {D}; ++j) m = proba[j] > m ? proba[j]"
+            " : m;\n"
+            "  float s = 0.0f;\n"
+            f"  for (int j = 0; j < {D}; ++j) {{ proba[j] = "
+            "std::exp(proba[j] - m); s += proba[j]; }\n"
+            f"  for (int j = 0; j < {D}; ++j) proba[j] /= s;\n"
+            "}\n"
+            "// Argmax class index.\n"
+            "inline int Predict(const Instance& instance) {\n"
+            f"  float acc[{D}];\n"
+            "  PredictRaw(instance, acc);\n"
+            "  int best = 0;\n"
+            f"  for (int j = 1; j < {D}; ++j) if (acc[j] > acc[best]) "
+            "best = j;\n"
+            "  return best;\n"
             "}"
         )
+    elif link == "proba":
+        bin_note = (
+            "  // Binary: probability of the positive class "
+            "(matches model.predict()).\n"
+        )
+        predict_fn = (
+            f"// Mean vote / distribution over trees ({D} classes).\n"
+            "inline void PredictProba(const Instance& instance, "
+            "float* proba) {\n"
+            "  PredictRaw(instance, proba);\n"
+            "}\n"
+            "inline float Predict(const Instance& instance) {\n"
+            + bin_note
+            + f"  float acc[{D}];\n"
+            "  PredictRaw(instance, acc);\n"
+            + (
+                "  return acc[1];\n"
+                if D == 2
+                else
+                "  int best = 0;\n"
+                f"  for (int j = 1; j < {D}; ++j) if (acc[j] > acc[best])"
+                " best = j;\n"
+                "  return static_cast<float>(best);\n"
+            )
+            + "}"
+        )
+    else:
+        if D == 1:
+            predict_fn = (
+                "inline float Predict(const Instance& instance) {\n"
+                "  return PredictRaw(instance);\n"
+                "}"
+            )
+        else:
+            predict_fn = (
+                "inline void Predict(const Instance& instance, "
+                "float* out) {\n"
+                "  PredictRaw(instance, out);\n"
+                "}"
+            )
 
-    label_doc = f"// Label: {model.label!r}; task: {model.task.value}."
+    label_doc = (
+        f"// Label: {model.label!r}; task: {model.task.value}; "
+        f"algorithm: {algorithm}."
+    )
     header = f"""// Generated by ydf_tpu embed codegen — dependency-free standalone model.
 // (Counterpart of the reference's serving/embed C++ target,
 //  ydf/serving/embed/embed.h:27-30.)
@@ -265,14 +427,11 @@ inline constexpr uint32_t kMasks[{max(len(mask_bank), 1)}][{max_words}] = {{
   {", ".join(mask_bank) if mask_bank else "{0u}"}
 }};
 
-{chr(10).join(trees_src)}
+{chr(10).join(internal_src)}
 
 }}  // namespace internal
 
-inline float PredictRaw(const Instance& instance) {{
-  using namespace internal;
-{chr(10).join(pred_body)}
-}}
+{raw_fns}
 
 {predict_fn}
 
@@ -281,3 +440,102 @@ inline float PredictRaw(const Instance& instance) {{
 #endif  // YDF_TPU_EMBED_{_ident(name).upper()}_H_
 """
     return {f"{name}.h": header}
+
+
+def _routing_bank(
+    f, leaf_values, names, binner, nfeat, P, ow, mask_id, T, D, K, V
+) -> str:
+    """ROUTING (data-bank) lowering: the shared flattener
+    (serving/flatten.py — also the portable blob's encoding, so the two
+    export backends cannot drift) rendered as flat constant C++ arrays +
+    one while loop — the reference's data-bank mode
+    (cpp_target_lowering.cc routing tables)."""
+    from ydf_tpu.serving.flatten import flatten_forest_data_bank
+
+    bank = flatten_forest_data_bank(
+        f, leaf_values, nfeat, ow, V, mask_id=mask_id
+    )
+    Fn = binner.num_numerical
+    num_get = [
+        f"    case {i}: return Imp(instance.{_ident(names[i])}, "
+        f"{_f32(binner.impute_values[i])});"
+        for i in range(Fn)
+    ]
+    cat_get = [
+        f"    case {i}: return static_cast<uint32_t>(instance."
+        f"{_ident(names[i])});"
+        for i in range(Fn, nfeat)
+    ]
+
+    def arr(name, typ, vals):
+        vals = list(vals)
+        body = ", ".join(str(v) for v in vals) if len(vals) else "0"
+        return (
+            f"inline constexpr {typ} {name}[{max(len(vals), 1)}] = "
+            f"{{{body}}};"
+        )
+
+    if D == 1:
+        add_leaf = "      acc += kLeafValues[kAux[e]];"
+    elif V > 1:
+        add_leaf = (
+            f"      for (int j = 0; j < {V}; ++j) "
+            f"acc[j] += kLeafValues[kAux[e] * {V} + j];"
+        )
+    else:  # K > 1: tree t feeds accumulator t % K
+        add_leaf = f"      acc[t % {K}u] += kLeafValues[kAux[e]];"
+    acc_sig = "float& acc" if D == 1 else "float* acc"
+
+    return f"""// ---- data-bank routing tables (ROUTING mode) ----
+inline constexpr uint32_t kNumTrees = {T};
+{arr("kTreeOffset", "uint32_t", bank.tree_offset)}
+{arr("kFeature", "int32_t", bank.feature)}
+{arr("kAux", "uint32_t", bank.aux)}
+{arr("kCatFeature", "uint32_t", bank.cat_feature)}
+{arr("kThresh", "float", (_f32(v) for v in bank.thresh))}
+{arr("kLeft", "uint32_t", bank.left)}
+{arr("kRight", "uint32_t", bank.right)}
+{arr("kLeafValues", "float", (_f32(v) for v in bank.leaf_values))}
+{arr("kProjStart", "uint32_t", bank.proj_start)}
+{arr("kProjFeature", "uint16_t", bank.proj_feature)}
+{arr("kProjWeight", "float", (_f32(v) for v in bank.proj_weight))}
+
+inline float NumFeature(const Instance& instance, int32_t fid) {{
+  switch (fid) {{
+{chr(10).join(num_get) if num_get else "    default: break;"}
+  }}
+  return 0.0f;
+}}
+
+inline uint32_t CatFeature(const Instance& instance, uint32_t fid) {{
+  switch (fid) {{
+{chr(10).join(cat_get) if cat_get else "    default: break;"}
+  }}
+  return 0u;
+}}
+
+inline void RouteTree(uint32_t t, const Instance& instance, {acc_sig}) {{
+  const uint32_t base = kTreeOffset[t];
+  uint32_t node = 0;
+  for (;;) {{
+    const uint32_t e = base + node;
+    const int32_t fid = kFeature[e];
+    if (fid == -1) {{
+{add_leaf}
+      return;
+    }}
+    bool go_left;
+    if (fid == -2) {{
+      go_left = BitSet(kMasks[kAux[e]], CatFeature(instance, kCatFeature[e]));
+    }} else if (fid == -3) {{
+      float v = 0.0f;
+      for (uint32_t p = kProjStart[kAux[e]]; p < kProjStart[kAux[e] + 1]; ++p)
+        v += kProjWeight[p] * NumFeature(instance, kProjFeature[p]);
+      go_left = v < kThresh[e];
+    }} else {{
+      go_left = NumFeature(instance, fid) < kThresh[e];
+    }}
+    node = go_left ? kLeft[e] : kRight[e];
+  }}
+}}
+"""

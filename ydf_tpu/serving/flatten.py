@@ -1,0 +1,128 @@
+"""Forest → flat data-bank node tables, shared by the embed ROUTING
+lowering (serving/embed.py) and the portable blob writer
+(serving/portable.py) — one implementation of the node encoding so the
+two export backends cannot drift apart.
+
+Per-entry encoding (mirrors the reference's data-bank routing tables,
+cpp_target_lowering.cc):
+
+    feature >= 0 : axis-aligned numerical node, compare to thresh
+    feature == -1: leaf; aux = offset into leaf_values (units of
+                   leaf_width)
+    feature == -2: categorical; aux = mask bank row, cat_feature =
+                   global feature id
+    feature == -3: oblique; aux = CSR row into proj_start
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class DataBank:
+    tree_offset: List[int]     # [T] first entry of each tree
+    feature: np.ndarray        # i32 [total]
+    aux: np.ndarray            # u32 [total]
+    cat_feature: np.ndarray    # u32 [total]
+    thresh: np.ndarray         # f32 [total]
+    left: np.ndarray           # u32 [total]
+    right: np.ndarray          # u32 [total]
+    na_left: np.ndarray        # u8  [total]
+    leaf_values: List[float]   # flat, leaf_width entries per leaf
+    masks: List[Tuple[int, ...]]  # deduped uint32 word tuples
+    proj_start: List[int]      # CSR [n_proj + 1]
+    proj_feature: List[int]
+    proj_weight: List[float]
+    leaf_width: int
+
+
+def flatten_forest_data_bank(
+    f: dict,
+    leaf_values: np.ndarray,  # [T, N, V] (votes already baked if WTA)
+    nfeat: int,
+    ow: Optional[np.ndarray],  # [T, P, Fn] oblique weights or None
+    V: int,
+    mask_id: Optional[Callable[[int, int], int]] = None,
+) -> DataBank:
+    """mask_id(t, nid) -> bank row: pass a callback to dedup into an
+    external mask bank (embed shares one bank across lowering modes);
+    default dedups into DataBank.masks."""
+    T = int(f["feature"].shape[0])
+    num_nodes = np.asarray(f["num_nodes"], np.int64)
+    tree_offset = [0]
+    for t in range(T):
+        tree_offset.append(tree_offset[-1] + int(num_nodes[t]))
+    total = tree_offset[-1]
+
+    leaf_width = V if V > 1 else 1
+    bank = DataBank(
+        tree_offset=tree_offset[:-1],
+        feature=np.zeros((total,), np.int32),
+        aux=np.zeros((total,), np.uint32),
+        cat_feature=np.zeros((total,), np.uint32),
+        thresh=np.zeros((total,), np.float32),
+        left=np.zeros((total,), np.uint32),
+        right=np.zeros((total,), np.uint32),
+        na_left=np.zeros((total,), np.uint8),
+        leaf_values=[],
+        masks=[],
+        proj_start=[],
+        proj_feature=[],
+        proj_weight=[],
+        leaf_width=leaf_width,
+    )
+    mask_index: dict = {}
+
+    def default_mask_id(t: int, nid: int) -> int:
+        words = tuple(int(w) for w in f["cat_mask"][t, nid])
+        if words not in mask_index:
+            mask_index[words] = len(bank.masks)
+            bank.masks.append(words)
+        return mask_index[words]
+
+    get_mask = mask_id or default_mask_id
+
+    na = f.get("na_left")
+    e = 0
+    for t in range(T):
+        for nid in range(int(num_nodes[t])):
+            if na is not None:
+                bank.na_left[e] = 1 if bool(na[t, nid]) else 0
+            if f["is_leaf"][t, nid]:
+                bank.feature[e] = -1
+                bank.aux[e] = len(bank.leaf_values) // leaf_width
+                if V > 1:
+                    bank.leaf_values.extend(
+                        float(leaf_values[t, nid, j]) for j in range(V)
+                    )
+                else:
+                    bank.leaf_values.append(float(leaf_values[t, nid, 0]))
+                e += 1
+                continue
+            feat = int(f["feature"][t, nid])
+            if bool(f["is_cat"][t, nid]):
+                bank.feature[e] = -2
+                bank.aux[e] = get_mask(t, nid)
+                bank.cat_feature[e] = feat
+            elif feat >= nfeat:  # oblique projection
+                bank.feature[e] = -3
+                bank.aux[e] = len(bank.proj_start)
+                bank.proj_start.append(len(bank.proj_feature))
+                w = np.asarray(ow[t, feat - nfeat], np.float32)
+                for i in np.flatnonzero(w != 0):
+                    bank.proj_feature.append(int(i))
+                    bank.proj_weight.append(float(w[int(i)]))
+                bank.thresh[e] = np.float32(f["threshold"][t, nid])
+            else:
+                bank.feature[e] = feat
+                bank.thresh[e] = np.float32(f["threshold"][t, nid])
+            bank.left[e] = int(f["left"][t, nid])
+            bank.right[e] = int(f["right"][t, nid])
+            e += 1
+    # CSR sentinel: projection p spans [proj_start[p], proj_start[p+1]).
+    bank.proj_start.append(len(bank.proj_feature))
+    return bank

@@ -1,0 +1,174 @@
+"""Portable standalone-inference artifact: model → one flat binary blob.
+
+The ports story (reference `port/go/` 16.8k LoC, `port/javascript/`
+2.7k, `port/tensorflow/` 4.5k — all *inference* front-ends over the same
+C++ engines): instead of re-implementing an engine per language, the TPU
+build ships ONE dependency-free C-ABI library
+(`native/portable_infer.cc`, ~no deps beyond libc/libm) that loads this
+blob and predicts. Any FFI-capable language — Go (cgo), Node (ffi-napi /
+N-API), Python (ctypes), Rust, JVM (JNA) — gets inference from a dozen
+lines of bindings; `ydf_tpu/serving/portable_runtime.py` is the ctypes
+reference binding and the round-trip test harness.
+
+Blob layout (all little-endian, see native/portable_infer.cc):
+
+    char[8] magic "YDFTPU1\\0"; u32 version
+    u32 output_mode; u32 D; u32 n_out; u32 K; u32 V; u32 T;
+    u32 combine_mean; u32 impute_missing; f32 init[D]
+    u32 Fn; f32 impute[Fn]
+    u32 Fc; per cat feature: u32 vocab_count, count x (u32 len, bytes)
+    u32 W; u32 n_masks; u32 masks[n_masks * W]
+    u32 total_nodes; u32 tree_offset[T]
+    i32 feature[]; u32 aux[]; u32 cat_feature[]; f32 thresh[];
+    u32 left[]; u32 right[]; u8 na_left[]
+    u32 n_leaf_vals; f32 leaf_values[]
+    u32 n_proj; u32 proj_start[n_proj + 1]; u32 n_pf;
+    u32 proj_feature[n_pf]; f32 proj_weight[n_pf]
+
+Node encoding matches the embed ROUTING data bank: feature >= 0 is an
+axis-aligned numerical node, -1 leaf (aux = leaf offset), -2 categorical
+(aux = mask row, cat_feature = global feature id), -3 oblique (aux =
+projection row).
+"""
+
+from __future__ import annotations
+
+import struct
+import numpy as np
+
+from ydf_tpu.serving.embed import EmbedUnsupported
+
+MAGIC = b"YDFTPU1\x00"
+VERSION = 1
+
+# output_mode
+RAW = 0            # n_out = D raw scores (regression/ranking/survival)
+SIGMOID = 1        # binary GBT: n_out = 1 probability
+SOFTMAX = 2        # multiclass GBT: n_out = D probabilities
+MEAN_PROBA = 3     # RF classification: n_out = D probabilities
+MEAN_PROBA_BINARY = 4  # binary RF: n_out = 1, probability of class 1
+EXP = 5            # Poisson GBT log link: n_out = 1
+
+
+def write_portable(model, path: str) -> None:
+    """Serializes `model` to the portable inference blob at `path`.
+    Raises EmbedUnsupported outside the envelope (vector-sequence or
+    categorical-set conditions)."""
+    from ydf_tpu.config import Task
+    from ydf_tpu.models.gbt_model import GradientBoostedTreesModel
+    from ydf_tpu.models.rf_model import RandomForestModel
+
+    f = model.forest.to_numpy()
+    binner = model.binner
+    if f.get("vs_anchor") is not None and np.size(f["vs_anchor"]) > 0:
+        raise EmbedUnsupported("vector-sequence conditions")
+    if getattr(binner, "num_set", 0) > 0:
+        raise EmbedUnsupported("categorical-set features")
+
+    is_gbt = isinstance(model, GradientBoostedTreesModel)
+    is_rf = isinstance(model, RandomForestModel)
+    if not (is_gbt or is_rf):
+        raise EmbedUnsupported(type(model).__name__)
+
+    names = binner.feature_names
+    Fn = binner.num_numerical
+    nfeat = len(names)
+    T = int(f["feature"].shape[0])
+    ow = f.get("oblique_weights")
+    P = 0 if ow is None else int(np.shape(ow)[1])
+    if P > 0 and getattr(model, "native_missing", False):
+        raise EmbedUnsupported(
+            "oblique conditions with native missing-value routing"
+        )
+
+    K = getattr(model, "num_trees_per_iter", 1) if is_gbt else 1
+    V = int(f["leaf_value"].shape[-1])
+    if K > 1 and V != 1:
+        raise EmbedUnsupported("multi-output leaves with trees-per-iter > 1")
+    D = max(K, V)
+
+    leaf_values = np.asarray(f["leaf_value"], np.float32)
+    if (
+        is_rf
+        and model.task == Task.CLASSIFICATION
+        and getattr(model, "winner_take_all", False)
+    ):
+        from ydf_tpu.models.forest import bake_winner_take_all
+
+        leaf_values = bake_winner_take_all(leaf_values)
+
+    init = np.zeros((D,), np.float32)
+    output_mode, n_out = RAW, D
+    if is_gbt:
+        init = np.asarray(
+            model.initial_predictions, np.float32
+        ).reshape(-1)[:D]
+        if model.apply_link_function:
+            if model.task == Task.CLASSIFICATION:
+                output_mode, n_out = (
+                    (SIGMOID, 1) if D == 1 else (SOFTMAX, D)
+                )
+            elif getattr(model, "loss_name", "") == "POISSON":
+                output_mode, n_out = EXP, 1
+        else:
+            n_out = D
+    elif is_rf and model.task == Task.CLASSIFICATION:
+        output_mode, n_out = (
+            (MEAN_PROBA_BINARY, 1) if D == 2 else (MEAN_PROBA, D)
+        )
+
+    # ---- flatten to the shared data-bank node encoding ----------------- #
+    # (serving/flatten.py — also the embed ROUTING lowering's encoding, so
+    # the two export backends cannot drift.)
+    from ydf_tpu.serving.flatten import flatten_forest_data_bank
+
+    bank = flatten_forest_data_bank(f, leaf_values, nfeat, ow, V)
+    W = int(np.shape(f["cat_mask"])[-1])
+
+    # ---- emit ---------------------------------------------------------- #
+    out = bytearray()
+    out += MAGIC
+    out += struct.pack(
+        "<IIIIIIIII",
+        VERSION, output_mode, D, n_out, K, V, T,
+        1 if is_rf else 0,
+        # impute_missing: our learners impute NaN/missing at encode time
+        # (embed's Imp semantics); imported reference models instead
+        # carry learned per-node na_left directions.
+        0 if getattr(model, "native_missing", False) else 1,
+    )
+    out += np.asarray(init, "<f4").tobytes()
+    out += struct.pack("<I", Fn)
+    out += np.asarray(
+        binner.impute_values[:Fn], "<f4"
+    ).tobytes()
+    Fc = nfeat - Fn
+    out += struct.pack("<I", Fc)
+    for i in range(Fn, nfeat):
+        col = model.dataspec.column_by_name(names[i])
+        vocab = col.vocabulary or []
+        out += struct.pack("<I", len(vocab))
+        for item in vocab:
+            b = str(item).encode("utf-8")
+            out += struct.pack("<I", len(b)) + b
+    out += struct.pack("<II", W, len(bank.masks))
+    if bank.masks:
+        out += np.asarray(bank.masks, "<u4").tobytes()
+    out += struct.pack("<I", len(bank.feature))
+    out += np.asarray(bank.tree_offset, "<u4").tobytes()
+    out += np.asarray(bank.feature, "<i4").tobytes()
+    out += np.asarray(bank.aux, "<u4").tobytes()
+    out += np.asarray(bank.cat_feature, "<u4").tobytes()
+    out += np.asarray(bank.thresh, "<f4").tobytes()
+    out += np.asarray(bank.left, "<u4").tobytes()
+    out += np.asarray(bank.right, "<u4").tobytes()
+    out += np.asarray(bank.na_left, "u1").tobytes()
+    out += struct.pack("<I", len(bank.leaf_values))
+    out += np.asarray(bank.leaf_values, "<f4").tobytes()
+    out += struct.pack("<I", len(bank.proj_start) - 1)
+    out += np.asarray(bank.proj_start, "<u4").tobytes()
+    out += struct.pack("<I", len(bank.proj_feature))
+    out += np.asarray(bank.proj_feature, "<u4").tobytes()
+    out += np.asarray(bank.proj_weight, "<f4").tobytes()
+    with open(path, "wb") as fh:
+        fh.write(bytes(out))

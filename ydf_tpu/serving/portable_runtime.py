@@ -1,0 +1,147 @@
+"""ctypes reference binding for the portable C-ABI inference library.
+
+This is the Python face of the single-engine ports story (see
+ydf_tpu/serving/portable.py and native/portable_infer.cc): any other
+language binds the same six C symbols the same way. Compiled on first
+use (g++ -O3 -shared) into native/build/, same lazy pattern as the
+native CSV loader (ydf_tpu/dataset/native_csv.py)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+_SRC = os.path.join(_REPO_ROOT, "native", "portable_infer.cc")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libydfportable.so")
+
+_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+
+def _load_library():
+    global _lib, _lib_failed
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            stale = (
+                os.path.isfile(_LIB_PATH)
+                and os.path.isfile(_SRC)
+                and os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
+            )
+            if not os.path.isfile(_LIB_PATH) or stale:
+                os.makedirs(_BUILD_DIR, exist_ok=True)
+                tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
+                subprocess.run(
+                    [
+                        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                        _SRC, "-o", tmp,
+                    ],
+                    check=True, capture_output=True, timeout=120,
+                )
+                os.replace(tmp, _LIB_PATH)
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.ydf_model_load.restype = ctypes.c_void_p
+            lib.ydf_model_load.argtypes = [ctypes.c_char_p]
+            lib.ydf_model_error.restype = ctypes.c_char_p
+            lib.ydf_model_error.argtypes = [ctypes.c_void_p]
+            lib.ydf_model_free.argtypes = [ctypes.c_void_p]
+            for fn in (
+                "ydf_model_num_numerical",
+                "ydf_model_num_categorical",
+                "ydf_model_num_outputs",
+            ):
+                getattr(lib, fn).restype = ctypes.c_int
+                getattr(lib, fn).argtypes = [ctypes.c_void_p]
+            lib.ydf_model_cat_index.restype = ctypes.c_int
+            lib.ydf_model_cat_index.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p,
+            ]
+            lib.ydf_model_predict.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_float),
+            ]
+            _lib = lib
+        except Exception:
+            _lib_failed = True
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return _load_library() is not None
+
+
+class PortableModel:
+    """Loaded portable model; predicts on pre-encoded feature arrays
+    (the exact layout other languages' bindings use)."""
+
+    def __init__(self, path: str):
+        lib = _load_library()
+        if lib is None:
+            raise RuntimeError("portable inference library unavailable")
+        self._lib = lib
+        self._h = lib.ydf_model_load(path.encode("utf-8"))
+        if not self._h:
+            raise RuntimeError("load failed")
+        err = lib.ydf_model_error(self._h)
+        if err:
+            msg = err.decode("utf-8")
+            lib.ydf_model_free(self._h)
+            self._h = None
+            raise RuntimeError(f"portable model load: {msg}")
+        self.num_numerical = lib.ydf_model_num_numerical(self._h)
+        self.num_categorical = lib.ydf_model_num_categorical(self._h)
+        self.num_outputs = lib.ydf_model_num_outputs(self._h)
+
+    def cat_index(self, cat_feature: int, value: str) -> int:
+        return self._lib.ydf_model_cat_index(
+            self._h, cat_feature, value.encode("utf-8")
+        )
+
+    def predict(
+        self, x_num: np.ndarray, x_cat: Optional[np.ndarray] = None
+    ) -> np.ndarray:
+        x_num = np.ascontiguousarray(x_num, np.float32).reshape(
+            -1, max(self.num_numerical, 1)
+        )[:, : self.num_numerical]
+        n = x_num.shape[0] if self.num_numerical else (
+            x_cat.shape[0] if x_cat is not None else 0
+        )
+        if x_cat is None:
+            x_cat = np.zeros((n, self.num_categorical), np.int32)
+        x_cat = np.ascontiguousarray(x_cat, np.int32)
+        if self.num_numerical == 0:
+            n = x_cat.shape[0]
+            x_num = np.zeros((n, 0), np.float32)
+        out = np.zeros((n, self.num_outputs), np.float32)
+        self._lib.ydf_model_predict(
+            self._h,
+            x_num.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            x_cat.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        )
+        return out[:, 0] if self.num_outputs == 1 else out
+
+    def close(self):
+        if self._h:
+            self._lib.ydf_model_free(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,149 @@
+"""Speed-ranked serving-engine registry.
+
+Counterpart of the reference's FastEngineFactory registry
+(`ydf/serving/decision_forest/register_engines.cc:172-875`: per model
+type, every engine declares IsCompatible() and a speed rank; BuildFastEngine
+picks the fastest compatible one). Here an engine factory is a small
+dataclass; registration is module-level; `best_engine(model)` returns the
+highest-ranked compatible factory and models expose
+`list_compatible_engines()` / `force_engine(name)` like the reference's
+PYDF API (`model/generic_model.py` same-named methods).
+
+The generic routed engine (ops/routing.py value-mode scan) is rank 0 and
+compatible with everything — it is the fallback the reference calls the
+"generic engine"."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineFactory:
+    """One serving engine: higher rank = preferred when compatible
+    (the reference factories are enumerated in speed order the same
+    way)."""
+
+    name: str
+    rank: int
+    is_compatible: Callable[[object], bool]
+    build: Callable[[object], object]  # model -> engine or None
+
+
+_REGISTRY: List[EngineFactory] = []
+
+
+def register_engine(factory: EngineFactory) -> None:
+    _REGISTRY.append(factory)
+    _REGISTRY.sort(key=lambda f: -f.rank)
+
+
+def list_engines() -> List[EngineFactory]:
+    return list(_REGISTRY)
+
+
+def compatible_engines(model) -> List[EngineFactory]:
+    """Compatible factories, fastest first."""
+    out = []
+    for f in _REGISTRY:
+        try:
+            if f.is_compatible(model):
+                out.append(f)
+        except Exception:
+            continue
+    return out
+
+
+def best_engine(model, forced: Optional[str] = None) -> EngineFactory:
+    if forced is not None:
+        for f in _REGISTRY:
+            if f.name == forced:
+                if not f.is_compatible(model):
+                    raise ValueError(
+                        f"Engine {forced!r} is not compatible with this "
+                        f"model (compatible: "
+                        f"{[c.name for c in compatible_engines(model)]})"
+                    )
+                return f
+        raise ValueError(
+            f"Unknown engine {forced!r}; registered: "
+            f"{[f.name for f in _REGISTRY]}"
+        )
+    compat = compatible_engines(model)
+    if not compat:
+        raise RuntimeError("No compatible serving engine (missing routed?)")
+    return compat[0]
+
+
+# --------------------------------------------------------------------- #
+# Built-in engines
+# --------------------------------------------------------------------- #
+
+
+def _scalar_sum_forest(model) -> bool:
+    """Common QuickScorer envelope: single accumulator, no set/VS
+    conditions, encode-time imputation."""
+    import numpy as np
+
+    return (
+        getattr(model.binner, "num_set", 0) == 0
+        and np.size(getattr(model.forest, "vs_anchor", np.zeros(0))) == 0
+        and not getattr(model, "native_missing", False)
+        and getattr(model, "num_trees_per_iter", 1) == 1
+        and int(model.forest.leaf_value.shape[-1]) == 1
+    )
+
+
+def _qs_allowed(model) -> bool:
+    """QuickScorer engines pay off compiled on TPU; the CPU interpreter
+    exists for tests (YDF_TPU_FORCE_QUICKSCORER=1) — same gating the
+    pre-registry dispatch used."""
+    from ydf_tpu.config import is_tpu_backend
+
+    return (
+        is_tpu_backend()
+        or os.environ.get("YDF_TPU_FORCE_QUICKSCORER") == "1"
+    )
+
+
+def _qs_compatible(model) -> bool:
+    if not (_scalar_sum_forest(model) and _qs_allowed(model)):
+        return False
+    from ydf_tpu.serving.quickscorer import compile_forest
+
+    return (
+        compile_forest(
+            model.forest, model.binner.num_numerical,
+            num_features=model.binner.num_scalar,
+        )
+        is not None
+    )
+
+
+def _build_qs(model):
+    from ydf_tpu.serving.quickscorer import build_quickscorer
+
+    return build_quickscorer(model)
+
+
+def _build_routed(model):
+    # Sentinel: the routed path lives in GenericModel._raw_scores (it
+    # needs the full input tuple, not just x_num/x_cat).
+    return None
+
+
+register_engine(EngineFactory(
+    name="QuickScorer",  # leaf-mask Pallas kernel (quickscorer.py)
+    rank=300,
+    is_compatible=_qs_compatible,
+    build=_build_qs,
+))
+
+register_engine(EngineFactory(
+    name="Routed",  # generic value-mode tree scan (ops/routing.py)
+    rank=0,
+    is_compatible=lambda model: True,
+    build=_build_routed,
+))
