@@ -50,6 +50,7 @@ def test_cache_reopen(tmp_path):
     assert re.label_classes() == cache.label_classes()
 
 
+@pytest.mark.slow
 def test_cache_regression_label(tmp_path):
     abalone = (
         "/root/reference/yggdrasil_decision_forests/test_data/dataset/"

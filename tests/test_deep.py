@@ -71,3 +71,25 @@ def test_tabular_transformer_binary():
     ).train(data)
     ev = m.evaluate(data)
     assert ev.accuracy > 0.72, str(ev)
+
+
+def test_deep_analyze():
+    """analyze() on NN models (reference deep/analysis.py PDP for NNs):
+    permutation importances + PDP/CEP through the forward pass."""
+    rng = np.random.RandomState(0)
+    n = 800
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    y = ((x1 + 0.3 * x2) > 0).astype(np.int64)
+    data = {"x1": x1, "x2": x2, "y": y}
+    m = deep.MultiLayerPerceptronLearner(
+        label="y", num_epochs=3, batch_size=128,
+    ).train(data)
+    a = m.analyze(data, num_pdp_features=2)
+    vi = a.variable_importances()
+    assert "MEAN_DECREASE_IN_METRIC" in vi
+    # x1 (the strong signal) outranks x2.
+    perm = {d["feature"]: d["importance"]
+            for d in vi["MEAN_DECREASE_IN_METRIC"]}
+    assert perm["x1"] > perm["x2"]
+    html = a.to_html()
+    assert "PDP" in html and "<html>" in html

@@ -45,20 +45,40 @@ def test_quickscorer_ranked_first_when_forced_on(monkeypatch):
     np.testing.assert_allclose(p_auto, p_routed, atol=1e-5)
 
 
-def test_force_engine_validates():
+def test_force_engine_validates(monkeypatch):
     m, _ = _model()
     with pytest.raises(ValueError, match="Unknown engine"):
         m.force_engine("WarpDrive")
-    # Multiclass is outside the QuickScorer envelope.
+    # Pin the gate closed (registry._qs_allowed is env/backend-dependent):
+    # an ungated QuickScorer must be rejected as incompatible.
+    from ydf_tpu.serving import registry as _reg
+
+    monkeypatch.delenv("YDF_TPU_FORCE_QUICKSCORER", raising=False)
+    monkeypatch.setattr(_reg, "_qs_allowed", lambda model: False)
+    with pytest.raises(ValueError, match="not compatible"):
+        m.force_engine("QuickScorer")
+
+
+def test_multiclass_uses_quickscorer_per_class(monkeypatch):
+    """Multiclass predict swaps per-class single-output sub-forests
+    through the fast engine — the compatibility check is against the
+    CURRENT forest geometry, not the model class."""
     rng = np.random.RandomState(1)
     x = rng.normal(size=900)
+    z = rng.normal(size=900)
     y = np.digitize(x, [-0.5, 0.5]).astype(np.int64)
+    data = {"x": x, "z": z, "y": y}
     mc = ydf.GradientBoostedTreesLearner(
         label="y", num_trees=3, max_depth=3, validation_ratio=0.0,
         early_stopping="NONE",
-    ).train({"x": x, "z": rng.normal(size=900), "y": y})
-    with pytest.raises(ValueError, match="not compatible"):
-        mc.force_engine("QuickScorer")
+    ).train(data)
+    monkeypatch.setenv("YDF_TPU_FORCE_QUICKSCORER", "1")
+    p1 = mc.predict(data)  # per-class sub-forests via QuickScorer
+    monkeypatch.delenv("YDF_TPU_FORCE_QUICKSCORER")
+    mc._qs_cache = {}
+    p2 = mc.predict(data)  # routed engine
+    assert p1.shape == (900, 3)
+    np.testing.assert_allclose(p1, p2, atol=1e-5)
 
 
 def test_registry_extensible():

@@ -94,6 +94,7 @@ def test_cross_validation_classification(adult_train):
     assert ev.accuracy > 0.80, str(ev)
 
 
+@pytest.mark.slow
 def test_cross_validation_regression(abalone):
     small = abalone.head(1500)
     learner = ydf.RandomForestLearner(

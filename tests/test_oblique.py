@@ -58,10 +58,17 @@ def test_import_ydf_oblique_gbdt(adult_test):
     assert m.evaluate(adult_test).accuracy > 0.86
 
 
-def test_shap_oblique_raises(adult_test):
+def test_shap_oblique_additivity(adult_test):
+    """TreeSHAP over oblique splits: the projection's first attribute
+    gathers the attribution (the reference's convention,
+    utils/shap.cc:248-250); additivity must hold exactly."""
     m = ydf.load_ydf_model(f"{MD}/adult_binary_class_gbdt_oblique")
-    with pytest.raises(NotImplementedError, match="oblique"):
-        m.predict_shap(adult_test.head(5))
+    head = adult_test.head(8)
+    phi, bias, rows = m.predict_shap(head)
+    raw = np.log(np.clip(m.predict(head), 1e-9, 1 - 1e-9))
+    raw = raw - np.log1p(-np.exp(raw))  # logit of proba = raw score
+    total = phi.sum(axis=1)[:, 0] + bias[0]
+    np.testing.assert_allclose(total, raw[rows], atol=1e-4)
 
 
 @pytest.mark.parametrize("wt", ["POWER_OF_TWO", "INTEGER"])

@@ -1,6 +1,7 @@
 """End-to-end smoke: the minimum slice of SURVEY.md §7 stage 2."""
 
 import numpy as np
+import pytest
 
 import ydf_tpu as ydf
 from ydf_tpu.config import Task
@@ -44,6 +45,7 @@ def test_gbt_regression_synthetic():
     assert ev.rmse < 0.8, str(ev)
 
 
+@pytest.mark.slow
 def test_rf_classification_synthetic():
     data = _synth_classif()
     model = ydf.RandomForestLearner(label="y", num_trees=20).train(data)

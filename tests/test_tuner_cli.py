@@ -69,6 +69,7 @@ def test_hp_optimizer_learner_parallel_matches_serial():
     assert m2.evaluate(data).accuracy > 0.8
 
 
+@pytest.mark.slow
 def test_hp_optimizer_auto_space_and_valid():
     data = _data(n=700, seed=8)
     hold = _data(n=300, seed=9)

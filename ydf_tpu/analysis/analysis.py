@@ -160,7 +160,10 @@ def analyze(
         model, data, num_rounds=permutation_rounds, max_rows=max_rows,
         seed=seed,
     )
-    struct = structure_importances(model)
+    # Deep (NN) models have no tree structure — permutation + PDP/CEP are
+    # model-agnostic and cover them (reference deep/analysis.py computes
+    # exactly the PDP set for its NN models).
+    struct = structure_importances(model) if hasattr(model, "forest") else {}
     # RF models trained with compute_oob_variable_importances carry
     # precomputed OOB permutation importances (random_forest.cc:981).
     oob_vi = getattr(model, "oob_variable_importances", None)
@@ -183,7 +186,7 @@ def analyze(
             for f in top
         ]
     return Analysis(
-        model_type=model.model_type,
+        model_type=getattr(model, "model_type", type(model).__name__),
         task=model.task.value,
         permutation_importances=perm,
         structure_importances=struct,

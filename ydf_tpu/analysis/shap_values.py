@@ -88,8 +88,25 @@ def _unwind(p: _Path, i: int) -> None:
     p.len -= 1
 
 
+def _oblique_value(tree, proj: int, x_num) -> float:
+    """Projected value dot(x_num, w_proj), mirroring routing.py's
+    evaluation exactly: missing attributes inside the projection use
+    their stored na_replacement when present; a NaN on a nonzero-weight
+    attribute WITHOUT a replacement propagates through the dot (the
+    caller then routes via na_left, decision_tree.proto Oblique
+    semantics). Zero-weight features never poison the dot."""
+    w = np.asarray(tree["oblique_weights"][proj], np.float64)
+    x = np.asarray(x_num, np.float64)
+    repl = tree.get("oblique_na_repl")
+    if repl is not None:
+        r = np.asarray(repl[proj], np.float64)
+        x = np.where(np.isnan(x) & ~np.isnan(r), r, x)
+    return float(np.dot(np.where(w != 0, x, 0.0), w))
+
+
 def _go_left(tree, nid: int, x_num, x_cat, num_numerical: int,
-             na_left, x_set=None, set_missing=None) -> bool:
+             na_left, x_set=None, set_missing=None, num_real: int = None,
+             ) -> bool:
     f = int(tree["feature"][nid])
     if tree["is_set"][nid]:
         # Contains condition: set ∩ selected-items mask ≠ ∅ → RIGHT.
@@ -109,6 +126,12 @@ def _go_left(tree, nid: int, x_num, x_cat, num_numerical: int,
             return bool(na_left[nid])
         word = tree["cat_mask"][nid][c >> 5]
         return bool((int(word) >> (c & 31)) & 1)
+    if num_real is not None and f >= num_real:
+        # Oblique node: projection id = f - num_real (Forest convention).
+        v = _oblique_value(tree, f - num_real, x_num)
+        if np.isnan(v):
+            return bool(na_left[nid])
+        return v < float(tree["threshold"][nid])
     v = float(x_num[f]) if f < num_numerical else 0.0
     if np.isnan(v):
         return bool(na_left[nid])
@@ -127,6 +150,19 @@ def _shap_one_tree(
 ) -> None:
     V = tree["leaf_value"].shape[-1]
     max_depth_cap = 128
+    num_real = phi.shape[0]  # real feature count; >= is a projection id
+
+    # Per-tree precomputation, hoisted out of the recursion: the
+    # projection's first involved attribute gathers the attribution —
+    # the reference's convention (utils/shap.cc:248-250).
+    ow = tree.get("oblique_weights")
+    if ow is not None and np.size(ow):
+        nz_mask = np.asarray(ow) != 0
+        proj_first = np.where(
+            nz_mask.any(axis=1), nz_mask.argmax(axis=1), 0
+        ).astype(np.int64)
+    else:
+        proj_first = None
 
     def recurse(nid: int, p: _Path, pz: float, po: float, pi: int):
         p = p.copy()
@@ -138,10 +174,11 @@ def _shap_one_tree(
                 phi[p.d[i]] += w * (p.o[i] - p.z[i]) * leaf
             return
         f = int(tree["feature"][nid])
+        f_path = int(proj_first[f - num_real]) if f >= num_real else f
         left, right = int(tree["left"][nid]), int(tree["right"][nid])
         goes_left = _go_left(
             tree, nid, x_num, x_cat, num_numerical, tree["na_left"],
-            x_set=x_set, set_missing=set_missing,
+            x_set=x_set, set_missing=set_missing, num_real=num_real,
         )
         hot, cold = (left, right) if goes_left else (right, left)
         cover = max(float(tree["cover"][nid]), 1e-9)
@@ -150,14 +187,14 @@ def _shap_one_tree(
         iz, io = 1.0, 1.0
         k = -1
         for j in range(1, p.len):
-            if p.d[j] == f:
+            if p.d[j] == f_path:
                 k = j
                 break
         if k >= 0:
             iz, io = p.z[k], p.o[k]
             _unwind(p, k)
-        recurse(hot, p, iz * hot_frac, io, f)
-        recurse(cold, p, iz * cold_frac, 0.0, f)
+        recurse(hot, p, iz * hot_frac, io, f_path)
+        recurse(cold, p, iz * cold_frac, 0.0, f_path)
 
     root_path = _Path(max_depth_cap + 2)
     recurse(0, root_path, 1.0, 1.0, -1)
@@ -178,10 +215,6 @@ def tree_shap(
     V = 1 for regression / binary GBT, num_classes for RF classification /
     multiclass GBT.
     """
-    if int(np.prod(model.forest.oblique_weights.shape[1:])) > 0:
-        raise NotImplementedError(
-            "TreeSHAP over oblique splits is not supported yet"
-        )
     if int(np.prod(model.forest.vs_anchor.shape[1:])) > 0:
         raise NotImplementedError(
             "TreeSHAP over vector-sequence splits is not supported yet"
@@ -236,6 +269,16 @@ def tree_shap(
     trees = [
         {k: forest[k][t] for k in forest if k != "num_nodes"} for t in range(T)
     ]
+    for d in trees:
+        # float64 once per tree — _oblique_value's asarray calls become
+        # no-ops in the per-node walk.
+        if np.size(d.get("oblique_weights", ())):
+            d["oblique_weights"] = np.asarray(
+                d["oblique_weights"], np.float64
+            )
+            d["oblique_na_repl"] = np.asarray(
+                d["oblique_na_repl"], np.float64
+            )
     for i in range(n):
         for t in range(T):
             out = phi[i, :, tree_dim[t] : tree_dim[t] + 1] if multi_gbt else phi[i]

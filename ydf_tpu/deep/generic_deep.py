@@ -95,8 +95,6 @@ class DeepPreprocessor:
 class GenericDeepModel:
     """A trained deep model: flax module + params + preprocessor."""
 
-    model_type = "DEEP"
-
     def __init__(
         self,
         task: Task,
@@ -175,14 +173,26 @@ class GenericDeepModel:
             self.task, labels, self.predict(data), classes=self.classes
         )
 
+    @property
+    def model_type(self) -> str:
+        return self.config.get("architecture", "DEEP")
+
     def describe(self) -> str:
         return (
-            f'Type: "{self.config.get("architecture", "DEEP")}"\n'
+            f'Type: "{self.model_type}"\n'
             f"Task: {self.task.value}\n"
             f'Label: "{self.label}"\n'
             f"Input features: {self.input_feature_names()}\n"
             f"Config: {self.config}"
         )
+
+    def analyze(self, data: InputData, **kwargs):
+        """Model-agnostic analysis — permutation importances + PDP/CEP
+        curves over the NN forward pass (the reference computes its NN
+        PDPs the same way, deep/analysis.py)."""
+        from ydf_tpu.analysis.analysis import analyze as _analyze
+
+        return _analyze(self, data, **kwargs)
 
     # -------------------------------------------------------------- #
 

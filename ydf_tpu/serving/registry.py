@@ -87,11 +87,13 @@ def _scalar_sum_forest(model) -> bool:
     conditions, encode-time imputation."""
     import numpy as np
 
+    # Geometry of the CURRENT forest, not the model class: multiclass GBT
+    # predict temporarily swaps per-class single-output sub-forests in and
+    # serves each through the fast engine.
     return (
         getattr(model.binner, "num_set", 0) == 0
         and np.size(getattr(model.forest, "vs_anchor", np.zeros(0))) == 0
         and not getattr(model, "native_missing", False)
-        and getattr(model, "num_trees_per_iter", 1) == 1
         and int(model.forest.leaf_value.shape[-1]) == 1
     )
 
