@@ -322,6 +322,18 @@ def run_bench(backend, rows, trees, depth, features, with_baseline, probe_log):
     record.setdefault("vs_baseline", record["vs_ydf64_estimate"])
     global _PARTIAL
     _PARTIAL = dict(record)
+    try:
+        # Batched inference throughput on the same model (reference
+        # benchmark_inference.cc's ns/example) — any backend; reuses the
+        # warmup + best-of-runs measurement in model.benchmark().
+        n_inf = min(rows, 100_000)
+        sample = {k: v[:n_inf] for k, v in data.items()}
+        record["infer_ns_per_example"] = round(
+            model.benchmark(sample, num_runs=3)["ns_per_example"], 1
+        )
+        _PARTIAL = dict(record)
+    except Exception as e:
+        record["infer_extra_error"] = f"{type(e).__name__}: {e}"
     if backend not in ("cpu",):
         hardware_extras(model, data, record)
     return record, model

@@ -174,7 +174,14 @@ class Dataset:
         elif isinstance(data, dict):
             cols = {k: _column_array(v) for k, v in data.items()}
         else:
-            raise TypeError(f"Unsupported dataset type: {type(data)}")
+            from ydf_tpu.dataset import grain_io
+
+            if grain_io.is_grain(data):
+                # PyGrain DataLoader / MapDataset / IterDataset of
+                # per-example dicts (reference dataset/io/pygrain_io.py).
+                cols = grain_io.to_columns(data)
+            else:
+                raise TypeError(f"Unsupported dataset type: {type(data)}")
 
         if dataspec is None:
             dataspec = infer_dataspec(
