@@ -87,3 +87,24 @@ def test_negative_int64_roundtrip(tmp_path):
     tfr.write_tfrecord_columns(p, cols)
     back = tfr.read_tfrecord_columns([p])
     np.testing.assert_allclose(back["v"], [-1, 2, -300])
+
+
+def test_predict_tf_examples_serving_adapter(tmp_path, adult_train):
+    """Serving-side tf.Example adapter (reference serving/tf_example.h):
+    serialized protos score identically to the equivalent DataFrame."""
+    import ydf_tpu as ydf
+
+    head = adult_train.head(200)
+    m = ydf.GradientBoostedTreesLearner(
+        label="income", num_trees=5, max_depth=4, validation_ratio=0.0,
+        early_stopping="NONE",
+    ).train(adult_train.head(2000))
+    p = tmp_path / "serve.tfrecord"
+    tfr.write_tfrecord_columns(
+        str(p), {c: head[c].to_numpy() for c in head.columns}
+    )
+    serialized = list(tfr.iter_records(str(p)))
+    assert len(serialized) == 200
+    got = m.predict_tf_examples(serialized)
+    want = m.predict(head)
+    np.testing.assert_allclose(got, want, atol=1e-6)

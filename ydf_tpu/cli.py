@@ -19,12 +19,14 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
 
 def _force_cpu_if_requested(args):
     if getattr(args, "cpu", False):
+        os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -331,9 +333,33 @@ def cmd_distribute(args):
         sys.exit(1)
 
 
+def cmd_worker(args):
+    """Remote train/evaluate worker (reference ydf.start_worker /
+    generic_worker.h): serves HyperParameterOptimizerLearner(workers=...)
+    trial requests until shut down. The transport executes requests from
+    the manager (like the reference's distribute workers), so bind
+    beyond loopback (--host 0.0.0.0) only on trusted job networks."""
+    _force_cpu_if_requested(args)
+    from ydf_tpu.parallel.worker_service import start_worker
+
+    print(f"worker listening on {args.host}:{args.port}", flush=True)
+    start_worker(args.port, host=args.host)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="ydf_tpu", description=__doc__)
     sub = ap.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser(
+        "worker",
+        help="serve remote train/evaluate requests for distributed "
+             "hyperparameter tuning (reference ydf.start_worker)",
+    )
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address; 0.0.0.0 only on trusted networks")
+    p.add_argument("--cpu", action="store_true")
+    p.set_defaults(fn=cmd_worker)
 
     p = sub.add_parser(
         "distribute",

@@ -155,17 +155,24 @@ def _repeated_bytes(msg: pw.Message, field: int) -> List[bytes]:
 def read_tfrecord_columns(files: List[str]) -> Dict[str, np.ndarray]:
     """Sharded TFRecord files → columnar dict (row-wise Examples are
     transposed into columns, the reference's example-reader role)."""
+    records = (rec for path in files for rec in iter_records(path))
+    return tf_examples_to_columns(records)
+
+
+def tf_examples_to_columns(serialized) -> Dict[str, np.ndarray]:
+    """Serialized tf.Example protos → columnar dict. Also the serving
+    adapter's parser (reference serving/tf_example.{h,cc}: feed
+    tf.Examples straight to the engines)."""
     rows: List[Dict[str, list]] = []
     keys: List[str] = []
     seen = set()
-    for path in files:
-        for rec in iter_records(path):
-            ex = _parse_example(rec)
-            rows.append(ex)
-            for k in ex:
-                if k not in seen:
-                    seen.add(k)
-                    keys.append(k)
+    for rec in serialized:
+        ex = _parse_example(rec)
+        rows.append(ex)
+        for k in ex:
+            if k not in seen:
+                seen.add(k)
+                keys.append(k)
     n = len(rows)
     cols: Dict[str, np.ndarray] = {}
     for k in keys:

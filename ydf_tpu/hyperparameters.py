@@ -42,6 +42,7 @@ _CONFIG_PARAMS = {
     "column_types", "working_dir", "resume_training",
     "resume_training_snapshot_interval_trees", "mesh", "random_seed",
     "base_learner", "search_space", "tuner", "monotonic_constraints",
+    "workers", "worker_timeout_s",
 }
 
 

@@ -56,10 +56,20 @@ class HyperParameterOptimizerLearner(HyperparameterValidationMixin):
         holdout_ratio: float = 0.2,
         cross_validation_folds: int = 0,
         parallel_trials: int = 0,  # 0 = one per visible device
+        workers: Optional[List[str]] = None,
+        worker_timeout_s: float = 3600.0,
         random_seed: int = 1234,
     ):
         if tuner is not None and search_space is not None:
             raise ValueError("Pass either tuner= or search_space=, not both")
+        # Remote trial execution (reference GenericWorker + the PYDF
+        # `workers=` deployment API): "host:port" addresses of
+        # `ydf_tpu.cli worker` processes; trials fan out round-robin and
+        # the winner is identical to a local run (fixed trial list).
+        # worker_timeout_s bounds one remote trial (connection + train +
+        # evaluate); raise it for long-training candidates.
+        self.workers = list(workers) if workers else None
+        self.worker_timeout_s = worker_timeout_s
         self.base_learner = base_learner
         self.tuner = tuner
         self.search_space = search_space
@@ -96,6 +106,14 @@ class HyperParameterOptimizerLearner(HyperparameterValidationMixin):
                 "cross_validation_folds scores trials by k-fold CV over "
                 "`data`; a `valid` dataset would be silently ignored for "
                 "trial scoring — pass one or the other"
+            )
+        if self.workers and self.cross_validation_folds >= 2:
+            # Checked at train() time (attributes are mutable after
+            # construction): the remote path scores on the shared
+            # holdout (the reference's self-evaluation mode).
+            raise ValueError(
+                "workers= scores trials on the shared holdout; use local "
+                "execution for cross-validation scoring"
             )
         space = self._space()
         trials = draw_trials(space, self.num_trials, self.random_seed)
@@ -140,11 +158,40 @@ class HyperParameterOptimizerLearner(HyperparameterValidationMixin):
             metric, value, sign = _primary_metric(model, ev)
             return float(sign * value)
 
+        wpool = None
+        data_key = None
+        if self.workers:
+            from ydf_tpu.parallel.worker_service import WorkerPool
+
+            wpool = WorkerPool(
+                self.workers, timeout_s=self.worker_timeout_s
+            )
+            wpool.ping_all()
+            # Ship the dataset pair to every worker ONCE; trials then
+            # reference it by key (no per-trial re-pickling).
+            data_key = f"hpo-{self.random_seed}-{id(self)}"
+            wpool.load_data_all(data_key, train_data, hold_data)
+            workers = min(len(self.workers), len(trials))
+
         def run_trial(i_params):
             i, params = i_params
             cand = copy.copy(self.base_learner)
             for k, v in params.items():
                 setattr(cand, k, v)
+            if wpool is not None:
+                # Remote execution: the worker trains the candidate and
+                # returns the signed primary-metric score (reference
+                # GenericWorker TrainModel+EvaluateModel).
+                resp = wpool.request(i, {
+                    "verb": "train_score",
+                    "learner": cand,
+                    "data_key": data_key,
+                })
+                if not resp.get("ok"):
+                    raise RuntimeError(
+                        f"remote trial {i} failed: {resp.get('error')}"
+                    )
+                return TrialLog(params=params, score=resp["score"])
             # Round-robin device placement: trial i trains on device
             # i mod n — the reference's trainer-pool fan-out
             # (hyperparameters_optimizer.cc trial dispatch), with chips

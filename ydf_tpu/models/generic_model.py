@@ -635,6 +635,16 @@ class GenericModel:
     def predict(self, data: InputData) -> np.ndarray:
         raise NotImplementedError
 
+    def predict_tf_examples(self, serialized) -> np.ndarray:
+        """Scores a sequence of serialized tf.Example protos — the
+        reference's tf.Example serving adapter (serving/tf_example.h:
+        feed tf.Examples straight to the engines) over the in-repo wire
+        codec, no TensorFlow dependency."""
+        from ydf_tpu.dataset.tfrecord import tf_examples_to_columns
+
+        cols = tf_examples_to_columns(serialized)
+        return self.predict(Dataset.from_data(cols, dataspec=self.dataspec))
+
     def predict_example(self, example: dict):
         """Scores ONE {column: value} row — the reference's
         single-example Predict overload (abstract_model.h:500-516) over

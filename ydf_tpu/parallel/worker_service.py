@@ -1,0 +1,192 @@
+"""Remote train/evaluate worker service.
+
+Counterpart of the reference's GenericWorker
+(`ydf/learner/generic_worker/generic_worker.h:15-55`: a distribute worker
+that executes TrainModel / EvaluateModel requests remotely, used by
+distributed hyperparameter tuning) and the PYDF `ydf.start_worker(port)`
+entry point (`port/python/ydf/learner/worker.py:22-51`).
+
+Design. Where the reference runs a gRPC server speaking the distribute
+protocol, the TPU build needs exactly one remote verb — "train this
+candidate on this data and return its validation score" — so the service
+is a length-prefixed-pickle request/response loop over a TCP socket: a
+dozen lines of protocol instead of a protocol stack. Like the
+reference's distribute layer, the transport assumes a TRUSTED network
+(the reference workers execute arbitrary training requests from their
+manager too); do not expose the port beyond the job's hosts.
+
+    # on each worker host / process
+    python -m ydf_tpu.cli worker --port 9900
+
+    # on the manager
+    HyperParameterOptimizerLearner(..., workers=["host:9900", ...])
+
+Trial results are deterministic regardless of placement: the trial list
+is drawn up-front and each trial's score is a pure function of
+(learner config, data, seed), so the remote winner equals the local
+winner.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def _send_msg(sock: socket.socket, obj: Any) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+# Worker-side dataset cache: load_data ships the (train, holdout) pair
+# ONCE per tuning run; every trial request then carries only the learner
+# config + the data key — the reference workers keep their dataset cache
+# resident across requests the same way (dataset_cache_reader.cc).
+_DATA_CACHE: Dict[str, Tuple[Any, Any]] = {}
+_DATA_CACHE_CAP = 4
+
+
+def _handle_request(req: Dict[str, Any]) -> Dict[str, Any]:
+    """Executes one request. Verbs: ping; load_data (cache a
+    train/holdout pair under a key); train_score (train a learner,
+    evaluate on the holdout, return the signed primary-metric score —
+    the reference GenericWorker's TrainModel+EvaluateModel fused; data
+    comes from the cache via data_key, or inline); shutdown."""
+    verb = req.get("verb")
+    if verb == "ping":
+        return {"ok": True}
+    if verb == "load_data":
+        if len(_DATA_CACHE) >= _DATA_CACHE_CAP:
+            _DATA_CACHE.pop(next(iter(_DATA_CACHE)))
+        _DATA_CACHE[req["key"]] = (req["train_data"], req["holdout_data"])
+        return {"ok": True}
+    if verb == "train_score":
+        from ydf_tpu.analysis.importance import _primary_metric
+
+        if "data_key" in req:
+            if req["data_key"] not in _DATA_CACHE:
+                return {
+                    "ok": False,
+                    "error": f"unknown data_key {req['data_key']!r} "
+                    "(worker restarted? resend load_data)",
+                    "need_data": True,
+                }
+            train_data, holdout_data = _DATA_CACHE[req["data_key"]]
+        else:
+            train_data, holdout_data = req["train_data"], req["holdout_data"]
+        learner = req["learner"]
+        model = learner.train(train_data)
+        ev = model.evaluate(holdout_data)
+        metric, value, sign = _primary_metric(model, ev)
+        return {"ok": True, "score": float(sign * value), "metric": metric}
+    if verb == "shutdown":
+        return {"ok": True, "shutdown": True}
+    return {"ok": False, "error": f"unknown verb {verb!r}"}
+
+
+def start_worker(
+    port: int, host: str = "127.0.0.1", blocking: bool = True
+) -> Optional[threading.Thread]:
+    """Serves train/evaluate requests until a shutdown request arrives
+    (reference ydf.start_worker). blocking=False runs the accept loop in
+    a daemon thread and returns it (for tests)."""
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind((host, port))
+    srv.listen(16)
+
+    def loop():
+        stop = False
+        while not stop:
+            conn, _ = srv.accept()
+            try:
+                req = _recv_msg(conn)
+                try:
+                    resp = _handle_request(req)
+                except Exception as e:  # worker stays alive on task errors
+                    resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+                _send_msg(conn, resp)
+                stop = bool(resp.get("shutdown"))
+            except Exception:
+                pass  # malformed/broken connection: keep serving
+            finally:
+                conn.close()
+        srv.close()
+
+    if blocking:
+        loop()
+        return None
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    return t
+
+
+class WorkerPool:
+    """Round-robin client over worker addresses ("host:port"). One
+    request per connection — the simplest protocol that is also robust
+    to worker restarts between trials (the reference re-instantiates
+    workers across manager restarts the same way, distribute.h:52-66)."""
+
+    def __init__(self, addresses: List[str], timeout_s: float = 3600.0):
+        if not addresses:
+            raise ValueError("empty worker address list")
+        self.addresses: List[Tuple[str, int]] = []
+        for a in addresses:
+            host, _, port = a.rpartition(":")
+            self.addresses.append((host or "127.0.0.1", int(port)))
+        self.timeout_s = timeout_s
+
+    def request(self, i: int, req: Dict[str, Any]) -> Dict[str, Any]:
+        host, port = self.addresses[i % len(self.addresses)]
+        with socket.create_connection(
+            (host, port), timeout=self.timeout_s
+        ) as sock:
+            _send_msg(sock, req)
+            return _recv_msg(sock)
+
+    def ping_all(self) -> None:
+        for i in range(len(self.addresses)):
+            resp = self.request(i, {"verb": "ping"})
+            if not resp.get("ok"):
+                raise ConnectionError(
+                    f"worker {self.addresses[i]} failed ping: {resp}"
+                )
+
+    def load_data_all(self, key: str, train_data, holdout_data) -> None:
+        """Ships the dataset pair to every worker ONCE; trial requests
+        then reference it by key instead of re-pickling gigabytes per
+        trial."""
+        for i in range(len(self.addresses)):
+            resp = self.request(i, {
+                "verb": "load_data", "key": key,
+                "train_data": train_data, "holdout_data": holdout_data,
+            })
+            if not resp.get("ok"):
+                raise ConnectionError(
+                    f"worker {self.addresses[i]} failed load_data: {resp}"
+                )
+
+    def shutdown_all(self) -> None:
+        for i in range(len(self.addresses)):
+            try:
+                self.request(i, {"verb": "shutdown"})
+            except Exception:
+                pass
