@@ -9,10 +9,13 @@ backend — the DCN path's wire protocol on localhost), training the SAME
 GBT through the unchanged learner code with the mesh spanning both
 processes."""
 
+import os
 import socket
 import subprocess
 import sys
 import textwrap
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 import pytest
@@ -32,7 +35,6 @@ _WORKER_SRC = textwrap.dedent(
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
     jax.config.update("jax_platforms", "cpu")
-    sys.path.insert(0, "/root/repo")
     import numpy as np
     from ydf_tpu.parallel.mesh import init_distributed, make_mesh
 
@@ -68,8 +70,9 @@ def test_two_process_training():
         f.write(_WORKER_SRC)
     env = {
         "PATH": "/usr/bin:/bin",
-        "HOME": "/root",
+        "HOME": os.environ.get("HOME", "/root"),
         "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO_ROOT,
         "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
     }
     procs = [

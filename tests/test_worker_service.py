@@ -129,3 +129,63 @@ def test_cli_worker_subprocess():
     finally:
         if proc.poll() is None:
             proc.kill()
+
+
+def test_tuning_survives_dead_worker():
+    """Fault tolerance (reference distribute semantics: the manager runs
+    with the workers it has): one of two workers is dead from the start
+    — it is pruned at ping time, trials run on the live one, and the
+    winner matches a local run."""
+    data = _data()
+    live = _free_port()
+    dead = _free_port()  # nothing listens here
+    start_worker(live, host="127.0.0.1", blocking=False)
+
+    remote = _make_opt(workers=[f"127.0.0.1:{dead}", f"127.0.0.1:{live}"])
+    remote.worker_timeout_s = 30.0
+    m_remote = remote.train(data)
+
+    local = _make_opt()
+    local.parallel_trials = 1
+    m_local = local.train(data)
+    assert (
+        m_local.extra_metadata["tuner_logs"]["best_params"]
+        == m_remote.extra_metadata["tuner_logs"]["best_params"]
+    )
+    WorkerPool([f"127.0.0.1:{live}"]).shutdown_all()
+
+
+def test_trial_retry_after_worker_cache_loss(monkeypatch):
+    """A worker that lost its dataset cache (restart) answers need_data;
+    the optimizer's retry branch re-ships the data and the trial still
+    succeeds — exercised END TO END by making the initial preload a
+    no-op (equivalent to the worker restarting right after it)."""
+    from ydf_tpu.parallel.worker_service import WorkerPool as _WP
+
+    port = _free_port()
+    start_worker(port, host="127.0.0.1", blocking=False)
+    addr = f"127.0.0.1:{port}"
+    # The raw protocol: unknown key → need_data.
+    pool = WorkerPool([addr])
+    resp = pool.request(0, {
+        "verb": "train_score",
+        "learner": _make_opt().base_learner,
+        "data_key": "never-loaded",
+    })
+    assert not resp["ok"] and resp.get("need_data")
+
+    # End to end: the preload "vanishes" (worker restarted), every trial
+    # hits need_data, and the re-ship branch recovers.
+    monkeypatch.setattr(_WP, "load_data_all", lambda *a, **k: None)
+    data = _data(300)
+    opt = _make_opt(workers=[addr])
+    m = opt.train(data)
+    assert "best_params" in m.extra_metadata["tuner_logs"]
+    local = _make_opt()
+    local.parallel_trials = 1
+    m_local = local.train(data)
+    assert (
+        m.extra_metadata["tuner_logs"]["best_params"]
+        == m_local.extra_metadata["tuner_logs"]["best_params"]
+    )
+    pool.shutdown_all()

@@ -166,12 +166,16 @@ class HyperParameterOptimizerLearner(HyperparameterValidationMixin):
             wpool = WorkerPool(
                 self.workers, timeout_s=self.worker_timeout_s
             )
-            wpool.ping_all()
+            # Dead workers are pruned from the rotation up front
+            # (reference distribute: the manager runs with the workers
+            # it has); raises only when none answer.
+            wpool.ping_all(drop_unreachable=True)
             # Ship the dataset pair to every worker ONCE; trials then
             # reference it by key (no per-trial re-pickling).
             data_key = f"hpo-{self.random_seed}-{id(self)}"
             wpool.load_data_all(data_key, train_data, hold_data)
-            workers = min(len(self.workers), len(trials))
+            # Fan-out sized to the LIVE worker count post-pruning.
+            workers = min(len(wpool.addresses), len(trials))
 
         def run_trial(i_params):
             i, params = i_params
@@ -181,17 +185,56 @@ class HyperParameterOptimizerLearner(HyperparameterValidationMixin):
             if wpool is not None:
                 # Remote execution: the worker trains the candidate and
                 # returns the signed primary-metric score (reference
-                # GenericWorker TrainModel+EvaluateModel).
-                resp = wpool.request(i, {
-                    "verb": "train_score",
-                    "learner": cand,
-                    "data_key": data_key,
-                })
-                if not resp.get("ok"):
-                    raise RuntimeError(
-                        f"remote trial {i} failed: {resp.get('error')}"
-                    )
-                return TrialLog(params=params, score=resp["score"])
+                # GenericWorker TrainModel+EvaluateModel). Fault
+                # tolerance mirrors the reference's distribute semantics
+                # (errors return to the manager, the run continues): a
+                # failed/unreachable worker is skipped and the trial
+                # retries on the next one; a restarted worker that lost
+                # its dataset cache gets it re-shipped.
+                last_err = None
+                for attempt in range(len(wpool.addresses)):
+                    w = i + attempt
+                    try:
+                        resp = wpool.request(w, {
+                            "verb": "train_score",
+                            "learner": cand,
+                            "data_key": data_key,
+                        })
+                        if resp.get("need_data"):
+                            reload_resp = wpool.request(w, {
+                                "verb": "load_data", "key": data_key,
+                                "train_data": train_data,
+                                "holdout_data": hold_data,
+                            })
+                            if not reload_resp.get("ok"):
+                                # Worker can't take the data — a worker
+                                # problem, not a task error: fail over.
+                                last_err = RuntimeError(
+                                    f"load_data failed: {reload_resp}"
+                                )
+                                continue
+                            resp = wpool.request(w, {
+                                "verb": "train_score",
+                                "learner": cand,
+                                "data_key": data_key,
+                            })
+                        if resp.get("ok"):
+                            return TrialLog(
+                                params=params, score=resp["score"]
+                            )
+                        # Task error (bad config): deterministic — no
+                        # point retrying elsewhere.
+                        raise RuntimeError(
+                            f"remote trial {i} failed: "
+                            f"{resp.get('error')}"
+                        )
+                    except (OSError, ConnectionError) as e:
+                        last_err = e
+                        continue
+                raise RuntimeError(
+                    f"remote trial {i}: no reachable worker "
+                    f"(last error: {last_err})"
+                )
             # Round-robin device placement: trial i trains on device
             # i mod n — the reference's trainer-pool fan-out
             # (hyperparameters_optimizer.cc trial dispatch), with chips

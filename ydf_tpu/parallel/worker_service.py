@@ -154,21 +154,49 @@ class WorkerPool:
             self.addresses.append((host or "127.0.0.1", int(port)))
         self.timeout_s = timeout_s
 
-    def request(self, i: int, req: Dict[str, Any]) -> Dict[str, Any]:
+    def request(
+        self, i: int, req: Dict[str, Any],
+        timeout_s: Optional[float] = None,
+    ) -> Dict[str, Any]:
         host, port = self.addresses[i % len(self.addresses)]
         with socket.create_connection(
-            (host, port), timeout=self.timeout_s
+            (host, port), timeout=timeout_s or self.timeout_s
         ) as sock:
             _send_msg(sock, req)
             return _recv_msg(sock)
 
-    def ping_all(self) -> None:
-        for i in range(len(self.addresses)):
-            resp = self.request(i, {"verb": "ping"})
-            if not resp.get("ok"):
-                raise ConnectionError(
-                    f"worker {self.addresses[i]} failed ping: {resp}"
+    def ping_all(self, drop_unreachable: bool = False) -> None:
+        """Health check. drop_unreachable=True prunes dead addresses
+        from the rotation instead of raising (the manager keeps going
+        with the workers it has — reference distribute semantics);
+        raises only when NO worker answers."""
+        alive = []
+        errors = []
+        for i, addr in enumerate(self.addresses):
+            try:
+                # Health checks use a short timeout — a blackholed host
+                # must not stall startup for the full job timeout.
+                resp = self.request(
+                    i, {"verb": "ping"},
+                    timeout_s=min(10.0, self.timeout_s),
                 )
+                if resp.get("ok"):
+                    alive.append(addr)
+                else:
+                    errors.append((addr, str(resp)))
+            except OSError as e:
+                errors.append((addr, f"{type(e).__name__}: {e}"))
+        if not drop_unreachable and errors:
+            raise ConnectionError(f"workers failed ping: {errors}")
+        if not alive:
+            raise ConnectionError(f"no reachable workers: {errors}")
+        if errors:
+            import warnings
+
+            warnings.warn(
+                f"dropping unreachable workers: {errors}", stacklevel=2
+            )
+        self.addresses = alive
 
     def load_data_all(self, key: str, train_data, holdout_data) -> None:
         """Ships the dataset pair to every worker ONCE; trial requests
