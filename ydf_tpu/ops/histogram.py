@@ -24,6 +24,20 @@ Two implementations:
 
 Inactive examples carry slot == L (one past the last frontier slot) and fall
 into a trash row that is dropped.
+
+Design note — why no sibling-subtraction trick. CPU histogram GBTs
+(sklearn/LightGBM, and the reference's per-node splitters) halve their
+per-level work by building each level's histograms only over the SMALLER
+child of every split and deriving the sibling as parent − child. That
+trick pays only when the builder iterates a per-node example-index list
+(work ∝ examples visited). Both implementations here are dense over the
+full example axis — segment_sum scatters all n rows, the one-hot matmul
+contracts all n rows — so masking out the larger children would not
+remove any work, and compacting them away would need data-dependent
+shapes that XLA cannot tile onto the MXU. The dense O(n)-per-layer
+formulation is the deliberate TPU trade: it costs ~2× the arithmetic of
+subtraction-tricked CPU code and buys a single fused contraction that
+batches over (nodes × features × bins) with no host round-trips.
 """
 
 from __future__ import annotations
