@@ -104,3 +104,21 @@ def test_cart_adult_accuracy(adult_train, adult_test):
     acc = m.evaluate(adult_test).accuracy
     assert acc > 0.82, acc
     assert m.extra_metadata["num_pruned_nodes"] > 0
+
+
+def test_cart_sparse_oblique():
+    """CART inherits the RF sparse-oblique path (reference: CART accepts
+    the shared decision-tree config incl. oblique, cart.cc)."""
+    import numpy as np
+
+    rng = np.random.RandomState(3)
+    n = 2500
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    y = ((x1 + x2) > 0).astype(np.int64)
+    data = {"x1": x1, "x2": x2, "y": y}
+    m = ydf.CartLearner(
+        label="y", max_depth=4, split_axis="SPARSE_OBLIQUE",
+        sparse_oblique_num_projections_exponent=2.0,
+    ).train(data)
+    assert np.asarray(m.forest.oblique_weights).size > 0
+    assert m.evaluate(data).accuracy > 0.93
