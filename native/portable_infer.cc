@@ -211,6 +211,11 @@ void RouteTree(const Model& m, uint32_t t, const float* x_num,
         // (encode-time convention of the TPU learners); otherwise the
         // node's learned na_left direction applies.
         if (m.impute_missing) c = 0; else missing = true;
+      } else if ((uint32_t)c >= m.mask_words * 32u) {
+        // Caller-supplied code beyond the mask width (stale vocabulary,
+        // foreign encoding): treat as OOV like ydf_model_cat_index does,
+        // never read past the mask bank.
+        c = 0;
       }
       go_left =
           !missing &&

@@ -117,3 +117,31 @@ def test_portable_cat_index(tmp_path, adult_train):
         assert pm.cat_index(0, str(item)) == idx
     assert pm.cat_index(0, "definitely-not-a-vocab-item") == 0
     pm.close()
+
+
+def test_portable_out_of_range_categorical_code(tmp_path, adult_train):
+    """A caller-supplied categorical code past the mask bank (stale
+    vocabulary / foreign encoding) is clamped to OOV instead of reading
+    out of bounds — and predicts the same as code 0 (advisor r3)."""
+    m = ydf.GradientBoostedTreesLearner(
+        label="income", num_trees=5, max_depth=5, validation_ratio=0.0,
+        early_stopping="NONE",
+    ).train(adult_train.head(2000))
+    head = adult_train.head(64)
+    path = str(tmp_path / "model.ydftpu")
+    write_portable(m, path)
+    pm = portable_runtime.PortableModel(path)
+    ds = Dataset.from_data(head, dataspec=m.dataspec)
+    x_num, x_cat, _ = m._encode_inputs(ds)
+    x_cat = np.asarray(x_cat).copy()
+    if x_cat.size == 0:
+        pm.close()
+        pytest.skip("no categorical features")
+    oov = x_cat.copy()
+    oov[:] = 0
+    want = pm.predict(x_num, oov)
+    huge = x_cat.copy()
+    huge[:] = 2**30  # far past any mask bank
+    got = pm.predict(x_num, huge)
+    pm.close()
+    np.testing.assert_allclose(got, want, atol=0)

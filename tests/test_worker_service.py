@@ -189,3 +189,40 @@ def test_trial_retry_after_worker_cache_loss(monkeypatch):
         == m_local.extra_metadata["tuner_logs"]["best_params"]
     )
     pool.shutdown_all()
+
+
+def test_hmac_auth_refuses_wrong_or_missing_secret():
+    """When the worker holds a shared secret, connections with the wrong
+    secret or none at all are dropped without executing anything; a
+    matching secret works end to end (counterpart of the reference gRPC
+    backend's TLS option, grpc.proto)."""
+    port = _free_port()
+    start_worker(port, host="127.0.0.1", blocking=False, secret=b"s3cret")
+
+    # Matching secret: full round trip.
+    good = WorkerPool([f"127.0.0.1:{port}"], timeout_s=10.0, secret=b"s3cret")
+    assert good.request(0, {"verb": "ping"})["ok"]
+
+    # Wrong secret: worker drops the connection (no response frame) AND
+    # even a response would fail the client's own verification.
+    bad = WorkerPool([f"127.0.0.1:{port}"], timeout_s=5.0, secret=b"wrong")
+    with pytest.raises((OSError, ConnectionError)):
+        bad.request(0, {"verb": "ping"})
+
+    # No secret at all: also refused.
+    anon = WorkerPool([f"127.0.0.1:{port}"], timeout_s=5.0, secret=b"")
+    anon.secret = None  # defeat the env fallback explicitly
+    with pytest.raises((OSError, ConnectionError)):
+        anon.request(0, {"verb": "ping"})
+
+    good.shutdown_all()
+
+
+def test_hmac_auth_env_var(monkeypatch):
+    """YDF_TPU_WORKER_SECRET wires both sides without code changes."""
+    monkeypatch.setenv("YDF_TPU_WORKER_SECRET", "env-secret")
+    port = _free_port()
+    start_worker(port, host="127.0.0.1", blocking=False)
+    pool = WorkerPool([f"127.0.0.1:{port}"], timeout_s=10.0)
+    assert pool.request(0, {"verb": "ping"})["ok"]
+    pool.shutdown_all()

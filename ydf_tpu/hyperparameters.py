@@ -33,6 +33,8 @@ import difflib
 import inspect
 from typing import Any, Dict, List, Optional, Tuple, Type
 
+import numpy as np
+
 #: Parameters that identify dataset columns or non-tunable plumbing —
 #: real constructor arguments, but not "hyperparameters" in the
 #: reference's sense (they appear in the spec with kind="config").
@@ -393,13 +395,18 @@ def _check_value(hp: HyperParameter, value: Any, cls_name: str) -> None:
             )
         return
     if hp.type in ("int", "float"):
-        if isinstance(value, bool) or not isinstance(value, (int, float)):
+        # numpy scalars are everyday inputs (np.int64 from np.arange,
+        # np.float32 from a search grid) — accept them alongside the
+        # Python types; np.bool_ is rejected like bool.
+        if isinstance(value, (bool, np.bool_)) or not isinstance(
+            value, (int, float, np.integer, np.floating)
+        ):
             raise TypeError(
                 f"{cls_name}: hyperparameter {hp.name!r} expects "
                 f"{'an int' if hp.type == 'int' else 'a number'}, got "
                 f"{type(value).__name__}"
             )
-        if hp.type == "int" and not isinstance(value, int):
+        if hp.type == "int" and not isinstance(value, (int, np.integer)):
             raise TypeError(
                 f"{cls_name}: hyperparameter {hp.name!r} expects an int, "
                 f"got {type(value).__name__}"
@@ -478,6 +485,12 @@ def install_validation(cls: Type) -> None:
             named = dict(kwargs)
         validate_call_kwargs(type(self), named)
         init(self, *args, **kwargs)
+        # Coerce numpy scalars to Python scalars post-init so they never
+        # leak into JSON metadata (model save, tuner logs, snapshots).
+        for name in hyperparameter_spec(type(self)):
+            v = getattr(self, name, None)
+            if isinstance(v, np.generic):
+                setattr(self, name, v.item())
 
     wrapped._hp_validated = True
     cls.__init__ = wrapped

@@ -115,15 +115,17 @@ class GenericLearner(HyperparameterValidationMixin):
                 f"Cache was built for label {cache.label!r}, learner wants "
                 f"{self.label!r}"
             )
-        if self.weights is not None and cache.weights != self.weights:
-            # Without this check, training would silently run unweighted
-            # (or with the cache's different weight column) while an
-            # explicit valid= dataset applies the learner's weights —
-            # inconsistently weighted early stopping.
+        if cache.weights != self.weights:
+            # Both directions matter: a learner expecting weights the cache
+            # lacks would silently train unweighted, and a weightless
+            # learner on a weighted cache would silently apply the cached
+            # weights while an explicit valid= dataset gets uniform ones —
+            # either way, inconsistently weighted early stopping.
             raise ValueError(
                 f"Learner weights column {self.weights!r} does not match "
                 f"the cache's stored weights ({cache.weights!r}); recreate "
-                f"the cache with weights={self.weights!r}"
+                f"the cache with weights={self.weights!r} or construct the "
+                f"learner with weights={cache.weights!r}"
             )
         # Column requirements per task — a helpful error instead of a
         # KeyError deep in the loss.

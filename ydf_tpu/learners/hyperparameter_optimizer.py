@@ -58,6 +58,7 @@ class HyperParameterOptimizerLearner(HyperparameterValidationMixin):
         parallel_trials: int = 0,  # 0 = one per visible device
         workers: Optional[List[str]] = None,
         worker_timeout_s: float = 3600.0,
+        worker_secret: Optional[bytes] = None,
         random_seed: int = 1234,
     ):
         if tuner is not None and search_space is not None:
@@ -67,9 +68,11 @@ class HyperParameterOptimizerLearner(HyperparameterValidationMixin):
         # `ydf_tpu.cli worker` processes; trials fan out round-robin and
         # the winner is identical to a local run (fixed trial list).
         # worker_timeout_s bounds one remote trial (connection + train +
-        # evaluate); raise it for long-training candidates.
+        # evaluate); raise it for long-training candidates. worker_secret
+        # is the shared HMAC secret (defaults to YDF_TPU_WORKER_SECRET).
         self.workers = list(workers) if workers else None
         self.worker_timeout_s = worker_timeout_s
+        self.worker_secret = worker_secret
         self.base_learner = base_learner
         self.tuner = tuner
         self.search_space = search_space
@@ -164,7 +167,8 @@ class HyperParameterOptimizerLearner(HyperparameterValidationMixin):
             from ydf_tpu.parallel.worker_service import WorkerPool
 
             wpool = WorkerPool(
-                self.workers, timeout_s=self.worker_timeout_s
+                self.workers, timeout_s=self.worker_timeout_s,
+                secret=self.worker_secret,
             )
             # Dead workers are pruned from the rotation up front
             # (reference distribute: the manager runs with the workers
@@ -194,6 +198,7 @@ class HyperParameterOptimizerLearner(HyperparameterValidationMixin):
                 last_err = None
                 for attempt in range(len(wpool.addresses)):
                     w = i + attempt
+                    addr = wpool.addresses[w % len(wpool.addresses)]
                     try:
                         resp = wpool.request(w, {
                             "verb": "train_score",
@@ -210,7 +215,8 @@ class HyperParameterOptimizerLearner(HyperparameterValidationMixin):
                                 # Worker can't take the data — a worker
                                 # problem, not a task error: fail over.
                                 last_err = RuntimeError(
-                                    f"load_data failed: {reload_resp}"
+                                    f"worker {addr} failed load_data: "
+                                    f"{reload_resp}"
                                 )
                                 continue
                             resp = wpool.request(w, {
@@ -219,14 +225,23 @@ class HyperParameterOptimizerLearner(HyperparameterValidationMixin):
                                 "data_key": data_key,
                             })
                         if resp.get("ok"):
+                            if "score" not in resp:
+                                # Malformed (stale/mismatched worker
+                                # build): a per-worker fault — fail over
+                                # like the other worker problems.
+                                last_err = RuntimeError(
+                                    f"worker {addr} sent a malformed "
+                                    f"response (ok but no 'score'): {resp}"
+                                )
+                                continue
                             return TrialLog(
                                 params=params, score=resp["score"]
                             )
                         # Task error (bad config): deterministic — no
                         # point retrying elsewhere.
                         raise RuntimeError(
-                            f"remote trial {i} failed: "
-                            f"{resp.get('error')}"
+                            f"remote trial {i} failed on worker {addr}: "
+                            f"{resp.get('error', f'malformed response {resp}')}"
                         )
                     except (OSError, ConnectionError) as e:
                         last_err = e

@@ -44,6 +44,12 @@ def draw_trials(
     out, seen = [], set()
     for _ in range(num_trials):
         params = {k: v[rng.integers(0, len(v))] for k, v in space.items()}
+        # Coerce numpy scalars (np.arange/linspace grids) to Python
+        # scalars: trial params land in tuner_logs and must json.dump.
+        params = {
+            k: (v.item() if isinstance(v, np.generic) else v)
+            for k, v in params.items()
+        }
         key = tuple(sorted((k, repr(v)) for k, v in params.items()))
         if key in seen:
             continue

@@ -348,6 +348,19 @@ class GenericModel:
 
         return to_standalone_cc(self, name=name, algorithm=algorithm)
 
+    def to_standalone_java(
+        self, name: str = "YdfModel", package: str = None,
+        algorithm: str = "IF_ELSE",
+    ) -> dict:
+        """Dependency-free standalone Java class (reference Java embed
+        target, serving/embed/java/java_embed.cc). Same IR and modes as
+        to_standalone_cc. Returns {filename: source}."""
+        from ydf_tpu.serving.embed_java import to_standalone_java
+
+        return to_standalone_java(
+            self, name=name, package=package, algorithm=algorithm
+        )
+
     def to_jax_function(self, apply_link_function: bool = True):
         """Returns (fn, params, encoder):
 

@@ -15,10 +15,22 @@ reference's distribute layer, the transport assumes a TRUSTED network
 (the reference workers execute arbitrary training requests from their
 manager too); do not expose the port beyond the job's hosts.
 
-    # on each worker host / process
-    python -m ydf_tpu.cli worker --port 9900
+Authentication. The reference's gRPC backend can enable TLS
+(`utils/distribute/implementations/grpc/grpc.proto:26`); the counterpart
+here is a shared-secret HMAC: when `YDF_TPU_WORKER_SECRET` is set (or a
+`secret=` is passed), every frame carries an HMAC-SHA256 of its payload
+and the worker drops connections whose MAC does not verify
+(constant-time compare). This keeps the trusted-network model but makes
+an accidental `--host 0.0.0.0` non-exploitable for code execution;
+resource use by unauthenticated peers is bounded by a per-connection
+idle timeout and a frame-size cap (YDF_TPU_WORKER_MAX_FRAME bytes,
+default 4 GiB), not eliminated. Requests execute pickled learner
+objects — NEVER expose an unsecured worker beyond loopback.
 
-    # on the manager
+    # on each worker host / process
+    YDF_TPU_WORKER_SECRET=s3cret python -m ydf_tpu.cli worker --port 9900
+
+    # on the manager (same env var, or workers= plus worker_secret=)
     HyperParameterOptimizerLearner(..., workers=["host:9900", ...])
 
 Trial results are deterministic regardless of placement: the trial list
@@ -29,15 +41,28 @@ winner.
 
 from __future__ import annotations
 
+import hmac
+import hashlib
+import os
 import pickle
 import socket
 import struct
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+_MAC_LEN = hashlib.sha256().digest_size  # 32
 
-def _send_msg(sock: socket.socket, obj: Any) -> None:
+
+def _env_secret() -> Optional[bytes]:
+    s = os.environ.get("YDF_TPU_WORKER_SECRET")
+    return s.encode() if s else None
+
+
+def _send_msg(sock: socket.socket, obj: Any,
+              secret: Optional[bytes] = None) -> None:
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    if secret:
+        payload += hmac.new(secret, payload, hashlib.sha256).digest()
     sock.sendall(struct.pack("<Q", len(payload)) + payload)
 
 
@@ -51,9 +76,26 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def _recv_msg(sock: socket.socket) -> Any:
+def _max_frame() -> int:
+    return int(os.environ.get("YDF_TPU_WORKER_MAX_FRAME", 4 << 30))
+
+
+def _recv_msg(sock: socket.socket, secret: Optional[bytes] = None) -> Any:
     (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
-    return pickle.loads(_recv_exact(sock, n))
+    if n > _max_frame():
+        # Checked BEFORE allocation: a bogus length prefix (or a peer
+        # speaking another protocol) must not buffer gigabytes pre-auth.
+        raise ConnectionError(f"frame of {n} bytes exceeds the cap")
+    data = _recv_exact(sock, n)
+    if secret:
+        if n < _MAC_LEN:
+            raise ConnectionError("authentication failed (frame too short)")
+        body, mac = data[:-_MAC_LEN], data[-_MAC_LEN:]
+        want = hmac.new(secret, body, hashlib.sha256).digest()
+        if not hmac.compare_digest(mac, want):
+            raise ConnectionError("authentication failed (bad HMAC)")
+        data = body
+    return pickle.loads(data)
 
 
 # Worker-side dataset cache: load_data ships the (train, holdout) pair
@@ -103,11 +145,16 @@ def _handle_request(req: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def start_worker(
-    port: int, host: str = "127.0.0.1", blocking: bool = True
+    port: int, host: str = "127.0.0.1", blocking: bool = True,
+    secret: Optional[bytes] = None,
 ) -> Optional[threading.Thread]:
     """Serves train/evaluate requests until a shutdown request arrives
     (reference ydf.start_worker). blocking=False runs the accept loop in
-    a daemon thread and returns it (for tests)."""
+    a daemon thread and returns it (for tests). When a secret is set
+    (param or YDF_TPU_WORKER_SECRET), unauthenticated or wrong-MAC
+    connections are dropped without executing anything."""
+    if secret is None:
+        secret = _env_secret()
     srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     srv.bind((host, port))
@@ -118,15 +165,21 @@ def start_worker(
         while not stop:
             conn, _ = srv.accept()
             try:
-                req = _recv_msg(conn)
+                # Idle timeout per recv/send chunk: a peer that connects
+                # and sends nothing must not starve the accept loop
+                # forever. Legit large frames stream continuously, so
+                # this does not bound request size or training time.
+                conn.settimeout(120.0)
+                req = _recv_msg(conn, secret)
+                conn.settimeout(None)  # training can take hours
                 try:
                     resp = _handle_request(req)
                 except Exception as e:  # worker stays alive on task errors
                     resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
-                _send_msg(conn, resp)
+                _send_msg(conn, resp, secret)
                 stop = bool(resp.get("shutdown"))
             except Exception:
-                pass  # malformed/broken connection: keep serving
+                pass  # malformed/broken/unauthenticated: keep serving
             finally:
                 conn.close()
         srv.close()
@@ -145,7 +198,8 @@ class WorkerPool:
     to worker restarts between trials (the reference re-instantiates
     workers across manager restarts the same way, distribute.h:52-66)."""
 
-    def __init__(self, addresses: List[str], timeout_s: float = 3600.0):
+    def __init__(self, addresses: List[str], timeout_s: float = 3600.0,
+                 secret: Optional[bytes] = None):
         if not addresses:
             raise ValueError("empty worker address list")
         self.addresses: List[Tuple[str, int]] = []
@@ -153,6 +207,7 @@ class WorkerPool:
             host, _, port = a.rpartition(":")
             self.addresses.append((host or "127.0.0.1", int(port)))
         self.timeout_s = timeout_s
+        self.secret = secret if secret is not None else _env_secret()
 
     def request(
         self, i: int, req: Dict[str, Any],
@@ -162,8 +217,8 @@ class WorkerPool:
         with socket.create_connection(
             (host, port), timeout=timeout_s or self.timeout_s
         ) as sock:
-            _send_msg(sock, req)
-            return _recv_msg(sock)
+            _send_msg(sock, req, self.secret)
+            return _recv_msg(sock, self.secret)
 
     def ping_all(self, drop_unreachable: bool = False) -> None:
         """Health check. drop_unreachable=True prunes dead addresses

@@ -69,6 +69,90 @@ class EmbedUnsupported(Exception):
     pass
 
 
+class EmbedSpec:
+    """Everything a language lowering needs, computed once — shared by the
+    C++ and Java backends so their envelopes and semantics cannot drift."""
+
+    def __init__(self, model):
+        from ydf_tpu.config import Task
+        from ydf_tpu.models.gbt_model import GradientBoostedTreesModel
+        from ydf_tpu.models.rf_model import RandomForestModel
+
+        f = model.forest.to_numpy()
+        binner = model.binner
+        if f.get("vs_anchor") is not None and np.size(f["vs_anchor"]) > 0:
+            raise EmbedUnsupported("vector-sequence conditions")
+        if getattr(binner, "num_set", 0) > 0:
+            raise EmbedUnsupported("categorical-set features")
+        if getattr(model, "native_missing", False):
+            # Imported models route missing values per node (na_left);
+            # the generated code bakes imputation instead.
+            raise EmbedUnsupported(
+                "imported model with native missing-value routing"
+            )
+
+        is_gbt = isinstance(model, GradientBoostedTreesModel)
+        is_rf = isinstance(model, RandomForestModel)
+        if not (is_gbt or is_rf):
+            raise EmbedUnsupported(type(model).__name__)
+
+        # K: GBT trees per iteration (tree t feeds accumulator t % K).
+        # V: leaf-vector width (RF classification leaves = distributions).
+        K = getattr(model, "num_trees_per_iter", 1) if is_gbt else 1
+        V = int(f["leaf_value"].shape[-1])
+        if K > 1 and V != 1:
+            raise EmbedUnsupported(
+                "multi-output leaves with trees-per-iter > 1"
+            )
+
+        leaf_values = np.asarray(f["leaf_value"], np.float32)  # [T, N, V]
+        if (
+            is_rf
+            and model.task == Task.CLASSIFICATION
+            and getattr(model, "winner_take_all", False)
+        ):
+            # Bake hard votes at codegen time (the same substitution
+            # rf_model.predict applies before routing).
+            from ydf_tpu.models.forest import bake_winner_take_all
+
+            leaf_values = bake_winner_take_all(leaf_values)
+
+        ow = f.get("oblique_weights")
+        self.model = model
+        self.f = f
+        self.binner = binner
+        self.is_gbt = is_gbt
+        self.is_rf = is_rf
+        self.K, self.V, self.D = K, V, max(K, V)
+        self.leaf_values = leaf_values
+        self.Fn = binner.num_numerical
+        self.names = binner.feature_names
+        self.T = int(f["feature"].shape[0])
+        self.nfeat = len(self.names)
+        self.ow = ow
+        self.P = 0 if ow is None else int(np.shape(ow)[1])
+
+        # Link function + initial predictions (the post-accumulation
+        # semantics; see the C++ lowering's comments for the bit-exactness
+        # argument).
+        init = np.zeros((self.D,), np.float32)
+        link = "raw"
+        if is_gbt:
+            init = np.asarray(
+                model.initial_predictions, np.float32
+            ).reshape(-1)
+            if model.apply_link_function:
+                if model.task == Task.CLASSIFICATION:
+                    link = "sigmoid" if self.D == 1 else "softmax"
+                elif getattr(model, "loss_name", "") == "POISSON":
+                    link = "exp"  # log link (gbt_model.py predict)
+        elif is_rf and model.task == Task.CLASSIFICATION:
+            link = "proba"  # accumulated votes, mean over trees
+        self.init = init
+        self.link = link
+        self.combine_mean = is_rf
+
+
 def to_standalone_cc(
     model,
     name: str = "ydf_model",
@@ -77,57 +161,17 @@ def to_standalone_cc(
 ) -> Dict[str, str]:
     """Returns {"<name>.h": header_source}. Raises EmbedUnsupported for
     models outside the envelope. algorithm: "IF_ELSE" | "ROUTING"."""
-    from ydf_tpu.config import Task
-    from ydf_tpu.models.gbt_model import GradientBoostedTreesModel
-    from ydf_tpu.models.rf_model import RandomForestModel
-
     if algorithm not in ("IF_ELSE", "ROUTING"):
         raise ValueError(f"Unknown embed algorithm {algorithm!r}")
     namespace = namespace or name
-    f = model.forest.to_numpy()
-    binner = model.binner
-    if f.get("vs_anchor") is not None and np.size(f["vs_anchor"]) > 0:
-        raise EmbedUnsupported("vector-sequence conditions")
-    if getattr(binner, "num_set", 0) > 0:
-        raise EmbedUnsupported("categorical-set features")
-    if getattr(model, "native_missing", False):
-        # Imported models route missing values per node (na_left); the
-        # generated code bakes imputation instead.
-        raise EmbedUnsupported("imported model with native missing-value "
-                               "routing")
-
-    is_gbt = isinstance(model, GradientBoostedTreesModel)
-    is_rf = isinstance(model, RandomForestModel)
-    if not (is_gbt or is_rf):
-        raise EmbedUnsupported(type(model).__name__)
-
-    Fn = binner.num_numerical
-    names = binner.feature_names
-    T = f["feature"].shape[0]
-    nfeat = len(names)
-    ow = f.get("oblique_weights")
-    P = 0 if ow is None else int(np.shape(ow)[1])
-
-    # --- output geometry ------------------------------------------------
-    # K: GBT trees per iteration (tree t feeds accumulator t % K).
-    # V: leaf-vector width (RF classification leaves are distributions).
-    K = getattr(model, "num_trees_per_iter", 1) if is_gbt else 1
-    V = int(f["leaf_value"].shape[-1])
-    if K > 1 and V != 1:
-        raise EmbedUnsupported("multi-output leaves with trees-per-iter > 1")
-    D = max(K, V)  # output dimensionality
-
-    leaf_values = np.asarray(f["leaf_value"], np.float32)  # [T, N, V]
-    if (
-        is_rf
-        and model.task == Task.CLASSIFICATION
-        and getattr(model, "winner_take_all", False)
-    ):
-        # Bake hard votes at codegen time (the same substitution
-        # rf_model.predict applies before routing).
-        from ydf_tpu.models.forest import bake_winner_take_all
-
-        leaf_values = bake_winner_take_all(leaf_values)
+    spec = EmbedSpec(model)
+    f, binner = spec.f, spec.binner
+    is_gbt, is_rf = spec.is_gbt, spec.is_rf
+    Fn, names, T, nfeat, ow, P = (
+        spec.Fn, spec.names, spec.T, spec.nfeat, spec.ow, spec.P,
+    )
+    K, V, D = spec.K, spec.V, spec.D
+    leaf_values = spec.leaf_values
 
     # --- Instance struct + categorical enums ---------------------------
     enums: List[str] = []
@@ -254,18 +298,7 @@ def to_standalone_cc(
         ]
 
     # --- prediction wrapper --------------------------------------------
-    init = np.zeros((D,), np.float32)
-    link = "raw"
-    if is_gbt:
-        init = np.asarray(model.initial_predictions, np.float32).reshape(-1)
-        if model.apply_link_function:
-            if model.task == Task.CLASSIFICATION:
-                link = "sigmoid" if D == 1 else "softmax"
-            elif getattr(model, "loss_name", "") == "POISSON":
-                link = "exp"  # log link (gbt_model.py predict)
-    elif is_rf and model.task == Task.CLASSIFICATION:
-        link = "proba"  # accumulated votes/distributions, mean over trees
-    combine_mean = is_rf
+    init, link, combine_mean = spec.init, spec.link, spec.combine_mean
     # Same f32 operation order as the routed engine (ops/routing.py):
     # trees accumulate from zero in scan order; the initial prediction
     # (GBT) / the mean division (RF) applies at the end — this is what
