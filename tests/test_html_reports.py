@@ -75,3 +75,74 @@ def test_regression_describe_html(abalone):
     ).train(abalone.iloc[:800])
     html = model.describe(output_format="html")
     assert "OOB" in html or "Training" in html
+
+
+# --------------------------------------------------------------------- #
+# Golden snapshots (reference keeps .html.expected goldens the same way:
+# test_data/golden/analyze_model_classification_gbt.html.expected).
+# Regenerate intentionally with YDF_TPU_REGEN_GOLDENS=1.
+# --------------------------------------------------------------------- #
+
+import os as _os
+
+_GOLDEN_DIR = _os.path.join(_os.path.dirname(__file__), "golden")
+
+
+def _check_golden(name, html):
+    import jax
+    import pytest
+
+    if jax.default_backend() != "cpu":
+        # Goldens are generated on the CPU conftest backend; float
+        # reduction order differs across backends.
+        pytest.skip("HTML goldens are CPU-backend snapshots")
+    path = _os.path.join(_GOLDEN_DIR, name)
+    if _os.environ.get("YDF_TPU_REGEN_GOLDENS"):
+        _os.makedirs(_GOLDEN_DIR, exist_ok=True)
+        with open(path, "w") as f:
+            f.write(html)
+        pytest.skip(f"regenerated {name}")
+    with open(path) as f:
+        assert html == f.read(), (
+            f"HTML report drifted from {name}; regenerate with "
+            "YDF_TPU_REGEN_GOLDENS=1 if the change is intended"
+        )
+
+
+def _golden_model():
+    from ydf_tpu.utils.html_report import reset_tab_counter
+
+    reset_tab_counter()  # byte-stable radio-group ids
+    rng = np.random.RandomState(42)
+    n = 400
+    data = {
+        "a": rng.normal(size=n).astype(np.float32),
+        "b": rng.normal(size=n).astype(np.float32),
+        "c": rng.choice(["u", "v"], size=n),
+    }
+    data["label"] = np.where(
+        data["a"] + (data["c"] == "u") > 0.2, "pos", "neg"
+    )
+    m = ydf.GradientBoostedTreesLearner(
+        label="label", num_trees=4, max_depth=3, validation_ratio=0.0,
+        early_stopping="NONE",
+    ).train(data)
+    return m, data
+
+
+def test_describe_html_golden():
+    m, _ = _golden_model()
+    _check_golden("report_describe.html.expected",
+                  m.describe(output_format="html"))
+
+
+def test_analyze_html_golden():
+    m, data = _golden_model()
+    html = m.analyze(data, num_pdp_features=2, max_rows=200).to_html()
+    _check_golden("report_analyze.html.expected", html)
+
+
+def test_evaluation_html_golden():
+    m, data = _golden_model()
+    _check_golden("report_evaluation.html.expected",
+                  m.evaluate(data).to_html())

@@ -1,0 +1,96 @@
+"""maximum_training_duration (reference abstract_learner.proto:52-64;
+GBT deadline check gradient_boosted_trees.cc:1314-1325): the tree loop
+stops within one chunk of the deadline and returns the trees finished so
+far; a generous deadline changes nothing."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+
+
+def _df(n=4000, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.normal(size=(n, 6)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] * x[:, 2] + rng.normal(size=n) * 0.3)
+    d = {f"f{i}": x[:, i] for i in range(6)}
+    d["y"] = y.astype(np.float32)
+    return pd.DataFrame(d)
+
+
+def test_gbt_deadline_truncates():
+    df = _df()
+    # A deadline that expires during the loop: the first chunk always
+    # completes, later ones do not start. 200 trees would take many
+    # chunks; expect strictly fewer trees, in whole-chunk units.
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", task=Task.REGRESSION, num_trees=200, max_depth=4,
+        validation_ratio=0.0, early_stopping="NONE",
+        maximum_training_duration=0.5,
+    ).train(df)
+    assert 0 < m.num_trees() < 200
+    # The truncated model predicts (structure is complete).
+    p = m.predict(df.head(10))
+    assert np.isfinite(np.asarray(p)).all()
+
+
+def test_gbt_generous_deadline_is_noop():
+    df = _df(800)
+    kw = dict(
+        label="y", task=Task.REGRESSION, num_trees=10, max_depth=3,
+        validation_ratio=0.0, early_stopping="NONE",
+    )
+    m1 = ydf.GradientBoostedTreesLearner(**kw).train(df)
+    m2 = ydf.GradientBoostedTreesLearner(
+        **kw, maximum_training_duration=3600.0
+    ).train(df)
+    np.testing.assert_array_equal(
+        np.asarray(m1.predict(df.head(50))),
+        np.asarray(m2.predict(df.head(50))),
+    )
+    assert m2.num_trees() == 10
+
+
+def test_rf_deadline_truncates():
+    df = _df()
+    m = ydf.RandomForestLearner(
+        label="y", task=Task.REGRESSION, num_trees=300,
+        compute_oob_performances=False,
+        maximum_training_duration=0.5,
+    ).train(df)
+    # Whole chunks of 25 trees; at least one chunk, strictly fewer than
+    # the full 300 within half a second on this box.
+    assert 0 < m.num_trees() < 300
+    assert m.num_trees() % 25 == 0
+    p = m.predict(df.head(10))
+    assert np.isfinite(np.asarray(p)).all()
+
+
+def test_rf_deadline_with_oob_keeps_consistent_count():
+    """OOB metadata reflects the number of trees actually trained."""
+    df = _df(1500)
+    m = ydf.RandomForestLearner(
+        label="y", task=Task.REGRESSION, num_trees=300,
+        maximum_training_duration=0.5,
+    ).train(df)
+    assert m.oob_evaluation["num_trees"] == m.num_trees() < 300
+
+
+def test_rf_chunking_is_invisible():
+    """Chunk boundaries never change the model (per-tree fold_in RNG):
+    27 trees (one full chunk of 25 + overshoot slicing) equals the same
+    training read back tree by tree."""
+    df = _df(600)
+    kw = dict(
+        label="y", task=Task.REGRESSION, num_trees=27,
+        compute_oob_performances=False,
+    )
+    m1 = ydf.RandomForestLearner(**kw).train(df)
+    m2 = ydf.RandomForestLearner(**kw).train(df)
+    assert m1.num_trees() == m2.num_trees() == 27
+    np.testing.assert_array_equal(
+        np.asarray(m1.predict(df.head(100))),
+        np.asarray(m2.predict(df.head(100))),
+    )

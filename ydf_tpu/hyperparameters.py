@@ -247,8 +247,11 @@ _PARAM_INFO: Dict[str, _Info] = {
     "honest_ratio_leaf_examples": _Info(
         "Fraction of examples reserved for leaf-value estimation in "
         "honest trees.", min_value=0.0, max_value=1.0),
-    "adapt_bootstrap_size_ratio_for_maximum_training_duration": _Info(
-        "Reserved for API parity; no effect."),
+    "maximum_training_duration": _Info(
+        "Deadline in seconds for the whole train() call; the tree loop "
+        "stops within one chunk of it and returns the trees finished so "
+        "far. Negative = no limit (reference "
+        "abstract_learner.proto maximum_training_duration)."),
     # ---- Isolation forest ----
     "subsample_count": _Info(
         "Examples sampled per isolation tree.", min_value=2),

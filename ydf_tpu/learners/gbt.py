@@ -118,6 +118,7 @@ class GradientBoostedTreesLearner(GenericLearner):
         working_dir: Optional[str] = None,
         resume_training: bool = False,
         resume_training_snapshot_interval_trees: int = 50,
+        maximum_training_duration: float = -1.0,
         features: Optional[Sequence[str]] = None,
         weights: Optional[str] = None,
         random_seed: int = 123456,
@@ -252,6 +253,12 @@ class GradientBoostedTreesLearner(GenericLearner):
         self.resume_training_snapshot_interval_trees = (
             resume_training_snapshot_interval_trees
         )
+        # Deadline for the whole train() call in seconds; the boosting
+        # loop runs chunked and stops within one chunk of the deadline,
+        # keeping the trees finished so far (reference
+        # abstract_learner.proto:52-64 maximum_training_duration and the
+        # GBT deadline check, gradient_boosted_trees.cc:1314-1325).
+        self.maximum_training_duration = maximum_training_duration
         # Test-only fault injection (reference MaybeSimulateFailure,
         # worker.cc:415-452): abort after N snapshots.
         self._abort_after_chunks = None
@@ -283,6 +290,14 @@ class GradientBoostedTreesLearner(GenericLearner):
     ) -> GradientBoostedTreesModel:
         from ydf_tpu.utils.profiling import StageTimer, maybe_trace
 
+        # Deadline clock starts at train() entry — ingestion and binning
+        # count against maximum_training_duration like the reference's.
+        deadline = (
+            time.monotonic() + self.maximum_training_duration
+            if self.maximum_training_duration
+            and self.maximum_training_duration > 0
+            else None
+        )
         timer = StageTimer()
         with timer.stage("ingest_bin"):
             prep = self._prepare(data, valid=valid)
@@ -749,6 +764,7 @@ class GradientBoostedTreesLearner(GenericLearner):
                 if self.early_stopping == "LOSS_INCREASE"
                 else 0
             ),
+            deadline=deadline,
         )
 
         _t_fin = time.perf_counter()
@@ -759,7 +775,9 @@ class GradientBoostedTreesLearner(GenericLearner):
             best_iter = int(np.argmin(valid_losses))
             num_iters = best_iter + 1
         else:
-            num_iters = self.num_trees
+            # A deadline (maximum_training_duration) may have stopped the
+            # chunked loop early: keep the iterations actually trained.
+            num_iters = min(self.num_trees, len(train_losses))
 
         # [T, K, ...] → [T*K, ...] iteration-major (the reference's
         # num_trees_per_iter layout, gradient_boosted_trees.h:57-151).
@@ -1476,10 +1494,13 @@ def _train_gbt(
     x_tr_raw=None, x_va_raw=None, set_tr=None, set_va=None,
     vs_tr=None, vs_va=None, vs_Ac=0, vs_Ap=0,
     cache_dir=None, resume=False, snapshot_interval=50,
-    abort_after_chunks=None, early_stop_lookahead=0,
+    abort_after_chunks=None, early_stop_lookahead=0, deadline=None,
 ):
     """The jitted boosting loop. Returns stacked trees [T, K, ...], leaf
-    values [T, K, N, 1] and per-iteration logs."""
+    values [T, K, N, 1] and per-iteration logs. `deadline` is an absolute
+    time.monotonic() value: the chunked drivers stop within one chunk of
+    it and return the iterations finished so far (reference GBT deadline
+    check, gradient_boosted_trees.cc:1314-1325)."""
     # Identity-hashed losses (LambdaMartNdcg carries per-dataset group
     # arrays) can never hit the cache — bypass it so dead entries don't pin
     # device memory or evict the reusable frozen-dataclass ones.
@@ -1519,16 +1540,17 @@ def _train_gbt(
             # Stopping can only ever fire when the loop outlives the
             # look-ahead window; otherwise the fused single scan is cheaper.
             and num_trees > early_stop_lookahead
-        ):
+        ) or deadline is not None:
             # In-loop early STOPPING without a working_dir: drive the same
             # run_chunk executable in memory and break once the validation
             # loss has not improved for `early_stop_lookahead` trees — the
             # reference stops its boosting loop the same way
             # (early_stopping.h:29-66) instead of training all num_trees
-            # and truncating post-hoc.
+            # and truncating post-hoc. A deadline forces this chunked
+            # driver too (the fused single scan cannot stop mid-flight).
             use_dart = getattr(run, "use_dart", False)
             carry, init_pred = run.init_state(y_tr, w_tr)
-            clen = max(1, min(early_stop_lookahead, 25))
+            clen = max(1, min(early_stop_lookahead or 25, 25))
             parts = []
             vls_seen = []
             start = 0
@@ -1540,9 +1562,11 @@ def _train_gbt(
                 parts.append(_chunk_arrays_from_ys(ys))
                 start += c
                 vls_seen.append(parts[-1]["vls"])
-                if _early_stop_hit(
+                if nv_rows > 0 and _early_stop_hit(
                     vls_seen, min(start, num_trees), early_stop_lookahead
                 ):
+                    break
+                if deadline is not None and time.monotonic() >= deadline:
                     break
             trees, lvs, tls, vls, obl_w, obl_b, vs_a, vs_b = (
                 _merge_chunk_parts(parts, num_trees, use_dart, carry)
@@ -1689,6 +1713,8 @@ def _train_gbt(
             raise _TrainingAborted(
                 f"aborted after {chunks_done} chunks ({start} iterations)"
             )
+        if deadline is not None and time.monotonic() >= deadline:
+            break
 
     # Merge chunk payloads (linear, once).
     latest = snaps.latest()

@@ -15,6 +15,7 @@ the gathered subsample (tiny histograms), not the full dataset.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence
 
 import jax
@@ -205,102 +206,123 @@ def _train_if(
     num_numerical, log_gap, seed, x_raw=None, obl_P=0, obl_density=2.0,
     obl_weight_type="BINARY",
 ):
+    return _if_run(
+        bins, log_gap, x_raw, jnp.asarray(seed, jnp.uint32),
+        num_trees=num_trees, sub=sub, depth=depth,
+        frontier=tree_cfg.frontier, num_bins=tree_cfg.num_bins,
+        max_nodes=max_nodes, num_numerical=num_numerical,
+        obl_P=obl_P, obl_density=obl_density,
+        obl_weight_type=obl_weight_type,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_trees", "sub", "depth", "frontier", "num_bins", "max_nodes",
+        "num_numerical", "obl_P", "obl_density", "obl_weight_type",
+    ),
+)
+def _if_run(
+    bins, log_gap, x_raw, seed, *, num_trees, sub, depth, frontier,
+    num_bins, max_nodes, num_numerical, obl_P, obl_density,
+    obl_weight_type,
+):
+    """Module-level jit so the compiled executable is cached across
+    train() calls (a per-call closure can never hit the jit cache —
+    profiling on the RF path measured ~30 s of recompilation per call)."""
     n = bins.shape[0]
     rule = RandomSplitRule()
-    B = tree_cfg.num_bins
+    B = num_bins
     P = obl_P
     Fn = num_numerical
 
-    @jax.jit
-    def run(bins, log_gap):
-        def one_tree(carry, t):
-            key = jax.random.fold_in(jax.random.PRNGKey(seed), t)
-            k_samp, k_grow, k_obl = jax.random.split(key, 3)
-            # subsample WITHOUT replacement: Gumbel top-k over examples.
-            scores = jax.random.uniform(k_samp, (n,))
-            _, idx = jax.lax.top_k(scores, sub)
-            sub_bins = bins[idx]
-            if P > 0:
-                # Per-tree sparse projections on the subsample (reference
-                # isolation_forest.cc:311 samples per node; the per-tree
-                # pool + per-node uniform pick is the batched recast).
-                # Shared sampler: ops/oblique.py.
-                from ydf_tpu.ops.oblique import (
-                    sample_projection_coefficients,
-                )
-
-                W = sample_projection_coefficients(
-                    k_obl, P, Fn,
-                    density=obl_density,
-                    weight_type=obl_weight_type,
-                )
-                z = x_raw[idx] @ W.T  # [sub, P]
-                zmin = jnp.min(z, axis=0)  # [P]
-                zmax = jnp.max(z, axis=0)
-                # Uniform (linspace) boundaries over the projected range:
-                # equal bin gaps ⇒ the gap-weighted random cut draws the
-                # reference's uniform threshold in (min, max].
-                qs = jnp.arange(1, B, dtype=jnp.float32) / B  # [B-1]
-                bnd = zmin[:, None] + (
-                    jnp.maximum(zmax - zmin, 1e-12)[:, None] * qs[None, :]
-                )  # [P, B-1]
-                zb = jax.vmap(
-                    lambda b, zz: jnp.searchsorted(b, zz, side="right")
-                )(bnd, z.T).astype(jnp.uint8).T  # [sub, P]
-                grow_bins = jnp.concatenate(
-                    [sub_bins[:, :Fn], zb, sub_bins[:, Fn:]], axis=1
-                )
-                grow_log_gap = jnp.concatenate(
-                    [
-                        log_gap[:Fn],  # -inf: axis numericals disabled
-                        jnp.zeros((P, B), jnp.float32),
-                        log_gap[Fn:],
-                    ],
-                    axis=0,
-                )
-                grow_Fn = Fn + P
-            else:
-                W = jnp.zeros((0, 0), jnp.float32)
-                bnd = jnp.zeros((0, B - 1), jnp.float32)
-                grow_bins = sub_bins
-                grow_log_gap = log_gap
-                grow_Fn = num_numerical
-            stats = jnp.ones((sub, 1), jnp.float32)
-            res = grower.grow_tree(
-                grow_bins, stats, k_grow,
-                rule=rule,
-                max_depth=depth,
-                frontier=tree_cfg.frontier,
-                max_nodes=max_nodes,
-                num_bins=tree_cfg.num_bins,
-                num_numerical=grow_Fn,
-                min_examples=1,
-                min_split_gain=float("-inf"),
-                candidate_features=-1,
-                rule_ctx=grow_log_gap,
+    def one_tree(carry, t):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), t)
+        k_samp, k_grow, k_obl = jax.random.split(key, 3)
+        # subsample WITHOUT replacement: Gumbel top-k over examples.
+        scores = jax.random.uniform(k_samp, (n,))
+        _, idx = jax.lax.top_k(scores, sub)
+        sub_bins = bins[idx]
+        if P > 0:
+            # Per-tree sparse projections on the subsample (reference
+            # isolation_forest.cc:311 samples per node; the per-tree
+            # pool + per-node uniform pick is the batched recast).
+            # Shared sampler: ops/oblique.py.
+            from ydf_tpu.ops.oblique import (
+                sample_projection_coefficients,
             )
-            tree = res.tree
-            # Node depths: parents precede children in BFS id order, so
-            # `depth` sweeps converge after max_depth scatter passes.
-            nd = jnp.zeros((max_nodes + 1,), jnp.int32)
-            for _ in range(depth):
-                internal = ~tree.is_leaf
-                tl = jnp.where(internal, tree.left, max_nodes)
-                tr = jnp.where(internal, tree.right, max_nodes)
-                d1 = nd[:max_nodes] + 1
-                nd = nd.at[tl].set(d1)
-                nd = nd.at[tr].set(d1)
-            node_depth = nd[:max_nodes].astype(jnp.float32)
-            counts = tree.leaf_stats[:, 0]
-            lv = (node_depth + _avg_path_length_jnp(counts))[:, None]
-            return carry, (tree, lv, W, bnd)
 
-        _, (trees, lvs, Ws, bnds) = jax.lax.scan(
-            one_tree, 0, jnp.arange(num_trees)
+            W = sample_projection_coefficients(
+                k_obl, P, Fn,
+                density=obl_density,
+                weight_type=obl_weight_type,
+            )
+            z = x_raw[idx] @ W.T  # [sub, P]
+            zmin = jnp.min(z, axis=0)  # [P]
+            zmax = jnp.max(z, axis=0)
+            # Uniform (linspace) boundaries over the projected range:
+            # equal bin gaps ⇒ the gap-weighted random cut draws the
+            # reference's uniform threshold in (min, max].
+            qs = jnp.arange(1, B, dtype=jnp.float32) / B  # [B-1]
+            bnd = zmin[:, None] + (
+                jnp.maximum(zmax - zmin, 1e-12)[:, None] * qs[None, :]
+            )  # [P, B-1]
+            zb = jax.vmap(
+                lambda b, zz: jnp.searchsorted(b, zz, side="right")
+            )(bnd, z.T).astype(jnp.uint8).T  # [sub, P]
+            grow_bins = jnp.concatenate(
+                [sub_bins[:, :Fn], zb, sub_bins[:, Fn:]], axis=1
+            )
+            grow_log_gap = jnp.concatenate(
+                [
+                    log_gap[:Fn],  # -inf: axis numericals disabled
+                    jnp.zeros((P, B), jnp.float32),
+                    log_gap[Fn:],
+                ],
+                axis=0,
+            )
+            grow_Fn = Fn + P
+        else:
+            W = jnp.zeros((0, 0), jnp.float32)
+            bnd = jnp.zeros((0, B - 1), jnp.float32)
+            grow_bins = sub_bins
+            grow_log_gap = log_gap
+            grow_Fn = num_numerical
+        stats = jnp.ones((sub, 1), jnp.float32)
+        res = grower.grow_tree(
+            grow_bins, stats, k_grow,
+            rule=rule,
+            max_depth=depth,
+            frontier=frontier,
+            max_nodes=max_nodes,
+            num_bins=num_bins,
+            num_numerical=grow_Fn,
+            min_examples=1,
+            min_split_gain=float("-inf"),
+            candidate_features=-1,
+            rule_ctx=grow_log_gap,
         )
-        return trees, lvs, (Ws, bnds)
+        tree = res.tree
+        # Node depths: parents precede children in BFS id order, so
+        # `depth` sweeps converge after max_depth scatter passes.
+        nd = jnp.zeros((max_nodes + 1,), jnp.int32)
+        for _ in range(depth):
+            internal = ~tree.is_leaf
+            tl = jnp.where(internal, tree.left, max_nodes)
+            tr = jnp.where(internal, tree.right, max_nodes)
+            d1 = nd[:max_nodes] + 1
+            nd = nd.at[tl].set(d1)
+            nd = nd.at[tr].set(d1)
+        node_depth = nd[:max_nodes].astype(jnp.float32)
+        counts = tree.leaf_stats[:, 0]
+        lv = (node_depth + _avg_path_length_jnp(counts))[:, None]
+        return carry, (tree, lv, W, bnd)
 
-    return run(bins, log_gap)
+    _, (trees, lvs, Ws, bnds) = jax.lax.scan(
+        one_tree, 0, jnp.arange(num_trees)
+    )
+    return trees, lvs, (Ws, bnds)
 
 
 def _avg_path_length_jnp(n):

@@ -13,6 +13,8 @@ exact multinomial counts, `random_forest.cc:350`).
 
 from __future__ import annotations
 
+import functools
+import time
 from typing import Optional, Sequence
 
 import jax
@@ -59,6 +61,7 @@ class RandomForestLearner(GenericLearner):
         uplift_treatment: Optional[str] = None,
         honest: bool = False,
         honest_ratio_leaf_examples: float = 0.5,
+        maximum_training_duration: float = -1.0,
         mesh=None,
         features: Optional[Sequence[str]] = None,
         weights: Optional[str] = None,
@@ -114,6 +117,10 @@ class RandomForestLearner(GenericLearner):
         # values — decoupling selection from estimation (Wager & Athey).
         self.honest = honest
         self.honest_ratio_leaf_examples = honest_ratio_leaf_examples
+        # Deadline in seconds for the whole train() call; the chunked
+        # tree loop stops within one chunk and keeps the trees finished
+        # so far (reference abstract_learner.proto:52-64).
+        self.maximum_training_duration = maximum_training_duration
         # jax.sharding.Mesh: data-parallel (rows over the data axis) and/or
         # feature-parallel (columns over the feature axis) training — the
         # per-layer histogram contraction all-reduces over the data axis
@@ -139,6 +146,8 @@ class RandomForestLearner(GenericLearner):
     def train(self, data: InputData, valid: Optional[InputData] = None):
         from ydf_tpu.utils.profiling import StageTimer, maybe_trace
 
+        # maximum_training_duration clock starts at train() entry.
+        self._train_start = time.monotonic()
         timer = StageTimer()
         with timer.stage("ingest_bin"):
             prep = self._prepare(data)
@@ -264,27 +273,35 @@ class RandomForestLearner(GenericLearner):
                 classes = None
                 y = jnp.asarray(prep["labels"].astype(np.float32))
 
-            def stats_fn(w):
-                w = w * t_known
-                wc = w * (1.0 - t01)
-                wt = w * t01
-                return jnp.stack([wc, wc * y, wt, wt * y, w], axis=1)
+            # Statistics are linear in the bootstrap weight:
+            # stats(w) = stat_basis * w[:, None] — the factored form the
+            # shared compiled chunk executable consumes (see _train_rf).
+            stat_basis = jnp.stack(
+                [
+                    t_known * (1.0 - t01),
+                    t_known * (1.0 - t01) * y,
+                    t_known * t01,
+                    t_known * t01 * y,
+                    t_known,
+                ],
+                axis=1,
+            )
         elif self.task == Task.CLASSIFICATION:
             classes = prep["classes"]
             C = len(classes)
             rule = ClassificationRule(num_classes=C)
             y = jnp.asarray(prep["labels"])
             y_onehot = jax.nn.one_hot(y, C, dtype=jnp.float32)
-
-            def stats_fn(w):
-                return jnp.concatenate([y_onehot * w[:, None], w[:, None]], 1)
+            stat_basis = jnp.concatenate(
+                [y_onehot, jnp.ones((n, 1), jnp.float32)], 1
+            )
         else:
             classes = None
             rule = RegressionRule()
             y = jnp.asarray(prep["labels"].astype(np.float32))
-
-            def stats_fn(w):
-                return jnp.stack([y * w, jnp.square(y) * w, w], axis=1)
+            stat_basis = jnp.stack(
+                [y, jnp.square(y), jnp.ones((n,), jnp.float32)], axis=1
+            )
 
         tree_cfg = TreeConfig(
             max_depth=self.max_depth,
@@ -304,11 +321,17 @@ class RandomForestLearner(GenericLearner):
             and self.bootstrap_training_dataset
             and self.task in (Task.CLASSIFICATION, Task.REGRESSION)
         )
+        deadline = (
+            self._train_start + self.maximum_training_duration
+            if self.maximum_training_duration
+            and self.maximum_training_duration > 0
+            else None
+        )
         with timer.stage("device_loop"), maybe_trace("rf_train"):
-            stacked, leaf_values, oob = _train_rf(
+            stacked, leaf_values, oob, trained = _train_rf(
             bins, w_base,
             set_bits=set_bits,
-            stats_fn=stats_fn, rule=rule, tree_cfg=tree_cfg,
+            stat_basis=stat_basis, rule=rule, tree_cfg=tree_cfg,
             max_nodes=max_nodes, num_trees=self.num_trees,
             bootstrap=self.bootstrap_training_dataset,
             candidate_features=cand,
@@ -334,7 +357,9 @@ class RandomForestLearner(GenericLearner):
             oob_importances=(
                 oob_enabled and self.compute_oob_variable_importances
             ),
+            deadline=deadline,
         )
+        self._trained_trees = trained  # may be < num_trees on deadline
 
         if obl_P > 0:
             # Remap grow-time feature ids [Fn, Fn+P) (projection block)
@@ -418,7 +443,7 @@ class RandomForestLearner(GenericLearner):
         model.oob_evaluation = {
             "source": "oob",
             "num_examples": int(idx.sum()),
-            "num_trees": self.num_trees,
+            "num_trees": getattr(self, "_trained_trees", self.num_trees),
             "metrics": {k: float(v) for k, v in base.metrics.items()},
         }
         if "sum_shuffled" not in oob:
@@ -453,17 +478,31 @@ class RandomForestLearner(GenericLearner):
 
 
 def _train_rf(
-    bins, w_base, *, stats_fn, rule, tree_cfg: TreeConfig, max_nodes,
+    bins, w_base, *, stat_basis, rule, tree_cfg: TreeConfig, max_nodes,
     num_trees, bootstrap, candidate_features, num_numerical, seed,
     honest_ratio=0.0, winner_take_all=False, compute_oob=False,
     oob_importances=False, set_bits=None, num_valid_features=None,
     x_raw=None, obl_P=0, obl_density=2.0, obl_weight_type="BINARY",
-    obl_weight_range=None,
+    obl_weight_range=None, deadline=None, chunk_trees=25,
 ):
+    """Chunked driver over the module-level jitted chunk executable.
+
+    `stat_basis` is U [n, S] with per-example statistics linear in the
+    bootstrap weight: stats(w) = U * w[:, None] — the factored form that
+    lets ONE compiled executable serve every task (the per-task stats_fn
+    closures of the old design forced a recompile on every train() call;
+    profiling showed ~30 s of the measured 252 s abalone row was exactly
+    that recompilation).
+
+    Trees are trained in chunks of `chunk_trees` by one reusable
+    executable; the tail chunk overshoots and is sliced off (overshoot
+    trees are masked out of the OOB accumulators). Chunking also gives
+    `deadline` (maximum_training_duration) a stopping point within one
+    chunk, mirroring the reference's deadline check
+    (abstract_learner.proto:52-64). Per-tree RNG is fold_in(seed, t), so
+    chunking never changes the produced model."""
     n, F = bins.shape
     P = obl_P
-    Fn = num_numerical
-    B = tree_cfg.num_bins
     if P > 0 and oob_importances:
         raise NotImplementedError(
             "compute_oob_variable_importances with SPARSE_OBLIQUE "
@@ -477,6 +516,115 @@ def _train_rf(
     Fs = 0 if set_bits is None else set_bits.shape[1]
     V = rule.num_outputs
 
+    C = max(1, min(int(chunk_trees), num_trees))
+    if compute_oob:
+        carry = (
+            jnp.zeros((n, V), jnp.float32),
+            jnp.zeros((n,), jnp.float32),
+            jnp.zeros(
+                (Fr + Fs if oob_importances else 0, n, V), jnp.float32
+            ),
+        )
+    else:
+        carry = (
+            jnp.zeros((0, V), jnp.float32),
+            jnp.zeros((0,), jnp.float32),
+            jnp.zeros((0, 0, V), jnp.float32),
+        )
+
+    static = dict(
+        chunk=C, rule=rule, max_depth=tree_cfg.max_depth,
+        frontier=tree_cfg.frontier, num_bins=tree_cfg.num_bins,
+        min_examples=tree_cfg.min_examples, max_nodes=max_nodes,
+        bootstrap=bootstrap, candidate_features=candidate_features,
+        num_numerical=num_numerical,
+        num_valid_features=num_valid_features,
+        honest_ratio=honest_ratio, winner_take_all=winner_take_all,
+        compute_oob=compute_oob, oob_importances=oob_importances,
+        obl_P=obl_P, obl_density=obl_density,
+        obl_weight_type=obl_weight_type,
+        obl_weight_range=obl_weight_range,
+    )
+    parts = []
+    start = 0
+    trained = 0
+    while start < num_trees:
+        carry, out = _rf_run_chunk(
+            bins, w_base, stat_basis, set_bits, x_raw,
+            jnp.asarray(start, jnp.int32),
+            jnp.asarray(num_trees, jnp.int32),
+            jnp.asarray(seed, jnp.uint32), carry, **static,
+        )
+        # Force to host per chunk: bounds device memory at C trees and
+        # gives the deadline check real (not async-queued) timing.
+        parts.append(jax.tree.map(np.asarray, out))
+        start += C
+        trained = min(start, num_trees)
+        if (
+            deadline is not None
+            and start < num_trees
+            and time.monotonic() >= deadline
+        ):
+            break
+
+    def cat(field):
+        return np.concatenate([p[field] for p in parts], 0)[:trained]
+
+    trees = grower.TreeArrays(
+        *[cat(f) for f in grower.TreeArrays._fields[:-1]],
+        num_nodes=cat("num_nodes"),
+    )
+    lvs = cat("lv")
+    oob_out = None
+    if compute_oob:
+        oob_out = {"sum": carry[0], "count": carry[1]}
+        if oob_importances:
+            oob_out["sum_shuffled"] = carry[2]
+    if P > 0:
+        return (trees, cat("obl_w"), cat("obl_b")), lvs, oob_out, trained
+    return trees, lvs, oob_out, trained
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "chunk", "rule", "max_depth", "frontier", "num_bins",
+        "min_examples", "max_nodes", "bootstrap", "candidate_features",
+        "num_numerical", "num_valid_features", "honest_ratio",
+        "winner_take_all", "compute_oob", "oob_importances", "obl_P",
+        "obl_density", "obl_weight_type", "obl_weight_range",
+    ),
+)
+def _rf_run_chunk(
+    bins, w_base, stat_basis, set_bits, x_raw, t_start, n_valid, seed,
+    carry,
+    *, chunk, rule, max_depth, frontier, num_bins, min_examples,
+    max_nodes, bootstrap, candidate_features, num_numerical,
+    num_valid_features, honest_ratio, winner_take_all, compute_oob,
+    oob_importances, obl_P, obl_density, obl_weight_type,
+    obl_weight_range,
+):
+    """One compiled executable training `chunk` trees [t_start,
+    t_start+chunk); cached across train() calls (module-level jit — the
+    per-call closure of the old design could never hit the cache).
+    Trees with index >= n_valid are tail overshoot: still computed (the
+    executable's shape is fixed) but masked out of the OOB carry and
+    sliced off by the driver."""
+    n, F = bins.shape
+    P = obl_P
+    Fn = num_numerical
+    B = num_bins
+    Fr = F if num_valid_features is None else num_valid_features
+    Fs = 0 if set_bits is None else set_bits.shape[1]
+    V = rule.num_outputs
+    tree_cfg = TreeConfig(
+        max_depth=max_depth, max_frontier=frontier, num_bins=num_bins,
+        min_examples=min_examples,
+    )
+
+    def stats_fn(w):
+        return stat_basis * w[:, None]
+
     def tree_vote(lv, leaves):
         """Per-example vote of one tree (reference
         AddClassificationLeafToAccumulator: winner-take-all → one-hot of
@@ -486,162 +634,149 @@ def _train_rf(
             v = jax.nn.one_hot(jnp.argmax(v, axis=1), V, dtype=jnp.float32)
         return v
 
-    @jax.jit
-    def run(bins, w_base):
-        def one_tree(carry, t):
-            key = jax.random.fold_in(jax.random.PRNGKey(seed), t)
-            k_boot, k_grow, k_honest, k_obl = jax.random.split(key, 4)
-            if bootstrap:
-                draws = jax.random.poisson(k_boot, 1.0, (n,)).astype(
-                    jnp.float32
-                )
-                w = w_base * draws
-            else:
-                w = w_base
-            if honest_ratio > 0.0:
-                # Honest split: structure half vs leaf-estimation half.
-                est = jax.random.bernoulli(k_honest, honest_ratio, (n,))
-                w_grow = w * (1.0 - est)
-                w_leaf = w * est
-            else:
-                w_grow = w
-            if P > 0:
-                # Per-tree sparse projections (shared sampler,
-                # ops/oblique.py): one MXU matmul + quantile binning; the
-                # projection columns splice in after the numericals and
-                # compete as ordinary candidates.
-                from ydf_tpu.ops.oblique import (
-                    sample_projection_coefficients,
-                )
-
-                W = sample_projection_coefficients(
-                    k_obl, P, Fn,
-                    density=obl_density,
-                    weight_type=obl_weight_type,
-                    weight_range=obl_weight_range,
-                )
-                z = x_raw @ W.T  # [n, P]
-                qs = jnp.linspace(1.0 / B, 1.0 - 1.0 / B, B - 1)
-                bnd = jnp.quantile(z, qs, axis=0).T  # [P, B-1]
-                zb = jax.vmap(
-                    lambda b, zz: jnp.searchsorted(b, zz, side="right")
-                )(bnd, z.T).astype(jnp.uint8).T
-                grow_bins = jnp.concatenate(
-                    [bins[:, :Fn], zb, bins[:, Fn:]], axis=1
-                )
-                grow_Fn = Fn + P
-                grow_valid = (
-                    None
-                    if num_valid_features is None
-                    else num_valid_features + P
-                )
-            else:
-                W = jnp.zeros((0, 0), jnp.float32)
-                bnd = jnp.zeros((0, B - 1), jnp.float32)
-                grow_bins = bins
-                grow_Fn = num_numerical
-                grow_valid = num_valid_features
-            res = grower.grow_tree(
-                grow_bins, stats_fn(w_grow), k_grow,
-                rule=rule,
-                max_depth=tree_cfg.max_depth,
-                frontier=tree_cfg.frontier,
-                max_nodes=max_nodes,
-                num_bins=tree_cfg.num_bins,
-                num_numerical=grow_Fn,
-                min_examples=tree_cfg.min_examples,
-                candidate_features=candidate_features,
-                num_valid_features=grow_valid,
-                set_bits=set_bits,
+    def one_tree(carry, t):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), t)
+        k_boot, k_grow, k_honest, k_obl = jax.random.split(key, 4)
+        if bootstrap:
+            draws = jax.random.poisson(k_boot, 1.0, (n,)).astype(
+                jnp.float32
             )
-            if honest_ratio > 0.0:
-                # Re-estimate every LEAF's statistics from the held-out
-                # half, routed through the grown structure. Internal nodes
-                # keep their grow-half stats (they feed cover/SHAP), and a
-                # leaf that drew no estimation examples falls back to its
-                # grow-half stats instead of an all-zero value.
-                est_stats = stats_fn(w_leaf)
-                seg = jax.ops.segment_sum(
-                    est_stats, res.leaf_id,
-                    num_segments=res.tree.leaf_stats.shape[0],
-                )
-                use_est = (
-                    res.tree.is_leaf & (seg[..., -1] > 0)
-                )[:, None]
-                leaf_stats = jnp.where(use_est, seg, res.tree.leaf_stats)
-                tree = res.tree._replace(leaf_stats=leaf_stats)
-                lv = rule.leaf_value(leaf_stats, None)
-            else:
-                tree = res.tree
-                lv = rule.leaf_value(res.tree.leaf_stats, None)
+            w = w_base * draws
+        else:
+            w = w_base
+        if honest_ratio > 0.0:
+            # Honest split: structure half vs leaf-estimation half.
+            est = jax.random.bernoulli(k_honest, honest_ratio, (n,))
+            w_grow = w * (1.0 - est)
+            w_leaf = w * est
+        else:
+            w_grow = w
+        if P > 0:
+            # Per-tree sparse projections (shared sampler,
+            # ops/oblique.py): one MXU matmul + quantile binning; the
+            # projection columns splice in after the numericals and
+            # compete as ordinary candidates.
+            from ydf_tpu.ops.oblique import (
+                sample_projection_coefficients,
+            )
 
-            if compute_oob:
-                # Out-of-bag accumulation (reference
-                # UpdateOOBPredictionsWithNewTree, random_forest.cc:1082):
-                # examples the bootstrap did NOT draw vote on this tree.
-                oob = (draws == 0.0) & (w_base > 0.0)
-                oob_f = oob.astype(jnp.float32)
-                oob_sum, oob_cnt, oob_shuf = carry
-                oob_sum = oob_sum + tree_vote(lv, res.leaf_id) * oob_f[:, None]
-                oob_cnt = oob_cnt + oob_f
-                if oob_importances:
-                    # Per-feature shuffled accumulators: the value of
-                    # feature f is taken from a random other row before
-                    # routing (reference GetLeafWithSwappedAttribute via a
-                    # per-tree permutation). One routed pass per feature,
-                    # vmapped.
-                    def shuffled_vote(f, k_f):
-                        perm = jax.random.permutation(k_f, n)
-                        col = bins[perm, jnp.minimum(f, F - 1)]
-                        b2 = jnp.where(
-                            jnp.arange(F)[None, :] == f, col[:, None], bins
-                        )
-                        if Fs > 0:
-                            # Set features (index block [Fr, Fr+Fs)):
-                            # shuffle the whole packed row of the feature.
-                            s2 = jnp.where(
-                                (jnp.arange(Fs)[None, :, None] + Fr) == f,
-                                set_bits[perm], set_bits,
-                            )
-                        else:
-                            s2 = None
-                        leaves = routing.route_tree_bins(
-                            tree, b2, tree_cfg.max_depth, x_set=s2,
-                            num_scalar=num_valid_features,
-                        )
-                        return tree_vote(lv, leaves)
-
-                    k_shuf = jax.random.split(
-                        jax.random.fold_in(key, 3), Fr + Fs
-                    )
-                    votes = jax.vmap(shuffled_vote)(
-                        jnp.arange(Fr + Fs), k_shuf
-                    )  # [Fr+Fs, n, V]
-                    oob_shuf = oob_shuf + votes * oob_f[None, :, None]
-                carry = (oob_sum, oob_cnt, oob_shuf)
-            return carry, (tree, lv, W, bnd)
-
-        if compute_oob:
-            carry0 = (
-                jnp.zeros((n, V), jnp.float32),
-                jnp.zeros((n,), jnp.float32),
-                jnp.zeros(
-                    (Fr + Fs if oob_importances else 0, n, V), jnp.float32
-                ),
+            W = sample_projection_coefficients(
+                k_obl, P, Fn,
+                density=obl_density,
+                weight_type=obl_weight_type,
+                weight_range=obl_weight_range,
+            )
+            z = x_raw @ W.T  # [n, P]
+            qs = jnp.linspace(1.0 / B, 1.0 - 1.0 / B, B - 1)
+            bnd = jnp.quantile(z, qs, axis=0).T  # [P, B-1]
+            zb = jax.vmap(
+                lambda b, zz: jnp.searchsorted(b, zz, side="right")
+            )(bnd, z.T).astype(jnp.uint8).T
+            grow_bins = jnp.concatenate(
+                [bins[:, :Fn], zb, bins[:, Fn:]], axis=1
+            )
+            grow_Fn = Fn + P
+            grow_valid = (
+                None
+                if num_valid_features is None
+                else num_valid_features + P
             )
         else:
-            carry0 = 0
-        carry, (trees, lvs, Ws, bnds) = jax.lax.scan(
-            one_tree, carry0, jnp.arange(num_trees)
+            W = jnp.zeros((0, 0), jnp.float32)
+            bnd = jnp.zeros((0, B - 1), jnp.float32)
+            grow_bins = bins
+            grow_Fn = num_numerical
+            grow_valid = num_valid_features
+        res = grower.grow_tree(
+            grow_bins, stats_fn(w_grow), k_grow,
+            rule=rule,
+            max_depth=tree_cfg.max_depth,
+            frontier=tree_cfg.frontier,
+            max_nodes=max_nodes,
+            num_bins=tree_cfg.num_bins,
+            num_numerical=grow_Fn,
+            min_examples=tree_cfg.min_examples,
+            candidate_features=candidate_features,
+            num_valid_features=grow_valid,
+            set_bits=set_bits,
         )
-        return trees, lvs, (Ws, bnds), carry
+        if honest_ratio > 0.0:
+            # Re-estimate every LEAF's statistics from the held-out
+            # half, routed through the grown structure. Internal nodes
+            # keep their grow-half stats (they feed cover/SHAP), and a
+            # leaf that drew no estimation examples falls back to its
+            # grow-half stats instead of an all-zero value.
+            est_stats = stats_fn(w_leaf)
+            seg = jax.ops.segment_sum(
+                est_stats, res.leaf_id,
+                num_segments=res.tree.leaf_stats.shape[0],
+            )
+            use_est = (
+                res.tree.is_leaf & (seg[..., -1] > 0)
+            )[:, None]
+            leaf_stats = jnp.where(use_est, seg, res.tree.leaf_stats)
+            tree = res.tree._replace(leaf_stats=leaf_stats)
+            lv = rule.leaf_value(leaf_stats, None)
+        else:
+            tree = res.tree
+            lv = rule.leaf_value(res.tree.leaf_stats, None)
 
-    trees, lvs, obl, carry = run(bins, w_base)
-    oob_out = None
-    if compute_oob:
-        oob_out = {"sum": carry[0], "count": carry[1]}
-        if oob_importances:
-            oob_out["sum_shuffled"] = carry[2]
-    if P > 0:
-        return (trees, obl[0], obl[1]), lvs, oob_out
-    return trees, lvs, oob_out
+        if compute_oob:
+            # Out-of-bag accumulation (reference
+            # UpdateOOBPredictionsWithNewTree, random_forest.cc:1082):
+            # examples the bootstrap did NOT draw vote on this tree.
+            # Tail-overshoot trees (t >= n_valid) are masked out —
+            # they are computed to keep the executable's shape fixed
+            # but must not vote.
+            oob = (draws == 0.0) & (w_base > 0.0)
+            oob_f = oob.astype(jnp.float32) * (
+                t < n_valid
+            ).astype(jnp.float32)
+            oob_sum, oob_cnt, oob_shuf = carry
+            oob_sum = oob_sum + tree_vote(lv, res.leaf_id) * oob_f[:, None]
+            oob_cnt = oob_cnt + oob_f
+            if oob_importances:
+                # Per-feature shuffled accumulators: the value of
+                # feature f is taken from a random other row before
+                # routing (reference GetLeafWithSwappedAttribute via a
+                # per-tree permutation). One routed pass per feature,
+                # vmapped.
+                def shuffled_vote(f, k_f):
+                    perm = jax.random.permutation(k_f, n)
+                    col = bins[perm, jnp.minimum(f, F - 1)]
+                    b2 = jnp.where(
+                        jnp.arange(F)[None, :] == f, col[:, None], bins
+                    )
+                    if Fs > 0:
+                        # Set features (index block [Fr, Fr+Fs)):
+                        # shuffle the whole packed row of the feature.
+                        s2 = jnp.where(
+                            (jnp.arange(Fs)[None, :, None] + Fr) == f,
+                            set_bits[perm], set_bits,
+                        )
+                    else:
+                        s2 = None
+                    leaves = routing.route_tree_bins(
+                        tree, b2, tree_cfg.max_depth, x_set=s2,
+                        num_scalar=num_valid_features,
+                    )
+                    return tree_vote(lv, leaves)
+
+                k_shuf = jax.random.split(
+                    jax.random.fold_in(key, 3), Fr + Fs
+                )
+                votes = jax.vmap(shuffled_vote)(
+                    jnp.arange(Fr + Fs), k_shuf
+                )  # [Fr+Fs, n, V]
+                oob_shuf = oob_shuf + votes * oob_f[None, :, None]
+            carry = (oob_sum, oob_cnt, oob_shuf)
+        return carry, (tree, lv, W, bnd)
+
+    carry, (trees, lvs, Ws, bnds) = jax.lax.scan(
+        one_tree, carry, t_start + jnp.arange(chunk)
+    )
+    out = {f: getattr(trees, f) for f in trees._fields}
+    out["lv"] = lvs
+    out["obl_w"] = Ws
+    out["obl_b"] = bnds
+    return carry, out

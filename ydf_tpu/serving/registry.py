@@ -113,10 +113,12 @@ def _qs_allowed(model) -> bool:
 def _qs_compatible(model) -> bool:
     if not (_scalar_sum_forest(model) and _qs_allowed(model)):
         return False
-    from ydf_tpu.serving.quickscorer import compile_forest
+    from ydf_tpu.serving.quickscorer import compile_forest_cached
 
+    # Memoized per forest: build() reuses this exact compile instead of
+    # walking every tree a second time.
     return (
-        compile_forest(
+        compile_forest_cached(
             model.forest, model.binner.num_numerical,
             num_features=model.binner.num_scalar,
         )

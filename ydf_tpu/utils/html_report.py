@@ -109,6 +109,12 @@ def document(title: str, body: str) -> str:
 _TAB_COUNTER = [0]
 
 
+def reset_tab_counter() -> None:
+    """Golden-snapshot hook: radio-group ids are process-unique by
+    counter; tests reset it so generated reports are byte-stable."""
+    _TAB_COUNTER[0] = 0
+
+
 def tabs(panes: List[Tuple[str, str]], group: str = "t") -> str:
     """CSS-only tab strip; panes = [(label, inner_html)]. Group ids get a
     process-unique suffix so several reports can share one page (two
