@@ -1,0 +1,230 @@
+"""Measured rows for BASELINE.json configs 3-5 at CPU-feasible scale.
+
+The real datasets (Higgs-11M, MSLR-WEB30K, KDDCup99) are not in the
+image and there is no egress, so each config runs at its reference SHAPE
+on synthetic data with a same-shape sklearn counterpart measured on the
+same core (the BASELINE.md proxy protocol):
+
+  3. Higgs-shaped GBT     : 1M x 28 numerical, binary label, 100 trees
+                            vs sklearn HistGradientBoostingClassifier
+  4. MSLR-shaped ranking  : 1000 queries x 100 docs, 136 features,
+                            graded 0-4 relevance, LambdaMART NDCG@5
+                            vs pointwise sklearn HGB-regressor scoring
+                            (the classic listwise-beats-pointwise check)
+  5. KDDCup-shaped IF     : 200k x 41, ~2% anomalies,
+                            vs sklearn IsolationForest ROC-AUC
+
+Each row prints one JSON line and lands in BASELINE_measured.json under
+key "config{3,4,5}". Run: python scripts/bench_configs.py [3|4|5|all]
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+CACHE = os.path.join(REPO, "BASELINE_measured.json")
+
+
+def save(key, rec):
+    cache = {}
+    if os.path.exists(CACHE):
+        with open(CACHE) as f:
+            cache = json.load(f)
+    cache[key] = rec
+    with open(CACHE, "w") as f:
+        json.dump(cache, f, indent=1)
+    print(json.dumps({key: rec}))
+
+
+def _ndcg_at_k(rel_by_group, scores_by_group, k=5):
+    """Mean NDCG@k over query groups (2^rel - 1 gains, log2 discounts)."""
+    vals = []
+    for rel, sc in zip(rel_by_group, scores_by_group):
+        order = np.argsort(-sc)
+        gains = (2.0 ** rel[order][:k] - 1.0) / np.log2(
+            np.arange(2, min(k, len(rel)) + 2)
+        )
+        ideal = (2.0 ** np.sort(rel)[::-1][:k] - 1.0) / np.log2(
+            np.arange(2, min(k, len(rel)) + 2)
+        )
+        vals.append(gains.sum() / ideal.sum() if ideal.sum() > 0 else 1.0)
+    return float(np.mean(vals))
+
+
+def config3_higgs(rows=1_000_000, trees=100, depth=6):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import ydf_tpu as ydf
+
+    rng = np.random.RandomState(0)
+    x = rng.normal(size=(rows, 28)).astype(np.float32)
+    logit = (
+        x[:, 0] - 0.5 * x[:, 1] + np.sin(2 * x[:, 2]) + x[:, 3] * x[:, 4]
+    )
+    y = (rng.uniform(size=rows) < 1 / (1 + np.exp(-logit))).astype(np.int64)
+    n_tr = rows * 9 // 10
+    data = {f"f{i}": x[:n_tr, i] for i in range(28)}
+    data["label"] = y[:n_tr]
+    test = {f"f{i}": x[n_tr:, i] for i in range(28)}
+
+    learner = ydf.GradientBoostedTreesLearner(
+        label="label", num_trees=trees, max_depth=depth,
+        validation_ratio=0.0, early_stopping="NONE",
+    )
+    learner.train(data)  # compile
+    t0 = time.time()
+    m = learner.train(data)
+    wall = time.time() - t0
+    from ydf_tpu.metrics import roc_auc
+
+    auc = float(roc_auc(y[n_tr:], np.asarray(m.predict(test))))
+
+    from sklearn.ensemble import HistGradientBoostingClassifier
+
+    clf = HistGradientBoostingClassifier(
+        max_iter=trees, max_depth=depth, max_bins=255,
+        early_stopping=False, validation_fraction=None,
+    )
+    t0 = time.time()
+    clf.fit(x[:n_tr], y[:n_tr])
+    sk_wall = time.time() - t0
+    sk_auc = float(
+        roc_auc(y[n_tr:], clf.predict_proba(x[n_tr:])[:, 1])
+    )
+    save("config3_higgs_shape", {
+        "rows": n_tr, "features": 28, "trees": trees, "depth": depth,
+        "wall_s": round(wall, 1),
+        "rows_trees_per_sec": round(n_tr * trees / wall, 1),
+        "auc": round(auc, 4),
+        "sklearn_wall_s": round(sk_wall, 1),
+        "sklearn_rows_trees_per_sec": round(n_tr * trees / sk_wall, 1),
+        "sklearn_auc": round(sk_auc, 4),
+        "ratio": round(sk_wall / wall, 3),
+    })
+
+
+def config4_mslr(n_groups=1000, group_size=100, features=136, trees=50):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import ydf_tpu as ydf
+    from ydf_tpu.config import Task
+
+    rng = np.random.RandomState(1)
+    n = n_groups * group_size
+    x = rng.normal(size=(n, features)).astype(np.float32)
+    # Graded relevance 0-4 driven by a sparse linear signal + noise.
+    w = np.zeros(features); w[:10] = rng.uniform(0.5, 1.0, 10)
+    raw = x @ w + rng.normal(size=n) * 2.0
+    rel = np.clip(
+        np.digitize(raw, np.quantile(raw, [0.5, 0.75, 0.9, 0.97])), 0, 4
+    ).astype(np.float32)
+    gid = np.repeat(np.arange(n_groups), group_size)
+    n_tr_g = n_groups * 4 // 5
+    tr = gid < n_tr_g
+    te = ~tr
+
+    data = {f"f{i}": x[tr, i] for i in range(features)}
+    data["rel"] = rel[tr]
+    data["g"] = gid[tr].astype(str)
+    learner = ydf.GradientBoostedTreesLearner(
+        label="rel", task=Task.RANKING, ranking_group="g",
+        num_trees=trees, max_depth=6, validation_ratio=0.0,
+        early_stopping="NONE",
+    )
+    learner.train(data)  # compile
+    t0 = time.time()
+    m = learner.train(data)
+    wall = time.time() - t0
+    test = {f"f{i}": x[te, i] for i in range(features)}
+    sc = np.asarray(m.predict(test))
+
+    gte = gid[te]
+    rel_g = [rel[te][gte == g] for g in range(n_tr_g, n_groups)]
+    sc_g = [sc[gte == g] for g in range(n_tr_g, n_groups)]
+    ndcg = _ndcg_at_k(rel_g, sc_g)
+
+    # Pointwise proxy: sklearn HGB regressor on the relevance labels.
+    from sklearn.ensemble import HistGradientBoostingRegressor
+
+    reg = HistGradientBoostingRegressor(
+        max_iter=trees, max_depth=6, max_bins=255, early_stopping=False,
+    )
+    t0 = time.time()
+    reg.fit(x[tr], rel[tr])
+    sk_wall = time.time() - t0
+    sk_sc = reg.predict(x[te])
+    sk_g = [sk_sc[gte == g] for g in range(n_tr_g, n_groups)]
+    sk_ndcg = _ndcg_at_k(rel_g, sk_g)
+    save("config4_mslr_shape", {
+        "groups": n_tr_g, "group_size": group_size, "features": features,
+        "trees": trees, "wall_s": round(wall, 1),
+        "ndcg5": round(ndcg, 4),
+        "sklearn_pointwise_wall_s": round(sk_wall, 1),
+        "sklearn_pointwise_ndcg5": round(sk_ndcg, 4),
+    })
+
+
+def config5_kddcup(rows=200_000, features=41, trees=300):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import ydf_tpu as ydf
+
+    rng = np.random.RandomState(2)
+    n_anom = rows // 50  # ~2% anomalies
+    normal = rng.normal(size=(rows - n_anom, features)).astype(np.float32)
+    # Anomalies: shifted + scaled in a random subspace per point.
+    anom = rng.normal(size=(n_anom, features)).astype(np.float32)
+    shift = rng.choice([-4.0, 4.0], size=(n_anom, features)) * (
+        rng.uniform(size=(n_anom, features)) < 0.25
+    )
+    anom = anom + shift.astype(np.float32)
+    x = np.concatenate([normal, anom], 0)
+    y = np.concatenate(
+        [np.zeros(rows - n_anom), np.ones(n_anom)]
+    ).astype(np.int64)
+    perm = rng.permutation(rows)
+    x, y = x[perm], y[perm]
+    data = {f"f{i}": x[:, i] for i in range(features)}
+
+    learner = ydf.IsolationForestLearner(num_trees=trees)
+    learner.train(data)  # compile
+    t0 = time.time()
+    m = learner.train(data)
+    wall = time.time() - t0
+    from ydf_tpu.metrics import roc_auc
+
+    auc = float(roc_auc(y, np.asarray(m.predict(data))))
+
+    from sklearn.ensemble import IsolationForest
+
+    t0 = time.time()
+    sk = IsolationForest(n_estimators=trees, random_state=0).fit(x)
+    sk_wall = time.time() - t0
+    sk_auc = float(roc_auc(y, -sk.score_samples(x)))
+    save("config5_kddcup_shape", {
+        "rows": rows, "features": features, "trees": trees,
+        "wall_s": round(wall, 1), "auc": round(auc, 4),
+        "sklearn_wall_s": round(sk_wall, 1),
+        "sklearn_auc": round(sk_auc, 4),
+        "ratio": round(sk_wall / wall, 3),
+    })
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("3", "all"):
+        config3_higgs()
+    if which in ("4", "all"):
+        config4_mslr()
+    if which in ("5", "all"):
+        config5_kddcup()

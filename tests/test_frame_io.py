@@ -1,0 +1,112 @@
+"""polars / xarray ingestion (reference port/python/ydf/dataset/io/
+polars_io.py, xarray_io.py). Neither library is in this image, so the
+tests install FAKE modules into sys.modules exposing the same public
+surface the duck-typed adapters rely on — exactly the contract
+frame_io.py documents."""
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.dataset.dataset import Dataset
+
+
+class _FakeSeries:
+    def __init__(self, values):
+        self._v = np.asarray(values)
+
+    def to_numpy(self):
+        return self._v
+
+
+class _FakePolarsFrame:
+    def __init__(self, cols):
+        self._cols = {k: _FakeSeries(v) for k, v in cols.items()}
+
+    @property
+    def columns(self):
+        return list(self._cols)
+
+    def __getitem__(self, c):
+        return self._cols[c]
+
+    # polars also has to_dict — present to prove the explicit branch
+    # wins over the generic pandas-DataFrame branch.
+    def to_dict(self):  # pragma: no cover - never called
+        raise AssertionError("adapter must use columns + to_numpy")
+
+
+class _FakeVar:
+    def __init__(self, values):
+        self.values = np.asarray(values)
+
+
+class _FakeXrDataset:
+    def __init__(self, cols):
+        self._cols = {k: _FakeVar(v) for k, v in cols.items()}
+
+    @property
+    def data_vars(self):
+        return list(self._cols)
+
+    def __getitem__(self, k):
+        return self._cols[k]
+
+
+@pytest.fixture
+def fake_modules(monkeypatch):
+    polars = types.ModuleType("polars")
+    polars.DataFrame = _FakePolarsFrame
+    xarray = types.ModuleType("xarray")
+    xarray.Dataset = _FakeXrDataset
+    monkeypatch.setitem(sys.modules, "polars", polars)
+    monkeypatch.setitem(sys.modules, "xarray", xarray)
+    yield
+
+
+def _cols(n=300, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "a": rng.normal(size=n).astype(np.float32),
+        "c": rng.choice(["u", "v", "w"], size=n),
+        "label": rng.randint(0, 2, size=n),
+    }
+
+
+def test_polars_frame_ingests_and_trains(fake_modules):
+    cols = _cols()
+    df = _FakePolarsFrame(cols)
+    ds = Dataset.from_data(df, label="label")
+    assert ds.num_rows == 300
+    m = ydf.GradientBoostedTreesLearner(
+        label="label", num_trees=3, validation_ratio=0.0,
+        early_stopping="NONE",
+    ).train(df)
+    p1 = np.asarray(m.predict(df))
+    p2 = np.asarray(m.predict(cols))
+    np.testing.assert_array_equal(p1, p2)
+
+
+def test_xarray_dataset_ingests(fake_modules):
+    cols = _cols(seed=1)
+    ds = Dataset.from_data(_FakeXrDataset(cols), label="label")
+    assert ds.num_rows == 300
+    np.testing.assert_array_equal(ds.data["a"], cols["a"])
+
+
+def test_xarray_rejects_multidim(fake_modules):
+    with pytest.raises(ValueError, match="1-D"):
+        Dataset.from_data(
+            _FakeXrDataset({"m": np.zeros((4, 4))}), label=None
+        )
+
+
+def test_without_libs_unsupported_type_still_errors():
+    class Mystery:
+        pass
+
+    with pytest.raises(TypeError, match="Unsupported dataset type"):
+        Dataset.from_data(Mystery())

@@ -30,6 +30,14 @@ from ydf_tpu.dataset.dataspec import (
 InputData = Union["Dataset", Dict[str, Any], str, "pandas.DataFrame"]  # noqa: F821
 
 
+def _frame_io():
+    """Lazy import of the optional-dependency frame adapters
+    (polars / xarray, dataset/frame_io.py)."""
+    from ydf_tpu.dataset import frame_io
+
+    return frame_io
+
+
 def _read_csv(path: str) -> Dict[str, np.ndarray]:
     """Reads a CSV into columns, with light type sniffing.
 
@@ -169,6 +177,11 @@ class Dataset:
                 cols = {}
                 for k in parts[0]:
                     cols[k] = np.concatenate([p[k] for p in parts])
+        elif _frame_io().is_polars_frame(data):
+            # polars (reference dataset/io/polars_io.py): checked before
+            # the generic DataFrame branch — polars also has
+            # .to_dict/.columns but its Series API differs in corners.
+            cols = _frame_io().polars_to_columns(data)
         elif hasattr(data, "to_dict") and hasattr(data, "columns"):  # DataFrame
             cols = {c: data[c].to_numpy() for c in data.columns}
         elif isinstance(data, dict):
@@ -180,6 +193,9 @@ class Dataset:
                 # PyGrain DataLoader / MapDataset / IterDataset of
                 # per-example dicts (reference dataset/io/pygrain_io.py).
                 cols = grain_io.to_columns(data)
+            elif _frame_io().is_xarray_dataset(data):
+                # xarray (reference dataset/io/xarray_io.py).
+                cols = _frame_io().xarray_to_columns(data)
             else:
                 raise TypeError(f"Unsupported dataset type: {type(data)}")
 

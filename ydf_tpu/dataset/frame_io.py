@@ -1,0 +1,59 @@
+"""polars / xarray dataset ingestion (duck-typed, dependency-optional).
+
+Counterpart of the reference's `port/python/ydf/dataset/io/polars_io.py`
+and `xarray_io.py`. Neither library ships in every image, so — like
+grain_io.py — detection goes through sys.modules: nothing here imports
+polars or xarray unless the caller already did, and the adapters only
+rely on the stable public surface (`df.columns` + `df[col].to_numpy()`
+for polars; `ds.data_vars` + `ds[name].values` for xarray), so any
+object exposing that surface ingests the same way.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Dict
+
+import numpy as np
+
+
+def _module_class(mod_name: str, cls_name: str):
+    m = sys.modules.get(mod_name)
+    c = getattr(m, cls_name, None) if m is not None else None
+    return c if isinstance(c, type) else None
+
+
+def is_polars_frame(data: Any) -> bool:
+    c = _module_class("polars", "DataFrame")
+    return c is not None and isinstance(data, c)
+
+
+def is_xarray_dataset(data: Any) -> bool:
+    c = _module_class("xarray", "Dataset")
+    return c is not None and isinstance(data, c)
+
+
+def polars_to_columns(df: Any) -> Dict[str, np.ndarray]:
+    """polars DataFrame → {column: np.ndarray}. String/categorical
+    columns come back as object arrays, which dataspec inference treats
+    as CATEGORICAL — same as the pandas path."""
+    out = {}
+    for c in df.columns:
+        out[str(c)] = np.asarray(df[c].to_numpy())
+    return out
+
+
+def xarray_to_columns(ds: Any) -> Dict[str, np.ndarray]:
+    """xarray Dataset → {variable: np.ndarray}; every data_var must be
+    1-D over the shared example dimension (the reference's xarray_io
+    contract)."""
+    out = {}
+    for name in ds.data_vars:
+        v = np.asarray(ds[name].values)
+        if v.ndim != 1:
+            raise ValueError(
+                f"xarray variable {name!r} has shape {v.shape}; expected "
+                "1-D columns over the example dimension"
+            )
+        out[str(name)] = v
+    return out

@@ -15,6 +15,11 @@ shared holdout; the winner's hyperparameters retrain on the full data.
 Trials reuse the jitted training executable whenever the static config
 repeats (the lru-cached boosting closure), which is the TPU analogue of
 the reference's trial-parallel worker pool.
+
+Not provided: the reference's VizierTuner (`pydf/learner/tuner.py:387`)
+— it is a thin client of Google's hosted Vizier service, which has no
+self-contained counterpart; random search over the same search-space
+API covers the open-source surface.
 """
 
 from __future__ import annotations
