@@ -1,0 +1,60 @@
+"""bench.py artifact protocol (VERDICT r3 #1: the bench must NEVER
+yield an unparseable artifact). The driver parses the LAST JSON line on
+stdout; every exit path — clean, SIGTERM mid-run, watchdog — must leave
+one."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _last_json(stdout: str):
+    lines = [
+        ln for ln in stdout.strip().splitlines()
+        if ln.strip().startswith("{")
+    ]
+    assert lines, f"no JSON line in: {stdout[-500:]!r}"
+    return json.loads(lines[-1])
+
+
+@pytest.mark.slow
+def test_small_cpu_run_emits_parseable_record():
+    out = subprocess.run(
+        [sys.executable, BENCH, "--cpu", "--small"],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+    )
+    assert out.returncode == 0
+    rec = _last_json(out.stdout)
+    assert rec["metric"] == "gbt_train_rows_x_trees_per_sec_per_chip"
+    assert rec["value"] > 0
+    assert "vs_baseline" in rec
+
+
+@pytest.mark.slow
+def test_sigterm_mid_run_still_leaves_a_record():
+    """The round-3 failure: the driver killed bench.py before emission
+    and the artifact was unparseable. SIGTERM at any point must flush a
+    structured record and exit 0."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.Popen(
+        [sys.executable, BENCH, "--cpu", "--small"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=REPO, env=env,
+    )
+    time.sleep(4)  # mid-compile/train, before any result
+    p.send_signal(signal.SIGTERM)
+    stdout, _ = p.communicate(timeout=120)
+    assert p.returncode == 0
+    rec = _last_json(stdout)
+    assert rec["metric"] == "gbt_train_rows_x_trees_per_sec_per_chip"
+    # Either a banked partial (value > 0) or a structured zero-record
+    # naming the signal — both parse; neither is a stack trace.
+    assert "value" in rec

@@ -16,8 +16,10 @@ one batched pass (leaf ids for all rows at once), per-node aggregates come
 from a numpy scatter-add over leaves plus ONE bottom-up sweep — children
 always have larger node ids than their parent (BFS allocation order,
 ops/grower.py) — and the prune decision is a linear host pass over the
-node arrays. Uplift trees are trained but not pruned yet
-(PruneTreeUpliftCategorical has no counterpart here).
+node arrays. CATEGORICAL_UPLIFT trees prune by per-node validation AUUC
+(`prune_single_tree_uplift`, reference PruneTreeUpliftCategorical
+cart.cc:518-598); numerical-uplift pruning has no reference counterpart
+and none here.
 """
 
 from __future__ import annotations
@@ -52,7 +54,11 @@ class CartLearner(RandomForestLearner):
         self.validation_ratio = validation_ratio
 
     def train(self, data: InputData, valid: Optional[InputData] = None):
-        prunable = self.task in (Task.CLASSIFICATION, Task.REGRESSION)
+        prunable = self.task in (
+            Task.CLASSIFICATION,
+            Task.REGRESSION,
+            Task.CATEGORICAL_UPLIFT,
+        )
         if not prunable or (valid is None and self.validation_ratio <= 0):
             return super().train(data)
 
@@ -81,9 +87,15 @@ class CartLearner(RandomForestLearner):
             model = super().train(train_part)
         finally:
             del self._forced_dataspec
-        num_pruned = prune_single_tree(
-            model, valid_part, weights_col=self.weights, task=self.task
-        )
+        if self.task == Task.CATEGORICAL_UPLIFT:
+            num_pruned = prune_single_tree_uplift(
+                model, valid_part, weights_col=self.weights,
+                treatment_col=self.uplift_treatment,
+            )
+        else:
+            num_pruned = prune_single_tree(
+                model, valid_part, weights_col=self.weights, task=self.task
+            )
         model.extra_metadata["num_pruned_nodes"] = num_pruned
         ev = model.evaluate(valid_part, weights=self.weights)
         model.oob_evaluation = {
@@ -94,19 +106,18 @@ class CartLearner(RandomForestLearner):
         return model
 
 
-def prune_single_tree(model, valid_data, *, weights_col, task) -> int:
-    """Reduced-error pruning of tree 0 of `model.forest`, in place on the
-    model. Returns the number of pruned nodes (reference
-    set_num_pruned_nodes, cart.cc:305)."""
+def _route_validation(model, valid_data, weights_col):
+    """Shared pruning preamble: encodes the validation data, routes every
+    example through tree 0 in one batched pass, and resolves the weight
+    column. Returns (dataset, leaf ids [nv], weights f64 [nv])."""
     import jax
     import jax.numpy as jnp
 
     from ydf_tpu.ops.routing import route_tree_values
 
-    forest = model.forest
     ds = Dataset.from_data(valid_data, dataspec=model.dataspec)
     x_num, x_cat, x_set = model._encode_inputs(ds)
-    tree0 = jax.tree.map(lambda a: a[0], forest)
+    tree0 = jax.tree.map(lambda a: a[0], model.forest)
     leaves = np.asarray(
         route_tree_values(
             tree0,
@@ -117,12 +128,20 @@ def prune_single_tree(model, valid_data, *, weights_col, task) -> int:
             x_set=None if x_set is None else jnp.asarray(x_set),
         )
     )
-    nv = leaves.shape[0]
     w = (
-        ds.data[weights_col].astype(np.float64)
+        np.asarray(ds.data[weights_col], np.float64)
         if weights_col
-        else np.ones((nv,), np.float64)
+        else np.ones((leaves.shape[0],), np.float64)
     )
+    return ds, leaves, w
+
+
+def prune_single_tree(model, valid_data, *, weights_col, task) -> int:
+    """Reduced-error pruning of tree 0 of `model.forest`, in place on the
+    model. Returns the number of pruned nodes (reference
+    set_num_pruned_nodes, cart.cc:305)."""
+    forest = model.forest
+    ds, leaves, w = _route_validation(model, valid_data, weights_col)
 
     feature = np.asarray(forest.feature[0])
     left = np.asarray(forest.left[0])
@@ -172,6 +191,23 @@ def prune_single_tree(model, valid_data, *, weights_col, task) -> int:
             new_is_leaf[v] = True
         else:
             subtree[v] = as_subtree
+
+    return _compact_pruned_tree(model, new_is_leaf)
+
+
+def _compact_pruned_tree(model, new_is_leaf: np.ndarray) -> int:
+    """BFS-renumbers the nodes still reachable after pruning and writes
+    the compacted single tree back onto the model. Returns the number of
+    removed nodes."""
+    import jax.numpy as jnp
+
+    forest = model.forest
+    feature = np.asarray(forest.feature[0])
+    left = np.asarray(forest.left[0])
+    right = np.asarray(forest.right[0])
+    is_leaf = np.asarray(forest.is_leaf[0])
+    lv = np.asarray(forest.leaf_value[0])
+    N = feature.shape[0]
 
     old_count = int(np.asarray(forest.num_nodes)[0])
     if np.array_equal(new_is_leaf, is_leaf):
@@ -230,3 +266,68 @@ def prune_single_tree(model, valid_data, *, weights_col, task) -> int:
     model.forest = new_forest
     model._qs_cache = {}
     return old_count - M
+
+
+def prune_single_tree_uplift(
+    model, valid_data, *, weights_col, treatment_col
+) -> int:
+    """Reduced-error pruning for CATEGORICAL_UPLIFT trees (reference
+    PruneTreeUpliftCategorical, cart.cc:518-598): per node, the
+    validation AUUC of predicting the node's constant treatment effect
+    (as-leaf) is compared with the AUUC of the already-pruned subtree's
+    per-example effects; the node is pruned when the leaf scores at
+    least as well. A node whose validation examples lack one of the two
+    treatment arms scores 0 both ways and is pruned, exactly like the
+    reference's num_treatments < 2 guard."""
+    from ydf_tpu.metrics.metrics import qini_curve
+
+    forest = model.forest
+    ds, leaves, w = _route_validation(model, valid_data, weights_col)
+    y = np.asarray(
+        ds.encoded_label(model.label, Task.CLASSIFICATION)
+    )
+    outcome = (y == 1).astype(np.int64)  # positive = 2nd dictionary item
+    tcodes = np.asarray(ds.encoded_categorical(treatment_col))
+    known = tcodes >= 1
+    t01 = (tcodes == 2).astype(np.int64)
+
+    left = np.asarray(forest.left[0])
+    right = np.asarray(forest.right[0])
+    is_leaf = np.asarray(forest.is_leaf[0])
+    lv = np.asarray(forest.leaf_value[0])  # [N, 1] treatment effect
+    N = left.shape[0]
+
+    # Examples (ascending order — AUUC tie-breaking must match between
+    # the as-leaf and as-subtree scores, like the reference's
+    # save_example_idxs_order) per node, built leaves-up.
+    keep = np.flatnonzero(known)
+    members = [[] for _ in range(N)]
+    for i in keep:
+        members[leaves[i]].append(i)
+    members = [np.asarray(m, np.int64) for m in members]
+    for v in range(N - 1, -1, -1):
+        if not is_leaf[v]:
+            members[v] = np.sort(
+                np.concatenate([members[left[v]], members[right[v]]])
+            )
+
+    def auuc(pred, idx):
+        if idx.size == 0 or len(np.unique(t01[idx])) < 2:
+            return 0.0
+        return qini_curve(pred, outcome[idx], t01[idx], weights=w[idx])[
+            "auuc"
+        ]
+
+    preds = lv[leaves, 0].astype(np.float64)
+    new_is_leaf = is_leaf.copy()
+    for v in range(N - 1, -1, -1):
+        if is_leaf[v]:
+            continue
+        E = members[v]
+        score_subtree = auuc(preds[E], E)
+        score_leaf = auuc(np.full(E.shape, lv[v, 0], np.float64), E)
+        if score_leaf >= score_subtree:
+            new_is_leaf[v] = True
+            preds[E] = lv[v, 0]
+
+    return _compact_pruned_tree(model, new_is_leaf)
