@@ -175,23 +175,29 @@ class Binner:
             else:
                 vals = dataset.encoded_numerical(name)
                 # Boundary fitting is O(n log n) (unique/quantile sorts);
-                # past ~500k rows a fixed-seed row sample estimates the
-                # quantiles with negligible split-quality impact — the
-                # reference's distributed dataset cache discretizes from
-                # samples the same way (dataset_cache.proto:42-58).
-                if len(vals) > 500_000:
+                # past ~200k rows a fixed-seed row sample estimates the
+                # 255 quantiles with negligible split-quality impact —
+                # the reference's distributed dataset cache discretizes
+                # from samples the same way (dataset_cache.proto:42-58),
+                # and sklearn's histogram GBT subsamples binning at the
+                # same scale. A small pre-sample screens cardinality so
+                # the full-column unique sort only runs when the column
+                # really is low-cardinality.
+                if len(vals) > 200_000:
                     sample_rng = np.random.default_rng(0xB1A5)
                     sample = vals[
-                        sample_rng.choice(len(vals), 500_000, replace=False)
+                        sample_rng.choice(len(vals), 200_000, replace=False)
                     ]
                 else:
                     sample = vals
-                uniq = np.unique(sample)
-                if len(uniq) <= max_boundaries and sample is not vals:
-                    # Low cardinality suggested by the sample — confirm on
-                    # the full column before taking exact midpoints.
+                presample = sample[: 4 * max_boundaries + 4]
+                if len(np.unique(presample)) <= max_boundaries:
+                    # Possibly low cardinality — confirm exactly (the
+                    # midpoint boundaries need the true unique set).
                     uniq = np.unique(vals)
-                if len(uniq) <= max_boundaries:
+                else:
+                    uniq = None  # dense column: quantile path
+                if uniq is not None and len(uniq) <= max_boundaries:
                     b = ((uniq[:-1] + uniq[1:]) / 2).astype(np.float32)
                 else:
                     qs = np.quantile(
