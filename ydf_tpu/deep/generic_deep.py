@@ -6,8 +6,9 @@ preprocessing (z-scored numericals, integer-coded categoricals with
 learned embeddings), a minibatched optax training loop, and a model
 object with the same predict/evaluate/save surface as the tree models.
 
-The save format is dependency-light: `config.json` + flax params in an
-.npz (the reference uses safetensors; same role)."""
+The save format is `config.json` + flax params in a safetensors file,
+like the reference deep models (deep/safetensors.py); pre-r4 .npz
+checkpoints still load."""
 
 from __future__ import annotations
 
@@ -199,7 +200,15 @@ class GenericDeepModel:
     def save(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)
         flat = _flatten_params(self.params)
-        np.savez(os.path.join(path, "params.npz"), **flat)
+        # Weights ride safetensors like the reference's deep models
+        # (ref deep/safetensors.py) — loadable by any safetensors
+        # implementation, not just this package.
+        from safetensors.numpy import save_file
+
+        save_file(
+            {k: np.ascontiguousarray(v) for k, v in flat.items()},
+            os.path.join(path, "params.safetensors"),
+        )
         with open(os.path.join(path, "config.json"), "w") as f:
             json.dump(
                 {
@@ -243,8 +252,14 @@ def load_deep_model(path: str) -> GenericDeepModel:
         meta = json.load(f)
     dataspec = DataSpecification.from_json(meta["dataspec"])
     pre = DeepPreprocessor.from_json(dataspec, meta["preprocessor"])
-    with np.load(os.path.join(path, "params.npz")) as z:
-        params = _unflatten_params({k: z[k] for k in z.files})
+    st = os.path.join(path, "params.safetensors")
+    if os.path.exists(st):
+        from safetensors.numpy import load_file
+
+        params = _unflatten_params(load_file(st))
+    else:  # pre-r4 checkpoints
+        with np.load(os.path.join(path, "params.npz")) as z:
+            params = _unflatten_params({k: z[k] for k in z.files})
     cfg = meta["config"]
     module = _build_module(cfg, pre)
     return GenericDeepModel(
