@@ -106,3 +106,108 @@ def test_train_and_test_matrix(tmp_path, ctor, task, kw):
         "w": np.array([1.0, 1.0], np.float32),
     }
     assert np.isfinite(np.asarray(model.predict(probe))).all()
+
+
+# ---- task-family cells (ranking / survival / uplift / anomaly / deep) ---- #
+
+
+def test_matrix_ranking(tmp_path):
+    rng = np.random.RandomState(11)
+    d = _data(Task.REGRESSION, seed=11)
+    d["g"] = rng.randint(0, 30, N).astype(str)
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", task=Task.RANKING, ranking_group="g", num_trees=10,
+        max_depth=4, validation_ratio=0.0, early_stopping="NONE",
+    ).train(d)
+    p = np.asarray(m.predict(d))
+    assert np.isfinite(p).all()
+    path = str(tmp_path / "m")
+    m.save(path)
+    np.testing.assert_array_equal(
+        p, np.asarray(ydf.load_model(path).predict(d))
+    )
+
+
+def test_matrix_survival(tmp_path):
+    rng = np.random.RandomState(12)
+    x1 = rng.normal(size=N).astype(np.float32)
+    hazard = np.exp(0.8 * x1)
+    t_event = rng.exponential(1.0 / hazard)
+    t_censor = rng.exponential(1.5, size=N)
+    d = {
+        "x1": x1,
+        "x2": rng.normal(size=N).astype(np.float32),
+        "age": np.minimum(t_event, t_censor).astype(np.float32),
+        "event": (t_event <= t_censor).astype(np.int64),
+    }
+    m = ydf.GradientBoostedTreesLearner(
+        label="age", task=Task.SURVIVAL_ANALYSIS,
+        label_event_observed="event", num_trees=10, max_depth=4,
+        validation_ratio=0.0, early_stopping="NONE",
+    ).train(d)
+    assert m.evaluate(d).concordance > 0.55
+    path = str(tmp_path / "m")
+    m.save(path)
+    np.testing.assert_array_equal(
+        np.asarray(m.predict(d)),
+        np.asarray(ydf.load_model(path).predict(d)),
+    )
+
+
+def test_matrix_uplift(tmp_path):
+    rng = np.random.RandomState(13)
+    x1 = rng.normal(size=N).astype(np.float32)
+    treat = rng.randint(0, 2, N)
+    y = (
+        x1 + 0.8 * treat * (x1 > 0) + rng.normal(size=N) * 0.5 > 0
+    ).astype(np.int64)
+    d = {
+        "x1": x1,
+        "x2": rng.normal(size=N).astype(np.float32),
+        "treat": np.where(treat == 1, "treated", "control"),
+        "y": y,
+    }
+    m = ydf.RandomForestLearner(
+        label="y", task=Task.CATEGORICAL_UPLIFT, uplift_treatment="treat",
+        num_trees=10, max_depth=4,
+    ).train(d)
+    p = np.asarray(m.predict(d))
+    assert np.isfinite(p).all()
+    path = str(tmp_path / "m")
+    m.save(path)
+    np.testing.assert_array_equal(
+        p, np.asarray(ydf.load_model(path).predict(d))
+    )
+
+
+def test_matrix_isolation_forest(tmp_path):
+    d = _data(Task.REGRESSION, seed=14)
+    feats = {k: d[k] for k in ("x1", "x2")}
+    m = ydf.IsolationForestLearner(num_trees=20).train(feats)
+    p = np.asarray(m.predict(feats))
+    assert np.isfinite(p).all() and (0 <= p).all() and (p <= 1).all()
+    path = str(tmp_path / "m")
+    m.save(path)
+    np.testing.assert_array_equal(
+        p, np.asarray(ydf.load_model(path).predict(feats))
+    )
+
+
+def test_matrix_deep_mlp(tmp_path):
+    from ydf_tpu.deep import MultiLayerPerceptronLearner
+
+    d = _data(Task.CLASSIFICATION, seed=15)
+    m = MultiLayerPerceptronLearner(
+        label="y", num_epochs=8, batch_size=128, random_seed=4,
+    ).train(d)
+    ev = m.evaluate(d)
+    assert ev.accuracy > 0.6, str(ev)
+    path = str(tmp_path / "m")
+    m.save(path)
+    from ydf_tpu.deep.generic_deep import load_deep_model
+
+    np.testing.assert_allclose(
+        np.asarray(m.predict(d)),
+        np.asarray(load_deep_model(path).predict(d)),
+        rtol=1e-6,
+    )
