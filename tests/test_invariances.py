@@ -1,0 +1,108 @@
+"""Property/invariance tests (the reference's randomized TrainAndTest
+sweeps assert the same invariances implicitly; here they are explicit):
+
+* training is invariant to ROW order (binning, histogram sums, and
+  split selection are permutation-invariant reductions);
+* prediction is invariant to COLUMN order and to extra unused columns
+  in the serving data (features are matched by name, never position);
+* predictions on a row subset equal the subset of predictions.
+"""
+
+import numpy as np
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+
+
+def _data(n=500, seed=3):
+    rng = np.random.RandomState(seed)
+    d = {
+        "a": rng.normal(size=n).astype(np.float32),
+        "b": rng.normal(size=n).astype(np.float32),
+        "c": rng.choice(["u", "v", "w"], size=n),
+    }
+    d["y"] = (d["a"] + 0.7 * (d["c"] == "u") - 0.3 * d["b"] > 0).astype(
+        np.int64
+    )
+    return d
+
+
+def _learner(**kw):
+    return ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=8, max_depth=4, validation_ratio=0.0,
+        early_stopping="NONE", **kw,
+    )
+
+
+def test_training_row_order_invariance():
+    d = _data()
+    n = len(d["y"])
+    perm = np.random.RandomState(0).permutation(n)
+    d_perm = {k: v[perm] for k, v in d.items()}
+    m1 = _learner().train(d)
+    m2 = _learner().train(d_perm)
+    probe = _data(seed=9)
+    np.testing.assert_allclose(
+        np.asarray(m1.predict(probe)),
+        np.asarray(m2.predict(probe)),
+        atol=1e-6,
+    )
+
+
+def test_predict_column_order_and_extra_columns():
+    d = _data()
+    m = _learner().train(d)
+    p = np.asarray(m.predict(d))
+    reordered = {k: d[k] for k in ["c", "y", "b", "a"]}
+    np.testing.assert_array_equal(p, np.asarray(m.predict(reordered)))
+    extra = dict(d)
+    extra["unrelated"] = np.arange(len(d["y"]), dtype=np.float32)
+    np.testing.assert_array_equal(p, np.asarray(m.predict(extra)))
+
+
+def test_predict_subset_consistency():
+    d = _data()
+    m = _learner().train(d)
+    p = np.asarray(m.predict(d))
+    sub = {k: v[100:200] for k, v in d.items()}
+    np.testing.assert_array_equal(p[100:200], np.asarray(m.predict(sub)))
+
+
+def test_rf_row_order_invariance_of_structure():
+    """RF bootstrap draws are per-ROW-INDEX (fold_in per tree over the
+    row axis), so permuted rows give a different but statistically
+    equivalent forest — structure-level invariance cannot hold. What
+    must hold: quality parity within noise."""
+    d = _data(n=1500, seed=4)
+    perm = np.random.RandomState(1).permutation(1500)
+    d_perm = {k: v[perm] for k, v in d.items()}
+    kw = dict(
+        label="y", num_trees=30, max_depth=6,
+        compute_oob_performances=False,
+    )
+    m1 = ydf.RandomForestLearner(**kw).train(d)
+    m2 = ydf.RandomForestLearner(**kw).train(d_perm)
+    a1 = m1.evaluate(d).accuracy
+    a2 = m2.evaluate(d).accuracy
+    assert abs(a1 - a2) < 0.05, (a1, a2)
+
+
+def test_weight_scaling_invariance():
+    """Multiplying all example weights by a constant must not change the
+    trained model once min_examples — a WEIGHTED count, the reference's
+    semantics — is scaled along: gains scale linearly (argmax invariant)
+    and leaf values are weight-ratio functions."""
+    d = _data()
+    d["w"] = np.random.RandomState(2).uniform(0.5, 2.0, len(d["y"]))
+    m1 = _learner(weights="w", min_examples=5).train(d)
+    d2 = dict(d)
+    d2["w"] = d["w"] * 7.0
+    m2 = _learner(weights="w", min_examples=35).train(d2)
+    probe = _data(seed=9)
+    probe["w"] = np.ones(len(probe["y"]))
+    np.testing.assert_allclose(
+        np.asarray(m1.predict(probe)),
+        np.asarray(m2.predict(probe)),
+        atol=1e-5,
+    )
