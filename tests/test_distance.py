@@ -1,0 +1,91 @@
+"""predict_leaves + model.distance (reference
+decision_forest_model.py:189-240: PredictLeaves and the Breiman
+proximity distance, random_forest.h:211-217)."""
+
+import numpy as np
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+
+
+def _model(n=400, seed=0):
+    rng = np.random.RandomState(seed)
+    d = {
+        "a": rng.normal(size=n).astype(np.float32),
+        "b": rng.normal(size=n).astype(np.float32),
+        "c": rng.choice(["u", "v"], size=n),
+    }
+    d["y"] = (d["a"] + 0.6 * (d["c"] == "u") > 0).astype(np.int64)
+    m = ydf.RandomForestLearner(
+        label="y", num_trees=20, max_depth=5,
+        compute_oob_performances=False,
+    ).train(d)
+    return m, d
+
+
+def test_predict_leaves_shape_and_validity():
+    m, d = _model()
+    leaves = m.predict_leaves(d)
+    T = m.num_trees()
+    assert leaves.shape == (400, T)
+    assert leaves.dtype == np.int32
+    # Every returned node is a leaf of its tree.
+    is_leaf = np.asarray(m.forest.is_leaf)
+    for t in range(T):
+        assert is_leaf[t][leaves[:, t]].all()
+
+
+def test_distance_properties():
+    m, d = _model()
+    dist = m.distance(d)
+    n = 400
+    assert dist.shape == (n, n)
+    # Self-distance is exactly 0; symmetric; within [0, 1].
+    np.testing.assert_allclose(np.diag(dist), 0.0, atol=1e-7)
+    np.testing.assert_allclose(dist, dist.T, atol=1e-7)
+    assert (dist >= -1e-7).all() and (dist <= 1 + 1e-7).all()
+
+
+def test_distance_orders_neighbors_sensibly():
+    """Two copies of the same example are at distance 0; an example with
+    flipped signal features is farther than a tiny perturbation."""
+    m, _ = _model()
+    base = {"a": np.array([1.5], np.float32),
+            "b": np.array([0.0], np.float32), "c": np.array(["u"])}
+    near = {"a": np.array([1.5001], np.float32),
+            "b": np.array([0.001], np.float32), "c": np.array(["u"])}
+    far = {"a": np.array([-1.5], np.float32),
+           "b": np.array([0.0], np.float32), "c": np.array(["v"])}
+    d_same = float(m.distance(base, base)[0, 0])
+    d_near = float(m.distance(base, near)[0, 0])
+    d_far = float(m.distance(base, far)[0, 0])
+    assert d_same == 0.0
+    assert d_near <= d_far
+    assert d_far > 0.5
+
+
+def test_distance_cross_dataset_shape():
+    m, d = _model()
+    d2 = {k: v[:37] for k, v in d.items()}
+    dist = m.distance(d2, d)
+    assert dist.shape == (37, 400)
+    # Rows of d2 are rows of d: their distance to themselves is 0.
+    np.testing.assert_allclose(
+        dist[np.arange(37), np.arange(37)], 0.0, atol=1e-7
+    )
+
+
+def test_distance_works_for_gbt_too():
+    rng = np.random.RandomState(2)
+    d = {
+        "x": rng.normal(size=300).astype(np.float32),
+        "y": rng.randint(0, 2, 300),
+    }
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=5, max_depth=3, validation_ratio=0.0,
+        early_stopping="NONE",
+    ).train(d)
+    dist = m.distance(d)
+    assert dist.shape == (300, 300)
+    np.testing.assert_allclose(np.diag(dist), 0.0, atol=1e-7)

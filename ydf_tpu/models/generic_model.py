@@ -645,6 +645,57 @@ class GenericModel:
         )
         return np.asarray(out)
 
+    def predict_leaves(self, data: InputData) -> np.ndarray:
+        """Leaf node id of every example in every tree: int32 [n, T]
+        (reference PredictLeaves,
+        decision_forest_model.py:189 / decision_forest.cc leaves)."""
+        from ydf_tpu.ops.routing import forest_leaves
+
+        ds = Dataset.from_data(data, dataspec=self.dataspec)
+        x_num, x_cat, x_set = self._encode_inputs(ds)
+        vs = self._encode_vs(ds)
+        set_missing = (
+            self._encode_set_missing(ds) if self.native_missing else None
+        )
+        return np.asarray(
+            forest_leaves(
+                self.forest,
+                jnp.asarray(x_num),
+                jnp.asarray(x_cat),
+                num_numerical=self.binner.num_numerical,
+                max_depth=self.max_depth,
+                x_set=None if x_set is None else jnp.asarray(x_set),
+                set_missing=(
+                    None if set_missing is None
+                    else jnp.asarray(set_missing)
+                ),
+                x_vs_vals=None if vs is None else jnp.asarray(vs[0]),
+                x_vs_len=None if vs is None else jnp.asarray(vs[1]),
+                vs_missing=(
+                    jnp.asarray(vs[2])
+                    if vs is not None and self.native_missing
+                    else None
+                ),
+            )
+        )
+
+    def distance(
+        self, data1: InputData, data2: Optional[InputData] = None
+    ) -> np.ndarray:
+        """Pairwise distance [n1, n2] = 1 − Breiman proximity (the
+        fraction of trees routing the pair to the same leaf) — the
+        reference's model.distance
+        (decision_forest_model.py:196; proximity definition
+        random_forest.h:211-217). data2=None compares data1 with
+        itself."""
+        from ydf_tpu.ops.routing import leaf_proximity
+
+        l1 = jnp.asarray(self.predict_leaves(data1))
+        l2 = l1 if data2 is None else jnp.asarray(
+            self.predict_leaves(data2)
+        )
+        return 1.0 - np.asarray(leaf_proximity(l1, l2))
+
     def predict(self, data: InputData) -> np.ndarray:
         raise NotImplementedError
 

@@ -293,3 +293,56 @@ def forest_predict_values(
     init = jnp.zeros((n, forest.leaf_value.shape[-1]), jnp.float32)
     acc, _ = jax.lax.scan(body, init, forest)
     return acc / T if combine == "mean" else acc
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_numerical", "max_depth")
+)
+def forest_leaves(
+    forest,
+    x_num: jax.Array,
+    x_cat: jax.Array,
+    num_numerical: int,
+    max_depth: int,
+    x_set: Optional[jax.Array] = None,
+    set_missing: Optional[jax.Array] = None,
+    x_vs_vals: Optional[jax.Array] = None,
+    x_vs_len: Optional[jax.Array] = None,
+    vs_missing: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Leaf node id of every example in every tree: int32 [n, T]
+    (reference PredictLeaves, decision_forest_model.py:189)."""
+
+    def body(c, tree):
+        return c, route_tree_values(
+            tree, x_num, x_cat, num_numerical, max_depth,
+            x_set=x_set, set_missing=set_missing,
+            x_vs_vals=x_vs_vals, x_vs_len=x_vs_len, vs_missing=vs_missing,
+        )
+
+    _, leaves = jax.lax.scan(body, 0, forest)  # [T, n]
+    return leaves.T
+
+
+@functools.partial(jax.jit, static_argnames=("chunk",))
+def leaf_proximity(
+    leaves1: jax.Array, leaves2: jax.Array, chunk: int = 1024
+) -> jax.Array:
+    """Breiman proximity: fraction of trees routing a pair to the SAME
+    leaf — f32 [n1, n2] (reference Proximity,
+    random_forest/random_forest.h:211-217). Chunked over rows of
+    leaves1 so the [chunk, n2, T] comparison tensor stays bounded."""
+    n1, T = leaves1.shape
+    n1p = ((n1 + chunk - 1) // chunk) * chunk
+    l1 = jnp.pad(leaves1, ((0, n1p - n1), (0, 0)))
+    l1c = l1.reshape(n1p // chunk, chunk, T)
+
+    def one(l1_blk):
+        # [chunk, n2, T] equality, averaged over trees.
+        return jnp.mean(
+            (l1_blk[:, None, :] == leaves2[None, :, :]).astype(jnp.float32),
+            axis=2,
+        )
+
+    _, prox = jax.lax.scan(lambda c, b: (c, one(b)), 0, l1c)
+    return prox.reshape(n1p, -1)[:n1]
