@@ -1,0 +1,142 @@
+"""Reference PYDF model-surface parity: the accessor/export methods a
+reference user would reach for (ref port/python/ydf/model/
+generic_model.py): name, data_spec, label_classes, input_features,
+predict_class, self_evaluation, variable_importances,
+serialize/deserialize, to_tensorflow_function, to_docker."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+
+
+def _model(n=400, seed=0):
+    rng = np.random.RandomState(seed)
+    d = {
+        "a": rng.normal(size=n).astype(np.float32),
+        "c": rng.choice(["u", "v"], size=n),
+    }
+    d["y"] = np.where(d["a"] + 0.5 * (d["c"] == "u") > 0, "pos", "neg")
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=6, max_depth=4, validation_ratio=0.2,
+    ).train(d)
+    return m, d
+
+
+def test_accessors():
+    m, d = _model()
+    assert m.name() == m.model_type
+    assert m.data_spec() is m.dataspec
+    assert set(m.label_classes()) == {"pos", "neg"}
+    assert m.label_col_idx() >= 0
+    feats = m.input_features()
+    assert ("a", "NUMERICAL", feats[0][2]) == feats[0]
+    assert m.input_features_col_idxs() == [f[2] for f in feats]
+
+
+def test_predict_class_matches_probabilities():
+    m, d = _model()
+    p = np.asarray(m.predict(d))
+    cls = m.predict_class(d)
+    classes = np.asarray(m.classes)
+    np.testing.assert_array_equal(cls, classes[(p >= 0.5).astype(int)])
+
+
+def test_self_evaluation_gbt_and_rf():
+    m, d = _model()  # validation_ratio=0.2 → validation self-eval
+    se = m.self_evaluation()
+    assert se and se["source"] == "gbt_validation"
+    assert np.isfinite(se["metrics"]["loss"])
+
+    rf = ydf.RandomForestLearner(
+        label="y", num_trees=10, max_depth=4,
+    ).train(d)
+    se = rf.self_evaluation()
+    assert se and se["source"] == "oob"
+
+
+def test_variable_importances_sorted_tuples():
+    m, d = _model()
+    vi = m.variable_importances()
+    assert "NUM_NODES" in vi
+    for rows in vi.values():
+        vals = [v for v, _ in rows]
+        assert vals == sorted(vals, reverse=True)
+        assert all(isinstance(nm, str) for _, nm in rows)
+
+
+def test_serialize_round_trip():
+    m, d = _model()
+    blob = m.serialize()
+    assert isinstance(blob, bytes) and len(blob) > 1000
+    m2 = ydf.deserialize_model(blob)
+    np.testing.assert_array_equal(
+        np.asarray(m.predict(d)), np.asarray(m2.predict(d))
+    )
+
+
+def test_to_tensorflow_function():
+    m, d = _model()
+    import tensorflow as tf
+
+    mod = m.to_tensorflow_function()
+    out = mod.serve(
+        a=tf.constant(d["a"][:32]), c=tf.constant(d["c"][:32])
+    )
+    np.testing.assert_allclose(
+        np.asarray(out).reshape(-1),
+        np.asarray(m.predict({k: v[:32] for k, v in d.items()})),
+        atol=1e-5,
+    )
+
+
+@pytest.mark.slow
+def test_to_docker_endpoint_serves(tmp_path):
+    """The generated endpoint directory actually serves: run main.py
+    (no Docker needed — the container runs the same file) and round-trip
+    a prediction over HTTP."""
+    m, d = _model()
+    out = tmp_path / "endpoint"
+    m.to_docker(str(out))
+    for f in ("Dockerfile", "main.py", "readme.md", "model",
+              "ydf_tpu", "test_locally.sh"):
+        assert (out / f).exists()
+    with pytest.raises(FileExistsError):
+        m.to_docker(str(out))
+    m.to_docker(str(out), exist_ok=True)  # overwrite allowed
+
+    env = dict(os.environ, PORT="18431", JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, str(out / "main.py")],
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, env=env,
+    )
+    try:
+        for _ in range(120):
+            try:
+                urllib.request.urlopen(
+                    "http://127.0.0.1:18431/health", timeout=2
+                )
+                break
+            except Exception:
+                assert proc.poll() is None, proc.stderr.read().decode()
+                time.sleep(1)
+        req = urllib.request.Request(
+            "http://127.0.0.1:18431/predict",
+            data=json.dumps(
+                {"a": d["a"][:8].tolist(), "c": d["c"][:8].tolist()}
+            ).encode(),
+            method="POST",
+        )
+        got = json.loads(urllib.request.urlopen(req, timeout=30).read())
+        want = np.asarray(m.predict({k: v[:8] for k, v in d.items()}))
+        np.testing.assert_allclose(got["predictions"], want, atol=1e-6)
+    finally:
+        proc.kill()

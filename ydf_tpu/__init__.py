@@ -35,7 +35,7 @@ from ydf_tpu.learners.hyperparameter_optimizer import (
     HyperParameterOptimizerLearner,
 )
 from ydf_tpu.metrics import cross_validation
-from ydf_tpu.models.io import load_model
+from ydf_tpu.models.io import deserialize_model, load_model
 from ydf_tpu.parallel.mesh import init_distributed, make_mesh
 from ydf_tpu.models.sklearn_import import from_sklearn
 from ydf_tpu.models.ydf_format import load_ydf_model
@@ -55,6 +55,7 @@ __all__ = [
     "CartLearner",
     "IsolationForestLearner",
     "load_model",
+    "deserialize_model",
     "load_ydf_model",
     "from_sklearn",
     "MultitaskerLearner",

@@ -50,6 +50,33 @@ def to_tensorflow_saved_model(
         input signature (e.g. tf.int64 for integer-valued categoricals;
         values are converted to string before the dictionary lookup).
     """
+    import tensorflow as tf
+
+    module, specs, serve_dict = build_tf_module(
+        model, feature_dtypes=feature_dtypes
+    )
+    signatures = None
+    if servo_api:
+        signatures = {
+            "serving_default": serve_dict.get_concrete_function(specs)
+        }
+    tf.saved_model.save(module, path, signatures=signatures)
+
+
+def to_tensorflow_function(model, feature_dtypes: Optional[dict] = None):
+    """A callable tf.Module reproducing `model.predict` WITHOUT writing a
+    SavedModel (reference model.to_tensorflow_function): call
+    `module.serve(feature=tensor, ...)` or
+    `module.serve_dict({name: tensor})` inside any TF program; the
+    module can also be embedded in a larger tf.Module and saved later.
+    """
+    module, _, _ = build_tf_module(model, feature_dtypes=feature_dtypes)
+    return module
+
+
+def build_tf_module(model, feature_dtypes: Optional[dict] = None):
+    """(tf.Module with serve/serve_dict, input specs, serve_dict fn) —
+    shared by SavedModel export and to_tensorflow_function."""
     try:
         import tensorflow as tf
     except ImportError as e:  # pragma: no cover - image always has TF
@@ -225,7 +252,4 @@ def to_tensorflow_saved_model(
         **{k: v for k, v in specs.items()}
     )
 
-    signatures = None
-    if servo_api:
-        signatures = {"serving_default": serve_dict.get_concrete_function(specs)}
-    tf.saved_model.save(module, path, signatures=signatures)
+    return module, specs, serve_dict

@@ -645,6 +645,133 @@ class GenericModel:
         )
         return np.asarray(out)
 
+    # ---- reference PYDF surface-parity accessors ---------------------- #
+    # (ref port/python/ydf/model/generic_model.py; attribute-style state
+    # like .label/.task/.dataspec also remains directly accessible.)
+
+    def name(self) -> str:
+        """Model type name, e.g. "RANDOM_FOREST" (ref model.name())."""
+        return self.model_type
+
+    def data_spec(self):
+        """The model's dataspec (ref model.data_spec())."""
+        return self.dataspec
+
+    def label_classes(self) -> List[str]:
+        """Classification label dictionary (ref model.label_classes())."""
+        if not self.classes:
+            raise ValueError(
+                "label_classes is only defined for classification models"
+            )
+        return list(self.classes)
+
+    def label_col_idx(self) -> int:
+        for i, c in enumerate(self.dataspec.columns):
+            if c.name == self.label:
+                return i
+        return -1
+
+    def input_features_col_idxs(self) -> List[int]:
+        by_name = {c.name: i for i, c in enumerate(self.dataspec.columns)}
+        return [by_name[n] for n in self.input_feature_names()]
+
+    def input_features(self) -> List[tuple]:
+        """[(name, column_type, column_index)] of the training features
+        (ref model.input_features() InputFeature tuples)."""
+        by_name = {c.name: i for i, c in enumerate(self.dataspec.columns)}
+        return [
+            (n, self.dataspec.column_by_name(n).type.value, by_name[n])
+            for n in self.input_feature_names()
+        ]
+
+    def predict_class(self, data: InputData) -> np.ndarray:
+        """Most likely class name per example (classification only; ref
+        model.predict_class)."""
+        if not self.classes:
+            raise ValueError(
+                "predict_class is only defined for classification models"
+            )
+        p = np.asarray(self.predict(data))
+        classes = np.asarray(self.classes)
+        if p.ndim == 1:  # binary: probability of classes[1]
+            return classes[(p >= 0.5).astype(np.int64)]
+        return classes[np.argmax(p, axis=1)]
+
+    def self_evaluation(self):
+        """The model's own training-time evaluation: OOB metrics for RF,
+        the held-out validation metrics for GBT, the pruning-validation
+        metrics for CART (ref model.self_evaluation). None when the
+        model has no self evaluation."""
+        oob = getattr(self, "oob_evaluation", None)
+        if oob is not None:
+            return oob
+        logs = getattr(self, "training_logs", None)
+        if logs and logs.get("valid_loss") is not None:
+            vl = np.asarray(logs["valid_loss"])
+            if vl.size:
+                # The kept model ends at the best validation iteration.
+                return {
+                    "source": "gbt_validation",
+                    "metrics": {"loss": float(np.min(vl))},
+                }
+        return None
+
+    def variable_importances(self) -> Dict[str, list]:
+        """Model-stored variable importances as
+        {importance_name: [(value, feature_name), ...]} sorted best
+        first (ref model.variable_importances). Structure importances
+        are always available; OOB permutation importances appear when
+        they were computed at training time."""
+        from ydf_tpu.analysis.importance import structure_importances
+
+        out = {}
+        for key, rows in structure_importances(self).items():
+            out[key] = [
+                (float(r["importance"]), r["feature"]) for r in rows
+            ]
+        oob_vi = getattr(self, "oob_variable_importances", None)
+        if oob_vi:
+            for key, rows in oob_vi.items():
+                out[key] = [
+                    (float(r["importance"]), r["feature"]) for r in rows
+                ]
+        return out
+
+    def serialize(self) -> bytes:
+        """The model as bytes (a tar of the saved directory); restore
+        with ydf_tpu.deserialize_model (ref model.serialize)."""
+        import io
+        import tarfile
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmp:
+            self.save(tmp)
+            buf = io.BytesIO()
+            with tarfile.open(fileobj=buf, mode="w") as tar:
+                tar.add(tmp, arcname="model")
+            return buf.getvalue()
+
+    def to_cpp(self, name: str = "ydf_model") -> Dict[str, str]:
+        """Standalone C++ serving sources (ref model.to_cpp; here the
+        embed codegen is the C++ serving artifact — see
+        to_standalone_cc for the algorithm choice)."""
+        return self.to_standalone_cc(name=name)
+
+    def to_tensorflow_function(self, feature_dtypes: Optional[dict] = None):
+        """A callable tf.Module reproducing predict() without writing a
+        SavedModel (ref model.to_tensorflow_function)."""
+        from ydf_tpu.models.export_tf import to_tensorflow_function
+
+        return to_tensorflow_function(self, feature_dtypes=feature_dtypes)
+
+    def to_docker(self, path: str, exist_ok: bool = False) -> None:
+        """Self-contained Docker serving endpoint directory (ref
+        model.to_docker): Dockerfile + HTTP server + the saved model +
+        this package, ready for `docker build`."""
+        from ydf_tpu.models.export_docker import to_docker
+
+        to_docker(self, path, exist_ok=exist_ok)
+
     def predict_leaves(self, data: InputData) -> np.ndarray:
         """Leaf node id of every example in every tree: int32 [n, T]
         (reference PredictLeaves,

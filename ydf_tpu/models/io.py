@@ -90,3 +90,16 @@ def load_model(path: str) -> GenericModel:
         native_missing=meta.get("native_missing", False),
     )
     return cls._from_saved(common, meta["specific"])
+
+
+def deserialize_model(data: bytes):
+    """Restores a model from model.serialize() bytes (a tar of the
+    saved directory; reference ydf.deserialize_model)."""
+    import io
+    import tarfile
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        with tarfile.open(fileobj=io.BytesIO(data)) as tar:
+            tar.extractall(tmp, filter="data")
+        return load_model(os.path.join(tmp, "model"))
