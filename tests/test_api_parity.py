@@ -140,3 +140,26 @@ def test_to_docker_endpoint_serves(tmp_path):
         np.testing.assert_allclose(got["predictions"], want, atol=1e-6)
     finally:
         proc.kill()
+
+
+def test_learner_surface_parity():
+    """Learner-side reference methods: learner_name, hyperparameters,
+    validate_hyperparameters, extract_input_feature_names,
+    cross_validation (ref generic_learner.py)."""
+    _, d = _model()
+    l = ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=5, max_depth=3, validation_ratio=0.0,
+        early_stopping="NONE",
+    )
+    assert l.learner_name() == "GradientBoostedTreesLearner"
+    hp = l.hyperparameters()
+    assert hp["num_trees"] == 5 and hp["max_depth"] == 3
+    l.validate_hyperparameters()  # current values are valid
+    l.num_trees = -3  # post-construction corruption is caught
+    with pytest.raises(ValueError):
+        l.validate_hyperparameters()
+    l.num_trees = 5
+    feats = l.extract_input_feature_names(d)
+    assert set(feats) == {"a", "c"}
+    ev = l.cross_validation(d, folds=3)
+    assert ev.accuracy > 0.6, str(ev)

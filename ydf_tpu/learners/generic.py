@@ -57,6 +57,53 @@ class GenericLearner(HyperparameterValidationMixin):
         self.discretize_numerical_columns = discretize_numerical_columns
         self.num_discretized_numerical_bins = num_discretized_numerical_bins
 
+    # ---- reference PYDF learner-surface parity ----------------------- #
+    # (ref port/python/ydf/learner/generic_learner.py)
+
+    def learner_name(self) -> str:
+        """e.g. "GradientBoostedTreesLearner" (ref learner_name)."""
+        return type(self).__name__
+
+    def hyperparameters(self) -> Dict[str, object]:
+        """Current hyperparameter values keyed by spec name (ref
+        learner.hyperparameters)."""
+        return {
+            name: getattr(self, name)
+            for name in type(self).hyperparameter_spec()
+            if hasattr(self, name)
+        }
+
+    def validate_hyperparameters(self) -> None:
+        """Re-checks the CURRENT attribute values against the spec —
+        catches invalid values assigned after construction (ref
+        learner.validate_hyperparameters)."""
+        from ydf_tpu.hyperparameters import validate_call_kwargs
+
+        validate_call_kwargs(type(self), self.hyperparameters())
+
+    def extract_input_feature_names(self, data: InputData) -> list:
+        """The feature columns this learner would train on for `data`
+        (ref extract_input_feature_names): dataspec inference + the
+        label/weights/group/treatment exclusions."""
+        prep_names = self._prepare(data)["binner"].feature_names
+        return list(prep_names)
+
+    def cross_validation(
+        self,
+        data: InputData,
+        folds: int = 10,
+        confidence_intervals: bool = True,
+    ):
+        """k-fold out-of-fold pooled evaluation (ref
+        learner.cross_validation; metrics/cross_validation.py)."""
+        from ydf_tpu.metrics.cross_validation import cross_validation
+
+        return cross_validation(
+            self, data, num_folds=folds,
+            seed=self.random_seed,
+            confidence_intervals=confidence_intervals,
+        )
+
     # ------------------------------------------------------------------ #
 
     def _infer_dataset(self, data: InputData) -> Dataset:
