@@ -128,6 +128,12 @@ def test_to_docker_endpoint_serves(tmp_path):
             except Exception:
                 assert proc.poll() is None, proc.stderr.read().decode()
                 time.sleep(1)
+        else:  # never came up (e.g. a hung backend init): show stderr
+            proc.kill()
+            pytest.fail(
+                "endpoint never became healthy; stderr:\n"
+                + proc.stderr.read().decode()
+            )
         req = urllib.request.Request(
             "http://127.0.0.1:18431/predict",
             data=json.dumps(

@@ -84,9 +84,9 @@ class GenericLearner(HyperparameterValidationMixin):
     def extract_input_feature_names(self, data: InputData) -> list:
         """The feature columns this learner would train on for `data`
         (ref extract_input_feature_names): dataspec inference + the
-        label/weights/group/treatment exclusions."""
-        prep_names = self._prepare(data)["binner"].feature_names
-        return list(prep_names)
+        label/weights/group/treatment exclusions — a metadata query, no
+        binning or encoding pass."""
+        return self._select_feature_names(self._infer_dataset(data))
 
     def cross_validation(
         self,
@@ -245,6 +245,40 @@ class GenericLearner(HyperparameterValidationMixin):
                 )
         return out
 
+    def _select_feature_names(self, ds: Dataset) -> list:
+        """Training feature columns for an inferred dataset: explicit
+        `features=` wins; otherwise every supported column minus the
+        label/weights/group/treatment/survival plumbing columns."""
+        if self.features is not None:
+            return list(self.features)
+        exclude = {
+            self.label,
+            self.weights,
+            getattr(self, "ranking_group", None),
+            getattr(self, "uplift_treatment", None),
+            getattr(self, "label_event_observed", None),
+            getattr(self, "label_entry_age", None),
+        } - {None}
+        supported = {
+            ColumnType.NUMERICAL,
+            ColumnType.CATEGORICAL,
+            ColumnType.BOOLEAN,
+            ColumnType.DISCRETIZED_NUMERICAL,
+        }
+        if getattr(self, "_supports_set_features", True):
+            # Isolation forests opt out (the reference trains IF on
+            # numerical splits only, isolation_forest.cc).
+            supported.add(ColumnType.CATEGORICAL_SET)
+        if getattr(self, "_supports_vs_features", False):
+            # Anchor-projection splits (reference vector_sequence.cc);
+            # GBT-only for now.
+            supported.add(ColumnType.NUMERICAL_VECTOR_SEQUENCE)
+        return [
+            c.name
+            for c in ds.dataspec.columns
+            if c.name not in exclude and c.type in supported
+        ]
+
     def _prepare(
         self, data: InputData, valid: Optional[InputData] = None
     ) -> Dict:
@@ -254,35 +288,7 @@ class GenericLearner(HyperparameterValidationMixin):
         if isinstance(data, DatasetCache):
             return self._prepare_from_cache(data, valid=valid)
         ds = self._infer_dataset(data)
-        feature_names = self.features
-        if feature_names is None:
-            exclude = {
-                self.label,
-                self.weights,
-                getattr(self, "ranking_group", None),
-                getattr(self, "uplift_treatment", None),
-                getattr(self, "label_event_observed", None),
-                getattr(self, "label_entry_age", None),
-            } - {None}
-            supported = {
-                ColumnType.NUMERICAL,
-                ColumnType.CATEGORICAL,
-                ColumnType.BOOLEAN,
-                ColumnType.DISCRETIZED_NUMERICAL,
-            }
-            if getattr(self, "_supports_set_features", True):
-                # Isolation forests opt out (the reference trains IF on
-                # numerical splits only, isolation_forest.cc).
-                supported.add(ColumnType.CATEGORICAL_SET)
-            if getattr(self, "_supports_vs_features", False):
-                # Anchor-projection splits (reference vector_sequence.cc);
-                # GBT-only for now.
-                supported.add(ColumnType.NUMERICAL_VECTOR_SEQUENCE)
-            feature_names = [
-                c.name
-                for c in ds.dataspec.columns
-                if c.name not in exclude and c.type in supported
-            ]
+        feature_names = self._select_feature_names(ds)
         binned = BinnedDataset.create(ds, feature_names, num_bins=self.num_bins)
         if binned.binner.num_vs > 0 and not getattr(
             self, "_supports_vs_features", False

@@ -50,11 +50,13 @@ def to_tensorflow_saved_model(
         input signature (e.g. tf.int64 for integer-valued categoricals;
         values are converted to string before the dictionary lookup).
     """
-    import tensorflow as tf
-
+    # build_tf_module owns the guarded tensorflow import (and its
+    # helpful error message); import tf here only after it succeeded.
     module, specs, serve_dict = build_tf_module(
         model, feature_dtypes=feature_dtypes
     )
+    import tensorflow as tf
+
     signatures = None
     if servo_api:
         signatures = {

@@ -665,22 +665,22 @@ class GenericModel:
             )
         return list(self.classes)
 
+    def _column_indices(self) -> Dict[str, int]:
+        return {c.name: i for i, c in enumerate(self.dataspec.columns)}
+
     def label_col_idx(self) -> int:
-        for i, c in enumerate(self.dataspec.columns):
-            if c.name == self.label:
-                return i
-        return -1
+        return self._column_indices().get(self.label, -1)
 
     def input_features_col_idxs(self) -> List[int]:
-        by_name = {c.name: i for i, c in enumerate(self.dataspec.columns)}
-        return [by_name[n] for n in self.input_feature_names()]
+        return [f[2] for f in self.input_features()]
 
     def input_features(self) -> List[tuple]:
         """[(name, column_type, column_index)] of the training features
         (ref model.input_features() InputFeature tuples)."""
-        by_name = {c.name: i for i, c in enumerate(self.dataspec.columns)}
+        by_name = self._column_indices()
+        cols = self.dataspec.columns
         return [
-            (n, self.dataspec.column_by_name(n).type.value, by_name[n])
+            (n, cols[by_name[n]].type.value, by_name[n])
             for n in self.input_feature_names()
         ]
 
@@ -709,10 +709,13 @@ class GenericModel:
         if logs and logs.get("valid_loss") is not None:
             vl = np.asarray(logs["valid_loss"])
             if vl.size:
-                # The kept model ends at the best validation iteration.
+                # Logs are truncated to the KEPT iterations (gbt.py), so
+                # the last entry is the saved model's validation loss —
+                # with early stopping that is also the minimum; without
+                # it, min() would report a loss the model never keeps.
                 return {
                     "source": "gbt_validation",
-                    "metrics": {"loss": float(np.min(vl))},
+                    "metrics": {"loss": float(vl[-1])},
                 }
         return None
 
