@@ -81,6 +81,17 @@ def unpack_mask_bit(packed: jax.Array, bit: jax.Array) -> jax.Array:
     return ((word >> (bit.astype(jnp.uint32) & 31)) & 1).astype(jnp.bool_)
 
 
+def grow_tree(bins, stats, key, *, hist_impl: str = "auto", **kw):
+    """Thin wrapper resolving hist_impl="auto" to a concrete impl BEFORE
+    the jit boundary — the jitted cache must be keyed on the concrete impl
+    (see ops/histogram.py:resolve_hist_impl for why)."""
+    from ydf_tpu.ops.histogram import resolve_hist_impl
+
+    return _grow_tree_jit(
+        bins, stats, key, hist_impl=resolve_hist_impl(hist_impl), **kw
+    )
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -90,7 +101,7 @@ def unpack_mask_bit(packed: jax.Array, bit: jax.Array) -> jax.Array:
         "monotone",
     ),
 )
-def grow_tree(
+def _grow_tree_jit(
     bins: jax.Array,        # uint8 [n, F] scalar features
     stats: jax.Array,       # f32 [n, S] weighted per-example statistics
     key: jax.Array,
@@ -105,7 +116,11 @@ def grow_tree(
     min_split_gain: float = 1e-9,
     candidate_features: int = -1,   # per-node feature sample; -1 = all
     num_valid_features: Optional[int] = None,  # real (unpadded) columns
-    hist_impl: str = "auto",
+    # Concrete impl only — "auto" must be resolved by the grow_tree
+    # wrapper; a literal "auto" here would be baked into the jit cache
+    # key and pin the first resolution forever (histogram's dispatch
+    # raises on it, making the invariant self-enforcing).
+    hist_impl: str = "segment",
     rule_ctx: Any = None,
     # Per-feature monotone directions (+1 / -1 / 0), static tuple of
     # length F or None. A cut on a +1 feature is only valid when the

@@ -119,6 +119,41 @@ def _histogram_matmul(
 @functools.partial(
     jax.jit, static_argnames=("num_slots", "num_bins", "impl", "chunk")
 )
+def _histogram_jit(bins, slot, stats, num_slots, num_bins, impl, chunk):
+    if impl == "segment":
+        return _histogram_segment(bins, slot, stats, num_slots, num_bins)
+    if impl == "matmul":
+        return _histogram_matmul(bins, slot, stats, num_slots, num_bins, chunk)
+    raise ValueError(f"Unknown histogram impl {impl!r}")
+
+
+def resolve_hist_impl(impl: str = "auto") -> str:
+    """Resolves "auto" to a concrete impl BEFORE the jit boundary, so the
+    jit cache is keyed on the concrete impl (resolving inside the traced
+    body would cache the first resolution under the key "auto" and ignore
+    later environment changes).
+
+    YDF_TPU_HIST_IMPL overrides auto-selection — used by the device-less
+    TPU export path (utils/tpu_lowering.py) to lower the matmul impl for
+    platform 'tpu' on a box with no TPU devices, and by CPU perf
+    experiments. Scope caveat: resolution happens at TRACE time, and the
+    boosting loop's closure cache (learners/gbt.py:_make_boost_fn
+    lru_cache) is keyed on neither this env var nor the impl — setting
+    the variable between two same-config train() calls in one process
+    does NOT retrace. It is reliable for export paths and fresh
+    processes (tpu_lowering bypasses the closure cache via __wrapped__
+    for exactly this reason)."""
+    if impl != "auto":
+        return impl
+    import os
+
+    from ydf_tpu.config import is_tpu_backend
+
+    return os.environ.get("YDF_TPU_HIST_IMPL") or (
+        "matmul" if is_tpu_backend() else "segment"
+    )
+
+
 def histogram(
     bins: jax.Array,  # uint8/int32 [n, F] bin index per (example, feature)
     slot: jax.Array,  # int32 [n] frontier slot in [0, L]; L = inactive
@@ -129,12 +164,7 @@ def histogram(
     chunk: int = 1 << 18,
 ) -> jax.Array:
     """Returns hist[num_slots, F, num_bins, S] = Σ_examples stats."""
-    if impl == "auto":
-        from ydf_tpu.config import is_tpu_backend
-
-        impl = "matmul" if is_tpu_backend() else "segment"
-    if impl == "segment":
-        return _histogram_segment(bins, slot, stats, num_slots, num_bins)
-    if impl == "matmul":
-        return _histogram_matmul(bins, slot, stats, num_slots, num_bins, chunk)
-    raise ValueError(f"Unknown histogram impl {impl!r}")
+    return _histogram_jit(
+        bins, slot, stats, num_slots, num_bins, resolve_hist_impl(impl),
+        chunk,
+    )
