@@ -359,6 +359,44 @@ def run_bench(backend, rows, trees, depth, features, with_baseline, probe_log):
     return record, model
 
 
+def tpu_projection_record(rows, depth, features):
+    """One JSON-able record projecting single-chip TPU training throughput
+    at the benched shape, derived from the device-less TPU lowering
+    (ydf_tpu/utils/tpu_lowering.py): closed-form FLOPs of the one-hot
+    histogram contraction (exact for the dots; HloCostAnalysis counts
+    loop bodies once so it under-counts) vs v5e peak at a conservative
+    MFU. Returns None if the lowering machinery fails — the projection
+    must never cost the measured artifact."""
+    try:
+        from ydf_tpu.utils.tpu_lowering import grow_tree_cost, tpu_projection
+
+        cost = grow_tree_cost(n=rows, F=features, max_depth=depth,
+                              hist_impl="matmul")
+        proj = tpu_projection(n=rows, F=features, max_depth=depth,
+                              chips=("v5e",), cost=cost)
+        row = proj["rows"][0]
+        return {
+            "metric": "gbt_train_rows_x_trees_per_sec_per_chip_PROJECTED",
+            "value": round(row["projected_rows_trees_per_sec"], 1),
+            "unit": "rows*trees/s",
+            "backend": "analytic_projection",
+            "chip": row["chip"],
+            "rows": rows,
+            "depth": depth,
+            "features": features,
+            "assumed_mfu": row["assumed_mfu"],
+            "bound": row["bound"],
+            "flops_per_tree": row["flops_per_tree_projected"],
+            "note": "device-less roofline projection from the committed "
+                    "TPU lowering (artifacts/tpu_lowering/); NOT a "
+                    "measurement — the next emitted line is the "
+                    "measured record",
+        }
+    except Exception as e:  # pragma: no cover - defensive
+        sys.stderr.write(f"# tpu projection failed: {type(e).__name__}: {e}\n")
+        return None
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true", help="force CPU backend")
@@ -465,6 +503,19 @@ def main():
         probe_log=probe_log,
     )
     record["probe_attempts"] = probe_log
+    # Device-less TPU evidence (VERDICT r4 #1c): an analytic roofline
+    # projection from the real lowering's cost analysis rides along even
+    # when the tunnel is down. Emitted BEFORE the measured record — the
+    # last line must stay a measurement, never a projection — and also
+    # embedded in the final record.
+    proj = tpu_projection_record(rows, args.depth, args.features)
+    if proj is not None:
+        emit(proj)
+        record["tpu_projection"] = {
+            k: proj[k]
+            for k in ("value", "chip", "assumed_mfu", "bound",
+                      "flops_per_tree", "note")
+        }
     # EMIT NOW, unconditionally (VERDICT r3 #1): the record on stdout is a
     # floor the driver can always parse; any TPU success below emits a
     # better line after it, and the consumer takes the last line.
@@ -517,6 +568,10 @@ def main():
                 tpu_rec["vs_baseline"] = round(
                     tpu_rec["value"] / record["baseline_rows_trees_per_sec"], 3
                 )
+            # Bank the TPU record BEFORE emitting: a signal landing
+            # between emit() and return must not re-flush the stale CPU
+            # floor over the better TPU line (advisor r4).
+            _PARTIAL = dict(tpu_rec)
             emit(tpu_rec)
             return
         probe_log.append({"tpu_bench_error": tpu_rec.get("error"),

@@ -1,0 +1,183 @@
+"""Round-5 experiment log: can XLA-CPU close the remaining ~4x GBT loop
+gap? (VERDICT r4 next-round #2.)
+
+Round 4 attributed the 500k-row loop to scatter throughput: ~1.7G
+segment-adds at ~125M rows/s. This script measures every candidate
+reformulation of the per-layer histogram at the bench shape
+(n=500k, F=28, S=3, B=256, layers Ld = 1..32) on one CPU core:
+
+  A. baseline      — vmap-over-features segment_sum (the shipped impl)
+  B. fused         — ONE segment_sum over n*F rows with a fused
+                     (f, slot, bin) index (advisor's transposed-bincount)
+  C. payload2      — drop the weight column (S=2): does payload width
+                     matter, or row count?
+  D. trash-half    — half the rows routed to a single trash segment,
+                     emulating the sibling-subtraction trick's smaller-
+                     child-only scatter: if cache-hot trash rows were
+                     ~free, subtraction would pay densely
+  E. matmul        — the MXU one-hot contraction, on CPU, per layer
+  F. sorted        — segment_sum with pre-sorted indices +
+                     indices_are_sorted=True (upper bound: ignores the
+                     per-layer sort cost that makes it impractical)
+
+Run: python scripts/exp_cpu_histogram.py  (~3 min, 1 core)
+Results (this box, 2026-07-30) are appended as a comment at the bottom.
+"""
+
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+n, F, S, B = 500_000, 28, 3, 256
+LAYERS = [1, 2, 4, 8, 16, 32]  # depth-6 frontier sizes
+
+rng = np.random.default_rng(0)
+bins = jnp.asarray(rng.integers(0, B, (n, F)), jnp.uint8)
+stats = jnp.asarray(rng.normal(size=(n, S)), jnp.float32)
+stats2 = stats[:, :2]
+
+
+def timed(fn, *args, reps=3):
+    fn(*args)[0].block_until_ready() if isinstance(fn(*args), tuple) else \
+        jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        jax.block_until_ready(fn(*args))
+    return (time.perf_counter() - t0) / reps
+
+
+def per_tree(fn_of_layer):
+    tot = 0.0
+    for Ld in LAYERS:
+        slot = jnp.asarray(rng.integers(0, Ld, (n,)), jnp.int32)
+        tot += fn_of_layer(Ld, slot)
+    return tot
+
+
+from ydf_tpu.ops.histogram import histogram  # noqa: E402
+
+
+def variant_A(Ld, slot):
+    f = jax.jit(lambda b, s, st: histogram(
+        b, s, st, num_slots=Ld, num_bins=B, impl="segment"))
+    return timed(f, bins, slot, stats)
+
+
+def variant_E(Ld, slot):
+    f = jax.jit(lambda b, s, st: histogram(
+        b, s, st, num_slots=Ld, num_bins=B, impl="matmul"))
+    return timed(f, bins, slot, stats)
+
+
+def _fused(b, s, st, Ld):
+    # ONE scatter over n*F rows: segment id = f*(Ld+1)*B + slot*B + bin.
+    fidx = jnp.arange(F, dtype=jnp.int32)[None, :]
+    idx = (fidx * (Ld + 1) + s[:, None].astype(jnp.int32)) * B + b.astype(
+        jnp.int32
+    )  # [n, F]
+    data = jnp.broadcast_to(st[:, None, :], (n, F, st.shape[1]))
+    h = jax.ops.segment_sum(
+        data.reshape(n * F, st.shape[1]), idx.reshape(n * F),
+        num_segments=F * (Ld + 1) * B,
+    )
+    return h.reshape(F, Ld + 1, B, st.shape[1])[:, :Ld]
+
+
+def variant_B(Ld, slot):
+    f = jax.jit(lambda b, s, st: _fused(b, s, st, Ld))
+    return timed(f, bins, slot, stats)
+
+
+def _segment2(b, s, st, Ld):
+    idx = s[:, None].astype(jnp.int32) * B + b.astype(jnp.int32)
+
+    def per_feature(col):
+        return jax.ops.segment_sum(st, col, num_segments=(Ld + 1) * B)
+
+    return jax.vmap(per_feature, in_axes=1, out_axes=0)(idx)
+
+
+def variant_C(Ld, slot):
+    f = jax.jit(lambda b, s, st: _segment2(b, s, st, Ld))
+    return timed(f, bins, slot, stats2)
+
+
+def variant_D(Ld, slot):
+    # Half the examples sent to the trash slot (bin pinned to 0 so the
+    # trash segment is ONE cache line): emulates smaller-child-only
+    # scatter with dense shapes.
+    keep = jnp.asarray(rng.random(n) < 0.5)
+    slot_t = jnp.where(keep, slot, Ld)
+    bins_t = jnp.where(keep[:, None], bins, 0)
+    f = jax.jit(lambda b, s, st: _segment2(b, s, st, Ld))
+    return timed(f, bins_t, slot_t, stats)
+
+
+def variant_F(Ld, slot):
+    idx = (slot[:, None].astype(jnp.int32) * B + bins.astype(jnp.int32))
+    order = jnp.argsort(idx[:, 0])
+    idx_sorted = idx[order]
+    stats_sorted = stats[order]
+
+    def one(col, st):
+        return jax.ops.segment_sum(
+            st, col, num_segments=(Ld + 1) * B, indices_are_sorted=True
+        )
+
+    f = jax.jit(lambda c, st: one(c, st))
+    return timed(f, idx_sorted[:, 0], stats_sorted)
+
+
+if __name__ == "__main__":
+    results = {}
+    for name, v in [("A_baseline", variant_A), ("B_fused", variant_B),
+                    ("C_payload2", variant_C), ("D_trash_half", variant_D),
+                    ("E_matmul", variant_E)]:
+        t = per_tree(v)
+        results[name] = t
+        print(f"{name:14s} per-tree histogram wall: {t*1e3:8.1f} ms")
+    # F measures a single feature column at Ld=32 (x28 for the tree says
+    # nothing about sort cost, just the scatter upper bound)
+    slot = jnp.asarray(rng.integers(0, 32, (n,)), jnp.int32)
+    tF = variant_F(32, slot) * F * len(LAYERS)
+    print(f"{'F_sorted_ub':14s} per-tree extrapolated: {tF*1e3:8.1f} ms "
+          "(excl. per-layer sort cost)")
+    base = results["A_baseline"]
+    for k, v in results.items():
+        print(f"  {k}: {base/v:5.2f}x vs baseline")
+
+
+# ---------------------------------------------------------------------------
+# RESULTS (this box, 1 CPU core, 2026-07-30, round 5):
+#
+#   A_baseline     per-tree histogram wall:  1259.7 ms   1.00x
+#   B_fused        per-tree histogram wall:   862.8 ms   1.46x  <- shipped
+#   C_payload2     per-tree histogram wall:  1030.1 ms   1.22x
+#   D_trash_half   per-tree histogram wall:  1391.3 ms   0.91x  <- kills the
+#                  sibling-subtraction idea: trash-routed rows are NOT
+#                  cheaper on XLA-CPU scatter, so smaller-child-only
+#                  scatter cannot pay in a dense formulation
+#   E_matmul       per-tree histogram wall: 68090.4 ms   0.02x  <- MXU impl
+#                  is TPU-only, as designed
+#   F_sorted_ub    per-tree extrapolated:    682.9 ms   (1.84x, excluding
+#                  the per-layer sort that makes it a net loss)
+#
+# Follow-up measured the same shape against the native XLA-FFI kernel
+# (native/histogram_ffi.cc, a plain cache-aware C++ loop):
+#
+#   native FFI     per-tree histogram wall:   186 ms     5.19x vs B_fused
+#   (Ld=1: 19.8ms ... Ld=32: 47.5ms; fused-xla 146-171ms flat)
+#
+# End-to-end effect on the bench row (500k x 28, 20 trees, d6, 1 core):
+#   r4 shipped (vmap segment): 16.7 s  = 5.99e5 rows*trees/s  0.20x sklearn
+#   + fused scatter (B):       11.2 s  = 8.96e5               0.30x
+#   + native FFI kernel:        7.16 s = 1.40e6               0.47x  <- r5
+# VERDICT r4 #2 target (>=1.2e6) exceeded. Conclusion: XLA-CPU scatter is
+# irreducible at ~130-180M rows/s, but the scatter itself is not — a
+# 60-line C++ kernel runs the same rows at ~5x. The auto impl now picks
+# native > segment on CPU; TPU unchanged (matmul / Mosaic pallas).
+# ---------------------------------------------------------------------------

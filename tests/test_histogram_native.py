@@ -1,0 +1,99 @@
+"""Native XLA-FFI histogram kernel (native/histogram_ffi.cc via
+ops/histogram_native.py): bit-level equivalence questions aside (both
+sides sum f32 in unspecified order), results must match the pure-XLA
+segment impl to float tolerance, including trash slots and whole-tree
+builds. Counterpart of the reference's bucket-fill loops
+(splitter_scanner.h:860,933)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ydf_tpu.ops import histogram_native
+from ydf_tpu.ops.histogram import histogram
+
+pytestmark = pytest.mark.skipif(
+    not histogram_native.available(), reason="native kernel unavailable"
+)
+
+
+@pytest.mark.parametrize(
+    "n,F,L,B,S",
+    [
+        (500, 4, 8, 16, 3),
+        (1024, 28, 32, 256, 3),
+        (777, 5, 1, 256, 2),
+        (2500, 3, 512, 64, 3),
+        (64, 9, 96, 32, 1),
+    ],
+)
+def test_matches_segment(n, F, L, B, S):
+    rng = np.random.default_rng(n)
+    bins = jnp.asarray(rng.integers(0, B, (n, F)), jnp.uint8)
+    slot = jnp.asarray(rng.integers(0, L + 1, (n,)), jnp.int32)
+    stats = jnp.asarray(rng.normal(size=(n, S)), jnp.float32)
+    h_ref = histogram(bins, slot, stats, num_slots=L, num_bins=B,
+                      impl="segment")
+    h_nat = histogram(bins, slot, stats, num_slots=L, num_bins=B,
+                      impl="native")
+    np.testing.assert_allclose(np.asarray(h_ref), np.asarray(h_nat),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_auto_resolves_native_on_cpu():
+    from ydf_tpu.ops.histogram import resolve_hist_impl
+
+    assert resolve_hist_impl("auto") == "native"
+
+
+def test_grow_tree_equivalent_trees():
+    """Identical tree (structure + leaf stats) under native vs segment."""
+    from ydf_tpu.config import TreeConfig
+    from ydf_tpu.ops.grower import grow_tree
+    from ydf_tpu.ops.split_rules import HessianGainRule
+
+    rng = np.random.default_rng(11)
+    n, F = 3000, 7
+    bins = jnp.asarray(rng.integers(0, 64, (n, F)), jnp.uint8)
+    g = rng.normal(size=n).astype(np.float32)
+    stats = jnp.asarray(np.stack([g, np.ones(n), np.ones(n)], 1))
+    cfg = TreeConfig(max_depth=5, num_bins=64)
+    kw = dict(rule=HessianGainRule(l2=0.1), max_depth=5,
+              frontier=cfg.frontier, max_nodes=cfg.max_nodes, num_bins=64,
+              num_numerical=F)
+    key = jax.random.PRNGKey(0)
+    r_seg = grow_tree(bins, stats, key, hist_impl="segment", **kw)
+    r_nat = grow_tree(bins, stats, key, hist_impl="native", **kw)
+    np.testing.assert_array_equal(np.asarray(r_seg.tree.feature),
+                                  np.asarray(r_nat.tree.feature))
+    np.testing.assert_array_equal(np.asarray(r_seg.tree.threshold_bin),
+                                  np.asarray(r_nat.tree.threshold_bin))
+    np.testing.assert_allclose(np.asarray(r_seg.tree.leaf_stats),
+                               np.asarray(r_nat.tree.leaf_stats),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_under_jit_and_scan():
+    """The FFI call composes with jit + lax.scan (the boosting loop's
+    structure)."""
+    rng = np.random.default_rng(5)
+    bins = jnp.asarray(rng.integers(0, 16, (400, 3)), jnp.uint8)
+    slot = jnp.asarray(rng.integers(0, 4, (400,)), jnp.int32)
+    stats = jnp.asarray(rng.normal(size=(400, 3)), jnp.float32)
+
+    @jax.jit
+    def f(b, s, st):
+        def body(c, _):
+            h = histogram(b, s, st, num_slots=4, num_bins=16, impl="native")
+            return c + h.sum(), None
+
+        out, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(3))
+        return out
+
+    expected = 3 * float(
+        histogram(bins, slot, stats, num_slots=4, num_bins=16,
+                  impl="segment").sum()
+    )
+    np.testing.assert_allclose(float(f(bins, slot, stats)), expected,
+                               rtol=1e-4)

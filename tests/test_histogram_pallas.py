@@ -1,0 +1,113 @@
+"""Mosaic histogram training kernel (ops/histogram_pallas.py): oracle
+equivalence in interpret mode, trash-slot/padding behavior, whole-tree
+equivalence through the grower, and device-less TPU (Mosaic) lowering.
+
+The kernel replaces the reference's per-(node, feature) bucket-fill
+scan (splitter_scanner.h:860,933) with VMEM-resident one-hot MXU
+contractions; the BASELINE.md roofline projection assumes its traffic
+pattern, so its correctness is part of the perf claim."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ydf_tpu.ops.histogram import histogram
+from ydf_tpu.ops.histogram_pallas import histogram_pallas
+
+
+@pytest.mark.parametrize(
+    "n,F,L,B,S",
+    [
+        (500, 4, 8, 16, 3),     # tiny, non-multiple n
+        (1024, 28, 32, 256, 3),  # bench-layer shape (scaled down in n)
+        (777, 5, 1, 256, 2),     # single slot (root layer), odd n
+        (2500, 3, 512, 64, 3),   # frontier > 128 (multi-tile slot axis)
+        (64, 9, 96, 32, 1),      # L not a multiple of 128, S=1
+    ],
+)
+def test_matches_segment_oracle(n, F, L, B, S):
+    rng = np.random.default_rng(n)
+    bins = jnp.asarray(rng.integers(0, B, (n, F)), jnp.uint8)
+    # slot L is the trash slot: inactive examples must contribute nothing
+    slot = jnp.asarray(rng.integers(0, L + 1, (n,)), jnp.int32)
+    stats = jnp.asarray(rng.normal(size=(n, S)), jnp.float32)
+    h_ref = histogram(bins, slot, stats, num_slots=L, num_bins=B,
+                      impl="segment")
+    h_pal = histogram_pallas(bins, slot, stats, num_slots=L, num_bins=B,
+                             interpret=True)
+    assert h_pal.shape == (L, F, B, S)
+    np.testing.assert_allclose(np.asarray(h_ref), np.asarray(h_pal),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_all_trash_is_zero():
+    bins = jnp.zeros((100, 3), jnp.uint8)
+    slot = jnp.full((100,), 4, jnp.int32)  # all in trash slot L=4
+    stats = jnp.ones((100, 2), jnp.float32)
+    h = histogram_pallas(bins, slot, stats, num_slots=4, num_bins=8,
+                         interpret=True)
+    assert float(jnp.abs(h).max()) == 0.0
+
+
+def test_dispatch_via_histogram_impl():
+    """impl="pallas_interpret" routes through the shared dispatch."""
+    rng = np.random.default_rng(7)
+    bins = jnp.asarray(rng.integers(0, 16, (300, 4)), jnp.uint8)
+    slot = jnp.asarray(rng.integers(0, 9, (300,)), jnp.int32)
+    stats = jnp.asarray(rng.normal(size=(300, 3)), jnp.float32)
+    h1 = histogram(bins, slot, stats, num_slots=8, num_bins=16,
+                   impl="segment")
+    h2 = histogram(bins, slot, stats, num_slots=8, num_bins=16,
+                   impl="pallas_interpret")
+    np.testing.assert_allclose(np.asarray(h1), np.asarray(h2),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_grow_tree_equivalent_trees():
+    """A whole tree grown with the Pallas kernel equals the segment
+    build: identical structure and leaf stats."""
+    from ydf_tpu.config import TreeConfig
+    from ydf_tpu.ops.grower import grow_tree
+    from ydf_tpu.ops.split_rules import HessianGainRule
+
+    rng = np.random.default_rng(3)
+    n, F = 2000, 6
+    bins = jnp.asarray(rng.integers(0, 32, (n, F)), jnp.uint8)
+    g = rng.normal(size=n).astype(np.float32)
+    stats = jnp.asarray(np.stack([g, np.ones(n), np.ones(n)], 1))
+    cfg = TreeConfig(max_depth=4, num_bins=32)
+    rule = HessianGainRule(l2=0.1)
+    kw = dict(rule=rule, max_depth=4, frontier=cfg.frontier,
+              max_nodes=cfg.max_nodes, num_bins=32, num_numerical=F)
+    key = jax.random.PRNGKey(0)
+    r_seg = grow_tree(bins, stats, key, hist_impl="segment", **kw)
+    r_pal = grow_tree(bins, stats, key, hist_impl="pallas_interpret", **kw)
+    np.testing.assert_array_equal(np.asarray(r_seg.tree.feature),
+                                  np.asarray(r_pal.tree.feature))
+    np.testing.assert_array_equal(np.asarray(r_seg.tree.threshold_bin),
+                                  np.asarray(r_pal.tree.threshold_bin))
+    np.testing.assert_allclose(np.asarray(r_seg.tree.leaf_stats),
+                               np.asarray(r_pal.tree.leaf_stats),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_kernel_lowers_to_mosaic():
+    from ydf_tpu.utils import tpu_lowering as tl
+
+    exp = tl.export_histogram_pallas(n=4096, F=8, L=32, B=64)
+    assert exp.platforms == ("tpu",)
+    assert "tpu_custom_call" in exp.mlir_module()
+
+
+def test_train_step_with_pallas_hist_lowers_for_tpu():
+    """The FULL boosting loop with the Mosaic histogram kernel embedded
+    lowers for platform 'tpu' — the strongest device-less training
+    evidence available without silicon."""
+    from ydf_tpu.utils import tpu_lowering as tl
+
+    exp = tl.export_train_step(
+        hist_impl="pallas", n=2048, F=8, num_trees=2, max_depth=4
+    )
+    assert exp.platforms == ("tpu",)
+    assert "tpu_custom_call" in exp.mlir_module()

@@ -22,15 +22,17 @@ def _df(n=4000, seed=0):
 
 def test_gbt_deadline_truncates():
     df = _df()
-    # A deadline that expires during the loop: the first chunk always
-    # completes, later ones do not start. 200 trees would take many
-    # chunks; expect strictly fewer trees, in whole-chunk units.
+    # A deadline that has already expired when the first chunk finishes
+    # (1 µs): exactly the guaranteed-to-complete first chunk trains, no
+    # matter how fast the machine is — the 0.5 s variant of this test
+    # was wall-clock dependent (advisor r4).
     m = ydf.GradientBoostedTreesLearner(
         label="y", task=Task.REGRESSION, num_trees=200, max_depth=4,
         validation_ratio=0.0, early_stopping="NONE",
-        maximum_training_duration=0.5,
+        maximum_training_duration=1e-6,
     ).train(df)
     assert 0 < m.num_trees() < 200
+    assert m.num_trees() % 25 == 0  # whole chunks only
     # The truncated model predicts (structure is complete).
     p = m.predict(df.head(10))
     assert np.isfinite(np.asarray(p)).all()
@@ -58,10 +60,10 @@ def test_rf_deadline_truncates():
     m = ydf.RandomForestLearner(
         label="y", task=Task.REGRESSION, num_trees=300,
         compute_oob_performances=False,
-        maximum_training_duration=0.5,
+        maximum_training_duration=1e-6,
     ).train(df)
-    # Whole chunks of 25 trees; at least one chunk, strictly fewer than
-    # the full 300 within half a second on this box.
+    # Whole chunks of 25 trees; the already-expired deadline (1 µs)
+    # guarantees truncation after the first chunk on any machine.
     assert 0 < m.num_trees() < 300
     assert m.num_trees() % 25 == 0
     p = m.predict(df.head(10))
@@ -73,7 +75,7 @@ def test_rf_deadline_with_oob_keeps_consistent_count():
     df = _df(1500)
     m = ydf.RandomForestLearner(
         label="y", task=Task.REGRESSION, num_trees=300,
-        maximum_training_duration=0.5,
+        maximum_training_duration=1e-6,
     ).train(df)
     assert m.oob_evaluation["num_trees"] == m.num_trees() < 300
 

@@ -101,7 +101,7 @@ def test_hist_impl_env_resolution(monkeypatch):
     monkeypatch.setenv("YDF_TPU_HIST_IMPL", "matmul")
     assert resolve_hist_impl("auto") == "matmul"
     monkeypatch.delenv("YDF_TPU_HIST_IMPL")
-    assert resolve_hist_impl("auto") in ("segment", "matmul")
+    assert resolve_hist_impl("auto") in ("segment", "matmul", "native")
     assert resolve_hist_impl("segment") == "segment"
 
 

@@ -118,8 +118,7 @@ def _grow_tree_jit(
     num_valid_features: Optional[int] = None,  # real (unpadded) columns
     # Concrete impl only — "auto" must be resolved by the grow_tree
     # wrapper; a literal "auto" here would be baked into the jit cache
-    # key and pin the first resolution forever (histogram's dispatch
-    # raises on it, making the invariant self-enforcing).
+    # key and pin the first resolution forever (the body raises on it).
     hist_impl: str = "segment",
     rule_ctx: Any = None,
     # Per-feature monotone directions (+1 / -1 / 0), static tuple of
@@ -142,6 +141,13 @@ def _grow_tree_jit(
     # explores the same sorted-order family sequentially.
     set_bits: Optional[jax.Array] = None,
 ) -> GrowResult:
+    if hist_impl == "auto":
+        raise ValueError(
+            "grow_tree's jitted core requires a concrete hist_impl — "
+            "call grow_tree() (the wrapper resolves 'auto' before the "
+            "jit cache key; a literal 'auto' would pin the first "
+            "resolution forever)"
+        )
     n, F = bins.shape
     S = stats.shape[1]
     L, B, N = frontier, num_bins, max_nodes

@@ -1,0 +1,149 @@
+"""Pallas/Mosaic histogram kernel — the TPU-native form of the training
+hot loop.
+
+The XLA `_histogram_matmul` impl (ops/histogram.py) expresses the
+histogram as one-hot matmuls, but XLA materializes every one-hot operand
+in HBM: ~[chunk, B] f32 per feature per layer, ≈17 TB of traffic per
+tree at the bench shape — two orders of magnitude over the input
+re-read floor, flipping the op from compute-bound to hopelessly
+memory-bound. This kernel is the fix: one-hot tiles are BUILT IN VMEM
+(a broadcasted-iota compare), fed straight to the MXU, and never touch
+HBM. Traffic drops to the floor (bins + stats re-read per layer); the
+roofline projection in BASELINE.md assumes exactly this kernel.
+
+Layout: grid (feature_blocks, example_chunks), sequential on TPU, so
+the output block for one feature slice stays resident in VMEM while the
+example chunks sweep (accumulation across grid steps along the last
+grid axis). Per step, for each (feature f, stat s) the kernel computes
+
+    out[f, s] += onehot(bins[:, f])[C, B]^T  @  (slot_onehot * stats_s)[C, Lp]
+
+an MXU dot with the example chunk C as the contraction dimension —
+deep in the systolic array's efficient regime (C = 1024 by default).
+The slot one-hot zero-fills trash rows (slot == L: inactive or padded
+examples), which either land in a padded column (sliced off by the
+wrapper) or outside the iota range entirely.
+
+f32 operands for bit-faithful parity with the segment oracle; the
+one-hot operand is exact in bf16, so a bf16x2 split of `stats` is the
+future 2x-throughput knob, not a correctness change.
+
+Reference counterpart: the per-(node, feature) bucket-fill scan loops
+`ydf/learner/decision_tree/splitter_scanner.h:860,933` — one linear
+pass per open node per feature on CPU; here the whole layer's
+(nodes x features x bins) histogram is a batch of dense contractions.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _hist_kernel(bins_ref, slot_ref, stats_ref, out_ref, *, Fb, S, B, Lp):
+    """One (feature-block, example-chunk) grid step.
+
+    Everything rides an example-minor [*, C] layout so the chunk C is the
+    (128-divisible) lane dimension of every block and the contraction
+    dimension of every dot — Mosaic's block rules want the last two dims
+    (8, 128)-divisible or full.
+
+    bins_ref  [Fb, C] int32   feature bin ids for this chunk/block
+    slot_ref  [1, C]  int32   frontier slot; >= L means inactive/pad
+    stats_ref [S, C]  f32     per-example statistics
+    out_ref   [Fb, S, B, Lp] f32  accumulated across the chunk axis
+    """
+    c_step = pl.program_id(1)
+
+    @pl.when(c_step == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    C = bins_ref.shape[1]
+    slot_ohT = (
+        slot_ref[...] == jax.lax.broadcasted_iota(jnp.int32, (Lp, C), 0)
+    ).astype(jnp.float32)  # [Lp, C]; trash rows all-zero or padded-row
+    biotaT = jax.lax.broadcasted_iota(jnp.int32, (B, C), 0)
+    for f in range(Fb):
+        ohT = (bins_ref[f : f + 1, :] == biotaT).astype(jnp.float32)  # [B,C]
+        for s in range(S):
+            aT = slot_ohT * stats_ref[s : s + 1, :]  # [Lp, C]
+            h = jax.lax.dot_general(
+                ohT, aT, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [B, Lp]
+            out_ref[f, s, :, :] += h
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_slots", "num_bins", "chunk", "feature_block", "interpret"
+    ),
+)
+def histogram_pallas(
+    bins: jax.Array,   # int-like [n, F]
+    slot: jax.Array,   # int32 [n], L = trash
+    stats: jax.Array,  # f32 [n, S]
+    num_slots: int,
+    num_bins: int = 256,
+    chunk: int = 1024,
+    feature_block: int | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Returns hist[num_slots, F, num_bins, S], same contract as
+    ops/histogram.py:histogram."""
+    n, F = bins.shape
+    S = stats.shape[1]
+    L, B = num_slots, num_bins
+    Lp = _round_up(max(L, 1), 128)
+
+    if feature_block is None:
+        # Keep the resident output block around ~6 MB of VMEM.
+        per_f = S * B * Lp * 4
+        feature_block = max(1, min(F, (6 << 20) // max(per_f, 1)))
+    Fb = feature_block
+    Fp = _round_up(F, Fb)
+
+    n_pad = _round_up(max(n, 1), chunk)
+    bins_i = bins.astype(jnp.int32)
+    if Fp != F:
+        # Padded feature columns histogram garbage; sliced off below.
+        bins_i = jnp.pad(bins_i, ((0, 0), (0, Fp - F)))
+    if n_pad != n:
+        bins_i = jnp.pad(bins_i, ((0, n_pad - n), (0, 0)))
+        # Padded examples fall in the trash slot -> all-zero one-hot row
+        # (or the sliced padded row when L < Lp).
+        slot = jnp.pad(slot, (0, n_pad - n), constant_values=L)
+        stats = jnp.pad(stats, ((0, n_pad - n), (0, 0)))
+
+    grid = (Fp // Fb, n_pad // chunk)
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel, Fb=Fb, S=S, B=B, Lp=Lp),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((Fb, chunk), lambda fb, c: (fb, c)),
+            pl.BlockSpec((1, chunk), lambda fb, c: (0, c)),
+            pl.BlockSpec((S, chunk), lambda fb, c: (0, c)),
+        ],
+        out_specs=pl.BlockSpec(
+            (Fb, S, B, Lp), lambda fb, c: (fb, 0, 0, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((Fp, S, B, Lp), jnp.float32),
+        interpret=interpret,
+    )(
+        bins_i.T,
+        slot.astype(jnp.int32)[None, :],
+        stats.astype(jnp.float32).T,
+    )
+
+    # [Fp, S, B, Lp] -> [L, F, B, S]
+    return jnp.transpose(out[:F, :, :, :L], (3, 0, 2, 1))

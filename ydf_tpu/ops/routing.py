@@ -324,14 +324,24 @@ def forest_leaves(
     return leaves.T
 
 
-@functools.partial(jax.jit, static_argnames=("chunk",))
 def leaf_proximity(
     leaves1: jax.Array, leaves2: jax.Array, chunk: int = 1024
 ) -> jax.Array:
     """Breiman proximity: fraction of trees routing a pair to the SAME
     leaf — f32 [n1, n2] (reference Proximity,
-    random_forest/random_forest.h:211-217). Chunked over rows of
-    leaves1 so the [chunk, n2, T] comparison tensor stays bounded."""
+    random_forest/random_forest.h:211-217). The leaves1 chunk size is
+    capped by n2*T so the [chunk, n2, T] comparison tensor stays bounded
+    (~256 MB) regardless of the data2/tree sizes — a fixed chunk would
+    allocate multi-GB blocks at e.g. 20k rows x 300 trees."""
+    n2, T = leaves2.shape
+    cap = max(1, (1 << 26) // max(n2 * T, 1))
+    return _leaf_proximity_jit(leaves1, leaves2, min(chunk, cap))
+
+
+@functools.partial(jax.jit, static_argnames=("chunk",))
+def _leaf_proximity_jit(
+    leaves1: jax.Array, leaves2: jax.Array, chunk: int
+) -> jax.Array:
     n1, T = leaves1.shape
     n1p = ((n1 + chunk - 1) // chunk) * chunk
     l1 = jnp.pad(leaves1, ((0, n1p - n1), (0, 0)))

@@ -78,25 +78,28 @@ def compile_forest_cached(
     the registry's IsCompatible check and the engine build."""
     import weakref
 
+    # Every array the compiled QuickScorerModel depends on — a rebuilt
+    # forest differing in ANY of them (thresholds, topology, masks,
+    # leaves) at a recycled id() must miss, not serve a stale engine.
+    guarded = (
+        forest.feature, forest.threshold, forest.threshold_bin,
+        forest.is_cat, forest.cat_mask, forest.left, forest.right,
+        forest.is_leaf, forest.leaf_value,
+    )
     key = (id(forest), num_numerical, num_features)
     hit = _COMPILE_CACHE.get(key)
-    if (
-        hit is not None
-        and hit[0]() is forest.feature
-        and hit[1]() is forest.leaf_value
+    if hit is not None and all(
+        r() is a for r, a in zip(hit[0], guarded)
     ):
-        # Both structure and values must be the very same arrays — a
-        # rebuilt forest sharing one array (e.g. leaves swapped by
-        # update_with_jax_params) must miss.
-        return hit[2]
+        return hit[1]
     qsm = compile_forest(forest, num_numerical, num_features=num_features)
     if len(_COMPILE_CACHE) >= _COMPILE_CACHE_CAP:
         _COMPILE_CACHE.pop(next(iter(_COMPILE_CACHE)))
     try:
-        refs = (weakref.ref(forest.feature), weakref.ref(forest.leaf_value))
+        refs = tuple(weakref.ref(a) for a in guarded)
     except TypeError:  # plain ndarray fields are not weakref-able
         return qsm
-    _COMPILE_CACHE[key] = refs + (qsm,)
+    _COMPILE_CACHE[key] = (refs, qsm)
     return qsm
 
 

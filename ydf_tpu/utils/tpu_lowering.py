@@ -179,6 +179,29 @@ def export_grow_tree(
     )
 
 
+def export_histogram_pallas(
+    n: int = 262_144, F: int = 28, L: int = 32, B: int = 256,
+    platforms=("tpu",),
+):
+    """jax.export of the Mosaic histogram training kernel
+    (ops/histogram_pallas.py) at a bench-layer shape."""
+    from ydf_tpu.ops.histogram_pallas import histogram_pallas
+
+    args = (
+        jax.ShapeDtypeStruct((n, F), jnp.uint8),
+        jax.ShapeDtypeStruct((n,), jnp.int32),
+        jax.ShapeDtypeStruct((n, 3), jnp.float32),
+    )
+    return jax.export.export(
+        jax.jit(
+            lambda b, s, st: histogram_pallas(
+                b, s, st, num_slots=L, num_bins=B
+            )
+        ),
+        platforms=tuple(platforms),
+    )(*args)
+
+
 def _tiny_quickscorer_engine():
     """A real QuickScorer engine compiled from a small trained model
     (interpret=False so lowering emits the Mosaic kernel)."""
@@ -373,9 +396,15 @@ def write_artifacts(outdir: str | Path, full_scale: bool = True) -> dict:
         "train_step_segment": lambda: export_train_step(
             hist_impl="segment", **scale
         ),
+        # The flagship: the boosting loop with the Mosaic histogram
+        # kernel (ops/histogram_pallas.py) embedded as tpu_custom_call.
+        "train_step_pallas": lambda: export_train_step(
+            hist_impl="pallas", **scale
+        ),
         "grow_tree_matmul": lambda: export_grow_tree(
             hist_impl="matmul", **scale
         ),
+        "histogram_pallas_kernel": export_histogram_pallas,
         "quickscorer_kernel": export_quickscorer,
         "vector_sequence_kernel": export_vector_sequence,
     }
