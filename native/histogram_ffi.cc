@@ -19,6 +19,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <vector>
 
 #include "xla/ffi/api/ffi.h"
 
@@ -35,8 +36,26 @@ static ffi::Error HistogramImpl(ffi::Buffer<ffi::DataType::U8> bins,
   const uint8_t* bp = bins.typed_data();
   const int32_t* sp = slot.typed_data();
   const float* stp = stats.typed_data();
-  float* op = out->typed_data();
-  std::memset(op, 0, sizeof(float) * L * F * B * S);
+  float* outp = out->typed_data();
+
+  // f64 accumulators (the reference's splitter sums are double too,
+  // utils/distribution.h): keeps the result row-order invariant to
+  // float tolerance and loses no gradient mass at n in the millions.
+  // The scratch is thread_local and grow-only: this runs once per layer
+  // per tree, and re-allocating ~100+ MB each call would dominate; a
+  // bad_alloc must surface as an FFI error, not cross the C boundary.
+  static thread_local std::vector<double> acc;
+  const size_t need = static_cast<size_t>(L) * F * B * S;
+  if (acc.size() < need) {
+    try {
+      acc.resize(need);
+    } catch (const std::bad_alloc&) {
+      return ffi::Error(ffi::ErrorCode::kResourceExhausted,
+                        "histogram scratch allocation failed");
+    }
+  }
+  std::memset(acc.data(), 0, sizeof(double) * need);
+  double* op = acc.data();
 
   // Accumulation layout matches the output directly: row stride of one
   // slot is F*B*S; one feature is B*S. For the common S=3 the inner
@@ -49,13 +68,13 @@ static ffi::Error HistogramImpl(ffi::Buffer<ffi::DataType::U8> bins,
     for (int64_t i = 0; i < n; ++i) {
       const int32_t l = sp[i];
       if (l < 0 || l >= L) continue;  // trash slot: inactive/padded row
-      const float g = stp[i * 3], h = stp[i * 3 + 1], w = stp[i * 3 + 2];
+      const double g = stp[i * 3], h = stp[i * 3 + 1], w = stp[i * 3 + 2];
       const uint8_t* br = bp + i * F;
-      float* orow = op + l * fbs;
+      double* orow = op + l * fbs;
       for (int64_t f = 0; f < F; ++f) {
         const int64_t b = br[f];
         if (b >= B) continue;
-        float* cell = orow + f * bs + b * 3;
+        double* cell = orow + f * bs + b * 3;
         cell[0] += g;
         cell[1] += h;
         cell[2] += w;
@@ -67,15 +86,17 @@ static ffi::Error HistogramImpl(ffi::Buffer<ffi::DataType::U8> bins,
       if (l < 0 || l >= L) continue;
       const float* srow = stp + i * S;
       const uint8_t* br = bp + i * F;
-      float* orow = op + l * fbs;
+      double* orow = op + l * fbs;
       for (int64_t f = 0; f < F; ++f) {
         const int64_t b = br[f];
         if (b >= B) continue;
-        float* cell = orow + f * bs + b * S;
+        double* cell = orow + f * bs + b * S;
         for (int64_t s = 0; s < S; ++s) cell[s] += srow[s];
       }
     }
   }
+  const int64_t total = L * F * B * S;
+  for (int64_t i = 0; i < total; ++i) outp[i] = static_cast<float>(op[i]);
   return ffi::Error::Success();
 }
 

@@ -110,3 +110,43 @@ def test_without_libs_unsupported_type_still_errors():
 
     with pytest.raises(TypeError, match="Unsupported dataset type"):
         Dataset.from_data(Mystery())
+
+
+# ---- real-library variants (VERDICT r4 #8) --------------------------------
+# Same bodies as the fake-module tests, gated on the actual libraries:
+# they skip cleanly in this image (neither lib is installed) and light up
+# on any machine that has them, validating the documented-surface
+# assumption against the real API (ref: port/python/ydf/dataset/io/).
+
+
+def test_real_polars_ingests_and_trains():
+    pl = pytest.importorskip("polars")
+    cols = _cols(seed=2)
+    df = pl.DataFrame({k: list(v) for k, v in cols.items()})
+    ds = Dataset.from_data(df, label="label")
+    assert ds.num_rows == 300
+    m = ydf.GradientBoostedTreesLearner(
+        label="label", num_trees=3, validation_ratio=0.0,
+        early_stopping="NONE",
+    ).train(df)
+    p1 = np.asarray(m.predict(df))
+    p2 = np.asarray(m.predict(cols))
+    np.testing.assert_array_equal(p1, p2)
+
+
+def test_real_xarray_ingests():
+    xr = pytest.importorskip("xarray")
+    cols = _cols(seed=3)
+    ds = Dataset.from_data(
+        xr.Dataset({k: ("row", v) for k, v in cols.items()}), label="label"
+    )
+    assert ds.num_rows == 300
+    np.testing.assert_array_equal(ds.data["a"], cols["a"])
+
+
+def test_real_xarray_rejects_multidim():
+    xr = pytest.importorskip("xarray")
+    with pytest.raises(ValueError, match="1-D"):
+        Dataset.from_data(
+            xr.Dataset({"m": (("x", "y"), np.zeros((4, 4)))}), label=None
+        )

@@ -133,3 +133,125 @@ def test_export_ranking_hash_group(tmp_path):
     from ydf_tpu.dataset.dataspec import ColumnType
     assert m.dataspec.column_by_name("q").type == ColumnType.HASH
     _roundtrip(m, data, tmp_path)
+
+
+# ---- schema-level assertions against REFERENCE golden files ---------------
+# (VERDICT r4 #7.) The read path is validated against genuine
+# reference-produced models; these pin the WRITE path to the same wire
+# schema — field inventories, blob-sequence framing, shard naming — so a
+# writer bug our own symmetric reader would silently accept still fails.
+# Ref: utils/blob_sequence.h:125-149, model/decision_tree/
+# decision_tree.proto:202, model/abstract_model.proto.
+
+import os
+import struct
+
+from ydf_tpu.models.ydf_format import read_blob_sequence
+from ydf_tpu.utils import protowire as pw
+
+GOLD = f"{MD}/adult_binary_class_gbdt"
+
+
+def _field_set(msg) -> set:
+    # protowire.Message is {field_number: [raw values]}
+    return set(msg.keys())
+
+
+def _fields(path) -> set:
+    with open(path, "rb") as f:
+        return _field_set(pw.decode(f.read()))
+
+
+def _trained_dir(tmp_path):
+    import pandas as pd
+
+    adult = pd.read_csv(
+        f"{D}/adult_train.csv"
+    ).head(3000)
+    m = ydf.GradientBoostedTreesLearner(
+        label="income", num_trees=5, max_depth=4, validation_ratio=0.0,
+        early_stopping="NONE",
+    ).train(adult)
+    out = str(tmp_path / "schema_m")
+    m.save_ydf(out)
+    return out
+
+
+def test_export_file_inventory_matches_reference(tmp_path):
+    ours = _trained_dir(tmp_path)
+    ref_files = set(os.listdir(GOLD))
+    our_files = set(os.listdir(ours))
+    # Every structural file class of the reference GBT dir must exist.
+    for required in ("header.pb", "data_spec.pb", "done",
+                     "gradient_boosted_trees_header.pb",
+                     "nodes-00000-of-00001"):
+        assert required in ref_files  # golden sanity
+        assert required in our_files, f"missing {required}"
+
+
+def test_export_header_field_inventory(tmp_path):
+    ours = _trained_dir(tmp_path)
+    ref_h = _fields(f"{GOLD}/header.pb")
+    our_h = _fields(f"{ours}/header.pb")
+    # The writer must emit no field number the reference file does not
+    # use (unknown fields would be silently preserved by real YDF and
+    # corrupt nothing — but they indicate a schema drift bug here).
+    assert our_h <= ref_h, f"unknown header fields {our_h - ref_h}"
+    # And the core identity fields must be present.
+    assert {1, 2} <= our_h  # name, task family per abstract_model.proto
+
+
+def test_export_dataspec_column_schema(tmp_path):
+    ours = _trained_dir(tmp_path)
+    with open(f"{GOLD}/data_spec.pb", "rb") as f:
+        ref_spec = pw.decode(f.read())
+    with open(f"{ours}/data_spec.pb", "rb") as f:
+        our_spec = pw.decode(f.read())
+    ref_cols = pw.get_repeated_msg(ref_spec, 1)
+    our_cols = pw.get_repeated_msg(our_spec, 1)
+    assert ref_cols and our_cols
+    ref_union = set()
+    for c in ref_cols:
+        ref_union |= _field_set(c)
+    for c in our_cols:
+        extra = _field_set(c) - ref_union
+        assert not extra, f"column emits unknown fields {extra}"
+        assert {1, 2} <= _field_set(c)  # name + type always present
+
+
+def test_export_blob_sequence_framing(tmp_path):
+    ours = _trained_dir(tmp_path)
+    ref_nodes = f"{GOLD}/nodes-00000-of-00001"
+    our_nodes = f"{ours}/nodes-00000-of-00001"
+    with open(ref_nodes, "rb") as f:
+        ref_head = f.read(8)
+    with open(our_nodes, "rb") as f:
+        our_head = f.read(8)
+    # Magic must match; version may legitimately differ (the reference
+    # writes v1, we write v0-uncompressed which every reader accepts).
+    assert our_head[:2] == ref_head[:2] == b"BS"
+    version = struct.unpack_from("<H", our_head, 2)[0]
+    assert version in (0, 1)
+    # Both parse as blob sequences with >= 1 record.
+    assert sum(1 for _ in read_blob_sequence(our_nodes)) >= 1
+    assert sum(1 for _ in read_blob_sequence(ref_nodes)) >= 1
+
+
+def test_export_node_records_use_reference_field_schema(tmp_path):
+    ours = _trained_dir(tmp_path)
+    ref_union = set()
+    ref_cond_union = set()
+    for rec in read_blob_sequence(f"{GOLD}/nodes-00000-of-00001"):
+        node = pw.decode(rec)
+        ref_union |= _field_set(node)
+        cond = pw.get_msg(node, 3)  # NodeCondition
+        if cond is not None:
+            ref_cond_union |= _field_set(cond)
+    for rec in read_blob_sequence(f"{ours}/nodes-00000-of-00001"):
+        node = pw.decode(rec)
+        extra = _field_set(node) - ref_union
+        assert not extra, f"node emits unknown fields {extra}"
+        cond = pw.get_msg(node, 3)
+        if cond is not None:
+            extra_c = _field_set(cond) - ref_cond_union
+            assert not extra_c, f"condition emits unknown fields {extra_c}"

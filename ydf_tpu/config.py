@@ -34,6 +34,50 @@ def is_tpu_backend() -> bool:
         return False
 
 
+def resolve_num_bins(num_bins, n: int, min_cat_vocab: int = 0) -> int:
+    """Resolves num_bins="auto" against the dataset size.
+
+    The dense layer buffers are [Ld, F, B, S] — independent of n — so at
+    small n the B axis dominates training cost (round-4 profile: abalone
+    RF spent ~0.7 s/tree streaming 256-bin buffers over 4.2k rows).
+    "auto" = pow2ceil(n / 180) clipped to [64, 256]; an explicit int is
+    honored unchanged. Calibrated on measured quality (round 5): adult
+    (22.8k rows) at B=128 keeps AUC bit-identical to 256 while halving
+    the wall (3.7 -> 1.9 s); B=64 there costs 1pt AUC, hence the 180
+    rows/bin knee and the 64 floor.
+
+    `min_cat_vocab`: largest categorical dictionary among the training
+    features. Dictionary indices >= num_bins collapse to OOV
+    (dataset/binning.py), so the auto result is floored at that vocab —
+    shrinking bins must never silently drop categories the old 256
+    default kept."""
+    if num_bins != "auto":
+        return int(num_bins)
+    floor = 64
+    while floor < 256 and floor < min_cat_vocab:
+        floor *= 2
+    if n >= 180 * 256:
+        return 256
+    b = floor
+    while b < 256 and b * 180 < n:
+        b *= 2
+    return b
+
+
+def resolve_max_frontier(max_frontier, n: int, min_examples: int) -> int:
+    """Resolves max_frontier="auto": a layer can never usefully hold more
+    open nodes than n / (2*min_examples) (each split needs min_examples
+    per child), so cap the frontier there — pow2-rounded up, bounded by
+    the 1024 default. An explicit int is honored unchanged."""
+    if max_frontier != "auto":
+        return int(max_frontier)
+    need = max(2, n // max(2 * min_examples, 1))
+    p = 2
+    while p < need and p < 1024:
+        p *= 2
+    return min(p, 1024)
+
+
 class Task(enum.Enum):
     """Modeling task. Reference: ydf/model/abstract_model.proto:Task."""
 

@@ -199,7 +199,7 @@ def create_dataset_cache(
     task: Task = Task.CLASSIFICATION,
     weights: Optional[str] = None,
     features: Optional[List[str]] = None,
-    num_bins: int = 256,
+    num_bins="auto",
     chunk_rows: int = 500_000,
     max_vocab_count: int = 2000,
     min_vocab_frequency: int = 5,
@@ -352,8 +352,26 @@ def create_dataset_cache(
             surrogate[name] = np.full((slen,), OOV_ITEM, object)
         else:
             surrogate[name] = np.zeros((slen,), np.float32)
+    # "auto" resolves against the TRUE row count (not the sketch-sample
+    # size) with the same rule as in-memory training — including the
+    # categorical-vocab floor — so a model trained from this cache
+    # equals one trained from the equivalent in-memory dataset
+    # (tests/test_dataset_cache.py composition assertions).
+    from ydf_tpu.config import resolve_num_bins
+
+    max_vocab = max(
+        (
+            spec.column_by_name(f).vocab_size
+            for f in feature_names
+            if spec.column_by_name(f).type == ColumnType.CATEGORICAL
+        ),
+        default=0,
+    )
     binner = Binner.fit(
-        Dataset(surrogate, spec), feature_names, num_bins=num_bins
+        Dataset(surrogate, spec), feature_names,
+        num_bins=resolve_num_bins(
+            num_bins, num_rows, min_cat_vocab=max_vocab
+        ),
     )
 
     # ---- pass 2: bin chunks into the memmap ------------------------- #

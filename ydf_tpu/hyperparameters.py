@@ -60,6 +60,7 @@ class HyperParameter:
     max_value: Optional[float] = None
     choices: Optional[Tuple[str, ...]] = None
     kind: str = "hyperparameter"  # or "config"
+    allow_auto: bool = False  # int parameter also accepting "auto"
 
     def to_json(self) -> Dict[str, Any]:
         default = self.default
@@ -79,6 +80,10 @@ class HyperParameter:
             out["max_value"] = self.max_value
         if self.choices is not None:
             out["choices"] = list(self.choices)
+        if self.allow_auto:
+            # Explains the int-typed parameter's "auto" default to
+            # spec-driven consumers (cli.py prints this JSON).
+            out["allow_auto"] = True
         return out
 
 
@@ -88,6 +93,10 @@ class _Info:
     min_value: Optional[float] = None
     max_value: Optional[float] = None
     choices: Optional[Tuple[str, ...]] = None
+    # int-typed parameter that also accepts the literal "auto" (resolved
+    # against the dataset at train time, e.g. num_bins/max_frontier
+    # shrinking to small data).
+    allow_auto: bool = False
 
 
 # Curated constraint/doc table, shared across learners (the reference
@@ -104,8 +113,11 @@ _PARAM_INFO: Dict[str, _Info] = {
         "the dictionary.", min_value=1),
     "num_bins": _Info(
         "Number of histogram bins per numerical feature (including the "
-        "missing-value bin). The uint8 bin matrix caps this at 256.",
-        min_value=2, max_value=256),
+        "missing-value bin). The uint8 bin matrix caps this at 256. "
+        "\"auto\" (default) shrinks to the dataset — pow2ceil(n/180) "
+        "clipped to [64, 256] — so small-data training does not stream "
+        "256-bin layer buffers for a 4k-row dataset.",
+        min_value=2, max_value=256, allow_auto=True),
     "discretize_numerical_columns": _Info(
         "Pre-discretize all numerical columns in the dataspec "
         "(DISCRETIZED_NUMERICAL in the reference): cheaper training, "
@@ -126,7 +138,10 @@ _PARAM_INFO: Dict[str, _Info] = {
     "max_frontier": _Info(
         "Maximum open nodes per layer (static-shape analogue of the "
         "reference's best-first growth cap: when a layer would exceed it, "
-        "only the highest-gain splits survive).", min_value=1),
+        "only the highest-gain splits survive). \"auto\" (default) caps "
+        "at pow2ceil(n / (2*min_examples)), bounded by 1024 — a layer "
+        "can never usefully hold more open nodes than that.",
+        min_value=1, allow_auto=True),
     "num_candidate_attributes": _Info(
         "Number of features sampled per node as split candidates. 0 uses "
         "the task default (sqrt(F) classification, F/3 regression); -1 "
@@ -355,6 +370,10 @@ def hyperparameter_spec(cls: Type) -> Dict[str, HyperParameter]:
         ptype = _type_of(default, p.annotation)
         if info and info.choices is not None:
             ptype = "enum"
+        if info and info.allow_auto:
+            # "auto" defaults would infer as str; the parameter is an int
+            # with a dataset-resolved sentinel.
+            ptype = "int"
         spec[name] = HyperParameter(
             name=name,
             type=ptype,
@@ -364,6 +383,7 @@ def hyperparameter_spec(cls: Type) -> Dict[str, HyperParameter]:
             max_value=info.max_value if info else None,
             choices=info.choices if info else None,
             kind=kind,
+            allow_auto=bool(info and info.allow_auto),
         )
     return spec
 
@@ -398,6 +418,8 @@ def _check_value(hp: HyperParameter, value: Any, cls_name: str) -> None:
             )
         return
     if hp.type in ("int", "float"):
+        if hp.allow_auto and value == "auto":
+            return
         # numpy scalars are everyday inputs (np.int64 from np.arange,
         # np.float32 from a search grid) — accept them alongside the
         # Python types; np.bool_ is rejected like bool.

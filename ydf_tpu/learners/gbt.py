@@ -94,7 +94,7 @@ class GradientBoostedTreesLearner(GenericLearner):
         ranking_max_group_size: int = 2048,
         label_event_observed: Optional[str] = None,
         label_entry_age: Optional[str] = None,
-        max_frontier: int = 1024,
+        max_frontier="auto",
         sampling_method: str = "RANDOM",
         goss_alpha: float = 0.2,
         goss_beta: float = 0.1,
@@ -570,10 +570,17 @@ class GradientBoostedTreesLearner(GenericLearner):
         else:
             cand = -1
 
+        from ydf_tpu.config import resolve_max_frontier
+
         tree_cfg = TreeConfig(
             max_depth=self.max_depth,
-            max_frontier=self.max_frontier,
-            num_bins=self.num_bins,
+            # "auto" shrinks the frontier/bin axes of the dense layer
+            # buffers to the dataset (config.py resolvers); the binner
+            # already resolved num_bins against the training rows.
+            max_frontier=resolve_max_frontier(
+                self.max_frontier, bins_tr.shape[0], self.min_examples
+            ),
+            num_bins=binner.num_bins,
             min_examples=self.min_examples,
         )
         rule = HessianGainRule(l2=self.l2_regularization)

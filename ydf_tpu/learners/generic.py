@@ -35,7 +35,7 @@ class GenericLearner(HyperparameterValidationMixin):
         weights: Optional[str] = None,
         max_vocab_count: int = 2000,
         min_vocab_frequency: int = 5,
-        num_bins: int = 256,
+        num_bins="auto",
         random_seed: int = 123456,
         column_types: Optional[Dict[str, ColumnType]] = None,
         discretize_numerical_columns: bool = False,
@@ -289,7 +289,25 @@ class GenericLearner(HyperparameterValidationMixin):
             return self._prepare_from_cache(data, valid=valid)
         ds = self._infer_dataset(data)
         feature_names = self._select_feature_names(ds)
-        binned = BinnedDataset.create(ds, feature_names, num_bins=self.num_bins)
+        from ydf_tpu.config import resolve_num_bins
+
+        # Auto-shrunk bins must still hold every categorical dictionary
+        # (indices >= num_bins collapse to OOV).
+        max_vocab = max(
+            (
+                ds.dataspec.column_by_name(f).vocab_size
+                for f in feature_names
+                if ds.dataspec.column_by_name(f).type
+                == ColumnType.CATEGORICAL
+            ),
+            default=0,
+        )
+        binned = BinnedDataset.create(
+            ds, feature_names,
+            num_bins=resolve_num_bins(
+                self.num_bins, ds.num_rows, min_cat_vocab=max_vocab
+            ),
+        )
         if binned.binner.num_vs > 0 and not getattr(
             self, "_supports_vs_features", False
         ):

@@ -111,7 +111,7 @@ class IsolationForestLearner(GenericLearner):
 
         # log gap widths per (feature, cut): weight of picking cut t is the
         # value-space distance between consecutive boundaries.
-        B = self.num_bins
+        B = binner.num_bins  # "auto" already resolved at binning time
         log_gap = np.full((F, B), -np.inf, np.float32)
         for f in range(binner.num_numerical):
             nb = int(binner.feature_num_bins[f]) - 1  # number of boundaries

@@ -57,7 +57,7 @@ class RandomForestLearner(GenericLearner):
         winner_take_all: bool = True,
         compute_oob_performances: bool = True,
         compute_oob_variable_importances: bool = False,
-        max_frontier: int = 1024,
+        max_frontier="auto",
         uplift_treatment: Optional[str] = None,
         honest: bool = False,
         honest_ratio_leaf_examples: float = 0.5,
@@ -303,10 +303,16 @@ class RandomForestLearner(GenericLearner):
                 [y, jnp.square(y), jnp.ones((n,), jnp.float32)], axis=1
             )
 
+        from ydf_tpu.config import resolve_max_frontier
+
         tree_cfg = TreeConfig(
             max_depth=self.max_depth,
-            max_frontier=self.max_frontier,
-            num_bins=self.num_bins,
+            # "auto" shrinks the frontier/bin axes of the dense layer
+            # buffers to the dataset (config.py resolvers).
+            max_frontier=resolve_max_frontier(
+                self.max_frontier, n, self.min_examples
+            ),
+            num_bins=binner.num_bins,
             min_examples=self.min_examples,
         )
         # Cap node capacity by what the dataset can actually produce: every
