@@ -54,3 +54,23 @@ def iris_df():
     import pandas as pd
 
     return pd.read_csv(os.path.join(REFERENCE_DATASET_DIR, "iris.csv"))
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_compile_cache_growth():
+    """Clears JAX's tracing/compilation caches at every module boundary.
+
+    A full single-process run of this suite accumulates hundreds of
+    XLA-CPU compilations; at roughly the 35-40 minute mark the process
+    segfaulted INSIDE XLA's backend_compile_and_load (captured with
+    faulthandler, docs/xla_cpu_segfault.md) in rounds 4 and 5 — an
+    XLA-CPU-side failure under compile-cache/memory accumulation, which
+    the sharded harness masked by process recycling. Clearing per module
+    bounds the growth the same way without giving up the single-process
+    run; per-module tests still share compilations (the expensive
+    within-file reuse), and fresh processes are unaffected."""
+    yield
+    import gc
+
+    jax.clear_caches()
+    gc.collect()
