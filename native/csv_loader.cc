@@ -89,8 +89,20 @@ bool ParseDouble(const std::string& s, double* out) {
   while (b < e && std::isspace(static_cast<unsigned char>(*b))) ++b;
   while (e > b && std::isspace(static_cast<unsigned char>(e[-1]))) --e;
   if (b < e && *b == '+') ++b;  // from_chars rejects a leading '+'
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
   auto res = std::from_chars(b, e, *out);
   return res.ec == std::errc() && res.ptr == e;
+#else
+  // libstdc++ < 11 declares only the integer overloads, which made this
+  // translation unit fail to COMPILE — i.e. the native loader silently
+  // never built on gcc-10 hosts. strtod fallback on a NUL-terminated
+  // copy; "C" locale is assumed (process default; matches pandas).
+  if (b == e) return false;
+  std::string trimmed(b, e);
+  char* endp = nullptr;
+  *out = std::strtod(trimmed.c_str(), &endp);
+  return endp == trimmed.c_str() + trimmed.size();
+#endif
 }
 
 // The pandas default NA marker set (pandas.read_csv na_values), so the

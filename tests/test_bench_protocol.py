@@ -36,6 +36,10 @@ def test_small_cpu_run_emits_parseable_record():
     assert rec["metric"] == "gbt_train_rows_x_trees_per_sec_per_chip"
     assert rec["value"] > 0
     assert "vs_baseline" in rec
+    # The ingestion/binning split rides every headline record so the
+    # trajectory tracks the fused-binning target (round 6).
+    assert "ingest_s" in rec and rec["ingest_s"] >= 0
+    assert "bin_s" in rec and rec["bin_s"] >= 0
 
 
 @pytest.mark.slow

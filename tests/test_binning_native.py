@@ -1,0 +1,209 @@
+"""Fused native binning kernel (native/binning_ffi.cc via
+ops/binning_native.py) and its device-side counterparts
+(ops/binning_pallas.py): every path must be BIT-IDENTICAL to the
+per-column NumPy `searchsorted` oracle — binning feeds the training
+loop, so a one-bin disagreement is a silently different model.
+
+Covers the ISSUE-mandated edge cases: NaN/missing imputation (including
+a NaN impute value), values exactly on boundaries, all-equal columns,
+zero-boundary columns, +/-inf values, and clamping when padded
+boundaries would push past the real count."""
+
+import numpy as np
+import pytest
+
+from ydf_tpu.dataset.binning import Binner, BinnedDataset, resolve_bin_impl
+from ydf_tpu.dataset.dataset import Dataset
+from ydf_tpu.ops import binning_native
+
+
+def _numpy_oracle(vals, bd, nb, imp):
+    """Per-column searchsorted reference, same contract as the kernel."""
+    F, n = vals.shape
+    out = np.zeros((n, F), np.uint8)
+    for f in range(F):
+        v = np.where(np.isnan(vals[f]), imp[f], vals[f])
+        idx = np.searchsorted(bd[f, : nb[f]], v, side="right")
+        out[:, f] = np.minimum(idx, nb[f]).astype(np.uint8)
+    return out
+
+
+def _random_case(seed, n, F, max_b=255):
+    rng = np.random.default_rng(seed)
+    vals = rng.normal(size=(F, n)).astype(np.float32)
+    bd = np.full((F, max_b), np.inf, np.float32)
+    nb = np.zeros(F, np.int32)
+    for f in range(F):
+        k = int(rng.integers(0, max_b + 1))
+        bd[f, :k] = np.sort(rng.normal(size=k)).astype(np.float32)
+        nb[f] = k
+        if k and n:
+            # Values exactly ON boundaries (side="right" semantics).
+            m = min(8, n)
+            vals[f, :m] = bd[f, rng.integers(0, k, m)]
+    if F and n:
+        vals[0, ::7] = np.nan                 # missing -> impute
+        vals[min(F - 1, 1), :] = 2.5          # all-equal column
+        vals[F - 1, ::5] = np.inf             # clamps to nb
+        vals[F - 1, 1::5] = -np.inf           # bins to 0
+    imp = rng.normal(size=F).astype(np.float32)
+    return vals, bd, nb, imp
+
+
+needs_native = pytest.mark.skipif(
+    not binning_native.available(), reason="native kernel unavailable"
+)
+
+
+@needs_native
+@pytest.mark.parametrize("seed,n,F", [(0, 5000, 7), (1, 999, 1),
+                                      (2, 17, 12), (3, 40_000, 3)])
+def test_native_matches_numpy_bitwise(seed, n, F):
+    vals, bd, nb, imp = _random_case(seed, n, F)
+    out = binning_native.bin_columns_native(vals, bd, nb, imp)
+    np.testing.assert_array_equal(out, _numpy_oracle(vals, bd, nb, imp))
+
+
+@needs_native
+def test_native_nan_impute_value_bins_to_nb():
+    """A NaN impute value leaves NaNs in place; NumPy sorts NaN after
+    every boundary, so the bin must be nb on both paths."""
+    vals = np.array([[np.nan, 1.0, np.nan]], np.float32)
+    bd = np.full((1, 255), np.inf, np.float32)
+    bd[0, :3] = [0.0, 1.0, 2.0]
+    nb = np.array([3], np.int32)
+    imp = np.array([np.nan], np.float32)
+    out = binning_native.bin_columns_native(vals, bd, nb, imp)
+    np.testing.assert_array_equal(out[:, 0], [3, 2, 3])
+
+
+@needs_native
+def test_native_strided_output_block():
+    """The kernel writes the numerical block of a WIDER matrix in place
+    (out_stride > F) without touching the categorical columns."""
+    vals, bd, nb, imp = _random_case(7, 1000, 4)
+    out = np.full((1000, 6), 255, np.uint8)
+    binning_native.bin_columns_native(vals, bd, nb, imp, out=out)
+    np.testing.assert_array_equal(
+        out[:, :4], _numpy_oracle(vals, bd, nb, imp)
+    )
+    assert (out[:, 4:] == 255).all()  # untouched
+
+
+@needs_native
+def test_ffi_custom_call_matches_ctypes():
+    """The XLA FFI surface ("ydf_binning") and the ctypes surface run
+    the same kernel — jitted pipelines get identical bins."""
+    import jax.numpy as jnp
+
+    assert binning_native.ffi_available()
+    vals, bd, nb, imp = _random_case(11, 3000, 5)
+    via_ffi = np.asarray(
+        binning_native.binning_native(
+            jnp.asarray(vals), jnp.asarray(bd), jnp.asarray(nb),
+            jnp.asarray(imp),
+        )
+    )
+    np.testing.assert_array_equal(
+        via_ffi, binning_native.bin_columns_native(vals, bd, nb, imp)
+    )
+
+
+def test_jit_searchsorted_path_matches_numpy():
+    import jax.numpy as jnp
+
+    from ydf_tpu.ops.binning_pallas import bin_columns_jit
+
+    vals, bd, nb, imp = _random_case(13, 2000, 6)
+    out = np.asarray(
+        bin_columns_jit(
+            jnp.asarray(vals), jnp.asarray(bd), jnp.asarray(nb),
+            jnp.asarray(imp),
+        )
+    )
+    np.testing.assert_array_equal(out, _numpy_oracle(vals, bd, nb, imp))
+
+
+def test_pallas_kernel_matches_numpy_interpret():
+    import jax.numpy as jnp
+
+    from ydf_tpu.ops.binning_pallas import binning_pallas
+
+    vals, bd, nb, imp = _random_case(17, 3000, 5)
+    out = np.asarray(
+        binning_pallas(
+            jnp.asarray(vals), jnp.asarray(bd), jnp.asarray(nb),
+            jnp.asarray(imp), interpret=True,
+        )
+    )
+    np.testing.assert_array_equal(out, _numpy_oracle(vals, bd, nb, imp))
+
+
+# ---------------------------------------------------------------------- #
+# Binner.transform integration
+# ---------------------------------------------------------------------- #
+
+
+def _bench_like_dataset(n=20_000, F=6, seed=0):
+    rng = np.random.RandomState(seed)
+    data = {f"f{i}": rng.normal(size=n).astype(np.float32)
+            for i in range(F)}
+    data["f0"][::9] = np.nan                      # missing
+    data["f1"] = np.full(n, 3.25, np.float32)     # all-equal column
+    data["f2"] = rng.randint(0, 4, n).astype(np.float64)  # low-card exact
+    data["c"] = np.array(["a", "b", "c", "d"])[rng.randint(0, 4, n)]
+    return Dataset.from_data(data, min_vocab_frequency=1)
+
+
+@needs_native
+def test_transform_native_vs_numpy_bit_identical():
+    ds = _bench_like_dataset()
+    features = [f"f{i}" for i in range(6)] + ["c"]
+    binner = Binner.fit(ds, features, num_bins=256)
+    nat = binner.transform(
+        ds, out=np.empty((ds.num_rows, binner.num_scalar), np.uint8),
+        impl="native",
+    )
+    ref = binner.transform(
+        ds, out=np.empty((ds.num_rows, binner.num_scalar), np.uint8),
+        impl="numpy",
+    )
+    np.testing.assert_array_equal(nat, ref)
+
+
+def test_transform_fallback_with_native_disabled(monkeypatch):
+    """YDF_TPU_BIN_IMPL=numpy (the no-toolchain fallback path) produces
+    the same bins the default path does."""
+    ds = _bench_like_dataset(seed=3)
+    features = [f"f{i}" for i in range(6)] + ["c"]
+    binner = Binner.fit(ds, features, num_bins=128)
+    default = np.asarray(binner.transform(ds))
+    monkeypatch.setenv("YDF_TPU_BIN_IMPL", "numpy")
+    assert resolve_bin_impl() == "numpy"
+    forced = binner.transform(
+        ds, out=np.empty((ds.num_rows, binner.num_scalar), np.uint8)
+    )
+    np.testing.assert_array_equal(default, forced)
+
+
+def test_resolve_bin_impl_rejects_bad_env(monkeypatch):
+    monkeypatch.setenv("YDF_TPU_BIN_IMPL", "nope")
+    with pytest.raises(ValueError, match="nope"):
+        resolve_bin_impl()
+
+
+def test_bin_matrix_cached_across_fits():
+    """Repeated BinnedDataset.create on the SAME Dataset (tuner / CV /
+    bench steady-state shape) reuses the fitted Binner and the bin
+    matrix; the cached matrix is read-only."""
+    ds = _bench_like_dataset(seed=5)
+    features = [f"f{i}" for i in range(6)] + ["c"]
+    b1 = BinnedDataset.create(ds, features, num_bins=128)
+    b2 = BinnedDataset.create(ds, features, num_bins=128)
+    assert b2.bins is b1.bins
+    assert b2.binner is b1.binner
+    assert not b1.bins.flags.writeable
+    # A different num_bins is a different cache entry, not a stale hit.
+    b3 = BinnedDataset.create(ds, features, num_bins=64)
+    assert b3.bins is not b1.bins
+    assert b3.bins.max() < 64

@@ -21,6 +21,44 @@ def test_numeric_label_consistent_encoding_across_datasets():
     assert ev.accuracy > 0.9, str(ev)  # class-1-only set, model should nail it
 
 
+def test_hist_impl_env_validated_eagerly(monkeypatch):
+    """A typo'd (or literal 'auto') YDF_TPU_HIST_IMPL must fail inside
+    resolve_hist_impl with a clear message, not later at trace time
+    (ADVICE r5)."""
+    from ydf_tpu.ops.histogram import resolve_hist_impl
+
+    monkeypatch.setenv("YDF_TPU_HIST_IMPL", "matmull")
+    with pytest.raises(ValueError, match="matmull"):
+        resolve_hist_impl("auto")
+    monkeypatch.setenv("YDF_TPU_HIST_IMPL", "auto")
+    with pytest.raises(ValueError, match="auto"):
+        resolve_hist_impl("auto")
+    monkeypatch.setenv("YDF_TPU_HIST_IMPL", "segment")
+    assert resolve_hist_impl("auto") == "segment"
+
+
+def test_histogram_output_dtype_follows_stats():
+    """Every histogram impl honors the same output-dtype contract:
+    result dtype == stats dtype (ADVICE r5 — 'native'/'pallas'
+    accumulate f32 internally and must cast back)."""
+    import jax.numpy as jnp
+
+    from ydf_tpu.ops import histogram_native
+    from ydf_tpu.ops.histogram import histogram
+
+    rng = np.random.RandomState(0)
+    bins = jnp.asarray(rng.randint(0, 8, (64, 3)), jnp.uint8)
+    slot = jnp.asarray(rng.randint(0, 2, 64), jnp.int32)
+    stats = jnp.asarray(rng.normal(size=(64, 3)), jnp.bfloat16)
+    impls = ["segment", "matmul", "pallas_interpret"]
+    if histogram_native.available():
+        impls.append("native")
+    for impl in impls:
+        h = histogram(bins, slot, stats, num_slots=2, num_bins=8,
+                      impl=impl)
+        assert h.dtype == stats.dtype, impl
+
+
 def test_invalid_num_bins_rejected():
     data = {"x": np.arange(100.0), "y": (np.arange(100) % 2).astype(np.int64)}
     with pytest.raises(ValueError, match="num_bins"):

@@ -193,7 +193,7 @@ class DatasetCache:
 
 
 def create_dataset_cache(
-    data_path: str,
+    data_path,
     cache_dir: str,
     label: str,
     task: Task = Task.CLASSIFICATION,
@@ -209,7 +209,9 @@ def create_dataset_cache(
     label_entry_age: Optional[str] = None,
     store_raw_numerical: bool = False,
 ) -> DatasetCache:
-    """Builds an on-disk binned cache from (sharded) CSV input.
+    """Builds an on-disk binned cache from (sharded) CSV input, or from
+    an in-memory columnar frame (pandas / polars DataFrame or dict of
+    arrays) streamed chunk-wise through the same fused binning path.
 
     Task plumbing columns (ranking_group / uplift_treatment /
     label_event_observed / label_entry_age) are stored beside the bins so
@@ -218,14 +220,29 @@ def create_dataset_cache(
     float32 feature matrix, which SPARSE_OBLIQUE training needs (the
     reference's dataset cache keeps raw numericals for the same reason,
     dataset_cache.proto:42-58)."""
-    fmt, _ = _split_typed_path(data_path)
-    if fmt != "csv":
-        raise NotImplementedError(
-            f"create_dataset_cache streams CSV input only (got {fmt!r}); "
-            "convert other formats to CSV first"
-        )
-    files = _resolve_typed_path(data_path)
+    if isinstance(data_path, str):
+        fmt, _ = _split_typed_path(data_path)
+        if fmt != "csv":
+            raise NotImplementedError(
+                f"create_dataset_cache streams CSV input only (got "
+                f"{fmt!r}); convert other formats to CSV first"
+            )
+        files = _resolve_typed_path(data_path)
+    else:
+        from ydf_tpu.dataset.frame_io import iter_frame_chunks
+
+        frame = data_path
+
+        def _iter_frame(_files, rows):
+            return iter_frame_chunks(frame, rows)
+
+        files = None
     os.makedirs(cache_dir, exist_ok=True)
+
+    def _chunks():
+        if files is None:
+            return _iter_frame(None, chunk_rows)
+        return _iter_chunks(files, chunk_rows)
 
     # ---- pass 1: streaming dataspec -------------------------------- #
     num_sketch: Dict[str, _NumSketch] = {}
@@ -256,7 +273,7 @@ def create_dataset_cache(
     # groups/arms into OOV.
     no_prune = {label, ranking_group, uplift_treatment} - {None}
 
-    for chunk in _iter_chunks(files, chunk_rows):
+    for chunk in _chunks():
         if not col_order:
             col_order = list(chunk.keys())
         num_rows += len(next(iter(chunk.values())))
@@ -289,7 +306,7 @@ def create_dataset_cache(
             del num_sketch[name]
             cat_counts[name] = {}
             cat_missing[name] = 0
-        for chunk in _iter_chunks(files, chunk_rows):
+        for chunk in _chunks():
             for name in mixed:
                 if name in chunk:
                     _count_categorical(name, np.asarray(chunk[name]))
@@ -427,10 +444,13 @@ def create_dataset_cache(
         if label_col.type == ColumnType.CATEGORICAL
         else Task.REGRESSION
     )
-    for chunk in _iter_chunks(files, chunk_rows):
+    for chunk in _chunks():
         ds = Dataset(chunk, spec)
         k = ds.num_rows
-        bins_mm[row: row + k] = binner.transform(ds)
+        # Fused ingest: each chunk is binned (native kernel when built)
+        # straight into its memmap slice — no intermediate [k, F] copy,
+        # and no full-f32 materialization of the chunk's columns.
+        binner.transform(ds, out=bins_mm[row: row + k])
         labels_mm[row: row + k] = ds.encoded_label(label, label_task)
         if weights_mm is not None:
             weights_mm[row: row + k] = np.asarray(

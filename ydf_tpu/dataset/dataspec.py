@@ -362,16 +362,25 @@ def infer_column(
 
     if ctype == ColumnType.CATEGORICAL:
         if _is_numeric_dtype(values):
+            # Count distinct floats first, stringify only the uniques:
+            # distinct finite floats map to distinct strings (np.unique
+            # already merged -0.0 into 0.0), so the counts carry over —
+            # the row-wise stringify loop was ~0.5 s on a 500k-row
+            # integer label column.
             fv = values.astype(np.float64)
             missing = np.isnan(fv)
-            svals = np.array(
-                [str(int(v)) if float(v).is_integer() else str(v) for v in fv[~missing]],
+            uniqf, counts = np.unique(fv[~missing], return_counts=True)
+            uniq = np.array(
+                [
+                    str(int(v)) if v.is_integer() else str(v)
+                    for v in uniqf.tolist()
+                ],
                 dtype=object,
             )
         else:
             missing = _string_missing_mask(values)
             svals = values[~missing].astype(str)
-        uniq, counts = np.unique(svals, return_counts=True)
+            uniq, counts = np.unique(svals, return_counts=True)
         # Sort by (-count, name): decreasing frequency, lexicographic ties —
         # the reference dictionary order (data_spec.cc item sorting).
         order = np.lexsort((uniq, -counts))
@@ -386,7 +395,7 @@ def infer_column(
             type=ctype,
             vocabulary=[OOV_ITEM] + [str(x) for x in kept],
             vocab_counts=[oov_count] + [int(c) for c in kept_counts],
-            num_values=int(len(svals)),
+            num_values=int(counts.sum()),
             num_missing=int(missing.sum()),
         )
 

@@ -43,6 +43,32 @@ def polars_to_columns(df: Any) -> Dict[str, np.ndarray]:
     return out
 
 
+def iter_frame_chunks(frame: Any, chunk_rows: int):
+    """Streams {column: ndarray} row chunks (≤ chunk_rows each) out of
+    an in-memory columnar frame — pandas or polars DataFrame, or a
+    plain dict of arrays. The fused ingestion path (dataset/cache.py)
+    uses this to bin big in-memory frames straight into the on-disk
+    cache without ever materializing a second full-size copy: each
+    chunk is a zero-copy row slice, converted column-wise."""
+    if isinstance(frame, dict):
+        n = len(next(iter(frame.values()))) if frame else 0
+        cols = {k: np.asarray(v) for k, v in frame.items()}
+        for s in range(0, n, chunk_rows):
+            yield {k: v[s: s + chunk_rows] for k, v in cols.items()}
+        return
+    if not (hasattr(frame, "columns") and hasattr(frame, "__getitem__")):
+        raise TypeError(
+            f"Unsupported frame type for chunked ingestion: {type(frame)}"
+        )
+    n = len(frame)
+    names = [str(c) for c in frame.columns]
+    for s in range(0, n, chunk_rows):
+        sl = frame[s: s + chunk_rows] if is_polars_frame(frame) else (
+            frame.iloc[s: s + chunk_rows]
+        )
+        yield {c: np.asarray(sl[c].to_numpy()) for c in names}
+
+
 def xarray_to_columns(ds: Any) -> Dict[str, np.ndarray]:
     """xarray Dataset → {variable: np.ndarray}; every data_var must be
     1-D over the shared example dimension (the reference's xarray_io

@@ -1,26 +1,29 @@
 """ctypes bridge to the native C++ CSV loader (native/csv_loader.cc).
 
-The native loader is compiled on first use (g++ -O3 -shared) into
-native/build/ and cached; any build or load failure silently falls back
-to the pandas reader, so the package works without a toolchain. This is
-the runtime counterpart of the reference's C++ dataset IO
-(ydf/dataset/csv_example_reader.cc) — IO stays native, compute stays XLA.
+The native loader is compiled on first use into native/build/ through
+the shared native-kernel helper (ops/native_ffi.py — same recipe as the
+histogram and binning kernels); any build or load failure falls back to
+the pandas reader (with the helper's one-time warning), so the package
+works without a toolchain. This is the runtime counterpart of the
+reference's C++ dataset IO (ydf/dataset/csv_example_reader.cc) — IO
+stays native, compute stays XLA.
 """
 
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
 import threading
 from typing import Dict, Optional
 
 import numpy as np
 
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
-_SRC = os.path.join(_REPO_ROOT, "native", "csv_loader.cc")
-_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
-_LIB_PATH = os.path.join(_BUILD_DIR, "libydfcsv.so")
+from ydf_tpu.ops.native_ffi import NativeLibrary
+
+_NATIVE = NativeLibrary(
+    src_name="csv_loader.cc",
+    lib_name="libydfcsv.so",
+    needs_ffi_headers=False,
+)
 
 _lock = threading.Lock()
 _lib = None
@@ -33,28 +36,9 @@ def _load_library():
         if _lib is not None or _lib_failed:
             return _lib
         try:
-            have_src = os.path.isfile(_SRC)
-            stale = (
-                have_src
-                and os.path.isfile(_LIB_PATH)
-                and os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
-            )
-            if not os.path.isfile(_LIB_PATH) or stale:
-                if not have_src:
-                    raise FileNotFoundError(_SRC)
-                os.makedirs(_BUILD_DIR, exist_ok=True)
-                # Per-process temp name: concurrent cold builds must not
-                # os.replace each other's half-written objects.
-                tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
-                subprocess.run(
-                    [
-                        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                        _SRC, "-o", tmp,
-                    ],
-                    check=True, capture_output=True, timeout=120,
-                )
-                os.replace(tmp, _LIB_PATH)
-            lib = ctypes.CDLL(_LIB_PATH)
+            lib = _NATIVE.load()
+            if lib is None:
+                raise OSError("native CSV library failed to build/load")
             lib.ydf_csv_load.restype = ctypes.c_void_p
             lib.ydf_csv_load.argtypes = [ctypes.c_char_p]
             lib.ydf_csv_free.argtypes = [ctypes.c_void_p]

@@ -282,11 +282,21 @@ class GenericLearner(HyperparameterValidationMixin):
     def _prepare(
         self, data: InputData, valid: Optional[InputData] = None
     ) -> Dict:
-        """Common ingestion: dataset, binning, encoded label/weights."""
+        """Common ingestion: dataset, binning, encoded label/weights.
+
+        Records wall-clock attribution on `self.last_data_timings`
+        ({"ingest_s": dataspec inference + label/weight encode,
+        "bin_s": Binner fit + transform}) — the two terms the bench
+        tracks separately (bench.py headline record)."""
+        import time as _time
+
         from ydf_tpu.dataset.cache import DatasetCache
 
         if isinstance(data, DatasetCache):
-            return self._prepare_from_cache(data, valid=valid)
+            out = self._prepare_from_cache(data, valid=valid)
+            self.last_data_timings = {"ingest_s": 0.0, "bin_s": 0.0}
+            return out
+        t_start = _time.perf_counter()
         ds = self._infer_dataset(data)
         feature_names = self._select_feature_names(ds)
         from ydf_tpu.config import resolve_num_bins
@@ -302,12 +312,14 @@ class GenericLearner(HyperparameterValidationMixin):
             ),
             default=0,
         )
+        t_bin0 = _time.perf_counter()
         binned = BinnedDataset.create(
             ds, feature_names,
             num_bins=resolve_num_bins(
                 self.num_bins, ds.num_rows, min_cat_vocab=max_vocab
             ),
         )
+        t_bin = _time.perf_counter() - t_bin0
         if binned.binner.num_vs > 0 and not getattr(
             self, "_supports_vs_features", False
         ):
@@ -356,6 +368,10 @@ class GenericLearner(HyperparameterValidationMixin):
                 out["valid_labels"] = vds.encoded_label(self.label, self.task)
             if self.weights is not None:
                 out["valid_weights"] = vds.data[self.weights].astype(np.float32)
+        self.last_data_timings = {
+            "ingest_s": _time.perf_counter() - t_start - t_bin,
+            "bin_s": t_bin,
+        }
         return out
 
     def train(self, data: InputData, valid: Optional[InputData] = None):

@@ -162,6 +162,11 @@ class RandomSearchTuner:
         train_data, hold_data = holdout_split(
             raw, ds.num_rows, self.holdout_ratio, self.seed
         )
+        # Ingest ONCE through the learner's own dataspec policy: every
+        # trial then trains on the same Dataset object, so the fitted
+        # Binner and the bin matrix are cache hits across trials
+        # (dataset/binning.py) — trials pay only the boosting loop.
+        train_ds = learner._infer_dataset(train_data)
 
         self.logs = []
         best: Optional[TrialLog] = None
@@ -169,7 +174,7 @@ class RandomSearchTuner:
             cand = copy.copy(learner)
             for k, v in params.items():
                 setattr(cand, k, v)
-            model = cand.train(train_data)
+            model = cand.train(train_ds)
             ev = model.evaluate(hold_data)
             metric, value, sign = _primary_metric(model, ev)
             score = sign * value

@@ -47,6 +47,12 @@ import functools
 import jax
 import jax.numpy as jnp
 
+# Concrete implementations _histogram_jit dispatches on; "auto" is
+# resolved to one of these BEFORE the jit boundary (resolve_hist_impl).
+_HIST_IMPLS = frozenset(
+    {"segment", "matmul", "native", "pallas", "pallas_interpret"}
+)
+
 
 def _histogram_segment(
     bins, slot, stats, num_slots: int, num_bins: int, chunk: int = 1 << 18
@@ -168,23 +174,31 @@ def _histogram_jit(bins, slot, stats, num_slots, num_bins, impl, chunk):
             "boundary (use histogram()/grow_tree(), or resolve_hist_impl)"
         )
     if impl == "segment":
-        return _histogram_segment(
+        out = _histogram_segment(
             bins, slot, stats, num_slots, num_bins, chunk
         )
-    if impl == "matmul":
-        return _histogram_matmul(bins, slot, stats, num_slots, num_bins, chunk)
-    if impl in ("pallas", "pallas_interpret"):
+    elif impl == "matmul":
+        out = _histogram_matmul(
+            bins, slot, stats, num_slots, num_bins, chunk
+        )
+    elif impl in ("pallas", "pallas_interpret"):
         from ydf_tpu.ops.histogram_pallas import histogram_pallas
 
-        return histogram_pallas(
+        out = histogram_pallas(
             bins, slot, stats, num_slots, num_bins,
             interpret=(impl == "pallas_interpret"),
         )
-    if impl == "native":
+    elif impl == "native":
         from ydf_tpu.ops.histogram_native import histogram_native
 
-        return histogram_native(bins, slot, stats, num_slots, num_bins)
-    raise ValueError(f"Unknown histogram impl {impl!r}")
+        out = histogram_native(bins, slot, stats, num_slots, num_bins)
+    else:
+        raise ValueError(f"Unknown histogram impl {impl!r}")
+    # One output-dtype contract for every impl: "segment" follows
+    # stats.dtype while "native"/"pallas" accumulate f32 — without this
+    # cast, auto-selection could silently change the result dtype for
+    # non-f32 stats (ADVICE r5).
+    return out.astype(stats.dtype)
 
 
 def resolve_hist_impl(impl: str = "auto") -> str:
@@ -211,6 +225,14 @@ def resolve_hist_impl(impl: str = "auto") -> str:
 
     forced = os.environ.get("YDF_TPU_HIST_IMPL")
     if forced:
+        # Fail HERE on a misconfigured override — "auto" or a typo
+        # would otherwise surface later as a trace-time error pointing
+        # back at this resolver (ADVICE r5).
+        if forced not in _HIST_IMPLS:
+            raise ValueError(
+                f"YDF_TPU_HIST_IMPL={forced!r} is not a concrete "
+                f"histogram impl; expected one of {sorted(_HIST_IMPLS)}"
+            )
         return forced
     if is_tpu_backend():
         return "matmul"

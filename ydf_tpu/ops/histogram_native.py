@@ -3,8 +3,10 @@
 
 Compiled on first use (g++ -O3 -shared, against jax.ffi's bundled XLA
 FFI headers) into native/build/ and registered as the CPU custom-call
-target "ydf_histogram"; any build/load failure degrades silently to the
-pure-XLA segment impl, so the package works without a toolchain.
+target "ydf_histogram" through the shared helper (ops/native_ffi.py);
+any build/load failure degrades to the pure-XLA segment impl with a
+one-time RuntimeWarning (the ~5x fallback must never be invisible —
+ADVICE r5), so the package still works without a toolchain.
 
 Why it exists: XLA-CPU lowers segment_sum to a generic scalar scatter
 (~125-180M rows/s measured); this kernel streams the same rows at ~5x
@@ -16,71 +18,17 @@ bucket-fill loops (splitter_scanner.h:860,933).
 
 from __future__ import annotations
 
-import ctypes
-import os
-import subprocess
-import threading
+from ydf_tpu.ops.native_ffi import NativeLibrary
 
-import numpy as np
-
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
-_SRC = os.path.join(_REPO_ROOT, "native", "histogram_ffi.cc")
-_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
-_LIB_PATH = os.path.join(_BUILD_DIR, "libydfhist.so")
-
-_lock = threading.Lock()
-_registered = False
-_failed = False
-
-
-def _ensure_registered() -> bool:
-    """Builds (if needed), loads and registers the FFI target once per
-    process. Returns availability."""
-    global _registered, _failed
-    if _registered:
-        return True
-    if _failed:
-        return False
-    with _lock:
-        if _registered or _failed:
-            return _registered
-        try:
-            import jax
-
-            have_src = os.path.isfile(_SRC)
-            stale = (
-                have_src
-                and os.path.isfile(_LIB_PATH)
-                and os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
-            )
-            if not os.path.isfile(_LIB_PATH) or stale:
-                if not have_src:
-                    raise FileNotFoundError(_SRC)
-                os.makedirs(_BUILD_DIR, exist_ok=True)
-                tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
-                subprocess.run(
-                    [
-                        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                        "-I", jax.ffi.include_dir(),
-                        _SRC, "-o", tmp,
-                    ],
-                    check=True, capture_output=True, timeout=180,
-                )
-                os.replace(tmp, _LIB_PATH)
-            lib = ctypes.CDLL(_LIB_PATH)
-            jax.ffi.register_ffi_target(
-                "ydf_histogram",
-                jax.ffi.pycapsule(lib.YdfHistogram),
-                platform="cpu",
-            )
-            _registered = True
-        except Exception:
-            _failed = True
-        return _registered
+_LIB = NativeLibrary(
+    src_name="histogram_ffi.cc",
+    lib_name="libydfhist.so",
+    ffi_targets={"ydf_histogram": "YdfHistogram"},
+)
 
 
 def available() -> bool:
-    return _ensure_registered()
+    return _LIB.ensure_ffi_registered()
 
 
 def histogram_native(bins, slot, stats, num_slots: int, num_bins: int):
@@ -89,9 +37,11 @@ def histogram_native(bins, slot, stats, num_slots: int, num_bins: int):
     import jax
     import jax.numpy as jnp
 
+    from ydf_tpu.ops.native_ffi import ffi_module
+
     n, F = bins.shape
     S = stats.shape[1]
-    return jax.ffi.ffi_call(
+    return ffi_module().ffi_call(
         "ydf_histogram",
         jax.ShapeDtypeStruct((num_slots, F, num_bins, S), jnp.float32),
     )(

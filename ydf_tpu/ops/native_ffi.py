@@ -1,0 +1,171 @@
+"""Shared build / load / registration plumbing for the native CPU
+kernels (native/*.cc).
+
+Three modules used to duplicate the same on-demand toolchain dance —
+stale-check the .so against the source, g++ into native/build/ under a
+per-process temp name, ctypes-load, optionally register XLA FFI custom
+calls (histogram_native.py, binning_native.py, native_csv.py). This
+helper centralizes it:
+
+  * one compile recipe (g++ -O3 -std=c++17 -shared -fPIC [+extra flags],
+    with jax.ffi's bundled XLA FFI headers when the kernel needs them);
+  * one failure policy: any build/load/registration error degrades to
+    `available() == False` so the package works without a toolchain,
+    but emits a ONE-TIME RuntimeWarning naming the kernel and the
+    exception — a silent fallback to a ~5x slower impl must never be an
+    invisible perf regression (ADVICE r5);
+  * one thread-safe "once per process" state machine per library.
+
+FFI registration is lazy and optional: ctypes-only callers (e.g. the
+NumPy binning fast path) never import jax.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import warnings
+from typing import Dict, Optional, Sequence
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+BUILD_DIR = os.path.join(NATIVE_DIR, "build")
+
+
+def ffi_module():
+    """jax's FFI namespace across versions: `jax.ffi` (>= 0.5) or
+    `jax.extend.ffi` (0.4.x). The old per-module code hardcoded
+    `jax.ffi`, which on jax 0.4.37 raised AttributeError inside the
+    swallow-everything registration path — i.e. the native histogram
+    kernel silently deselected itself on exactly this box (the invisible
+    ~5x regression ADVICE r5 warned about)."""
+    import jax
+
+    ffi = getattr(jax, "ffi", None)
+    if ffi is None:
+        from jax.extend import ffi  # jax 0.4.x
+    return ffi
+
+
+class NativeLibrary:
+    """One native shared library: built on first use, loaded once,
+    optionally registered as XLA FFI custom-call targets.
+
+    Args:
+      src_name: source file name under native/ (e.g. "binning_ffi.cc").
+      lib_name: output .so name under native/build/.
+      ffi_targets: XLA custom-call target name -> exported handler
+        symbol; registered (platform "cpu") on the first
+        `ensure_ffi_registered()` call.
+      extra_cflags: appended to the compile command (e.g. "-pthread").
+      needs_ffi_headers: add -I jax.ffi.include_dir() (requires jax at
+        BUILD time only; pre-built libraries load without it).
+    """
+
+    def __init__(
+        self,
+        src_name: str,
+        lib_name: str,
+        ffi_targets: Optional[Dict[str, str]] = None,
+        extra_cflags: Sequence[str] = (),
+        needs_ffi_headers: bool = True,
+    ):
+        self.src = os.path.join(NATIVE_DIR, src_name)
+        self.lib_path = os.path.join(BUILD_DIR, lib_name)
+        self.ffi_targets = dict(ffi_targets or {})
+        self.extra_cflags = tuple(extra_cflags)
+        self.needs_ffi_headers = needs_ffi_headers
+        self._lock = threading.Lock()
+        self._lib: Optional[ctypes.CDLL] = None
+        self._failed = False
+        self._ffi_registered = False
+        self._warned = False
+
+    # ------------------------------------------------------------------ #
+
+    def _warn_once(self, stage: str, err: BaseException) -> None:
+        if self._warned:
+            return
+        self._warned = True
+        warnings.warn(
+            f"ydf_tpu native kernel {os.path.basename(self.src)!r} "
+            f"unavailable ({stage}: {type(err).__name__}: {err}); falling "
+            f"back to the pure-Python/XLA path. This can be a large perf "
+            f"regression — install a C++ toolchain or set the relevant "
+            f"impl override to silence this warning.",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+
+    def _build_if_needed(self) -> None:
+        have_src = os.path.isfile(self.src)
+        stale = (
+            have_src
+            and os.path.isfile(self.lib_path)
+            and os.path.getmtime(self.lib_path) < os.path.getmtime(self.src)
+        )
+        if os.path.isfile(self.lib_path) and not stale:
+            return
+        if not have_src:
+            raise FileNotFoundError(self.src)
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC"]
+        cmd += list(self.extra_cflags)
+        if self.needs_ffi_headers:
+            cmd += ["-I", ffi_module().include_dir()]
+        os.makedirs(BUILD_DIR, exist_ok=True)
+        # Per-process temp name: concurrent cold builds must not
+        # os.replace each other's half-written objects.
+        tmp = f"{self.lib_path}.{os.getpid()}.tmp"
+        cmd += [self.src, "-o", tmp]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+        os.replace(tmp, self.lib_path)
+
+    def load(self) -> Optional[ctypes.CDLL]:
+        """Builds (if needed) and ctypes-loads the library once per
+        process; None after any failure (warned once)."""
+        if self._lib is not None:
+            return self._lib
+        if self._failed:
+            return None
+        with self._lock:
+            if self._lib is not None or self._failed:
+                return self._lib
+            try:
+                self._build_if_needed()
+                self._lib = ctypes.CDLL(self.lib_path)
+            except Exception as e:
+                self._failed = True
+                self._warn_once("build/load", e)
+            return self._lib
+
+    def available(self) -> bool:
+        return self.load() is not None
+
+    def ensure_ffi_registered(self) -> bool:
+        """Registers every ffi_target with jax.ffi (CPU platform), once.
+        Returns availability of the registered library."""
+        if self._ffi_registered:
+            return True
+        if self._failed:
+            return False
+        lib = self.load()
+        if lib is None:
+            return False
+        with self._lock:
+            if self._ffi_registered:
+                return True
+            try:
+                ffi = ffi_module()
+                for target, symbol in self.ffi_targets.items():
+                    ffi.register_ffi_target(
+                        target,
+                        ffi.pycapsule(getattr(lib, symbol)),
+                        platform="cpu",
+                    )
+                self._ffi_registered = True
+            except Exception as e:
+                self._failed = True
+                self._warn_once("ffi registration", e)
+            return self._ffi_registered

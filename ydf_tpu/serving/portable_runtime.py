@@ -3,23 +3,25 @@
 This is the Python face of the single-engine ports story (see
 ydf_tpu/serving/portable.py and native/portable_infer.cc): any other
 language binds the same six C symbols the same way. Compiled on first
-use (g++ -O3 -shared) into native/build/, same lazy pattern as the
-native CSV loader (ydf_tpu/dataset/native_csv.py)."""
+use into native/build/ through the shared native-kernel helper
+(ydf_tpu/ops/native_ffi.py), same lazy pattern as the native CSV
+loader and the binning/histogram kernels."""
 
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
 import threading
 from typing import Optional
 
 import numpy as np
 
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
-_SRC = os.path.join(_REPO_ROOT, "native", "portable_infer.cc")
-_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
-_LIB_PATH = os.path.join(_BUILD_DIR, "libydfportable.so")
+from ydf_tpu.ops.native_ffi import NativeLibrary
+
+_NATIVE = NativeLibrary(
+    src_name="portable_infer.cc",
+    lib_name="libydfportable.so",
+    needs_ffi_headers=False,
+)
 
 _lock = threading.Lock()
 _lib = None
@@ -32,23 +34,9 @@ def _load_library():
         if _lib is not None or _lib_failed:
             return _lib
         try:
-            stale = (
-                os.path.isfile(_LIB_PATH)
-                and os.path.isfile(_SRC)
-                and os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
-            )
-            if not os.path.isfile(_LIB_PATH) or stale:
-                os.makedirs(_BUILD_DIR, exist_ok=True)
-                tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
-                subprocess.run(
-                    [
-                        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                        _SRC, "-o", tmp,
-                    ],
-                    check=True, capture_output=True, timeout=120,
-                )
-                os.replace(tmp, _LIB_PATH)
-            lib = ctypes.CDLL(_LIB_PATH)
+            lib = _NATIVE.load()
+            if lib is None:
+                raise OSError("portable inference library failed to build/load")
             lib.ydf_model_load.restype = ctypes.c_void_p
             lib.ydf_model_load.argtypes = [ctypes.c_char_p]
             lib.ydf_model_error.restype = ctypes.c_char_p

@@ -31,6 +31,7 @@ import os
 from pathlib import Path
 
 import jax
+import jax.export  # noqa: F401 — not auto-imported by `import jax` on 0.4.x
 import jax.numpy as jnp
 import numpy as np
 
@@ -38,6 +39,7 @@ __all__ = [
     "build_train_step",
     "export_train_step",
     "export_grow_tree",
+    "export_binning_pallas",
     "export_quickscorer",
     "export_vector_sequence",
     "grow_tree_cost",
@@ -197,6 +199,29 @@ def export_histogram_pallas(
             lambda b, s, st: histogram_pallas(
                 b, s, st, num_slots=L, num_bins=B
             )
+        ),
+        platforms=tuple(platforms),
+    )(*args)
+
+
+def export_binning_pallas(
+    n: int = 262_144, F: int = 28, B: int = 256, platforms=("tpu",),
+):
+    """jax.export of the Mosaic quantile-binning kernel
+    (ops/binning_pallas.py) — the ingestion side of the fused pipeline,
+    proving feature binning compiles for TPU next to the training loop
+    it feeds."""
+    from ydf_tpu.ops.binning_pallas import binning_pallas
+
+    args = (
+        jax.ShapeDtypeStruct((F, n), jnp.float32),    # values
+        jax.ShapeDtypeStruct((F, B - 1), jnp.float32),  # boundaries
+        jax.ShapeDtypeStruct((F,), jnp.int32),        # nbounds
+        jax.ShapeDtypeStruct((F,), jnp.float32),      # impute
+    )
+    return jax.export.export(
+        jax.jit(
+            lambda v, b, nb, imp: binning_pallas(v, b, nb, imp)
         ),
         platforms=tuple(platforms),
     )(*args)
@@ -405,6 +430,10 @@ def write_artifacts(outdir: str | Path, full_scale: bool = True) -> dict:
             hist_impl="matmul", **scale
         ),
         "histogram_pallas_kernel": export_histogram_pallas,
+        # Ingestion: the fused binning pipeline's Mosaic kernel
+        # (ops/binning_pallas.py) — bins compile on-device next to the
+        # loop that consumes them.
+        "binning_pallas_kernel": export_binning_pallas,
         "quickscorer_kernel": export_quickscorer,
         "vector_sequence_kernel": export_vector_sequence,
     }
