@@ -63,6 +63,14 @@ _PARTIAL = None
 # os._exit cannot orphan a child that then hangs on the tunnel forever.
 _CHILD = None
 _START = time.time()
+# Negative probe cache: once a backend probe attempt TIMES OUT (the
+# tunnel hangs rather than errors), every further probe this run would
+# burn the same full timeout — BENCH_r05 lost 4x240 s re-probing an
+# identical hung 'axon' platform. A timeout sets this flag and later
+# probes return immediately with a "skipped" log entry. Fast errors
+# (rc != 0) do NOT set it: those probes are cheap and the tunnel may
+# still come up.
+_PROBE_TIMED_OUT = False
 
 
 def emit(record):
@@ -98,8 +106,18 @@ def probe_backend(probe_log, attempts=2, timeout_s=240):
     is appended to `probe_log`, which ships inside the emitted JSON.
     Returns the backend name ("tpu", "axon", ...) or None.
     """
+    global _PROBE_TIMED_OUT
     code = "import jax; print(jax.default_backend())"
     for i in range(attempts):
+        if _PROBE_TIMED_OUT:
+            probe_log.append(
+                {
+                    "t_offset_s": round(time.time() - _START, 1),
+                    "skipped": "earlier probe timed out; negative result "
+                    "cached for the rest of the run",
+                }
+            )
+            return None
         t0 = time.time()
         entry = {"t_offset_s": round(t0 - _START, 1)}
         try:
@@ -121,6 +139,7 @@ def probe_backend(probe_log, attempts=2, timeout_s=240):
         except subprocess.TimeoutExpired as e:
             entry["seconds"] = round(time.time() - t0, 1)
             entry["timeout"] = True
+            _PROBE_TIMED_OUT = True
             if e.stderr:
                 stderr = e.stderr if isinstance(e.stderr, str) else e.stderr.decode(
                     "utf-8", "replace"
@@ -285,6 +304,73 @@ def bench_in_subprocess(rows, trees, depth, features, timeout_s):
         _CHILD = None
 
 
+def measure_hist_breakdown(rows, features, depth, trees, record):
+    """Measured per-layer histogram wall at the training shape, emitted
+    as `hist_s` (sibling-subtraction slot counts — what the grower runs)
+    and `hist_direct_s` (the pre-subtraction full-frontier counts), both
+    scaled to the whole train call (× trees). This is ATTRIBUTION, not
+    an in-loop probe: the boosting loop is one fused jit scan, so the
+    per-op split is re-measured outside it on same-shape data with the
+    same resolved impl. Failures are recorded, never fatal."""
+    import numpy as np
+    import jax
+
+    try:
+        from ydf_tpu.config import resolve_max_frontier
+        from ydf_tpu.ops.histogram import histogram, resolve_hist_impl
+
+        impl = resolve_hist_impl("auto")
+        L = min(
+            2 ** max(depth - 1, 0), resolve_max_frontier("auto", rows, 5)
+        )
+        B = 256
+        rng = np.random.RandomState(7)
+        bins = jax.numpy.asarray(
+            rng.randint(0, B, size=(rows, features)).astype(np.uint8)
+        )
+        stats = jax.numpy.asarray(
+            rng.normal(size=(rows, 3)).astype(np.float32)
+        )
+
+        def timed(slot_np, num_slots):
+            slot = jax.numpy.asarray(slot_np)
+            o = histogram(
+                bins, slot, stats, num_slots=num_slots, num_bins=B,
+                impl=impl,
+            )
+            jax.block_until_ready(o)  # warm (compile)
+            t0 = time.time()
+            o = histogram(
+                bins, slot, stats, num_slots=num_slots, num_bins=B,
+                impl=impl,
+            )
+            jax.block_until_ready(o)
+            return time.time() - t0
+
+        t_sub = t_direct = 0.0
+        for d in range(depth):
+            Ld = min(2**d, L)
+            if d == 0:
+                t_layer = timed(np.zeros(rows, np.int32), 1)
+                t_sub += t_layer
+                t_direct += t_layer
+                continue
+            # Subtraction layer: Lh live slots, ~half the rows (the
+            # larger children) on the trash slot.
+            Lh = max(1, min(2 ** (d - 1), L // 2))
+            raw = rng.randint(0, 2 * Lh, size=rows).astype(np.int32)
+            t_sub += timed(np.where(raw < Lh, raw, Lh), Lh)
+            # Direct layer: every row live across the full Ld slots.
+            t_direct += timed(
+                rng.randint(0, Ld, size=rows).astype(np.int32), Ld
+            )
+        record["hist_s"] = round(t_sub * trees, 3)
+        record["hist_direct_s"] = round(t_direct * trees, 3)
+        record["hist_impl"] = impl
+    except Exception as e:
+        record["hist_extra_error"] = f"{type(e).__name__}: {e}"
+
+
 def synth_higgs_chunk(rng, rows, features):
     """One chunk of the synthetic Higgs-shaped table — the ONE label
     model shared by the bench rows and the north-star flow, so their AUC
@@ -371,6 +457,9 @@ def run_bench(backend, rows, trees, depth, features, with_baseline, probe_log):
             record["baseline_source"] = source
             record["vs_baseline"] = round(value / base, 3)
     record.setdefault("vs_baseline", record["vs_ydf64_estimate"])
+    # Per-layer histogram attribution (the PR-2 sibling-subtraction
+    # target): hist_s rides every headline record next to ingest_s/bin_s.
+    measure_hist_breakdown(rows, features, depth, trees, record)
     global _PARTIAL
     _PARTIAL = dict(record)
     try:
@@ -717,6 +806,13 @@ def main():
     tpu_rows = args.rows or 2_000_000
     tpu_trees = args.trees or 20
     while True:
+        if _PROBE_TIMED_OUT:
+            # A probe already hung to its timeout this run; re-probing
+            # would burn the remaining window on the same hang.
+            sys.stderr.write(
+                "# probe timeout cached; not re-probing this run\n"
+            )
+            break
         remaining = budget - (time.time() - _START)
         # Need at least a probe (240s) + a minimally useful run.
         if remaining < 240 + 240:

@@ -7,6 +7,30 @@
 // cache-aware C++ loop over the same data (the accumulation target for
 // realistic L*F*B*S fits in L2/L3) and roughly doubles that.
 //
+// Slot contract (ops/histogram.py): slot values in [0, L); anything
+// outside — the trash slot L, negative, padded — is skipped with an
+// early continue BEFORE the per-row feature loop. Under the grower's
+// sibling-subtraction mode every larger-child row rides the trash
+// slot, so past the root this kernel touches only ~half the rows' F*S
+// work per layer (the smaller children), on top of the halved [L,...]
+// scratch/writeback.
+//
+// Threading (same std::thread, OpenMP-free standard as
+// native/binning_ffi.cc): rows are cut into FIXED 32k-row blocks, each
+// block accumulated into its own f64 partial histogram by a worker
+// thread, and partials are reduced into the result in ASCENDING BLOCK
+// ORDER (the reduction itself parallelizes over disjoint cell ranges).
+// Because the block boundaries and the reduction order are independent
+// of the thread count, the result is BIT-STABLE across thread counts —
+// 1 thread and 16 threads produce identical f32 outputs (f64 partial
+// sums rounded once at the end), which keeps trained trees
+// reproducible across machines. YDF_TPU_HIST_THREADS overrides the
+// thread count (hardware_concurrency by default).
+//
+// f64 accumulators (the reference's splitter sums are double too,
+// utils/distribution.h): keeps the result row-order invariant to
+// float tolerance and loses no gradient mass at n in the millions.
+//
 // TPU-native note: this kernel exists for the CPU fallback path only —
 // on TPU the same contraction runs as the Mosaic one-hot-matmul kernel
 // (ops/histogram_pallas.py). It is the moral counterpart of the
@@ -14,16 +38,93 @@
 // (ydf/learner/decision_tree/splitter_scanner.h:860,933).
 //
 // Built on demand by ydf_tpu/ops/histogram_native.py with
-//   g++ -O3 -std=c++17 -shared -fPIC -I<jax.ffi.include_dir()>
+//   g++ -O3 -std=c++17 -shared -fPIC -pthread -I<jax.ffi.include_dir()>
 // and registered via jax.ffi.register_ffi_target (CPU platform).
 
+#include <algorithm>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
+#include <thread>
 #include <vector>
 
 #include "xla/ffi/api/ffi.h"
 
 namespace ffi = xla::ffi;
+
+namespace {
+
+// Fixed accumulation block: the unit of work AND of reduction order.
+// Must not depend on the thread count (bit-stability) — do not "tune"
+// it per machine.
+constexpr int64_t kRowBlock = 32768;
+// Cap on the per-call partial-histogram arena (doubles). Oversized
+// [L, F, B, S] targets fall back to fewer in-flight partials rather
+// than exhausting memory.
+constexpr int64_t kArenaBudgetBytes = int64_t{512} << 20;
+
+// Accumulates rows [row_begin, row_end) into `acc` (an [L, F, B, S]
+// f64 histogram, zeroed by the caller). The common S=3 (grad, hess,
+// weight) inner loop is unrolled; the generic path covers any S.
+void AccumulateRows(const uint8_t* bp, const int32_t* sp, const float* stp,
+                    double* acc, int64_t F, int64_t L, int64_t B, int64_t S,
+                    int64_t row_begin, int64_t row_end) {
+  const int64_t fbs = F * B * S, bs = B * S;
+  // Out-of-range bins are skipped defensively (callers guarantee
+  // bin < B; a violation must corrupt a histogram cell in XLA's scatter
+  // formulation but must NOT scribble past this buffer).
+  if (S == 3) {
+    for (int64_t i = row_begin; i < row_end; ++i) {
+      const int32_t l = sp[i];
+      if (l < 0 || l >= L) continue;  // trash slot: inactive/padded or
+                                      // larger-child (subtraction) row
+      const double g = stp[i * 3], h = stp[i * 3 + 1], w = stp[i * 3 + 2];
+      const uint8_t* br = bp + i * F;
+      double* orow = acc + l * fbs;
+      for (int64_t f = 0; f < F; ++f) {
+        const int64_t b = br[f];
+        if (b >= B) continue;
+        double* cell = orow + f * bs + b * 3;
+        cell[0] += g;
+        cell[1] += h;
+        cell[2] += w;
+      }
+    }
+  } else {
+    for (int64_t i = row_begin; i < row_end; ++i) {
+      const int32_t l = sp[i];
+      if (l < 0 || l >= L) continue;
+      const float* srow = stp + i * S;
+      const uint8_t* br = bp + i * F;
+      double* orow = acc + l * fbs;
+      for (int64_t f = 0; f < F; ++f) {
+        const int64_t b = br[f];
+        if (b >= B) continue;
+        double* cell = orow + f * bs + b * S;
+        for (int64_t s = 0; s < S; ++s) cell[s] += srow[s];
+      }
+    }
+  }
+}
+
+int ResolveThreads(int64_t nblocks, int64_t need) {
+  int num_threads = 0;
+  if (const char* env = std::getenv("YDF_TPU_HIST_THREADS")) {
+    num_threads = std::atoi(env);
+  }
+  if (num_threads <= 0) {
+    num_threads = static_cast<int>(std::thread::hardware_concurrency());
+  }
+  if (num_threads < 1) num_threads = 1;
+  // One partial histogram lives per in-flight block: bound the arena.
+  const int64_t mem_cap =
+      std::max<int64_t>(1, kArenaBudgetBytes / (need * int64_t{8}));
+  num_threads = static_cast<int>(std::min<int64_t>(
+      {static_cast<int64_t>(num_threads), nblocks, mem_cap}));
+  return num_threads;
+}
+
+}  // namespace
 
 static ffi::Error HistogramImpl(ffi::Buffer<ffi::DataType::U8> bins,
                                 ffi::Buffer<ffi::DataType::S32> slot,
@@ -38,65 +139,85 @@ static ffi::Error HistogramImpl(ffi::Buffer<ffi::DataType::U8> bins,
   const float* stp = stats.typed_data();
   float* outp = out->typed_data();
 
-  // f64 accumulators (the reference's splitter sums are double too,
-  // utils/distribution.h): keeps the result row-order invariant to
-  // float tolerance and loses no gradient mass at n in the millions.
-  // The scratch is thread_local and grow-only: this runs once per layer
-  // per tree, and re-allocating ~100+ MB each call would dominate; a
+  // Scratch is thread_local and grow-only: this runs once per layer per
+  // tree, and re-allocating ~100+ MB each call would dominate; a
   // bad_alloc must surface as an FFI error, not cross the C boundary.
   static thread_local std::vector<double> acc;
-  const size_t need = static_cast<size_t>(L) * F * B * S;
-  if (acc.size() < need) {
-    try {
-      acc.resize(need);
-    } catch (const std::bad_alloc&) {
-      return ffi::Error(ffi::ErrorCode::kResourceExhausted,
-                        "histogram scratch allocation failed");
+  static thread_local std::vector<double> arena;
+  const int64_t need = L * F * B * S;
+  const int64_t nblocks = (n + kRowBlock - 1) / kRowBlock;
+  const int threads = ResolveThreads(std::max<int64_t>(nblocks, 1), need);
+  // In-flight partials per wave. 1 block ≡ 1 partial ≡ the accumulator
+  // itself, so the arena is skipped entirely.
+  const int wave = static_cast<int>(
+      std::min<int64_t>(std::max(threads, 1), std::max<int64_t>(nblocks, 1)));
+  try {
+    if (acc.size() < static_cast<size_t>(need)) acc.resize(need);
+    if (nblocks > 1 &&
+        arena.size() < static_cast<size_t>(need) * wave) {
+      arena.resize(static_cast<size_t>(need) * wave);
     }
+  } catch (const std::bad_alloc&) {
+    return ffi::Error(ffi::ErrorCode::kResourceExhausted,
+                      "histogram scratch allocation failed");
   }
-  std::memset(acc.data(), 0, sizeof(double) * need);
-  double* op = acc.data();
+  // Raw pointers for the worker lambdas: `acc`/`arena` are thread_local,
+  // and thread_locals are NOT captured by lambdas — a worker thread
+  // naming them would resolve its OWN (empty) instances and fault.
+  double* const acc_p = acc.data();
+  double* const arena_p = arena.empty() ? nullptr : arena.data();
+  std::memset(acc_p, 0, sizeof(double) * need);
 
-  // Accumulation layout matches the output directly: row stride of one
-  // slot is F*B*S; one feature is B*S. For the common S=3 the inner
-  // loop is unrolled; the generic path covers any S.
-  const int64_t fbs = F * B * S, bs = B * S;
-  // Out-of-range bins are skipped defensively (callers guarantee
-  // bin < B; a violation must corrupt a histogram cell in XLA's scatter
-  // formulation but must NOT scribble past this buffer).
-  if (S == 3) {
-    for (int64_t i = 0; i < n; ++i) {
-      const int32_t l = sp[i];
-      if (l < 0 || l >= L) continue;  // trash slot: inactive/padded row
-      const double g = stp[i * 3], h = stp[i * 3 + 1], w = stp[i * 3 + 2];
-      const uint8_t* br = bp + i * F;
-      double* orow = op + l * fbs;
-      for (int64_t f = 0; f < F; ++f) {
-        const int64_t b = br[f];
-        if (b >= B) continue;
-        double* cell = orow + f * bs + b * 3;
-        cell[0] += g;
-        cell[1] += h;
-        cell[2] += w;
-      }
-    }
+  if (nblocks <= 1) {
+    // Single block: accumulating straight into the (zeroed) result is
+    // bit-identical to partial-then-reduce.
+    AccumulateRows(bp, sp, stp, acc_p, F, L, B, S, 0, n);
   } else {
-    for (int64_t i = 0; i < n; ++i) {
-      const int32_t l = sp[i];
-      if (l < 0 || l >= L) continue;
-      const float* srow = stp + i * S;
-      const uint8_t* br = bp + i * F;
-      double* orow = op + l * fbs;
-      for (int64_t f = 0; f < F; ++f) {
-        const int64_t b = br[f];
-        if (b >= B) continue;
-        double* cell = orow + f * bs + b * S;
-        for (int64_t s = 0; s < S; ++s) cell[s] += srow[s];
+    for (int64_t wave0 = 0; wave0 < nblocks; wave0 += wave) {
+      const int m = static_cast<int>(
+          std::min<int64_t>(wave, nblocks - wave0));
+      auto fill = [&, arena_p](int j) {
+        double* part = arena_p + static_cast<size_t>(j) * need;
+        std::memset(part, 0, sizeof(double) * need);
+        const int64_t r0 = (wave0 + j) * kRowBlock;
+        const int64_t r1 = std::min(r0 + kRowBlock, n);
+        AccumulateRows(bp, sp, stp, part, F, L, B, S, r0, r1);
+      };
+      if (m == 1 || threads == 1) {
+        for (int j = 0; j < m; ++j) fill(j);
+      } else {
+        std::vector<std::thread> pool;
+        pool.reserve(m);
+        for (int j = 0; j < m; ++j) pool.emplace_back(fill, j);
+        for (auto& th : pool) th.join();
+      }
+      // Reduce this wave's partials into acc in ASCENDING BLOCK ORDER
+      // per cell (the fixed-order reduction that makes the result
+      // independent of the thread count); parallel over disjoint cell
+      // ranges.
+      auto reduce = [&, acc_p, arena_p](int64_t c0, int64_t c1) {
+        for (int j = 0; j < m; ++j) {
+          const double* part = arena_p + static_cast<size_t>(j) * need;
+          for (int64_t c = c0; c < c1; ++c) acc_p[c] += part[c];
+        }
+      };
+      if (threads == 1 || need < (int64_t{1} << 16)) {
+        reduce(0, need);
+      } else {
+        std::vector<std::thread> pool;
+        pool.reserve(threads);
+        const int64_t per = (need + threads - 1) / threads;
+        for (int t = 0; t < threads; ++t) {
+          const int64_t c0 = t * per;
+          const int64_t c1 = std::min(c0 + per, need);
+          if (c0 >= c1) break;
+          pool.emplace_back(reduce, c0, c1);
+        }
+        for (auto& th : pool) th.join();
       }
     }
   }
-  const int64_t total = L * F * B * S;
-  for (int64_t i = 0; i < total; ++i) outp[i] = static_cast<float>(op[i]);
+  for (int64_t i = 0; i < need; ++i) outp[i] = static_cast<float>(acc_p[i]);
   return ffi::Error::Success();
 }
 

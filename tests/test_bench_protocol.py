@@ -40,6 +40,11 @@ def test_small_cpu_run_emits_parseable_record():
     # trajectory tracks the fused-binning target (round 6).
     assert "ingest_s" in rec and rec["ingest_s"] >= 0
     assert "bin_s" in rec and rec["bin_s"] >= 0
+    # The per-layer histogram attribution (PR-2 sibling subtraction):
+    # measured subtraction-slot walls plus the direct-slot comparison
+    # that makes the halved contraction visible in the record.
+    assert "hist_s" in rec and rec["hist_s"] >= 0
+    assert "hist_direct_s" in rec and rec["hist_direct_s"] >= 0
 
 
 @pytest.mark.slow

@@ -47,6 +47,43 @@ def test_histogram_chunking(impl):
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
 
 
+def _impls_with_native():
+    from ydf_tpu.ops import histogram_native
+
+    impls = ["segment", "matmul", "pallas_interpret"]
+    if histogram_native.available():
+        impls.append("native")
+    return impls
+
+
+@pytest.mark.parametrize("n", [1000, 1024])  # 1000 % 256 != 0; 1024 exact
+@pytest.mark.parametrize("chunk", [256])
+def test_chunk_boundaries_bit_equal(n, chunk):
+    """Every impl at a small explicit chunk — both with a ragged tail
+    (n % chunk != 0) and at the exact-multiple edge — is BIT-equal to
+    the unchunked segment oracle. Integer-valued stats make every
+    partial sum exactly representable in f32, so accumulation order
+    (scan chunks, per-thread blocks, dot tilings) cannot excuse a
+    mismatch."""
+    rng = np.random.default_rng(n)
+    F, L, B, S = 5, 8, 16, 3
+    bins = jnp.asarray(rng.integers(0, B, (n, F)), jnp.uint8)
+    slot = jnp.asarray(rng.integers(0, L + 1, (n,)), jnp.int32)
+    stats = jnp.asarray(
+        rng.integers(-8, 9, (n, S)).astype(np.float32)
+    )
+    oracle = np.asarray(
+        histogram(bins, slot, stats, num_slots=L, num_bins=B,
+                  impl="segment", chunk=1 << 20)
+    )
+    for impl in _impls_with_native():
+        got = np.asarray(
+            histogram(bins, slot, stats, num_slots=L, num_bins=B,
+                      impl=impl, chunk=chunk)
+        )
+        np.testing.assert_array_equal(got, oracle, err_msg=impl)
+
+
 def test_segment_chunked_scan_path():
     """The fused-scatter segment impl accumulates identically when the
     example axis is split into scan chunks (memory-bounding path)."""

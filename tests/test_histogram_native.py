@@ -74,6 +74,35 @@ def test_grow_tree_equivalent_trees():
                                rtol=1e-5, atol=1e-4)
 
 
+def test_bit_stable_across_thread_counts(monkeypatch):
+    """The multithreaded kernel partitions rows into FIXED 32k blocks and
+    reduces per-block f64 partials in ascending block order, so the f32
+    result is BIT-identical for any YDF_TPU_HIST_THREADS — trained trees
+    stay reproducible across machines with different core counts. The
+    77k-row input spans 3 blocks with a ragged tail."""
+    rng = np.random.default_rng(3)
+    n, F, L, B, S = 77_000, 6, 8, 32, 3
+    bins = jnp.asarray(rng.integers(0, B, (n, F)), jnp.uint8)
+    slot = jnp.asarray(rng.integers(0, L + 1, (n,)), jnp.int32)
+    stats = jnp.asarray(rng.normal(size=(n, S)), jnp.float32)
+
+    def run(threads):
+        monkeypatch.setenv("YDF_TPU_HIST_THREADS", str(threads))
+        return np.asarray(
+            histogram(bins, slot, stats, num_slots=L, num_bins=B,
+                      impl="native")
+        )
+
+    base = run(1)
+    for t in (2, 3, 8):
+        np.testing.assert_array_equal(base, run(t), err_msg=f"threads={t}")
+    ref = np.asarray(
+        histogram(bins, slot, stats, num_slots=L, num_bins=B,
+                  impl="segment")
+    )
+    np.testing.assert_allclose(base, ref, rtol=1e-5, atol=1e-4)
+
+
 def test_under_jit_and_scan():
     """The FFI call composes with jit + lax.scan (the boosting loop's
     structure)."""

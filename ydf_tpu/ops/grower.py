@@ -81,14 +81,24 @@ def unpack_mask_bit(packed: jax.Array, bit: jax.Array) -> jax.Array:
     return ((word >> (bit.astype(jnp.uint32) & 31)) & 1).astype(jnp.bool_)
 
 
-def grow_tree(bins, stats, key, *, hist_impl: str = "auto", **kw):
-    """Thin wrapper resolving hist_impl="auto" to a concrete impl BEFORE
-    the jit boundary — the jitted cache must be keyed on the concrete impl
-    (see ops/histogram.py:resolve_hist_impl for why)."""
-    from ydf_tpu.ops.histogram import resolve_hist_impl
+def grow_tree(
+    bins, stats, key, *, hist_impl: str = "auto",
+    hist_subtract: Optional[bool] = None, **kw,
+):
+    """Thin wrapper resolving hist_impl="auto" (and the sibling-subtraction
+    default) to concrete values BEFORE the jit boundary — the jitted cache
+    must be keyed on the concrete impl (see
+    ops/histogram.py:resolve_hist_impl for why)."""
+    from ydf_tpu.ops.histogram import (
+        resolve_hist_impl,
+        resolve_hist_subtract,
+    )
 
     return _grow_tree_jit(
-        bins, stats, key, hist_impl=resolve_hist_impl(hist_impl), **kw
+        bins, stats, key,
+        hist_impl=resolve_hist_impl(hist_impl),
+        hist_subtract=resolve_hist_subtract(hist_subtract),
+        **kw,
     )
 
 
@@ -98,7 +108,7 @@ def grow_tree(bins, stats, key, *, hist_impl: str = "auto", **kw):
         "rule", "max_depth", "frontier", "max_nodes", "num_bins",
         "num_numerical", "min_examples", "min_split_gain",
         "candidate_features", "num_valid_features", "hist_impl",
-        "monotone",
+        "hist_subtract", "monotone",
     ),
 )
 def _grow_tree_jit(
@@ -120,6 +130,16 @@ def _grow_tree_jit(
     # wrapper; a literal "auto" here would be baked into the jit cache
     # key and pin the first resolution forever (the body raises on it).
     hist_impl: str = "segment",
+    # Sibling-subtraction histograms (LightGBM-lineage slot halving): at
+    # every layer past the root, only the SMALLER child of each split
+    # carries a live histogram slot; the larger sibling's histogram is
+    # reconstructed as parent − child from the parent histograms carried
+    # across layers. Halves the per-layer contraction width on every
+    # dense backend and lets the native kernel early-continue larger
+    # child rows. See ops/histogram.py's design note for the float
+    # tolerance argument. Resolved by the grow_tree wrapper
+    # (YDF_TPU_HIST_SUBTRACT=0 disables).
+    hist_subtract: bool = True,
     rule_ctx: Any = None,
     # Per-feature monotone directions (+1 / -1 / 0), static tuple of
     # length F or None. A cut on a +1 feature is only valid when the
@@ -203,6 +223,15 @@ def _grow_tree_jit(
             ((set_bits[..., None] >> shifts) & jnp.uint32(1)) > 0
         ).reshape(n, Fs, Vs)
 
+    # Sibling-subtraction scan state, carried across the (unrolled) layer
+    # loop: (parent_hist [Lh, F, B, S], hslot_map [L+1], small_is_left
+    # [Lh], Lh). hslot_map sends an example's frontier slot to its
+    # histogram slot: split-rank s when the example sits in split s's
+    # SMALLER child, the trash slot Lh otherwise — so the next layer's
+    # histogram is built over ≤ ceil(Ld/2) live slots and larger-child
+    # rows are skippable by every backend.
+    sub_state = None
+
     for depth in range(max_depth):
         key, k_gain, k_feat = jax.random.split(jax.random.fold_in(key, depth), 3)
         children_in_frontier = depth + 1 < max_depth
@@ -224,6 +253,31 @@ def _grow_tree_jit(
             # alone below.
             left_all = jnp.zeros((Ld, 0, B, S), f32)
             hist = None
+        elif sub_state is not None:
+            # Sibling subtraction: histogram ONLY the smaller child of
+            # every previous-layer split (Lh ≤ ceil(Ld/2) live slots; all
+            # other rows carry the trash slot Lh), then reconstruct the
+            # larger sibling as parent − child. The matmul/segment/pallas
+            # contraction width halves; the native kernel early-continues
+            # the trash rows.
+            parent_hist, hslot_map, small_is_left, Lh = sub_state
+            hist_small = histogram(
+                bins, hslot_map[slot], stats, num_slots=Lh, num_bins=B,
+                impl=hist_impl,
+            )  # [Lh, F, B, S]
+            hist_big = parent_hist - hist_small
+            sil = small_is_left[:, None, None, None, None]
+            # Split s's children live at slots (2s, 2s+1) = (left, right).
+            hist = jnp.where(
+                sil,
+                jnp.stack([hist_small, hist_big], axis=1),
+                jnp.stack([hist_big, hist_small], axis=1),
+            ).reshape(2 * Lh, F, B, S)
+            if 2 * Lh < Ld:  # odd frontier cap: top slots never occupied
+                hist = jnp.pad(
+                    hist, ((0, Ld - 2 * Lh), (0, 0), (0, 0), (0, 0))
+                )
+            csum_num = jnp.cumsum(hist[:, :Fn], axis=2)  # [Ld, Fn, B, S]
         else:
             hist = histogram(
                 bins, slot, stats, num_slots=Ld, num_bins=B, impl=hist_impl
@@ -521,6 +575,39 @@ def _grow_tree_jit(
         leaf_id = jnp.where(split_e, child_id_e, leaf_id)
 
         if children_in_frontier:
+            Lh_next = min(Ld, L // 2)  # static bound on this layer's splits
+            if hist_subtract and F > 0 and Lh_next >= 1:
+                # Index each split's data by its rank (children of rank s
+                # sit at slots 2s / 2s+1 next layer); rank Lh_next is the
+                # scatter trash row, sliced off.
+                ridx = jnp.where(do_split, split_rank, Lh_next)
+                parent_next = (
+                    jnp.zeros((Lh_next + 1, F, B, S), hist.dtype)
+                    .at[ridx].set(hist)[:Lh_next]
+                )
+                # Smaller child by the count-like last stat column (the
+                # same column the min_examples validity check uses). The
+                # choice only steers WORK, not results: parent − child is
+                # exact for any additive stats, so a skewed weighting
+                # costs speed, never correctness.
+                small_left = left_stats[:, -1] <= right_stats[:, -1]  # [Ld]
+                small_is_left_next = (
+                    jnp.zeros((Lh_next + 1,), jnp.bool_)
+                    .at[ridx].set(small_left)[:Lh_next]
+                )
+                tgt_l_pre = jnp.where(do_split, 2 * split_rank, L)
+                tgt_r_pre = jnp.where(do_split, 2 * split_rank + 1, L)
+                hmap = jnp.full((L + 1,), Lh_next, i32)
+                hmap = hmap.at[tgt_l_pre].set(
+                    jnp.where(do_split & small_left, split_rank, Lh_next)
+                )
+                hmap = hmap.at[tgt_r_pre].set(
+                    jnp.where(do_split & ~small_left, split_rank, Lh_next)
+                )
+                hmap = hmap.at[L].set(Lh_next)
+                sub_state = (parent_next, hmap, small_is_left_next, Lh_next)
+            else:
+                sub_state = None
             child_slot_e = jnp.where(
                 go_left_e, 2 * pad(split_rank, 0)[slot], 2 * pad(split_rank, 0)[slot] + 1
             )

@@ -22,22 +22,47 @@ Two implementations:
     (slot, bin) index — fast on CPU where scatter-add is native; used by the
     unit tests and as the correctness oracle.
 
-Inactive examples carry slot == L (one past the last frontier slot) and fall
-into a trash row that is dropped.
+Slot contract (shared by ALL backends — segment, matmul, native,
+pallas): `slot` holds the histogram slot of every example, an int32 in
+[0, num_slots]; the value num_slots is the TRASH slot — inactive,
+padded, or deliberately-skipped rows — whose contribution is dropped.
+Callers may pass ANY subset of rows as live; in particular the grower's
+sibling-subtraction mode (ops/grower.py) passes at most ceil(frontier/2)
+live slots per layer, with every larger-child row on the trash slot.
 
-Design note — why no sibling-subtraction trick. CPU histogram GBTs
-(sklearn/LightGBM, and the reference's per-node splitters) halve their
-per-level work by building each level's histograms only over the SMALLER
-child of every split and deriving the sibling as parent − child. That
-trick pays only when the builder iterates a per-node example-index list
-(work ∝ examples visited). Both implementations here are dense over the
-full example axis — segment_sum scatters all n rows, the one-hot matmul
-contracts all n rows — so masking out the larger children would not
-remove any work, and compacting them away would need data-dependent
-shapes that XLA cannot tile onto the MXU. The dense O(n)-per-layer
-formulation is the deliberate TPU trade: it costs ~2× the arithmetic of
-subtraction-tricked CPU code and buys a single fused contraction that
-batches over (nodes × features × bins) with no host round-trips.
+Design note — sibling-subtraction histograms (the slot-halving
+contract). CPU histogram GBTs (sklearn/LightGBM, and the reference's
+per-node splitters) halve their per-level work by building each level's
+histograms only over the SMALLER child of every split and deriving the
+sibling as parent − child. An earlier revision of this file argued the
+trick cannot pay in a dense formulation because every row is touched
+regardless — that was wrong for the contraction backends: the one-hot
+matmul's FLOPs scale with n·B·L·S, so halving the LIVE SLOT COUNT L
+halves the MXU contraction (and the psum payload under shard_map) even
+though all n rows are still read. The grower therefore assigns
+histogram slots only to the smaller child of each split and rebuilds
+the sibling by subtraction before gain search:
+
+  * matmul / segment: the [*, L*S] operand (resp. the [F*(L+1)*B, S]
+    scatter target) halves — half the FLOPs / accumulator footprint.
+  * native: the kernel early-continues rows on the trash slot, so the
+    per-row F-loop runs only for smaller-child rows (~n/2 per layer
+    past the root) and the f64 scratch halves.
+  * pallas: the slot axis is padded to 128 lanes, so the dot shape only
+    shrinks once L exceeds 128; correctness is unchanged (trash rows
+    zero their one-hot column) and HBM traffic was already at the
+    re-read floor.
+
+Float tolerance of parent − child: both operands are f32 sums of the
+same per-example stats, so the reconstruction error per cell is bounded
+by a few ulps of the PARENT's magnitude, and it compounds only linearly
+with depth (each layer's parent is itself at most one subtraction
+deep per level). Count-like stats are small integers times weights —
+cancellation can leave a derived count of 0 at ±~1e-4, far below the
+min_examples >= 1 validity threshold, so no phantom split can validate.
+Gain search already derived every right-hand candidate as parent −
+left-prefix before this change; sibling subtraction adds one more
+subtraction of the same character, not a new failure mode.
 """
 
 from __future__ import annotations
@@ -239,6 +264,30 @@ def resolve_hist_impl(impl: str = "auto") -> str:
     from ydf_tpu.ops.histogram_native import available
 
     return "native" if available() else "segment"
+
+
+def resolve_hist_subtract(value=None) -> bool:
+    """Resolves the grower's sibling-subtraction default BEFORE the jit
+    boundary (same trace-time caveats as resolve_hist_impl: the boosting
+    loop's closure cache is keyed on neither this env var nor the flag).
+    An explicit bool wins; YDF_TPU_HIST_SUBTRACT=0 disables the trick
+    globally (parity debugging, perf A/B); default is ON."""
+    if value is not None:
+        return bool(value)
+    import os
+
+    env = os.environ.get("YDF_TPU_HIST_SUBTRACT")
+    if env is None:
+        return True
+    low = env.strip().lower()
+    if low in ("1", "true", "yes", "on"):
+        return True
+    if low in ("0", "false", "no", "off"):
+        return False
+    raise ValueError(
+        f"YDF_TPU_HIST_SUBTRACT={env!r} is not a boolean; expected one of "
+        "1/0/true/false/yes/no/on/off"
+    )
 
 
 def histogram(

@@ -10,7 +10,13 @@ ADVICE r5), so the package still works without a toolchain.
 
 Why it exists: XLA-CPU lowers segment_sum to a generic scalar scatter
 (~125-180M rows/s measured); this kernel streams the same rows at ~5x
-that (scripts/exp_cpu_histogram.py has the full experiment matrix).
+that (scripts/exp_cpu_histogram.py has the full experiment matrix), and
+is multithreaded over fixed 32k-row blocks with a fixed-order f64
+reduction — bit-stable across thread counts (YDF_TPU_HIST_THREADS
+overrides; same std::thread standard as the binning kernel). Rows on
+the trash slot (slot == num_slots — inactive/padded examples, and every
+larger-child row under the grower's sibling-subtraction mode) are
+early-continued before the per-row feature loop.
 CPU-fallback only — on TPU the histogram is the Mosaic one-hot matmul
 (ops/histogram_pallas.py). Counterpart of the reference's hand-tuned
 bucket-fill loops (splitter_scanner.h:860,933).
@@ -24,6 +30,7 @@ _LIB = NativeLibrary(
     src_name="histogram_ffi.cc",
     lib_name="libydfhist.so",
     ffi_targets={"ydf_histogram": "YdfHistogram"},
+    extra_cflags=("-pthread",),
 )
 
 

@@ -21,8 +21,13 @@ grid axis). Per step, for each (feature f, stat s) the kernel computes
 an MXU dot with the example chunk C as the contraction dimension —
 deep in the systolic array's efficient regime (C = 1024 by default).
 The slot one-hot zero-fills trash rows (slot == L: inactive or padded
-examples), which either land in a padded column (sliced off by the
-wrapper) or outside the iota range entirely.
+examples — and, under the grower's sibling-subtraction mode, every
+larger-child row), which either land in a padded column (sliced off by
+the wrapper) or outside the iota range entirely. Subtraction halves the
+live slot count L per layer; since the slot axis pads to Lp = 128
+lanes, the dot shape only shrinks once L exceeds 128 — the win on this
+backend is the halved [L, F, B, S] output block and psum payload, while
+HBM traffic already sits at the bins+stats re-read floor.
 
 f32 operands for bit-faithful parity with the segment oracle; the
 one-hot operand is exact in bf16, so a bf16x2 split of `stats` is the

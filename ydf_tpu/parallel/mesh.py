@@ -13,7 +13,12 @@ Mapping (SURVEY.md §2.3.3 checklist):
   * example-sharding (data parallelism): the bin matrix / gradients are
     sharded over the `data` mesh axis; the per-layer histogram contraction
     produces partial histograms whose psum over ICI *is* the reference's
-    manager-side merge of worker FindSplits answers.
+    manager-side merge of worker FindSplits answers. Under the grower's
+    sibling-subtraction mode (ops/grower.py) only the smaller child of
+    each split carries a live histogram slot, so the all-reduced tensor
+    is [ceil(L/2), F, B, S] — the psum moves HALF the bytes per layer,
+    and the sibling reconstruction (parent − child) happens on the
+    already-replicated result with no extra collectives.
   * feature-parallel (the reference's model-parallel dimension): shard the
     bin matrix's feature axis over the `feature` mesh axis; per-node argmax
     then needs an all-gather over the feature axis. The ShareSplits /

@@ -327,14 +327,24 @@ def grow_tree_cost(
     }
 
 
-def _analytic_hist_flops(n, F, max_depth, num_bins, S=3, L=1024):
+def _analytic_hist_flops(n, F, max_depth, num_bins, S=3, L=1024,
+                         subtract=True):
     """Closed-form FLOP count of the histogram contraction per tree:
     layer d contracts onehot[n,B]^T @ A[n, Ld*S] per feature
-    (2*n*B*Ld*S flops), Ld = min(2^d, frontier)."""
+    (2*n*B*Ld*S flops), Ld = min(2^d, frontier). With the grower's
+    sibling-subtraction mode (the default) layers past the root only
+    histogram the SMALLER child of each previous split — the live slot
+    count is Lh = min(2^(d-1), frontier // 2) and the sibling comes from
+    a parent − child subtraction (O(Lh·F·B·S), negligible next to the
+    n-row contraction) — halving the MXU work of every layer but the
+    root's."""
     frontier = min(2 ** max(max_depth - 1, 0), L)
     total = 0.0
     for d in range(max_depth):
-        Ld = min(2**d, frontier)
+        if subtract and d > 0:
+            Ld = max(1, min(2 ** (d - 1), frontier // 2))
+        else:
+            Ld = min(2**d, frontier)
         total += 2.0 * n * num_bins * Ld * S * F
     return total
 
