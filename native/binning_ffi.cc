@@ -12,11 +12,16 @@
 // into one pass, with the boundary row (<=1 KB) pinned in L1 and the
 // output tile cache-resident. All columns are processed in ONE call.
 //
-// Threading is std::thread (OpenMP-free). Work is partitioned over ROW
-// ranges rather than columns: the uint8 output is row-major, so two
-// threads owning adjacent columns would false-share nearly every output
-// cache line, while disjoint row ranges never share a line. Each thread
-// still runs the multi-column loop, so boundaries stay hot per column.
+// Threading rides the persistent shared pool (native/thread_pool.h —
+// lazily created, owned by the one shared library this file is
+// compiled into together with histogram_ffi.cc; no per-call thread
+// spawn). Work is partitioned over ROW ranges rather than columns: the
+// uint8 output is row-major, so two tasks owning adjacent columns
+// would false-share nearly every output cache line, while disjoint row
+// ranges never share a line. Each task still runs the multi-column
+// loop, so boundaries stay hot per column. YDF_TPU_BIN_THREADS caps
+// the per-call task count (partitioning, not pool size), so results
+// stay independent of both.
 //
 // Semantics (must stay bit-identical to the NumPy path in
 // ydf_tpu/dataset/binning.py:transform):
@@ -37,6 +42,7 @@
 #include <thread>
 #include <vector>
 
+#include "thread_pool.h"
 #include "xla/ffi/api/ffi.h"
 
 namespace {
@@ -159,17 +165,18 @@ extern "C" void ydf_bin_columns(const float* values, const float* boundaries,
             out_stride, 0, n);
     return;
   }
-  std::vector<std::thread> pool;
-  pool.reserve(threads);
+  // Fixed row-range partition per task; execution order is irrelevant
+  // (tasks write disjoint output rows), so the pool cannot change the
+  // result.
   const int64_t per = (n + threads - 1) / threads;
-  for (int t = 0; t < threads; ++t) {
+  ydf_native::ThreadPool::Get().Run(threads, [&](int t) {
     const int64_t r0 = t * per;
     const int64_t r1 = std::min(r0 + per, n);
-    if (r0 >= r1) break;
-    pool.emplace_back(BinRows, values, boundaries, nbounds, impute, out, n,
-                      F, max_b, out_stride, r0, r1);
-  }
-  for (auto& th : pool) th.join();
+    if (r0 < r1) {
+      BinRows(values, boundaries, nbounds, impute, out, n, F, max_b,
+              out_stride, r0, r1);
+    }
+  });
 }
 
 namespace ffi = xla::ffi;

@@ -1,78 +1,139 @@
-// Native CPU histogram kernel, exposed to XLA as an FFI custom call.
+// Native CPU histogram kernels, exposed to XLA as FFI custom calls.
 //
 // The per-layer split-search histogram hist[L, F, B, S] = sum over
 // examples of stats[S] at (slot, feature, bin) is THE hot loop of
 // CPU-fallback training. XLA-CPU lowers segment_sum to a generic
-// scalar scatter measured at ~125-180M rows/s; this kernel is a plain
-// cache-aware C++ loop over the same data (the accumulation target for
-// realistic L*F*B*S fits in L2/L3) and roughly doubles that.
+// scalar scatter measured at ~125-180M rows/s; these kernels are plain
+// cache-aware C++ loops over the same data (the accumulation target for
+// realistic L*F*B*S fits in L2/L3).
+//
+// Two precisions:
+//
+//   "ydf_histogram"    f32 stats -> f64 block partials -> f32 out. The
+//                      exact path (the reference's splitter sums are
+//                      double too, utils/distribution.h).
+//   "ydf_histogram_q8" int8 quantized stats (ops/histogram.py's int8
+//                      mode) -> packed int16-lane block accumulation ->
+//                      int32 partials -> int64 fixed-order reduction
+//                      with a SINGLE dequantize (× f32 scale) at the
+//                      end. For the hot S == 3 (grad, hess, weight)
+//                      layout the three per-cell adds collapse into ONE
+//                      64-bit add: each cell is a packed word of four
+//                      16-bit lanes [hit-count | s0 | s1 | s2], each
+//                      stat lane biased +128 per add so arbitrary-sign
+//                      int8 values stay non-negative in-lane (no carry
+//                      can cross a lane boundary). A lane saturates
+//                      after 128 hits ((255+bias-max) * 128 = 32640 <
+//                      2^16), so the hit-count lane doubles as the
+//                      SATURATION WATERMARK: when a cell's count
+//                      reaches 128 it spills into the block's int32
+//                      partial and resets. This is the LightGBM-GPU
+//                      quantized-histogram trick recast for CPU SIMD
+//                      word-packing; the cell array is 8 bytes/cell vs
+//                      the f32 path's 24 (f64 x 3) — a 3x accumulator
+//                      footprint cut on top of the 4x stats-read cut.
 //
 // Slot contract (ops/histogram.py): slot values in [0, L); anything
 // outside — the trash slot L, negative, padded — is skipped with an
 // early continue BEFORE the per-row feature loop. Under the grower's
 // sibling-subtraction mode every larger-child row rides the trash
-// slot, so past the root this kernel touches only ~half the rows' F*S
-// work per layer (the smaller children), on top of the halved [L,...]
-// scratch/writeback.
+// slot, so past the root these kernels touch only ~half the rows' F*S
+// work per layer.
 //
-// Threading (same std::thread, OpenMP-free standard as
-// native/binning_ffi.cc): rows are cut into FIXED 32k-row blocks, each
-// block accumulated into its own f64 partial histogram by a worker
-// thread, and partials are reduced into the result in ASCENDING BLOCK
-// ORDER (the reduction itself parallelizes over disjoint cell ranges).
-// Because the block boundaries and the reduction order are independent
-// of the thread count, the result is BIT-STABLE across thread counts —
-// 1 thread and 16 threads produce identical f32 outputs (f64 partial
-// sums rounded once at the end), which keeps trained trees
-// reproducible across machines. YDF_TPU_HIST_THREADS overrides the
-// thread count (hardware_concurrency by default).
+// Threading (shared persistent pool, native/thread_pool.h): rows are
+// cut into FIXED 32k-row blocks, each block accumulated into its own
+// partial histogram by a pool task, and partials are reduced into the
+// result in ASCENDING BLOCK ORDER (the reduction itself parallelizes
+// over disjoint cell ranges). Because the block boundaries and the
+// reduction order are independent of the thread count, the result is
+// BIT-STABLE across thread counts — and the q8 kernel's integer
+// partials make that exactness trivial (integer addition is
+// associative). YDF_TPU_HIST_THREADS caps the per-call task wave
+// (hardware_concurrency by default).
 //
-// f64 accumulators (the reference's splitter sums are double too,
-// utils/distribution.h): keeps the result row-order invariant to
-// float tolerance and loses no gradient mass at n in the millions.
-//
-// TPU-native note: this kernel exists for the CPU fallback path only —
+// TPU-native note: these kernels exist for the CPU fallback path only —
 // on TPU the same contraction runs as the Mosaic one-hot-matmul kernel
-// (ops/histogram_pallas.py). It is the moral counterpart of the
-// reference's hand-tuned bucket-fill scan loops
-// (ydf/learner/decision_tree/splitter_scanner.h:860,933).
+// (ops/histogram_pallas.py, bf16x2/int8 MXU tiles under the same quant
+// modes). Moral counterpart of the reference's hand-tuned bucket-fill
+// scan loops (ydf/learner/decision_tree/splitter_scanner.h:860,933).
 //
-// Built on demand by ydf_tpu/ops/histogram_native.py with
-//   g++ -O3 -std=c++17 -shared -fPIC -pthread -I<jax.ffi.include_dir()>
-// and registered via jax.ffi.register_ffi_target (CPU platform).
+// Built on demand by ydf_tpu/ops/native_ffi.py (one shared library with
+// binning_ffi.cc) and registered via jax.ffi.register_ffi_target (CPU).
 
 #include <algorithm>
+#include <atomic>
+#include <chrono>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
-#include <thread>
 #include <vector>
 
+#include "thread_pool.h"
 #include "xla/ffi/api/ffi.h"
 
 namespace ffi = xla::ffi;
 
+// In-loop wall-clock attribution (read by ydf_tpu/utils/profiling.py
+// through ctypes): the jitted boosting loop is one fused XLA program,
+// so the only honest per-op histogram timing on the CPU path is
+// measured INSIDE the custom call. Counters are cumulative; the bench
+// resets them around the steady-state train() it attributes.
+static std::atomic<int64_t> g_hist_ns{0};
+static std::atomic<int64_t> g_hist_calls{0};
+
+extern "C" int64_t ydf_hist_ns_total() { return g_hist_ns.load(); }
+extern "C" int64_t ydf_hist_calls_total() { return g_hist_calls.load(); }
+extern "C" void ydf_hist_counters_reset() {
+  g_hist_ns.store(0);
+  g_hist_calls.store(0);
+}
+
 namespace {
+
+class ScopedHistTimer {
+ public:
+  ScopedHistTimer() : t0_(std::chrono::steady_clock::now()) {}
+  ~ScopedHistTimer() {
+    g_hist_ns.fetch_add(std::chrono::duration_cast<std::chrono::nanoseconds>(
+                            std::chrono::steady_clock::now() - t0_)
+                            .count());
+    g_hist_calls.fetch_add(1);
+  }
+
+ private:
+  std::chrono::steady_clock::time_point t0_;
+};
 
 // Fixed accumulation block: the unit of work AND of reduction order.
 // Must not depend on the thread count (bit-stability) — do not "tune"
 // it per machine.
 constexpr int64_t kRowBlock = 32768;
-// Cap on the per-call partial-histogram arena (doubles). Oversized
-// [L, F, B, S] targets fall back to fewer in-flight partials rather
-// than exhausting memory.
+// Cap on the per-call partial-histogram arena. Oversized [L, F, B, S]
+// targets fall back to fewer in-flight partials rather than exhausting
+// memory.
 constexpr int64_t kArenaBudgetBytes = int64_t{512} << 20;
+
+// Packed-q8 lane layout (S == 3): [count | s0 | s1 | s2], 16 bits each,
+// stat lanes biased by kBias per add. Spill when count reaches
+// kWatermark: max lane value is (127 + kBias) * kWatermark = 32640.
+constexpr uint64_t kBias = 128;
+constexpr uint64_t kWatermark = 128;
 
 // Accumulates rows [row_begin, row_end) into `acc` (an [L, F, B, S]
 // f64 histogram, zeroed by the caller). The common S=3 (grad, hess,
 // weight) inner loop is unrolled; the generic path covers any S.
-void AccumulateRows(const uint8_t* bp, const int32_t* sp, const float* stp,
-                    double* acc, int64_t F, int64_t L, int64_t B, int64_t S,
-                    int64_t row_begin, int64_t row_end) {
+// kCheckB: out-of-range bins are skipped defensively (callers guarantee
+// bin < B; a violation must corrupt a histogram cell in XLA's scatter
+// formulation but must NOT scribble past this buffer). With uint8 bins
+// and B == 256 the check can never fire, so the dispatcher drops it
+// from the inner loop (bit-identical by construction — the branch was
+// never taken).
+template <bool kCheckB>
+void AccumulateRowsImpl(const uint8_t* bp, const int32_t* sp,
+                        const float* stp, double* acc, int64_t F, int64_t L,
+                        int64_t B, int64_t S, int64_t row_begin,
+                        int64_t row_end) {
   const int64_t fbs = F * B * S, bs = B * S;
-  // Out-of-range bins are skipped defensively (callers guarantee
-  // bin < B; a violation must corrupt a histogram cell in XLA's scatter
-  // formulation but must NOT scribble past this buffer).
   if (S == 3) {
     for (int64_t i = row_begin; i < row_end; ++i) {
       const int32_t l = sp[i];
@@ -83,7 +144,7 @@ void AccumulateRows(const uint8_t* bp, const int32_t* sp, const float* stp,
       double* orow = acc + l * fbs;
       for (int64_t f = 0; f < F; ++f) {
         const int64_t b = br[f];
-        if (b >= B) continue;
+        if (kCheckB && b >= B) continue;
         double* cell = orow + f * bs + b * 3;
         cell[0] += g;
         cell[1] += h;
@@ -99,7 +160,7 @@ void AccumulateRows(const uint8_t* bp, const int32_t* sp, const float* stp,
       double* orow = acc + l * fbs;
       for (int64_t f = 0; f < F; ++f) {
         const int64_t b = br[f];
-        if (b >= B) continue;
+        if (kCheckB && b >= B) continue;
         double* cell = orow + f * bs + b * S;
         for (int64_t s = 0; s < S; ++s) cell[s] += srow[s];
       }
@@ -107,7 +168,144 @@ void AccumulateRows(const uint8_t* bp, const int32_t* sp, const float* stp,
   }
 }
 
-int ResolveThreads(int64_t nblocks, int64_t need) {
+void AccumulateRows(const uint8_t* bp, const int32_t* sp, const float* stp,
+                    double* acc, int64_t F, int64_t L, int64_t B, int64_t S,
+                    int64_t row_begin, int64_t row_end) {
+  if (B >= 256) {
+    AccumulateRowsImpl<false>(bp, sp, stp, acc, F, L, B, S, row_begin,
+                              row_end);
+  } else {
+    AccumulateRowsImpl<true>(bp, sp, stp, acc, F, L, B, S, row_begin,
+                             row_end);
+  }
+}
+
+// Spills one packed q8 cell into its int32 partial triple and returns
+// the cleared word. Unbias: lane holds sum(q + kBias) = sum(q) +
+// kBias * count.
+inline void SpillCell(uint64_t word, int32_t* cell3) {
+  const int64_t count = static_cast<int64_t>(word & 0xFFFF);
+  const int64_t bias = static_cast<int64_t>(kBias) * count;
+  cell3[0] += static_cast<int32_t>(
+      static_cast<int64_t>((word >> 16) & 0xFFFF) - bias);
+  cell3[1] += static_cast<int32_t>(
+      static_cast<int64_t>((word >> 32) & 0xFFFF) - bias);
+  cell3[2] += static_cast<int32_t>(
+      static_cast<int64_t>((word >> 48) & 0xFFFF) - bias);
+}
+
+// Accumulates q8 rows [row_begin, row_end) into the int32 partial
+// `part` ([L, F, B, S], zeroed by caller). For S == 3, `packed` is the
+// [L*F*B] packed-lane scratch (zeroed by caller); all still-packed
+// cells are flushed into `part` before returning, so `packed` leaves
+// this function all-zero again.
+// Accumulates q8 rows [row_begin, row_end) into the int32 partial
+// `part` ([L, F, B, S], zeroed by caller). For S == 3, `packed` selects
+// the packed int16-lane path: each cell is one 64-bit word of four
+// 16-bit lanes [count | s0 | s1 | s2] (biased; see the header comment)
+// so the three per-cell adds collapse into ONE 64-bit add, spilling to
+// `part` at the saturation watermark. The small-footprint S == 3 path
+// (packed == nullptr, chosen by the caller when the cell array is
+// cache-resident) does three register-hoisted int32 adds instead —
+// on a cache-resident array the independent adds pipeline better than
+// the packed add->mask->compare chain. All still-packed cells are
+// flushed into `part` before returning, so `packed` leaves this
+// function all-zero again. NOTE: a 16-way-interleaved gather-then-sweep
+// schedule (the binning kernel's standard) was measured HERE and LOST
+// ~25% to this straight row walk — the row-major bins walk rides the
+// hardware prefetcher, which the column sweep defeats; see
+// docs/histogram_quantization.md for the experiment table.
+template <bool kCheckB>
+void AccumulateRowsQ8Impl(const uint8_t* bp, const int32_t* sp,
+                          const int8_t* qp, int32_t* part, uint64_t* packed,
+                          int64_t F, int64_t L, int64_t B, int64_t S,
+                          int64_t row_begin, int64_t row_end) {
+  const int64_t fb = F * B;
+  if (S == 3 && packed == nullptr) {
+    for (int64_t i = row_begin; i < row_end; ++i) {
+      const int32_t l = sp[i];
+      if (l < 0 || l >= L) continue;  // trash slot skipped before the
+                                      // feature loop, like the f32 path
+      const int32_t q0 = qp[i * 3], q1 = qp[i * 3 + 1], q2 = qp[i * 3 + 2];
+      const uint8_t* br = bp + i * F;
+      int32_t* orow = part + l * fb * 3;
+      for (int64_t f = 0; f < F; ++f) {
+        const int64_t b = br[f];
+        if (kCheckB && b >= B) continue;
+        int32_t* cell = orow + (f * B + b) * 3;
+        cell[0] += q0;
+        cell[1] += q1;
+        cell[2] += q2;
+      }
+    }
+    return;
+  }
+  if (S == 3) {
+    for (int64_t i = row_begin; i < row_end; ++i) {
+      const int32_t l = sp[i];
+      if (l < 0 || l >= L) continue;
+      const int8_t* q = qp + i * 3;
+      // One packed delta per ROW, shared by all its features.
+      const uint64_t delta =
+          1ull |
+          (static_cast<uint64_t>(static_cast<uint8_t>(q[0] + 128)) << 16) |
+          (static_cast<uint64_t>(static_cast<uint8_t>(q[1] + 128)) << 32) |
+          (static_cast<uint64_t>(static_cast<uint8_t>(q[2] + 128)) << 48);
+      const uint8_t* br = bp + i * F;
+      uint64_t* prow = packed + l * fb;
+      for (int64_t f = 0; f < F; ++f) {
+        const int64_t b = br[f];
+        if (kCheckB && b >= B) continue;
+        uint64_t* cell = prow + f * B + b;
+        uint64_t w = *cell + delta;
+        if ((w & 0xFFFF) >= kWatermark) {  // saturation watermark
+          SpillCell(w, part + (cell - packed) * 3);
+          w = 0;
+        }
+        *cell = w;
+      }
+    }
+    // Flush the still-packed remainder (count < watermark) and leave
+    // the scratch zeroed for the next block.
+    const int64_t ncells = L * fb;
+    for (int64_t c = 0; c < ncells; ++c) {
+      if (packed[c] != 0) {
+        SpillCell(packed[c], part + c * 3);
+        packed[c] = 0;
+      }
+    }
+  } else {
+    const int64_t fbs = fb * S, bs = B * S;
+    for (int64_t i = row_begin; i < row_end; ++i) {
+      const int32_t l = sp[i];
+      if (l < 0 || l >= L) continue;
+      const int8_t* q = qp + i * S;
+      const uint8_t* br = bp + i * F;
+      int32_t* orow = part + l * fbs;
+      for (int64_t f = 0; f < F; ++f) {
+        const int64_t b = br[f];
+        if (kCheckB && b >= B) continue;
+        int32_t* cell = orow + f * bs + b * S;
+        for (int64_t s = 0; s < S; ++s) cell[s] += q[s];
+      }
+    }
+  }
+}
+
+void AccumulateRowsQ8(const uint8_t* bp, const int32_t* sp,
+                      const int8_t* qp, int32_t* part, uint64_t* packed,
+                      int64_t F, int64_t L, int64_t B, int64_t S,
+                      int64_t row_begin, int64_t row_end) {
+  if (B >= 256) {
+    AccumulateRowsQ8Impl<false>(bp, sp, qp, part, packed, F, L, B, S,
+                                row_begin, row_end);
+  } else {
+    AccumulateRowsQ8Impl<true>(bp, sp, qp, part, packed, F, L, B, S,
+                               row_begin, row_end);
+  }
+}
+
+int ResolveThreads(int64_t nblocks, int64_t bytes_per_partial) {
   int num_threads = 0;
   if (const char* env = std::getenv("YDF_TPU_HIST_THREADS")) {
     num_threads = std::atoi(env);
@@ -118,10 +316,34 @@ int ResolveThreads(int64_t nblocks, int64_t need) {
   if (num_threads < 1) num_threads = 1;
   // One partial histogram lives per in-flight block: bound the arena.
   const int64_t mem_cap =
-      std::max<int64_t>(1, kArenaBudgetBytes / (need * int64_t{8}));
+      std::max<int64_t>(1, kArenaBudgetBytes / bytes_per_partial);
   num_threads = static_cast<int>(std::min<int64_t>(
       {static_cast<int64_t>(num_threads), nblocks, mem_cap}));
   return num_threads;
+}
+
+// Ascending-block-order partial reduction shared by both kernels:
+// reduce[c0, c1) sums wave partials (stride `need`) into acc, block
+// order fixed, parallel over disjoint cell ranges on the pool.
+template <typename PartT, typename AccT>
+void ReduceWave(const PartT* arena, AccT* acc, int64_t need, int m,
+                int threads) {
+  auto reduce = [&](int64_t c0, int64_t c1) {
+    for (int j = 0; j < m; ++j) {
+      const PartT* part = arena + static_cast<size_t>(j) * need;
+      for (int64_t c = c0; c < c1; ++c) acc[c] += part[c];
+    }
+  };
+  if (threads == 1 || need < (int64_t{1} << 16)) {
+    reduce(0, need);
+  } else {
+    const int64_t per = (need + threads - 1) / threads;
+    ydf_native::ThreadPool::Get().Run(threads, [&](int t) {
+      const int64_t c0 = t * per;
+      const int64_t c1 = std::min(c0 + per, need);
+      if (c0 < c1) reduce(c0, c1);
+    });
+  }
 }
 
 }  // namespace
@@ -130,6 +352,7 @@ static ffi::Error HistogramImpl(ffi::Buffer<ffi::DataType::U8> bins,
                                 ffi::Buffer<ffi::DataType::S32> slot,
                                 ffi::Buffer<ffi::DataType::F32> stats,
                                 ffi::ResultBufferR4<ffi::DataType::F32> out) {
+  ScopedHistTimer timer;
   const auto bdims = bins.dimensions();   // [n, F]
   const auto odims = out->dimensions();   // [L, F, B, S]
   const int64_t n = bdims[0], F = bdims[1];
@@ -146,7 +369,8 @@ static ffi::Error HistogramImpl(ffi::Buffer<ffi::DataType::U8> bins,
   static thread_local std::vector<double> arena;
   const int64_t need = L * F * B * S;
   const int64_t nblocks = (n + kRowBlock - 1) / kRowBlock;
-  const int threads = ResolveThreads(std::max<int64_t>(nblocks, 1), need);
+  const int threads =
+      ResolveThreads(std::max<int64_t>(nblocks, 1), need * int64_t{8});
   // In-flight partials per wave. 1 block ≡ 1 partial ≡ the accumulator
   // itself, so the arena is skipped entirely.
   const int wave = static_cast<int>(
@@ -162,7 +386,7 @@ static ffi::Error HistogramImpl(ffi::Buffer<ffi::DataType::U8> bins,
                       "histogram scratch allocation failed");
   }
   // Raw pointers for the worker lambdas: `acc`/`arena` are thread_local,
-  // and thread_locals are NOT captured by lambdas — a worker thread
+  // and thread_locals are NOT captured by lambdas — a pool thread
   // naming them would resolve its OWN (empty) instances and fault.
   double* const acc_p = acc.data();
   double* const arena_p = arena.empty() ? nullptr : arena.data();
@@ -176,48 +400,132 @@ static ffi::Error HistogramImpl(ffi::Buffer<ffi::DataType::U8> bins,
     for (int64_t wave0 = 0; wave0 < nblocks; wave0 += wave) {
       const int m = static_cast<int>(
           std::min<int64_t>(wave, nblocks - wave0));
-      auto fill = [&, arena_p](int j) {
+      ydf_native::ThreadPool::Get().Run(m, [&, arena_p](int j) {
         double* part = arena_p + static_cast<size_t>(j) * need;
         std::memset(part, 0, sizeof(double) * need);
         const int64_t r0 = (wave0 + j) * kRowBlock;
         const int64_t r1 = std::min(r0 + kRowBlock, n);
         AccumulateRows(bp, sp, stp, part, F, L, B, S, r0, r1);
-      };
-      if (m == 1 || threads == 1) {
-        for (int j = 0; j < m; ++j) fill(j);
-      } else {
-        std::vector<std::thread> pool;
-        pool.reserve(m);
-        for (int j = 0; j < m; ++j) pool.emplace_back(fill, j);
-        for (auto& th : pool) th.join();
-      }
+      });
       // Reduce this wave's partials into acc in ASCENDING BLOCK ORDER
       // per cell (the fixed-order reduction that makes the result
-      // independent of the thread count); parallel over disjoint cell
-      // ranges.
-      auto reduce = [&, acc_p, arena_p](int64_t c0, int64_t c1) {
-        for (int j = 0; j < m; ++j) {
-          const double* part = arena_p + static_cast<size_t>(j) * need;
-          for (int64_t c = c0; c < c1; ++c) acc_p[c] += part[c];
-        }
-      };
-      if (threads == 1 || need < (int64_t{1} << 16)) {
-        reduce(0, need);
-      } else {
-        std::vector<std::thread> pool;
-        pool.reserve(threads);
-        const int64_t per = (need + threads - 1) / threads;
-        for (int t = 0; t < threads; ++t) {
-          const int64_t c0 = t * per;
-          const int64_t c1 = std::min(c0 + per, need);
-          if (c0 >= c1) break;
-          pool.emplace_back(reduce, c0, c1);
-        }
-        for (auto& th : pool) th.join();
-      }
+      // independent of the thread count).
+      ReduceWave(arena_p, acc_p, need, m, threads);
     }
   }
   for (int64_t i = 0; i < need; ++i) outp[i] = static_cast<float>(acc_p[i]);
+  return ffi::Error::Success();
+}
+
+// int8 quantized-gradient kernel: bins u8 [n, F], slot s32 [n],
+// quantized stats s8 [n, S] (|q| <= 127), scale f32 [S]. Output
+// f32 [L, F, B, S] = (Σ q) * scale — the dequantize happens ONCE, on
+// the int64 totals of the fixed-block-order reduction, so the result
+// is exactly `integer_total * scale` rounded once to f32: bit-stable
+// across thread counts by integer associativity.
+static ffi::Error HistogramQ8Impl(
+    ffi::Buffer<ffi::DataType::U8> bins, ffi::Buffer<ffi::DataType::S32> slot,
+    ffi::Buffer<ffi::DataType::S8> stats, ffi::Buffer<ffi::DataType::F32> scale,
+    ffi::ResultBufferR4<ffi::DataType::F32> out) {
+  ScopedHistTimer timer;
+  const auto bdims = bins.dimensions();   // [n, F]
+  const auto odims = out->dimensions();   // [L, F, B, S]
+  const int64_t n = bdims[0], F = bdims[1];
+  const int64_t L = odims[0], B = odims[2], S = odims[3];
+  const uint8_t* bp = bins.typed_data();
+  const int32_t* sp = slot.typed_data();
+  const int8_t* qp = stats.typed_data();
+  const float* scp = scale.typed_data();
+  float* outp = out->typed_data();
+
+  const int64_t need = L * F * B * S;
+  const int64_t ncells = L * F * B;
+  // Packed int16 lanes pay once the packed cell array outgrows L2 (the
+  // 8-byte cell is 1/3 the int32 triple's working set and the spill
+  // branch amortizes); below that, the register-hoisted int32 triple
+  // add pipelines better. Threshold measured on the bench shapes
+  // (docs/histogram_quantization.md): packed wins from ~L=8·F=28·B=256
+  // upward. The CHOICE does not affect results — both accumulate the
+  // same exact integers.
+  constexpr int64_t kPackedMinBytes = int64_t{384} << 10;
+  const bool use_packed = (S == 3) && ncells * 8 >= kPackedMinBytes;
+  const int64_t nblocks = (n + kRowBlock - 1) / kRowBlock;
+  // Per in-flight block: an int32 partial + (packed path) the 8-byte
+  // packed-lane scratch.
+  const int64_t bytes_per_partial =
+      need * int64_t{4} + (use_packed ? ncells * int64_t{8} : int64_t{0});
+  const int threads =
+      ResolveThreads(std::max<int64_t>(nblocks, 1), bytes_per_partial);
+  const int wave = static_cast<int>(
+      std::min<int64_t>(std::max(threads, 1), std::max<int64_t>(nblocks, 1)));
+
+  static thread_local std::vector<int64_t> acc_q8;
+  static thread_local std::vector<int32_t> arena_q8;
+  static thread_local std::vector<uint64_t> packed_q8;
+  try {
+    if (acc_q8.size() < static_cast<size_t>(need)) acc_q8.resize(need);
+    if (arena_q8.size() < static_cast<size_t>(need) * wave) {
+      arena_q8.resize(static_cast<size_t>(need) * wave);
+    }
+    if (use_packed &&
+        packed_q8.size() < static_cast<size_t>(ncells) * wave) {
+      packed_q8.resize(static_cast<size_t>(ncells) * wave);
+    }
+  } catch (const std::bad_alloc&) {
+    return ffi::Error(ffi::ErrorCode::kResourceExhausted,
+                      "histogram_q8 scratch allocation failed");
+  }
+  // thread_local not captured by lambdas — see HistogramImpl.
+  int64_t* const acc_p = acc_q8.data();
+  int32_t* const arena_p = arena_q8.data();
+  uint64_t* const packed_p = use_packed ? packed_q8.data() : nullptr;
+
+  // Single-thread fast path: integer addition is associative, so one
+  // straight pass over all rows into one int32 partial is EXACTLY the
+  // block-partials-then-ascending-reduce result (unlike the f64 f32
+  // kernel, where the block structure is load-bearing for
+  // bit-stability) — and it skips one memset + one full-array reduce
+  // per 32k-row block, ~40% of single-core wall at bench shapes. Lane
+  // bound: |cell| <= 127 * n must fit int32, so n is capped; larger
+  // inputs take the wave path whose per-block bound is kRowBlock * 127.
+  constexpr int64_t kMaxSingleRows = ((int64_t{1} << 31) - 1) / 127;
+  if (threads == 1 && n <= kMaxSingleRows) {
+    std::memset(arena_p, 0, sizeof(int32_t) * need);
+    if (packed_p != nullptr) {
+      std::memset(packed_p, 0, sizeof(uint64_t) * ncells);
+    }
+    AccumulateRowsQ8(bp, sp, qp, arena_p, packed_p, F, L, B, S, 0, n);
+    for (int64_t i = 0; i < need; ++i) {
+      outp[i] = static_cast<float>(static_cast<double>(arena_p[i]) *
+                                   static_cast<double>(scp[i % S]));
+    }
+    return ffi::Error::Success();
+  }
+
+  std::memset(acc_p, 0, sizeof(int64_t) * need);
+  for (int64_t wave0 = 0; wave0 < nblocks; wave0 += wave) {
+    const int m =
+        static_cast<int>(std::min<int64_t>(wave, nblocks - wave0));
+    ydf_native::ThreadPool::Get().Run(m, [&, arena_p, packed_p](int j) {
+      int32_t* part = arena_p + static_cast<size_t>(j) * need;
+      std::memset(part, 0, sizeof(int32_t) * need);
+      uint64_t* packed = nullptr;
+      if (packed_p != nullptr) {
+        packed = packed_p + static_cast<size_t>(j) * ncells;
+        std::memset(packed, 0, sizeof(uint64_t) * ncells);
+      }
+      const int64_t r0 = (wave0 + j) * kRowBlock;
+      const int64_t r1 = std::min(r0 + kRowBlock, n);
+      AccumulateRowsQ8(bp, sp, qp, part, packed, F, L, B, S, r0, r1);
+    });
+    ReduceWave(arena_p, acc_p, need, m, threads);
+  }
+  // The single dequantize: int64 totals × per-stat scale, one f32
+  // rounding at the very end.
+  for (int64_t i = 0; i < need; ++i) {
+    outp[i] = static_cast<float>(static_cast<double>(acc_p[i]) *
+                                 static_cast<double>(scp[i % S]));
+  }
   return ffi::Error::Success();
 }
 
@@ -226,5 +534,14 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(
     ffi::Ffi::Bind()
         .Arg<ffi::Buffer<ffi::DataType::U8>>()
         .Arg<ffi::Buffer<ffi::DataType::S32>>()
+        .Arg<ffi::Buffer<ffi::DataType::F32>>()
+        .Ret<ffi::BufferR4<ffi::DataType::F32>>());
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    YdfHistogramQ8, HistogramQ8Impl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::DataType::U8>>()
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()
+        .Arg<ffi::Buffer<ffi::DataType::S8>>()
         .Arg<ffi::Buffer<ffi::DataType::F32>>()
         .Ret<ffi::BufferR4<ffi::DataType::F32>>());

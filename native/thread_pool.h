@@ -1,0 +1,159 @@
+// Persistent worker pool shared by the native kernels (histogram_ffi.cc
+// and binning_ffi.cc, compiled together into ONE shared library by
+// ydf_tpu/ops/native_ffi.py — the pool is owned by that loaded module).
+//
+// Why: the kernels used to spawn std::thread per call. At 32k-row block
+// granularity that is fine for one cold call, but the boosting loop
+// issues one histogram call per (layer, tree) — hundreds of calls per
+// train() — and thread spawn+join was a measurable fixed cost on
+// many-core hosts (ROADMAP open item). The pool spins up ONCE (lazily,
+// on the first parallel call) and parks workers on a condition variable
+// between calls.
+//
+// Bit-stability contract: the pool only changes WHO runs a task, never
+// the task partitioning or the reduction order. Callers still cut work
+// into fixed blocks and reduce in ascending block order, so results
+// remain bit-stable across pool sizes and caller-side thread caps —
+// parallelism is controlled by how many TASKS a call submits (the
+// per-call YDF_TPU_HIST_THREADS / YDF_TPU_BIN_THREADS resolution),
+// which the pool merely bounds from above.
+//
+// Sizing: YDF_TPU_HIST_THREADS at first use, else hardware_concurrency.
+// Task claims are mutex-protected: tasks are 32k-row blocks (~ms), so
+// claim contention is noise, and the mutex closes the stale-worker race
+// (a worker waking from a PREVIOUS run can never claim a task of the
+// current one — claims are generation-checked under the lock).
+
+#ifndef YDF_TPU_NATIVE_THREAD_POOL_H_
+#define YDF_TPU_NATIVE_THREAD_POOL_H_
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace ydf_native {
+
+class ThreadPool {
+ public:
+  // Lazily-created singleton (one per loaded shared library).
+  static ThreadPool& Get() {
+    static ThreadPool pool(ResolveSize());
+    return pool;
+  }
+
+  // Runs fn(0) .. fn(m-1) across the pool and the calling thread;
+  // returns when all m tasks finished. At most min(m, size+1) tasks run
+  // concurrently. Whole Run() calls are serialized (two concurrent XLA
+  // custom calls queue rather than interleave task sets).
+  void Run(int m, const std::function<void(int)>& fn) {
+    if (m <= 0) return;
+    if (m == 1 || workers_.empty()) {
+      for (int i = 0; i < m; ++i) fn(i);
+      return;
+    }
+    std::lock_guard<std::mutex> run_lock(run_mutex_);
+    uint64_t gen;
+    {
+      std::lock_guard<std::mutex> lk(mutex_);
+      task_fn_ = fn;
+      total_ = m;
+      next_ = 0;
+      completed_ = 0;
+      gen = ++generation_;
+    }
+    wake_.notify_all();
+    Work(fn, gen);  // the caller participates
+    {
+      std::unique_lock<std::mutex> lk(mutex_);
+      done_.wait(lk, [&] { return completed_ == total_; });
+      task_fn_ = nullptr;
+    }
+  }
+
+  int size() const { return static_cast<int>(workers_.size()) + 1; }
+
+ private:
+  static int ResolveSize() {
+    int n = 0;
+    if (const char* env = std::getenv("YDF_TPU_HIST_THREADS")) {
+      n = std::atoi(env);
+    }
+    if (n <= 0) n = static_cast<int>(std::thread::hardware_concurrency());
+    if (n < 1) n = 1;
+    // The caller thread participates in every Run, so n-1 workers give
+    // an n-lane pool.
+    return n - 1;
+  }
+
+  explicit ThreadPool(int workers) {
+    workers_.reserve(workers > 0 ? workers : 0);
+    for (int i = 0; i < workers; ++i) {
+      workers_.emplace_back([this] { WorkerLoop(); });
+    }
+  }
+
+  ~ThreadPool() {
+    {
+      std::lock_guard<std::mutex> lk(mutex_);
+      stop_ = true;
+    }
+    wake_.notify_all();
+    for (auto& t : workers_) t.join();
+  }
+
+  void WorkerLoop() {
+    uint64_t seen = 0;
+    while (true) {
+      std::function<void(int)> task;
+      uint64_t gen;
+      {
+        std::unique_lock<std::mutex> lk(mutex_);
+        wake_.wait(lk, [&] { return stop_ || generation_ != seen; });
+        if (stop_) return;
+        seen = gen = generation_;
+        task = task_fn_;  // copy: outlives the caller's reference
+      }
+      if (task) Work(task, gen);
+    }
+  }
+
+  // Claims the next task index of generation `gen`, or -1 when that
+  // generation is exhausted or superseded.
+  int Claim(uint64_t gen) {
+    std::lock_guard<std::mutex> lk(mutex_);
+    if (gen != generation_ || next_ >= total_) return -1;
+    return next_++;
+  }
+
+  void Work(const std::function<void(int)>& fn, uint64_t gen) {
+    while (true) {
+      const int i = Claim(gen);
+      if (i < 0) return;
+      fn(i);
+      std::lock_guard<std::mutex> lk(mutex_);
+      if (gen == generation_ && ++completed_ == total_) {
+        done_.notify_all();
+      }
+    }
+  }
+
+  std::vector<std::thread> workers_;
+  std::mutex run_mutex_;  // serializes whole Run() calls
+  std::mutex mutex_;
+  std::condition_variable wake_;
+  std::condition_variable done_;
+  std::function<void(int)> task_fn_;
+  int total_ = 0;
+  int next_ = 0;
+  int completed_ = 0;
+  uint64_t generation_ = 0;
+  bool stop_ = false;
+};
+
+}  // namespace ydf_native
+
+#endif  // YDF_TPU_NATIVE_THREAD_POOL_H_

@@ -40,11 +40,19 @@ def test_small_cpu_run_emits_parseable_record():
     # trajectory tracks the fused-binning target (round 6).
     assert "ingest_s" in rec and rec["ingest_s"] >= 0
     assert "bin_s" in rec and rec["bin_s"] >= 0
-    # The per-layer histogram attribution (PR-2 sibling subtraction):
-    # measured subtraction-slot walls plus the direct-slot comparison
-    # that makes the halved contraction visible in the record.
+    # Histogram timing, two ways (PR 3): hist_s is the real in-loop op
+    # time (native kernel counter / profiler trace), hist_attrib_s the
+    # historical same-shape attribution, hist_direct_s the
+    # pre-subtraction comparison that makes the halved contraction
+    # visible. hist_quant names the active quantization mode so
+    # quantized and exact trajectories can't be conflated.
     assert "hist_s" in rec and rec["hist_s"] >= 0
+    assert rec.get("hist_s_source") in (
+        "native_kernel_counter", "profiler_trace"
+    )
+    assert "hist_attrib_s" in rec and rec["hist_attrib_s"] >= 0
     assert "hist_direct_s" in rec and rec["hist_direct_s"] >= 0
+    assert rec["hist_quant"] in ("f32", "bf16x2", "int8")
 
 
 @pytest.mark.slow

@@ -27,14 +27,9 @@ from typing import Optional
 
 import numpy as np
 
-from ydf_tpu.ops.native_ffi import NativeLibrary
-
-_LIB = NativeLibrary(
-    src_name="binning_ffi.cc",
-    lib_name="libydfbin.so",
-    ffi_targets={"ydf_binning": "YdfBinning"},
-    extra_cflags=("-pthread",),
-)
+# One shared library with the histogram kernels (ops/native_ffi.py):
+# both ride the persistent worker pool in native/thread_pool.h.
+from ydf_tpu.ops.native_ffi import KERNELS_LIB as _LIB
 
 _PROTO_READY = False
 

@@ -83,14 +83,17 @@ def unpack_mask_bit(packed: jax.Array, bit: jax.Array) -> jax.Array:
 
 def grow_tree(
     bins, stats, key, *, hist_impl: str = "auto",
-    hist_subtract: Optional[bool] = None, **kw,
+    hist_subtract: Optional[bool] = None,
+    hist_quant: Optional[str] = None, **kw,
 ):
-    """Thin wrapper resolving hist_impl="auto" (and the sibling-subtraction
-    default) to concrete values BEFORE the jit boundary — the jitted cache
-    must be keyed on the concrete impl (see
-    ops/histogram.py:resolve_hist_impl for why)."""
+    """Thin wrapper resolving hist_impl="auto" (plus the
+    sibling-subtraction and gradient-quantization defaults) to concrete
+    values BEFORE the jit boundary — the jitted cache must be keyed on
+    the concrete impl (see ops/histogram.py:resolve_hist_impl for
+    why)."""
     from ydf_tpu.ops.histogram import (
         resolve_hist_impl,
+        resolve_hist_quant,
         resolve_hist_subtract,
     )
 
@@ -98,6 +101,7 @@ def grow_tree(
         bins, stats, key,
         hist_impl=resolve_hist_impl(hist_impl),
         hist_subtract=resolve_hist_subtract(hist_subtract),
+        hist_quant=resolve_hist_quant(hist_quant),
         **kw,
     )
 
@@ -108,7 +112,7 @@ def grow_tree(
         "rule", "max_depth", "frontier", "max_nodes", "num_bins",
         "num_numerical", "min_examples", "min_split_gain",
         "candidate_features", "num_valid_features", "hist_impl",
-        "hist_subtract", "monotone",
+        "hist_subtract", "hist_quant", "monotone",
     ),
 )
 def _grow_tree_jit(
@@ -140,6 +144,19 @@ def _grow_tree_jit(
     # tolerance argument. Resolved by the grow_tree wrapper
     # (YDF_TPU_HIST_SUBTRACT=0 disables).
     hist_subtract: bool = True,
+    # Gradient-quantization mode for the stats operand of the scalar
+    # histogram ("f32" exact / "bf16x2" / "int8" — resolved by the
+    # grow_tree wrapper from YDF_TPU_HIST_QUANT). In int8 mode a
+    # dynamic scale is computed from the root frontier's stat ranges,
+    # carried unchanged through the layer-loop scan state (see the
+    # per-tree-scale note at the quantization block below), and
+    # histogram() dequantizes before anything reaches the gain search,
+    # so split gains are scale-invariant up to the documented error
+    # bound (docs/histogram_quantization.md). Set-feature candidates
+    # run EXACT f32 sums of the same dequantized g̃ grid (their
+    # contraction is not histogram-dominated; staying on one grid keeps
+    # parent − prefix consistent).
+    hist_quant: str = "f32",
     rule_ctx: Any = None,
     # Per-feature monotone directions (+1 / -1 / 0), static tuple of
     # length F or None. A cut on a +1 feature is only valid when the
@@ -203,7 +220,47 @@ def _grow_tree_jit(
         is_leaf=jnp.ones((N + 1,), jnp.bool_),
         leaf_stats=jnp.zeros((N + 1, S), f32),
     )
-    total = jnp.sum(stats, axis=0)  # [S]
+
+    # int8 gradient quantization: ONE per-tree scale, computed from the
+    # root frontier's stat ranges and carried unchanged through the
+    # layer-loop scan state. The semantics are then EXACTLY "grow the
+    # tree on the dequantized stats g̃ = round(g/scale)·scale": every
+    # histogram, parent total, and sibling subtraction sees the same
+    # per-row values, so parent − child cancels EXACTLY and the root
+    # total must be the quantized total too. (Re-quantizing per layer
+    # looks tighter but breaks that cancellation: a per-row rounding
+    # bias of ~scale/2 times a 100k-row layer, set against an
+    # exact parent, materializes phantom gradient mass in near-empty
+    # sibling cells and produces unbounded phantom gains — measured as
+    # a 2.5x-too-large bogus root gain on the bench-like shape.) The
+    # scale is snapped to a power of two inside histogram(); mirror
+    # that here so the root total uses the identical grid.
+    if hist_quant == "int8":
+        qscale = jnp.max(jnp.abs(stats), axis=0) / 127.0
+        qscale = jnp.maximum(
+            qscale.astype(f32), jnp.finfo(jnp.float32).tiny
+        )
+        qscale = jnp.exp2(jnp.ceil(jnp.log2(qscale)))
+        # Multiply by the exact pow2 reciprocal (≡ divide, bit for bit).
+        stats_q = jnp.clip(
+            jnp.round(stats * (1.0 / qscale)[None, :]), -127.0, 127.0
+        )
+        total = jnp.sum(stats_q, axis=0) * qscale  # [S] dequantized
+        # Quantize ONCE per tree; every layer's histogram takes the
+        # int8 operand directly (histogram() detects the dtype) instead
+        # of re-paying the O(n·S) transform per layer.
+        hist_stats = stats_q.astype(jnp.int8)
+    elif hist_quant == "bf16x2":
+        qscale = None
+        total = jnp.sum(stats, axis=0)  # [S]
+        # Split ONCE per tree into the bf16 high/residual halves.
+        s_hi = stats.astype(jnp.bfloat16)
+        s_lo = (stats - s_hi.astype(f32)).astype(jnp.bfloat16)
+        hist_stats = jnp.concatenate([s_hi, s_lo], axis=1)  # [n, 2S]
+    else:
+        qscale = None
+        total = jnp.sum(stats, axis=0)  # [S]
+        hist_stats = stats
     tree["leaf_stats"] = tree["leaf_stats"].at[0].set(total)
 
     # Frontier state, padded with one trash slot at index L.
@@ -222,6 +279,17 @@ def _grow_tree_jit(
         multi = (
             ((set_bits[..., None] >> shifts) & jnp.uint32(1)) > 0
         ).reshape(n, Fs, Vs)
+        # Under quantization the set-feature candidates must see the
+        # SAME dequantized stats g̃ the scalar histograms sum — mixing
+        # exact per-item stats against the quantized parent chain would
+        # re-open the phantom-mass hazard the per-tree scale closes
+        # (left_set = parent − prefix with operands on different grids).
+        if hist_quant == "int8":
+            stats_set = stats_q * qscale
+        elif hist_quant == "bf16x2":
+            stats_set = s_hi.astype(f32) + s_lo.astype(f32)
+        else:
+            stats_set = stats
 
     # Sibling-subtraction scan state, carried across the (unrolled) layer
     # loop: (parent_hist [Lh, F, B, S], hslot_map [L+1], small_is_left
@@ -231,6 +299,16 @@ def _grow_tree_jit(
     # histogram is built over ≤ ceil(Ld/2) live slots and larger-child
     # rows are skippable by every backend.
     sub_state = None
+
+    # Trash-row compaction capacity for the XLA-CPU segment impl: under
+    # sibling subtraction the live (smaller-child) rows are at most
+    # ceil(r/2) per split for count-like weights, so n//2 plus one slot
+    # per possible split (+ margin) holds; histogram() falls back to the
+    # full-row path at runtime when non-uniform example weights break
+    # the bound. Other impls ignore the hint (the native kernel already
+    # early-continues trash rows).
+    def _compact_cap(Lh):
+        return (n // 2 + Lh + 8) if hist_impl == "segment" else 0
 
     for depth in range(max_depth):
         key, k_gain, k_feat = jax.random.split(jax.random.fold_in(key, depth), 3)
@@ -262,9 +340,10 @@ def _grow_tree_jit(
             # the trash rows.
             parent_hist, hslot_map, small_is_left, Lh = sub_state
             hist_small = histogram(
-                bins, hslot_map[slot], stats, num_slots=Lh, num_bins=B,
-                impl=hist_impl,
-            )  # [Lh, F, B, S]
+                bins, hslot_map[slot], hist_stats, num_slots=Lh,
+                num_bins=B, impl=hist_impl, quant=hist_quant,
+                quant_scale=qscale, compact=_compact_cap(Lh),
+            )  # [Lh, F, B, S] (dequantized f32 under quantization)
             hist_big = parent_hist - hist_small
             sil = small_is_left[:, None, None, None, None]
             # Split s's children live at slots (2s, 2s+1) = (left, right).
@@ -280,7 +359,8 @@ def _grow_tree_jit(
             csum_num = jnp.cumsum(hist[:, :Fn], axis=2)  # [Ld, Fn, B, S]
         else:
             hist = histogram(
-                bins, slot, stats, num_slots=Ld, num_bins=B, impl=hist_impl
+                bins, slot, hist_stats, num_slots=Ld, num_bins=B,
+                impl=hist_impl, quant=hist_quant, quant_scale=qscale,
             )  # [Ld, F, B, S]
             csum_num = jnp.cumsum(hist[:, :Fn], axis=2)  # [Ld, Fn, B, S]
         if F == 0:
@@ -328,7 +408,7 @@ def _grow_tree_jit(
             # columns [F, F+Fs) ascending, [F+Fs, F+2Fs) descending.
             oh = (slot[:, None] == jnp.arange(Ld)).astype(f32)  # [n, Ld]
             per_item = jnp.einsum(
-                "nfv,nl,ns->lfvs", multi.astype(f32), oh, stats
+                "nfv,nl,ns->lfvs", multi.astype(f32), oh, stats_set
             )  # [Ld, Fs, Vs, S]
             skey = rule.cat_sort_key(per_item, rule_ctx)  # [Ld, Fs, Vs]
             # Items absent from the node sort last IN BOTH DIRECTIONS →
@@ -354,8 +434,9 @@ def _grow_tree_jit(
                     in_cut = (rm < Tc).astype(f32)
                     h = histogram(
                         jnp.minimum(rm, Tc - 1)[:, None], slot,
-                        stats * in_cut[:, None],
+                        stats_set * in_cut[:, None],
                         num_slots=Ld, num_bins=Tc, impl=hist_impl,
+                        quant="f32",  # exact sums of the SAME g̃ grid
                     )  # [Ld, 1, Tc, S]
                     pos_hists.append(h[:, 0])
                 sranks_dirs.append(sranks)

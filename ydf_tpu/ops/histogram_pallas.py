@@ -29,9 +29,22 @@ lanes, the dot shape only shrinks once L exceeds 128 — the win on this
 backend is the halved [L, F, B, S] output block and psum payload, while
 HBM traffic already sits at the bins+stats re-read floor.
 
-f32 operands for bit-faithful parity with the segment oracle; the
-one-hot operand is exact in bf16, so a bf16x2 split of `stats` is the
-future 2x-throughput knob, not a correctness change.
+Operand precision follows stats.dtype (the quantized-gradient pipeline
+in ops/histogram.py hands this kernel the already-split/quantized
+operand):
+
+  * f32 — exact, bit-faithful parity with the segment oracle. Mosaic
+    decomposes each f32 MXU dot into 3 bf16 passes (hi·hi + hi·lo +
+    lo·hi), so this is the SLOW reference precision.
+  * bf16 (the "bf16x2" mode's hi/residual halves, S doubled by the
+    wrapper) — one-hot and slot one-hot are EXACT in bf16 (0/1), so
+    every dot runs as a single native-bf16 MXU pass with f32
+    accumulation: 2 passes per original stat column vs f32's 3.
+  * int8 (the "int8" mode's quantized stats) — both operands are int8
+    tiles (2× the bf16 issue rate on v5+ MXUs) contracting into an
+    int32 accumulator. EXACT: products ≤ 127, per-chunk sums ≤
+    C·127 ≪ 2^31, cross-chunk accumulation in int32. The wrapper
+    dequantizes once after the reduction.
 
 Reference counterpart: the per-(node, feature) bucket-fill scan loops
 `ydf/learner/decision_tree/splitter_scanner.h:860,933` — one linear
@@ -53,7 +66,10 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-def _hist_kernel(bins_ref, slot_ref, stats_ref, out_ref, *, Fb, S, B, Lp):
+def _hist_kernel(
+    bins_ref, slot_ref, stats_ref, out_ref, *, Fb, S, B, Lp, op_dtype,
+    acc_dtype,
+):
     """One (feature-block, example-chunk) grid step.
 
     Everything rides an example-minor [*, C] layout so the chunk C is the
@@ -61,10 +77,11 @@ def _hist_kernel(bins_ref, slot_ref, stats_ref, out_ref, *, Fb, S, B, Lp):
     dimension of every dot — Mosaic's block rules want the last two dims
     (8, 128)-divisible or full.
 
-    bins_ref  [Fb, C] int32   feature bin ids for this chunk/block
-    slot_ref  [1, C]  int32   frontier slot; >= L means inactive/pad
-    stats_ref [S, C]  f32     per-example statistics
-    out_ref   [Fb, S, B, Lp] f32  accumulated across the chunk axis
+    bins_ref  [Fb, C] int32         feature bin ids for this chunk/block
+    slot_ref  [1, C]  int32         frontier slot; >= L = inactive/pad
+    stats_ref [S, C]  op_dtype      per-example statistics (f32 exact,
+                                    bf16 halves, or int8 quantized)
+    out_ref   [Fb, S, B, Lp] acc_dtype  accumulated across the chunk axis
     """
     c_step = pl.program_id(1)
 
@@ -75,15 +92,17 @@ def _hist_kernel(bins_ref, slot_ref, stats_ref, out_ref, *, Fb, S, B, Lp):
     C = bins_ref.shape[1]
     slot_ohT = (
         slot_ref[...] == jax.lax.broadcasted_iota(jnp.int32, (Lp, C), 0)
-    ).astype(jnp.float32)  # [Lp, C]; trash rows all-zero or padded-row
+    ).astype(op_dtype)  # [Lp, C]; trash rows all-zero or padded-row
     biotaT = jax.lax.broadcasted_iota(jnp.int32, (B, C), 0)
     for f in range(Fb):
-        ohT = (bins_ref[f : f + 1, :] == biotaT).astype(jnp.float32)  # [B,C]
+        ohT = (bins_ref[f : f + 1, :] == biotaT).astype(op_dtype)  # [B,C]
         for s in range(S):
+            # one-hot × stat product is exact in every op_dtype (the
+            # one-hot factor is 0/1); int8 keeps |values| ≤ 127.
             aT = slot_ohT * stats_ref[s : s + 1, :]  # [Lp, C]
             h = jax.lax.dot_general(
                 ohT, aT, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
+                preferred_element_type=acc_dtype,
             )  # [B, Lp]
             out_ref[f, s, :, :] += h
 
@@ -111,6 +130,15 @@ def histogram_pallas(
     L, B = num_slots, num_bins
     Lp = _round_up(max(L, 1), 128)
 
+    # Operand/accumulator precision follows stats.dtype (see module
+    # docstring): bf16 halves accumulate f32; int8 contracts into int32.
+    if stats.dtype == jnp.bfloat16:
+        op_dtype, acc_dtype = jnp.bfloat16, jnp.float32
+    elif jnp.issubdtype(stats.dtype, jnp.integer):
+        op_dtype, acc_dtype = jnp.int8, jnp.int32
+    else:
+        op_dtype, acc_dtype = jnp.float32, jnp.float32
+
     if feature_block is None:
         # Keep the resident output block around ~6 MB of VMEM.
         per_f = S * B * Lp * 4
@@ -132,7 +160,10 @@ def histogram_pallas(
 
     grid = (Fp // Fb, n_pad // chunk)
     out = pl.pallas_call(
-        functools.partial(_hist_kernel, Fb=Fb, S=S, B=B, Lp=Lp),
+        functools.partial(
+            _hist_kernel, Fb=Fb, S=S, B=B, Lp=Lp, op_dtype=op_dtype,
+            acc_dtype=acc_dtype,
+        ),
         grid=grid,
         in_specs=[
             pl.BlockSpec((Fb, chunk), lambda fb, c: (fb, c)),
@@ -142,12 +173,12 @@ def histogram_pallas(
         out_specs=pl.BlockSpec(
             (Fb, S, B, Lp), lambda fb, c: (fb, 0, 0, 0)
         ),
-        out_shape=jax.ShapeDtypeStruct((Fp, S, B, Lp), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((Fp, S, B, Lp), acc_dtype),
         interpret=interpret,
     )(
         bins_i.T,
         slot.astype(jnp.int32)[None, :],
-        stats.astype(jnp.float32).T,
+        stats.astype(op_dtype).T,
     )
 
     # [Fp, S, B, Lp] -> [L, F, B, S]

@@ -54,7 +54,10 @@ class NativeLibrary:
     optionally registered as XLA FFI custom-call targets.
 
     Args:
-      src_name: source file name under native/ (e.g. "binning_ffi.cc").
+      src_name: source file name(s) under native/ — a single name or a
+        sequence compiled together into one .so (e.g. the histogram and
+        binning kernels share a library so they share the persistent
+        thread pool in native/thread_pool.h).
       lib_name: output .so name under native/build/.
       ffi_targets: XLA custom-call target name -> exported handler
         symbol; registered (platform "cpu") on the first
@@ -62,17 +65,25 @@ class NativeLibrary:
       extra_cflags: appended to the compile command (e.g. "-pthread").
       needs_ffi_headers: add -I jax.ffi.include_dir() (requires jax at
         BUILD time only; pre-built libraries load without it).
+      extra_deps: additional files under native/ (headers) whose mtime
+        participates in the staleness check.
     """
 
     def __init__(
         self,
-        src_name: str,
+        src_name,
         lib_name: str,
         ffi_targets: Optional[Dict[str, str]] = None,
         extra_cflags: Sequence[str] = (),
         needs_ffi_headers: bool = True,
+        extra_deps: Sequence[str] = (),
     ):
-        self.src = os.path.join(NATIVE_DIR, src_name)
+        names = (
+            (src_name,) if isinstance(src_name, str) else tuple(src_name)
+        )
+        self.srcs = tuple(os.path.join(NATIVE_DIR, s) for s in names)
+        self.src = self.srcs[0]  # primary source, used in warnings
+        self.deps = tuple(os.path.join(NATIVE_DIR, d) for d in extra_deps)
         self.lib_path = os.path.join(BUILD_DIR, lib_name)
         self.ffi_targets = dict(ffi_targets or {})
         self.extra_cflags = tuple(extra_cflags)
@@ -99,26 +110,34 @@ class NativeLibrary:
             stacklevel=3,
         )
 
-    def _build_if_needed(self) -> None:
-        have_src = os.path.isfile(self.src)
-        stale = (
-            have_src
-            and os.path.isfile(self.lib_path)
-            and os.path.getmtime(self.lib_path) < os.path.getmtime(self.src)
+    def is_stale(self) -> bool:
+        """True when the built .so is missing or older than any source
+        or dependency header (the tier-1 native smoke check asserts the
+        opposite after a load)."""
+        if not os.path.isfile(self.lib_path):
+            return True
+        lib_mtime = os.path.getmtime(self.lib_path)
+        return any(
+            os.path.isfile(p) and lib_mtime < os.path.getmtime(p)
+            for p in self.srcs + self.deps
         )
-        if os.path.isfile(self.lib_path) and not stale:
+
+    def _build_if_needed(self) -> None:
+        missing = [p for p in self.srcs if not os.path.isfile(p)]
+        if os.path.isfile(self.lib_path) and not self.is_stale():
             return
-        if not have_src:
-            raise FileNotFoundError(self.src)
+        if missing:
+            raise FileNotFoundError(missing[0])
         cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC"]
         cmd += list(self.extra_cflags)
+        cmd += ["-I", NATIVE_DIR]
         if self.needs_ffi_headers:
             cmd += ["-I", ffi_module().include_dir()]
         os.makedirs(BUILD_DIR, exist_ok=True)
         # Per-process temp name: concurrent cold builds must not
         # os.replace each other's half-written objects.
         tmp = f"{self.lib_path}.{os.getpid()}.tmp"
-        cmd += [self.src, "-o", tmp]
+        cmd += list(self.srcs) + ["-o", tmp]
         subprocess.run(cmd, check=True, capture_output=True, timeout=180)
         os.replace(tmp, self.lib_path)
 
@@ -169,3 +188,23 @@ class NativeLibrary:
                 self._failed = True
                 self._warn_once("ffi registration", e)
             return self._ffi_registered
+
+
+# The training kernels (histogram f32 + int8-quantized, binning) are
+# compiled TOGETHER into one shared library so they share the lazily
+# created persistent worker pool in native/thread_pool.h (per-call
+# std::thread spawn/join was a measurable fixed cost at the boosting
+# loop's call rate — ROADMAP open item). The pool's lifetime is this
+# loaded module's; YDF_TPU_HIST_THREADS sizes it at first use, and the
+# per-call env resolutions still bound each call's task wave.
+KERNELS_LIB = NativeLibrary(
+    src_name=("histogram_ffi.cc", "binning_ffi.cc"),
+    lib_name="libydfkernels.so",
+    ffi_targets={
+        "ydf_histogram": "YdfHistogram",
+        "ydf_histogram_q8": "YdfHistogramQ8",
+        "ydf_binning": "YdfBinning",
+    },
+    extra_cflags=("-pthread",),
+    extra_deps=("thread_pool.h",),
+)

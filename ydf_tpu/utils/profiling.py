@@ -67,6 +67,80 @@ def maybe_trace(label: str = "train") -> Iterator[None]:
         yield
 
 
+def trace_event_seconds(
+    trace_dir: str, substrings: Optional[tuple] = None
+) -> Dict[str, float]:
+    """Aggregates per-op wall seconds from a jax.profiler trace directory.
+
+    Parses the xprof `*.xplane.pb` files with the schema-less protowire
+    reader (utils/protowire.py) — no tensorflow/tensorboard dependency —
+    summing XEvent durations per event-metadata name across all planes
+    and lines. `substrings` filters to event names containing any of the
+    given fragments (None keeps everything). Field numbers are interface
+    facts of tsl/profiler/protobuf/xplane.proto: XSpace.planes=1;
+    XPlane.lines=3, .event_metadata=4 (map entries key=1/value=2);
+    XLine.events=4; XEvent.metadata_id=1, .duration_ps=3;
+    XEventMetadata.id=1, .name=2.
+
+    This is the honest IN-LOOP per-op attribution: the boosting loop is
+    one fused jit scan, so re-measuring ops outside it (bench.py's
+    historical `hist_s`) is same-shape attribution, not measurement.
+    """
+    import pathlib
+
+    from ydf_tpu.utils import protowire as pw
+
+    out: Dict[str, float] = {}
+    for path in sorted(pathlib.Path(trace_dir).rglob("*.xplane.pb")):
+        try:
+            space = pw.decode(path.read_bytes())
+        except Exception:
+            continue  # partial/foreign file: skip, never fail the bench
+        for plane_b in space.get(1, []):
+            plane = pw.decode(bytes(plane_b))
+            names: Dict[int, str] = {}
+            for entry_b in plane.get(4, []):
+                entry = pw.decode(bytes(entry_b))
+                md_b = entry.get(2)
+                if not md_b:
+                    continue
+                md = pw.decode(bytes(md_b[-1]))
+                names[pw.get_int(entry, 1)] = pw.get_str(md, 2)
+            if not names:
+                continue
+            for line_b in plane.get(3, []):
+                line = pw.decode(bytes(line_b))
+                for ev_b in line.get(4, []):
+                    ev = pw.decode(bytes(ev_b))
+                    name = names.get(pw.get_int(ev, 1))
+                    if not name:
+                        continue
+                    if substrings is not None and not any(
+                        s in name for s in substrings
+                    ):
+                        continue
+                    out[name] = out.get(name, 0.0) + (
+                        pw.get_int(ev, 3) / 1e12
+                    )
+    return out
+
+
+def native_hist_kernel_seconds() -> float:
+    """Cumulative wall seconds spent INSIDE the native histogram custom
+    call (both precisions) — the exact in-loop attribution for the CPU
+    path, measured by the kernel itself (native/histogram_ffi.cc
+    counters). 0.0 when the native kernel is unavailable."""
+    from ydf_tpu.ops import histogram_native
+
+    return histogram_native.kernel_seconds()
+
+
+def reset_native_hist_kernel_counters() -> None:
+    from ydf_tpu.ops import histogram_native
+
+    histogram_native.reset_kernel_counters()
+
+
 def format_profile(profile: Optional[Dict[str, float]]) -> str:
     """One-line human summary, largest stages first."""
     if not profile:

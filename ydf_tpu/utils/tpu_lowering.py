@@ -183,16 +183,25 @@ def export_grow_tree(
 
 def export_histogram_pallas(
     n: int = 262_144, F: int = 28, L: int = 32, B: int = 256,
-    platforms=("tpu",),
+    quant: str = "f32", platforms=("tpu",),
 ):
     """jax.export of the Mosaic histogram training kernel
-    (ops/histogram_pallas.py) at a bench-layer shape."""
+    (ops/histogram_pallas.py) at a bench-layer shape. `quant` selects
+    the stats operand the quantized-gradient pipeline would hand the
+    kernel: "f32" exact, "bf16x2" (bf16 hi/lo halves, S doubled), or
+    "int8" (quantized stats, int8 MXU tiles with int32 accumulation) —
+    proving all three operand precisions Mosaic-lower for TPU."""
     from ydf_tpu.ops.histogram_pallas import histogram_pallas
 
+    dtype, S = {
+        "f32": (jnp.float32, 3),
+        "bf16x2": (jnp.bfloat16, 6),
+        "int8": (jnp.int8, 3),
+    }[quant]
     args = (
         jax.ShapeDtypeStruct((n, F), jnp.uint8),
         jax.ShapeDtypeStruct((n,), jnp.int32),
-        jax.ShapeDtypeStruct((n, 3), jnp.float32),
+        jax.ShapeDtypeStruct((n, S), dtype),
     )
     return jax.export.export(
         jax.jit(
@@ -349,6 +358,19 @@ def _analytic_hist_flops(n, F, max_depth, num_bins, S=3, L=1024,
     return total
 
 
+# MXU issue cost per histogram MAC, in native-bf16-pass units, by stats
+# operand precision (docs/histogram_quantization.md has the derivation):
+#   f32     Mosaic decomposes an f32×f32 dot into bf16 passes (hi·hi +
+#           hi·lo + lo·hi): 3 passes per MAC. (Earlier rooflines
+#           projected f32 operands at the full bf16 peak — a ~3x
+#           overcount the quantization work made explicit.)
+#   bf16x2  the one-hot operand is EXACT in bf16, so only stats split:
+#           2S single-pass bf16 columns = 2 passes per original MAC —
+#           the "halved MXU-operand width" (32 -> 2x16 bit) win.
+#   int8    int8 MXU tiles issue at 2x the bf16 rate on v5+: 0.5.
+MXU_PASSES_PER_MAC = {"f32": 3.0, "bf16x2": 2.0, "int8": 0.5}
+
+
 def tpu_projection(
     n: int = 500_000,
     F: int = 28,
@@ -357,6 +379,7 @@ def tpu_projection(
     chips=("v5e", "v4", "v5p"),
     mfu: float = 0.4,
     cost: dict | None = None,
+    hist_quant: str = "f32",
 ):
     """Analytic roofline projection of training throughput per chip.
 
@@ -368,7 +391,9 @@ def tpu_projection(
     efficiency; 40% is the conservative end of large-contraction matmul
     MFU on TPU. Two FLOP numbers are reported: XLA-counted (from
     HloCostAnalysis of the real lowering — includes every elementwise op)
-    and closed-form matmul-only (the floor)."""
+    and closed-form matmul-only (the floor). `hist_quant` scales the
+    compute term by MXU_PASSES_PER_MAC — the gradient-quantization
+    modes change the TILE precision of the dot, not its MAC count."""
     if cost is None:
         cost = grow_tree_cost(n, F, max_depth, num_bins, "matmul")
     analytic = _analytic_hist_flops(n, F, max_depth, num_bins)
@@ -377,18 +402,24 @@ def tpu_projection(
     # histogram dots; the closed-form matmul count is exact for the dots
     # and dominates everything else. Project on whichever is larger.
     flops = max(cost["flops"], analytic)
+    passes = MXU_PASSES_PER_MAC[hist_quant]
     # HBM traffic floor per tree: re-read bins + stats once per layer
     # (the Pallas/fused formulation; XLA's unfused "bytes accessed"
-    # wildly overcounts by materializing one-hots).
-    bytes_floor = max_depth * (n * F * 1 + n * 3 * 4 + n * 4 * 2)
+    # wildly overcounts by materializing one-hots). The stats re-read
+    # shrinks with the operand width (f32 12 B/row, bf16x2 hi+lo 12 B,
+    # int8 3 B) — third-order next to the bins term.
+    stats_bytes = {"f32": 12, "bf16x2": 12, "int8": 3}[hist_quant]
+    bytes_floor = max_depth * (n * F * 1 + n * stats_bytes + n * 4 * 2)
     rows = []
     for chip in chips:
         spec = CHIP_SPECS[chip]
-        t_compute = flops / (spec["peak_flops"] * mfu)
+        t_compute = flops * passes / (spec["peak_flops"] * mfu)
         t_mem = bytes_floor / spec["hbm_gbps"]
         t_tree = max(t_compute, t_mem)
         rows.append({
             "chip": chip,
+            "hist_quant": hist_quant,
+            "mxu_passes_per_mac": passes,
             "flops_per_tree_projected": flops,
             "flops_per_tree_xla": cost["flops"],
             "flops_per_tree_matmul_floor": analytic,
@@ -399,7 +430,8 @@ def tpu_projection(
             "bound": "compute" if t_compute >= t_mem else "memory",
         })
     return {"config": {"n": n, "F": F, "max_depth": max_depth,
-                       "num_bins": num_bins}, "rows": rows}
+                       "num_bins": num_bins, "hist_quant": hist_quant},
+            "rows": rows}
 
 
 # --------------------------------------------------------------------------
@@ -440,6 +472,15 @@ def write_artifacts(outdir: str | Path, full_scale: bool = True) -> dict:
             hist_impl="matmul", **scale
         ),
         "histogram_pallas_kernel": export_histogram_pallas,
+        # The quantized-gradient operand precisions (YDF_TPU_HIST_QUANT)
+        # Mosaic-lower next to the exact kernel: bf16 hi/lo halves and
+        # int8 MXU tiles with int32 accumulation.
+        "histogram_pallas_kernel_bf16x2": lambda: export_histogram_pallas(
+            quant="bf16x2"
+        ),
+        "histogram_pallas_kernel_int8": lambda: export_histogram_pallas(
+            quant="int8"
+        ),
         # Ingestion: the fused binning pipeline's Mosaic kernel
         # (ops/binning_pallas.py) — bins compile on-device next to the
         # loop that consumes them.
@@ -465,6 +506,13 @@ def write_artifacts(outdir: str | Path, full_scale: bool = True) -> dict:
             "mosaic_kernel": "tpu_custom_call" in mlir,
         }
     summary["projection"] = tpu_projection()
+    # Per-quant-mode rooflines (one shared cost analysis — the MAC
+    # count is precision-independent; only the tile rate changes).
+    cost = grow_tree_cost()
+    summary["projection_by_quant"] = {
+        q: tpu_projection(cost=cost, hist_quant=q)
+        for q in ("f32", "bf16x2", "int8")
+    }
     (outdir / "summary.json").write_text(json.dumps(summary, indent=2))
     return summary
 
