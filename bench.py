@@ -305,16 +305,19 @@ def bench_in_subprocess(rows, trees, depth, features, timeout_s):
 
 
 def measure_in_loop_hist(train, record):
-    """The REAL in-loop histogram attribution (ROADMAP open item closed
-    by PR 3): one extra steady-state train() runs under
-    jax.profiler.trace with the native kernel's wall counters reset, and
-    `hist_s` is the time measured INSIDE the in-loop histogram op — the
-    native custom call's own counter when the native impl is active
-    (exact), else the trace's custom-call events parsed via
-    profiling.trace_event_seconds (no tensorboard dependency). The
-    historical outside-the-scan re-measurement stays emitted as
-    `hist_attrib_s` (measure_hist_attribution) for trajectory
-    continuity. Failures are recorded, never fatal."""
+    """The REAL in-loop kernel attribution: one extra steady-state
+    train() runs under jax.profiler.trace with the native kernels' wall
+    counters reset. `hist_s` is the time measured INSIDE the in-loop
+    histogram op (ROADMAP open item closed by PR 3); `route_s` /
+    `update_s` are the same measurement for the fused row-routing and
+    prediction-update kernels (PR 4 — the NON-histogram half of the
+    loop; 0.0 and absent when YDF_TPU_ROUTE_IMPL=xla, where those ops
+    live inside XLA fusions and cannot be attributed). The histogram
+    falls back to the trace's custom-call events parsed via
+    profiling.trace_event_seconds (no tensorboard dependency) on
+    non-native impls. The historical outside-the-scan re-measurement
+    stays emitted as `hist_attrib_s` (measure_hist_attribution) for
+    trajectory continuity. Failures are recorded, never fatal."""
     import shutil
     import tempfile
 
@@ -322,13 +325,17 @@ def measure_in_loop_hist(train, record):
 
     from ydf_tpu.utils.profiling import (
         native_hist_kernel_seconds,
+        native_route_kernel_seconds,
+        native_update_kernel_seconds,
         reset_native_hist_kernel_counters,
+        reset_native_route_kernel_counters,
         trace_event_seconds,
     )
 
     td = tempfile.mkdtemp(prefix="ydf_hist_trace_")
     try:
         reset_native_hist_kernel_counters()
+        reset_native_route_kernel_counters()
         with jax.profiler.trace(td):
             _, wall, _ = train()
         record["hist_profiled_train_wall_s"] = round(wall, 2)
@@ -345,6 +352,22 @@ def measure_in_loop_hist(train, record):
             if total > 0:
                 record["hist_s"] = round(total, 3)
                 record["hist_s_source"] = "profiler_trace"
+        route_s = native_route_kernel_seconds()
+        update_s = native_update_kernel_seconds()
+        if route_s > 0 or update_s > 0:
+            record["route_s"] = round(route_s, 3)
+            record["update_s"] = round(update_s, 3)
+            record["route_s_source"] = "native_kernel_counter"
+        # Fully-fused histogram+routing calls (route_impl=native AND
+        # hist_impl=native): the per-layer routing rides the histogram
+        # kernel's own row walk, so its time is inseparable from the
+        # contraction — reported whole as fused_s (route_s then counts
+        # only the standalone last-layer/validation passes).
+        from ydf_tpu.ops.routing_native import fused_kernel_seconds
+
+        fused_s = fused_kernel_seconds()
+        if fused_s > 0:
+            record["fused_s"] = round(fused_s, 3)
     except Exception as e:
         record["hist_in_loop_error"] = f"{type(e).__name__}: {e}"
     finally:
@@ -477,6 +500,17 @@ def run_bench(backend, rows, trees, depth, features, with_baseline, probe_log):
     model, wall, _ = train()                 # cached steady state
 
     from ydf_tpu.ops.histogram import resolve_hist_quant
+    from ydf_tpu.ops.routing_native import (
+        resolve_route_impl,
+        resolved_route_threads,
+    )
+
+    def _resolved_env_threads(env_name):
+        try:
+            v = int(os.environ.get(env_name, "0"))
+        except ValueError:
+            v = 0
+        return v if v > 0 else (os.cpu_count() or 1)
 
     value = rows * trees / wall
     record = {
@@ -498,6 +532,14 @@ def run_bench(backend, rows, trees, depth, features, with_baseline, probe_log):
         # headline record names it so quantized and exact trajectories
         # can never be conflated.
         "hist_quant": resolve_hist_quant(None),
+        # Active example-routing impl (YDF_TPU_ROUTE_IMPL) and the
+        # native thread caps the kernels will resolve — a many-core host
+        # shows the persistent pool compounding across the histogram AND
+        # routing kernels (ROADMAP multi-core wave validation,
+        # measurement side).
+        "route_impl": resolve_route_impl(None),
+        "route_threads": resolved_route_threads(),
+        "hist_threads": _resolved_env_threads("YDF_TPU_HIST_THREADS"),
         "vs_ydf64_estimate": round(
             value / BASELINE_YDF64_ESTIMATE_ROWS_TREES_PER_SEC, 3
         ),

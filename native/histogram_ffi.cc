@@ -33,6 +33,16 @@
 //                      the f32 path's 24 (f64 x 3) — a 3x accumulator
 //                      footprint cut on top of the 4x stats-read cut.
 //
+// Fused variants "ydf_histogram_routed" / "ydf_histogram_q8_routed"
+// (PR 4, docs/row_routing.md): same contractions, but each example's
+// histogram slot is computed ON THE FLY by applying the previous
+// layer's chosen splits (the ydf_route_update decision logic from
+// routing_ffi.cc, kept in lockstep), emitting new_slot/new_leaf as
+// side outputs. The standalone per-layer routing pass — a whole extra
+// sweep of slot/leaf/bins/outputs through memory — disappears, and the
+// split-feature byte gather is free because the row's bins are already
+// streaming through cache for the feature loop.
+//
 // Slot contract (ops/histogram.py): slot values in [0, L); anything
 // outside — the trash slot L, negative, padded — is skipped with an
 // early continue BEFORE the per-row feature loop. Under the grower's
@@ -81,26 +91,43 @@ namespace ffi = xla::ffi;
 static std::atomic<int64_t> g_hist_ns{0};
 static std::atomic<int64_t> g_hist_calls{0};
 
+// Fused histogram+routing calls (the ydf_histogram*_routed targets)
+// keep their OWN counter pair: inside one fused row loop the routing
+// and contraction work are inseparable by construction, so bench.py
+// reports them as `fused_s` next to the pure `hist_s` / `route_s`.
+static std::atomic<int64_t> g_fused_ns{0};
+static std::atomic<int64_t> g_fused_calls{0};
+
 extern "C" int64_t ydf_hist_ns_total() { return g_hist_ns.load(); }
 extern "C" int64_t ydf_hist_calls_total() { return g_hist_calls.load(); }
+extern "C" int64_t ydf_hist_fused_ns_total() { return g_fused_ns.load(); }
+extern "C" int64_t ydf_hist_fused_calls_total() {
+  return g_fused_calls.load();
+}
 extern "C" void ydf_hist_counters_reset() {
   g_hist_ns.store(0);
   g_hist_calls.store(0);
+  g_fused_ns.store(0);
+  g_fused_calls.store(0);
 }
 
 namespace {
 
 class ScopedHistTimer {
  public:
-  ScopedHistTimer() : t0_(std::chrono::steady_clock::now()) {}
+  explicit ScopedHistTimer(bool fused = false)
+      : fused_(fused), t0_(std::chrono::steady_clock::now()) {}
   ~ScopedHistTimer() {
-    g_hist_ns.fetch_add(std::chrono::duration_cast<std::chrono::nanoseconds>(
-                            std::chrono::steady_clock::now() - t0_)
-                            .count());
-    g_hist_calls.fetch_add(1);
+    const int64_t ns =
+        std::chrono::duration_cast<std::chrono::nanoseconds>(
+            std::chrono::steady_clock::now() - t0_)
+            .count();
+    (fused_ ? g_fused_ns : g_hist_ns).fetch_add(ns);
+    (fused_ ? g_fused_calls : g_hist_calls).fetch_add(1);
   }
 
  private:
+  bool fused_;
   std::chrono::steady_clock::time_point t0_;
 };
 
@@ -119,6 +146,71 @@ constexpr int64_t kArenaBudgetBytes = int64_t{512} << 20;
 constexpr uint64_t kBias = 128;
 constexpr uint64_t kWatermark = 128;
 
+// Per-row histogram-slot provider, the template seam between the plain
+// kernels and the fused histogram+routing ones:
+//
+//   SlotRead    the original contract — the slot arrives precomputed
+//               in an [n] buffer (sp[i]).
+//   RouteSlot   the fused contract — the example's slot for THIS
+//               layer's histogram is computed on the fly by applying
+//               the PREVIOUS layer's chosen splits (the standalone
+//               ydf_route_update pass, folded into the row walk). The
+//               row's bins pointer is already in hand for the feature
+//               loop, so the split-feature byte gather that forced the
+//               standalone kernel into a transposed bins copy is FREE
+//               here; new_slot/new_leaf are written as a side effect
+//               (per-row pure, so the block parallelism stays
+//               bit-stable). KEEP THE DECISION LOGIC IN LOCKSTEP with
+//               routing_ffi.cc:RouteUpdateImpl — the two must stay
+//               bit-identical (tests/test_routing_native.py).
+struct SlotRead {
+  const int32_t* sp;
+  inline int32_t operator()(int64_t i, const uint8_t*) const {
+    return sp[i];
+  }
+};
+
+struct RouteSlot {
+  const int32_t* sp;   // previous layer's slot [n]
+  const int32_t* lp;   // previous layer's leaf id [n]
+  const uint8_t* dsp;  // do_split [L1]
+  const int32_t* rfp;  // route_f [L1], pre-clipped to [0, F)
+  const uint8_t* glp;  // go_left [L1, B]
+  const int32_t* lip;  // left_id [L1]
+  const int32_t* rip;  // right_id [L1]
+  const int32_t* srp;  // split_rank [L1]
+  const int32_t* hmp;  // hmap [L1]
+  const uint8_t* isp;  // is_set [L1]
+  const uint8_t* sgp;  // set_go_left [n] (have_set) or [1]
+  bool have_set;
+  int64_t B;           // go_left table width == num_bins
+  int64_t F;
+  int32_t trash;       // L1 - 1
+  int32_t hist_trash;  // hmp[trash]
+  int32_t* nsp;        // out: new_slot [n]
+  int32_t* nlp;        // out: new_leaf [n]
+  inline int32_t operator()(int64_t i, const uint8_t* br) const {
+    int32_t s = sp[i];
+    if (s < 0 || s > trash) s = trash;
+    if (!dsp[s]) {
+      nsp[i] = trash;
+      nlp[i] = lp[i];
+      return hist_trash;
+    }
+    bool gl;
+    if (isp[s] && have_set) {
+      gl = sgp[i] != 0;
+    } else {
+      const int64_t f = std::min<int64_t>(std::max(rfp[s], 0), F - 1);
+      gl = glp[s * B + br[f]] != 0;
+    }
+    nlp[i] = gl ? lip[s] : rip[s];
+    const int32_t cs = 2 * srp[s] + (gl ? 0 : 1);
+    nsp[i] = cs;
+    return hmp[std::min(std::max(cs, 0), trash)];
+  }
+};
+
 // Accumulates rows [row_begin, row_end) into `acc` (an [L, F, B, S]
 // f64 histogram, zeroed by the caller). The common S=3 (grad, hess,
 // weight) inner loop is unrolled; the generic path covers any S.
@@ -128,19 +220,19 @@ constexpr uint64_t kWatermark = 128;
 // and B == 256 the check can never fire, so the dispatcher drops it
 // from the inner loop (bit-identical by construction — the branch was
 // never taken).
-template <bool kCheckB>
-void AccumulateRowsImpl(const uint8_t* bp, const int32_t* sp,
+template <bool kCheckB, class SlotFn>
+void AccumulateRowsImpl(const uint8_t* bp, const SlotFn& slot_of,
                         const float* stp, double* acc, int64_t F, int64_t L,
                         int64_t B, int64_t S, int64_t row_begin,
                         int64_t row_end) {
   const int64_t fbs = F * B * S, bs = B * S;
   if (S == 3) {
     for (int64_t i = row_begin; i < row_end; ++i) {
-      const int32_t l = sp[i];
+      const uint8_t* br = bp + i * F;
+      const int32_t l = slot_of(i, br);
       if (l < 0 || l >= L) continue;  // trash slot: inactive/padded or
                                       // larger-child (subtraction) row
       const double g = stp[i * 3], h = stp[i * 3 + 1], w = stp[i * 3 + 2];
-      const uint8_t* br = bp + i * F;
       double* orow = acc + l * fbs;
       for (int64_t f = 0; f < F; ++f) {
         const int64_t b = br[f];
@@ -153,10 +245,10 @@ void AccumulateRowsImpl(const uint8_t* bp, const int32_t* sp,
     }
   } else {
     for (int64_t i = row_begin; i < row_end; ++i) {
-      const int32_t l = sp[i];
+      const uint8_t* br = bp + i * F;
+      const int32_t l = slot_of(i, br);
       if (l < 0 || l >= L) continue;
       const float* srow = stp + i * S;
-      const uint8_t* br = bp + i * F;
       double* orow = acc + l * fbs;
       for (int64_t f = 0; f < F; ++f) {
         const int64_t b = br[f];
@@ -168,14 +260,16 @@ void AccumulateRowsImpl(const uint8_t* bp, const int32_t* sp,
   }
 }
 
-void AccumulateRows(const uint8_t* bp, const int32_t* sp, const float* stp,
-                    double* acc, int64_t F, int64_t L, int64_t B, int64_t S,
-                    int64_t row_begin, int64_t row_end) {
+template <class SlotFn>
+void AccumulateRows(const uint8_t* bp, const SlotFn& slot_of,
+                    const float* stp, double* acc, int64_t F, int64_t L,
+                    int64_t B, int64_t S, int64_t row_begin,
+                    int64_t row_end) {
   if (B >= 256) {
-    AccumulateRowsImpl<false>(bp, sp, stp, acc, F, L, B, S, row_begin,
+    AccumulateRowsImpl<false>(bp, slot_of, stp, acc, F, L, B, S, row_begin,
                               row_end);
   } else {
-    AccumulateRowsImpl<true>(bp, sp, stp, acc, F, L, B, S, row_begin,
+    AccumulateRowsImpl<true>(bp, slot_of, stp, acc, F, L, B, S, row_begin,
                              row_end);
   }
 }
@@ -215,19 +309,31 @@ inline void SpillCell(uint64_t word, int32_t* cell3) {
 // ~25% to this straight row walk — the row-major bins walk rides the
 // hardware prefetcher, which the column sweep defeats; see
 // docs/histogram_quantization.md for the experiment table.
-template <bool kCheckB>
-void AccumulateRowsQ8Impl(const uint8_t* bp, const int32_t* sp,
+// Flushes every still-packed cell (count < watermark) into the int32
+// partial and leaves the packed scratch zeroed.
+inline void FlushPacked(uint64_t* packed, int32_t* part, int64_t ncells) {
+  for (int64_t c = 0; c < ncells; ++c) {
+    if (packed[c] != 0) {
+      SpillCell(packed[c], part + c * 3);
+      packed[c] = 0;
+    }
+  }
+}
+
+template <bool kCheckB, class SlotFn>
+void AccumulateRowsQ8Impl(const uint8_t* bp, const SlotFn& slot_of,
                           const int8_t* qp, int32_t* part, uint64_t* packed,
                           int64_t F, int64_t L, int64_t B, int64_t S,
-                          int64_t row_begin, int64_t row_end) {
+                          int64_t row_begin, int64_t row_end,
+                          bool flush_packed) {
   const int64_t fb = F * B;
   if (S == 3 && packed == nullptr) {
     for (int64_t i = row_begin; i < row_end; ++i) {
-      const int32_t l = sp[i];
+      const uint8_t* br = bp + i * F;
+      const int32_t l = slot_of(i, br);
       if (l < 0 || l >= L) continue;  // trash slot skipped before the
                                       // feature loop, like the f32 path
       const int32_t q0 = qp[i * 3], q1 = qp[i * 3 + 1], q2 = qp[i * 3 + 2];
-      const uint8_t* br = bp + i * F;
       int32_t* orow = part + l * fb * 3;
       for (int64_t f = 0; f < F; ++f) {
         const int64_t b = br[f];
@@ -242,7 +348,8 @@ void AccumulateRowsQ8Impl(const uint8_t* bp, const int32_t* sp,
   }
   if (S == 3) {
     for (int64_t i = row_begin; i < row_end; ++i) {
-      const int32_t l = sp[i];
+      const uint8_t* br = bp + i * F;
+      const int32_t l = slot_of(i, br);
       if (l < 0 || l >= L) continue;
       const int8_t* q = qp + i * 3;
       // One packed delta per ROW, shared by all its features.
@@ -251,7 +358,6 @@ void AccumulateRowsQ8Impl(const uint8_t* bp, const int32_t* sp,
           (static_cast<uint64_t>(static_cast<uint8_t>(q[0] + 128)) << 16) |
           (static_cast<uint64_t>(static_cast<uint8_t>(q[1] + 128)) << 32) |
           (static_cast<uint64_t>(static_cast<uint8_t>(q[2] + 128)) << 48);
-      const uint8_t* br = bp + i * F;
       uint64_t* prow = packed + l * fb;
       for (int64_t f = 0; f < F; ++f) {
         const int64_t b = br[f];
@@ -266,21 +372,18 @@ void AccumulateRowsQ8Impl(const uint8_t* bp, const int32_t* sp,
       }
     }
     // Flush the still-packed remainder (count < watermark) and leave
-    // the scratch zeroed for the next block.
-    const int64_t ncells = L * fb;
-    for (int64_t c = 0; c < ncells; ++c) {
-      if (packed[c] != 0) {
-        SpillCell(packed[c], part + c * 3);
-        packed[c] = 0;
-      }
-    }
+    // the scratch zeroed for the next block. The fused single-thread
+    // path defers this (flush_packed=false) across its row chunks —
+    // one final sweep instead of one per chunk; integer associativity
+    // keeps the totals bit-identical.
+    if (flush_packed) FlushPacked(packed, part, L * fb);
   } else {
     const int64_t fbs = fb * S, bs = B * S;
     for (int64_t i = row_begin; i < row_end; ++i) {
-      const int32_t l = sp[i];
+      const uint8_t* br = bp + i * F;
+      const int32_t l = slot_of(i, br);
       if (l < 0 || l >= L) continue;
       const int8_t* q = qp + i * S;
-      const uint8_t* br = bp + i * F;
       int32_t* orow = part + l * fbs;
       for (int64_t f = 0; f < F; ++f) {
         const int64_t b = br[f];
@@ -292,16 +395,18 @@ void AccumulateRowsQ8Impl(const uint8_t* bp, const int32_t* sp,
   }
 }
 
-void AccumulateRowsQ8(const uint8_t* bp, const int32_t* sp,
+template <class SlotFn>
+void AccumulateRowsQ8(const uint8_t* bp, const SlotFn& slot_of,
                       const int8_t* qp, int32_t* part, uint64_t* packed,
                       int64_t F, int64_t L, int64_t B, int64_t S,
-                      int64_t row_begin, int64_t row_end) {
+                      int64_t row_begin, int64_t row_end,
+                      bool flush_packed = true) {
   if (B >= 256) {
-    AccumulateRowsQ8Impl<false>(bp, sp, qp, part, packed, F, L, B, S,
-                                row_begin, row_end);
+    AccumulateRowsQ8Impl<false>(bp, slot_of, qp, part, packed, F, L, B, S,
+                                row_begin, row_end, flush_packed);
   } else {
-    AccumulateRowsQ8Impl<true>(bp, sp, qp, part, packed, F, L, B, S,
-                               row_begin, row_end);
+    AccumulateRowsQ8Impl<true>(bp, slot_of, qp, part, packed, F, L, B, S,
+                               row_begin, row_end, flush_packed);
   }
 }
 
@@ -346,22 +451,13 @@ void ReduceWave(const PartT* arena, AccT* acc, int64_t need, int m,
   }
 }
 
-}  // namespace
-
-static ffi::Error HistogramImpl(ffi::Buffer<ffi::DataType::U8> bins,
-                                ffi::Buffer<ffi::DataType::S32> slot,
-                                ffi::Buffer<ffi::DataType::F32> stats,
-                                ffi::ResultBufferR4<ffi::DataType::F32> out) {
-  ScopedHistTimer timer;
-  const auto bdims = bins.dimensions();   // [n, F]
-  const auto odims = out->dimensions();   // [L, F, B, S]
-  const int64_t n = bdims[0], F = bdims[1];
-  const int64_t L = odims[0], B = odims[2], S = odims[3];
-  const uint8_t* bp = bins.typed_data();
-  const int32_t* sp = slot.typed_data();
-  const float* stp = stats.typed_data();
-  float* outp = out->typed_data();
-
+// Shared f32 core: wave-parallel block accumulation with the
+// fixed-ascending-order reduction, templated on the slot provider
+// (SlotRead = plain histogram, RouteSlot = fused histogram+routing).
+template <class SlotFn>
+ffi::Error RunHistogramF32(const uint8_t* bp, const SlotFn& slot_of,
+                           const float* stp, float* outp, int64_t n,
+                           int64_t F, int64_t L, int64_t B, int64_t S) {
   // Scratch is thread_local and grow-only: this runs once per layer per
   // tree, and re-allocating ~100+ MB each call would dominate; a
   // bad_alloc must surface as an FFI error, not cross the C boundary.
@@ -395,7 +491,7 @@ static ffi::Error HistogramImpl(ffi::Buffer<ffi::DataType::U8> bins,
   if (nblocks <= 1) {
     // Single block: accumulating straight into the (zeroed) result is
     // bit-identical to partial-then-reduce.
-    AccumulateRows(bp, sp, stp, acc_p, F, L, B, S, 0, n);
+    AccumulateRows(bp, slot_of, stp, acc_p, F, L, B, S, 0, n);
   } else {
     for (int64_t wave0 = 0; wave0 < nblocks; wave0 += wave) {
       const int m = static_cast<int>(
@@ -405,7 +501,7 @@ static ffi::Error HistogramImpl(ffi::Buffer<ffi::DataType::U8> bins,
         std::memset(part, 0, sizeof(double) * need);
         const int64_t r0 = (wave0 + j) * kRowBlock;
         const int64_t r1 = std::min(r0 + kRowBlock, n);
-        AccumulateRows(bp, sp, stp, part, F, L, B, S, r0, r1);
+        AccumulateRows(bp, slot_of, stp, part, F, L, B, S, r0, r1);
       });
       // Reduce this wave's partials into acc in ASCENDING BLOCK ORDER
       // per cell (the fixed-order reduction that makes the result
@@ -417,27 +513,29 @@ static ffi::Error HistogramImpl(ffi::Buffer<ffi::DataType::U8> bins,
   return ffi::Error::Success();
 }
 
-// int8 quantized-gradient kernel: bins u8 [n, F], slot s32 [n],
-// quantized stats s8 [n, S] (|q| <= 127), scale f32 [S]. Output
-// f32 [L, F, B, S] = (Σ q) * scale — the dequantize happens ONCE, on
-// the int64 totals of the fixed-block-order reduction, so the result
-// is exactly `integer_total * scale` rounded once to f32: bit-stable
-// across thread counts by integer associativity.
-static ffi::Error HistogramQ8Impl(
-    ffi::Buffer<ffi::DataType::U8> bins, ffi::Buffer<ffi::DataType::S32> slot,
-    ffi::Buffer<ffi::DataType::S8> stats, ffi::Buffer<ffi::DataType::F32> scale,
-    ffi::ResultBufferR4<ffi::DataType::F32> out) {
+}  // namespace
+
+static ffi::Error HistogramImpl(ffi::Buffer<ffi::DataType::U8> bins,
+                                ffi::Buffer<ffi::DataType::S32> slot,
+                                ffi::Buffer<ffi::DataType::F32> stats,
+                                ffi::ResultBufferR4<ffi::DataType::F32> out) {
   ScopedHistTimer timer;
   const auto bdims = bins.dimensions();   // [n, F]
   const auto odims = out->dimensions();   // [L, F, B, S]
-  const int64_t n = bdims[0], F = bdims[1];
-  const int64_t L = odims[0], B = odims[2], S = odims[3];
-  const uint8_t* bp = bins.typed_data();
-  const int32_t* sp = slot.typed_data();
-  const int8_t* qp = stats.typed_data();
-  const float* scp = scale.typed_data();
-  float* outp = out->typed_data();
+  return RunHistogramF32(bins.typed_data(), SlotRead{slot.typed_data()},
+                         stats.typed_data(), out->typed_data(), bdims[0],
+                         bdims[1], odims[0], odims[2], odims[3]);
+}
 
+namespace {
+
+// Shared q8 core (see HistogramQ8Impl's header comment), templated on
+// the slot provider like RunHistogramF32.
+template <class SlotFn>
+ffi::Error RunHistogramQ8(const uint8_t* bp, const SlotFn& slot_of,
+                          const int8_t* qp, const float* scp, float* outp,
+                          int64_t n, int64_t F, int64_t L, int64_t B,
+                          int64_t S) {
   const int64_t need = L * F * B * S;
   const int64_t ncells = L * F * B;
   // Packed int16 lanes pay once the packed cell array outgrows L2 (the
@@ -494,7 +592,9 @@ static ffi::Error HistogramQ8Impl(
     if (packed_p != nullptr) {
       std::memset(packed_p, 0, sizeof(uint64_t) * ncells);
     }
-    AccumulateRowsQ8(bp, sp, qp, arena_p, packed_p, F, L, B, S, 0, n);
+    AccumulateRowsQ8(bp, slot_of, qp, arena_p, packed_p, F, L, B, S, 0, n,
+                     /*flush_packed=*/false);
+    if (packed_p != nullptr) FlushPacked(packed_p, arena_p, ncells);
     for (int64_t i = 0; i < need; ++i) {
       outp[i] = static_cast<float>(static_cast<double>(arena_p[i]) *
                                    static_cast<double>(scp[i % S]));
@@ -516,7 +616,7 @@ static ffi::Error HistogramQ8Impl(
       }
       const int64_t r0 = (wave0 + j) * kRowBlock;
       const int64_t r1 = std::min(r0 + kRowBlock, n);
-      AccumulateRowsQ8(bp, sp, qp, part, packed, F, L, B, S, r0, r1);
+      AccumulateRowsQ8(bp, slot_of, qp, part, packed, F, L, B, S, r0, r1);
     });
     ReduceWave(arena_p, acc_p, need, m, threads);
   }
@@ -527,6 +627,136 @@ static ffi::Error HistogramQ8Impl(
                                  static_cast<double>(scp[i % S]));
   }
   return ffi::Error::Success();
+}
+
+}  // namespace
+
+// int8 quantized-gradient kernel: bins u8 [n, F], slot s32 [n],
+// quantized stats s8 [n, S] (|q| <= 127), scale f32 [S]. Output
+// f32 [L, F, B, S] = (Σ q) * scale — the dequantize happens ONCE, on
+// the int64 totals of the fixed-block-order reduction, so the result
+// is exactly `integer_total * scale` rounded once to f32: bit-stable
+// across thread counts by integer associativity.
+static ffi::Error HistogramQ8Impl(
+    ffi::Buffer<ffi::DataType::U8> bins, ffi::Buffer<ffi::DataType::S32> slot,
+    ffi::Buffer<ffi::DataType::S8> stats, ffi::Buffer<ffi::DataType::F32> scale,
+    ffi::ResultBufferR4<ffi::DataType::F32> out) {
+  ScopedHistTimer timer;
+  const auto bdims = bins.dimensions();   // [n, F]
+  const auto odims = out->dimensions();   // [L, F, B, S]
+  return RunHistogramQ8(bins.typed_data(), SlotRead{slot.typed_data()},
+                        stats.typed_data(), scale.typed_data(),
+                        out->typed_data(), bdims[0], bdims[1], odims[0],
+                        odims[2], odims[3]);
+}
+
+// Builds the fused-routing slot provider from the FFI buffers shared by
+// both fused handlers. The histogram output's L is the NEXT layer's
+// hist-slot count (hmap range); the routing tables' L1 covers the
+// previous layer's frontier slots + trash.
+static RouteSlot MakeRouteSlot(
+    int64_t n, int64_t F, ffi::Buffer<ffi::DataType::S32>& slot,
+    ffi::Buffer<ffi::DataType::S32>& leaf,
+    ffi::Buffer<ffi::DataType::U8>& do_split,
+    ffi::Buffer<ffi::DataType::S32>& route_f,
+    ffi::Buffer<ffi::DataType::U8>& go_left,
+    ffi::Buffer<ffi::DataType::S32>& left_id,
+    ffi::Buffer<ffi::DataType::S32>& right_id,
+    ffi::Buffer<ffi::DataType::S32>& split_rank,
+    ffi::Buffer<ffi::DataType::S32>& hmap,
+    ffi::Buffer<ffi::DataType::U8>& is_set,
+    ffi::Buffer<ffi::DataType::U8>& set_go_left,
+    ffi::ResultBufferR1<ffi::DataType::S32>& new_slot,
+    ffi::ResultBufferR1<ffi::DataType::S32>& new_leaf) {
+  const int64_t L1 = do_split.dimensions()[0];
+  const int64_t Bt = go_left.dimensions()[1];
+  const int32_t trash = static_cast<int32_t>(L1 - 1);
+  return RouteSlot{
+      slot.typed_data(),
+      leaf.typed_data(),
+      do_split.typed_data(),
+      route_f.typed_data(),
+      go_left.typed_data(),
+      left_id.typed_data(),
+      right_id.typed_data(),
+      split_rank.typed_data(),
+      hmap.typed_data(),
+      is_set.typed_data(),
+      set_go_left.typed_data(),
+      /*have_set=*/set_go_left.dimensions()[0] == static_cast<uint64_t>(n),
+      /*B=*/Bt,
+      /*F=*/F,
+      trash,
+      /*hist_trash=*/hmap.typed_data()[trash],
+      new_slot->typed_data(),
+      new_leaf->typed_data()};
+}
+
+// Fused histogram + routing (f32): applies the PREVIOUS layer's chosen
+// splits per row (exactly ydf_route_update's decision logic — slot
+// lookup, split-feature bin gather, left/right select, child slot/node,
+// hmap composition) and accumulates THIS layer's histogram from the
+// resulting hist slot, in ONE pass over rows. The per-layer hist_slot
+// array never exists, the split-feature byte rides the bins row already
+// streamed for the contraction, and the standalone routing pass's whole
+// memory sweep disappears (docs/row_routing.md).
+static ffi::Error HistogramRoutedImpl(
+    ffi::Buffer<ffi::DataType::U8> bins, ffi::Buffer<ffi::DataType::S32> slot,
+    ffi::Buffer<ffi::DataType::S32> leaf,
+    ffi::Buffer<ffi::DataType::U8> do_split,
+    ffi::Buffer<ffi::DataType::S32> route_f,
+    ffi::Buffer<ffi::DataType::U8> go_left,
+    ffi::Buffer<ffi::DataType::S32> left_id,
+    ffi::Buffer<ffi::DataType::S32> right_id,
+    ffi::Buffer<ffi::DataType::S32> split_rank,
+    ffi::Buffer<ffi::DataType::S32> hmap,
+    ffi::Buffer<ffi::DataType::U8> is_set,
+    ffi::Buffer<ffi::DataType::U8> set_go_left,
+    ffi::Buffer<ffi::DataType::F32> stats,
+    ffi::ResultBufferR4<ffi::DataType::F32> out,
+    ffi::ResultBufferR1<ffi::DataType::S32> new_slot,
+    ffi::ResultBufferR1<ffi::DataType::S32> new_leaf) {
+  ScopedHistTimer timer(/*fused=*/true);
+  const auto bdims = bins.dimensions();   // [n, F]
+  const auto odims = out->dimensions();   // [L, F, B, S]
+  const int64_t n = bdims[0], F = bdims[1];
+  const RouteSlot rs = MakeRouteSlot(
+      n, F, slot, leaf, do_split, route_f, go_left, left_id, right_id,
+      split_rank, hmap, is_set, set_go_left, new_slot, new_leaf);
+  return RunHistogramF32(bins.typed_data(), rs, stats.typed_data(),
+                         out->typed_data(), n, F, odims[0], odims[2],
+                         odims[3]);
+}
+
+// Fused histogram + routing, int8 quantized stats (see above + the q8
+// header comment).
+static ffi::Error HistogramQ8RoutedImpl(
+    ffi::Buffer<ffi::DataType::U8> bins, ffi::Buffer<ffi::DataType::S32> slot,
+    ffi::Buffer<ffi::DataType::S32> leaf,
+    ffi::Buffer<ffi::DataType::U8> do_split,
+    ffi::Buffer<ffi::DataType::S32> route_f,
+    ffi::Buffer<ffi::DataType::U8> go_left,
+    ffi::Buffer<ffi::DataType::S32> left_id,
+    ffi::Buffer<ffi::DataType::S32> right_id,
+    ffi::Buffer<ffi::DataType::S32> split_rank,
+    ffi::Buffer<ffi::DataType::S32> hmap,
+    ffi::Buffer<ffi::DataType::U8> is_set,
+    ffi::Buffer<ffi::DataType::U8> set_go_left,
+    ffi::Buffer<ffi::DataType::S8> stats,
+    ffi::Buffer<ffi::DataType::F32> scale,
+    ffi::ResultBufferR4<ffi::DataType::F32> out,
+    ffi::ResultBufferR1<ffi::DataType::S32> new_slot,
+    ffi::ResultBufferR1<ffi::DataType::S32> new_leaf) {
+  ScopedHistTimer timer(/*fused=*/true);
+  const auto bdims = bins.dimensions();   // [n, F]
+  const auto odims = out->dimensions();   // [L, F, B, S]
+  const int64_t n = bdims[0], F = bdims[1];
+  const RouteSlot rs = MakeRouteSlot(
+      n, F, slot, leaf, do_split, route_f, go_left, left_id, right_id,
+      split_rank, hmap, is_set, set_go_left, new_slot, new_leaf);
+  return RunHistogramQ8(bins.typed_data(), rs, stats.typed_data(),
+                        scale.typed_data(), out->typed_data(), n, F,
+                        odims[0], odims[2], odims[3]);
 }
 
 XLA_FFI_DEFINE_HANDLER_SYMBOL(
@@ -545,3 +775,44 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(
         .Arg<ffi::Buffer<ffi::DataType::S8>>()
         .Arg<ffi::Buffer<ffi::DataType::F32>>()
         .Ret<ffi::BufferR4<ffi::DataType::F32>>());
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    YdfHistogramRouted, HistogramRoutedImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::DataType::U8>>()   // bins [n, F]
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()  // prev slot [n]
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()  // prev leaf [n]
+        .Arg<ffi::Buffer<ffi::DataType::U8>>()   // do_split [L1]
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()  // route_f [L1]
+        .Arg<ffi::Buffer<ffi::DataType::U8>>()   // go_left [L1, B]
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()  // left_id [L1]
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()  // right_id [L1]
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()  // split_rank [L1]
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()  // hmap [L1]
+        .Arg<ffi::Buffer<ffi::DataType::U8>>()   // is_set [L1]
+        .Arg<ffi::Buffer<ffi::DataType::U8>>()   // set_go_left [n|1]
+        .Arg<ffi::Buffer<ffi::DataType::F32>>()  // stats [n, S]
+        .Ret<ffi::BufferR4<ffi::DataType::F32>>()   // hist [L, F, B, S]
+        .Ret<ffi::BufferR1<ffi::DataType::S32>>()   // new_slot [n]
+        .Ret<ffi::BufferR1<ffi::DataType::S32>>());  // new_leaf [n]
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    YdfHistogramQ8Routed, HistogramQ8RoutedImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::DataType::U8>>()   // bins [n, F]
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()  // prev slot [n]
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()  // prev leaf [n]
+        .Arg<ffi::Buffer<ffi::DataType::U8>>()   // do_split [L1]
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()  // route_f [L1]
+        .Arg<ffi::Buffer<ffi::DataType::U8>>()   // go_left [L1, B]
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()  // left_id [L1]
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()  // right_id [L1]
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()  // split_rank [L1]
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()  // hmap [L1]
+        .Arg<ffi::Buffer<ffi::DataType::U8>>()   // is_set [L1]
+        .Arg<ffi::Buffer<ffi::DataType::U8>>()   // set_go_left [n|1]
+        .Arg<ffi::Buffer<ffi::DataType::S8>>()   // q8 stats [n, S]
+        .Arg<ffi::Buffer<ffi::DataType::F32>>()  // scale [S]
+        .Ret<ffi::BufferR4<ffi::DataType::F32>>()   // hist [L, F, B, S]
+        .Ret<ffi::BufferR1<ffi::DataType::S32>>()   // new_slot [n]
+        .Ret<ffi::BufferR1<ffi::DataType::S32>>());  // new_leaf [n]

@@ -1,0 +1,578 @@
+// Native CPU row-routing & prediction-update kernels ("ydf_route_update"
+// family), exposed to XLA as FFI custom calls.
+//
+// With the histogram down to ~half of the in-loop wall (PR 1-3), the
+// dominant remaining cost of CPU-fallback training is everything AROUND
+// it: the per-layer example->child routing chain in ops/grower.py
+// (slot gather -> per-row feature-column gather -> go-left table gather
+// -> two child-id gathers -> three selects -> next-layer hist-slot
+// gather: ~10 separate XLA passes over n-row arrays per layer), the
+// per-tree `preds += leaf_value[leaf_id]` update, and the loss's
+// grad/hess recompute. GPU tree-boosting systems hit the same wall once
+// their histograms were fast (XGBoost-GPU arXiv 1806.11248; arXiv
+// 1706.08359) and fused data partitioning into a single pass over rows;
+// these kernels are that pass for the CPU path.
+//
+// Three kernels:
+//
+//   "ydf_route_update"  one multithreaded pass over rows per LAYER:
+//                       for each example, read its frontier slot, look
+//                       up the slot's chosen split, gather the
+//                       example's bin of the split's feature column
+//                       (one byte, usually on the already-resident
+//                       cache line of the row), and emit in one go the
+//                       child frontier slot, the child node id
+//                       (leaf_id), the NEXT layer's histogram slot
+//                       (through the sibling-subtraction slot->hist
+//                       map, so the grower's `hmap[slot]` gather
+//                       disappears), and per-(slot, side) row counts.
+//   "ydf_leaf_update"   end-of-tree preds[i] += raw_leaf[leaf_id[i]]·η
+//                       — the XLA gather+mul+add chain as one pass.
+//                       XLA CPU CONTRACTS the shrinkage multiply into
+//                       the preds add as a hardware FMA — and it does
+//                       so through the leaf-value gather AND through an
+//                       hlo OptimizationBarrier (measured on jax
+//                       0.4.37: the fusion inlines the η-mul producer
+//                       into the consumer loop, where LLVM emits
+//                       fmuladd). The stored model values stay
+//                       round(raw·η), so train-time preds in the
+//                       DEFAULT pipeline are fma(raw, η, preds). To be
+//                       bit-identical to that oracle, this kernel takes
+//                       the UNSCALED leaf values + η and replicates the
+//                       contraction with std::fmaf; a `mode` flag
+//                       (resolved by a one-shot XLA probe in
+//                       ops/routing_native.py:update_uses_fma) drops to
+//                       the plain two-rounding add on hosts whose XLA
+//                       does not contract.
+//   "ydf_leaf_update_grad"  the same update FUSED with the squared-error
+//                       gradient recompute: emits preds_out and the
+//                       grower's stats rows [g*w, h*w, w] = [(p-y)*w,
+//                       w, w] so gradients never make a second trip
+//                       through memory. The recompute runs on the
+//                       ROUNDED f32 preds_out (matching XLA, which
+//                       reads it back from the scan carry), so the fma
+//                       subtlety is confined to the update itself. Only
+//                       losses whose grad is elementwise-reproducible
+//                       in plain arithmetic are fused (squared error:
+//                       one subtract, one multiply — bit-identical to
+//                       XLA's elementwise lowering); transcendental
+//                       losses (sigmoid, softmax) keep their XLA
+//                       recompute because a libm exp() is not
+//                       bit-identical to XLA's vectorized expansion.
+//   "ydf_route_tree"    full-tree routing of a batch (the validation
+//                       set in learners/gbt.py) through a finished
+//                       tree: walk <= max_depth nodes per row in one
+//                       pass instead of max_depth whole-array gather
+//                       rounds (ops/routing.py:route_tree_bins).
+//
+// Bit-stability contract (same as the histogram kernels): every per-row
+// output is a pure function of that row — parallelism over fixed 32k
+// row blocks cannot change a bit. The only cross-row outputs are the
+// integer child counts, accumulated per block and reduced in ascending
+// block order (integer addition is associative, so this is trivially
+// thread-count-invariant). YDF_TPU_ROUTE_THREADS caps the per-call task
+// wave (hardware_concurrency by default); the work runs on the shared
+// persistent pool in native/thread_pool.h.
+//
+// Parity contract: ops/grower.py keeps the XLA routing chain as the
+// default/oracle; these kernels replicate its integer/float semantics
+// EXACTLY (same clamps, same select order, same single f32 add per
+// prediction), validated by tests/test_routing_native.py bit-equality.
+//
+// Built by ydf_tpu/ops/native_ffi.py into the shared kernel library
+// (with histogram_ffi.cc / binning_ffi.cc) and registered via
+// jax.ffi.register_ffi_target (CPU).
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+#include "thread_pool.h"
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+// In-loop wall-clock attribution (read by ydf_tpu/utils/profiling.py
+// through ctypes, emitted as bench.py's route_s / update_s): cumulative
+// nanoseconds inside the routing kernels (route_update + route_tree)
+// and the prediction-update kernels. The bench resets them around the
+// steady-state train() it attributes.
+static std::atomic<int64_t> g_route_ns{0};
+static std::atomic<int64_t> g_route_calls{0};
+static std::atomic<int64_t> g_update_ns{0};
+static std::atomic<int64_t> g_update_calls{0};
+
+extern "C" int64_t ydf_route_ns_total() { return g_route_ns.load(); }
+extern "C" int64_t ydf_route_calls_total() { return g_route_calls.load(); }
+extern "C" int64_t ydf_update_ns_total() { return g_update_ns.load(); }
+extern "C" int64_t ydf_update_calls_total() { return g_update_calls.load(); }
+extern "C" void ydf_route_counters_reset() {
+  g_route_ns.store(0);
+  g_route_calls.store(0);
+  g_update_ns.store(0);
+  g_update_calls.store(0);
+}
+
+namespace {
+
+class ScopedTimer {
+ public:
+  ScopedTimer(std::atomic<int64_t>* ns, std::atomic<int64_t>* calls)
+      : ns_(ns), calls_(calls), t0_(std::chrono::steady_clock::now()) {}
+  ~ScopedTimer() {
+    ns_->fetch_add(std::chrono::duration_cast<std::chrono::nanoseconds>(
+                       std::chrono::steady_clock::now() - t0_)
+                       .count());
+    calls_->fetch_add(1);
+  }
+
+ private:
+  std::atomic<int64_t>* ns_;
+  std::atomic<int64_t>* calls_;
+  std::chrono::steady_clock::time_point t0_;
+};
+
+// Fixed work block — the unit of task partitioning. Like the histogram
+// kernels, the block boundaries are independent of the thread count.
+constexpr int64_t kRowBlock = 32768;
+
+int ResolveRouteThreads(int64_t nblocks) {
+  int num_threads = 0;
+  if (const char* env = std::getenv("YDF_TPU_ROUTE_THREADS")) {
+    num_threads = std::atoi(env);
+  }
+  if (num_threads <= 0) {
+    num_threads = static_cast<int>(std::thread::hardware_concurrency());
+  }
+  if (num_threads < 1) num_threads = 1;
+  return static_cast<int>(
+      std::min<int64_t>(num_threads, std::max<int64_t>(nblocks, 1)));
+}
+
+// Runs fn(0..nblocks-1) in waves of at most `threads` pool tasks. The
+// block partitioning is fixed (kRowBlock) and every block writes
+// disjoint output ranges, so the thread cap only changes scheduling,
+// never a bit of the result.
+template <typename Fn>
+void RunBlocks(int64_t nblocks, int threads, const Fn& fn) {
+  if (nblocks <= 1 || threads <= 1) {
+    for (int64_t blk = 0; blk < nblocks; ++blk) fn(blk);
+    return;
+  }
+  for (int64_t w0 = 0; w0 < nblocks; w0 += threads) {
+    const int m = static_cast<int>(std::min<int64_t>(threads, nblocks - w0));
+    ydf_native::ThreadPool::Get().Run(
+        m, [&, w0](int j) { fn(w0 + j); });
+  }
+}
+
+}  // namespace
+
+// Per-layer fused routing. Shapes:
+//   binsT       u8  [F, n]      binned features, FEATURE-major. The
+//                               row-major [n, F] layout the rest of the
+//                               pipeline uses would touch one cache
+//                               line per row for ONE byte (the whole
+//                               14 MB matrix per layer at the bench
+//                               shape); transposed, each live slot's
+//                               chosen-feature gather is a sequential
+//                               stream over only the columns actually
+//                               split on (~a few × 0.5 MB) — the
+//                               transpose is computed ONCE per training
+//                               (hoisted out of the boosting scan by
+//                               learners/gbt.py) and pays for itself in
+//                               the first layer.
+//   slot        s32 [n]         frontier slot, in [0, L] (L = trash)
+//   leaf        s32 [n]         current node id per example
+//   do_split    u8  [L1]        L1 = L + 1; slot L is the trash slot
+//   route_f     s32 [L1]        bins column of the chosen split,
+//                               pre-clipped to [0, F)
+//   go_left     u8  [L1, B]     per-(slot, bin) left mask
+//   left_id     s32 [L1]
+//   right_id    s32 [L1]
+//   split_rank  s32 [L1]
+//   hmap        s32 [L1]        NEW slot -> next-layer histogram slot
+//                               (identity when subtraction is off)
+//   is_set      u8  [L1]        slot's split is a categorical-set split
+//   set_go_left u8  [ns]        per-example set-split decision (ns == n
+//                               when set features exist, else 1 and
+//                               never read)
+// Results:
+//   new_slot    s32 [n]         child slot, L when the slot didn't split
+//   new_leaf    s32 [n]         child node id (or unchanged leaf)
+//   hist_slot   s32 [n]         hmap[new_slot] — the next layer's
+//                               histogram slot, emitted from this pass
+//   counts      s32 [L1, 2]     rows routed (left, right) per slot
+static ffi::Error RouteUpdateImpl(
+    ffi::Buffer<ffi::DataType::U8> bins, ffi::Buffer<ffi::DataType::S32> slot,
+    ffi::Buffer<ffi::DataType::S32> leaf,
+    ffi::Buffer<ffi::DataType::U8> do_split,
+    ffi::Buffer<ffi::DataType::S32> route_f,
+    ffi::Buffer<ffi::DataType::U8> go_left,
+    ffi::Buffer<ffi::DataType::S32> left_id,
+    ffi::Buffer<ffi::DataType::S32> right_id,
+    ffi::Buffer<ffi::DataType::S32> split_rank,
+    ffi::Buffer<ffi::DataType::S32> hmap,
+    ffi::Buffer<ffi::DataType::U8> is_set,
+    ffi::Buffer<ffi::DataType::U8> set_go_left,
+    ffi::ResultBufferR1<ffi::DataType::S32> new_slot,
+    ffi::ResultBufferR1<ffi::DataType::S32> new_leaf,
+    ffi::ResultBufferR1<ffi::DataType::S32> hist_slot,
+    ffi::ResultBufferR2<ffi::DataType::S32> counts) {
+  ScopedTimer timer(&g_route_ns, &g_route_calls);
+  const auto bdims = bins.dimensions();  // [F, n] — feature-major, see above
+  const int64_t F = bdims[0], n = bdims[1];
+  const auto gdims = go_left.dimensions();  // [L1, B]
+  const int64_t L1 = gdims[0], B = gdims[1];
+  const int32_t trash = static_cast<int32_t>(L1 - 1);
+  const bool have_set =
+      set_go_left.dimensions()[0] == static_cast<uint64_t>(n);
+
+  const uint8_t* bp = bins.typed_data();
+  const int32_t* sp = slot.typed_data();
+  const int32_t* lp = leaf.typed_data();
+  const uint8_t* dsp = do_split.typed_data();
+  const int32_t* rfp = route_f.typed_data();
+  const uint8_t* glp = go_left.typed_data();
+  const int32_t* lip = left_id.typed_data();
+  const int32_t* rip = right_id.typed_data();
+  const int32_t* srp = split_rank.typed_data();
+  const int32_t* hmp = hmap.typed_data();
+  const uint8_t* isp = is_set.typed_data();
+  const uint8_t* sgp = set_go_left.typed_data();
+  int32_t* nsp = new_slot->typed_data();
+  int32_t* nlp = new_leaf->typed_data();
+  int32_t* hsp = hist_slot->typed_data();
+  int32_t* cp = counts->typed_data();
+
+  const int32_t hist_trash = hmp[trash];
+  const int64_t nblocks = (n + kRowBlock - 1) / kRowBlock;
+  const int threads = ResolveRouteThreads(nblocks);
+  const int64_t ncount = L1 * 2;
+
+  // Per-block integer count partials, reduced in ascending block order
+  // (associative, so the order is cosmetic — but keep the histogram
+  // kernels' convention).
+  static thread_local std::vector<int64_t> count_arena;
+  try {
+    if (count_arena.size() < static_cast<size_t>(ncount) * nblocks) {
+      count_arena.resize(static_cast<size_t>(ncount) * nblocks);
+    }
+  } catch (const std::bad_alloc&) {
+    return ffi::Error(ffi::ErrorCode::kResourceExhausted,
+                      "route_update scratch allocation failed");
+  }
+  // thread_local is NOT captured by lambdas (a pool thread naming it
+  // would resolve its OWN empty instance) — hoist the raw pointer.
+  int64_t* const arena_p = count_arena.data();
+
+  auto run_block = [&, arena_p](int64_t blk) {
+    int64_t* cnt = arena_p + blk * ncount;
+    std::memset(cnt, 0, sizeof(int64_t) * ncount);
+    const int64_t r0 = blk * kRowBlock;
+    const int64_t r1 = std::min(r0 + kRowBlock, n);
+    for (int64_t i = r0; i < r1; ++i) {
+      int32_t s = sp[i];
+      if (s < 0 || s >= static_cast<int32_t>(L1)) s = trash;
+      if (!dsp[s]) {
+        nsp[i] = trash;
+        nlp[i] = lp[i];
+        hsp[i] = hist_trash;
+        continue;
+      }
+      bool gl;
+      if (isp[s] && have_set) {
+        gl = sgp[i] != 0;
+      } else {
+        // Feature-major gather: ascending-i iteration turns each live
+        // slot's chosen column into a sequential stream (one per
+        // distinct split feature), so a layer touches ~(#chosen
+        // features)·n bytes instead of the whole row-major matrix.
+        // route_f arrives pre-clipped; the min is memory-safety only.
+        const int64_t f = std::min<int64_t>(std::max(rfp[s], 0), F - 1);
+        const int64_t b = bp[f * n + i];
+        gl = glp[s * B + b] != 0;
+      }
+      nlp[i] = gl ? lip[s] : rip[s];
+      // Children of split rank r land on slots (2r, 2r+1). Ranks are
+      // < L/2 on frontier layers (the grower's overflow cap); the last
+      // layer's slots are discarded by the caller, so only the hmap
+      // read needs the clamp.
+      const int32_t cs = 2 * srp[s] + (gl ? 0 : 1);
+      nsp[i] = cs;
+      hsp[i] = hmp[std::min<int32_t>(std::max<int32_t>(cs, 0), trash)];
+      ++cnt[s * 2 + (gl ? 0 : 1)];
+    }
+  };
+
+  RunBlocks(nblocks, threads, run_block);
+  // Ascending-block-order reduction of the count partials.
+  std::memset(cp, 0, sizeof(int32_t) * ncount);
+  for (int64_t blk = 0; blk < nblocks; ++blk) {
+    const int64_t* cnt = arena_p + blk * ncount;
+    for (int64_t c = 0; c < ncount; ++c) {
+      cp[c] += static_cast<int32_t>(cnt[c]);
+    }
+  }
+  return ffi::Error::Success();
+}
+
+// The per-row prediction update, replicating XLA's contraction choice:
+//   mode 1 (fma):   preds + raw[l]·η in ONE rounding (std::fmaf — what
+//                   XLA CPU emits when LLVM contracts the shrinkage
+//                   multiply into the add; measured default on x86-64
+//                   with FMA units).
+//   mode 0 (plain): round(raw[l]·η) then add — two roundings, the
+//                   uncontracted lowering (and exactly the STORED model
+//                   leaf value being added).
+static inline float UpdateOne(float p, float raw, float eta, bool fma) {
+  return fma ? std::fmaf(raw, eta, p) : p + raw * eta;
+}
+
+// preds_out[i] = update(preds[i], raw_leaf[clamp(leaf_id[i])], η) — the
+// XLA gather+mul+add chain as one pass. `params` f32 [1] = η;
+// `mode` s32 [1] = 1 to contract (fmaf), 0 for the plain add.
+static ffi::Error LeafUpdateImpl(
+    ffi::Buffer<ffi::DataType::S32> leaf_id,
+    ffi::Buffer<ffi::DataType::F32> leaf_value,
+    ffi::Buffer<ffi::DataType::F32> preds,
+    ffi::Buffer<ffi::DataType::F32> params,
+    ffi::Buffer<ffi::DataType::S32> mode,
+    ffi::ResultBufferR1<ffi::DataType::F32> preds_out) {
+  ScopedTimer timer(&g_update_ns, &g_update_calls);
+  const int64_t n = leaf_id.dimensions()[0];
+  const int64_t N = leaf_value.dimensions()[0];
+  const int32_t* lp = leaf_id.typed_data();
+  const float* lvp = leaf_value.typed_data();
+  const float* pp = preds.typed_data();
+  const float eta = params.typed_data()[0];
+  const bool fma = mode.typed_data()[0] != 0;
+  float* op = preds_out->typed_data();
+
+  const int64_t nblocks = (n + kRowBlock - 1) / kRowBlock;
+  const int threads = ResolveRouteThreads(nblocks);
+  auto run_block = [&](int64_t blk) {
+    const int64_t r0 = blk * kRowBlock;
+    const int64_t r1 = std::min(r0 + kRowBlock, n);
+    for (int64_t i = r0; i < r1; ++i) {
+      int64_t l = lp[i];
+      if (l < 0) l = 0;
+      if (l >= N) l = N - 1;
+      op[i] = UpdateOne(pp[i], lvp[l], eta, fma);
+    }
+  };
+  RunBlocks(nblocks, threads, run_block);
+  return ffi::Error::Success();
+}
+
+// Squared-error fused update: preds_out[i] = update(preds[i],
+// raw_leaf[leaf_id[i]], η), then the grower's stats row from the
+// RECOMPUTED gradient — g = preds_out - y, h = 1, w_eff = w — as
+// [g*w, w, w]. The recompute reads the ROUNDED f32 preds_out (exactly
+// the ops XLA's elementwise path runs on the materialized scan carry:
+// one subtract, one multiply per column), so the result is
+// bit-identical; the fusion saves the second trip of preds/gradients
+// through memory at the top of the next iteration.
+static ffi::Error LeafUpdateGradImpl(
+    ffi::Buffer<ffi::DataType::S32> leaf_id,
+    ffi::Buffer<ffi::DataType::F32> leaf_value,
+    ffi::Buffer<ffi::DataType::F32> preds, ffi::Buffer<ffi::DataType::F32> y,
+    ffi::Buffer<ffi::DataType::F32> w,
+    ffi::Buffer<ffi::DataType::F32> params,
+    ffi::Buffer<ffi::DataType::S32> mode,
+    ffi::ResultBufferR1<ffi::DataType::F32> preds_out,
+    ffi::ResultBufferR2<ffi::DataType::F32> stats) {
+  ScopedTimer timer(&g_update_ns, &g_update_calls);
+  const int64_t n = leaf_id.dimensions()[0];
+  const int64_t N = leaf_value.dimensions()[0];
+  const int32_t* lp = leaf_id.typed_data();
+  const float* lvp = leaf_value.typed_data();
+  const float* pp = preds.typed_data();
+  const float* yp = y.typed_data();
+  const float* wp = w.typed_data();
+  const float eta = params.typed_data()[0];
+  const bool fma = mode.typed_data()[0] != 0;
+  float* op = preds_out->typed_data();
+  float* stp = stats->typed_data();
+
+  const int64_t nblocks = (n + kRowBlock - 1) / kRowBlock;
+  const int threads = ResolveRouteThreads(nblocks);
+  auto run_block = [&](int64_t blk) {
+    const int64_t r0 = blk * kRowBlock;
+    const int64_t r1 = std::min(r0 + kRowBlock, n);
+    for (int64_t i = r0; i < r1; ++i) {
+      int64_t l = lp[i];
+      if (l < 0) l = 0;
+      if (l >= N) l = N - 1;
+      const float p = UpdateOne(pp[i], lvp[l], eta, fma);
+      op[i] = p;
+      const float wi = wp[i];
+      stp[i * 3] = (p - yp[i]) * wi;  // g * w_eff
+      stp[i * 3 + 1] = wi;            // h (= 1) * w_eff
+      stp[i * 3 + 2] = wi;            // w_eff
+    }
+  };
+  RunBlocks(nblocks, threads, run_block);
+  return ffi::Error::Success();
+}
+
+// Full-tree batched routing (validation rows through one finished tree):
+// walks each row down from the root in one pass, replicating
+// ops/routing.py:route_tree_bins' loop body exactly (same clamps, same
+// select order; leaves are absorbing so early exit is equivalent to the
+// XLA path's fixed max_depth iterations).
+//   bins u8 [n, F], feature/threshold/left/right s32 [N1],
+//   is_cat/is_set/is_leaf u8 [N1], cat_mask u32 [N1, W],
+//   x_set u32 [ns, Fs, Ws] (ns == n when set features exist, else a
+//   [1, 1, 1] dummy), params s32 [2] = (max_depth, num_scalar).
+// Result: leaves s32 [n].
+static ffi::Error RouteTreeImpl(
+    ffi::Buffer<ffi::DataType::U8> bins,
+    ffi::Buffer<ffi::DataType::S32> feature,
+    ffi::Buffer<ffi::DataType::S32> threshold,
+    ffi::Buffer<ffi::DataType::U8> is_cat,
+    ffi::Buffer<ffi::DataType::U8> is_set,
+    ffi::Buffer<ffi::DataType::U32> cat_mask,
+    ffi::Buffer<ffi::DataType::S32> left, ffi::Buffer<ffi::DataType::S32> right,
+    ffi::Buffer<ffi::DataType::U8> is_leaf,
+    ffi::Buffer<ffi::DataType::U32> x_set,
+    ffi::Buffer<ffi::DataType::S32> params,
+    ffi::ResultBufferR1<ffi::DataType::S32> leaves) {
+  ScopedTimer timer(&g_route_ns, &g_route_calls);
+  const auto bdims = bins.dimensions();  // [n, F]
+  const int64_t n = bdims[0], F = bdims[1];
+  const int64_t N1 = feature.dimensions()[0];
+  const int64_t W = cat_mask.dimensions()[1];
+  const auto xdims = x_set.dimensions();  // [ns, Fs, Ws]
+  const bool have_set = xdims[0] == static_cast<uint64_t>(n);
+  const int64_t Fs = have_set ? xdims[1] : 0;
+  const int64_t Ws = have_set ? xdims[2] : 0;
+  const int64_t Wm = std::min(W, Ws);
+  const int32_t* prm = params.typed_data();
+  const int32_t max_depth = prm[0];
+  const int32_t num_scalar = prm[1];
+
+  const uint8_t* bp = bins.typed_data();
+  const int32_t* fp = feature.typed_data();
+  const int32_t* tp = threshold.typed_data();
+  const uint8_t* icp = is_cat.typed_data();
+  const uint8_t* isp = is_set.typed_data();
+  const uint32_t* cmp = cat_mask.typed_data();
+  const int32_t* lfp = left.typed_data();
+  const int32_t* rgp = right.typed_data();
+  const uint8_t* ilp = is_leaf.typed_data();
+  const uint32_t* xsp = x_set.typed_data();
+  int32_t* out = leaves->typed_data();
+
+  const int64_t nblocks = (n + kRowBlock - 1) / kRowBlock;
+  const int threads = ResolveRouteThreads(nblocks);
+  auto run_block = [&](int64_t blk) {
+    const int64_t r0 = blk * kRowBlock;
+    const int64_t r1 = std::min(r0 + kRowBlock, n);
+    for (int64_t i = r0; i < r1; ++i) {
+      int32_t node = 0;
+      for (int32_t d = 0; d < max_depth; ++d) {
+        if (ilp[node]) break;  // leaves self-loop in the XLA body
+        const int32_t f = std::max(fp[node], 0);
+        const int64_t fc =
+            std::min<int64_t>(std::max<int32_t>(f, 0), F > 0 ? F - 1 : 0);
+        const int64_t b = F > 0 ? bp[i * F + fc] : 0;
+        bool go_left;
+        if (icp[node]) {
+          const int64_t word = std::min<int64_t>(b >> 5, W - 1);
+          go_left = ((cmp[node * W + word] >> (b & 31)) & 1u) != 0;
+        } else {
+          go_left = static_cast<int32_t>(b) <= tp[node];
+        }
+        if (isp[node] && have_set) {
+          // Contains => the positive branch => RIGHT (ops/routing.py
+          // _set_intersects).
+          int64_t fs = f - num_scalar;
+          if (fs < 0) fs = 0;
+          if (fs >= Fs) fs = Fs - 1;
+          const uint32_t* words = xsp + (i * Fs + fs) * Ws;
+          const uint32_t* mask = cmp + node * W;
+          bool inter = false;
+          for (int64_t k = 0; k < Wm; ++k) {
+            if (words[k] & mask[k]) {
+              inter = true;
+              break;
+            }
+          }
+          go_left = !inter;
+        }
+        int32_t nxt = go_left ? lfp[node] : rgp[node];
+        if (nxt < 0) nxt = 0;
+        if (nxt >= static_cast<int32_t>(N1)) nxt = static_cast<int32_t>(N1 - 1);
+        node = nxt;
+      }
+      out[i] = node;
+    }
+  };
+  RunBlocks(nblocks, threads, run_block);
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    YdfRouteUpdate, RouteUpdateImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::DataType::U8>>()
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()
+        .Arg<ffi::Buffer<ffi::DataType::U8>>()
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()
+        .Arg<ffi::Buffer<ffi::DataType::U8>>()
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()
+        .Arg<ffi::Buffer<ffi::DataType::U8>>()
+        .Arg<ffi::Buffer<ffi::DataType::U8>>()
+        .Ret<ffi::BufferR1<ffi::DataType::S32>>()
+        .Ret<ffi::BufferR1<ffi::DataType::S32>>()
+        .Ret<ffi::BufferR1<ffi::DataType::S32>>()
+        .Ret<ffi::BufferR2<ffi::DataType::S32>>());
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    YdfLeafUpdate, LeafUpdateImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()
+        .Arg<ffi::Buffer<ffi::DataType::F32>>()
+        .Arg<ffi::Buffer<ffi::DataType::F32>>()
+        .Arg<ffi::Buffer<ffi::DataType::F32>>()
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()
+        .Ret<ffi::BufferR1<ffi::DataType::F32>>());
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    YdfLeafUpdateGrad, LeafUpdateGradImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()
+        .Arg<ffi::Buffer<ffi::DataType::F32>>()
+        .Arg<ffi::Buffer<ffi::DataType::F32>>()
+        .Arg<ffi::Buffer<ffi::DataType::F32>>()
+        .Arg<ffi::Buffer<ffi::DataType::F32>>()
+        .Arg<ffi::Buffer<ffi::DataType::F32>>()
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()
+        .Ret<ffi::BufferR1<ffi::DataType::F32>>()
+        .Ret<ffi::BufferR2<ffi::DataType::F32>>());
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    YdfRouteTree, RouteTreeImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::DataType::U8>>()
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()
+        .Arg<ffi::Buffer<ffi::DataType::U8>>()
+        .Arg<ffi::Buffer<ffi::DataType::U8>>()
+        .Arg<ffi::Buffer<ffi::DataType::U32>>()
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()
+        .Arg<ffi::Buffer<ffi::DataType::U8>>()
+        .Arg<ffi::Buffer<ffi::DataType::U32>>()
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()
+        .Ret<ffi::BufferR1<ffi::DataType::S32>>());

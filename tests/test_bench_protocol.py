@@ -53,6 +53,20 @@ def test_small_cpu_run_emits_parseable_record():
     assert "hist_attrib_s" in rec and rec["hist_attrib_s"] >= 0
     assert "hist_direct_s" in rec and rec["hist_direct_s"] >= 0
     assert rec["hist_quant"] in ("f32", "bf16x2", "int8")
+    # Routing attribution (PR 4): every headline record names the active
+    # routing impl and resolved native thread caps; with the native path
+    # on, route_s/update_s carry the in-kernel wall time next to hist_s.
+    assert rec["route_impl"] in ("xla", "native")
+    assert rec["route_threads"] >= 1
+    assert rec["hist_threads"] >= 1
+    if rec["route_impl"] == "native":
+        assert "route_s" in rec and rec["route_s"] >= 0
+        assert "update_s" in rec and rec["update_s"] >= 0
+        assert rec.get("route_s_source") == "native_kernel_counter"
+        # Fully-fused histogram+routing (native hist impl, the default
+        # on CPU): the joint row-walk time rides its own field.
+        if "fused_s" in rec:
+            assert rec["fused_s"] >= 0
 
 
 @pytest.mark.slow

@@ -41,6 +41,35 @@ def test_matches_segment_oracle(n, F, L, B, S):
                                rtol=1e-5, atol=1e-4)
 
 
+@pytest.mark.parametrize(
+    "L,S",
+    [
+        (32, 3),   # bench subtraction layer: G = 4 -> one dot per feature
+        (48, 2),   # G = 2, S divides G
+        (64, 3),   # G = 2, S odd: last group half-filled
+        (1, 3),    # root layer: G = 3 in a 128-lane dim
+    ],
+)
+def test_packed_lane_path_bit_exact(L, S):
+    """The sub-128-lane slot packing (PR 4 satellite: L <= 64 packs
+    G = 128//L stat columns into one lane dim) is a lane PERMUTATION of
+    the unpacked contraction — with integer-valued stats every partial
+    sum is exact, so the packed kernel must BIT-equal the segment
+    oracle, trash rows and ragged n included."""
+    G = min(S, 128 // L)
+    assert G >= 2, "shape must exercise the packed path"
+    rng = np.random.default_rng(L * 100 + S)
+    n, F, B = 1531, 5, 64
+    bins = jnp.asarray(rng.integers(0, B, (n, F)), jnp.uint8)
+    slot = jnp.asarray(rng.integers(0, L + 1, (n,)), jnp.int32)
+    stats = jnp.asarray(rng.integers(-8, 9, (n, S)).astype(np.float32))
+    h_ref = histogram(bins, slot, stats, num_slots=L, num_bins=B,
+                      impl="segment")
+    h_pal = histogram_pallas(bins, slot, stats, num_slots=L, num_bins=B,
+                             interpret=True)
+    np.testing.assert_array_equal(np.asarray(h_ref), np.asarray(h_pal))
+
+
 def test_all_trash_is_zero():
     bins = jnp.zeros((100, 3), jnp.uint8)
     slot = jnp.full((100,), 4, jnp.int32)  # all in trash slot L=4

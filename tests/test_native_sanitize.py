@@ -1,0 +1,164 @@
+"""Sanitizer builds of the native kernels (PR 4 satellite):
+YDF_TPU_NATIVE_SANITIZE={asan,ubsan} in ops/native_ffi.py compiles the
+WHOLE shared kernel library (-fsanitize=..., separate .so name so the
+normal build is never clobbered) and these tests drive every kernel
+family — histogram f32+q8, binning, routing/prediction-update — under
+it in a subprocess. Correctness tooling for every future native PR: a
+heap overflow or UB in a new kernel fails HERE with a report instead of
+corrupting a benchmark three rounds later.
+
+Subprocess because the sanitize mode is resolved at library-object
+creation (first ydf_tpu import); asan additionally needs its runtime
+preloaded before python itself, and libstdc++ preloaded next to it —
+gcc-10's interceptor init otherwise aborts with "real___cxa_throw != 0"
+when XLA throws its first C++ exception.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_DRIVER = r"""
+import numpy as np
+import jax.numpy as jnp
+
+from ydf_tpu.ops.native_ffi import KERNELS_LIB
+from ydf_tpu.ops import routing_native
+
+mode = KERNELS_LIB.sanitize
+assert mode, "sanitize mode did not reach the build helper"
+assert mode in KERNELS_LIB.lib_path, KERNELS_LIB.lib_path
+assert KERNELS_LIB.ensure_ffi_registered()
+
+rng = np.random.RandomState(0)
+n, F, L, B = 40000, 4, 4, 32
+
+# histogram, both precisions
+from ydf_tpu.ops.histogram import histogram
+bins = jnp.asarray(rng.randint(0, B, size=(n, F)).astype(np.uint8))
+slot = jnp.asarray(rng.randint(0, L + 1, size=n).astype(np.int32))
+stats = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32))
+np.asarray(histogram(bins, slot, stats, num_slots=L, num_bins=B,
+                     impl="native"))
+np.asarray(histogram(bins, slot, stats, num_slots=L, num_bins=B,
+                     impl="native", quant="int8"))
+
+# binning (values are feature-major [F, n])
+from ydf_tpu.ops import binning_native
+vals = rng.normal(size=(F, n)).astype(np.float32)
+vals[rng.rand(F, n) < 0.05] = np.nan
+bounds = np.sort(rng.normal(size=(F, B - 1)).astype(np.float32), axis=1)
+out = binning_native.binning_native(
+    jnp.asarray(vals), jnp.asarray(bounds),
+    jnp.asarray(np.full(F, B - 1, np.int32)),
+    jnp.asarray(np.zeros(F, np.float32)),
+)
+np.asarray(out)
+
+# fused routing + prediction updates (grower end to end)
+import jax
+from ydf_tpu.ops import grower
+from ydf_tpu.ops.split_rules import HessianGainRule
+stats_f = jnp.asarray(np.stack(
+    [rng.normal(size=n), np.ones(n), np.ones(n)], 1
+).astype(np.float32))
+grow_kw = dict(
+    rule=HessianGainRule(l2=1.0), max_depth=4, frontier=16, max_nodes=31,
+    num_bins=B, min_examples=2, min_split_gain=0.0, route_impl="native",
+)
+# route_fuse=True drives the fused histogram+routing kernels; False the
+# standalone ydf_route_update pass — both under the sanitizer.
+res = grower.grow_tree(bins, stats_f, jax.random.PRNGKey(0),
+                       route_fuse=True, **grow_kw)
+np.asarray(res.leaf_id)
+res2 = grower.grow_tree(bins, stats_f, jax.random.PRNGKey(0),
+                        route_fuse=False, **grow_kw)
+assert np.array_equal(np.asarray(res.leaf_id), np.asarray(res2.leaf_id))
+leaf = jnp.asarray(rng.randint(0, 31, n).astype(np.int32))
+raw = jnp.asarray(rng.normal(size=31).astype(np.float32))
+preds = jnp.asarray(rng.normal(size=n).astype(np.float32))
+np.asarray(routing_native.leaf_update(leaf, raw, 0.1, preds))
+pg, st = routing_native.leaf_update_grad(
+    leaf, raw, 0.1, preds,
+    jnp.asarray(rng.normal(size=n).astype(np.float32)),
+    jnp.asarray(np.ones(n, np.float32)),
+)
+np.asarray(pg), np.asarray(st)
+np.asarray(routing_native.route_tree(
+    bins, res.tree.feature, res.tree.threshold_bin, res.tree.is_cat,
+    res.tree.is_set, res.tree.cat_mask, res.tree.left, res.tree.right,
+    res.tree.is_leaf, 4,
+))
+print("SANITIZE_RUN_OK", mode)
+"""
+
+
+def _gcc_lib(name):
+    out = subprocess.run(
+        ["g++", f"-print-file-name={name}"], capture_output=True, text=True
+    )
+    path = out.stdout.strip()
+    return path if os.path.sep in path else None
+
+
+def _run(mode, extra_env):
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu", YDF_TPU_NATIVE_SANITIZE=mode,
+        **extra_env,
+    )
+    return subprocess.run(
+        [sys.executable, "-c", _DRIVER], capture_output=True, text=True,
+        timeout=900, cwd=REPO, env=env,
+    )
+
+
+@pytest.mark.slow
+def test_kernels_clean_under_asan():
+    libasan = _gcc_lib("libasan.so")
+    libstdcpp = _gcc_lib("libstdc++.so.6") or _gcc_lib("libstdc++.so")
+    if libasan is None:
+        pytest.skip("no libasan runtime in this toolchain")
+    out = _run(
+        "asan",
+        {
+            "LD_PRELOAD": f"{libasan} {libstdcpp}" if libstdcpp else libasan,
+            # XLA's arena allocations never free by design; leak checking
+            # would drown real errors.
+            "ASAN_OPTIONS": "detect_leaks=0",
+        },
+    )
+    assert "SANITIZE_RUN_OK asan" in out.stdout, (
+        f"asan run failed\nstdout: {out.stdout[-2000:]}\n"
+        f"stderr: {out.stderr[-4000:]}"
+    )
+    assert "ERROR: AddressSanitizer" not in out.stderr, out.stderr[-4000:]
+
+
+@pytest.mark.slow
+def test_kernels_clean_under_ubsan():
+    out = _run(
+        "ubsan",
+        {"UBSAN_OPTIONS": "print_stacktrace=1,halt_on_error=1"},
+    )
+    assert "SANITIZE_RUN_OK ubsan" in out.stdout, (
+        f"ubsan run failed\nstdout: {out.stdout[-2000:]}\n"
+        f"stderr: {out.stderr[-4000:]}"
+    )
+    assert "runtime error" not in out.stderr, out.stderr[-4000:]
+
+
+def test_sanitize_mode_env_validation(monkeypatch):
+    """Typos fail eagerly at the env boundary (tier-1: fast, no build)."""
+    from ydf_tpu.ops import native_ffi
+
+    monkeypatch.setenv("YDF_TPU_NATIVE_SANITIZE", "asna")
+    with pytest.raises(ValueError, match="not a sanitizer mode"):
+        native_ffi.sanitize_mode()
+    monkeypatch.setenv("YDF_TPU_NATIVE_SANITIZE", "asan")
+    assert native_ffi.sanitize_mode() == "asan"
+    monkeypatch.setenv("YDF_TPU_NATIVE_SANITIZE", "")
+    assert native_ffi.sanitize_mode() is None

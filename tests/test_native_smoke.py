@@ -118,12 +118,70 @@ def test_stale_build_detection(tmp_path):
 
 
 def test_q8_target_registered_alongside_f32():
-    """Both precisions and the binning target ride one library; a
-    partial registration would mean the int8 bench mode silently cannot
-    run."""
+    """Every training kernel — both histogram precisions, binning, and
+    the PR-4 routing/prediction-update family — rides ONE library; a
+    partial registration would mean a bench mode silently cannot run."""
     from ydf_tpu.ops.native_ffi import KERNELS_LIB
 
     assert set(KERNELS_LIB.ffi_targets) == {
-        "ydf_histogram", "ydf_histogram_q8", "ydf_binning"
+        "ydf_histogram", "ydf_histogram_q8",
+        "ydf_histogram_routed", "ydf_histogram_q8_routed",
+        "ydf_binning",
+        "ydf_route_update", "ydf_leaf_update", "ydf_leaf_update_grad",
+        "ydf_route_tree",
     }
     assert KERNELS_LIB.ensure_ffi_registered()
+
+
+def test_route_kernels_build_and_register():
+    """The fused row-routing family (native/routing_ffi.cc) registers
+    with the rest of the shared library — registers-or-raises, never a
+    silent XLA fallback under an explicit impl."""
+    from ydf_tpu.ops import routing_native
+
+    assert routing_native.available(), (
+        "native routing kernels failed to build/register — "
+        "YDF_TPU_ROUTE_IMPL=native would raise and the bench would lose "
+        "the fused path"
+    )
+    assert not routing_native.build_is_stale()
+
+
+def test_route_impl_native_actually_executes():
+    """End-to-end proof the fused route_update custom call RUNS inside a
+    grower build (not a fallback): its own call counter must advance."""
+    import jax
+
+    from ydf_tpu.ops import grower, routing_native
+    from ydf_tpu.ops.split_rules import HessianGainRule
+
+    rng = np.random.RandomState(0)
+    n, F, B = 4000, 4, 32
+    bins = jnp.asarray(rng.randint(0, B, size=(n, F)).astype(np.uint8))
+    stats = jnp.asarray(
+        np.stack(
+            [rng.normal(size=n), np.ones(n), np.ones(n)], axis=1
+        ).astype(np.float32)
+    )
+    before = routing_native.route_kernel_calls()
+    res = grower.grow_tree(
+        bins, stats, jax.random.PRNGKey(0), rule=HessianGainRule(l2=1.0),
+        max_depth=4, frontier=16, max_nodes=31, num_bins=B,
+        min_examples=2, min_split_gain=0.0, route_impl="native",
+    )
+    np.asarray(res.leaf_id)  # force execution
+    assert routing_native.route_kernel_calls() > before, (
+        "route_impl='native' did not reach the ydf_route_update custom "
+        "call"
+    )
+
+
+def test_explicit_native_route_fails_loudly_when_unavailable(monkeypatch):
+    """Explicit YDF_TPU_ROUTE_IMPL=native with a failed build must raise
+    (the same no-silent-fallback contract as the histogram kernels)."""
+    from ydf_tpu.ops import routing_native
+
+    monkeypatch.setattr(routing_native._LIB, "_failed", True)
+    monkeypatch.setattr(routing_native._LIB, "_ffi_registered", False)
+    with pytest.raises(RuntimeError, match="could not be built"):
+        routing_native._require_registered()

@@ -1,0 +1,495 @@
+"""Fused native row-routing & prediction-update parity (PR 4).
+
+The XLA routing chain in ops/grower.py stays the default/oracle; the
+native kernel family (native/routing_ffi.cc: ydf_route_update,
+ydf_leaf_update, ydf_leaf_update_grad, ydf_route_tree) must be
+BIT-identical to it — same leaf_id, same chosen splits, same final
+predictions — across quant modes, ragged row counts, NaN + categorical
++ categorical-set features, and every YDF_TPU_ROUTE_THREADS value.
+
+The one rounding subtlety lives in the prediction update: XLA CPU
+contracts the shrinkage multiply into the preds add as a hardware FMA
+(through the leaf-value gather AND through an optimization_barrier), so
+the kernels take (raw leaf value, η) and replicate the contraction that
+ops/routing_native.py:update_uses_fma observes — see
+docs/row_routing.md.
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import ydf_tpu as ydf
+from ydf_tpu.ops import grower, routing_native
+from ydf_tpu.ops.routing import apply_leaf_values, route_tree_bins
+from ydf_tpu.ops.split_rules import HessianGainRule
+
+
+def _grow_both(bins, stats, key, **kw):
+    outs = {}
+    for impl in ("xla", "native"):
+        outs[impl] = grower.grow_tree(bins, stats, key, route_impl=impl, **kw)
+    return outs["xla"], outs["native"]
+
+
+def _assert_tree_equal(a, b):
+    assert bool((a.leaf_id == b.leaf_id).all()), "leaf_id diverged"
+    for f in ("feature", "threshold_bin", "left", "right", "is_leaf",
+              "cat_mask", "leaf_stats"):
+        fa, fb = getattr(a.tree, f, None), getattr(b.tree, f, None)
+        if fa is None:
+            continue
+        assert bool((fa == fb).all()), f"tree.{f} diverged"
+
+
+@pytest.mark.parametrize("quant", ["f32", "bf16x2", "int8"])
+def test_grower_routing_parity_all_quant_modes(quant, monkeypatch):
+    """Full-tree bit-equality of leaf_id, chosen splits and leaf stats
+    between the XLA chain and the fused kernel, under every gradient
+    quantization mode (the routing consumes the same decisions whatever
+    grid the histogram summed on)."""
+    monkeypatch.setenv("YDF_TPU_HIST_QUANT", quant)
+    rng = np.random.default_rng(1)
+    n, F, B = 20000, 8, 64
+    bins = jnp.asarray(rng.integers(0, B, (n, F), dtype=np.int64).astype(np.uint8))
+    g = rng.standard_normal(n).astype(np.float32)
+    stats = jnp.asarray(
+        np.stack([g, np.ones(n, np.float32), np.ones(n, np.float32)], 1)
+    )
+    a, b = _grow_both(
+        bins, stats, jax.random.PRNGKey(3), rule=HessianGainRule(l2=1.0),
+        max_depth=6, frontier=64, max_nodes=127, num_bins=B,
+        min_examples=5, min_split_gain=0.0,
+    )
+    _assert_tree_equal(a, b)
+
+
+def test_grower_routing_parity_ragged_rows():
+    """Row counts straddling the kernel's fixed 32k block boundary (n %
+    32768 != 0, multi-block) must not change a bit."""
+    rng = np.random.default_rng(2)
+    for n in (31, 32768, 32769, 70001):
+        F, B = 3, 32
+        bins = jnp.asarray(
+            rng.integers(0, B, (n, F), dtype=np.int64).astype(np.uint8)
+        )
+        g = rng.standard_normal(n).astype(np.float32)
+        stats = jnp.asarray(
+            np.stack([g, np.ones(n, np.float32), np.ones(n, np.float32)], 1)
+        )
+        a, b = _grow_both(
+            bins, stats, jax.random.PRNGKey(0), rule=HessianGainRule(l2=1.0),
+            max_depth=4, frontier=16, max_nodes=31, num_bins=B,
+            min_examples=2, min_split_gain=0.0,
+        )
+        _assert_tree_equal(a, b)
+
+
+def _train_pair(df, label, route_impls=("xla", "native"), **kw):
+    models = []
+    for impl in route_impls:
+        os.environ["YDF_TPU_ROUTE_IMPL"] = impl
+        try:
+            models.append(
+                ydf.GradientBoostedTreesLearner(label=label, **kw).train(df)
+            )
+        finally:
+            del os.environ["YDF_TPU_ROUTE_IMPL"]
+    return models
+
+
+def test_learner_parity_nan_and_categorical():
+    """End-to-end learner bit-parity (trees, leaf values, predictions)
+    with NaN numericals + string categoricals, validation split on (the
+    native route_tree covers the validation batch)."""
+    rng = np.random.default_rng(5)
+    n = 3000
+    x = rng.standard_normal(n).astype(np.float32)
+    x[rng.random(n) < 0.1] = np.nan
+    df = pd.DataFrame({
+        "num": x,
+        "cat": rng.choice(["red", "green", "blue", "teal"], n),
+        "num2": rng.standard_normal(n).astype(np.float32),
+    })
+    df["label"] = (
+        (np.nan_to_num(x) + (df["cat"] == "red") * 1.5) > 0.3
+    ).astype(int)
+    mx, mn = _train_pair(df, "label", num_trees=8)
+    px, pn = mx.predict(df), mn.predict(df)
+    assert np.array_equal(np.asarray(px), np.asarray(pn))
+    assert np.array_equal(
+        np.asarray(mx.forest.leaf_value), np.asarray(mn.forest.leaf_value)
+    )
+    for f in ("feature", "threshold_bin", "left", "right", "is_leaf"):
+        assert np.array_equal(
+            np.asarray(getattr(mx.forest, f)),
+            np.asarray(getattr(mn.forest, f)),
+        ), f
+
+
+def test_grower_routing_parity_multi_ordering_categoricals():
+    """O > 1 categorical orderings (CART multiclass): the expanded
+    candidate columns mean the raw best_f does NOT index the bins matrix
+    — routing must gather the collapsed best_f_scalar column. A
+    raw-index clip would mis-route into a neighboring feature's column
+    (regression for the route_f collapse in ops/grower.py); the kernel
+    and the XLA chain must agree bit for bit."""
+    from ydf_tpu.ops.split_rules import ClassificationRule
+
+    rng = np.random.default_rng(31)
+    n, Fn, Fc, B = 6000, 1, 3, 64
+    cats = rng.integers(0, 7, (n, Fc))
+    bins = jnp.asarray(
+        np.concatenate([rng.integers(0, B, (n, Fn)), cats], 1).astype(
+            np.uint8
+        )
+    )
+    C = 3
+    ycls = (cats[:, 0] % C).astype(np.int64)
+    stats = np.zeros((n, C + 1), np.float32)
+    stats[np.arange(n), ycls] = 1.0
+    stats[:, -1] = 1.0
+    rule = ClassificationRule(num_classes=C)
+    assert rule.num_cat_orderings == C  # the expanded-columns case
+    a, b = _grow_both(
+        bins, jnp.asarray(stats), jax.random.PRNGKey(2), rule=rule,
+        max_depth=4, frontier=16, max_nodes=31, num_bins=B,
+        num_numerical=Fn, min_examples=2, min_split_gain=0.0,
+    )
+    _assert_tree_equal(a, b)
+    assert bool(np.asarray(a.tree.is_cat).any()), (
+        "test shape never chose a categorical split — the O-collapse "
+        "path was not exercised"
+    )
+
+
+def test_learner_multiclass_demotes_to_xla():
+    """Multi-output losses (K > 1) keep the XLA routing even under
+    YDF_TPU_ROUTE_IMPL=native: the oracle program's per-column FMA
+    contraction choices are compiler whim that no kernel can replicate
+    (docs/row_routing.md), so the learner demotes — and the two env
+    settings must therefore be EXACTLY identical."""
+    rng = np.random.default_rng(11)
+    n = 1500
+    df = pd.DataFrame({
+        "c1": rng.choice(["a", "b", "c", "d", "e"], n),
+        "num": rng.standard_normal(n).astype(np.float32),
+    })
+    y = np.select([df.c1 == "a", df.num > 0.5], [0, 1], default=2)
+    df["label"] = pd.Series(y).map({0: "u", 1: "v", 2: "w"})
+    mx, mn = _train_pair(df, "label", num_trees=4)
+    assert np.array_equal(np.asarray(mx.predict(df)), np.asarray(mn.predict(df)))
+    assert np.array_equal(
+        np.asarray(mx.forest.leaf_value), np.asarray(mn.forest.leaf_value)
+    )
+
+
+def test_learner_parity_categorical_set():
+    """Set-valued features route through the per-example set decision
+    (shared by both impls at the layer level; the full-tree kernel
+    recomputes the mask intersection) — preds must stay bit-equal."""
+    rng = np.random.RandomState(0)
+    n = 2000
+    universe = list("abcdefghij")
+    sets = [
+        list(rng.choice(universe, size=rng.randint(0, 4), replace=False))
+        for _ in range(n)
+    ]
+    x = rng.normal(size=n).astype(np.float32)
+    y = np.array(
+        [int(("a" in s) or ("b" in s and xi > 0)) for s, xi in zip(sets, x)]
+    )
+    df = pd.DataFrame({
+        "tags": pd.Series(np.array(sets, dtype=object)),
+        "f": x,
+        "label": y,
+    })
+    mx, mn = _train_pair(df, "label", num_trees=6)
+    assert np.array_equal(np.asarray(mx.predict(df)), np.asarray(mn.predict(df)))
+
+
+def _random_tree(seed=0, F=5, B=64, with_cat=True):
+    """A real grown tree (so all invariants hold) over random data."""
+    rng = np.random.default_rng(seed)
+    n = 4000
+    bins = jnp.asarray(rng.integers(0, B, (n, F), dtype=np.int64).astype(np.uint8))
+    g = rng.standard_normal(n).astype(np.float32)
+    stats = jnp.asarray(
+        np.stack([g, np.ones(n, np.float32), np.ones(n, np.float32)], 1)
+    )
+    res = grower.grow_tree(
+        bins, stats, jax.random.PRNGKey(seed), rule=HessianGainRule(l2=1.0),
+        max_depth=5, frontier=32, max_nodes=63, num_bins=B,
+        num_numerical=F if not with_cat else F - 1,
+        min_examples=2, min_split_gain=0.0,
+    )
+    return res.tree
+
+
+def test_route_tree_parity():
+    """Full-tree batched routing (the validation-set path): the one-pass
+    kernel must produce the same leaf for every example as the XLA
+    fori_loop, over fresh examples and ragged batch sizes."""
+    tree = _random_tree(seed=3)
+    rng = np.random.default_rng(7)
+    for n in (1, 1000, 32769):
+        bins = jnp.asarray(
+            rng.integers(0, 64, (n, 5), dtype=np.int64).astype(np.uint8)
+        )
+        lx = route_tree_bins(tree, bins, 5, impl="xla")
+        ln = route_tree_bins(tree, bins, 5, impl="native")
+        assert np.array_equal(np.asarray(lx), np.asarray(ln)), n
+
+
+def test_route_tree_trailing_pad_columns_regression():
+    """num_scalar contract (docstring fix): with trailing pad columns on
+    the bins matrix (feature-parallel padding), the DEFAULT offset
+    (bins.shape[1]) would shift every set-feature id — callers must pass
+    the unpadded count, and routing with the explicit offset over the
+    padded matrix must equal routing over the unpadded matrix. Also
+    exercises numeric trees: trailing pads never change their leaves
+    because stored feature ids only cover real columns."""
+    tree = _random_tree(seed=4)
+    rng = np.random.default_rng(9)
+    n, F = 2000, 5
+    bins = rng.integers(0, 64, (n, F), dtype=np.int64).astype(np.uint8)
+    padded = np.concatenate(
+        [bins, rng.integers(0, 64, (n, 3)).astype(np.uint8)], axis=1
+    )
+    base = np.asarray(route_tree_bins(tree, jnp.asarray(bins), 5))
+    for impl in ("xla", "native"):
+        got = np.asarray(
+            route_tree_bins(
+                tree, jnp.asarray(padded), 5, num_scalar=F, impl=impl
+            )
+        )
+        assert np.array_equal(got, base), impl
+
+
+def test_thread_count_bit_stability(monkeypatch):
+    """Fixed 32k blocks + ascending-block-order count reduction: the
+    thread cap only changes scheduling, never a bit, for the layer
+    routing AND the prediction updates."""
+    rng = np.random.default_rng(13)
+    n, F, B = 70001, 4, 32
+    bins = jnp.asarray(rng.integers(0, B, (n, F), dtype=np.int64).astype(np.uint8))
+    g = rng.standard_normal(n).astype(np.float32)
+    stats = jnp.asarray(
+        np.stack([g, np.ones(n, np.float32), np.ones(n, np.float32)], 1)
+    )
+    kw = dict(
+        rule=HessianGainRule(l2=1.0), max_depth=4, frontier=16,
+        max_nodes=31, num_bins=B, min_examples=2, min_split_gain=0.0,
+    )
+    leaf = rng.integers(0, 31, n).astype(np.int32)
+    raw = rng.standard_normal(31).astype(np.float32)
+    preds = rng.standard_normal(n).astype(np.float32)
+    y = rng.standard_normal(n).astype(np.float32)
+    w = np.ones(n, np.float32)
+    outs = {}
+    for t in ("1", "3", "16"):
+        monkeypatch.setenv("YDF_TPU_ROUTE_THREADS", t)
+        # The fully-fused histogram+routing kernels run on the HIST
+        # thread cap (they are histogram calls); vary it in lockstep so
+        # the fused per-block routing is exercised at every width too.
+        monkeypatch.setenv("YDF_TPU_HIST_THREADS", t)
+        res = grower.grow_tree(
+            bins, stats, jax.random.PRNGKey(1), route_impl="native", **kw
+        )
+        up = routing_native.leaf_update(
+            jnp.asarray(leaf), jnp.asarray(raw), 0.1, jnp.asarray(preds)
+        )
+        pg, st = routing_native.leaf_update_grad(
+            jnp.asarray(leaf), jnp.asarray(raw), 0.1, jnp.asarray(preds),
+            jnp.asarray(y), jnp.asarray(w),
+        )
+        outs[t] = (
+            np.asarray(res.leaf_id), np.asarray(up), np.asarray(pg),
+            np.asarray(st),
+        )
+    for t in ("3", "16"):
+        for a, b in zip(outs["1"], outs[t]):
+            assert np.array_equal(a, b), t
+
+
+def test_leaf_update_matches_xla_rounding():
+    """The rounding contract: the kernel must reproduce whatever this
+    host's XLA emits for `preds + (raw·η)[leaf]` — fma(raw, η, preds)
+    when LLVM contracts (the measured default on x86-64), the plain
+    two-rounding chain otherwise. The probe decides; this test closes
+    the loop against the real XLA lowering."""
+    rng = np.random.default_rng(17)
+    n, N = 50000, 127
+    raw = rng.standard_normal(N).astype(np.float32)
+    leaf = rng.integers(0, N, n).astype(np.int32)
+    p0 = rng.standard_normal(n).astype(np.float32)
+    eta = 0.1
+    xla_out = np.asarray(
+        jax.jit(lambda r, l, p: p + (r * jnp.float32(eta))[l])(
+            jnp.asarray(raw), jnp.asarray(leaf), jnp.asarray(p0)
+        )
+    )
+    kern = np.asarray(
+        routing_native.leaf_update(
+            jnp.asarray(leaf), jnp.asarray(raw), eta, jnp.asarray(p0)
+        )
+    )
+    assert np.array_equal(kern, xla_out)
+    # Fused-gradient stats: computed from the ROUNDED preds_out exactly
+    # like XLA recomputes them from the materialized scan carry.
+    y = rng.standard_normal(n).astype(np.float32)
+    w = (rng.random(n).astype(np.float32) + 0.5)
+    pg, st = routing_native.leaf_update_grad(
+        jnp.asarray(leaf), jnp.asarray(raw), eta, jnp.asarray(p0),
+        jnp.asarray(y), jnp.asarray(w),
+    )
+    assert np.array_equal(np.asarray(pg), xla_out)
+    expect = np.stack(
+        [(xla_out - y) * w, w, w], axis=1
+    ).astype(np.float32)
+    assert np.array_equal(np.asarray(st), expect)
+
+
+def test_apply_leaf_values_impl_parity():
+    rng = np.random.default_rng(19)
+    n, N = 10000, 63
+    raw = rng.standard_normal(N).astype(np.float32)
+    leaf = rng.integers(0, N, n).astype(np.int32)
+    p0 = rng.standard_normal(n).astype(np.float32)
+    a = jax.jit(
+        lambda l, r, p: apply_leaf_values(l, r, p, scale=0.1, impl="xla")
+    )(jnp.asarray(leaf), jnp.asarray(raw), jnp.asarray(p0))
+    b = apply_leaf_values(
+        jnp.asarray(leaf), jnp.asarray(raw), jnp.asarray(p0),
+        scale=0.1, impl="native",
+    )
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_route_update_counts():
+    """The per-(slot, side) row counts the kernel emits (the
+    smaller-child bookkeeping input) match a numpy ground truth."""
+    rng = np.random.default_rng(23)
+    n, F, B, L = 5000, 3, 16, 8
+    bins = rng.integers(0, B, (n, F), dtype=np.int64).astype(np.uint8)
+    slot = rng.integers(0, L + 1, n).astype(np.int32)
+    leaf = rng.integers(0, 15, n).astype(np.int32)
+    do_split = (rng.random(L + 1) < 0.7)
+    do_split[L] = False
+    route_f = rng.integers(0, F, L + 1).astype(np.int32)
+    go_left = rng.random((L + 1, B)) < 0.5
+    left_id = rng.integers(0, 15, L + 1).astype(np.int32)
+    right_id = rng.integers(0, 15, L + 1).astype(np.int32)
+    split_rank = np.minimum(
+        np.cumsum(do_split) - 1, L // 2 - 1
+    ).clip(0).astype(np.int32)
+    hmap = np.arange(L + 1, dtype=np.int32)
+    new_slot, new_leaf, hist_slot, counts = routing_native.route_update(
+        jnp.asarray(bins.T), jnp.asarray(slot), jnp.asarray(leaf),
+        jnp.asarray(do_split.astype(np.uint8)), jnp.asarray(route_f),
+        jnp.asarray(go_left.astype(np.uint8)), jnp.asarray(left_id),
+        jnp.asarray(right_id), jnp.asarray(split_rank), jnp.asarray(hmap),
+        jnp.asarray(np.zeros(L + 1, np.uint8)),
+        jnp.asarray(np.zeros(1, np.uint8)),
+    )
+    ref = np.zeros((L + 1, 2), np.int64)
+    for i in range(n):
+        s = slot[i]
+        if not do_split[s]:
+            assert int(new_slot[i]) == L
+            assert int(new_leaf[i]) == leaf[i]
+            continue
+        gl = go_left[s, bins[i, route_f[s]]]
+        ref[s, 0 if gl else 1] += 1
+        assert int(new_leaf[i]) == (left_id[s] if gl else right_id[s])
+        assert int(new_slot[i]) == 2 * split_rank[s] + (0 if gl else 1)
+    assert np.array_equal(np.asarray(counts), ref.astype(np.int32))
+
+
+@pytest.mark.parametrize("quant", ["f32", "int8"])
+def test_fused_histogram_routed_matches_composition(quant):
+    """The fused histogram+routing kernel must BIT-equal the two-pass
+    composition it replaces: ydf_route_update (new_slot/new_leaf/
+    hist_slot) followed by the plain native histogram over hist_slot.
+    Same blocks, same reduction order, same routing decisions — any
+    drift here means the lockstep copies of the decision logic
+    (histogram_ffi.cc:RouteSlot vs routing_ffi.cc) diverged."""
+    rng = np.random.default_rng(31)
+    n, F, B, L = 70001, 5, 32, 8
+    Lh = 4
+    bins = np.ascontiguousarray(
+        rng.integers(0, B, (n, F), dtype=np.int64).astype(np.uint8)
+    )
+    slot = rng.integers(0, L + 1, n).astype(np.int32)
+    leaf = rng.integers(0, 15, n).astype(np.int32)
+    do_split = (rng.random(L + 1) < 0.7).astype(np.uint8)
+    do_split[L] = 0
+    route_f = rng.integers(0, F, L + 1).astype(np.int32)
+    go_left = (rng.random((L + 1, B)) < 0.5).astype(np.uint8)
+    left_id = rng.integers(0, 15, L + 1).astype(np.int32)
+    right_id = rng.integers(0, 15, L + 1).astype(np.int32)
+    split_rank = np.minimum(
+        np.cumsum(do_split) - 1, L // 2 - 1
+    ).clip(0).astype(np.int32)
+    hmap = rng.integers(0, Lh + 1, L + 1).astype(np.int32)
+    hmap[L] = Lh
+    is_set = np.zeros(L + 1, np.uint8)
+    set_gl = np.zeros(1, np.uint8)
+    if quant == "int8":
+        stats = rng.integers(-127, 128, (n, 3)).astype(np.int8)
+        qscale = np.asarray([0.5, 0.25, 1.0], np.float32)
+    else:
+        stats = rng.standard_normal((n, 3)).astype(np.float32)
+        qscale = None
+
+    args = [
+        jnp.asarray(a)
+        for a in (slot, leaf, do_split, route_f, go_left, left_id,
+                  right_id, split_rank, hmap, is_set, set_gl)
+    ]
+    hist_f, ns_f, nl_f = routing_native.histogram_routed(
+        jnp.asarray(bins), *args, stats=jnp.asarray(stats),
+        num_slots=Lh, num_bins=B,
+        quant_scale=None if qscale is None else jnp.asarray(qscale),
+    )
+    ns_r, nl_r, hs_r, _ = routing_native.route_update(
+        jnp.asarray(np.ascontiguousarray(bins.T)), *args
+    )
+    from ydf_tpu.ops.histogram_native import (
+        histogram_native,
+        histogram_native_q8,
+    )
+
+    if quant == "int8":
+        hist_r = histogram_native_q8(
+            jnp.asarray(bins), hs_r, jnp.asarray(stats),
+            jnp.asarray(qscale), Lh, B,
+        )
+    else:
+        hist_r = histogram_native(
+            jnp.asarray(bins), hs_r, jnp.asarray(stats), Lh, B
+        )
+    assert np.array_equal(np.asarray(ns_f), np.asarray(ns_r))
+    assert np.array_equal(np.asarray(nl_f), np.asarray(nl_r))
+    assert np.array_equal(np.asarray(hist_f), np.asarray(hist_r))
+
+
+def test_route_impl_env_validation(monkeypatch):
+    """YDF_TPU_ROUTE_IMPL typos fail EAGERLY at the env boundary."""
+    monkeypatch.setenv("YDF_TPU_ROUTE_IMPL", "navite")
+    with pytest.raises(ValueError, match="not a routing impl"):
+        routing_native.resolve_route_impl(None)
+    monkeypatch.setenv("YDF_TPU_ROUTE_IMPL", "native")
+    assert routing_native.resolve_route_impl(None) == "native"
+    monkeypatch.delenv("YDF_TPU_ROUTE_IMPL")
+    assert routing_native.resolve_route_impl(None) == "xla"
+    with pytest.raises(ValueError, match="not a routing impl"):
+        routing_native.resolve_route_impl("nativ")
+    monkeypatch.setenv("YDF_TPU_UPDATE_FMA", "maybe")
+    with pytest.raises(ValueError, match="must be 0, 1 or auto"):
+        routing_native.update_uses_fma()

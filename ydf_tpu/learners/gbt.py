@@ -40,7 +40,7 @@ from ydf_tpu.learners.losses import make_loss
 from ydf_tpu.models.forest import forest_from_stacked_trees
 from ydf_tpu.models.gbt_model import GradientBoostedTreesModel
 from ydf_tpu.ops import grower
-from ydf_tpu.ops.routing import route_tree_bins
+from ydf_tpu.ops.routing import apply_leaf_values, route_tree_bins
 from ydf_tpu.ops.split_rules import HessianGainRule
 
 
@@ -585,6 +585,39 @@ class GradientBoostedTreesLearner(GenericLearner):
         )
         rule = HessianGainRule(l2=self.l2_regularization)
 
+        # Example-routing impl for the whole boosting loop, resolved ONCE
+        # at the env boundary (YDF_TPU_ROUTE_IMPL, validated eagerly) and
+        # passed explicitly down the stack — unlike the histogram env
+        # vars, the closure cache IS keyed on it (the fused-gradient path
+        # changes the scan carry structure). The fused kernels are CPU
+        # custom calls: the TPU backend and the GSPMD mesh path keep the
+        # XLA chain, which is bit-identical anyway (docs/row_routing.md).
+        from ydf_tpu.config import is_tpu_backend
+        from ydf_tpu.ops.routing_native import (
+            resolve_route_fuse,
+            resolve_route_impl,
+        )
+
+        route_impl = resolve_route_impl(None)
+        route_fuse = resolve_route_fuse()
+        if route_impl == "native" and (
+            self.mesh is not None
+            or is_tpu_backend()
+            or self.dart_dropout > 0.0
+            or K > 1
+        ):
+            # TPU/mesh: the fused kernels are CPU custom calls. DART and
+            # multi-output (K > 1) losses: their preds updates live in
+            # XLA expressions whose FMA-contraction choices are compiler
+            # whim — measured on the multiclass path, the ORACLE program
+            # itself contracts some class columns and not others, so no
+            # kernel can replicate it and a native-routed program would
+            # drift a ulp from the second iteration on
+            # (docs/row_routing.md). These configs keep the XLA routing
+            # wholesale; the bench family (binomial/MSE, K = 1) gets the
+            # fused path.
+            route_impl = "xla"
+
         monotone = None
         if self.monotonic_constraints:
             # Multi-dim losses (multiclass) work unchanged: each of the K
@@ -762,6 +795,8 @@ class GradientBoostedTreesLearner(GenericLearner):
             ),
             vs_Ac=vs_Ac,
             vs_Ap=vs_Ap,
+            route_impl=route_impl,
+            route_fuse=route_fuse,
             cache_dir=self.working_dir,
             resume=self.resume_training,
             snapshot_interval=self.resume_training_snapshot_interval_trees,
@@ -915,7 +950,7 @@ def _make_boost_fn(
     dart_dropout=0.0, oblique_P=0, oblique_density=2.0,
     oblique_weight_type="BINARY", oblique_weight_range=None,
     oblique_mode="SPARSE", mhld_max_attributes=4, num_label_classes=1,
-    monotone=None, vs_Ac=0, vs_Ap=0,
+    monotone=None, vs_Ac=0, vs_Ap=0, route_impl="xla", route_fuse=True,
 ):
     """Builds (and caches) the jitted boosting loop for one static config.
 
@@ -931,6 +966,35 @@ def _make_boost_fn(
     use_dart = dart_dropout > 0.0
     P = oblique_P
 
+    # Native fused end-of-tree update (docs/row_routing.md): with the
+    # native routing path on, the per-tree (per-class column)
+    # `preds += leaf_value[leaf_id]` runs as one kernel pass
+    # (fuse_update); for squared error under unit sampling the same pass
+    # also recomputes the next iteration's [g·w, h·w, w] stats rows
+    # (fuse_grad — the carry then threads the stats to the next scan
+    # step, so gradients never make a second trip through memory).
+    # fuse_update is NOT optional when routing natively: leaving the
+    # update to XLA would let the native program's different fusion
+    # clustering make different FMA-contraction choices than the oracle
+    # program compiles (measured on the multiclass path — ulp drift
+    # from the second iteration on), while the kernel pins the probed
+    # contraction behavior for every column. Only losses whose gradient
+    # is plain arithmetic fuse_grad: squared error's g = p − y is
+    # bit-identical between XLA and the kernel, while sigmoid/softmax
+    # losses keep the XLA recompute (elementwise, deterministic across
+    # both compiled programs).
+    fuse_update = route_impl == "native" and not use_dart
+    from ydf_tpu.learners.losses import MeanSquaredError
+
+    fuse_grad = (
+        fuse_update
+        and K == 1
+        and isinstance(loss_obj, MeanSquaredError)
+        and sampling == "RANDOM"
+        and subsample >= 1.0
+        and oblique_mode != "MHLD"  # LDA consumes w_eff pre-update
+    )
+
     def _init(y_tr, w_tr):
         y_f = y_tr.astype(jnp.float32)
         init_pred = loss_obj.initial_predictions(y_f, w_tr)  # [K]
@@ -944,6 +1008,16 @@ def _make_boost_fn(
                 jnp.zeros((num_trees, nv, K), jnp.float32),
                 jnp.zeros((num_trees,), jnp.float32),
             )
+        elif fuse_grad:
+            # Iteration 0's stats rows, with EXACTLY the ops the unfused
+            # path would run (g·(w·1), h·(w·1), w·1) so the fused loop is
+            # bit-identical from the first tree.
+            g0, h0 = loss_obj.grad_hess(y_tr, preds0)
+            w_eff0 = w_tr * jnp.ones((n,), jnp.float32)
+            stats0 = jnp.stack(
+                [g0[:, 0] * w_eff0, h0[:, 0] * w_eff0, w_eff0], axis=1
+            )
+            carry0 = (preds0, vpreds0, key0, stats0)
         else:
             carry0 = (preds0, vpreds0, key0)
         return carry0, init_pred
@@ -952,6 +1026,14 @@ def _make_boost_fn(
                    x_tr_raw=None, x_va_raw=None, set_tr=None, set_va=None,
                    vs_tr=None, vs_va=None):
         y_f = y_tr.astype(jnp.float32)
+
+        # Feature-major bins copy for the fused native route kernel,
+        # computed HERE — outside the boosting scan — so the one
+        # materialized transpose (14 MB at the bench shape) is shared by
+        # every tree and layer. Per-tree candidate blocks (oblique/VS
+        # projections) rebuild grow_bins per iteration; those configs
+        # let the grower transpose in-trace instead.
+        bins_tr_T = bins_tr.T if route_impl == "native" else None
 
         def sample_mask(k_sub, g, preds):
             """Per-example training-weight multiplier for this iteration —
@@ -1206,14 +1288,28 @@ def _make_boost_fn(
                     "t,tnk->nk", drop * tree_scale, contrib
                 )
                 preds_used = preds - dropped_sum
+            elif fuse_grad:
+                # Stats rows arrive pre-computed from the previous
+                # iteration's fused update kernel; the key evolution is
+                # kept IDENTICAL to the unfused path (k_sub is simply
+                # unused — RANDOM sampling at subsample 1.0 draws
+                # nothing from it).
+                preds, vpreds, key, stats_carry = carry
+                key, k_sub = jax.random.split(jax.random.fold_in(key, it))
+                preds_used = preds
             else:
                 preds, vpreds, key = carry
                 key, k_sub = jax.random.split(jax.random.fold_in(key, it))
                 preds_used = preds
 
-            g, h = loss_obj.grad_hess(y_tr, preds_used)  # [n, K]
-            m = sample_mask(k_sub, g, preds_used)
-            w_eff = w_tr * m
+            if fuse_grad:
+                # w_eff only feeds the per-tree projection machinery
+                # here; w_tr·1 ≡ w_tr bit for bit.
+                w_eff = w_tr
+            else:
+                g, h = loss_obj.grad_hess(y_tr, preds_used)  # [n, K]
+                m = sample_mask(k_sub, g, preds_used)
+                w_eff = w_tr * m
 
             if P > 0:
                 key, k_proj = jax.random.split(key)
@@ -1292,15 +1388,21 @@ def _make_boost_fn(
                 grow_monotone = None
 
             trees_k, leaves_k = [], []
+            fused = fuse_update or fuse_grad  # K == 1, non-DART
+            stats_next = None
             new_contrib = jnp.zeros((n, K), jnp.float32)
             new_vcontrib = jnp.zeros((nv, K), jnp.float32)
             for k in range(K):
                 kk = jax.random.fold_in(key, k)
-                stats = jnp.stack(
-                    [g[:, k] * w_eff, h[:, k] * w_eff, w_eff], axis=1
-                )
+                if fuse_grad:
+                    stats = stats_carry
+                else:
+                    stats = jnp.stack(
+                        [g[:, k] * w_eff, h[:, k] * w_eff, w_eff], axis=1
+                    )
                 res = grower.grow_tree(
                     grow_bins, stats, kk,
+                    bins_t=bins_tr_T if grow_bins is bins_tr else None,
                     rule=rule,
                     max_depth=tree_cfg.max_depth,
                     frontier=tree_cfg.frontier,
@@ -1313,11 +1415,47 @@ def _make_boost_fn(
                     monotone=grow_monotone,
                     monotone_dirs=grow_mono_dirs,
                     set_bits=set_tr,
+                    route_impl=route_impl,
+                    route_fuse=route_fuse,
                 )
                 # Leaf values scaled by shrinkage at storage time, like the
-                # reference (set_leaf applies shrinkage).
-                lv = rule.leaf_value(res.tree.leaf_stats, None) * shrinkage
-                new_contrib = new_contrib.at[:, k].set(lv[res.leaf_id, 0])
+                # reference (set_leaf applies shrinkage). The raw
+                # (unscaled) values are kept separate for the fused
+                # update kernels: XLA CPU contracts the η-multiply into
+                # the preds add as a hardware FMA (one rounding, through
+                # the gather AND through an optimization_barrier —
+                # measured; docs/row_routing.md), so train preds in the
+                # oracle are fma(raw, η, preds) while the model stores
+                # round(raw·η). The kernels take (raw, η) and replicate
+                # the host's observed contraction to stay bit-identical.
+                lv_raw = rule.leaf_value(res.tree.leaf_stats, None)
+                lv = lv_raw * shrinkage
+                if fused:
+                    # End-of-tree update as ONE fused kernel pass per
+                    # class column: preds[:, k] += lv[leaf_id], and
+                    # (squared error) the next iteration's stats rows
+                    # from the same pass — bit-identical to the
+                    # gather+mul+add(+grad) chain below. Safe inside
+                    # the k loop: g for every class was computed from
+                    # preds_used at the top of the iteration.
+                    from ydf_tpu.ops import routing_native
+
+                    if fuse_grad:
+                        p_col, stats_next = routing_native.leaf_update_grad(
+                            res.leaf_id, lv_raw[:, 0], shrinkage,
+                            preds[:, 0], y_f, w_tr
+                        )
+                    else:
+                        p_col = routing_native.leaf_update(
+                            res.leaf_id, lv_raw[:, 0], shrinkage,
+                            preds[:, k]
+                        )
+                    preds = (
+                        p_col[:, None] if K == 1
+                        else preds.at[:, k].set(p_col)
+                    )
+                else:
+                    new_contrib = new_contrib.at[:, k].set(lv[res.leaf_id, 0])
                 if nv > 0:
                     vleaves = route_tree_bins(
                         res.tree, grow_bins_va, tree_cfg.max_depth,
@@ -1325,8 +1463,19 @@ def _make_boost_fn(
                         # Stored set-feature ids are offset by the UNPADDED
                         # scalar count (see grow_tree best_f_store).
                         num_scalar=grow_num_valid,
+                        impl=route_impl,
                     )
-                    new_vcontrib = new_vcontrib.at[:, k].set(lv[vleaves, 0])
+                    if fused:
+                        vp_col = apply_leaf_values(
+                            vleaves, lv_raw[:, 0], vpreds[:, k],
+                            scale=shrinkage, impl=route_impl
+                        )
+                        vpreds = (
+                            vp_col[:, None] if K == 1
+                            else vpreds.at[:, k].set(vp_col)
+                        )
+                    else:
+                        new_vcontrib = new_vcontrib.at[:, k].set(lv[vleaves, 0])
                 trees_k.append(res.tree)
                 leaves_k.append(lv)
 
@@ -1357,7 +1506,7 @@ def _make_boost_fn(
                         + vdropped * nd * factor
                         + new_vcontrib * factor
                     )
-            else:
+            elif not fused:
                 preds = preds + new_contrib
                 if nv > 0:
                     vpreds = vpreds + new_vcontrib
@@ -1372,6 +1521,8 @@ def _make_boost_fn(
             )
             if use_dart:
                 new_carry = (preds, vpreds, key, contrib, vcontrib, tree_scale)
+            elif fuse_grad:
+                new_carry = (preds, vpreds, key, stats_next)
             else:
                 new_carry = (preds, vpreds, key)
             return new_carry, (trees, lvs, tl, vl, obl_w, obl_b, vs_a, vs_b)
@@ -1499,7 +1650,8 @@ def _train_gbt(
     oblique_mode="SPARSE", mhld_max_attributes=4, num_label_classes=1,
     monotone=None,
     x_tr_raw=None, x_va_raw=None, set_tr=None, set_va=None,
-    vs_tr=None, vs_va=None, vs_Ac=0, vs_Ap=0,
+    vs_tr=None, vs_va=None, vs_Ac=0, vs_Ap=0, route_impl="xla",
+    route_fuse=True,
     cache_dir=None, resume=False, snapshot_interval=50,
     abort_after_chunks=None, early_stop_lookahead=0, deadline=None,
 ):
@@ -1529,6 +1681,8 @@ def _train_gbt(
         num_label_classes, monotone,
         vs_Ac if vs_tr is not None else 0,
         vs_Ap if vs_tr is not None else 0,
+        route_impl=route_impl,
+        route_fuse=route_fuse,
     )
     nv_rows = bins_va.shape[0]
     data_args = (bins_tr, y_tr, w_tr, bins_va, y_va, w_va) + (
@@ -1625,6 +1779,10 @@ def _train_gbt(
                 num_valid_features, seed, sampling, goss_alpha, goss_beta,
                 selgb_ratio, dart_dropout, oblique_P, oblique_density,
                 oblique_weight_type, vs_Ac, vs_Ap,
+                # The fused-gradient path changes the carry structure, so
+                # a snapshot must never resume across routing impls.
+                route_impl,
+                route_fuse,
             )
         ).encode()
     )

@@ -84,24 +84,53 @@ def unpack_mask_bit(packed: jax.Array, bit: jax.Array) -> jax.Array:
 def grow_tree(
     bins, stats, key, *, hist_impl: str = "auto",
     hist_subtract: Optional[bool] = None,
-    hist_quant: Optional[str] = None, **kw,
+    hist_quant: Optional[str] = None,
+    route_impl: str = "auto", route_fuse: Optional[bool] = None,
+    bins_t=None, **kw,
 ):
     """Thin wrapper resolving hist_impl="auto" (plus the
-    sibling-subtraction and gradient-quantization defaults) to concrete
-    values BEFORE the jit boundary — the jitted cache must be keyed on
-    the concrete impl (see ops/histogram.py:resolve_hist_impl for
-    why)."""
+    sibling-subtraction, gradient-quantization and routing-impl
+    defaults) to concrete values BEFORE the jit boundary — the jitted
+    cache must be keyed on the concrete impl (see
+    ops/histogram.py:resolve_hist_impl for why).
+
+    `bins_t` (optional, native routing only): a pre-transposed
+    FEATURE-major u8 [F, n] copy of `bins` for the fused route kernel's
+    column-stream gather. Callers growing many trees over the SAME bins
+    matrix should pass it (learners/gbt.py hoists the transpose out of
+    the boosting scan); when absent the grower transposes in-trace."""
     from ydf_tpu.ops.histogram import (
         resolve_hist_impl,
         resolve_hist_quant,
         resolve_hist_subtract,
     )
+    from ydf_tpu.ops.routing_native import (
+        resolve_route_fuse,
+        resolve_route_impl,
+    )
 
+    route = resolve_route_impl(route_impl)
+    if route_fuse is None:
+        route_fuse = resolve_route_fuse()
+    if route == "native" and bins.shape[1] == 0:
+        # Set-features-only datasets have no bins matrix for the fused
+        # kernel to gather from; the XLA chain handles them.
+        route = "xla"
+    if route == "native":
+        from ydf_tpu.config import is_tpu_backend
+
+        if is_tpu_backend():
+            # The fused kernel is a CPU custom call; on TPU the XLA
+            # chain is the (fused-by-XLA) path.
+            route = "xla"
     return _grow_tree_jit(
         bins, stats, key,
         hist_impl=resolve_hist_impl(hist_impl),
         hist_subtract=resolve_hist_subtract(hist_subtract),
         hist_quant=resolve_hist_quant(hist_quant),
+        route_impl=route,
+        route_fuse=route_fuse,
+        bins_t=bins_t if route == "native" else None,
         **kw,
     )
 
@@ -112,7 +141,8 @@ def grow_tree(
         "rule", "max_depth", "frontier", "max_nodes", "num_bins",
         "num_numerical", "min_examples", "min_split_gain",
         "candidate_features", "num_valid_features", "hist_impl",
-        "hist_subtract", "hist_quant", "monotone",
+        "hist_subtract", "hist_quant", "route_impl", "route_fuse",
+        "monotone",
     ),
 )
 def _grow_tree_jit(
@@ -157,6 +187,23 @@ def _grow_tree_jit(
     # contraction is not histogram-dominated; staying on one grid keeps
     # parent − prefix consistent).
     hist_quant: str = "f32",
+    # Example-routing impl for the per-layer slot/leaf update: "xla"
+    # (default — the exact oracle chain of gathers/selects) or "native"
+    # (the fused ydf_route_update CPU kernel, one multithreaded pass per
+    # layer that also emits the next layer's histogram slots;
+    # bit-identical by construction — docs/row_routing.md). Resolved by
+    # the grow_tree wrapper from YDF_TPU_ROUTE_IMPL.
+    route_impl: str = "xla",
+    # Whether native routing may fuse into the native histogram kernel
+    # (YDF_TPU_ROUTE_FUSE, default on; resolved by the wrapper). The
+    # unfused native path keeps one standalone route_update pass per
+    # layer — same bits either way, measurably different wall on hosts
+    # whose LLC hides XLA's inter-pass traffic (docs/row_routing.md).
+    route_fuse: bool = True,
+    # Pre-transposed feature-major u8 [F, n] copy of `bins` for the
+    # native route kernel (see the grow_tree wrapper docstring);
+    # ignored unless route_impl == "native".
+    bins_t: Optional[jax.Array] = None,
     rule_ctx: Any = None,
     # Per-feature monotone directions (+1 / -1 / 0), static tuple of
     # length F or None. A cut on a +1 feature is only valid when the
@@ -187,6 +234,27 @@ def _grow_tree_jit(
         )
     n, F = bins.shape
     S = stats.shape[1]
+    # Feature-major bins copy for the STANDALONE native route kernel —
+    # one traced value shared by the layers that still need it (per-TREE
+    # transpose when no hoisted copy arrives; learners/gbt.py hoists it
+    # out of the whole boosting scan).
+    binsT = None
+    if route_impl == "native" and F > 0:
+        binsT = bins_t if bins_t is not None else bins.T
+    # Fully-fused mode (docs/row_routing.md): when BOTH the histogram
+    # and the routing run native, each layer's histogram kernel applies
+    # the previous layer's splits per row on the fly (the route step
+    # rides the bins row already streaming for the contraction) — the
+    # standalone per-layer routing pass exists only for the LAST layer,
+    # where no histogram follows. bf16x2 stats keep the unfused
+    # native-route path (no fused bf16 kernel).
+    fuse_route = (
+        route_fuse
+        and route_impl == "native"
+        and hist_impl == "native"
+        and hist_quant in ("f32", "int8")
+        and F > 0
+    )
     L, B, N = frontier, num_bins, max_nodes
     Fn = F if num_numerical is None else num_numerical
     Fc = F - Fn
@@ -292,13 +360,18 @@ def _grow_tree_jit(
             stats_set = stats
 
     # Sibling-subtraction scan state, carried across the (unrolled) layer
-    # loop: (parent_hist [Lh, F, B, S], hslot_map [L+1], small_is_left
-    # [Lh], Lh). hslot_map sends an example's frontier slot to its
-    # histogram slot: split-rank s when the example sits in split s's
-    # SMALLER child, the trash slot Lh otherwise — so the next layer's
-    # histogram is built over ≤ ceil(Ld/2) live slots and larger-child
-    # rows are skippable by every backend.
+    # loop: (parent_hist [Lh, F, B, S], hist_slot [n], small_is_left
+    # [Lh], Lh). hist_slot is each example's histogram slot for the
+    # layer: split-rank s when the example sits in split s's SMALLER
+    # child, the trash slot Lh otherwise — so the layer's histogram is
+    # built over ≤ ceil(Ld/2) live slots and larger-child rows are
+    # skippable by every backend. The XLA route computes it as
+    # hmap[new_slot]; the native route kernel emits it from the same
+    # fused pass over rows.
     sub_state = None
+    # Fully-fused routing: the previous layer's decision tables, applied
+    # per row by this layer's fused histogram kernel (None at the root).
+    route_ctx = None
 
     # Trash-row compaction capacity for the XLA-CPU segment impl: under
     # sibling subtraction the live (smaller-child) rows are at most
@@ -338,12 +411,25 @@ def _grow_tree_jit(
             # larger sibling as parent − child. The matmul/segment/pallas
             # contraction width halves; the native kernel early-continues
             # the trash rows.
-            parent_hist, hslot_map, small_is_left, Lh = sub_state
-            hist_small = histogram(
-                bins, hslot_map[slot], hist_stats, num_slots=Lh,
-                num_bins=B, impl=hist_impl, quant=hist_quant,
-                quant_scale=qscale, compact=_compact_cap(Lh),
-            )  # [Lh, F, B, S] (dequantized f32 under quantization)
+            parent_hist, hslot_e, small_is_left, Lh = sub_state
+            if fuse_route:
+                # Fully-fused: the kernel routes each row through the
+                # PREVIOUS layer's splits (route_ctx) and accumulates
+                # its histogram slot in the same pass — hslot_e was
+                # never materialized (docs/row_routing.md).
+                from ydf_tpu.ops import routing_native
+
+                hist_small, slot, leaf_id = routing_native.histogram_routed(
+                    bins, slot, leaf_id, *route_ctx,
+                    stats=hist_stats, num_slots=Lh, num_bins=B,
+                    quant_scale=qscale,
+                )
+            else:
+                hist_small = histogram(
+                    bins, hslot_e, hist_stats, num_slots=Lh,
+                    num_bins=B, impl=hist_impl, quant=hist_quant,
+                    quant_scale=qscale, compact=_compact_cap(Lh),
+                )  # [Lh, F, B, S] (dequantized f32 under quantization)
             hist_big = parent_hist - hist_small
             sil = small_is_left[:, None, None, None, None]
             # Split s's children live at slots (2s, 2s+1) = (left, right).
@@ -356,6 +442,18 @@ def _grow_tree_jit(
                 hist = jnp.pad(
                     hist, ((0, Ld - 2 * Lh), (0, 0), (0, 0), (0, 0))
                 )
+            csum_num = jnp.cumsum(hist[:, :Fn], axis=2)  # [Ld, Fn, B, S]
+        elif fuse_route and depth > 0:
+            # Subtraction off, fused: route the previous layer's splits
+            # and histogram the resulting frontier slots in one pass
+            # (identity hmap — hist slot == frontier slot).
+            from ydf_tpu.ops import routing_native
+
+            hist, slot, leaf_id = routing_native.histogram_routed(
+                bins, slot, leaf_id, *route_ctx,
+                stats=hist_stats, num_slots=Ld, num_bins=B,
+                quant_scale=qscale,
+            )
             csum_num = jnp.cumsum(hist[:, :Fn], axis=2)  # [Ld, Fn, B, S]
         else:
             hist = histogram(
@@ -622,39 +720,13 @@ def _grow_tree_jit(
         tree["leaf_stats"] = tree["leaf_stats"].at[right_id].set(right_stats)
         num_nodes = num_nodes + 2 * jnp.sum(do_split.astype(i32))
 
-        # ---- route examples --------------------------------------------- #
-        # Pad per-slot decision arrays from Ld up to L+1 so they can be
-        # indexed by `slot` (values in [0, Ld) ∪ {L}; L = inactive).
-        pad = lambda a, fill: jnp.concatenate(
-            [a, jnp.full((L + 1 - Ld,) + a.shape[1:], fill, a.dtype)], 0
-        )
-        split_e = pad(do_split, False)[slot]
-        bf_e = pad(best_f, 0)[slot]
-        if F > 0:
-            bin_e = jnp.take_along_axis(
-                bins, jnp.clip(bf_e, 0, F - 1)[:, None].astype(i32), axis=1
-            )[:, 0].astype(i32)
-            # Flat 1-D gather — do NOT index [slot] then [bin]: that would
-            # materialize an [n, B] intermediate.
-            glb_flat = pad(go_left_bins, False).reshape(-1)
-            go_left_e = glb_flat[slot * B + bin_e]
-        else:
-            go_left_e = jnp.zeros((n,), jnp.bool_)
-        if Fs > 0:
-            is_set_e = pad(is_set_split, False)[slot]
-            fset_e = jnp.clip(pad(fset, 0)[slot], 0, Fs - 1)[:, None]
-            dir_e = pad(set_dir, False)[slot]
-            rm0 = jnp.take_along_axis(rank_min_dirs[0], fset_e, axis=1)[:, 0]
-            rm1 = jnp.take_along_axis(rank_min_dirs[1], fset_e, axis=1)[:, 0]
-            rm_e = jnp.where(dir_e, rm1, rm0)
-            t_e = pad(best_t, 0)[slot]
-            # Not-contains (min rank beyond the cut) → LEFT.
-            go_left_e = jnp.where(is_set_e, rm_e > t_e, go_left_e)
-        child_id_e = jnp.where(
-            go_left_e, pad(left_id, N)[slot], pad(right_id, N)[slot]
-        )
-        leaf_id = jnp.where(split_e, child_id_e, leaf_id)
-
+        # ---- sibling-subtraction bookkeeping for the NEXT layer --------- #
+        # Computed BEFORE routing so the fused native kernel can emit
+        # the next layer's histogram slots in the same pass over rows
+        # (the smaller-child flags and the slot→hist-slot map must come
+        # from the same decisions the routing applies).
+        next_sub = None
+        hmap = None
         if children_in_frontier:
             Lh_next = min(Ld, L // 2)  # static bound on this layer's splits
             if hist_subtract and F > 0 and Lh_next >= 1:
@@ -686,13 +758,127 @@ def _grow_tree_jit(
                     jnp.where(do_split & ~small_left, split_rank, Lh_next)
                 )
                 hmap = hmap.at[L].set(Lh_next)
-                sub_state = (parent_next, hmap, small_is_left_next, Lh_next)
-            else:
-                sub_state = None
-            child_slot_e = jnp.where(
-                go_left_e, 2 * pad(split_rank, 0)[slot], 2 * pad(split_rank, 0)[slot] + 1
+                next_sub = (parent_next, small_is_left_next, Lh_next)
+
+        # ---- route examples --------------------------------------------- #
+        # Pad per-slot decision arrays from Ld up to L+1 so they can be
+        # indexed by `slot` (values in [0, Ld) ∪ {L}; L = inactive).
+        pad = lambda a, fill: jnp.concatenate(
+            [a, jnp.full((L + 1 - Ld,) + a.shape[1:], fill, a.dtype)], 0
+        )
+        # The bins column of the chosen split: the raw best_f indexes the
+        # EXPANDED candidate columns (O orderings per categorical, two
+        # direction columns per set feature), so routing must gather the
+        # collapsed best_f_scalar column. (With O > 1 the raw index used
+        # to be clipped into a NEIGHBORING feature's column — a
+        # train-time mis-route for multiclass forests with 2+ categorical
+        # features; tests/test_routing_native.py has the regression.)
+        route_f = jnp.clip(best_f_scalar, 0, max(F - 1, 0))
+        if Fs > 0:
+            # Per-example set-split decision (shared by both routing
+            # impls): not-contains (min rank beyond the cut) → LEFT.
+            is_set_e = pad(is_set_split, False)[slot]
+            fset_e = jnp.clip(pad(fset, 0)[slot], 0, Fs - 1)[:, None]
+            dir_e = pad(set_dir, False)[slot]
+            rm0 = jnp.take_along_axis(rank_min_dirs[0], fset_e, axis=1)[:, 0]
+            rm1 = jnp.take_along_axis(rank_min_dirs[1], fset_e, axis=1)[:, 0]
+            rm_e = jnp.where(dir_e, rm1, rm0)
+            t_e = pad(best_t, 0)[slot]
+            set_go_left_e = rm_e > t_e
+
+        if route_impl == "native" and F > 0:
+            # Native routing. The per-slot decision tables follow one
+            # padded [L+1] contract shared by the standalone
+            # ydf_route_update kernel and the fused histogram+routing
+            # kernels (docs/row_routing.md).
+            from ydf_tpu.ops import routing_native
+
+            hmap_k = (
+                hmap if hmap is not None
+                else jnp.arange(L + 1, dtype=i32)  # identity: no remap
             )
-            slot = jnp.where(split_e, child_slot_e, L)
+            set_gl_k = (
+                set_go_left_e.astype(jnp.uint8) if Fs > 0
+                else jnp.zeros((1,), jnp.uint8)
+            )
+            tables = (
+                pad(do_split, False), pad(route_f, 0),
+                pad(go_left_bins, False),
+                pad(left_id, N), pad(right_id, N),
+                pad(split_rank, 0), hmap_k,
+                pad(is_set_split, False), set_gl_k,
+            )
+            if fuse_route and children_in_frontier:
+                # Fully-fused mode: this layer's routing is applied by
+                # the NEXT layer's histogram kernel in its own row walk
+                # — just carry the decision tables.
+                route_ctx = tables
+            else:
+                # Last layer (or unfused native): one standalone
+                # multithreaded pass over rows — slot lookup, bin
+                # gather, left/right decision, child slot + node id,
+                # next layer's hist slot (hmap composed in-kernel) —
+                # bit-identical to the XLA chain below.
+                new_slot, new_leaf, hist_slot_e, _counts = (
+                    routing_native.route_update(binsT, slot, leaf_id,
+                                                *tables)
+                )
+                leaf_id = new_leaf
+        else:
+            split_e = pad(do_split, False)[slot]
+            rf_e = pad(route_f, 0)[slot]
+            if F > 0:
+                bin_e = jnp.take_along_axis(
+                    bins, rf_e[:, None].astype(i32), axis=1
+                )[:, 0].astype(i32)
+                # Flat 1-D gather — do NOT index [slot] then [bin]: that
+                # would materialize an [n, B] intermediate.
+                glb_flat = pad(go_left_bins, False).reshape(-1)
+                go_left_e = glb_flat[slot * B + bin_e]
+            else:
+                go_left_e = jnp.zeros((n,), jnp.bool_)
+            if Fs > 0:
+                go_left_e = jnp.where(is_set_e, set_go_left_e, go_left_e)
+            child_id_e = jnp.where(
+                go_left_e, pad(left_id, N)[slot], pad(right_id, N)[slot]
+            )
+            leaf_id = jnp.where(split_e, child_id_e, leaf_id)
+            if children_in_frontier:
+                child_slot_e = jnp.where(
+                    go_left_e,
+                    2 * pad(split_rank, 0)[slot],
+                    2 * pad(split_rank, 0)[slot] + 1,
+                )
+                new_slot = jnp.where(split_e, child_slot_e, L)
+                hist_slot_e = (
+                    hmap[new_slot] if hmap is not None else new_slot
+                )
+
+        if children_in_frontier:
+            if fuse_route:
+                # slot/leaf_id update deferred into the next layer's
+                # fused histogram call; sub_state carries no per-example
+                # hist slot (the kernel computes it in-register).
+                if next_sub is not None:
+                    parent_next, small_is_left_next, Lh_next = next_sub
+                    sub_state = (
+                        parent_next, None, small_is_left_next, Lh_next
+                    )
+                else:
+                    sub_state = None
+            else:
+                slot = new_slot
+                # sub_state carries the PER-EXAMPLE histogram slot of
+                # the next layer (both impls compute hmap[new_slot]; the
+                # native kernel emits it from the same fused pass).
+                if next_sub is not None:
+                    parent_next, small_is_left_next, Lh_next = next_sub
+                    sub_state = (
+                        parent_next, hist_slot_e, small_is_left_next,
+                        Lh_next
+                    )
+                else:
+                    sub_state = None
             # New frontier: children packed at slots [0, 2·#splits).
             tgt_l = jnp.where(do_split, 2 * split_rank, L)
             tgt_r = jnp.where(do_split, 2 * split_rank + 1, L)

@@ -23,11 +23,23 @@ deep in the systolic array's efficient regime (C = 1024 by default).
 The slot one-hot zero-fills trash rows (slot == L: inactive or padded
 examples — and, under the grower's sibling-subtraction mode, every
 larger-child row), which either land in a padded column (sliced off by
-the wrapper) or outside the iota range entirely. Subtraction halves the
-live slot count L per layer; since the slot axis pads to Lp = 128
-lanes, the dot shape only shrinks once L exceeds 128 — the win on this
-backend is the halved [L, F, B, S] output block and psum payload, while
-HBM traffic already sits at the bins+stats re-read floor.
+the wrapper) or outside the iota range entirely.
+
+Sub-128-lane slot packing (ROADMAP item, PR 4): the dot's lane
+dimension is the slot axis, and the MXU issues full 128-lane passes no
+matter how few are live — so a sibling-subtraction layer with L = 32
+live slots used to waste 3/4 of every pass ([B, C] @ [C, 128] with 96
+dead lanes, once per stat column). When L <= 64 the kernel now packs
+G = 128 // L STAT columns into one lane dimension (lane j = k·L + l
+holds stat column g·G + k, slot l) and issues ceil(S/G) dots per
+feature instead of S — at the bench shape (L = 32, S = 3, G >= 3) the
+subtraction layers collapse to ONE full-width dot per feature, a 3x
+MXU-issue reduction that finally realizes the slot-halving win on this
+backend (the halved [L, F, B, S] output block and psum payload were
+already real). Lane packing permutes lanes only — each output element
+is the same [B, C] x [C, 128] contraction — so results stay
+bit-identical to the unpacked path. Layers with L > 64 keep the
+original per-stat dots.
 
 Operand precision follows stats.dtype (the quantized-gradient pipeline
 in ops/histogram.py hands this kernel the already-split/quantized
@@ -107,6 +119,56 @@ def _hist_kernel(
             out_ref[f, s, :, :] += h
 
 
+def _hist_kernel_packed(
+    bins_ref, slot_ref, stats_ref, out_ref, *, Fb, S, B, L, G, Sg,
+    op_dtype, acc_dtype,
+):
+    """Slot-packed variant for L <= 64 live slots: lane j = k·L + l of
+    group g carries (stat column g·G + k, slot l), so one [B, C] @
+    [C, 128] dot covers G stat columns at full lane utilization instead
+    of G dots with 128 − L dead lanes each (module docstring).
+
+    out_ref [Fb, Sg, B, 128]; the wrapper unpacks lanes back to
+    [L, F, B, S]. Trash rows (slot == L) match no packed lane — block
+    k's lanes only accept slot values in [0, L).
+    """
+    c_step = pl.program_id(1)
+
+    @pl.when(c_step == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    C = bins_ref.shape[1]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (128, C), 0)
+    slot_b = slot_ref[...]  # [1, C] broadcasts against [128, C]
+    zero = jnp.zeros((), op_dtype)
+    biotaT = jax.lax.broadcasted_iota(jnp.int32, (B, C), 0)
+    for f in range(Fb):
+        ohT = (bins_ref[f : f + 1, :] == biotaT).astype(op_dtype)  # [B,C]
+        for g in range(Sg):
+            # aT[k·L + l, c] = stats[g·G + k, c] when slot[c] == l (and
+            # the column exists), else 0 — the select keeps the product
+            # exact in every op_dtype, including int8.
+            aT = None
+            for k in range(G):
+                s = g * G + k
+                if s >= S:
+                    break
+                # Upper bound is load-bearing: without it lane (k+1)·L
+                # would satisfy lane − k·L == L and absorb block k's
+                # TRASH rows into the next block's slot-0 lane. The
+                # lower bound is implicit (slot >= 0 never equals a
+                # negative lane − k·L).
+                m = (slot_b == (lane - k * L)) & (lane < (k + 1) * L)
+                part = jnp.where(m, stats_ref[s : s + 1, :], zero)
+                aT = part if aT is None else aT + part
+            h = jax.lax.dot_general(
+                ohT, aT, (((1,), (1,)), ((), ())),
+                preferred_element_type=acc_dtype,
+            )  # [B, 128]
+            out_ref[f, g, :, :] += h
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -129,6 +191,12 @@ def histogram_pallas(
     S = stats.shape[1]
     L, B = num_slots, num_bins
     Lp = _round_up(max(L, 1), 128)
+    # Sub-128-lane slot packing (module docstring): when the live slot
+    # count fits 2+ times into the 128-lane dim, pack G stat columns per
+    # dot and issue Sg = ceil(S/G) dots per feature instead of S.
+    G = min(S, 128 // max(L, 1)) if L >= 1 else 1
+    packed = G >= 2
+    Sg = -(-S // G) if packed else S
 
     # Operand/accumulator precision follows stats.dtype (see module
     # docstring): bf16 halves accumulate f32; int8 contracts into int32.
@@ -139,9 +207,10 @@ def histogram_pallas(
     else:
         op_dtype, acc_dtype = jnp.float32, jnp.float32
 
+    out_L = 128 if packed else Lp
     if feature_block is None:
         # Keep the resident output block around ~6 MB of VMEM.
-        per_f = S * B * Lp * 4
+        per_f = Sg * B * out_L * 4
         feature_block = max(1, min(F, (6 << 20) // max(per_f, 1)))
     Fb = feature_block
     Fp = _round_up(F, Fb)
@@ -154,16 +223,24 @@ def histogram_pallas(
     if n_pad != n:
         bins_i = jnp.pad(bins_i, ((0, n_pad - n), (0, 0)))
         # Padded examples fall in the trash slot -> all-zero one-hot row
-        # (or the sliced padded row when L < Lp).
+        # (or the sliced padded row when L < Lp; packed lanes never
+        # match slot == L at all).
         slot = jnp.pad(slot, (0, n_pad - n), constant_values=L)
         stats = jnp.pad(stats, ((0, n_pad - n), (0, 0)))
 
-    grid = (Fp // Fb, n_pad // chunk)
-    out = pl.pallas_call(
-        functools.partial(
+    if packed:
+        kernel = functools.partial(
+            _hist_kernel_packed, Fb=Fb, S=S, B=B, L=L, G=G, Sg=Sg,
+            op_dtype=op_dtype, acc_dtype=acc_dtype,
+        )
+    else:
+        kernel = functools.partial(
             _hist_kernel, Fb=Fb, S=S, B=B, Lp=Lp, op_dtype=op_dtype,
             acc_dtype=acc_dtype,
-        ),
+        )
+    grid = (Fp // Fb, n_pad // chunk)
+    out = pl.pallas_call(
+        kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((Fb, chunk), lambda fb, c: (fb, c)),
@@ -171,15 +248,24 @@ def histogram_pallas(
             pl.BlockSpec((S, chunk), lambda fb, c: (0, c)),
         ],
         out_specs=pl.BlockSpec(
-            (Fb, S, B, Lp), lambda fb, c: (fb, 0, 0, 0)
+            (Fb, Sg, B, out_L), lambda fb, c: (fb, 0, 0, 0)
         ),
-        out_shape=jax.ShapeDtypeStruct((Fp, S, B, Lp), acc_dtype),
+        out_shape=jax.ShapeDtypeStruct((Fp, Sg, B, out_L), acc_dtype),
         interpret=interpret,
     )(
         bins_i.T,
         slot.astype(jnp.int32)[None, :],
         stats.astype(op_dtype).T,
     )
+
+    if packed:
+        # Unpack lanes: stat column s lives in group s // G at lane
+        # offset (s % G)·L. [Fp, Sg, B, 128] -> [L, F, B, S].
+        cols = []
+        for s in range(S):
+            g, k = divmod(s, G)
+            cols.append(out[:F, g, :, k * L : k * L + L])  # [F, B, L]
+        return jnp.transpose(jnp.stack(cols, axis=0), (3, 1, 2, 0))
 
     # [Fp, S, B, Lp] -> [L, F, B, S]
     return jnp.transpose(out[:F, :, :, :L], (3, 0, 2, 1))

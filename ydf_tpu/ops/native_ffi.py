@@ -33,6 +33,29 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
 NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 BUILD_DIR = os.path.join(NATIVE_DIR, "build")
 
+# Optional sanitizer builds for ALL native kernels — correctness tooling
+# for every native PR (tests/test_native_sanitize.py drives the kernels
+# under it in a subprocess). Resolved EAGERLY per build/load so a typo
+# fails at the env boundary, not as a silent normal build.
+_SANITIZE_MODES = {"asan": ("-fsanitize=address",),
+                   "ubsan": ("-fsanitize=undefined",
+                             "-fno-sanitize-recover=undefined")}
+
+
+def sanitize_mode():
+    """YDF_TPU_NATIVE_SANITIZE ∈ {asan, ubsan} selects a sanitizer build
+    (separate .so name, so it never clobbers — or staleness-races — the
+    normal build); empty/unset means the plain -O3 build."""
+    env = os.environ.get("YDF_TPU_NATIVE_SANITIZE", "").strip().lower()
+    if env in ("", "0", "off", "none"):
+        return None
+    if env not in _SANITIZE_MODES:
+        raise ValueError(
+            f"YDF_TPU_NATIVE_SANITIZE={env!r} is not a sanitizer mode; "
+            f"expected one of {sorted(_SANITIZE_MODES)} (or unset)"
+        )
+    return env
+
 
 def ffi_module():
     """jax's FFI namespace across versions: `jax.ffi` (>= 0.5) or
@@ -84,6 +107,15 @@ class NativeLibrary:
         self.srcs = tuple(os.path.join(NATIVE_DIR, s) for s in names)
         self.src = self.srcs[0]  # primary source, used in warnings
         self.deps = tuple(os.path.join(NATIVE_DIR, d) for d in extra_deps)
+        # Sanitizer builds get their own .so name: a -fsanitize build
+        # must never overwrite the normal library (or constantly re-mark
+        # it stale for tier-1); resolved once at library-object creation,
+        # i.e. set YDF_TPU_NATIVE_SANITIZE before the first ydf_tpu
+        # import of the process (the sanitize test uses a subprocess).
+        self.sanitize = sanitize_mode()
+        if self.sanitize:
+            base, ext = os.path.splitext(lib_name)
+            lib_name = f"{base}.{self.sanitize}{ext}"
         self.lib_path = os.path.join(BUILD_DIR, lib_name)
         self.ffi_targets = dict(ffi_targets or {})
         self.extra_cflags = tuple(extra_cflags)
@@ -129,6 +161,9 @@ class NativeLibrary:
         if missing:
             raise FileNotFoundError(missing[0])
         cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC"]
+        if self.sanitize:
+            cmd += list(_SANITIZE_MODES[self.sanitize])
+            cmd += ["-g", "-fno-omit-frame-pointer"]
         cmd += list(self.extra_cflags)
         cmd += ["-I", NATIVE_DIR]
         if self.needs_ffi_headers:
@@ -190,20 +225,28 @@ class NativeLibrary:
             return self._ffi_registered
 
 
-# The training kernels (histogram f32 + int8-quantized, binning) are
-# compiled TOGETHER into one shared library so they share the lazily
-# created persistent worker pool in native/thread_pool.h (per-call
-# std::thread spawn/join was a measurable fixed cost at the boosting
-# loop's call rate — ROADMAP open item). The pool's lifetime is this
-# loaded module's; YDF_TPU_HIST_THREADS sizes it at first use, and the
-# per-call env resolutions still bound each call's task wave.
+# The training kernels (histogram f32 + int8-quantized, binning, and
+# the row-routing/prediction-update family) are compiled TOGETHER into
+# one shared library so they share the lazily created persistent worker
+# pool in native/thread_pool.h (per-call std::thread spawn/join was a
+# measurable fixed cost at the boosting loop's call rate — ROADMAP open
+# item). The pool's lifetime is this loaded module's; YDF_TPU_HIST_THREADS
+# sizes it at first use, and the per-call env resolutions
+# (YDF_TPU_HIST_THREADS / YDF_TPU_BIN_THREADS / YDF_TPU_ROUTE_THREADS)
+# still bound each call's task wave.
 KERNELS_LIB = NativeLibrary(
-    src_name=("histogram_ffi.cc", "binning_ffi.cc"),
+    src_name=("histogram_ffi.cc", "binning_ffi.cc", "routing_ffi.cc"),
     lib_name="libydfkernels.so",
     ffi_targets={
         "ydf_histogram": "YdfHistogram",
         "ydf_histogram_q8": "YdfHistogramQ8",
+        "ydf_histogram_routed": "YdfHistogramRouted",
+        "ydf_histogram_q8_routed": "YdfHistogramQ8Routed",
         "ydf_binning": "YdfBinning",
+        "ydf_route_update": "YdfRouteUpdate",
+        "ydf_leaf_update": "YdfLeafUpdate",
+        "ydf_leaf_update_grad": "YdfLeafUpdateGrad",
+        "ydf_route_tree": "YdfRouteTree",
     },
     extra_cflags=("-pthread",),
     extra_deps=("thread_pool.h",),

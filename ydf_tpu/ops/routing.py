@@ -48,12 +48,25 @@ def route_tree_bins(
     tree, bins: jax.Array, max_depth: int,
     x_set: Optional[jax.Array] = None,
     num_scalar: Optional[int] = None,
+    impl: str = "xla",
 ) -> jax.Array:
     """Leaf node id per example. tree: TreeArrays-like (single tree).
     `x_set`: packed multi-hot set features uint32 [n, Fs, W]. Set features
-    sit after the scalar features in the node feature-id space —
-    `num_scalar` gives that offset when the bins matrix carries trailing
-    pad columns (feature-parallel padding); default = bins.shape[1].
+    sit after the scalar features in the node feature-id space, and the
+    grower stores their ids offset by the UNPADDED scalar-column count
+    (grow_tree `best_f_store`). `num_scalar` gives that offset; the
+    default bins.shape[1] is only correct when the bins matrix carries
+    no trailing pad columns — under feature-parallel padding (mesh
+    feature axis > 1) the matrix is wider than the stored offset, so
+    callers MUST pass the unpadded count explicitly (learners/gbt.py
+    passes `grow_num_valid`; tests/test_routing_native.py has the
+    trailing-pad-columns regression).
+
+    `impl` selects the formulation: "xla" (default — the fori_loop of
+    whole-array gathers below) or "native" (the fused one-pass tree-walk
+    kernel native/routing_ffi.cc:ydf_route_tree, bit-identical; CPU
+    only, resolved by the caller via
+    ops/routing_native.py:resolve_route_impl).
 
     Does NOT support oblique nodes (projections are not part of the input
     bin matrix) — oblique forests must route in value mode."""
@@ -70,6 +83,17 @@ def route_tree_bins(
             "use value-mode routing (forest_predict_values)"
         )
     n, Fb = bins.shape
+    if impl == "native":
+        from ydf_tpu.ops import routing_native
+
+        is_set = getattr(tree, "is_set", None)
+        if is_set is None:
+            is_set = jnp.zeros_like(tree.is_cat)
+        return routing_native.route_tree(
+            bins, tree.feature, tree.threshold_bin, tree.is_cat, is_set,
+            tree.cat_mask, tree.left, tree.right, tree.is_leaf,
+            max_depth, x_set=x_set, num_scalar=num_scalar,
+        )
 
     def body(_, node):
         f = jnp.maximum(tree.feature[node], 0)
@@ -97,6 +121,32 @@ def route_tree_bins(
     # graph size independent of depth — best-first-grown trees can be
     # 50+ deep, which would explode an unrolled trace.
     return jax.lax.fori_loop(0, max_depth, body, jnp.zeros((n,), i32))
+
+
+def apply_leaf_values(
+    leaf_id: jax.Array,         # int32 [n]
+    leaf_value_raw: jax.Array,  # f32 [N] UNSCALED value per node
+    preds: jax.Array,           # f32 [n]
+    scale: float = 1.0,
+    impl: str = "xla",
+) -> jax.Array:
+    """preds + (leaf_value_raw·scale)[leaf_id] — the boosting loop's
+    per-tree prediction update, shared by the training-set and
+    validation-set paths (learners/gbt.py). The leaf values arrive
+    UNSCALED with the shrinkage factor separate because XLA CPU
+    contracts the scale-multiply into the add as a hardware FMA (one
+    rounding, straight through the gather — docs/row_routing.md);
+    impl="native" runs the fused ydf_leaf_update kernel, which
+    replicates whichever contraction behavior the host's XLA exhibits
+    (routing_native.update_uses_fma probe) so both impls stay
+    bit-identical."""
+    if impl == "native":
+        from ydf_tpu.ops import routing_native
+
+        return routing_native.leaf_update(
+            leaf_id, leaf_value_raw, scale, preds
+        )
+    return preds + (leaf_value_raw * jnp.float32(scale))[leaf_id]
 
 
 def _vs_tree_projections(tree, x_vs_vals, x_vs_len):

@@ -141,6 +141,32 @@ def reset_native_hist_kernel_counters() -> None:
     histogram_native.reset_kernel_counters()
 
 
+def native_route_kernel_seconds() -> float:
+    """Cumulative wall seconds spent INSIDE the native routing custom
+    calls (per-layer ydf_route_update + full-tree ydf_route_tree) —
+    the non-histogram in-loop attribution for the CPU path, measured by
+    the kernels themselves (native/routing_ffi.cc counters; bench.py's
+    route_s). 0.0 when the native kernels are unavailable."""
+    from ydf_tpu.ops import routing_native
+
+    return routing_native.route_kernel_seconds()
+
+
+def native_update_kernel_seconds() -> float:
+    """Cumulative wall seconds spent INSIDE the native prediction-update
+    custom calls (ydf_leaf_update + ydf_leaf_update_grad; bench.py's
+    update_s). 0.0 when the native kernels are unavailable."""
+    from ydf_tpu.ops import routing_native
+
+    return routing_native.update_kernel_seconds()
+
+
+def reset_native_route_kernel_counters() -> None:
+    from ydf_tpu.ops import routing_native
+
+    routing_native.reset_kernel_counters()
+
+
 def format_profile(profile: Optional[Dict[str, float]]) -> str:
     """One-line human summary, largest stages first."""
     if not profile:

@@ -358,6 +358,50 @@ def _analytic_hist_flops(n, F, max_depth, num_bins, S=3, L=1024,
     return total
 
 
+def pallas_lane_packing_summary(
+    n: int = 500_000, F: int = 28, max_depth: int = 6, num_bins: int = 256,
+    S: int = 3, frontier_cap: int = 1024,
+):
+    """Per-layer MXU ISSUE accounting for the Pallas kernel's sub-128-lane
+    slot packing (ops/histogram_pallas.py, ROADMAP item closed in PR 4).
+
+    The MXU issues full 128-lane passes regardless of how few slot lanes
+    are live, so the relevant cost is issued lane-FLOPs, not MACs:
+    2·n·B·128 per (feature, dot). Unpacked, every layer issues S dots
+    per feature; packed, a layer with L <= 64 live slots issues
+    ceil(S / (128 // L)) — at the bench shape the sibling-subtraction
+    layers (L = 1..16 live after halving) collapse to one dot per
+    feature. The MAC-based roofline (tpu_projection) is unchanged by
+    packing; this summary shows the issue-level win it unlocks."""
+    frontier = min(2 ** max(max_depth - 1, 0), frontier_cap)
+    per_layer = []
+    issued_unpacked = issued_packed = 0.0
+    for d in range(max_depth):
+        if d > 0:
+            L = max(1, min(2 ** (d - 1), frontier // 2))  # subtraction
+        else:
+            L = 1
+        G = min(S, 128 // L) if L <= 64 else 1
+        dots_unpacked = S
+        dots_packed = -(-S // G)
+        lane_flops = 2.0 * n * num_bins * 128 * F
+        issued_unpacked += dots_unpacked * lane_flops
+        issued_packed += dots_packed * lane_flops
+        per_layer.append({
+            "depth": d, "live_slots": L, "pack_G": G,
+            "dots_per_feature_unpacked": dots_unpacked,
+            "dots_per_feature_packed": dots_packed,
+        })
+    return {
+        "config": {"n": n, "F": F, "max_depth": max_depth,
+                   "num_bins": num_bins, "S": S},
+        "per_layer": per_layer,
+        "issued_lane_flops_per_tree_unpacked": issued_unpacked,
+        "issued_lane_flops_per_tree_packed": issued_packed,
+        "issue_reduction": round(issued_unpacked / issued_packed, 3),
+    }
+
+
 # MXU issue cost per histogram MAC, in native-bf16-pass units, by stats
 # operand precision (docs/histogram_quantization.md has the derivation):
 #   f32     Mosaic decomposes an f32×f32 dot into bf16 passes (hi·hi +
@@ -513,6 +557,10 @@ def write_artifacts(outdir: str | Path, full_scale: bool = True) -> dict:
         q: tpu_projection(cost=cost, hist_quant=q)
         for q in ("f32", "bf16x2", "int8")
     }
+    # Sub-128-lane slot packing (PR 4): MXU issue accounting the
+    # MAC-based projection can't see — the per-layer dot-count collapse
+    # on sibling-subtraction layers.
+    summary["pallas_slot_packing"] = pallas_lane_packing_summary()
     (outdir / "summary.json").write_text(json.dumps(summary, indent=2))
     return summary
 
