@@ -1,0 +1,577 @@
+"""Chaos suite: randomized and deterministic fault schedules driven
+through the failpoint registry (utils/failpoints.py), asserting the
+recovery paths hold the repo's equality bar — a resumed/retried run is
+BIT-IDENTICAL to the fault-free run (the same bar the histogram/routing
+kernels meet).
+
+Layout (the `chaos` marker spans all of it):
+  * deterministic one-shot schedules — tier-1 (fast, no subprocess);
+  * SIGKILL/SIGTERM of a real training subprocess and seeded randomized
+    schedules — additionally marked `slow`.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.dataset.cache import (
+    CacheCorruptionError,
+    DatasetCache,
+    create_dataset_cache,
+)
+from ydf_tpu.utils import failpoints
+
+pytestmark = pytest.mark.chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _data(n=1500, seed=2):
+    rng = np.random.RandomState(seed)
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    y = (x1 + 0.5 * x2 + rng.normal(scale=0.5, size=n) > 0).astype(
+        np.int64
+    )
+    return {"x1": x1, "x2": x2, "y": y}
+
+
+_KW = dict(label="y", num_trees=12, max_depth=3, random_seed=7)
+
+
+def _train_until_done(working_dir, data, max_crashes=8, **kw):
+    """Drives train → crash → resume until completion (the scheduler's
+    retry loop, in miniature). Returns (model, crash count)."""
+    crashes = 0
+    while True:
+        try:
+            m = ydf.GradientBoostedTreesLearner(
+                working_dir=working_dir,
+                resume_training=crashes > 0,
+                resume_training_snapshot_interval_trees=4,
+                **kw,
+            ).train(data)
+            return m, crashes
+        except (failpoints.FailpointError, ydf.TrainingPreempted):
+            crashes += 1
+            assert crashes <= max_crashes, "training never completed"
+
+
+# --------------------------------------------------------------------- #
+# Deterministic one-shot schedules (tier-1).
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize(
+    "schedule",
+    [
+        # Crash right after a chunk's snapshot is durable.
+        "gbt.chunk=error@2",
+        # Torn snapshot payload whose index entry survived (the exact
+        # reordering the fsync contract prevents on real crashes):
+        # latest() must fall back one snapshot and resume re-does the
+        # last chunk.
+        "snapshot.save=torn_write@2",
+        # Crash between payload write and index update: the documented
+        # payload-before-index invariant.
+        "snapshot.index=error@2",
+    ],
+)
+def test_training_crash_resume_bit_identical(tmp_path, schedule):
+    data = _data()
+    base = ydf.GradientBoostedTreesLearner(**_KW).train(data)
+    with failpoints.active(schedule):
+        m, crashes = _train_until_done(str(tmp_path), data, **_KW)
+        assert crashes == 1
+        assert failpoints.fired_sites()  # the schedule actually fired
+    np.testing.assert_array_equal(base.predict(data), m.predict(data))
+
+
+def test_preemption_stop_is_resumable(tmp_path):
+    """SIGTERM semantics at the chunk boundary (via the deterministic
+    trigger hook — real OS delivery is covered by the slow subprocess
+    test and the guard unit test below): a preempted run stops with the
+    distinct resumable code and resume is bit-identical."""
+    data = _data()
+    base = ydf.GradientBoostedTreesLearner(**_KW).train(data)
+    learner = ydf.GradientBoostedTreesLearner(
+        working_dir=str(tmp_path),
+        resume_training_snapshot_interval_trees=4,
+        **_KW,
+    )
+    learner._preempt_after_chunks = 1
+    with pytest.raises(ydf.TrainingPreempted) as ei:
+        learner.train(data)
+    assert ei.value.exit_code == 75
+    assert "resumable" in str(ei.value)
+    resumed = ydf.GradientBoostedTreesLearner(
+        working_dir=str(tmp_path), resume_training=True,
+        resume_training_snapshot_interval_trees=4, **_KW,
+    ).train(data)
+    np.testing.assert_array_equal(
+        base.predict(data), resumed.predict(data)
+    )
+
+
+def test_preemption_guard_real_signal_delivery():
+    """A real SIGTERM to the process flips the guard flag (no crash) and
+    the previous handler is restored on exit."""
+    from ydf_tpu.learners.gbt import _PreemptionGuard
+
+    before = signal.getsignal(signal.SIGTERM)
+    with _PreemptionGuard() as g:
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.time() + 5
+        while not g.triggered and time.time() < deadline:
+            time.sleep(0.001)
+        assert g.triggered and g.signal_name == "SIGTERM"
+    assert signal.getsignal(signal.SIGTERM) == before
+
+
+def _write_csv(path, n=3000, seed=0):
+    import pandas as pd
+
+    rng = np.random.RandomState(seed)
+    cols = {
+        "x1": rng.normal(size=n),
+        "x2": rng.normal(size=n),
+        "y": (rng.normal(size=n) > 0).astype(int),
+    }
+    pd.DataFrame(cols).to_csv(path, index=False)
+    return cols
+
+
+def test_corrupt_cache_detected_and_rebuilt(tmp_path):
+    """Bit-flip in a cache chunk → CacheCorruptionError (never a garbage
+    model); create_dataset_cache(reuse=True) detects and rebuilds, and
+    the model from the rebuilt cache equals the pre-corruption one."""
+    csv = tmp_path / "d.csv"
+    cols = _write_csv(str(csv))
+    cdir = str(tmp_path / "cache")
+    cache = create_dataset_cache(
+        f"csv:{csv}", cdir, label="y", chunk_rows=500
+    )
+    base = ydf.GradientBoostedTreesLearner(**_KW).train(cache)
+
+    bins_path = os.path.join(cdir, "bins.npy")
+    with open(bins_path, "r+b") as f:
+        f.seek(os.path.getsize(bins_path) // 2)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(CacheCorruptionError, match="checksum"):
+        DatasetCache(cdir, verify="full")
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        rebuilt = create_dataset_cache(
+            f"csv:{csv}", cdir, label="y", chunk_rows=500, reuse=True
+        )
+        assert any("rebuild" in str(x.message) for x in w)
+    rebuilt.verify(full=True)
+    m = ydf.GradientBoostedTreesLearner(**_KW).train(rebuilt)
+    np.testing.assert_array_equal(base.predict(cols), m.predict(cols))
+
+
+def test_truncated_cache_detected_on_default_open(tmp_path):
+    """Truncation is caught by the DEFAULT (size-level) open check."""
+    csv = tmp_path / "d.csv"
+    _write_csv(str(csv))
+    cdir = str(tmp_path / "cache")
+    create_dataset_cache(f"csv:{csv}", cdir, label="y", chunk_rows=500)
+    with open(os.path.join(cdir, "labels.npy"), "r+b") as f:
+        f.truncate(64)
+    with pytest.raises(CacheCorruptionError, match="truncated"):
+        DatasetCache(cdir)
+
+
+def test_cache_crash_mid_build_never_half_valid(tmp_path):
+    """A crash during pass 2 (cache.write_chunk) or before the metadata
+    publish (cache.finalize) leaves a cache that refuses to open —
+    cache_meta.json is the commit record — and reuse=True rebuilds."""
+    csv = tmp_path / "d.csv"
+    _write_csv(str(csv))
+    for schedule in ("cache.write_chunk=error@2", "cache.finalize=error"):
+        cdir = str(tmp_path / f"cache_{schedule.split('=')[0]}")
+        with failpoints.active(schedule):
+            with pytest.raises(failpoints.FailpointError):
+                create_dataset_cache(
+                    f"csv:{csv}", cdir, label="y", chunk_rows=500
+                )
+        with pytest.raises(CacheCorruptionError):
+            DatasetCache(cdir)
+        rebuilt = create_dataset_cache(
+            f"csv:{csv}", cdir, label="y", chunk_rows=500, reuse=True
+        )
+        rebuilt.verify(full=True)
+
+
+def test_cache_verify_env_validation(monkeypatch):
+    from ydf_tpu.dataset.cache import _resolve_verify
+
+    monkeypatch.setenv("YDF_TPU_CACHE_VERIFY", "fulll")
+    with pytest.raises(ValueError, match="not one of"):
+        _resolve_verify(None)
+    monkeypatch.setenv("YDF_TPU_CACHE_VERIFY", "full")
+    assert _resolve_verify(None) == "full"
+    monkeypatch.delenv("YDF_TPU_CACHE_VERIFY", raising=False)
+    assert _resolve_verify(None) == "size"
+    with pytest.raises(ValueError):
+        _resolve_verify("sometimes")
+
+
+def test_native_register_fault_is_transient():
+    """An injected registration fault degrades ONE call (XLA fallback —
+    bit-identical by the kernel equality bar) and the next registration
+    attempt succeeds: fail_once → retry is a real recovery, not a
+    process-wide latch."""
+    from ydf_tpu.ops.native_ffi import KERNELS_LIB, NativeLibrary
+
+    if not KERNELS_LIB.available():
+        pytest.skip("no native toolchain in this environment")
+    lib = NativeLibrary(
+        src_name=(
+            "histogram_ffi.cc", "binning_ffi.cc", "routing_ffi.cc",
+        ),
+        lib_name="libydfkernels.so",  # already built: no recompile
+        ffi_targets={},  # empty: re-registration must not collide
+        extra_cflags=("-pthread",),
+        extra_deps=("thread_pool.h",),
+    )
+    with failpoints.active("native.register=fail_once"):
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert lib.ensure_ffi_registered() is False
+            assert any(
+                "injected" in str(x.message) for x in w
+            ), [str(x.message) for x in w]
+        assert "native.register" in failpoints.fired_sites()
+    assert lib._failed is False  # transient, not latched
+    assert lib.ensure_ffi_registered() is True
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _make_opt(workers=None):
+    return ydf.HyperParameterOptimizerLearner(
+        base_learner=ydf.GradientBoostedTreesLearner(
+            label="y", num_trees=6, validation_ratio=0.0,
+            early_stopping="NONE",
+        ),
+        search_space={"max_depth": [2, 3], "shrinkage": [0.05, 0.2]},
+        num_trials=4,
+        random_seed=7,
+        workers=workers,
+        worker_backoff_base_s=0.05,  # fast test backoff
+    )
+
+
+@pytest.mark.parametrize(
+    "schedule",
+    [
+        # Hit 3 = first trial request (1: ping_all, 2: load_data) —
+        # dropped before the worker reads it; the retry succeeds.
+        "worker.recv=drop_conn@3",
+        # Dropped AFTER training, before the response: the manager
+        # retries and the worker retrains — same score (pure function
+        # of config+data+seed).
+        "worker.send=drop_conn@3",
+        # Dropped between recv and execution.
+        "worker.handle=drop_conn@3",
+    ],
+)
+def test_tuning_survives_dropped_connections(schedule):
+    """Distributed tuning with injected worker-side connection drops:
+    the trial retries through the pool's backoff/quarantine policy and
+    the winner (and every per-trial score) equals the local run; the
+    tuning report records which worker served each trial."""
+    from ydf_tpu.parallel.worker_service import WorkerPool, start_worker
+
+    data = _data(600, seed=4)
+    port = _free_port()
+    start_worker(port, host="127.0.0.1", blocking=False)
+    addr = f"127.0.0.1:{port}"
+
+    local = _make_opt()
+    local.parallel_trials = 1
+    m_local = local.train(data)
+
+    with failpoints.active(schedule):
+        m_remote = _make_opt(workers=[addr]).train(data)
+        assert failpoints.fired_sites()
+
+    l1 = m_local.extra_metadata["tuner_logs"]
+    l2 = m_remote.extra_metadata["tuner_logs"]
+    assert l1["best_params"] == l2["best_params"]
+    np.testing.assert_allclose(
+        [t["score"] for t in l1["trials"]],
+        [t["score"] for t in l2["trials"]],
+        atol=1e-9,
+    )
+    # Placement is logged per trial (satellite: tuning report names the
+    # serving worker).
+    assert all(t["worker"] == addr for t in l2["trials"])
+    WorkerPool([addr]).shutdown_all()
+
+
+def test_all_sites_one_run():
+    """The acceptance schedule: every registered site family faulted in
+    one flow — cache write, snapshot save, gbt chunk boundary, worker
+    recv/send, native register — and every recovery lands bit-identical
+    to the fault-free artifacts."""
+    import tempfile
+
+    from ydf_tpu.parallel.worker_service import WorkerPool, start_worker
+    from ydf_tpu.ops.native_ffi import KERNELS_LIB, NativeLibrary
+
+    tmp = tempfile.mkdtemp()
+    csv = os.path.join(tmp, "d.csv")
+    cols = _write_csv(csv)
+    cache_dir = os.path.join(tmp, "cache")
+    wd = os.path.join(tmp, "wd")
+    data = _data(600, seed=4)
+    port = _free_port()
+    start_worker(port, host="127.0.0.1", blocking=False)
+    addr = f"127.0.0.1:{port}"
+
+    # Fault-free references.
+    ref_cache = create_dataset_cache(
+        f"csv:{csv}", os.path.join(tmp, "ref_cache"), label="y",
+        chunk_rows=500,
+    )
+    ref_model = ydf.GradientBoostedTreesLearner(**_KW).train(ref_cache)
+    local = _make_opt()
+    local.parallel_trials = 1
+    ref_tuned = local.train(data)
+
+    schedule = (
+        "cache.write_chunk=error@2;"
+        "snapshot.save=torn_write@1;"
+        "gbt.chunk=error@2;"
+        "worker.recv=drop_conn@3;"
+        "worker.send=drop_conn@5;"
+        "native.register=fail_once"
+    )
+    with failpoints.active(schedule):
+        # Native registration fault: one degraded call, then recovery.
+        if KERNELS_LIB.available():
+            probe = NativeLibrary(
+                src_name=(
+                    "histogram_ffi.cc", "binning_ffi.cc",
+                    "routing_ffi.cc",
+                ),
+                lib_name="libydfkernels.so",
+                ffi_targets={},
+                extra_cflags=("-pthread",),
+                extra_deps=("thread_pool.h",),
+            )
+            assert probe.ensure_ffi_registered() is False
+            assert probe.ensure_ffi_registered() is True
+        else:
+            failpoints.hit("native.register")  # count the site anyway
+
+        # Cache build crashes mid-pass-2, rebuild recovers.
+        try:
+            create_dataset_cache(
+                f"csv:{csv}", cache_dir, label="y", chunk_rows=500
+            )
+            raise AssertionError("cache fault did not fire")
+        except failpoints.FailpointError:
+            pass
+        cache = create_dataset_cache(
+            f"csv:{csv}", cache_dir, label="y", chunk_rows=500,
+            reuse=True,
+        )
+
+        # Checkpointed training from the rebuilt cache: torn snapshot on
+        # chunk 1, crash at chunk-2 boundary — two resumes to finish.
+        model, crashes = _train_until_done(wd, cache, **_KW)
+        assert crashes == 2
+
+        # Distributed tuning through dropped connections.
+        tuned = _make_opt(workers=[addr]).train(data)
+
+        fired = set(failpoints.fired_sites())
+    assert fired == {
+        "native.register", "cache.write_chunk", "snapshot.save",
+        "gbt.chunk", "worker.recv", "worker.send",
+    }, fired
+
+    np.testing.assert_array_equal(
+        ref_model.predict(cols), model.predict(cols)
+    )
+    assert (
+        ref_tuned.extra_metadata["tuner_logs"]["best_params"]
+        == tuned.extra_metadata["tuner_logs"]["best_params"]
+    )
+    WorkerPool([addr]).shutdown_all()
+
+
+def test_bad_env_schedule_fails_at_import_boundary():
+    """YDF_TPU_FAILPOINTS typos fail the importing process eagerly (the
+    registry module imports pure stdlib, so this subprocess is cheap)."""
+    out = subprocess.run(
+        [sys.executable, "-c", "import ydf_tpu.utils.failpoints"],
+        capture_output=True, text=True, timeout=60,
+        cwd=REPO,
+        env={**os.environ, "YDF_TPU_FAILPOINTS": "gbt.chunk=explode"},
+    )
+    assert out.returncode != 0
+    assert "is not one of" in out.stderr
+
+
+# --------------------------------------------------------------------- #
+# Subprocess kill/preempt + randomized schedules (slow).
+# --------------------------------------------------------------------- #
+
+_TRAIN_SCRIPT = r"""
+import sys
+import numpy as np
+import ydf_tpu as ydf
+
+wd = sys.argv[1]
+resume = len(sys.argv) > 2 and sys.argv[2] == "resume"
+rng = np.random.RandomState(2)
+n = 4000
+x1, x2 = rng.normal(size=n), rng.normal(size=n)
+y = (x1 + 0.5 * x2 + rng.normal(scale=0.5, size=n) > 0).astype(np.int64)
+data = {"x1": x1, "x2": x2, "y": y}
+try:
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=60, max_depth=3, random_seed=7,
+        working_dir=wd, resume_training=resume,
+        resume_training_snapshot_interval_trees=5,
+    ).train(data)
+except ydf.TrainingPreempted as e:
+    print("PREEMPTED", flush=True)
+    sys.exit(e.exit_code)
+np.save(wd + "/preds.npy", np.asarray(m.predict(data)))
+print("DONE", flush=True)
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("sig,expect_rc", [
+    (signal.SIGKILL, -signal.SIGKILL),  # hard kill: no goodbye
+    (signal.SIGTERM, 75),               # preemption: resumable exit
+])
+def test_kill_training_subprocess_and_resume(tmp_path, sig, expect_rc):
+    """The satellite kill-resume test, with a REAL process: training is
+    SIGKILLed/SIGTERMed mid-run after its first snapshot lands, then
+    resumed to completion in a fresh process; the final model is
+    bit-identical to an uninterrupted run."""
+    env = {
+        **os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO,
+    }
+    env.pop("YDF_TPU_FAILPOINTS", None)
+
+    wd = str(tmp_path / "wd")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _TRAIN_SCRIPT, wd],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+    )
+    # Kill as soon as the first snapshot is durable (54 chunks remain:
+    # the run cannot finish between the poll and the signal).
+    index = os.path.join(wd, "snapshot")
+    deadline = time.time() + 300
+    while not os.path.exists(index) and time.time() < deadline:
+        if proc.poll() is not None:
+            pytest.fail(
+                f"training exited before first snapshot: "
+                f"{proc.stderr.read()}"
+            )
+        time.sleep(0.01)
+    assert os.path.exists(index), "no snapshot within 300s"
+    proc.send_signal(sig)
+    rc = proc.wait(timeout=120)
+    assert rc == expect_rc, (rc, proc.stderr.read()[-2000:])
+
+    # Resume in a fresh process; uninterrupted baseline in another.
+    done = subprocess.run(
+        [sys.executable, "-c", _TRAIN_SCRIPT, wd, "resume"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert done.returncode == 0, done.stderr[-2000:]
+    base_wd = str(tmp_path / "base")
+    os.makedirs(base_wd)
+    base = subprocess.run(
+        [sys.executable, "-c", _TRAIN_SCRIPT, base_wd],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert base.returncode == 0, base.stderr[-2000:]
+    np.testing.assert_array_equal(
+        np.load(os.path.join(wd, "preds.npy")),
+        np.load(os.path.join(base_wd, "preds.npy")),
+    )
+
+
+@pytest.mark.slow
+def test_randomized_training_chaos_schedules(tmp_path):
+    """Seeded random fault schedules over the training sites: whatever
+    one-shot faults fire in whatever order, crash-retry converges and
+    the model is bit-identical to the fault-free run."""
+    data = _data()
+    base = ydf.GradientBoostedTreesLearner(**_KW).train(data)
+    rng = np.random.RandomState(0xC4A05)
+    sites = [
+        ("gbt.chunk", "error"),
+        ("snapshot.save", "torn_write"),
+        ("snapshot.index", "error"),
+    ]
+    for round_i in range(6):
+        picks = rng.choice(len(sites), size=rng.randint(1, 3),
+                           replace=False)
+        schedule = ";".join(
+            f"{sites[p][0]}={sites[p][1]}@{rng.randint(1, 4)}"
+            for p in picks
+        )
+        wd = str(tmp_path / f"round{round_i}")
+        with failpoints.active(schedule):
+            m, _ = _train_until_done(wd, data, **_KW)
+        np.testing.assert_array_equal(
+            base.predict(data), m.predict(data),
+            err_msg=f"schedule {schedule!r} broke bit-identity",
+        )
+
+
+@pytest.mark.slow
+def test_randomized_tuning_chaos_schedules():
+    """Seeded random worker-side connection drops during distributed
+    tuning: retry/backoff always converges to the local winner."""
+    from ydf_tpu.parallel.worker_service import WorkerPool, start_worker
+
+    data = _data(600, seed=4)
+    port = _free_port()
+    start_worker(port, host="127.0.0.1", blocking=False)
+    addr = f"127.0.0.1:{port}"
+    local = _make_opt()
+    local.parallel_trials = 1
+    want = local.train(data).extra_metadata["tuner_logs"]["best_params"]
+
+    rng = np.random.RandomState(0xD1CE)
+    for _ in range(4):
+        site = ["worker.recv", "worker.send", "worker.handle"][
+            rng.randint(3)
+        ]
+        schedule = f"{site}=drop_conn@{rng.randint(1, 8)}"
+        with failpoints.active(schedule):
+            got = _make_opt(workers=[addr]).train(data)
+        assert (
+            got.extra_metadata["tuner_logs"]["best_params"] == want
+        ), f"schedule {schedule!r} changed the winner"
+    WorkerPool([addr]).shutdown_all()

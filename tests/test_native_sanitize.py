@@ -12,6 +12,11 @@ creation (first ydf_tpu import); asan additionally needs its runtime
 preloaded before python itself, and libstdc++ preloaded next to it —
 gcc-10's interceptor init otherwise aborts with "real___cxa_throw != 0"
 when XLA throws its first C++ exception.
+
+The driver also routes the failpoint-injected native registration error
+path (utils/failpoints.py, site native.register) through the sanitized
+build first: the injected fault must degrade one call without latching,
+and the retried registration then serves every sanitized kernel run.
 """
 
 import os
@@ -32,6 +37,20 @@ from ydf_tpu.ops import routing_native
 mode = KERNELS_LIB.sanitize
 assert mode, "sanitize mode did not reach the build helper"
 assert mode in KERNELS_LIB.lib_path, KERNELS_LIB.lib_path
+
+# Failpoint-injected registration error path (PR 5 satellite), under the
+# sanitizer: the injected fault degrades exactly one registration
+# attempt (build/load already happened) and must NOT latch _failed —
+# the immediate retry below registers for real and every kernel then
+# runs sanitized.
+from ydf_tpu.utils import failpoints
+import warnings as _w
+with failpoints.active("native.register=fail_once"):
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        assert not KERNELS_LIB.ensure_ffi_registered()
+assert not KERNELS_LIB._failed, "injected fault latched the library"
+
 assert KERNELS_LIB.ensure_ffi_registered()
 
 rng = np.random.RandomState(0)

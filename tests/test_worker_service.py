@@ -191,6 +191,77 @@ def test_trial_retry_after_worker_cache_loss(monkeypatch):
     pool.shutdown_all()
 
 
+def test_stalled_manager_does_not_block_other_managers():
+    """Accept-loop wedge (PR 5 satellite): the loop used to be
+    single-threaded, so a peer that connected and sent nothing held the
+    worker hostage for the whole idle timeout. Connections are now
+    handled on per-connection threads: a concurrent request must
+    complete immediately while the stalled one is still open."""
+    port = _free_port()
+    start_worker(port, host="127.0.0.1", blocking=False)
+    stalled = socket.create_connection(("127.0.0.1", port))
+    try:
+        pool = WorkerPool([f"127.0.0.1:{port}"], timeout_s=10.0)
+        t0 = time.time()
+        assert pool.request(0, {"verb": "ping"})["ok"]
+        assert time.time() - t0 < 5.0, "ping was blocked by stalled conn"
+    finally:
+        stalled.close()
+    WorkerPool([f"127.0.0.1:{port}"]).shutdown_all()
+
+
+def test_worker_pool_backoff_quarantine_and_reprobe():
+    """Transport failures quarantine a worker with exponential backoff;
+    after the backoff expires the worker is re-PROBED with a ping and —
+    if it came back (restart) — returns to rotation."""
+    live = _free_port()
+    start_worker(live, host="127.0.0.1", blocking=False)
+    late = _free_port()  # dead now, comes up mid-test
+    pool = WorkerPool(
+        [f"127.0.0.1:{late}", f"127.0.0.1:{live}"],
+        timeout_s=5.0, backoff_base_s=0.1, backoff_max_s=0.4,
+    )
+    # request_retry starting at the dead worker fails over to the live
+    # one and quarantines the dead one.
+    resp, idx = pool.request_retry(0, {"verb": "ping"})
+    assert resp["ok"]
+    assert pool.addr_str(idx) == f"127.0.0.1:{live}"
+    assert pool._health, "failed worker was not quarantined"
+    # While quarantined, pick_worker skips it without a network attempt.
+    assert pool.pick_worker(0) == 1
+    # Bring it up; once the quarantine expires the next pick re-probes
+    # and heals it.
+    start_worker(late, host="127.0.0.1", blocking=False)
+    time.sleep(0.7)  # > backoff_max_s with jitter: quarantine expired
+    assert pool.pick_worker(0) == 0
+    assert not pool._health, "healed worker still quarantined"
+    for p in (live, late):
+        WorkerPool([f"127.0.0.1:{p}"]).shutdown_all()
+
+
+def test_backoff_delay_exponential_with_jitter():
+    pool = WorkerPool(
+        ["127.0.0.1:1"], backoff_base_s=0.2, backoff_max_s=10.0
+    )
+    d0 = [pool.backoff_delay(0) for _ in range(20)]
+    d3 = [pool.backoff_delay(3) for _ in range(20)]
+    assert all(0.1 <= d < 0.3 for d in d0), d0     # 0.2 · U[0.5, 1.5)
+    assert all(0.8 <= d < 2.4 for d in d3), d3     # 1.6 · U[0.5, 1.5)
+    assert len(set(d0)) > 1, "no jitter"
+
+
+def test_send_timeout_env(monkeypatch):
+    """The response send runs under a deadline (default 120 s,
+    YDF_TPU_WORKER_SEND_TIMEOUT overrides) — a dead manager can wedge
+    at most its own handler thread, and only that long."""
+    from ydf_tpu.parallel import worker_service as ws
+
+    monkeypatch.delenv("YDF_TPU_WORKER_SEND_TIMEOUT", raising=False)
+    assert ws._send_timeout() == 120.0
+    monkeypatch.setenv("YDF_TPU_WORKER_SEND_TIMEOUT", "7.5")
+    assert ws._send_timeout() == 7.5
+
+
 def test_hmac_auth_refuses_wrong_or_missing_secret():
     """When the worker holds a shared secret, connections with the wrong
     secret or none at all are dropped without executing anything; a

@@ -24,7 +24,10 @@ from ydf_tpu.dataset.dataspec import (
     infer_dataspec,
 )
 from ydf_tpu.dataset.dataset import Dataset
-from ydf_tpu.learners.gbt import GradientBoostedTreesLearner
+from ydf_tpu.learners.gbt import (
+    GradientBoostedTreesLearner,
+    TrainingPreempted,
+)
 from ydf_tpu.learners.losses import CustomLoss
 from ydf_tpu.learners.random_forest import RandomForestLearner
 from ydf_tpu.learners.cart import CartLearner
@@ -50,6 +53,7 @@ __all__ = [
     "Dataset",
     "infer_dataspec",
     "GradientBoostedTreesLearner",
+    "TrainingPreempted",
     "CustomLoss",
     "RandomForestLearner",
     "CartLearner",

@@ -55,8 +55,24 @@ def cmd_train(args):
                 f"error: --label is required for learner {args.learner}"
             )
         learner = cls(label=args.label, task=Task(args.task), **kwargs)
+    if getattr(args, "working_dir", None):
+        learner.working_dir = args.working_dir
+    if getattr(args, "resume", False):
+        learner.resume_training = True
     t0 = time.time()
-    model = learner.train(args.dataset)
+    try:
+        model = learner.train(args.dataset)
+    except Exception as e:
+        # Preemption (SIGTERM/SIGINT during checkpointed training) is a
+        # RESUMABLE outcome, not a failure: exit with its distinct code
+        # (75, EX_TEMPFAIL) so schedulers requeue with --resume instead
+        # of treating the job as crashed.
+        from ydf_tpu.learners.gbt import TrainingPreempted
+
+        if isinstance(e, TrainingPreempted):
+            print(f"preempted: {e}", file=sys.stderr)
+            sys.exit(TrainingPreempted.exit_code)
+        raise
     print(f"Trained in {time.time() - t0:.2f}s", file=sys.stderr)
     model.save(args.output)
     print(f"Model saved to {args.output}")
@@ -392,6 +408,13 @@ def main(argv=None):
                    choices=sorted(_LEARNERS))
     p.add_argument("--output", required=True)
     p.add_argument("--hyperparameters", help="JSON dict of learner kwargs")
+    p.add_argument("--working_dir",
+                   help="snapshot directory for checkpointed training "
+                        "(enables preemption-safe SIGTERM handling; "
+                        "exit code 75 = resumable)")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the latest snapshot in "
+                        "--working_dir")
     p.add_argument("--cpu", action="store_true")
     p.set_defaults(fn=cmd_train)
 

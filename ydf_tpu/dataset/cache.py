@@ -24,11 +24,16 @@ device transfer of the uint8 bin matrix is the only full-size copy —
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import warnings
+import zlib
 from typing import Dict, Iterator, List, Optional
 
 import numpy as np
+
+from ydf_tpu.utils import failpoints
 
 from ydf_tpu.config import Task
 from ydf_tpu.dataset.binning import Binner
@@ -128,13 +133,138 @@ class _NumSketch:
         )
 
 
-class DatasetCache:
-    """Handle to a created cache directory; accepted by the learners."""
+class CacheCorruptionError(RuntimeError):
+    """The on-disk cache failed an integrity check (truncated file, crc
+    mismatch, unreadable metadata). Training on a silently corrupt
+    memmap would produce a garbage model; callers should recreate the
+    cache — `create_dataset_cache(..., reuse=True)` does exactly that
+    (detect-and-rebuild)."""
 
-    def __init__(self, path: str):
+
+# Integrity metadata (cache_meta.json "integrity" key): every data file
+# records its byte size plus a crc32 (zlib polynomial — the stdlib's
+# hardware-free counterpart of the crc32c the reference cache format
+# would use) per fixed 4 MiB block. Block-wise checksums keep
+# verification streaming (O(block) RSS over a memmap-sized file) and
+# localize a mismatch to a block index for the error message.
+_CRC_BLOCK = 4 << 20
+
+
+def _file_integrity(path: str) -> Dict[str, object]:
+    crcs: List[int] = []
+    size = 0
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(_CRC_BLOCK)
+            if not b:
+                break
+            size += len(b)
+            crcs.append(zlib.crc32(b))
+    return {"size": size, "crc32": crcs}
+
+
+def _verify_file(path: str, rec: Dict[str, object], full: bool) -> None:
+    name = os.path.basename(path)
+    if not os.path.isfile(path):
+        raise CacheCorruptionError(f"cache file {name!r} is missing")
+    size = os.path.getsize(path)
+    if size != rec["size"]:
+        raise CacheCorruptionError(
+            f"cache file {name!r} is {size} bytes, expected "
+            f"{rec['size']} (truncated or partially written)"
+        )
+    if not full:
+        return
+    with open(path, "rb") as f:
+        for i, want in enumerate(rec["crc32"]):
+            b = f.read(_CRC_BLOCK)
+            if zlib.crc32(b) != want:
+                raise CacheCorruptionError(
+                    f"cache file {name!r} fails its checksum at block "
+                    f"{i} (byte offset {i * _CRC_BLOCK}): the cache is "
+                    "corrupt; recreate it (create_dataset_cache with "
+                    "reuse=True rebuilds automatically)"
+                )
+
+
+def _try_reuse_cache(
+    cache_dir: str, request_fp: str
+) -> Optional["DatasetCache"]:
+    """reuse=True probe: a fully-verified cache built from the same
+    request → return it; anything else (missing, corrupt, different
+    request) → None, after clearing a corrupt cache's metadata so a
+    crash mid-rebuild can never leave it half-valid."""
+    meta_path = os.path.join(cache_dir, "cache_meta.json")
+    if not os.path.isfile(meta_path):
+        return None
+    try:
+        cache = DatasetCache(cache_dir, verify="full")
+    except CacheCorruptionError as e:
+        warnings.warn(
+            f"existing dataset cache in {cache_dir!r} failed integrity "
+            f"verification ({e}); rebuilding it",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+        try:
+            os.remove(meta_path)
+        except OSError:
+            pass
+        return None
+    if cache._meta.get("request_fingerprint") != request_fp:
+        return None  # same directory, different data/config: rebuild
+    return cache
+
+
+_VERIFY_MODES = ("off", "size", "full")
+
+
+def _resolve_verify(verify: Optional[str]) -> str:
+    """Open-time verification level. Explicit argument wins; otherwise
+    YDF_TPU_CACHE_VERIFY (eagerly validated, like YDF_TPU_HIST_IMPL),
+    defaulting to "size" — free truncation detection on every open; set
+    "full" to also stream the crc blocks (one read pass — worth it
+    anywhere a cache can outlive the process that wrote it)."""
+    if verify is None:
+        verify = (
+            os.environ.get("YDF_TPU_CACHE_VERIFY", "").strip().lower()
+            or "size"
+        )
+    if verify not in _VERIFY_MODES:
+        raise ValueError(
+            f"cache verify mode {verify!r} is not one of "
+            f"{list(_VERIFY_MODES)} (from YDF_TPU_CACHE_VERIFY or the "
+            "verify= argument)"
+        )
+    return verify
+
+
+class DatasetCache:
+    """Handle to a created cache directory; accepted by the learners.
+
+    Opening validates the cache against the integrity metadata recorded
+    at creation (`verify=`: "size" checks byte sizes — catches
+    truncation; "full" additionally streams per-block crc32 — catches
+    bit corruption; "off" trusts the files). Caches written before the
+    integrity metadata existed open without checks."""
+
+    def __init__(self, path: str, verify: Optional[str] = None):
         self.path = path
-        with open(os.path.join(path, "cache_meta.json")) as f:
-            meta = json.load(f)
+        verify = _resolve_verify(verify)
+        meta_path = os.path.join(path, "cache_meta.json")
+        if not os.path.isfile(meta_path):
+            raise CacheCorruptionError(
+                f"{path!r} has no cache_meta.json — not a dataset cache, "
+                "or its creation crashed before the metadata publish"
+            )
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+        except (OSError, ValueError) as e:
+            raise CacheCorruptionError(
+                f"cache metadata in {path!r} is unreadable "
+                f"({type(e).__name__}: {e})"
+            ) from e
         self.dataspec = DataSpecification.from_json(meta["dataspec"])
         self.binner = Binner.from_json(meta["binner"])
         self.num_rows = int(meta["num_rows"])
@@ -144,6 +274,19 @@ class DatasetCache:
         #: uplift treatment, survival event/entry) — name → dtype kind.
         self.extra_columns: List[str] = list(meta.get("extra_columns", []))
         self._meta = meta
+        if verify != "off":
+            self.verify(full=(verify == "full"))
+
+    def verify(self, full: bool = True) -> None:
+        """Checks every data file against the integrity metadata; raises
+        CacheCorruptionError on the first mismatch. `full=False` checks
+        sizes only (truncation); `full=True` also streams the per-block
+        crc32s. No-op for pre-integrity caches."""
+        integrity = self._meta.get("integrity")
+        if not integrity:
+            return
+        for name, rec in integrity["files"].items():
+            _verify_file(os.path.join(self.path, name), rec, full)
 
     @property
     def bins(self) -> np.ndarray:
@@ -208,6 +351,7 @@ def create_dataset_cache(
     label_event_observed: Optional[str] = None,
     label_entry_age: Optional[str] = None,
     store_raw_numerical: bool = False,
+    reuse: bool = False,
 ) -> DatasetCache:
     """Builds an on-disk binned cache from (sharded) CSV input, or from
     an in-memory columnar frame (pandas / polars DataFrame or dict of
@@ -219,7 +363,16 @@ def create_dataset_cache(
     cache; `store_raw_numerical=True` additionally memmaps the imputed
     float32 feature matrix, which SPARSE_OBLIQUE training needs (the
     reference's dataset cache keeps raw numericals for the same reason,
-    dataset_cache.proto:42-58)."""
+    dataset_cache.proto:42-58).
+
+    `reuse=True` is the detect-and-rebuild entry point: when cache_dir
+    already holds a cache built from the SAME request (source files by
+    size+mtime, label/task/binning/vocab/extra-column config — the
+    request fingerprint stored in cache_meta.json) that passes a FULL
+    integrity verification, it is returned as-is; a corrupt, truncated
+    or mismatching cache is rebuilt from scratch instead of being
+    trained on. In-memory frame input always rebuilds (no cheap content
+    identity to fingerprint)."""
     if isinstance(data_path, str):
         fmt, _ = _split_typed_path(data_path)
         if fmt != "csv":
@@ -238,6 +391,30 @@ def create_dataset_cache(
 
         files = None
     os.makedirs(cache_dir, exist_ok=True)
+
+    # Request fingerprint: identifies (source content proxy, requested
+    # config) so a reuse can never hand back a cache built from other
+    # data or another binning/vocab policy. File identity is
+    # (basename, size, mtime_ns) — the usual cheap content proxy.
+    request_fp = None
+    if files is not None:
+        src = sorted(
+            (os.path.basename(p), os.path.getsize(p),
+             os.stat(p).st_mtime_ns)
+            for p in files
+        )
+        request_fp = hashlib.sha1(
+            repr((
+                src, label, task.value, weights, features, num_bins,
+                chunk_rows, max_vocab_count, min_vocab_frequency,
+                ranking_group, uplift_treatment, label_event_observed,
+                label_entry_age, store_raw_numerical,
+            )).encode()
+        ).hexdigest()
+    if reuse and request_fp is not None:
+        existing = _try_reuse_cache(cache_dir, request_fp)
+        if existing is not None:
+            return existing
 
     def _chunks():
         if files is None:
@@ -445,6 +622,7 @@ def create_dataset_cache(
         else Task.REGRESSION
     )
     for chunk in _chunks():
+        failpoints.hit("cache.write_chunk")
         ds = Dataset(chunk, spec)
         k = ds.num_rows
         # Fused ingest: each chunk is binned (native kernel when built)
@@ -480,7 +658,31 @@ def create_dataset_cache(
     if raw_mm is not None:
         raw_mm.flush()
 
-    with open(os.path.join(cache_dir, "cache_meta.json"), "w") as f:
+    # ---- finalize: integrity metadata + atomic publish -------------- #
+    # The metadata is the cache's commit record: it is written LAST,
+    # fsync-before-rename (same durability recipe as utils/snapshot.py),
+    # so a crash anywhere in pass 1/2 leaves a cache that *fails to
+    # open* instead of one that trains on half-written memmaps.
+    data_files = ["bins.npy", "labels.npy"]
+    if weights_mm is not None:
+        data_files.append("weights.npy")
+    data_files += [f"col_{name}.npy" for name in extra_mm]
+    if raw_mm is not None:
+        data_files.append("raw_numerical.npy")
+    integrity = {
+        "algo": "crc32",
+        "block_bytes": _CRC_BLOCK,
+        "files": {
+            name: _file_integrity(os.path.join(cache_dir, name))
+            for name in data_files
+        },
+    }
+    failpoints.hit("cache.finalize")
+    from ydf_tpu.utils.snapshot import _durable_replace
+
+    meta_path = os.path.join(cache_dir, "cache_meta.json")
+    tmp = meta_path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(
             {
                 "dataspec": spec.to_json(),
@@ -490,8 +692,12 @@ def create_dataset_cache(
                 "weights": weights,
                 "extra_columns": extra_cols,
                 "store_raw_numerical": bool(raw_mm is not None),
-                "source": data_path,
+                "source": data_path if isinstance(data_path, str) else
+                "<in-memory frame>",
+                "integrity": integrity,
+                "request_fingerprint": request_fp,
             },
             f,
         )
+    _durable_replace(tmp, meta_path)
     return DatasetCache(cache_dir)

@@ -26,6 +26,8 @@ from __future__ import annotations
 
 import functools
 import os
+import signal
+import threading
 import time
 from typing import Optional, Sequence
 
@@ -34,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ydf_tpu.config import Task, TreeConfig
+from ydf_tpu.utils import failpoints
 from ydf_tpu.dataset.dataset import InputData
 from ydf_tpu.learners.generic import GenericLearner
 from ydf_tpu.learners.losses import make_loss
@@ -260,8 +263,14 @@ class GradientBoostedTreesLearner(GenericLearner):
         # GBT deadline check, gradient_boosted_trees.cc:1314-1325).
         self.maximum_training_duration = maximum_training_duration
         # Test-only fault injection (reference MaybeSimulateFailure,
-        # worker.cc:415-452): abort after N snapshots.
+        # worker.cc:415-452): abort after N snapshots. The generalized
+        # version is the failpoint registry (utils/failpoints.py, site
+        # "gbt.chunk"); this hook predates it and stays for the old
+        # tests. _preempt_after_chunks simulates a SIGTERM delivered
+        # during chunk N (same code path as a real signal, minus the OS
+        # delivery — tests/test_chaos.py covers the real one too).
         self._abort_after_chunks = None
+        self._preempt_after_chunks = None
         # jax.sharding.Mesh with axes (data, feature): distributes training
         # via GSPMD sharding annotations (see ydf_tpu/parallel/mesh.py — the
         # TPU-native replacement of the reference's gRPC worker protocol).
@@ -801,6 +810,7 @@ class GradientBoostedTreesLearner(GenericLearner):
             resume=self.resume_training,
             snapshot_interval=self.resume_training_snapshot_interval_trees,
             abort_after_chunks=self._abort_after_chunks,
+            preempt_after_chunks=self._preempt_after_chunks,
             early_stop_lookahead=(
                 self.early_stopping_num_trees_look_ahead
                 if self.early_stopping == "LOSS_INCREASE"
@@ -1653,7 +1663,8 @@ def _train_gbt(
     vs_tr=None, vs_va=None, vs_Ac=0, vs_Ap=0, route_impl="xla",
     route_fuse=True,
     cache_dir=None, resume=False, snapshot_interval=50,
-    abort_after_chunks=None, early_stop_lookahead=0, deadline=None,
+    abort_after_chunks=None, preempt_after_chunks=None,
+    early_stop_lookahead=0, deadline=None,
 ):
     """The jitted boosting loop. Returns stacked trees [T, K, ...], leaf
     values [T, K, N, 1] and per-iteration logs. `deadline` is an absolute
@@ -1833,53 +1844,81 @@ def _train_gbt(
                     vls_seen.append(np.asarray(z["vls"]))
             except Exception:
                 pass
-    while start < num_trees:
-        clen = _chunk_len(snapshot_interval, start, num_trees, use_dart)
-        carry, ys = run.run_chunk(
-            carry, jnp.asarray(start), clen, *data_args, **data_kwargs
-        )
-        chunk_arrays = _chunk_arrays_from_ys(ys)
-        tmp = _chunk_path(start) + ".tmp"
-        with open(tmp, "wb") as f:
-            np.savez(f, **chunk_arrays)
-        os.replace(tmp, _chunk_path(start))
+    from ydf_tpu.utils.snapshot import _durable_replace
 
-        start_next = start + clen
-        arrays = {"init_pred": np.asarray(init_pred)}
-        for i, leaf in enumerate(jax.tree.leaves(carry)):
-            arrays[f"carry_{i}"] = np.asarray(leaf)
-        if chunks_done == 0:
-            # Chunk list carried across interrupted runs via the snapshot.
-            all_starts = (
-                list(state[2].get("chunk_starts", []))
-                if state is not None
-                else []
+    with _PreemptionGuard() as guard:
+        while start < num_trees:
+            clen = _chunk_len(
+                snapshot_interval, start, num_trees, use_dart
             )
-        all_starts.append(start)
-        snaps.save(
-            start_next,
-            arrays,
-            meta={
-                "completed_iters": start_next,
-                "num_carry": len(jax.tree.leaves(carry)),
-                "fingerprint": fingerprint,
-                "chunk_starts": all_starts,
-            },
-        )
-        start = start_next
-        chunks_done += 1
-        if early_stop_lookahead > 0 and nv_rows > 0:
-            # vls_seen covers iterations [0, start) including pre-resume
-            # chunks (re-seeded above), so argmin is an absolute index.
-            vls_seen.append(chunk_arrays["vls"])
-            if _early_stop_hit(vls_seen, start, early_stop_lookahead):
+            carry, ys = run.run_chunk(
+                carry, jnp.asarray(start), clen, *data_args, **data_kwargs
+            )
+            chunk_arrays = _chunk_arrays_from_ys(ys)
+            tmp = _chunk_path(start) + ".tmp"
+            with open(tmp, "wb") as f:
+                np.savez(f, **chunk_arrays)
+            # Durable before the snapshot that references it: the final
+            # merge reads chunk payloads back after a crash, so a torn
+            # chunk behind a durable snapshot would be unrecoverable.
+            _durable_replace(tmp, _chunk_path(start))
+
+            start_next = start + clen
+            arrays = {"init_pred": np.asarray(init_pred)}
+            for i, leaf in enumerate(jax.tree.leaves(carry)):
+                arrays[f"carry_{i}"] = np.asarray(leaf)
+            if chunks_done == 0:
+                # Chunk list carried across interrupted runs via the
+                # snapshot.
+                all_starts = (
+                    list(state[2].get("chunk_starts", []))
+                    if state is not None
+                    else []
+                )
+            all_starts.append(start)
+            snaps.save(
+                start_next,
+                arrays,
+                meta={
+                    "completed_iters": start_next,
+                    "num_carry": len(jax.tree.leaves(carry)),
+                    "fingerprint": fingerprint,
+                    "chunk_starts": all_starts,
+                },
+            )
+            start = start_next
+            chunks_done += 1
+            failpoints.hit("gbt.chunk")
+            if (
+                preempt_after_chunks is not None
+                and chunks_done >= preempt_after_chunks
+            ):
+                guard.trigger(signal.SIGTERM)
+            if guard.triggered:
+                # The snapshot just saved IS the forced final snapshot;
+                # exit resumable with a distinct (schedulable) outcome.
+                raise TrainingPreempted(
+                    f"training preempted by {guard.signal_name}: "
+                    f"snapshot at {start}/{num_trees} iterations in "
+                    f"{cache_dir!r} is resumable (resume_training=True)"
+                )
+            if early_stop_lookahead > 0 and nv_rows > 0:
+                # vls_seen covers iterations [0, start) including
+                # pre-resume chunks (re-seeded above), so argmin is an
+                # absolute index.
+                vls_seen.append(chunk_arrays["vls"])
+                if _early_stop_hit(vls_seen, start, early_stop_lookahead):
+                    break
+            if (
+                abort_after_chunks is not None
+                and chunks_done >= abort_after_chunks
+            ):
+                raise _TrainingAborted(
+                    f"aborted after {chunks_done} chunks "
+                    f"({start} iterations)"
+                )
+            if deadline is not None and time.monotonic() >= deadline:
                 break
-        if abort_after_chunks is not None and chunks_done >= abort_after_chunks:
-            raise _TrainingAborted(
-                f"aborted after {chunks_done} chunks ({start} iterations)"
-            )
-        if deadline is not None and time.monotonic() >= deadline:
-            break
 
     # Merge chunk payloads (linear, once).
     latest = snaps.latest()
@@ -1906,6 +1945,77 @@ def _train_gbt(
 class _TrainingAborted(RuntimeError):
     """Raised by the test-only abort hook (the reference injects failures
     the same way: MaybeSimulateFailure, worker.cc:415-452)."""
+
+
+class TrainingPreempted(RuntimeError):
+    """SIGTERM/SIGINT arrived during checkpointed training. The boosting
+    loop finished the in-flight chunk, saved its snapshot durably, and
+    exited RESUMABLE: rerun with resume_training=True to continue from
+    exactly where it stopped (bit-identical to an uninterrupted run).
+    Schedulers distinguish this from a crash by `exit_code` (wired up by
+    `python -m ydf_tpu.cli train`)."""
+
+    #: EX_TEMPFAIL: transient condition — reschedule the job.
+    exit_code = 75
+
+
+class _PreemptionGuard:
+    """Installs SIGTERM/SIGINT handlers around the checkpointed boosting
+    loop (main thread only — Python delivers signals there; tuner trials
+    on worker threads skip installation and keep the process handlers).
+    The handler only sets a flag: the loop checks it at each chunk
+    boundary, right after the snapshot save, so the forced "final
+    snapshot" of a preemption is always the one just made durable. A
+    second signal restores the previous handlers and re-delivers itself
+    — a wedged chunk can still be killed the default way."""
+
+    _SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self):
+        self.triggered = False
+        self.signal_name: Optional[str] = None
+        self._old = {}
+
+    def __enter__(self):
+        if threading.current_thread() is threading.main_thread():
+            for sig in self._SIGNALS:
+                try:
+                    self._old[sig] = signal.signal(sig, self._handle)
+                except (ValueError, OSError):
+                    pass  # exotic embedding: keep existing handlers
+        return self
+
+    def __exit__(self, *exc):
+        for sig, old in self._old.items():
+            try:
+                signal.signal(
+                    sig, old if old is not None else signal.SIG_DFL
+                )
+            except (ValueError, OSError, TypeError):
+                pass
+        self._old.clear()
+        return False
+
+    def trigger(self, signum: int) -> None:
+        """Flag a preemption (real handler and the _preempt_after_chunks
+        test hook share this path)."""
+        self.signal_name = signal.Signals(signum).name
+        self.triggered = True
+
+    def _handle(self, signum, frame):
+        if self.triggered:
+            # Second signal: restore the previous disposition and
+            # re-deliver — the user wants out NOW.
+            old = self._old.pop(signum, signal.SIG_DFL)
+            try:
+                signal.signal(
+                    signum, old if old is not None else signal.SIG_DFL
+                )
+            except (ValueError, OSError, TypeError):
+                signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+            return
+        self.trigger(signum)
 
 
 

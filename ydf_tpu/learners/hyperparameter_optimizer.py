@@ -21,6 +21,7 @@ lowest trial index) — the parallel winner equals the serial winner.
 from __future__ import annotations
 
 import copy
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
@@ -59,6 +60,8 @@ class HyperParameterOptimizerLearner(HyperparameterValidationMixin):
         workers: Optional[List[str]] = None,
         worker_timeout_s: float = 3600.0,
         worker_secret: Optional[bytes] = None,
+        worker_retry_attempts: int = 8,
+        worker_backoff_base_s: float = 0.25,
         random_seed: int = 1234,
     ):
         if tuner is not None and search_space is not None:
@@ -73,6 +76,11 @@ class HyperParameterOptimizerLearner(HyperparameterValidationMixin):
         self.workers = list(workers) if workers else None
         self.worker_timeout_s = worker_timeout_s
         self.worker_secret = worker_secret
+        # Per-trial retry policy (WorkerPool backoff/quarantine):
+        # transport failures back off exponentially (base·2^attempt,
+        # jittered) across up to worker_retry_attempts attempts.
+        self.worker_retry_attempts = worker_retry_attempts
+        self.worker_backoff_base_s = worker_backoff_base_s
         self.base_learner = base_learner
         self.tuner = tuner
         self.search_space = search_space
@@ -169,6 +177,8 @@ class HyperParameterOptimizerLearner(HyperparameterValidationMixin):
             wpool = WorkerPool(
                 self.workers, timeout_s=self.worker_timeout_s,
                 secret=self.worker_secret,
+                retry_attempts=self.worker_retry_attempts,
+                backoff_base_s=self.worker_backoff_base_s,
             )
             # Dead workers are pruned from the rotation up front
             # (reference distribute: the manager runs with the workers
@@ -191,14 +201,27 @@ class HyperParameterOptimizerLearner(HyperparameterValidationMixin):
                 # returns the signed primary-metric score (reference
                 # GenericWorker TrainModel+EvaluateModel). Fault
                 # tolerance mirrors the reference's distribute semantics
-                # (errors return to the manager, the run continues): a
-                # failed/unreachable worker is skipped and the trial
-                # retries on the next one; a restarted worker that lost
-                # its dataset cache gets it re-shipped.
+                # (errors return to the manager, the run continues),
+                # routed through the pool's retry policy: transport
+                # failures quarantine the worker with exponential
+                # backoff + jitter and move on; a quarantined worker is
+                # re-probed (ping) once its backoff expires, so a
+                # RESTARTED worker rejoins the rotation instead of being
+                # dropped for the run. A restarted worker that lost its
+                # dataset cache gets it re-shipped (need_data). The
+                # serving worker is recorded in the trial log.
                 last_err = None
-                for attempt in range(len(wpool.addresses)):
-                    w = i + attempt
-                    addr = wpool.addresses[w % len(wpool.addresses)]
+                start_at = i
+                for attempt in range(wpool.retry_attempts):
+                    if attempt:
+                        time.sleep(wpool.backoff_delay(attempt - 1))
+                    w = wpool.pick_worker(start_at)
+                    if w is None:
+                        last_err = last_err or ConnectionError(
+                            "all workers quarantined"
+                        )
+                        continue
+                    addr = wpool.addr_str(w)
                     try:
                         resp = wpool.request(w, {
                             "verb": "train_score",
@@ -206,6 +229,9 @@ class HyperParameterOptimizerLearner(HyperparameterValidationMixin):
                             "data_key": data_key,
                         })
                         if resp.get("need_data"):
+                            # Re-ship to the SAME worker, then retrain
+                            # there (one request per connection, so the
+                            # reload must stay pinned to w).
                             reload_resp = wpool.request(w, {
                                 "verb": "load_data", "key": data_key,
                                 "train_data": train_data,
@@ -218,6 +244,8 @@ class HyperParameterOptimizerLearner(HyperparameterValidationMixin):
                                     f"worker {addr} failed load_data: "
                                     f"{reload_resp}"
                                 )
+                                wpool.mark_failed(w)
+                                start_at = w + 1
                                 continue
                             resp = wpool.request(w, {
                                 "verb": "train_score",
@@ -233,21 +261,30 @@ class HyperParameterOptimizerLearner(HyperparameterValidationMixin):
                                     f"worker {addr} sent a malformed "
                                     f"response (ok but no 'score'): {resp}"
                                 )
+                                wpool.mark_failed(w)
+                                start_at = w + 1
                                 continue
+                            wpool.mark_ok(w)
                             return TrialLog(
-                                params=params, score=resp["score"]
+                                params=params, score=resp["score"],
+                                worker=addr,
                             )
                         # Task error (bad config): deterministic — no
-                        # point retrying elsewhere.
+                        # point retrying elsewhere. The worker itself is
+                        # healthy (it answered).
+                        wpool.mark_ok(w)
                         raise RuntimeError(
                             f"remote trial {i} failed on worker {addr}: "
                             f"{resp.get('error', f'malformed response {resp}')}"
                         )
                     except (OSError, ConnectionError) as e:
                         last_err = e
+                        wpool.mark_failed(w)
+                        start_at = w + 1
                         continue
                 raise RuntimeError(
-                    f"remote trial {i}: no reachable worker "
+                    f"remote trial {i}: no reachable worker after "
+                    f"{wpool.retry_attempts} attempts "
                     f"(last error: {last_err})"
                 )
             # Round-robin device placement: trial i trains on device

@@ -37,6 +37,10 @@ from ydf_tpu.dataset.dataset import Dataset
 class TrialLog:
     params: Dict[str, Any]
     score: float  # higher = better
+    #: "host:port" of the worker that served the trial (distributed
+    #: tuning only) — the tuning report records placement so a flaky
+    #: worker is attributable from the logs alone.
+    worker: Optional[str] = None
 
 
 def draw_trials(
@@ -103,7 +107,11 @@ def attach_tuner_logs(model, logs: List[TrialLog], best: TrialLog) -> None:
     model.extra_metadata["tuner_logs"] = {
         "best_params": best.params,
         "best_score": best.score,
-        "trials": [{"params": t.params, "score": t.score} for t in logs],
+        "trials": [
+            {"params": t.params, "score": t.score}
+            | ({"worker": t.worker} if t.worker is not None else {})
+            for t in logs
+        ],
     }
 
 

@@ -29,6 +29,8 @@ import threading
 import warnings
 from typing import Dict, Optional, Sequence
 
+from ydf_tpu.utils import failpoints
+
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
 NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 BUILD_DIR = os.path.join(NATIVE_DIR, "build")
@@ -160,6 +162,7 @@ class NativeLibrary:
             return
         if missing:
             raise FileNotFoundError(missing[0])
+        failpoints.hit("native.build")
         cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC"]
         if self.sanitize:
             cmd += list(_SANITIZE_MODES[self.sanitize])
@@ -189,6 +192,11 @@ class NativeLibrary:
             try:
                 self._build_if_needed()
                 self._lib = ctypes.CDLL(self.lib_path)
+            except failpoints.FailpointError as e:
+                # Injected fault: TRANSIENT by contract (failpoints fire
+                # once) — warn and fall back for this call, but do not
+                # latch _failed: the retry path is the point.
+                self._warn_once("build/load (injected)", e)
             except Exception as e:
                 self._failed = True
                 self._warn_once("build/load", e)
@@ -211,6 +219,7 @@ class NativeLibrary:
             if self._ffi_registered:
                 return True
             try:
+                failpoints.hit("native.register")
                 ffi = ffi_module()
                 for target, symbol in self.ffi_targets.items():
                     ffi.register_ffi_target(
@@ -219,6 +228,12 @@ class NativeLibrary:
                         platform="cpu",
                     )
                 self._ffi_registered = True
+            except failpoints.FailpointError as e:
+                # Injected registration fault is transient: callers see
+                # one unavailable() (→ XLA fallback, bit-identical) and
+                # the NEXT ensure_ffi_registered() retries and succeeds
+                # — the recovery the chaos suite asserts.
+                self._warn_once("ffi registration (injected)", e)
             except Exception as e:
                 self._failed = True
                 self._warn_once("ffi registration", e)

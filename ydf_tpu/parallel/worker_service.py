@@ -45,10 +45,14 @@ import hmac
 import hashlib
 import os
 import pickle
+import random
 import socket
 import struct
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
+
+from ydf_tpu.utils import failpoints
 
 _MAC_LEN = hashlib.sha256().digest_size  # 32
 
@@ -104,6 +108,19 @@ def _recv_msg(sock: socket.socket, secret: Optional[bytes] = None) -> Any:
 # resident across requests the same way (dataset_cache_reader.cc).
 _DATA_CACHE: Dict[str, Tuple[Any, Any]] = {}
 _DATA_CACHE_CAP = 4
+# Requests are handled on per-connection threads; cache mutations are
+# tiny (dict insert/evict) so one lock suffices.
+_DATA_CACHE_LOCK = threading.Lock()
+
+
+def _send_timeout() -> float:
+    """Deadline for sending one response frame. The accept loop used to
+    run the response send with NO timeout (settimeout(None) for
+    training), so a manager that died mid-request — or stopped reading
+    with a full TCP window — wedged the single-threaded worker forever.
+    Connections are now handled on their own threads AND every send is
+    bounded."""
+    return float(os.environ.get("YDF_TPU_WORKER_SEND_TIMEOUT", 120.0))
 
 
 def _handle_request(req: Dict[str, Any]) -> Dict[str, Any]:
@@ -116,22 +133,27 @@ def _handle_request(req: Dict[str, Any]) -> Dict[str, Any]:
     if verb == "ping":
         return {"ok": True}
     if verb == "load_data":
-        if len(_DATA_CACHE) >= _DATA_CACHE_CAP:
-            _DATA_CACHE.pop(next(iter(_DATA_CACHE)))
-        _DATA_CACHE[req["key"]] = (req["train_data"], req["holdout_data"])
+        with _DATA_CACHE_LOCK:
+            if len(_DATA_CACHE) >= _DATA_CACHE_CAP:
+                _DATA_CACHE.pop(next(iter(_DATA_CACHE)))
+            _DATA_CACHE[req["key"]] = (
+                req["train_data"], req["holdout_data"],
+            )
         return {"ok": True}
     if verb == "train_score":
         from ydf_tpu.analysis.importance import _primary_metric
 
         if "data_key" in req:
-            if req["data_key"] not in _DATA_CACHE:
+            with _DATA_CACHE_LOCK:
+                pair = _DATA_CACHE.get(req["data_key"])
+            if pair is None:
                 return {
                     "ok": False,
                     "error": f"unknown data_key {req['data_key']!r} "
                     "(worker restarted? resend load_data)",
                     "need_data": True,
                 }
-            train_data, holdout_data = _DATA_CACHE[req["data_key"]]
+            train_data, holdout_data = pair
         else:
             train_data, holdout_data = req["train_data"], req["holdout_data"]
         learner = req["learner"]
@@ -159,30 +181,70 @@ def start_worker(
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     srv.bind((host, port))
     srv.listen(16)
+    stop_evt = threading.Event()
+
+    def serve_conn(conn: socket.socket) -> None:
+        """One connection, on its own thread: a stalled or dead manager
+        wedges only this thread, never the accept loop (the old
+        single-threaded loop ran the response send with settimeout(None)
+        — one bad peer blocked every other manager forever)."""
+        try:
+            # Idle timeout per recv chunk: a peer that connects and
+            # sends nothing must not pin a handler thread forever.
+            # Legit large frames stream continuously, so this does not
+            # bound request size.
+            conn.settimeout(120.0)
+            failpoints.hit("worker.recv")
+            req = _recv_msg(conn, secret)
+            conn.settimeout(None)  # training can take hours
+            failpoints.hit("worker.handle")
+            try:
+                resp = _handle_request(req)
+            except Exception as e:  # worker stays alive on task errors
+                resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            # Send deadline: a manager that vanished after sending its
+            # request (full TCP window, half-open connection) must not
+            # pin this thread past the timeout.
+            conn.settimeout(_send_timeout())
+            failpoints.hit("worker.send")
+            _send_msg(conn, resp, secret)
+            if resp.get("shutdown"):
+                stop_evt.set()
+                # Wake the accept loop: closing a listening socket
+                # another thread is blocked in accept() on is not
+                # guaranteed to unblock it — poke it with a no-op
+                # connection instead.
+                whost, wport = srv.getsockname()[:2]
+                if whost == "0.0.0.0":
+                    whost = "127.0.0.1"
+                try:
+                    with socket.create_connection(
+                        (whost, wport), timeout=5
+                    ):
+                        pass
+                except OSError:
+                    pass
+        except Exception:
+            pass  # malformed/broken/unauthenticated/stalled: drop conn
+        finally:
+            conn.close()
 
     def loop():
-        stop = False
-        while not stop:
-            conn, _ = srv.accept()
+        while not stop_evt.is_set():
             try:
-                # Idle timeout per recv/send chunk: a peer that connects
-                # and sends nothing must not starve the accept loop
-                # forever. Legit large frames stream continuously, so
-                # this does not bound request size or training time.
-                conn.settimeout(120.0)
-                req = _recv_msg(conn, secret)
-                conn.settimeout(None)  # training can take hours
-                try:
-                    resp = _handle_request(req)
-                except Exception as e:  # worker stays alive on task errors
-                    resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
-                _send_msg(conn, resp, secret)
-                stop = bool(resp.get("shutdown"))
-            except Exception:
-                pass  # malformed/broken/unauthenticated: keep serving
-            finally:
-                conn.close()
-        srv.close()
+                conn, _ = srv.accept()
+            except OSError:
+                break  # server socket closed
+            if stop_evt.is_set():
+                conn.close()  # the shutdown wake-up poke
+                break
+            threading.Thread(
+                target=serve_conn, args=(conn,), daemon=True
+            ).start()
+        try:
+            srv.close()
+        except OSError:
+            pass
 
     if blocking:
         loop()
@@ -196,10 +258,24 @@ class WorkerPool:
     """Round-robin client over worker addresses ("host:port"). One
     request per connection — the simplest protocol that is also robust
     to worker restarts between trials (the reference re-instantiates
-    workers across manager restarts the same way, distribute.h:52-66)."""
+    workers across manager restarts the same way, distribute.h:52-66).
+
+    Fault tolerance (reference distribute semantics, made explicit):
+    transport failures quarantine the worker with exponential backoff —
+    doubling per consecutive failure, capped, jittered so a fleet of
+    managers never retries in lockstep — and a quarantined worker is
+    re-PROBED with a short ping once its backoff expires, returning to
+    rotation on success (a restarted worker is healed, not permanently
+    dropped). `request_retry` wraps one logical request in that policy;
+    `pick_worker`/`mark_failed`/`mark_ok`/`backoff_delay` expose the
+    pieces for callers with their own retry structure (the tuner's
+    need_data re-ship)."""
 
     def __init__(self, addresses: List[str], timeout_s: float = 3600.0,
-                 secret: Optional[bytes] = None):
+                 secret: Optional[bytes] = None,
+                 retry_attempts: int = 8,
+                 backoff_base_s: float = 0.25,
+                 backoff_max_s: float = 30.0):
         if not addresses:
             raise ValueError("empty worker address list")
         self.addresses: List[Tuple[str, int]] = []
@@ -208,6 +284,17 @@ class WorkerPool:
             self.addresses.append((host or "127.0.0.1", int(port)))
         self.timeout_s = timeout_s
         self.secret = secret if secret is not None else _env_secret()
+        self.retry_attempts = retry_attempts
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        # Per-worker health, keyed by (host, port) so ping_all's address
+        # pruning can't misalign it: consecutive failure count and the
+        # monotonic deadline until which the worker is quarantined.
+        self._health: Dict[Tuple[str, int], Dict[str, float]] = {}
+        self._health_lock = threading.Lock()
+        # Jitter only — never part of any result, so an unseeded RNG
+        # keeps trial outcomes deterministic.
+        self._jitter = random.Random(0xFA17)
 
     def request(
         self, i: int, req: Dict[str, Any],
@@ -220,6 +307,104 @@ class WorkerPool:
             _send_msg(sock, req, self.secret)
             return _recv_msg(sock, self.secret)
 
+    # ---- retry / backoff / quarantine ------------------------------- #
+
+    def addr_str(self, i: int) -> str:
+        host, port = self.addresses[i % len(self.addresses)]
+        return f"{host}:{port}"
+
+    def backoff_delay(self, attempt: int) -> float:
+        """Exponential backoff with full jitter for the given 0-based
+        attempt: base·2^attempt scaled by U[0.5, 1.5), capped."""
+        d = min(
+            self.backoff_max_s, self.backoff_base_s * (2.0 ** attempt)
+        )
+        return d * (0.5 + self._jitter.random())
+
+    def mark_failed(self, i: int) -> None:
+        """Records a transport failure: the worker is quarantined for a
+        backoff that doubles with each consecutive failure."""
+        addr = self.addresses[i % len(self.addresses)]
+        with self._health_lock:
+            st = self._health.setdefault(addr, {"fails": 0, "until": 0.0})
+            st["fails"] += 1
+            hold = min(
+                self.backoff_max_s,
+                self.backoff_base_s * (2.0 ** (st["fails"] - 1)),
+            ) * (0.5 + self._jitter.random())
+            st["until"] = time.monotonic() + hold
+
+    def mark_ok(self, i: int) -> None:
+        addr = self.addresses[i % len(self.addresses)]
+        with self._health_lock:
+            self._health.pop(addr, None)
+
+    def pick_worker(self, start: int) -> Optional[int]:
+        """Next usable worker index at/after `start` (round-robin).
+        Skips quarantined workers; one whose quarantine has EXPIRED is
+        re-probed with a short ping first — success heals it, failure
+        re-quarantines with a doubled backoff. None when every worker
+        is currently quarantined (caller backs off and retries)."""
+        n = len(self.addresses)
+        for off in range(n):
+            i = (start + off) % n
+            addr = self.addresses[i]
+            with self._health_lock:
+                st = self._health.get(addr)
+                if st is not None and st["until"] > time.monotonic():
+                    continue  # still quarantined
+                needs_probe = st is not None and st["fails"] > 0
+            if not needs_probe:
+                return i
+            try:
+                resp = self.request(
+                    i, {"verb": "ping"},
+                    timeout_s=min(10.0, self.timeout_s),
+                )
+                if resp.get("ok"):
+                    self.mark_ok(i)
+                    return i
+                self.mark_failed(i)
+            except (OSError, ConnectionError):
+                self.mark_failed(i)
+        return None
+
+    def request_retry(
+        self, i: int, req: Dict[str, Any],
+        timeout_s: Optional[float] = None,
+    ) -> Tuple[Dict[str, Any], int]:
+        """`request` under the retry policy: up to `retry_attempts`
+        transport attempts across the rotation with exponential backoff
+        + jitter between them. Returns (response, index of the worker
+        that served it); raises ConnectionError when every attempt
+        failed. Protocol-level errors (ok=False responses) are returned
+        to the caller untouched — they are the worker speaking, not the
+        transport failing."""
+        last_err: Optional[BaseException] = None
+        start = i
+        for attempt in range(self.retry_attempts):
+            if attempt:
+                time.sleep(self.backoff_delay(attempt - 1))
+            idx = self.pick_worker(start)
+            if idx is None:
+                last_err = last_err or ConnectionError(
+                    "all workers quarantined"
+                )
+                continue
+            try:
+                resp = self.request(idx, req, timeout_s=timeout_s)
+            except (OSError, ConnectionError) as e:
+                last_err = e
+                self.mark_failed(idx)
+                start = idx + 1
+                continue
+            self.mark_ok(idx)
+            return resp, idx
+        raise ConnectionError(
+            f"request failed on every attempt "
+            f"({self.retry_attempts}); last error: {last_err}"
+        )
+
     def ping_all(self, drop_unreachable: bool = False) -> None:
         """Health check. drop_unreachable=True prunes dead addresses
         from the rotation instead of raising (the manager keeps going
@@ -228,19 +413,29 @@ class WorkerPool:
         alive = []
         errors = []
         for i, addr in enumerate(self.addresses):
-            try:
-                # Health checks use a short timeout — a blackholed host
-                # must not stall startup for the full job timeout.
-                resp = self.request(
-                    i, {"verb": "ping"},
-                    timeout_s=min(10.0, self.timeout_s),
-                )
-                if resp.get("ok"):
-                    alive.append(addr)
-                else:
-                    errors.append((addr, str(resp)))
-            except OSError as e:
-                errors.append((addr, f"{type(e).__name__}: {e}"))
+            last = None
+            # One short retry per host: a single dropped SYN/frame must
+            # not eject a healthy worker from the whole run.
+            for attempt in range(2):
+                if attempt:
+                    time.sleep(self.backoff_delay(0))
+                try:
+                    # Health checks use a short timeout — a blackholed
+                    # host must not stall startup for the full job
+                    # timeout.
+                    resp = self.request(
+                        i, {"verb": "ping"},
+                        timeout_s=min(10.0, self.timeout_s),
+                    )
+                    if resp.get("ok"):
+                        alive.append(addr)
+                        last = None
+                        break
+                    last = (addr, str(resp))
+                except OSError as e:
+                    last = (addr, f"{type(e).__name__}: {e}")
+            if last is not None:
+                errors.append(last)
         if not drop_unreachable and errors:
             raise ConnectionError(f"workers failed ping: {errors}")
         if not alive:
@@ -256,12 +451,37 @@ class WorkerPool:
     def load_data_all(self, key: str, train_data, holdout_data) -> None:
         """Ships the dataset pair to every worker ONCE; trial requests
         then reference it by key instead of re-pickling gigabytes per
-        trial."""
+        trial. Transport failures retry (pinned to the worker — the data
+        must land on THAT host) with backoff; a worker that stays
+        unreachable is quarantined and tolerated: the trial-time
+        need_data re-ship recovers it if it comes back."""
+        import warnings
+
         for i in range(len(self.addresses)):
-            resp = self.request(i, {
-                "verb": "load_data", "key": key,
-                "train_data": train_data, "holdout_data": holdout_data,
-            })
+            resp = None
+            last_err: Optional[BaseException] = None
+            for attempt in range(min(3, self.retry_attempts)):
+                if attempt:
+                    time.sleep(self.backoff_delay(attempt - 1))
+                try:
+                    resp = self.request(i, {
+                        "verb": "load_data", "key": key,
+                        "train_data": train_data,
+                        "holdout_data": holdout_data,
+                    })
+                    last_err = None
+                    break
+                except (OSError, ConnectionError) as e:
+                    last_err = e
+            if last_err is not None:
+                self.mark_failed(i)
+                warnings.warn(
+                    f"worker {self.addr_str(i)} unreachable during "
+                    f"load_data ({last_err}); it is quarantined and the "
+                    "data will be re-shipped on demand if it returns",
+                    RuntimeWarning, stacklevel=2,
+                )
+                continue
             if not resp.get("ok"):
                 raise ConnectionError(
                     f"worker {self.addresses[i]} failed load_data: {resp}"

@@ -1,0 +1,237 @@
+"""Process-wide fault-injection registry (failpoints).
+
+Counterpart of the reference's fault-injection hook (MaybeSimulateFailure,
+`ydf/utils/distribute/implementations/.../worker.cc:415-452` — a counter
+that kills the worker on the N-th call), generalized into *named
+injection sites* threaded through every recovery path this repo claims
+to have: dataset-cache IO, snapshot save/load, worker RPC framing,
+native kernel build/registration, and the boosting loop's chunk
+boundary. The chaos suite (tests/test_chaos.py) drives randomized fault
+schedules through these sites and asserts the recovered result is
+bit-identical to the fault-free run.
+
+Two ways to arm a failpoint, both speaking the same grammar:
+
+  * Environment (whole-process, e.g. a training subprocess):
+
+        YDF_TPU_FAILPOINTS="cache.write_chunk=error@2;worker.recv=drop_conn"
+
+    Parsed and validated EAGERLY at import (same policy as
+    YDF_TPU_HIST_IMPL): a typo'd site or action raises ValueError at the
+    env boundary, never a silently-inert chaos run.
+
+  * Programmatic (tests):
+
+        with failpoints.active("snapshot.save=torn_write"):
+            ...
+
+Grammar: `site=action[@N]` entries joined by `;`. `@N` arms the spec on
+the N-th hit of the site (1-based, default 1); every spec fires exactly
+once, so a retried/resumed operation passes — which is precisely what
+the recovery tests need to assert.
+
+Actions:
+
+  error       raise FailpointError at the armed hit.
+  fail_once   alias of `error@1` (reads better for registration-style
+              sites that are retried, e.g. native.register).
+  drop_conn   raise ConnectionError — sites on the worker RPC path see a
+              realistic transport failure instead of a foreign exception.
+  torn_write  cooperative: hit() RETURNS "torn_write" and the site is
+              responsible for simulating a crash mid-write (truncate the
+              payload, then raise FailpointError). Only sites that
+              document torn-write support accept it.
+
+Overhead contract: with YDF_TPU_FAILPOINTS unset, every instrumented
+site costs one module-global boolean check (`ENABLED`, computed once at
+import — never a per-call os.environ read) plus a function call at
+chunk/RPC granularity; the headline bench is unaffected (acceptance
+criterion of the robustness PR).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import threading
+from typing import Dict, List, Optional
+
+__all__ = [
+    "FailpointError",
+    "KNOWN_SITES",
+    "ENABLED",
+    "hit",
+    "active",
+    "parse",
+    "fired_sites",
+]
+
+
+class FailpointError(RuntimeError):
+    """An injected fault (actions `error` / `fail_once`, and the raise
+    half of a cooperative `torn_write`). Deliberately NOT an OSError
+    subclass: recovery paths that catch IO errors must be exercised via
+    `drop_conn`, while FailpointError models an abrupt crash."""
+
+
+#: Every instrumented site. parse() validates against this set so a
+#: chaos schedule can never silently name a site that nothing hits.
+KNOWN_SITES = frozenset(
+    {
+        # dataset/cache.py — per-chunk write of pass 2, and the final
+        # (atomic) cache_meta.json publish.
+        "cache.write_chunk",
+        "cache.finalize",
+        # utils/snapshot.py — payload write (torn_write-capable) and the
+        # index update that follows it.
+        "snapshot.save",
+        "snapshot.index",
+        # parallel/worker_service.py — worker-side request recv, the
+        # window between recv and execution, and the response send.
+        "worker.recv",
+        "worker.handle",
+        "worker.send",
+        # ops/native_ffi.py — kernel compile and XLA FFI registration.
+        "native.build",
+        "native.register",
+        # learners/gbt.py — checkpointed boosting loop, after each
+        # chunk's snapshot is durably saved.
+        "gbt.chunk",
+    }
+)
+
+#: Sites that implement the cooperative torn_write action.
+TORN_WRITE_SITES = frozenset({"snapshot.save"})
+
+_ACTIONS = ("error", "fail_once", "drop_conn", "torn_write")
+
+
+@dataclasses.dataclass
+class _Spec:
+    site: str
+    action: str
+    at: int  # 1-based hit index the spec arms on
+    hits: int = 0
+    fired: bool = False
+
+
+def parse(spec: str) -> Dict[str, _Spec]:
+    """Parses a failpoint schedule string into {site: _Spec}, validating
+    sites, actions and counts eagerly. Empty/blank input → {}."""
+    out: Dict[str, _Spec] = {}
+    for entry in (spec or "").split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        site, sep, action = entry.partition("=")
+        site = site.strip()
+        action = action.strip()
+        if not sep or not action:
+            raise ValueError(
+                f"YDF_TPU_FAILPOINTS entry {entry!r} is not of the form "
+                "'site=action[@N]'"
+            )
+        if site not in KNOWN_SITES:
+            raise ValueError(
+                f"YDF_TPU_FAILPOINTS names unknown site {site!r}; "
+                f"known sites: {sorted(KNOWN_SITES)}"
+            )
+        at = 1
+        if "@" in action:
+            action, _, n = action.partition("@")
+            action = action.strip()
+            n = n.strip()
+            if not n.isdigit() or int(n) < 1:
+                raise ValueError(
+                    f"YDF_TPU_FAILPOINTS count {n!r} for site {site!r} "
+                    "must be a positive integer"
+                )
+            at = int(n)
+        if action not in _ACTIONS:
+            raise ValueError(
+                f"YDF_TPU_FAILPOINTS action {action!r} for site {site!r} "
+                f"is not one of {list(_ACTIONS)}"
+            )
+        if action == "fail_once":
+            action = "error"
+            # fail_once always means "the first hit" regardless of @N.
+            at = 1
+        if action == "torn_write" and site not in TORN_WRITE_SITES:
+            raise ValueError(
+                f"site {site!r} does not support torn_write (supported: "
+                f"{sorted(TORN_WRITE_SITES)}); use 'error' instead"
+            )
+        if site in out:
+            raise ValueError(
+                f"YDF_TPU_FAILPOINTS lists site {site!r} twice"
+            )
+        out[site] = _Spec(site=site, action=action, at=at)
+    return out
+
+
+_LOCK = threading.Lock()
+# Eager env parse at import: a malformed schedule fails the first
+# ydf_tpu import of the process, not the Nth training hour.
+_SPECS: Dict[str, _Spec] = parse(os.environ.get("YDF_TPU_FAILPOINTS", ""))
+
+#: Module-level constant when env-driven; flipped only by the
+#: programmatic `active()` context manager. Sites read it through the
+#: module (`failpoints.ENABLED`) so both stay O(attribute lookup).
+ENABLED: bool = bool(_SPECS)
+
+
+def hit(site: str) -> Optional[str]:
+    """Called by an instrumented site. Free no-op unless a spec is armed
+    for `site`. Raising actions raise here (FailpointError for error,
+    ConnectionError for drop_conn); the cooperative torn_write action is
+    RETURNED for the site to act on. Returns None when nothing fires."""
+    if not ENABLED:
+        return None
+    with _LOCK:
+        sp = _SPECS.get(site)
+        if sp is None or sp.fired:
+            return None
+        sp.hits += 1
+        if sp.hits != sp.at:
+            return None
+        sp.fired = True
+        action, at = sp.action, sp.at
+    if action == "error":
+        raise FailpointError(f"injected fault at {site!r} (hit {at})")
+    if action == "drop_conn":
+        raise ConnectionError(
+            f"injected connection drop at {site!r} (hit {at})"
+        )
+    return action  # "torn_write"
+
+
+def fired_sites() -> List[str]:
+    """Sites of the CURRENTLY ARMED schedule whose spec has fired —
+    chaos tests assert their schedule actually exercised the paths it
+    named. Scoped with the schedule: `active()` arms fresh (unfired)
+    specs and restores the previous set on exit."""
+    with _LOCK:
+        return [s.site for s in _SPECS.values() if s.fired]
+
+
+@contextlib.contextmanager
+def active(spec: str):
+    """Arms `spec` (same grammar as the env var) for the duration of the
+    with-block, on top of whatever is already armed; previous state is
+    restored on exit. Thread-safe to *hit* concurrently, but nest/enter
+    from one test thread at a time."""
+    global _SPECS, ENABLED
+    new = parse(spec)
+    with _LOCK:
+        old_specs, old_enabled = _SPECS, ENABLED
+        merged = dict(old_specs)
+        merged.update(new)
+        _SPECS = merged
+        ENABLED = True
+    try:
+        yield new
+    finally:
+        with _LOCK:
+            _SPECS = old_specs
+            ENABLED = old_enabled
