@@ -71,6 +71,51 @@ _START = time.time()
 # (rc != 0) do NOT set it: those probes are cheap and the tunnel may
 # still come up.
 _PROBE_TIMED_OUT = False
+# Whether this run's probe outcome came from the ON-DISK cache below
+# (emitted as `probe_cached` on the headline record).
+_PROBE_CACHED = False
+
+# On-disk probe cache with a TTL: the in-run negative flag above still
+# let EVERY round re-burn one full 240 s timeout on the same hung
+# tunnel (BENCH_r02-r05). The outcome — positive or negative — is
+# persisted next to this file and honored across runs while fresh.
+PROBE_CACHE_PATH = os.environ.get(
+    "YDF_TPU_PROBE_CACHE",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 ".probe_cache.json"),
+)
+PROBE_CACHE_TTL_S = float(os.environ.get("YDF_TPU_PROBE_TTL_S", 3600))
+
+
+def _probe_cache_load():
+    """Fresh cached probe outcome, or None. Entry shape:
+    {"backend": str|None, "timed_out": bool, "ts": epoch_seconds}."""
+    try:
+        with open(PROBE_CACHE_PATH) as f:
+            entry = json.load(f)
+        age = time.time() - float(entry["ts"])
+        if 0 <= age < PROBE_CACHE_TTL_S:
+            entry["age_s"] = round(age, 1)
+            return entry
+    except (OSError, ValueError, KeyError, TypeError):
+        pass
+    return None
+
+
+def _probe_cache_store(backend, timed_out):
+    """Persists a probe outcome (best-effort — a read-only checkout
+    must not fail the bench)."""
+    try:
+        tmp = PROBE_CACHE_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"backend": backend, "timed_out": bool(timed_out),
+                 "ts": time.time()},
+                f,
+            )
+        os.replace(tmp, PROBE_CACHE_PATH)
+    except OSError:
+        pass
 
 
 def emit(record):
@@ -106,7 +151,26 @@ def probe_backend(probe_log, attempts=2, timeout_s=240):
     is appended to `probe_log`, which ships inside the emitted JSON.
     Returns the backend name ("tpu", "axon", ...) or None.
     """
-    global _PROBE_TIMED_OUT
+    global _PROBE_TIMED_OUT, _PROBE_CACHED
+    cached = _probe_cache_load()
+    if cached is not None:
+        # Honor a fresh on-disk outcome — positive or negative — instead
+        # of re-burning the probe (and, for a hung tunnel, its full
+        # timeout) every round. Delete the file or set
+        # YDF_TPU_PROBE_TTL_S=0 to force a live probe.
+        _PROBE_CACHED = True
+        if cached.get("timed_out"):
+            _PROBE_TIMED_OUT = True
+        probe_log.append(
+            {
+                "t_offset_s": round(time.time() - _START, 1),
+                "cached": True,
+                "age_s": cached.get("age_s"),
+                "backend": cached.get("backend"),
+                "timed_out": bool(cached.get("timed_out")),
+            }
+        )
+        return cached.get("backend")
     code = "import jax; print(jax.default_backend())"
     for i in range(attempts):
         if _PROBE_TIMED_OUT:
@@ -134,12 +198,16 @@ def probe_backend(probe_log, attempts=2, timeout_s=240):
                 name = out.stdout.strip().splitlines()[-1]
                 entry["backend"] = name
                 probe_log.append(entry)
+                _probe_cache_store(name, timed_out=False)
                 return name
             entry["stderr_tail"] = " | ".join(tail)
         except subprocess.TimeoutExpired as e:
             entry["seconds"] = round(time.time() - t0, 1)
             entry["timeout"] = True
             _PROBE_TIMED_OUT = True
+            # Persist the negative outcome so the NEXT round skips the
+            # hang too (TTL-bounded; positive probes overwrite it).
+            _probe_cache_store(None, timed_out=True)
             if e.stderr:
                 stderr = e.stderr if isinstance(e.stderr, str) else e.stderr.decode(
                     "utf-8", "replace"
@@ -564,11 +632,16 @@ def run_bench(backend, rows, trees, depth, features, with_baseline, probe_log):
         # Batched inference throughput on the same model (reference
         # benchmark_inference.cc's ns/example) — any backend; reuses the
         # warmup + best-of-runs measurement in model.benchmark().
+        # p50/p99 come from the serving latency histogram
+        # (utils/telemetry.LatencyHistogram over the per-run walls) —
+        # the percentile guard ROADMAP item 1 (serving at traffic)
+        # regresses against, next to the historical best-of-runs floor.
         n_inf = min(rows, 100_000)
         sample = {k: v[:n_inf] for k, v in data.items()}
-        record["infer_ns_per_example"] = round(
-            model.benchmark(sample, num_runs=3)["ns_per_example"], 1
-        )
+        bres = model.benchmark(sample, num_runs=10)
+        record["infer_ns_per_example"] = round(bres["ns_per_example"], 1)
+        record["infer_p50_ns"] = round(bres["p50_ns_per_example"], 1)
+        record["infer_p99_ns"] = round(bres["p99_ns_per_example"], 1)
         _PARTIAL = dict(record)
     except Exception as e:
         record["infer_extra_error"] = f"{type(e).__name__}: {e}"
@@ -877,6 +950,7 @@ def main():
         probe_log=probe_log,
     )
     record["probe_attempts"] = probe_log
+    record["probe_cached"] = _PROBE_CACHED
     # Device-less TPU evidence (VERDICT r4 #1c): an analytic roofline
     # projection from the real lowering's cost analysis rides along even
     # when the tunnel is down. Emitted BEFORE the measured record — the
@@ -940,6 +1014,7 @@ def main():
                 if k in record
             }
             tpu_rec["probe_attempts"] = probe_log
+            tpu_rec["probe_cached"] = _PROBE_CACHED
             if record.get("baseline_rows_trees_per_sec"):
                 # Same-box sklearn baseline (measured at the CPU shape),
                 # rescaled per rows*trees/s — shape-normalized comparison.

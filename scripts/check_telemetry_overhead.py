@@ -1,0 +1,124 @@
+"""Telemetry overhead guard: the instrumentation may never eat the perf
+wins of rounds 6-9.
+
+Trains the small smoke family three ways — telemetry disabled (twice,
+bracketing, so run-to-run noise is measured rather than assumed) and
+fully enabled (registry + span recording + export armed to a temp dir)
+— on ONE shared dataset and learner config so every timed call hits the
+cached jitted boosting loop, and asserts
+
+  * disabled-path overhead is below noise: the enabled/disabled check
+    uses the MEASURED noise between the two disabled batches as part of
+    its budget, so a quiet box enforces close to the raw 3 %;
+  * enabled-path overhead < 3 % of the disabled steady-state train wall
+    (plus the noise term and a small absolute floor — at smoke shapes a
+    3 % margin alone is sub-noise).
+
+Exit code 0 and a JSON summary line on success; non-zero with the same
+summary on failure. Run standalone
+
+    JAX_PLATFORMS=cpu python scripts/check_telemetry_overhead.py
+
+or bigger (tighter, slower): `--rows 200000 --trees 20 --reps 5`.
+tests/test_telemetry_overhead.py runs the small config in tier-1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+
+def measure_min_wall(train_once, reps: int) -> float:
+    walls = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        train_once()
+        walls.append(time.perf_counter() - t0)
+    return min(walls)
+
+
+def run_check(
+    rows: int = 12_000,
+    trees: int = 10,
+    depth: int = 4,
+    features: int = 8,
+    reps: int = 3,
+    rel_budget: float = 0.03,
+    abs_floor_s: float = 0.08,
+) -> dict:
+    import numpy as np
+
+    import ydf_tpu as ydf
+    from ydf_tpu.dataset.dataset import Dataset
+    from ydf_tpu.utils import telemetry
+
+    rng = np.random.RandomState(0)
+    x = rng.normal(size=(rows, features)).astype(np.float32)
+    y = (x[:, 0] - 0.5 * x[:, 1] + rng.normal(size=rows) > 0).astype(
+        np.int64
+    )
+    data = {f"f{i}": x[:, i] for i in range(features)}
+    data["label"] = y
+    ds = Dataset.from_data(data, label="label")
+
+    def train_once():
+        ydf.GradientBoostedTreesLearner(
+            label="label", num_trees=trees, max_depth=depth,
+            validation_ratio=0.0, early_stopping="NONE",
+        ).train(ds)
+
+    train_once()  # compile + cold binning: excluded, like bench.py
+
+    disabled_a = measure_min_wall(train_once, reps)
+    td = tempfile.mkdtemp(prefix="ydf_tel_overhead_")
+    try:
+        with telemetry.active(td):
+            enabled = measure_min_wall(train_once, reps)
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
+    disabled_b = measure_min_wall(train_once, reps)
+
+    disabled = min(disabled_a, disabled_b)
+    noise = abs(disabled_a - disabled_b)
+    overhead = enabled - disabled
+    budget = rel_budget * disabled + noise + abs_floor_s
+    summary = {
+        "rows": rows,
+        "trees": trees,
+        "reps": reps,
+        "disabled_min_s": round(disabled, 4),
+        "disabled_noise_s": round(noise, 4),
+        "enabled_min_s": round(enabled, 4),
+        "overhead_s": round(overhead, 4),
+        "overhead_rel": round(overhead / disabled, 4) if disabled else 0.0,
+        "budget_s": round(budget, 4),
+        "ok": overhead <= budget,
+    }
+    return summary
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, default=12_000)
+    ap.add_argument("--trees", type=int, default=10)
+    ap.add_argument("--depth", type=int, default=4)
+    ap.add_argument("--features", type=int, default=8)
+    ap.add_argument("--reps", type=int, default=3)
+    args = ap.parse_args(argv)
+    summary = run_check(
+        rows=args.rows, trees=args.trees, depth=args.depth,
+        features=args.features, reps=args.reps,
+    )
+    print(json.dumps(summary))
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(main())

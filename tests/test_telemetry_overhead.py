@@ -1,0 +1,30 @@
+"""Tier-1 wrapper for scripts/check_telemetry_overhead.py: the
+instrumentation must never silently eat the perf wins of rounds 6-9.
+The script measures its own run-to-run noise (two bracketing disabled
+batches) and budgets 3 % + noise + a small absolute floor, so this stays
+meaningful without being a CI flake."""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "check_telemetry_overhead.py")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "check_telemetry_overhead", SCRIPT
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_enabled_overhead_within_budget():
+    mod = _load()
+    summary = mod.run_check(rows=8_000, trees=8, depth=4, reps=2)
+    assert summary["disabled_min_s"] > 0
+    assert summary["ok"], (
+        "telemetry enabled-path overhead exceeded its budget: "
+        f"{summary}"
+    )

@@ -44,7 +44,12 @@ def cmd_train(args):
     _force_cpu_if_requested(args)
     import ydf_tpu as ydf
     from ydf_tpu.config import Task
+    from ydf_tpu.utils import log, telemetry
 
+    if getattr(args, "telemetry_dir", None):
+        # Post-import arming (the env var is parsed before argv exists);
+        # train() flushes the trace + metrics dump there.
+        telemetry.configure(directory=args.telemetry_dir)
     cls = getattr(ydf, _LEARNERS[args.learner])
     kwargs = json.loads(args.hyperparameters) if args.hyperparameters else {}
     if args.learner == "ISOLATION_FOREST":
@@ -70,11 +75,14 @@ def cmd_train(args):
         from ydf_tpu.learners.gbt import TrainingPreempted
 
         if isinstance(e, TrainingPreempted):
-            print(f"preempted: {e}", file=sys.stderr)
+            log.info(f"preempted: {e}")
             sys.exit(TrainingPreempted.exit_code)
         raise
-    print(f"Trained in {time.time() - t0:.2f}s", file=sys.stderr)
+    log.info(f"Trained in {time.time() - t0:.2f}s")
     model.save(args.output)
+    if getattr(args, "telemetry_dir", None):
+        telemetry.flush()
+        log.info(f"telemetry written to {args.telemetry_dir}")
     print(f"Model saved to {args.output}")
 
 
@@ -415,6 +423,11 @@ def main(argv=None):
     p.add_argument("--resume", action="store_true",
                    help="resume from the latest snapshot in "
                         "--working_dir")
+    p.add_argument("--telemetry_dir",
+                   help="write chrome-tracing spans + a Prometheus "
+                        "metrics dump here (same as "
+                        "YDF_TPU_TELEMETRY_DIR; see "
+                        "docs/observability.md)")
     p.add_argument("--cpu", action="store_true")
     p.set_defaults(fn=cmd_train)
 

@@ -33,7 +33,7 @@ from typing import Dict, Iterator, List, Optional
 
 import numpy as np
 
-from ydf_tpu.utils import failpoints
+from ydf_tpu.utils import failpoints, telemetry
 
 from ydf_tpu.config import Task
 from ydf_tpu.dataset.binning import Binner
@@ -200,6 +200,8 @@ def _try_reuse_cache(
     try:
         cache = DatasetCache(cache_dir, verify="full")
     except CacheCorruptionError as e:
+        if telemetry.ENABLED:
+            telemetry.counter("ydf_cache_rebuild_total").inc()
         warnings.warn(
             f"existing dataset cache in {cache_dir!r} failed integrity "
             f"verification ({e}); rebuilding it",
@@ -285,8 +287,18 @@ class DatasetCache:
         integrity = self._meta.get("integrity")
         if not integrity:
             return
-        for name, rec in integrity["files"].items():
-            _verify_file(os.path.join(self.path, name), rec, full)
+        if telemetry.ENABLED:
+            telemetry.counter(
+                "ydf_cache_verify_total",
+                mode="full" if full else "size",
+            ).inc()
+        try:
+            for name, rec in integrity["files"].items():
+                _verify_file(os.path.join(self.path, name), rec, full)
+        except CacheCorruptionError:
+            if telemetry.ENABLED:
+                telemetry.counter("ydf_cache_corruption_total").inc()
+            raise
 
     @property
     def bins(self) -> np.ndarray:
@@ -677,6 +689,11 @@ def create_dataset_cache(
             for name in data_files
         },
     }
+    if telemetry.ENABLED:
+        telemetry.counter("ydf_cache_builds_total").inc()
+        telemetry.counter("ydf_cache_bytes_written_total").inc(
+            sum(rec["size"] for rec in integrity["files"].values())
+        )
     failpoints.hit("cache.finalize")
     from ydf_tpu.utils.snapshot import _durable_replace
 

@@ -36,7 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ydf_tpu.config import Task, TreeConfig
-from ydf_tpu.utils import failpoints
+from ydf_tpu.utils import failpoints, log, telemetry
 from ydf_tpu.dataset.dataset import InputData
 from ydf_tpu.learners.generic import GenericLearner
 from ydf_tpu.learners.losses import make_loss
@@ -299,6 +299,9 @@ class GradientBoostedTreesLearner(GenericLearner):
     ) -> GradientBoostedTreesModel:
         from ydf_tpu.utils.profiling import StageTimer, maybe_trace
 
+        # Root of the train→chunk→tree→layer trace; recorded via
+        # emit_span at the end so the huge body needs no re-indent.
+        _t_train0_ns = time.perf_counter_ns()
         # Deadline clock starts at train() entry — ingestion and binning
         # count against maximum_training_duration like the reference's.
         deadline = (
@@ -909,6 +912,7 @@ class GradientBoostedTreesLearner(GenericLearner):
             )
 
         initial_predictions = np.asarray(logs["initial_predictions"])
+        chunk_walls = logs.get("chunk_walls") or []
         model = GradientBoostedTreesModel(
             task=self.task,
             label=self.label,
@@ -931,6 +935,13 @@ class GradientBoostedTreesLearner(GenericLearner):
                 # requested num_trees when in-loop early stopping fired
                 # (reference early_stopping.h:29-66).
                 "num_trees_trained": int(train_losses.shape[0]),
+                # One YDF-style record per TRAINED boosting iteration
+                # (reference TrainingLogs; the tuner/early-stopping
+                # consumable). Seconds are per-chunk wall attributed
+                # uniformly within the chunk (docs/observability.md).
+                "iterations": _iteration_records(
+                    train_losses, valid_losses, has_valid, chunk_walls
+                ),
             },
             extra_metadata=self._model_metadata(),
         )
@@ -938,6 +949,21 @@ class GradientBoostedTreesLearner(GenericLearner):
         # Per-stage wall breakdown (reference Monitoring per-stage logs);
         # device_loop includes XLA compile on first call.
         model.training_profile = timer.finish()
+        if telemetry.ENABLED:
+            _emit_train_spans(
+                chunk_walls, int(train_losses.shape[0]), self.max_depth
+            )
+            telemetry.emit_span(
+                "train",
+                _t_train0_ns,
+                time.perf_counter_ns() - _t_train0_ns,
+                {
+                    "rows": int(n),
+                    "num_trees": int(train_losses.shape[0]),
+                    "learner": "GRADIENT_BOOSTED_TREES",
+                },
+            )
+            telemetry.flush()
         return model
 
     def _model_metadata(self) -> Optional[dict]:
@@ -1584,6 +1610,91 @@ def _make_boost_fn(
     return run
 
 
+def _note_chunk(
+    chunk_walls, start, clen, num_trees, t0_ns, chunk_arrays, nv_rows
+):
+    """Per-chunk bookkeeping shared by the three boosting drivers:
+    records the chunk's host wall (the attribution source for the
+    per-iteration training logs and the train→chunk→tree→layer trace),
+    feeds the training metrics, and emits the per-chunk progress line
+    at debug level (the reference manager's per-stage Monitoring log,
+    distributed_gradient_boosted_trees.cc:832-836)."""
+    dur_ns = time.perf_counter_ns() - t0_ns
+    chunk_walls.append((start, clen, t0_ns, dur_ns))
+    tl = float(np.asarray(chunk_arrays["tls"])[-1])
+    vl = float(np.asarray(chunk_arrays["vls"])[-1]) if nv_rows > 0 else None
+    if telemetry.ENABLED:
+        telemetry.counter("ydf_train_iterations_total").inc(clen)
+        telemetry.histogram("ydf_train_chunk_latency_ns").observe_ns(
+            dur_ns
+        )
+        telemetry.gauge("ydf_train_last_train_loss").set(tl)
+        if vl is not None:
+            telemetry.gauge("ydf_train_last_valid_loss").set(vl)
+    if log.is_debug():
+        done = min(start + clen, num_trees)
+        msg = (
+            f"gbt: iter {done}/{num_trees} train_loss={tl:.6g}"
+            + (f" valid_loss={vl:.6g}" if vl is not None else "")
+            + f" chunk_s={dur_ns / 1e9:.3f}"
+        )
+        log.debug(msg)
+
+
+def _iteration_records(train_losses, valid_losses, has_valid, chunk_walls):
+    """training_logs["iterations"]: one YDF-style record per TRAINED
+    boosting iteration — iteration (1-based), losses, and wall seconds.
+    Seconds are the measured per-chunk host wall attributed uniformly
+    across the chunk's iterations (the device loop is one fused scan;
+    finer host timing does not exist — see docs/observability.md)."""
+    trained = int(np.asarray(train_losses).shape[0])
+    secs = np.zeros((trained,), np.float64)
+    for s, c, _t0, dur in chunk_walls or []:
+        hi = min(s + c, trained)
+        if hi > s and c > 0:
+            secs[s:hi] = dur / 1e9 / c
+    out = []
+    for i in range(trained):
+        rec = {
+            "iteration": i + 1,
+            "train_loss": float(train_losses[i]),
+            "valid_loss": float(valid_losses[i]) if has_valid else None,
+            "seconds": float(secs[i]),
+        }
+        out.append(rec)
+    return out
+
+
+def _emit_train_spans(chunk_walls, trained, max_depth):
+    """Chrome-tracing spans for the boosting timeline: one measured
+    span per chunk, subdivided into per-tree and per-layer spans by
+    uniform attribution (flagged `attributed: true` — the scan is one
+    fused device program, so within-chunk splits are bookkeeping, not
+    measurement). Only runs when telemetry is armed."""
+    if not telemetry.ENABLED:
+        return
+    for s, c, t0, dur in chunk_walls or []:
+        n = max(min(s + c, trained) - s, 0)
+        telemetry.emit_span(
+            "train.chunk", t0, dur, {"start_iter": s, "iterations": c}
+        )
+        if n == 0 or dur <= 0:
+            continue
+        tree_dur = dur // c
+        layer_dur = max(tree_dur // max(max_depth, 1), 1)
+        for j in range(n):
+            tt0 = t0 + j * tree_dur
+            telemetry.emit_span(
+                "train.tree", tt0, tree_dur,
+                {"iteration": s + j + 1, "attributed": True},
+            )
+            for d in range(max_depth):
+                telemetry.emit_span(
+                    "train.layer", tt0 + d * layer_dur, layer_dur,
+                    {"depth": d, "attributed": True},
+                )
+
+
 def _chunk_len(clen: int, start: int, num_trees: int, use_dart: bool) -> int:
     """Fixed chunk length so ONE compiled executable serves every chunk;
     the tail overshoots and is sliced off at merge. DART is the exception —
@@ -1725,13 +1836,19 @@ def _train_gbt(
             clen = max(1, min(early_stop_lookahead or 25, 25))
             parts = []
             vls_seen = []
+            chunk_walls = []
             start = 0
             while start < num_trees:
                 c = _chunk_len(clen, start, num_trees, use_dart)
+                t0_ns = time.perf_counter_ns()
                 carry, ys = run.run_chunk(
                     carry, jnp.asarray(start), c, *data_args, **data_kwargs
                 )
                 parts.append(_chunk_arrays_from_ys(ys))
+                _note_chunk(
+                    chunk_walls, start, c, num_trees, t0_ns, parts[-1],
+                    nv_rows,
+                )
                 start += c
                 vls_seen.append(parts[-1]["vls"])
                 if nv_rows > 0 and _early_stop_hit(
@@ -1751,11 +1868,18 @@ def _train_gbt(
                 "oblique_b": obl_b,
                 "vs_a": vs_a,
                 "vs_b": vs_b,
+                "chunk_walls": chunk_walls,
             }
             return trees, lvs, logs
+        t0_ns = time.perf_counter_ns()
         trees, lvs, tls, vls, init_pred, obl_w, obl_b, vs_a, vs_b = run(
             *data_args, **data_kwargs
         )
+        # Block before reading the clock: the jit call returns futures,
+        # and every output is materialized a few lines later anyway —
+        # this just keeps the single "chunk" wall honest.
+        jax.block_until_ready(tls)
+        single_wall = [(0, num_trees, t0_ns, time.perf_counter_ns() - t0_ns)]
         logs = {
             "train_loss": tls,
             "valid_loss": vls,
@@ -1764,7 +1888,13 @@ def _train_gbt(
             "oblique_b": obl_b,
             "vs_a": vs_a,
             "vs_b": vs_b,
+            "chunk_walls": single_wall,
         }
+        if telemetry.ENABLED or log.is_debug():
+            _note_chunk(
+                [], 0, num_trees, num_trees, t0_ns,
+                {"tls": np.asarray(tls), "vls": np.asarray(vls)}, nv_rows,
+            )
         return trees, lvs, logs
 
     # --- checkpointed training: the boosting loop runs in chunks of
@@ -1846,15 +1976,21 @@ def _train_gbt(
                 pass
     from ydf_tpu.utils.snapshot import _durable_replace
 
+    chunk_walls = []
     with _PreemptionGuard() as guard:
         while start < num_trees:
             clen = _chunk_len(
                 snapshot_interval, start, num_trees, use_dart
             )
+            t0_ns = time.perf_counter_ns()
             carry, ys = run.run_chunk(
                 carry, jnp.asarray(start), clen, *data_args, **data_kwargs
             )
             chunk_arrays = _chunk_arrays_from_ys(ys)
+            _note_chunk(
+                chunk_walls, start, clen, num_trees, t0_ns, chunk_arrays,
+                nv_rows,
+            )
             tmp = _chunk_path(start) + ".tmp"
             with open(tmp, "wb") as f:
                 np.savez(f, **chunk_arrays)
@@ -1938,6 +2074,9 @@ def _train_gbt(
         "oblique_b": obl_b,
         "vs_a": vs_a,
         "vs_b": vs_b,
+        # Pre-resume chunks carry no wall (they ran in another
+        # process); their iteration records report 0 seconds.
+        "chunk_walls": chunk_walls,
     }
     return trees, lvs, logs
 

@@ -11,6 +11,7 @@ binned-input serving is also available and bit-identical.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -18,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ydf_tpu.config import Task
+from ydf_tpu.utils import telemetry
 from ydf_tpu.dataset.binning import Binner
 from ydf_tpu.dataset.dataset import Dataset, InputData
 from ydf_tpu.dataset.dataspec import DataSpecification
@@ -606,44 +608,76 @@ class GenericModel:
             cache[key] = (self.forest.feature, eng)
         return cache[key][1]
 
+    def _note_serve(self, engine: str, batch: int, t0_ns: int, sp) -> None:
+        """Per-call serving telemetry: latency histogram keyed by
+        engine + power-of-two batch bucket (bounded label cardinality),
+        request counter, span labels. Sites call under an ENABLED
+        guard — the disabled predict path pays one bool check."""
+        dur = time.perf_counter_ns() - t0_ns
+        b = telemetry.pow2_bucket(max(batch, 1))
+        telemetry.histogram(
+            "ydf_serve_latency_ns", engine=engine, batch_pow2=b
+        ).observe_ns(dur)
+        telemetry.counter(
+            "ydf_serve_requests_total", engine=engine
+        ).inc()
+        sp.set(engine=engine, batch=batch)
+
     def _raw_scores(self, data: InputData, combine: str) -> np.ndarray:
-        ds = Dataset.from_data(data, dataspec=self.dataspec)
-        x_num, x_cat, x_set = self._encode_inputs(ds)
-        vs = self._encode_vs(ds)
-        if (
-            combine == "sum"
-            and not self.native_missing
-            and x_set is None
-            and vs is None
-        ):
-            eng = self._fast_engine()
-            if eng is not None:
-                return np.asarray(
-                    eng(jnp.asarray(x_num), jnp.asarray(x_cat))
-                )[:, None]
-        set_missing = (
-            self._encode_set_missing(ds) if self.native_missing else None
-        )
-        out = forest_predict_values(
-            self.forest,
-            jnp.asarray(x_num),
-            jnp.asarray(x_cat),
-            num_numerical=self.binner.num_numerical,
-            max_depth=self.max_depth,
-            combine=combine,
-            x_set=None if x_set is None else jnp.asarray(x_set),
-            set_missing=(
-                None if set_missing is None else jnp.asarray(set_missing)
-            ),
-            x_vs_vals=None if vs is None else jnp.asarray(vs[0]),
-            x_vs_len=None if vs is None else jnp.asarray(vs[1]),
-            vs_missing=(
-                jnp.asarray(vs[2])
-                if vs is not None and self.native_missing
-                else None
-            ),
-        )
-        return np.asarray(out)
+        # serve → batch(predict) → encode/kernel span hierarchy; the
+        # latency histogram covers the WHOLE call (encode included —
+        # the user-visible per-request latency).
+        with telemetry.span("serve.predict") as sp:
+            t0_ns = time.perf_counter_ns() if telemetry.ENABLED else 0
+            ds = Dataset.from_data(data, dataspec=self.dataspec)
+            with telemetry.span("serve.encode"):
+                x_num, x_cat, x_set = self._encode_inputs(ds)
+                vs = self._encode_vs(ds)
+            if (
+                combine == "sum"
+                and not self.native_missing
+                and x_set is None
+                and vs is None
+            ):
+                eng = self._fast_engine()
+                if eng is not None:
+                    with telemetry.span("serve.kernel"):
+                        out = np.asarray(
+                            eng(jnp.asarray(x_num), jnp.asarray(x_cat))
+                        )[:, None]
+                    if telemetry.ENABLED:
+                        self._note_serve(
+                            type(eng).__name__, ds.num_rows, t0_ns, sp
+                        )
+                    return out
+            set_missing = (
+                self._encode_set_missing(ds) if self.native_missing else None
+            )
+            with telemetry.span("serve.kernel"):
+                out = forest_predict_values(
+                    self.forest,
+                    jnp.asarray(x_num),
+                    jnp.asarray(x_cat),
+                    num_numerical=self.binner.num_numerical,
+                    max_depth=self.max_depth,
+                    combine=combine,
+                    x_set=None if x_set is None else jnp.asarray(x_set),
+                    set_missing=(
+                        None if set_missing is None
+                        else jnp.asarray(set_missing)
+                    ),
+                    x_vs_vals=None if vs is None else jnp.asarray(vs[0]),
+                    x_vs_len=None if vs is None else jnp.asarray(vs[1]),
+                    vs_missing=(
+                        jnp.asarray(vs[2])
+                        if vs is not None and self.native_missing
+                        else None
+                    ),
+                )
+                out = np.asarray(out)
+            if telemetry.ENABLED:
+                self._note_serve("Routed", ds.num_rows, t0_ns, sp)
+            return out
 
     # ---- reference PYDF surface-parity accessors ---------------------- #
     # (ref port/python/ydf/model/generic_model.py; attribute-style state
@@ -869,10 +903,16 @@ class GenericModel:
         ds = Dataset.from_data(data, dataspec=self.dataspec)
         self.predict(ds)  # warmup + compile
         times = []
+        # Per-run latencies feed the serving latency histogram class
+        # (utils/telemetry.py), which derives the p50/p99 per-example
+        # figures the bench's serving-regression guard reads.
+        hist = telemetry.LatencyHistogram()
         for _ in range(num_runs):
             t0 = time.perf_counter()
             self.predict(ds)
-            times.append(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            times.append(dt)
+            hist.observe_s(dt)
         best = min(times)
         n = max(ds.num_rows, 1)
         out = {
@@ -880,6 +920,12 @@ class GenericModel:
             "num_runs": num_runs,
             "best_wall_s": best,
             "ns_per_example": 1e9 * best / n,
+            # Percentiles over the per-call wall times, normalized per
+            # example (log2-bucket resolution, ~12.5 % — see
+            # LatencyHistogram). p50 tracks the typical call; p99 the
+            # tail the QPS story cares about.
+            "p50_ns_per_example": hist.percentile_ns(50) / n,
+            "p99_ns_per_example": hist.percentile_ns(99) / n,
         }
         if not engines:
             return out

@@ -52,7 +52,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from ydf_tpu.utils import failpoints
+from ydf_tpu.utils import failpoints, telemetry
 
 _MAC_LEN = hashlib.sha256().digest_size  # 32
 
@@ -198,10 +198,29 @@ def start_worker(
             req = _recv_msg(conn, secret)
             conn.settimeout(None)  # training can take hours
             failpoints.hit("worker.handle")
-            try:
-                resp = _handle_request(req)
-            except Exception as e:  # worker stays alive on task errors
-                resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            # Per-request span + counters — the telemetry the
+            # distributed round's manager-side debugging stands on
+            # (reference per-stage Monitoring logs).
+            verb = str(req.get("verb")) if isinstance(req, dict) else "?"
+            with telemetry.span("worker.request") as sp:
+                if telemetry.ENABLED:
+                    sp.set(verb=verb)
+                    telemetry.counter(
+                        "ydf_worker_requests_total", verb=verb
+                    ).inc()
+                    t0 = time.perf_counter_ns()
+                try:
+                    resp = _handle_request(req)
+                except Exception as e:  # worker stays alive on task errors
+                    resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+                if telemetry.ENABLED:
+                    telemetry.histogram(
+                        "ydf_worker_request_latency_ns", verb=verb
+                    ).observe_ns(time.perf_counter_ns() - t0)
+                    if not resp.get("ok"):
+                        telemetry.counter(
+                            "ydf_worker_request_errors_total", verb=verb
+                        ).inc()
             # Send deadline: a manager that vanished after sending its
             # request (full TCP window, half-open connection) must not
             # pin this thread past the timeout.
@@ -325,6 +344,11 @@ class WorkerPool:
         """Records a transport failure: the worker is quarantined for a
         backoff that doubles with each consecutive failure."""
         addr = self.addresses[i % len(self.addresses)]
+        if telemetry.ENABLED:
+            telemetry.counter(
+                "ydf_worker_quarantine_total",
+                worker=f"{addr[0]}:{addr[1]}",
+            ).inc()
         with self._health_lock:
             st = self._health.setdefault(addr, {"fails": 0, "until": 0.0})
             st["fails"] += 1
@@ -384,6 +408,8 @@ class WorkerPool:
         start = i
         for attempt in range(self.retry_attempts):
             if attempt:
+                if telemetry.ENABLED:
+                    telemetry.counter("ydf_worker_retries_total").inc()
                 time.sleep(self.backoff_delay(attempt - 1))
             idx = self.pick_worker(start)
             if idx is None:

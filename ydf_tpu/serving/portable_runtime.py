@@ -114,13 +114,26 @@ class PortableModel:
             n = x_cat.shape[0]
             x_num = np.zeros((n, 0), np.float32)
         out = np.zeros((n, self.num_outputs), np.float32)
-        self._lib.ydf_model_predict(
-            self._h,
-            x_num.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            x_cat.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            n,
-            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-        )
+        from ydf_tpu.utils import telemetry
+
+        with telemetry.span("serve.kernel") as sp:
+            if telemetry.ENABLED:
+                import time
+
+                sp.set(engine="Portable", batch=int(n))
+                t0 = time.perf_counter_ns()
+            self._lib.ydf_model_predict(
+                self._h,
+                x_num.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                x_cat.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                n,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            )
+            if telemetry.ENABLED:
+                telemetry.histogram(
+                    "ydf_serve_latency_ns", engine="Portable",
+                    batch_pow2=telemetry.pow2_bucket(max(int(n), 1)),
+                ).observe_ns(time.perf_counter_ns() - t0)
         return out[:, 0] if self.num_outputs == 1 else out
 
     def close(self):

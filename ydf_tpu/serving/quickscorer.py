@@ -316,6 +316,22 @@ class QuickScorerEngine:
         self.interpret = interpret
 
     def __call__(self, x_num, x_cat=None) -> jnp.ndarray:
+        from ydf_tpu.utils import telemetry
+
+        if telemetry.ENABLED:
+            import time
+
+            t0 = time.perf_counter_ns()
+            out = self._score(x_num, x_cat)
+            out.block_until_ready()
+            telemetry.histogram(
+                "ydf_serve_kernel_latency_ns", engine="QuickScorer",
+                batch_pow2=telemetry.pow2_bucket(int(out.shape[0])),
+            ).observe_ns(time.perf_counter_ns() - t0)
+            return out
+        return self._score(x_num, x_cat)
+
+    def _score(self, x_num, x_cat=None) -> jnp.ndarray:
         qsm = self.qsm
         x_all = jnp.asarray(x_num, jnp.float32)
         if x_cat is not None and np.shape(x_cat)[1] > 0:

@@ -56,6 +56,16 @@ def compatible_engines(model) -> List[EngineFactory]:
     return out
 
 
+def _note_selected(factory: EngineFactory, forced: bool) -> None:
+    from ydf_tpu.utils import telemetry
+
+    if telemetry.ENABLED:
+        telemetry.counter(
+            "ydf_serve_engine_selected_total",
+            engine=factory.name, forced=str(forced).lower(),
+        ).inc()
+
+
 def best_engine(model, forced: Optional[str] = None) -> EngineFactory:
     if forced is not None:
         for f in _REGISTRY:
@@ -66,6 +76,7 @@ def best_engine(model, forced: Optional[str] = None) -> EngineFactory:
                         f"model (compatible: "
                         f"{[c.name for c in compatible_engines(model)]})"
                     )
+                _note_selected(f, forced=True)
                 return f
         raise ValueError(
             f"Unknown engine {forced!r}; registered: "
@@ -74,6 +85,7 @@ def best_engine(model, forced: Optional[str] = None) -> EngineFactory:
     compat = compatible_engines(model)
     if not compat:
         raise RuntimeError("No compatible serving engine (missing routed?)")
+    _note_selected(compat[0], forced=False)
     return compat[0]
 
 

@@ -98,6 +98,10 @@ KNOWN_SITES = frozenset(
         # learners/gbt.py — checkpointed boosting loop, after each
         # chunk's snapshot is durably saved.
         "gbt.chunk",
+        # utils/telemetry.py — span/metrics exporter. flush() swallows
+        # the injected fault (export is observation): the chaos test
+        # asserts a crashing exporter leaves training bit-identical.
+        "telemetry.flush",
     }
 )
 

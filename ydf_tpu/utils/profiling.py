@@ -167,6 +167,29 @@ def reset_native_route_kernel_counters() -> None:
     routing_native.reset_kernel_counters()
 
 
+def native_kernel_metrics() -> Dict[str, float]:
+    """The native kernels' cumulative in-kernel wall counters as
+    registered telemetry gauges — the accessor functions above, exposed
+    through the metrics registry (utils/telemetry.py registers this as
+    a default collector, so every metrics dump carries them instead of
+    callers knowing five one-off functions). Unavailable kernels report
+    0.0, matching the accessors."""
+    from ydf_tpu.ops import routing_native
+
+    out = {
+        "ydf_native_hist_kernel_seconds": native_hist_kernel_seconds(),
+        "ydf_native_route_kernel_seconds": native_route_kernel_seconds(),
+        "ydf_native_update_kernel_seconds": native_update_kernel_seconds(),
+    }
+    try:
+        out["ydf_native_fused_kernel_seconds"] = (
+            routing_native.fused_kernel_seconds()
+        )
+    except Exception:
+        out["ydf_native_fused_kernel_seconds"] = 0.0
+    return out
+
+
 def format_profile(profile: Optional[Dict[str, float]]) -> str:
     """One-line human summary, largest stages first."""
     if not profile:

@@ -34,7 +34,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ydf_tpu.utils import failpoints
+from ydf_tpu.utils import failpoints, telemetry
 
 
 def _fsync_file(path: str) -> None:
@@ -122,6 +122,11 @@ class Snapshots:
                 f"injected torn write at 'snapshot.save' (idx {idx})"
             )
         _durable_replace(tmp, self._payload_path(idx))
+        if telemetry.ENABLED:
+            telemetry.counter("ydf_snapshot_saves_total").inc()
+            telemetry.counter("ydf_snapshot_bytes_written_total").inc(
+                os.path.getsize(self._payload_path(idx))
+            )
         failpoints.hit("snapshot.index")
         idxs = [i for i in self.indices() if i != idx] + [idx]
         self._write_index(idxs)
@@ -148,7 +153,15 @@ class Snapshots:
                 with np.load(path) as z:
                     arrays = {k: z[k] for k in z.files if k != "__meta__"}
                     meta = json.loads(bytes(z["__meta__"]).decode("utf-8"))
+                if telemetry.ENABLED:
+                    telemetry.counter("ydf_snapshot_loads_total").inc()
                 return idx, arrays, meta
             except Exception:
+                if telemetry.ENABLED:
+                    # A torn/corrupt payload was skipped for an older one
+                    # — the recovery event worth counting.
+                    telemetry.counter(
+                        "ydf_snapshot_fallback_total"
+                    ).inc()
                 continue  # partially written / corrupt → try older
         return None

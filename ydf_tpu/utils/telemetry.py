@@ -1,0 +1,642 @@
+"""Process-wide telemetry: metrics registry + structured tracing spans.
+
+Counterpart of the reference's monitoring thread — the per-stage
+`Monitoring` logs in the distributed-GBT manager
+(`distributed_gradient_boosted_trees.cc:832-836`), the `utils/usage.h`
+telemetry hooks, and the `utils/benchmark/inference.h` latency harness —
+unified into ONE registry the train, serve and worker paths all report
+through, instead of the five disconnected fragments this repo grew
+(StageTimer, per-kernel wall counters, the xplane.pb parser,
+bench-record fields, bare stderr prints).
+
+Three primitives:
+
+  * **Counters / gauges** — monotonically-added and last-set values,
+    keyed by (name, sorted label items).
+  * **Latency histograms** — log2-bucketed (8 linear sub-buckets per
+    octave, so ~12.5 % worst-case value resolution) over non-negative
+    integer nanoseconds; p50/p90/p99 are derived from the buckets with
+    linear interpolation inside the covering sub-bucket. The observe
+    path is lock-free (plain `+=` on a Python list slot — GIL-serialized
+    bytecode; a concurrent increment can in principle be lost, which is
+    acceptable for telemetry and impossible on the single-threaded
+    training loop).
+  * **Tracing spans** — `with telemetry.span("train.chunk"): ...`
+    nest by wall-clock containment per thread (train → chunk → tree →
+    layer; serve → batch → kernel) and export as chrome-tracing JSONL
+    (one complete "X" event per line — `json.loads` each line, or wrap
+    the lines in `[...]` and load the file in `chrome://tracing` /
+    Perfetto).
+
+Enablement follows `failpoints.py`'s zero-overhead contract exactly:
+
+  * `YDF_TPU_TELEMETRY_DIR=/path` — enable AND export: every
+    `flush()` (end of `train()`, `cli train`, process exit) appends
+    spans to `trace-<pid>.jsonl` and rewrites `metrics-<pid>.prom`
+    (Prometheus text exposition) in that directory. The directory is
+    created EAGERLY at import so a bad path fails at the env boundary.
+  * `YDF_TPU_TELEMETRY=1|on` — enable the in-memory registry without
+    export (programmatic consumers: `snapshot()`, `metrics_text()`,
+    `events()`). Any other value raises ValueError at import.
+  * Programmatic (tests): `with telemetry.active(dir): ...` arms a
+    FRESH registry + event buffer and restores the previous state on
+    exit.
+
+Overhead contract: with both env vars unset, every instrumented site
+costs one module-attribute lookup plus a bool check
+(`telemetry.ENABLED`), and `span(name)` returns the same no-op
+singleton — ZERO allocations per call on the disabled span fast path
+(verified by tests/test_telemetry.py with tracemalloc; the 3 %
+enabled-path budget is scripts/check_telemetry_overhead.py's job).
+Sites therefore follow the pattern
+
+    with telemetry.span("serve.predict") as sp:
+        if telemetry.ENABLED:
+            sp.set(batch=n, engine=name)
+
+`flush()` NEVER raises: the exporter is observation, and a full disk or
+injected fault (failpoint site `telemetry.flush`) must not perturb the
+training result — tests/test_telemetry.py proves the trained model is
+bit-identical with telemetry off, on, and crashing. See
+docs/observability.md for metric naming conventions and the full
+contract.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import json
+import math
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "ENABLED",
+    "EXPORT_DIR",
+    "span",
+    "counter",
+    "gauge",
+    "histogram",
+    "emit_span",
+    "events",
+    "snapshot",
+    "metrics_text",
+    "flush",
+    "reset",
+    "active",
+    "configure",
+    "register_collector",
+    "pow2_bucket",
+    "LatencyHistogram",
+    "Counter",
+    "Gauge",
+]
+
+
+# --------------------------------------------------------------------- #
+# Env boundary (eager, like YDF_TPU_FAILPOINTS / YDF_TPU_HIST_IMPL)
+# --------------------------------------------------------------------- #
+
+_ON_VALUES = ("1", "on")
+_OFF_VALUES = ("", "0", "off")
+
+
+def _parse_env(
+    flag: Optional[str], directory: Optional[str]
+) -> Tuple[bool, Optional[str]]:
+    """Validates (YDF_TPU_TELEMETRY, YDF_TPU_TELEMETRY_DIR) eagerly.
+    Returns (enabled, export_dir). A directory implies enabled; the
+    directory is created here so a bad path fails at import, not at the
+    first flush hours into training."""
+    f = (flag or "").strip().lower()
+    if f not in _ON_VALUES + _OFF_VALUES:
+        raise ValueError(
+            f"YDF_TPU_TELEMETRY={flag!r} is not one of "
+            f"{list(_ON_VALUES + _OFF_VALUES)}"
+        )
+    d = (directory or "").strip() or None
+    if d is not None:
+        try:
+            os.makedirs(d, exist_ok=True)
+        except OSError as e:
+            raise ValueError(
+                f"YDF_TPU_TELEMETRY_DIR={d!r} cannot be created: "
+                f"{type(e).__name__}: {e}"
+            ) from e
+    return (f in _ON_VALUES) or (d is not None), d
+
+
+# --------------------------------------------------------------------- #
+# Metrics
+# --------------------------------------------------------------------- #
+
+
+class Counter:
+    """Monotonically increasing value. inc() is a plain add — the
+    lock-free fast path (GIL-serialized; see module docstring)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        self.value += n
+
+
+class Gauge:
+    """Last-set value."""
+
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+
+#: Linear sub-buckets per power-of-two octave: worst-case relative
+#: bucket width (and so percentile error) is 1/_SUB = 12.5 %.
+_SUB = 8
+_NUM_BUCKETS = 64 * _SUB
+
+
+class LatencyHistogram:
+    """Log2-bucketed histogram over non-negative integer nanoseconds.
+
+    Bucket index for v ≥ 1: octave e = v.bit_length() − 1, sub-bucket
+    s = ⌊(v − 2^e) · 8 / 2^e⌋, index = 8·e + s; v < 1 → bucket 0.
+    observe() is a list-slot `+=` (lock-free fast path); percentiles
+    walk the 512 slots and interpolate linearly inside the covering
+    sub-bucket, clamped to the exact observed [min, max]."""
+
+    __slots__ = ("buckets", "count", "total", "min", "max")
+
+    def __init__(self) -> None:
+        self.buckets = [0] * _NUM_BUCKETS
+        self.count = 0
+        self.total = 0
+        self.min = None  # exact extrema: clamp + zero-count answers
+        self.max = None
+
+    @staticmethod
+    def bucket_index(v: int) -> int:
+        if v < 1:
+            return 0
+        e = v.bit_length() - 1
+        if e > 62:
+            return _NUM_BUCKETS - 1
+        return (e << 3) + (((v - (1 << e)) << 3) >> e)
+
+    @staticmethod
+    def bucket_bounds(i: int) -> Tuple[float, float]:
+        e, s = i >> 3, i & 7
+        base = float(1 << e)
+        return base + s * base / _SUB, base + (s + 1) * base / _SUB
+
+    def observe_ns(self, v) -> None:
+        v = int(v)
+        self.buckets[self.bucket_index(v)] += 1
+        self.count += 1
+        self.total += v
+        if self.min is None or v < self.min:
+            self.min = v
+        if self.max is None or v > self.max:
+            self.max = v
+
+    def observe_s(self, seconds: float) -> None:
+        self.observe_ns(int(seconds * 1e9))
+
+    def percentile_ns(self, p: float) -> Optional[float]:
+        """Nearest-rank percentile with in-bucket linear interpolation;
+        None while empty."""
+        if self.count == 0:
+            return None
+        rank = min(max(int(math.ceil(p / 100.0 * self.count)), 1),
+                   self.count)
+        cum = 0
+        for i, c in enumerate(self.buckets):
+            if c == 0:
+                continue
+            if cum + c >= rank:
+                lo, hi = self.bucket_bounds(i)
+                frac = (rank - cum) / c
+                est = lo + frac * (hi - lo)
+                return float(min(max(est, self.min), self.max))
+            cum += c
+        return float(self.max)  # unreachable, defensive
+
+    def summary(self) -> Dict[str, float]:
+        out = {
+            "count": self.count,
+            "sum_ns": self.total,
+        }
+        if self.count:
+            out.update(
+                min_ns=self.min,
+                max_ns=self.max,
+                p50_ns=self.percentile_ns(50),
+                p90_ns=self.percentile_ns(90),
+                p99_ns=self.percentile_ns(99),
+            )
+        return out
+
+
+_MetricKey = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+
+class _Registry:
+    """Process-wide metric store. Creation takes a lock; the returned
+    metric objects are then incremented lock-free at the sites."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[_MetricKey, Counter] = {}
+        self._gauges: Dict[_MetricKey, Gauge] = {}
+        self._hists: Dict[_MetricKey, LatencyHistogram] = {}
+
+    @staticmethod
+    def _key(name: str, labels: Dict[str, object]) -> _MetricKey:
+        return name, tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+    def _get(self, store, cls, name, labels):
+        key = self._key(name, labels)
+        m = store.get(key)
+        if m is None:
+            with self._lock:
+                m = store.setdefault(key, cls())
+        return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get(self._counters, Counter, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get(self._gauges, Gauge, name, labels)
+
+    def histogram(self, name: str, **labels) -> LatencyHistogram:
+        return self._get(self._hists, LatencyHistogram, name, labels)
+
+
+def _fmt_labels(items: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in items]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+# --------------------------------------------------------------------- #
+# Spans
+# --------------------------------------------------------------------- #
+
+#: Event-buffer cap — a run that never flushes must stay bounded; drops
+#: are counted in ydf_telemetry_dropped_events_total.
+_MAX_EVENTS = 200_000
+
+
+class _NoopSpan:
+    """Singleton returned by span() when telemetry is disabled. No state,
+    no allocations: __enter__/__exit__ return existing objects only."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+    def set(self, **kw):
+        pass
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("name", "args", "_t0")
+
+    def __init__(self, name: str, args: Optional[dict]) -> None:
+        self.name = name
+        self.args = args
+        self._t0 = 0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def set(self, **kw):
+        if self.args is None:
+            self.args = {}
+        self.args.update(kw)
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = time.perf_counter_ns()
+        _record_event(self.name, self._t0, t1 - self._t0, self.args)
+        return False
+
+
+def _record_event(
+    name: str, start_ns: int, dur_ns: int, args: Optional[dict],
+    tid: Optional[int] = None,
+) -> None:
+    ev = _STATE["events"]
+    if len(ev) >= _MAX_EVENTS:
+        _STATE["registry"].counter(
+            "ydf_telemetry_dropped_events_total"
+        ).inc()
+        return
+    ev.append(
+        (
+            name,
+            start_ns,
+            max(int(dur_ns), 0),
+            tid if tid is not None else threading.get_ident(),
+            args,
+        )
+    )
+
+
+# --------------------------------------------------------------------- #
+# Module state
+# --------------------------------------------------------------------- #
+
+_STATE: Dict[str, object] = {
+    "registry": _Registry(),
+    "events": [],
+    "collectors": [],
+}
+_FLUSH_LOCK = threading.Lock()
+
+ENABLED, EXPORT_DIR = _parse_env(
+    os.environ.get("YDF_TPU_TELEMETRY"),
+    os.environ.get("YDF_TPU_TELEMETRY_DIR"),
+)
+
+
+def span(name: str, args: Optional[dict] = None):
+    """Tracing span context manager. Disabled → the shared no-op
+    singleton (zero allocations). `args` takes a pre-built dict; hot
+    sites attach labels with `sp.set(...)` under an ENABLED guard
+    instead, so the disabled call carries no dict literal."""
+    if not ENABLED:
+        return _NOOP_SPAN
+    return _Span(name, args)
+
+
+def counter(name: str, **labels) -> Counter:
+    return _STATE["registry"].counter(name, **labels)
+
+
+def gauge(name: str, **labels) -> Gauge:
+    return _STATE["registry"].gauge(name, **labels)
+
+
+def histogram(name: str, **labels) -> LatencyHistogram:
+    return _STATE["registry"].histogram(name, **labels)
+
+
+def emit_span(
+    name: str, start_ns: int, dur_ns: int,
+    args: Optional[dict] = None, tid: Optional[int] = None,
+) -> None:
+    """Records a complete span with EXPLICIT timestamps — used for
+    post-hoc attribution of host-opaque device work (the fused boosting
+    scan's per-tree/per-layer subdivision, gbt.py). Attributed spans
+    carry `{"attributed": true}` in args by convention."""
+    if not ENABLED:
+        return
+    _record_event(name, start_ns, dur_ns, args, tid=tid)
+
+
+def register_collector(fn: Callable[[], Dict[str, float]]) -> None:
+    """Registers a gauge collector: a callable returning {metric_name:
+    value}, sampled at snapshot()/metrics_text() time. This is how
+    pull-model sources (the native kernels' cumulative wall counters,
+    profiling.py) become registered metrics without a push at every
+    kernel return."""
+    _STATE["collectors"].append(fn)
+
+
+def _collected() -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for fn in list(_STATE["collectors"]):
+        try:
+            out.update(fn())
+        except Exception:
+            continue  # a broken collector must never break the dump
+    return out
+
+
+def _default_collectors() -> None:
+    """Registers the built-in native-kernel collectors once per state.
+    Lazy import: profiling pulls in the ops modules."""
+    from ydf_tpu.utils import profiling
+
+    register_collector(profiling.native_kernel_metrics)
+
+
+def pow2_bucket(n: int) -> int:
+    """Power-of-two batch-size bucket (bounded label cardinality for
+    the serving latency histogram): 1000 → 1024."""
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+# --------------------------------------------------------------------- #
+# Introspection / export
+# --------------------------------------------------------------------- #
+
+
+def events() -> List[dict]:
+    """The in-memory span buffer as chrome-tracing event dicts (not yet
+    flushed)."""
+    return [_event_json(e) for e in list(_STATE["events"])]
+
+
+def _event_json(e) -> dict:
+    name, start_ns, dur_ns, tid, args = e
+    ev = {
+        "name": name,
+        "cat": "ydf_tpu",
+        "ph": "X",
+        # Fractional µs (chrome tracing accepts doubles): integer-µs
+        # flooring would break strict nesting containment for sub-µs
+        # spans. Epoch is perf_counter's.
+        "ts": start_ns / 1000,
+        "dur": max(dur_ns, 1) / 1000,
+        "pid": os.getpid(),
+        "tid": tid,
+    }
+    if args:
+        ev["args"] = args
+    return ev
+
+
+def snapshot() -> Dict[str, object]:
+    """All metrics as one JSON-able dict:
+    {"counters": {...}, "gauges": {...}, "histograms": {name: summary}}.
+    Collector-sourced values appear under "gauges"."""
+    _ensure_default_collectors()
+    reg: _Registry = _STATE["registry"]
+
+    def _name(key: _MetricKey) -> str:
+        return key[0] + _fmt_labels(key[1])
+
+    out = {
+        "counters": {_name(k): c.value for k, c in reg._counters.items()},
+        "gauges": {_name(k): g.value for k, g in reg._gauges.items()},
+        "histograms": {
+            _name(k): h.summary() for k, h in reg._hists.items()
+        },
+    }
+    out["gauges"].update(_collected())
+    return out
+
+
+_DEFAULTS_REGISTERED = False
+
+
+def _ensure_default_collectors() -> None:
+    global _DEFAULTS_REGISTERED
+    if _DEFAULTS_REGISTERED:
+        return
+    _DEFAULTS_REGISTERED = True
+    try:
+        _default_collectors()
+    except Exception:
+        pass  # ops import failure must not break telemetry itself
+
+
+def metrics_text() -> str:
+    """Prometheus text exposition of the registry. Histograms export
+    summary-style: _count, _sum (ns) and quantile samples 0.5/0.9/0.99."""
+    _ensure_default_collectors()
+    reg: _Registry = _STATE["registry"]
+    lines: List[str] = []
+    for (name, labels), c in sorted(reg._counters.items()):
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name}{_fmt_labels(labels)} {c.value:g}")
+    for (name, labels), g in sorted(reg._gauges.items()):
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name}{_fmt_labels(labels)} {g.value:g}")
+    for mname, value in sorted(_collected().items()):
+        lines.append(f"# TYPE {mname} gauge")
+        lines.append(f"{mname} {value:g}")
+    for (name, labels), h in sorted(reg._hists.items()):
+        lines.append(f"# TYPE {name} summary")
+        lines.append(f"{name}_count{_fmt_labels(labels)} {h.count}")
+        lines.append(f"{name}_sum{_fmt_labels(labels)} {h.total}")
+        for q in (0.5, 0.9, 0.99):
+            v = h.percentile_ns(q * 100)
+            if v is not None:
+                qlab = _fmt_labels(labels, 'quantile="%s"' % q)
+                lines.append(f"{name}{qlab} {v:g}")
+    return "\n".join(lines) + "\n"
+
+
+def flush(directory: Optional[str] = None) -> None:
+    """Exports spans (append, `trace-<pid>.jsonl`) and metrics (rewrite,
+    `metrics-<pid>.prom`) to `directory` (default: the armed
+    EXPORT_DIR; no-op without one). NEVER raises — export is
+    observation, and an exporter fault (full disk, or the
+    `telemetry.flush` failpoint the chaos suite arms) must not perturb
+    the training result. Failures are counted in
+    ydf_telemetry_flush_errors_total and logged at debug level."""
+    d = directory or EXPORT_DIR
+    if d is None or not ENABLED:
+        return
+    with _FLUSH_LOCK:
+        drained = list(_STATE["events"])
+        del _STATE["events"][: len(drained)]
+        try:
+            from ydf_tpu.utils import failpoints
+
+            failpoints.hit("telemetry.flush")
+            os.makedirs(d, exist_ok=True)
+            pid = os.getpid()
+            if drained:
+                path = os.path.join(d, f"trace-{pid}.jsonl")
+                with open(path, "a") as f:
+                    for e in drained:
+                        f.write(json.dumps(_event_json(e)) + "\n")
+            with open(os.path.join(d, f"metrics-{pid}.prom"), "w") as f:
+                f.write(metrics_text())
+            from ydf_tpu.utils import log
+
+            log.debug(
+                f"telemetry: flushed {len(drained)} spans to {d}"
+            )
+        except Exception as e:
+            # Swallow, count, restore the drained spans for a later
+            # attempt (bounded by _MAX_EVENTS as usual).
+            _STATE["registry"].counter(
+                "ydf_telemetry_flush_errors_total"
+            ).inc()
+            _STATE["events"][:0] = drained[
+                : _MAX_EVENTS - len(_STATE["events"])
+            ]
+            try:
+                from ydf_tpu.utils import log
+
+                log.debug(f"telemetry: flush failed: "
+                          f"{type(e).__name__}: {e}")
+            except Exception:
+                pass
+
+
+def reset() -> None:
+    """Clears the CURRENT registry and event buffer (tests, bench)."""
+    _STATE["registry"] = _Registry()
+    _STATE["events"] = []
+
+
+def configure(
+    enabled: Optional[bool] = None, directory: Optional[str] = None
+) -> None:
+    """Programmatic arming — the post-import equivalent of the env vars
+    (`cli train --telemetry_dir` uses this; the env is parsed once at
+    import, before argv exists). Validates like the env boundary."""
+    global ENABLED, EXPORT_DIR
+    if directory is not None:
+        _, EXPORT_DIR = _parse_env(None, directory)
+        ENABLED = True
+    if enabled is not None:
+        ENABLED = bool(enabled)
+
+
+@contextlib.contextmanager
+def active(directory: Optional[str] = None):
+    """Arms telemetry with a FRESH registry + event buffer for the
+    with-block (optionally exporting to `directory`), restoring the
+    previous state — including disabled-ness — on exit. The test-side
+    twin of the env vars, like failpoints.active()."""
+    global ENABLED, EXPORT_DIR
+    old = (
+        ENABLED, EXPORT_DIR, _STATE["registry"], _STATE["events"],
+        _STATE["collectors"],
+    )
+    global _DEFAULTS_REGISTERED
+    old_defaults = _DEFAULTS_REGISTERED
+    _, d = _parse_env(None, directory)
+    _STATE["registry"] = _Registry()
+    _STATE["events"] = []
+    _STATE["collectors"] = []
+    _DEFAULTS_REGISTERED = False
+    ENABLED, EXPORT_DIR = True, d
+    try:
+        yield
+    finally:
+        (
+            ENABLED, EXPORT_DIR, _STATE["registry"], _STATE["events"],
+            _STATE["collectors"],
+        ) = old
+        _DEFAULTS_REGISTERED = old_defaults
+
+
+# A process that armed export via env gets its tail spans/metrics even
+# if nothing calls flush() explicitly (e.g. predict-only serving).
+if EXPORT_DIR is not None:
+    atexit.register(flush)
