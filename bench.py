@@ -53,6 +53,24 @@ import time
 BASELINE_YDF64_ESTIMATE_ROWS_TREES_PER_SEC = 6.1e6  # engineering estimate
 BASELINE_CACHE = os.path.join(os.path.dirname(__file__), "BASELINE_measured.json")
 
+# Recorded per-example serving floors on the bench box, keyed by the
+# (rows, trees) shape of the record that measured them. ROADMAP item 1
+# read "640 ns (r04) → 1381 ns (r05)" as a serving regression; the
+# bisect (this round) shows it was a SHAPE CONFOUND: r04's 640.5 ns is
+# the QUICK-FLOOR record (20k rows, 5 trees) while r05's 1380.7 ns is
+# the FULL record (500k rows, 20 trees, n_inf = 100k) — r04's own full
+# record measured 1451.2 ns, so same-shape serving IMPROVED 5 % between
+# the rounds. ns/example scales ~linearly with tree count (4× trees ≈
+# 2.2× measured, sub-linear because fixed per-call costs amortize over
+# the larger n_inf), so floors are only comparable per shape. The guard
+# below emits infer_p50_floor_ns / infer_p50_within_floor on every
+# record whose shape has a recorded floor (docs/serving.md "The 640 ns
+# story").
+INFER_P50_FLOOR_NS = {
+    (20_000, 5): 640.5,     # BENCH_r04 quick floor
+    (500_000, 20): 1380.7,  # BENCH_r05 full record
+}
+
 _RESULT_EMITTED = False
 _LAST_EMITTED = None
 # Best record assembled so far — the watchdog/SIGTERM handler emits this
@@ -508,6 +526,144 @@ def measure_hist_attribution(rows, features, depth, trees, record):
         record["hist_extra_error"] = f"{type(e).__name__}: {e}"
 
 
+def measure_serving_family(model, data, rows, record):
+    """The serving bench family (ROADMAP item 1's measurement half):
+    per-call p50/p99 latency at batch sizes {1, 16, 256, 4096} for every
+    compatible serving engine on pre-encoded inputs, plus the binned
+    native fast path. `serve_engine` names the engine predict() actually
+    selects for this model (registry fastest-compatible); the headline
+    `infer_qps` / `infer_batch_p50_ns` / `infer_batch_p99_ns` fields
+    are that engine's numbers — rows/sec at the best batch size, and
+    per-call latency per batch size (the "millions of users" figures;
+    docs/serving.md "Bench fields"). Failures recorded, never fatal."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from ydf_tpu.dataset.dataset import Dataset
+    from ydf_tpu.ops.routing import forest_predict_values
+    from ydf_tpu.utils.telemetry import LatencyHistogram
+
+    SIZES = (1, 16, 256, 4096)
+    CALLS = {1: 200, 16: 100, 256: 40, 4096: 15}
+    try:
+        sample = {k: v[: min(rows, 8192)] for k, v in data.items()}
+        ds = Dataset.from_data(sample, dataspec=model.dataspec)
+        x_num, x_cat, _ = model._encode_inputs(ds)
+        n_av = x_num.shape[0]
+        jx_num, jx_cat = jnp.asarray(x_num), jnp.asarray(x_cat)
+
+        sel = model._fast_engine()
+        serve_engine = (
+            type(sel).__name__.replace("Engine", "")
+            if sel is not None
+            else "Routed"
+        )
+        record["serve_engine"] = serve_engine
+
+        # name -> {batch: zero-arg callable} with inputs pre-sliced
+        # outside the timed region.
+        per_engine = {}
+
+        def routed_calls():
+            calls = {}
+            for b in SIZES:
+                if b > n_av:
+                    continue
+                xn, xc = jx_num[:b], jx_cat[:b]
+
+                def run(xn=xn, xc=xc):
+                    return np.asarray(
+                        forest_predict_values(
+                            model.forest, xn, xc,
+                            num_numerical=model.binner.num_numerical,
+                            max_depth=model.max_depth, combine="sum",
+                        )
+                    )
+
+                calls[b] = run
+            return calls
+
+        per_engine["Routed"] = routed_calls()
+
+        from ydf_tpu.serving.registry import compatible_engines
+
+        for f in compatible_engines(model):
+            if f.name == "Routed" or f.name in per_engine:
+                continue
+            try:
+                eng = f.build(model)
+            except Exception:
+                continue
+            if eng is None:
+                continue
+            calls = {}
+            for b in SIZES:
+                if b > n_av:
+                    continue
+                xn = np.ascontiguousarray(x_num[:b])
+                xc = np.ascontiguousarray(x_cat[:b])
+
+                def run(eng=eng, xn=xn, xc=xc):
+                    return np.asarray(eng(xn, xc))
+
+                calls[b] = run
+            per_engine[f.name] = calls
+
+        try:
+            from ydf_tpu.serving.native_serve import (
+                build_native_binned_engine,
+            )
+
+            nbb = build_native_binned_engine(model)
+            if nbb is not None:
+                bins = np.ascontiguousarray(
+                    model.binner.transform(ds)[:, : model.binner.num_scalar]
+                )
+                calls = {}
+                for b in SIZES:
+                    if b > n_av:
+                        continue
+                    bn = np.ascontiguousarray(bins[:b])
+
+                    def run(nbb=nbb, bn=bn):
+                        return np.asarray(nbb(bn))
+
+                    calls[b] = run
+                per_engine["NativeBinned"] = calls
+        except Exception:
+            pass
+
+        res = {}
+        for name, calls in per_engine.items():
+            per = {}
+            for b, run in calls.items():
+                run()  # warmup / compile
+                hist = LatencyHistogram()
+                for _ in range(CALLS[b]):
+                    t0 = time.perf_counter()
+                    run()
+                    hist.observe_s(time.perf_counter() - t0)
+                p50 = hist.percentile_ns(50)
+                p99 = hist.percentile_ns(99)
+                per[str(b)] = {
+                    "p50_ns": round(p50, 1),
+                    "p99_ns": round(p99, 1),
+                    "qps": round(b * 1e9 / max(p50, 1.0), 1),
+                }
+            res[name] = per
+        record["infer_engines"] = res
+        chosen = res.get(serve_engine) or res["Routed"]
+        record["infer_qps"] = max(v["qps"] for v in chosen.values())
+        record["infer_batch_p50_ns"] = {
+            b: v["p50_ns"] for b, v in chosen.items()
+        }
+        record["infer_batch_p99_ns"] = {
+            b: v["p99_ns"] for b, v in chosen.items()
+        }
+    except Exception as e:
+        record["serve_family_error"] = f"{type(e).__name__}: {e}"
+
+
 def synth_higgs_chunk(rng, rows, features):
     """One chunk of the synthetic Higgs-shaped table — the ONE label
     model shared by the bench rows and the north-star flow, so their AUC
@@ -642,9 +798,24 @@ def run_bench(backend, rows, trees, depth, features, with_baseline, probe_log):
         record["infer_ns_per_example"] = round(bres["ns_per_example"], 1)
         record["infer_p50_ns"] = round(bres["p50_ns_per_example"], 1)
         record["infer_p99_ns"] = round(bres["p99_ns_per_example"], 1)
+        # Serving-regression guard (ROADMAP item 1): compare against the
+        # recorded same-shape floor — floors at different (rows, trees)
+        # shapes are NOT comparable (the r04→r05 "regression" was a
+        # shape confound, see INFER_P50_FLOOR_NS).
+        floor = INFER_P50_FLOOR_NS.get((rows, trees))
+        if floor is not None:
+            record["infer_p50_floor_ns"] = floor
+            record["infer_p50_within_floor"] = bool(
+                record["infer_p50_ns"] <= floor
+            )
         _PARTIAL = dict(record)
     except Exception as e:
         record["infer_extra_error"] = f"{type(e).__name__}: {e}"
+    # Serving bench family: per-engine QPS + p50/p99 per batch size, and
+    # which engine actually serves (serve_engine) — rides every headline
+    # record (ROADMAP item 1's "millions of users" measurement).
+    measure_serving_family(model, data, rows, record)
+    _PARTIAL = dict(record)
     if backend not in ("cpu",):
         hardware_extras(model, data, record)
     return record, model

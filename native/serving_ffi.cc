@@ -1,0 +1,604 @@
+// Native batched-inference kernel over the flat serving data bank
+// ("ydf_serve_batch" family) — the production CPU serving engine.
+//
+// Training got ~8x faster across five native-kernel PRs while serving
+// kept running the generic XLA tree scan; this kernel is the serving
+// counterpart of that work (ROADMAP open item 1). The model is
+// flattened ONCE at load into the struct-of-arrays data bank of
+// ydf_tpu/serving/flatten.py (the same node encoding the portable blob
+// and the embed ROUTING lowering use), and each predict call is then
+// one multithreaded pass over rows: per example, walk every tree's
+// node chain through the cache-resident flat tables and accumulate the
+// leaf values. The same gather/routing-bound argument the training
+// kernels proved (and Booster, arXiv 2011.02022, makes for GBT
+// inference) applies: flat node tables walked in a tight batched loop
+// beat the generic whole-array gather scan.
+//
+// Node encoding (serving/flatten.py):
+//   feature >= 0 : axis-aligned numerical, go left iff x < thresh
+//   feature == -1: leaf; aux = offset into leaf_values (units of V)
+//   feature == -2: categorical; aux = mask-bank row, cat_feature =
+//                  GLOBAL feature id (column = cat_feature - Fn)
+//   feature == -3: oblique; aux = CSR row into proj_start
+//
+// Two input modes share one templated row walk:
+//   value mode   — f32 x_num [n, Fn] + i32 x_cat [n, Fc] (the engine
+//                  inputs GenericModel._raw_scores encodes); numerical
+//                  condition `x < thresh`.
+//   binned mode  — u8 bins [n, num_scalar] from the model's own Binner
+//                  (the 8-bit fast path: condition `bin <= thresh_bin`,
+//                  categorical codes ride their bin column). Oblique
+//                  nodes cannot run on bins; the Python side gates it.
+//
+// Parity contract (the training-kernel standard): the walk replicates
+// ops/routing.py:route_tree_values' semantics EXACTLY for the engine
+// envelope — same clamps (cat code max(c,0), mask word min(c>>5, W-1)),
+// same missing handling (NaN numerical / negative categorical code →
+// the node's na_left direction), the oblique dot accumulated
+// sequentially in ascending feature order over the non-zero projection
+// weights (adding the zero-weight terms the oracle multiplies by zero
+// changes no bit of a sequential f32 sum), and per-example tree
+// accumulation in ascending tree order with one f32 add per tree —
+// exactly lax.scan's accumulation. Bit-stability across thread counts
+// is trivial: every output row is a pure function of its input row;
+// blocks only partition rows.
+//
+// Surfaces:
+//   * ctypes handle API — the bank is copied once into an owned handle
+//     at model load (ydf_serve_bank_create) and each predict call is a
+//     two-pointer call (ydf_serve_batch / ydf_serve_batch_binned): no
+//     XLA dispatch on the serving hot path.
+//   * XLA FFI custom call "ydf_serve_batch" (YdfServeBatch) — the same
+//     walk over argument buffers, registered with the merged kernel
+//     library (ops/native_ffi.py) so serving can also run inside a
+//     jitted program and the registers-or-raises smoke contract covers
+//     it.
+//
+// Built by ydf_tpu/ops/native_ffi.py into the shared kernel library
+// (with the histogram/binning/routing kernels, sharing the persistent
+// pool in native/thread_pool.h). YDF_TPU_SERVE_THREADS caps the
+// per-call task wave.
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+#include "thread_pool.h"
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+// In-kernel wall attribution (read through ctypes by
+// ydf_tpu/utils/profiling.py; the native smoke test asserts the
+// counter advances across an engine call).
+static std::atomic<int64_t> g_serve_ns{0};
+static std::atomic<int64_t> g_serve_calls{0};
+
+extern "C" int64_t ydf_serve_ns_total() { return g_serve_ns.load(); }
+extern "C" int64_t ydf_serve_calls_total() { return g_serve_calls.load(); }
+extern "C" void ydf_serve_counters_reset() {
+  g_serve_ns.store(0);
+  g_serve_calls.store(0);
+}
+
+namespace {
+
+class ScopedServeTimer {
+ public:
+  ScopedServeTimer() : t0_(std::chrono::steady_clock::now()) {}
+  ~ScopedServeTimer() {
+    g_serve_ns.fetch_add(
+        std::chrono::duration_cast<std::chrono::nanoseconds>(
+            std::chrono::steady_clock::now() - t0_)
+            .count());
+    g_serve_calls.fetch_add(1);
+  }
+
+ private:
+  std::chrono::steady_clock::time_point t0_;
+};
+
+// Non-owning view of the flat data bank — the one struct both surfaces
+// (ctypes handle, XLA FFI buffers) route through.
+struct BankView {
+  int64_t T = 0, total = 0;
+  int32_t Fn = 0, Fc = 0, V = 1, W = 0;
+  const uint32_t* tree_offset = nullptr;  // [T]
+  const int32_t* feature = nullptr;       // [total]
+  const uint32_t* aux = nullptr;          // [total]
+  const uint32_t* cat_feature = nullptr;  // [total]
+  const float* thresh = nullptr;          // [total]
+  const int32_t* thresh_bin = nullptr;    // [total] (binned mode only)
+  const uint32_t* left = nullptr;         // [total]
+  const uint32_t* right = nullptr;        // [total]
+  const uint8_t* na_left = nullptr;       // [total]
+  const float* leaf_values = nullptr;     // [n_leaf * V]
+  const uint32_t* masks = nullptr;        // [n_masks * W]
+  const uint32_t* proj_start = nullptr;   // [n_proj + 1]
+  const uint32_t* proj_feature = nullptr;
+  const float* proj_weight = nullptr;
+};
+
+// Value-mode input adapter: raw floats + categorical vocab indices.
+struct FloatInput {
+  const float* x_num;
+  const int32_t* x_cat;
+  int32_t Fn, Fc;
+
+  inline int32_t Cat(int64_t i, int32_t col) const {
+    if (col < 0) col = 0;
+    if (col >= Fc) col = Fc > 0 ? Fc - 1 : 0;
+    return Fc > 0 ? x_cat[i * Fc + col] : 0;
+  }
+  inline float Num(int64_t i, int32_t f) const {
+    if (f < 0) f = 0;
+    if (f >= Fn) f = Fn > 0 ? Fn - 1 : 0;
+    return Fn > 0 ? x_num[i * Fn + f] : 0.0f;
+  }
+  // go-left of a numerical node; `missing` reports NaN for the
+  // na_left override (ops/routing.py value-mode semantics).
+  inline bool NumGoLeft(const BankView& b, int64_t i, int32_t fid,
+                        int64_t e, bool* missing) const {
+    const float v = Num(i, fid);
+    *missing = std::isnan(v);
+    return v < b.thresh[e];
+  }
+  static constexpr bool kSupportsOblique = true;
+};
+
+// Binned-mode input adapter: the model's own uint8 bin matrix over the
+// scalar columns (numerical bins in [0, Fn), categorical codes riding
+// their columns in [Fn, Fn + Fc)). Numerical condition is the binner's
+// `bin <= threshold_bin` (ops/routing.py binned mode); bins carry no
+// missingness (the binner imputes), so `missing` is always false.
+struct BinnedInput {
+  const uint8_t* bins;
+  int32_t Fn, Fs;  // Fs = num_scalar columns in the bins matrix
+
+  inline int32_t Cat(int64_t i, int32_t col) const {
+    int32_t c = Fn + col;
+    if (c < 0) c = 0;
+    if (c >= Fs) c = Fs > 0 ? Fs - 1 : 0;
+    return Fs > 0 ? static_cast<int32_t>(bins[i * Fs + c]) : 0;
+  }
+  inline float Num(int64_t, int32_t) const { return 0.0f; }  // no oblique
+  inline bool NumGoLeft(const BankView& b, int64_t i, int32_t fid,
+                        int64_t e, bool* missing) const {
+    *missing = false;
+    int32_t f = fid;
+    if (f < 0) f = 0;
+    if (f >= Fs) f = Fs > 0 ? Fs - 1 : 0;
+    const int32_t bin = Fs > 0 ? static_cast<int32_t>(bins[i * Fs + f]) : 0;
+    return bin <= b.thresh_bin[e];
+  }
+  static constexpr bool kSupportsOblique = false;
+};
+
+// Walks rows [r0, r1) through every tree, accumulating leaf values into
+// out [n, V] (zero-initialized here). Per-row pure function — the
+// thread-count bit-stability is by construction.
+template <typename Input>
+void ServeRows(const BankView& b, const Input& in, int64_t r0, int64_t r1,
+               float* out) {
+  const int32_t V = b.V;
+  const int32_t W = b.W;
+  for (int64_t i = r0; i < r1; ++i) {
+    float* acc = out + i * V;
+    for (int32_t j = 0; j < V; ++j) acc[j] = 0.0f;
+    for (int64_t t = 0; t < b.T; ++t) {
+      const int64_t base = b.tree_offset[t];
+      int64_t node = 0;
+      // Safety bound only: well-formed trees reach a leaf in <= total
+      // steps; a corrupted bank must not hang the server.
+      for (int64_t step = 0; step <= b.total; ++step) {
+        const int64_t e = base + node;
+        if (e < 0 || e >= b.total) break;
+        const int32_t fid = b.feature[e];
+        if (fid == -1) {  // leaf
+          const float* lv =
+              b.leaf_values + static_cast<int64_t>(b.aux[e]) * V;
+          for (int32_t j = 0; j < V; ++j) acc[j] += lv[j];
+          break;
+        }
+        bool gl;
+        bool missing = false;
+        if (fid == -2) {  // categorical mask
+          int32_t c = in.Cat(i, static_cast<int32_t>(b.cat_feature[e]) -
+                                    b.Fn);
+          missing = c < 0;
+          if (c < 0) c = 0;  // oracle: unpack_mask_bit(max(c, 0))
+          // Word index clamps like the oracle's take_along_axis (XLA
+          // gather clamp); the bit shift uses the raw low 5 bits.
+          int32_t w = c >> 5;
+          if (w >= W) w = W > 0 ? W - 1 : 0;
+          const uint32_t word =
+              W > 0 ? b.masks[static_cast<int64_t>(b.aux[e]) * W + w] : 0u;
+          gl = ((word >> (static_cast<uint32_t>(c) & 31u)) & 1u) != 0;
+        } else if (fid == -3) {  // oblique projection (value mode only)
+          if (!Input::kSupportsOblique) break;
+          const uint32_t p0 = b.proj_start[b.aux[e]];
+          const uint32_t p1 = b.proj_start[b.aux[e] + 1];
+          // Sequential ascending-feature sum over the non-zero weights
+          // — bit-equal to the oracle's masked full-row sequential sum
+          // (the dropped terms are exact zeros).
+          float v = 0.0f;
+          for (uint32_t p = p0; p < p1; ++p) {
+            v += b.proj_weight[p] *
+                 in.Num(i, static_cast<int32_t>(b.proj_feature[p]));
+          }
+          missing = std::isnan(v);
+          gl = v < b.thresh[e];
+        } else {  // axis-aligned numerical
+          gl = in.NumGoLeft(b, i, fid, e, &missing);
+        }
+        if (missing) gl = b.na_left[e] != 0;
+        node = gl ? b.left[e] : b.right[e];
+      }
+    }
+  }
+}
+
+// Serving block: smaller than the training kernels' 32k — serving
+// batches are request-sized (1..4k rows) and a block must not serialize
+// a whole 4k batch onto one lane. Fixed regardless of thread count.
+constexpr int64_t kServeRowBlock = 512;
+
+int ResolveServeThreads(int64_t nblocks) {
+  int num_threads = 0;
+  if (const char* env = std::getenv("YDF_TPU_SERVE_THREADS")) {
+    num_threads = std::atoi(env);
+  }
+  if (num_threads <= 0) {
+    // hardware_concurrency() re-reads sysfs on glibc (~tens of µs) —
+    // never on the per-request path; cache it for the process.
+    static const int hw =
+        static_cast<int>(std::thread::hardware_concurrency());
+    num_threads = hw;
+  }
+  if (num_threads < 1) num_threads = 1;
+  return static_cast<int>(
+      std::min<int64_t>(num_threads, std::max<int64_t>(nblocks, 1)));
+}
+
+template <typename Input>
+void ServeBatch(const BankView& b, const Input& in, int64_t n, float* out) {
+  ScopedServeTimer timer;
+  const int64_t nblocks = (n + kServeRowBlock - 1) / kServeRowBlock;
+  auto run_block = [&](int64_t blk) {
+    const int64_t r0 = blk * kServeRowBlock;
+    const int64_t r1 = std::min(r0 + kServeRowBlock, n);
+    ServeRows(b, in, r0, r1, out);
+  };
+  if (nblocks <= 1) {  // single block: no thread resolution at all
+    run_block(0);
+    return;
+  }
+  const int threads = ResolveServeThreads(nblocks);
+  if (threads <= 1) {
+    for (int64_t blk = 0; blk < nblocks; ++blk) run_block(blk);
+    return;
+  }
+  for (int64_t w0 = 0; w0 < nblocks; w0 += threads) {
+    const int m =
+        static_cast<int>(std::min<int64_t>(threads, nblocks - w0));
+    ydf_native::ThreadPool::Get().Run(m,
+                                      [&, w0](int j) { run_block(w0 + j); });
+  }
+}
+
+// Owned bank: the ctypes handle. Arrays are copied once at model load
+// (the flatten-once contract) so the Python-side numpy buffers carry no
+// lifetime obligation.
+struct OwnedBank {
+  std::vector<uint32_t> tree_offset;
+  std::vector<int32_t> feature;
+  std::vector<uint32_t> aux, cat_feature;
+  std::vector<float> thresh;
+  std::vector<int32_t> thresh_bin;
+  std::vector<uint32_t> left, right;
+  std::vector<uint8_t> na_left;
+  std::vector<float> leaf_values;
+  std::vector<uint32_t> masks;
+  std::vector<uint32_t> proj_start;
+  std::vector<uint32_t> proj_feature;
+  std::vector<float> proj_weight;
+  BankView view;
+
+  // Branchless fast path (pure numerical+leaf banks, V == 1, no
+  // learned na_left directions — the common production GBT): leaves
+  // self-loop (left = right = self, thresh = +inf) so the walk is a
+  // FIXED depth[t] steps of load→compare→cmov per tree with no
+  // node-kind dispatch and no data-dependent branches. The
+  // general walk loses ~2/3 of its time to branch mispredicts on
+  // 50/50 split decisions once the bank is cache-resident; the
+  // fixed-depth select chain + independent per-row chains (the inner
+  // loop interleaves rows of a block, so out-of-order execution
+  // overlaps several walks) is the same branchless argument as the
+  // XLA oracle's vectorized scan, per row instead of per array.
+  // Bit-identity is preserved exactly: same `v < thresh` decision,
+  // NaN compares false → right, which with na_left == 0 everywhere is
+  // the oracle's missing direction; leaf self-loops replicate the
+  // oracle's is_leaf stay; accumulation order per row is unchanged.
+  bool fast_numeric = false;
+  std::vector<int32_t> d_feat;     // [total] leaf: 0
+  std::vector<float> d_thresh;     // [total] leaf: +inf (self-loop)
+  std::vector<uint32_t> d_left;    // [total] leaf: self
+  std::vector<uint32_t> d_right;   // [total] leaf: self
+  std::vector<float> d_leafval;    // [total] leaf value, 0 at internal
+  std::vector<int32_t> tree_depth; // [T] max root→leaf edge count
+
+  void BuildFastNumeric() {
+    const BankView& b = view;
+    if (b.V != 1) return;
+    for (int64_t e = 0; e < b.total; ++e) {
+      if (b.feature[e] == -2 || b.feature[e] == -3) return;
+      if (b.na_left[e]) return;
+    }
+    d_feat.resize(b.total);
+    d_thresh.resize(b.total);
+    d_left.resize(b.total);
+    d_right.resize(b.total);
+    d_leafval.resize(b.total);
+    tree_depth.assign(b.T, 0);
+    for (int64_t t = 0; t < b.T; ++t) {
+      const int64_t base = b.tree_offset[t];
+      const int64_t end = t + 1 < b.T
+                              ? static_cast<int64_t>(b.tree_offset[t + 1])
+                              : b.total;
+      for (int64_t e = base; e < end; ++e) {
+        const int64_t n = e - base;
+        if (b.feature[e] == -1) {
+          d_feat[e] = 0;
+          d_thresh[e] = INFINITY;
+          d_left[e] = static_cast<uint32_t>(n);
+          d_right[e] = static_cast<uint32_t>(n);
+          d_leafval[e] = b.leaf_values[b.aux[e]];
+        } else {
+          d_feat[e] = b.feature[e];
+          d_thresh[e] = b.thresh[e];
+          d_left[e] = b.left[e];
+          d_right[e] = b.right[e];
+          d_leafval[e] = 0.0f;
+        }
+      }
+      // Iterative depth: longest root→leaf edge count bounds the
+      // fixed-step walk.
+      std::vector<std::pair<int64_t, int32_t>> stack{{0, 0}};
+      int32_t depth = 0;
+      while (!stack.empty()) {
+        auto [n, d] = stack.back();
+        stack.pop_back();
+        const int64_t e = base + n;
+        if (e < base || e >= end) continue;
+        if (b.feature[e] == -1) {
+          depth = std::max(depth, d);
+          continue;
+        }
+        if (d >= static_cast<int32_t>(end - base)) continue;  // cycle guard
+        stack.push_back({b.left[e], d + 1});
+        stack.push_back({b.right[e], d + 1});
+      }
+      tree_depth[t] = depth;
+    }
+    fast_numeric = true;
+  }
+};
+
+// Serving block: smaller than the training kernels' 32k — serving
+// batches are request-sized and a block must not serialize a whole
+// batch onto one lane (declared above for ServeBatch; reused here for
+// the fast walk's node-state buffer bound).
+void ServeRowsFastNumeric(const OwnedBank& o, const float* x_num,
+                          int64_t r0, int64_t r1, float* out) {
+  const BankView& b = o.view;
+  const int32_t Fn = b.Fn;
+  const int32_t* df = o.d_feat.data();
+  const float* dt = o.d_thresh.data();
+  const uint32_t* dl = o.d_left.data();
+  const uint32_t* dr = o.d_right.data();
+  const float* dv = o.d_leafval.data();
+  const int64_t m = r1 - r0;
+  int32_t node[kServeRowBlock];  // block-sized walk state
+  for (int64_t i = 0; i < m; ++i) out[r0 + i] = 0.0f;
+  for (int64_t t = 0; t < b.T; ++t) {
+    const int64_t base = b.tree_offset[t];
+    const int32_t D = o.tree_depth[t];
+    for (int64_t i = 0; i < m; ++i) node[i] = 0;
+    for (int32_t step = 0; step < D; ++step) {
+      // Independent per-row chains: out-of-order execution overlaps
+      // several load→compare→select walks; no data-dependent branch.
+      for (int64_t i = 0; i < m; ++i) {
+        const int64_t e = base + node[i];
+        const bool gl = x_num[(r0 + i) * Fn + df[e]] < dt[e];
+        node[i] = static_cast<int32_t>(gl ? dl[e] : dr[e]);
+      }
+    }
+    for (int64_t i = 0; i < m; ++i) {
+      out[r0 + i] += dv[base + node[i]];
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Copies the flat bank into an owned handle. `thresh_bin` may be null
+// (binned serving then unavailable for this bank).
+void* ydf_serve_bank_create(
+    int64_t T, int64_t total, const uint32_t* tree_offset,
+    const int32_t* feature, const uint32_t* aux, const uint32_t* cat_feature,
+    const float* thresh, const int32_t* thresh_bin, const uint32_t* left,
+    const uint32_t* right, const uint8_t* na_left, int64_t n_leaf_vals,
+    const float* leaf_values, int32_t leaf_width, int64_t n_masks,
+    int32_t mask_words, const uint32_t* masks, int64_t n_proj,
+    const uint32_t* proj_start, int64_t n_pf, const uint32_t* proj_feature,
+    const float* proj_weight, int32_t Fn, int32_t Fc) {
+  auto* o = new OwnedBank();
+  o->tree_offset.assign(tree_offset, tree_offset + T);
+  o->feature.assign(feature, feature + total);
+  o->aux.assign(aux, aux + total);
+  o->cat_feature.assign(cat_feature, cat_feature + total);
+  o->thresh.assign(thresh, thresh + total);
+  if (thresh_bin) {
+    o->thresh_bin.assign(thresh_bin, thresh_bin + total);
+  } else {
+    o->thresh_bin.assign(total, 0);
+  }
+  o->left.assign(left, left + total);
+  o->right.assign(right, right + total);
+  o->na_left.assign(na_left, na_left + total);
+  o->leaf_values.assign(leaf_values, leaf_values + n_leaf_vals);
+  o->masks.assign(masks, masks + n_masks * mask_words);
+  o->proj_start.assign(proj_start, proj_start + n_proj + 1);
+  o->proj_feature.assign(proj_feature, proj_feature + n_pf);
+  o->proj_weight.assign(proj_weight, proj_weight + n_pf);
+
+  BankView& v = o->view;
+  v.T = T;
+  v.total = total;
+  v.Fn = Fn;
+  v.Fc = Fc;
+  v.V = leaf_width;
+  v.W = mask_words;
+  v.tree_offset = o->tree_offset.data();
+  v.feature = o->feature.data();
+  v.aux = o->aux.data();
+  v.cat_feature = o->cat_feature.data();
+  v.thresh = o->thresh.data();
+  v.thresh_bin = o->thresh_bin.data();
+  v.left = o->left.data();
+  v.right = o->right.data();
+  v.na_left = o->na_left.data();
+  v.leaf_values = o->leaf_values.data();
+  v.masks = o->masks.data();
+  v.proj_start = o->proj_start.data();
+  v.proj_feature = o->proj_feature.data();
+  v.proj_weight = o->proj_weight.data();
+  o->BuildFastNumeric();
+  return o;
+}
+
+void ydf_serve_bank_free(void* h) { delete static_cast<OwnedBank*>(h); }
+
+// Value mode: x_num f32 [n, Fn], x_cat i32 [n, Fc] → out f32 [n, V]
+// (raw tree-sum scores, no init/link — the engine contract).
+void ydf_serve_batch(const void* h, const float* x_num, const int32_t* x_cat,
+                     int64_t n, float* out) {
+  const OwnedBank* o = static_cast<const OwnedBank*>(h);
+  if (o->fast_numeric) {
+    ScopedServeTimer timer;
+    const int64_t nblocks = (n + kServeRowBlock - 1) / kServeRowBlock;
+    auto run_block = [&](int64_t blk) {
+      const int64_t r0 = blk * kServeRowBlock;
+      ServeRowsFastNumeric(*o, x_num, r0,
+                           std::min(r0 + kServeRowBlock, n), out);
+    };
+    if (nblocks <= 1) {
+      run_block(0);
+      return;
+    }
+    const int threads = ResolveServeThreads(nblocks);
+    if (threads <= 1) {
+      for (int64_t blk = 0; blk < nblocks; ++blk) run_block(blk);
+      return;
+    }
+    for (int64_t w0 = 0; w0 < nblocks; w0 += threads) {
+      const int m =
+          static_cast<int>(std::min<int64_t>(threads, nblocks - w0));
+      ydf_native::ThreadPool::Get().Run(
+          m, [&, w0](int j) { run_block(w0 + j); });
+    }
+    return;
+  }
+  const BankView& b = o->view;
+  FloatInput in{x_num, x_cat, b.Fn, b.Fc};
+  ServeBatch(b, in, n, out);
+}
+
+// Binned mode: bins u8 [n, num_scalar] → out f32 [n, V]. `num_scalar`
+// names the bins-matrix width (Fn numerical + Fc categorical columns).
+void ydf_serve_batch_binned(const void* h, const uint8_t* bins,
+                            int32_t num_scalar, int64_t n, float* out) {
+  const BankView& b = static_cast<const OwnedBank*>(h)->view;
+  BinnedInput in{bins, b.Fn, num_scalar};
+  ServeBatch(b, in, n, out);
+}
+
+}  // extern "C"
+
+// XLA FFI surface: the same value-mode walk over argument buffers
+// (bank arrays ride as inputs; XLA keeps them as resident host buffers,
+// so no per-call copy). Output [n, V] carries V.
+static ffi::Error ServeBatchFfiImpl(
+    ffi::Buffer<ffi::DataType::F32> x_num,
+    ffi::Buffer<ffi::DataType::S32> x_cat,
+    ffi::Buffer<ffi::DataType::U32> tree_offset,
+    ffi::Buffer<ffi::DataType::S32> feature,
+    ffi::Buffer<ffi::DataType::U32> aux,
+    ffi::Buffer<ffi::DataType::U32> cat_feature,
+    ffi::Buffer<ffi::DataType::F32> thresh,
+    ffi::Buffer<ffi::DataType::U32> left,
+    ffi::Buffer<ffi::DataType::U32> right,
+    ffi::Buffer<ffi::DataType::U8> na_left,
+    ffi::Buffer<ffi::DataType::F32> leaf_values,
+    ffi::Buffer<ffi::DataType::U32> masks,
+    ffi::Buffer<ffi::DataType::U32> proj_start,
+    ffi::Buffer<ffi::DataType::U32> proj_feature,
+    ffi::Buffer<ffi::DataType::F32> proj_weight,
+    ffi::ResultBufferR2<ffi::DataType::F32> out) {
+  BankView b;
+  const auto xdims = x_num.dimensions();    // [n, Fn]
+  const auto cdims = x_cat.dimensions();    // [n, Fc]
+  const auto odims = out->dimensions();     // [n, V]
+  const auto mdims = masks.dimensions();    // [n_masks, W]
+  b.T = static_cast<int64_t>(tree_offset.dimensions()[0]);
+  b.total = static_cast<int64_t>(feature.dimensions()[0]);
+  b.Fn = static_cast<int32_t>(xdims[1]);
+  b.Fc = static_cast<int32_t>(cdims[1]);
+  b.V = static_cast<int32_t>(odims[1]);
+  b.W = mdims.size() > 1 ? static_cast<int32_t>(mdims[1]) : 0;
+  b.tree_offset = tree_offset.typed_data();
+  b.feature = feature.typed_data();
+  b.aux = aux.typed_data();
+  b.cat_feature = cat_feature.typed_data();
+  b.thresh = thresh.typed_data();
+  b.thresh_bin = nullptr;  // value mode only on the FFI surface
+  b.left = left.typed_data();
+  b.right = right.typed_data();
+  b.na_left = na_left.typed_data();
+  b.leaf_values = leaf_values.typed_data();
+  b.masks = masks.typed_data();
+  b.proj_start = proj_start.typed_data();
+  b.proj_feature = proj_feature.typed_data();
+  b.proj_weight = proj_weight.typed_data();
+  const int64_t n = static_cast<int64_t>(xdims[0]);
+  FloatInput in{x_num.typed_data(), x_cat.typed_data(), b.Fn, b.Fc};
+  ServeBatch(b, in, n, out->typed_data());
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    YdfServeBatch, ServeBatchFfiImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::DataType::F32>>()
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()
+        .Arg<ffi::Buffer<ffi::DataType::U32>>()
+        .Arg<ffi::Buffer<ffi::DataType::S32>>()
+        .Arg<ffi::Buffer<ffi::DataType::U32>>()
+        .Arg<ffi::Buffer<ffi::DataType::U32>>()
+        .Arg<ffi::Buffer<ffi::DataType::F32>>()
+        .Arg<ffi::Buffer<ffi::DataType::U32>>()
+        .Arg<ffi::Buffer<ffi::DataType::U32>>()
+        .Arg<ffi::Buffer<ffi::DataType::U8>>()
+        .Arg<ffi::Buffer<ffi::DataType::F32>>()
+        .Arg<ffi::Buffer<ffi::DataType::U32>>()
+        .Arg<ffi::Buffer<ffi::DataType::U32>>()
+        .Arg<ffi::Buffer<ffi::DataType::U32>>()
+        .Arg<ffi::Buffer<ffi::DataType::F32>>()
+        .Ret<ffi::BufferR2<ffi::DataType::F32>>());

@@ -59,13 +59,39 @@ def test_small_cpu_run_emits_parseable_record():
     assert rec["route_impl"] in ("xla", "native")
     assert rec["route_threads"] >= 1
     assert rec["hist_threads"] >= 1
-    # Serving percentiles (this round): every headline record carries
-    # p50/p99 per-example inference latency from the telemetry latency
+    # Serving percentiles: every headline record carries p50/p99
+    # per-example inference latency from the telemetry latency
     # histogram next to the historical best-of-runs floor — the
     # serving-regression guard ROADMAP item 1 reads.
     assert rec["infer_ns_per_example"] > 0
     assert rec["infer_p50_ns"] > 0
     assert rec["infer_p99_ns"] >= rec["infer_p50_ns"]
+    # Serving-regression guard (this round): the --small shape
+    # (20k rows, 5 trees) has a recorded floor (BENCH_r04's 640.5 ns
+    # quick floor); the record must carry the comparison, and the
+    # measured p50 must hold the floor (1.5x margin absorbs box
+    # contention — the recorded runs show the native engine well
+    # under it).
+    assert rec["infer_p50_floor_ns"] == 640.5
+    assert rec["infer_p50_within_floor"] in (True, False)
+    assert rec["infer_p50_ns"] <= rec["infer_p50_floor_ns"] * 1.5
+    # Serving bench family (this round): which engine actually served
+    # the headline measurement, rows/sec at the best batch size, and
+    # per-call p50/p99 at every bench batch size — per compatible
+    # engine in infer_engines, headline engine flattened on the record.
+    assert isinstance(rec["serve_engine"], str) and rec["serve_engine"]
+    assert rec["infer_qps"] > 0
+    for field in ("infer_batch_p50_ns", "infer_batch_p99_ns"):
+        assert set(rec[field]) == {"1", "16", "256", "4096"}
+        assert all(v > 0 for v in rec[field].values())
+    assert rec["serve_engine"] in rec["infer_engines"]
+    for eng, per in rec["infer_engines"].items():
+        for b, row in per.items():
+            assert row["p99_ns"] >= row["p50_ns"] > 0
+            assert row["qps"] > 0
+    # On this CPU image the native engine must actually be the one
+    # serving — anything else means the build silently degraded.
+    assert rec["serve_engine"] == "NativeBatch"
     # The backend-probe outcome is persisted across rounds; the record
     # names whether this run used the cache (--cpu skips the probe, so
     # here it is simply present and False).

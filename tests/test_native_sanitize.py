@@ -2,8 +2,9 @@
 YDF_TPU_NATIVE_SANITIZE={asan,ubsan} in ops/native_ffi.py compiles the
 WHOLE shared kernel library (-fsanitize=..., separate .so name so the
 normal build is never clobbered) and these tests drive every kernel
-family — histogram f32+q8, binning, routing/prediction-update — under
-it in a subprocess. Correctness tooling for every future native PR: a
+family — histogram f32+q8, binning, routing/prediction-update, and the
+batched serving family (ydf_serve_batch, both surfaces and input
+modes) — under it in a subprocess. Correctness tooling for every future native PR: a
 heap overflow or UB in a new kernel fails HERE with a report instead of
 corrupting a benchmark three rounds later.
 
@@ -112,6 +113,58 @@ np.asarray(routing_native.route_tree(
     res.tree.is_set, res.tree.cat_mask, res.tree.left, res.tree.right,
     res.tree.is_leaf, 4,
 ))
+
+# batched serving kernel family (native/serving_ffi.cc): both surfaces
+# (ctypes handle + XLA FFI) and both input modes (value + binned) over a
+# real trained model with categorical and oblique splits — the node
+# kinds exercise every branch of the row walk under the sanitizer.
+import pandas as pd
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+from ydf_tpu.serving import native_serve
+from ydf_tpu.dataset.dataset import Dataset
+
+df = pd.DataFrame({f"g{i}": rng.normal(size=1500) for i in range(5)})
+df["c"] = np.asarray(rng.choice(list("abcd"), size=1500))
+df["y"] = (df["g0"] + df["g1"] * df["g2"] + (df["c"] == "a")).astype(
+    np.float32
+)
+m = ydf.GradientBoostedTreesLearner(
+    label="y", task=Task.REGRESSION, num_trees=4, max_depth=4,
+    validation_ratio=0.0, early_stopping="NONE",
+).train(df)
+ds = Dataset.from_data(df, dataspec=m.dataspec)
+x_num, x_cat, _ = m._encode_inputs(ds)
+eng = native_serve.build_native_engine(m)
+assert eng is not None
+np.asarray(eng(x_num, x_cat))
+bq = native_serve.build_native_binned_engine(m)
+assert bq is not None
+np.asarray(bq(m.binner.transform(ds)[:, : m.binner.num_scalar]))
+np.asarray(native_serve.serve_batch_ffi(
+    native_serve.model_serve_bank(m), x_num, x_cat))
+mo = ydf.GradientBoostedTreesLearner(
+    label="y", task=Task.REGRESSION, num_trees=3, max_depth=4,
+    split_axis="SPARSE_OBLIQUE",
+    validation_ratio=0.0, early_stopping="NONE",
+).train(df)
+dso = Dataset.from_data(df, dataspec=mo.dataspec)
+xo_num, xo_cat, _ = mo._encode_inputs(dso)
+engo = native_serve.build_native_engine(mo)
+assert engo is not None
+np.asarray(engo(xo_num, xo_cat))
+# pure-numerical model: drives the branchless fixed-depth fast walk
+# (serving_ffi.cc ServeRowsFastNumeric) under the sanitizer too.
+dfn = df.drop(columns=["c"])
+mn = ydf.GradientBoostedTreesLearner(
+    label="y", task=Task.REGRESSION, num_trees=4, max_depth=4,
+    validation_ratio=0.0, early_stopping="NONE",
+).train(dfn)
+dsn = Dataset.from_data(dfn, dataspec=mn.dataspec)
+xn_num, xn_cat, _ = mn._encode_inputs(dsn)
+engn = native_serve.build_native_engine(mn)
+assert engn is not None
+np.asarray(engn(xn_num, xn_cat))
 print("SANITIZE_RUN_OK", mode)
 """
 

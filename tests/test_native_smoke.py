@@ -129,6 +129,7 @@ def test_q8_target_registered_alongside_f32():
         "ydf_binning",
         "ydf_route_update", "ydf_leaf_update", "ydf_leaf_update_grad",
         "ydf_route_tree",
+        "ydf_serve_batch",
     }
     assert KERNELS_LIB.ensure_ffi_registered()
 
@@ -185,3 +186,52 @@ def test_explicit_native_route_fails_loudly_when_unavailable(monkeypatch):
     monkeypatch.setattr(routing_native._LIB, "_ffi_registered", False)
     with pytest.raises(RuntimeError, match="could not be built"):
         routing_native._require_registered()
+
+
+def test_serving_kernel_registers_and_counter_advances():
+    """The batched serving kernel (native/serving_ffi.cc) registers with
+    the shared library and REALLY runs: its in-kernel wall/call counters
+    must advance across an engine call — the bench's serve attribution
+    and the QPS family would otherwise silently time a fallback."""
+    import pandas as pd
+
+    import ydf_tpu as ydf
+    from ydf_tpu.config import Task
+    from ydf_tpu.serving import native_serve
+
+    assert native_serve.available(), (
+        "native serving kernel failed to build/register — predict would "
+        "silently fall back to the generic engine"
+    )
+    rng = np.random.RandomState(0)
+    df = pd.DataFrame({f"f{i}": rng.normal(size=600) for i in range(4)})
+    df["y"] = (df.f0 + df.f1).astype(np.float32)
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", task=Task.REGRESSION, num_trees=3, max_depth=3,
+        validation_ratio=0.0, early_stopping="NONE",
+    ).train(df)
+    eng = native_serve.build_native_engine(m)
+    assert eng is not None
+    from ydf_tpu.dataset.dataset import Dataset
+
+    ds = Dataset.from_data(df, dataspec=m.dataspec)
+    x_num, x_cat, _ = m._encode_inputs(ds)
+    calls0 = native_serve.serve_kernel_calls()
+    ns0 = native_serve.serve_kernel_seconds()
+    out = eng(x_num, x_cat)
+    assert np.isfinite(out).all()
+    assert native_serve.serve_kernel_calls() > calls0, (
+        "engine call did not reach the native serving kernel"
+    )
+    assert native_serve.serve_kernel_seconds() >= ns0
+
+
+def test_explicit_native_serve_fails_loudly_when_unavailable(monkeypatch):
+    """YDF_TPU_SERVE_IMPL=native with a failed build must raise (the
+    serving side of the no-silent-fallback contract)."""
+    from ydf_tpu.serving import native_serve
+
+    monkeypatch.setattr(native_serve._LIB, "_failed", True)
+    monkeypatch.setattr(native_serve._LIB, "_ffi_registered", False)
+    with pytest.raises(RuntimeError, match="could not be built"):
+        native_serve._require_registered()

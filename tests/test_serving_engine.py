@@ -1,0 +1,484 @@
+"""Production serving engine: cross-engine bit-parity, thread
+bit-stability, the request-coalescing batcher, and the serving env
+contract (this round's tentpole — docs/serving.md).
+
+Parity strategy (the reference's TestGenericEngine /
+ExpectEqualPredictions, test_utils.h:254-331, tightened to BIT
+equality): the XLA value-mode scan (ops/routing.py:
+forest_predict_values) is the oracle; every fast engine compatible
+with a model must reproduce its raw scores exactly — the native
+batched data-bank kernel (ctypes and XLA-FFI surfaces), the binned
+native fast path, and the Pallas data-bank scorer in interpret mode.
+The portable C-ABI runtime is compared through its own blob round-trip
+(allclose — its link/init arithmetic is its own)."""
+
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax.numpy as jnp
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+from ydf_tpu.dataset.dataset import Dataset
+from ydf_tpu.ops.routing import forest_predict_values
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _oracle_raw(m, x_num, x_cat):
+    return np.asarray(
+        forest_predict_values(
+            m.forest, jnp.asarray(x_num), jnp.asarray(x_cat),
+            num_numerical=m.binner.num_numerical,
+            max_depth=m.max_depth, combine="sum",
+        )
+    )[:, 0]
+
+
+def _encoded(m, df):
+    ds = Dataset.from_data(df, dataspec=m.dataspec)
+    x_num, x_cat, _ = m._encode_inputs(ds)
+    return ds, x_num, x_cat
+
+
+def _mixed_df(n=3000, seed=0, with_nan=False):
+    rng = np.random.RandomState(seed)
+    df = pd.DataFrame({f"f{i}": rng.normal(size=n) for i in range(6)})
+    df["c"] = rng.choice(list("abcdefgh"), size=n)
+    df["y"] = (
+        df.f0 + df.f1 * df.f2 + (df.c == "a") - (df.c == "g")
+    ).astype(np.float32)
+    if with_nan:
+        for col in ("f0", "f3"):
+            mask = rng.rand(n) < 0.1
+            df.loc[mask, col] = np.nan
+    return df
+
+
+def _gbt(df, **kw):
+    kw.setdefault("num_trees", 8)
+    kw.setdefault("max_depth", 5)
+    return ydf.GradientBoostedTreesLearner(
+        label="y", task=Task.REGRESSION, validation_ratio=0.0,
+        early_stopping="NONE", **kw,
+    ).train(df)
+
+
+# --------------------------------------------------------------------- #
+# Cross-engine bit-parity suite
+# --------------------------------------------------------------------- #
+
+
+def _assert_all_engines_bit_identical(m, df, expect_binned=True,
+                                      expect_pallas=True):
+    from ydf_tpu.serving.native_serve import (
+        build_native_binned_engine,
+        build_native_engine,
+        model_serve_bank,
+        serve_batch_ffi,
+    )
+    from ydf_tpu.serving.pallas_scorer import build_pallas_scorer
+
+    ds, x_num, x_cat = _encoded(m, df)
+    oracle = _oracle_raw(m, x_num, x_cat)
+
+    eng = build_native_engine(m)
+    assert eng is not None, "model unexpectedly outside native envelope"
+    out = eng(x_num, x_cat)
+    assert np.array_equal(out, oracle), (
+        f"NativeBatch != oracle (max diff "
+        f"{np.max(np.abs(out - oracle))})"
+    )
+
+    ffi_out = np.asarray(
+        serve_batch_ffi(model_serve_bank(m), x_num, x_cat)
+    )[:, 0]
+    assert np.array_equal(ffi_out, oracle), "FFI surface != oracle"
+
+    bq = build_native_binned_engine(m)
+    if expect_binned:
+        assert bq is not None
+        bins = m.binner.transform(ds)[:, : m.binner.num_scalar]
+        bout = bq(bins)
+        assert np.array_equal(bout, oracle), "NativeBinned != oracle"
+
+    pe = build_pallas_scorer(m, interpret=True)
+    if expect_pallas:
+        assert pe is not None
+        pout = np.asarray(pe(x_num, x_cat))
+        assert np.array_equal(pout, oracle), "PallasBank != oracle"
+
+
+def test_parity_numerical_only():
+    df = _mixed_df().drop(columns=["c"])
+    _assert_all_engines_bit_identical(_gbt(df), df)
+
+
+def test_parity_mixed_categorical():
+    df = _mixed_df()
+    m = _gbt(df)
+    assert np.asarray(m.forest.is_cat)[
+        ~np.asarray(m.forest.is_leaf)
+    ].any(), "model grew no categorical splits — parity vacuous"
+    _assert_all_engines_bit_identical(m, df)
+
+
+def test_parity_nan_inputs():
+    """NaNs in the INPUT data: the engine path encodes with imputation,
+    so every engine sees the same imputed block — results stay
+    bit-identical (the oracle's missing branch is a no-op)."""
+    df = _mixed_df(with_nan=True)
+    _assert_all_engines_bit_identical(_gbt(df), df)
+
+
+def test_parity_oblique():
+    """Oblique projections: the native kernel's CSR dot (sequential,
+    ascending feature order, non-zero weights only) must be bit-equal
+    to the oracle's masked full-row sum."""
+    df = _mixed_df().drop(columns=["c"])
+    m = _gbt(df, split_axis="SPARSE_OBLIQUE",
+             sparse_oblique_num_projections_exponent=2.0)
+    assert np.asarray(m.forest.oblique_weights).size > 0
+    # Oblique is outside the binned and Pallas envelopes — the builders
+    # must decline, not mis-serve.
+    from ydf_tpu.serving.native_serve import build_native_binned_engine
+    from ydf_tpu.serving.pallas_scorer import build_pallas_scorer
+
+    assert build_native_binned_engine(m) is None
+    assert build_pallas_scorer(m, interpret=True) is None
+    _assert_all_engines_bit_identical(
+        m, df, expect_binned=False, expect_pallas=False
+    )
+
+
+def test_parity_multiclass_per_class_swap():
+    """Multiclass predict swaps per-class single-output sub-forests
+    through the fast engine (the QuickScorer pattern): forced NativeBatch
+    equals the generic path bit-for-bit on the class probabilities."""
+    rng = np.random.RandomState(3)
+    n = 1500
+    x = rng.normal(size=n)
+    z = rng.normal(size=n)
+    y = np.digitize(x + 0.3 * z, [-0.5, 0.5]).astype(np.int64)
+    data = {"x": x, "z": z, "y": y}
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=4, max_depth=4, validation_ratio=0.0,
+        early_stopping="NONE",
+    ).train(data)
+    m.force_engine("NativeBatch")
+    p_native = m.predict(data)
+    m.force_engine("Routed")
+    p_routed = m.predict(data)
+    m.force_engine(None)
+    assert p_native.shape == (n, 3)
+    assert np.array_equal(p_native, p_routed)
+
+
+def test_parity_portable_runtime(tmp_path):
+    """The portable C-ABI runtime round-trips the same data bank; its
+    raw scores match the engines within float tolerance (its init/link
+    arithmetic is its own — see portable.py)."""
+    from ydf_tpu.serving.portable import write_portable
+    from ydf_tpu.serving.portable_runtime import PortableModel, available
+
+    if not available():
+        pytest.skip("portable runtime unavailable (no toolchain)")
+    df = _mixed_df()
+    m = _gbt(df)
+    path = str(tmp_path / "m.ydfb")
+    write_portable(m, path)
+    pm = PortableModel(path)
+    _, x_num, x_cat = _encoded(m, df)
+    got = np.asarray(pm.predict(x_num, x_cat))
+    want = _oracle_raw(m, x_num, x_cat) + float(m.initial_predictions[0])
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    pm.close()
+
+
+def test_categorical_set_model_declines_fast_engines():
+    """Set-condition models are outside every fast-engine envelope: the
+    builders must return None and predict must still serve (generic)."""
+    rng = np.random.RandomState(0)
+    n = 800
+    items = list("abcdefg")
+    df = pd.DataFrame({
+        "s": [
+            " ".join(rng.choice(items, size=rng.randint(1, 4),
+                                replace=False))
+            for _ in range(n)
+        ],
+        "f0": rng.normal(size=n),
+    })
+    df["y"] = (
+        df.s.str.contains("a").astype(np.float32) + df.f0 * 0.1
+    )
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", task=Task.REGRESSION, num_trees=4, max_depth=4,
+        validation_ratio=0.0, early_stopping="NONE",
+        column_types={"s": ydf.ColumnType.CATEGORICAL_SET},
+    ).train(df)
+    if getattr(m.binner, "num_set", 0) == 0:
+        pytest.skip("no set feature trained — envelope test vacuous")
+    from ydf_tpu.serving.native_serve import build_native_engine
+    from ydf_tpu.serving.pallas_scorer import build_pallas_scorer
+
+    assert build_native_engine(m) is None
+    assert build_pallas_scorer(m, interpret=True) is None
+    assert "NativeBatch" not in m.list_compatible_engines()
+    assert np.isfinite(m.predict(df)).all()
+
+
+# --------------------------------------------------------------------- #
+# Thread bit-stability
+# --------------------------------------------------------------------- #
+
+
+def test_serve_batch_thread_bit_stability(monkeypatch):
+    """ydf_serve_batch output is a pure per-row function; any thread
+    count must reproduce every bit (the training-kernel contract). n
+    spans multiple 512-row blocks so the wave really parallelizes."""
+    from ydf_tpu.serving.native_serve import build_native_engine
+
+    df = _mixed_df(n=5000, seed=7)
+    m = _gbt(df)
+    _, x_num, x_cat = _encoded(m, df)
+    eng = build_native_engine(m)
+    assert eng is not None
+    ref = None
+    for t in ("1", "2", "5", "16"):
+        monkeypatch.setenv("YDF_TPU_SERVE_THREADS", t)
+        out = eng(x_num, x_cat)
+        if ref is None:
+            ref = out
+        else:
+            assert np.array_equal(out, ref), f"threads={t} changed bits"
+
+
+# --------------------------------------------------------------------- #
+# Registry / env contract
+# --------------------------------------------------------------------- #
+
+
+def test_native_engine_ranked_above_routed_on_cpu():
+    df = _mixed_df(n=1200)
+    m = _gbt(df, num_trees=4)
+    names = m.list_compatible_engines()
+    assert "NativeBatch" in names
+    assert names.index("NativeBatch") < names.index("Routed")
+
+
+def test_serve_impl_xla_disables_native(monkeypatch):
+    df = _mixed_df(n=1200)
+    m = _gbt(df, num_trees=4)
+    monkeypatch.setenv("YDF_TPU_SERVE_IMPL", "xla")
+    assert "NativeBatch" not in m.list_compatible_engines()
+    eng = m._fast_engine()
+    assert eng is None or type(eng).__name__ != "NativeBatchEngine"
+    monkeypatch.setenv("YDF_TPU_SERVE_IMPL", "auto")
+    assert "NativeBatch" in m.list_compatible_engines()
+
+
+def test_serve_impl_native_registers_or_raises(monkeypatch):
+    """YDF_TPU_SERVE_IMPL=native with a failed build must raise at
+    engine build — never silently fall back to the generic engine."""
+    from ydf_tpu.serving import native_serve
+
+    df = _mixed_df(n=1200)
+    m = _gbt(df, num_trees=4)
+    monkeypatch.setenv("YDF_TPU_SERVE_IMPL", "native")
+    assert np.isfinite(m.predict(df)).all()  # healthy build serves
+    monkeypatch.setattr(native_serve._LIB, "_failed", True)
+    monkeypatch.setattr(native_serve._LIB, "_ffi_registered", False)
+    m._qs_cache = {}
+    with pytest.raises(RuntimeError, match="could not be built"):
+        m.predict(df)
+
+
+def test_resolve_serve_impl_validates():
+    from ydf_tpu.serving.registry import resolve_serve_impl
+
+    assert resolve_serve_impl("auto") == "auto"
+    assert resolve_serve_impl("NATIVE") == "native"
+    with pytest.raises(ValueError, match="not a serving impl"):
+        resolve_serve_impl("turbo")
+
+
+@pytest.mark.parametrize(
+    "env,val",
+    [
+        ("YDF_TPU_SERVE_IMPL", "warp"),
+        ("YDF_TPU_SERVE_MAX_BATCH", "0"),
+        ("YDF_TPU_SERVE_MAX_BATCH", "many"),
+        ("YDF_TPU_SERVE_BATCH_TIMEOUT_US", "-5"),
+        ("YDF_TPU_FORCE_QUICKSCORER", "yes"),
+    ],
+)
+def test_serving_env_validated_at_import(env, val):
+    """The YDF_TPU_HIST_IMPL import-time contract for the serving knobs:
+    a malformed value fails `import ydf_tpu.serving.registry` in a fresh
+    process — never a silent fallback to the generic engine."""
+    out = subprocess.run(
+        [sys.executable, "-c", "import ydf_tpu.serving.registry"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", env: val},
+    )
+    assert out.returncode != 0
+    assert "ValueError" in out.stderr
+    assert env in out.stderr
+
+
+def test_force_engine_native(monkeypatch):
+    df = _mixed_df(n=1200)
+    m = _gbt(df, num_trees=4)
+    m.force_engine("NativeBatch")
+    p1 = m.predict(df)
+    m.force_engine("Routed")
+    p2 = m.predict(df)
+    m.force_engine(None)
+    assert np.array_equal(p1, p2)
+
+
+# --------------------------------------------------------------------- #
+# Request-coalescing batcher
+# --------------------------------------------------------------------- #
+
+
+def test_batcher_exact_once_order_preserved():
+    """Concurrent callers: every row answered exactly once with ITS OWN
+    result (row↔result mapping proven against the per-row oracle), and
+    rows coalesce into batches bounded by max_batch."""
+    from ydf_tpu.serving.registry import CoalescingBatcher
+
+    n = 600
+    rng = np.random.RandomState(0)
+    rows = rng.normal(size=(n, 3)).astype(np.float32)
+    seen_sizes = []
+
+    def batch_fn(x):
+        seen_sizes.append(x.shape[0])
+        assert x.shape[0] <= 32
+        return x.sum(axis=1) * 2.0
+
+    want = rows.sum(axis=1) * 2.0
+    results = {}
+    lock = threading.Lock()
+    with CoalescingBatcher(batch_fn, max_batch=32,
+                           timeout_us=500.0) as bat:
+        def worker(lo, hi):
+            for i in range(lo, hi):
+                r = bat.predict_one(rows[i])
+                with lock:
+                    assert i not in results  # exactly once
+                    results[i] = r
+
+        ts = [
+            threading.Thread(target=worker, args=(k * 75, (k + 1) * 75))
+            for k in range(8)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    assert len(results) == n
+    got = np.array([results[i] for i in range(n)], np.float32)
+    assert np.array_equal(got, want.astype(np.float32))
+    # Coalescing actually happened (not 600 singleton batches).
+    assert max(seen_sizes) > 1
+    assert sum(seen_sizes) == n
+
+
+def test_batcher_deadline_answers_partial_batch():
+    """A single row must be served at the deadline even when the batch
+    never fills."""
+    from ydf_tpu.serving.registry import CoalescingBatcher
+
+    with CoalescingBatcher(
+        lambda x: x * 3.0, max_batch=1024, timeout_us=2000.0
+    ) as bat:
+        out = bat.predict_one(np.float32(2.0))
+    assert out == np.float32(6.0)
+
+
+def test_batcher_error_propagates_to_all_callers():
+    from ydf_tpu.serving.registry import CoalescingBatcher
+
+    def boom(x):
+        raise RuntimeError("kernel down")
+
+    with CoalescingBatcher(boom, max_batch=4, timeout_us=500.0) as bat:
+        with pytest.raises(RuntimeError, match="kernel down"):
+            bat.predict_one(np.float32(1.0))
+    with pytest.raises(RuntimeError, match="closed"):
+        bat.predict_one(np.float32(1.0))
+
+
+def test_model_batcher_serves_engine_scores():
+    from ydf_tpu.serving.registry import model_batcher
+
+    df = _mixed_df(n=800)
+    m = _gbt(df, num_trees=4)
+    _, x_num, x_cat = _encoded(m, df)
+    ref = _oracle_raw(m, x_num, x_cat)
+    with model_batcher(m, max_batch=64, timeout_us=500.0) as bat:
+        got = np.array(
+            [bat.predict_one(x_num[i], x_cat[i]) for i in range(100)],
+            np.float32,
+        )
+    assert np.array_equal(got, ref[:100])
+
+
+def test_batcher_telemetry_histograms():
+    """The batcher reports through the per-engine serving histograms
+    (engine="Batcher") so p50/p99 under load is measurable."""
+    from ydf_tpu.serving.registry import CoalescingBatcher
+    from ydf_tpu.utils import telemetry
+
+    with telemetry.active(None):
+        with CoalescingBatcher(
+            lambda x: x * 2.0, max_batch=8, timeout_us=300.0
+        ) as bat:
+            for _ in range(10):
+                bat.predict_one(np.float32(1.0))
+        snap = telemetry.snapshot()
+        hists = [
+            k for k in snap["histograms"]
+            if k.startswith("ydf_serve_latency_ns")
+            and 'engine="Batcher"' in k
+        ]
+        assert hists, (
+            f"no Batcher latency histogram in {list(snap['histograms'])}"
+        )
+        assert snap["counters"].get("ydf_serve_batcher_rows_total") == 10
+
+
+# --------------------------------------------------------------------- #
+# Flatten-at-load cache
+# --------------------------------------------------------------------- #
+
+
+def test_bank_flattened_once_per_forest(monkeypatch):
+    """The data bank is built once at load and reused across predicts
+    (the flatten-at-load contract)."""
+    from ydf_tpu.serving import native_serve
+
+    df = _mixed_df(n=1200)
+    m = _gbt(df, num_trees=4)
+    calls = {"n": 0}
+    real = native_serve.ServeBank
+
+    def counting(model):
+        calls["n"] += 1
+        return real(model)
+
+    monkeypatch.setattr(native_serve, "ServeBank", counting)
+    m._serve_bank_cache = {}
+    m.predict(df)
+    m.predict(df.head(50))
+    m.predict(df.head(7))
+    assert calls["n"] == 1

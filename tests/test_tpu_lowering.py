@@ -67,6 +67,18 @@ def test_committed_binning_artifact_present():
     assert (ARTIFACTS / "binning_pallas_kernel.jax_export.bin.gz").exists()
 
 
+def test_committed_serve_bank_artifact_present():
+    """The committed pack must carry the batched data-bank serving
+    kernel (serving/pallas_scorer.py — this round's TPU serving
+    engine); the deserialize sweep below proves it live."""
+    summary = json.loads((ARTIFACTS / "summary.json").read_text())
+    meta = summary["artifacts"]["serve_bank_pallas_kernel"]
+    assert meta["mosaic_kernel"] is True
+    assert (
+        ARTIFACTS / "serve_bank_pallas_kernel.jax_export.bin.gz"
+    ).exists()
+
+
 def test_quickscorer_kernel_lowers_to_mosaic():
     """The leaf-bitmask inference kernel compiles through Pallas→Mosaic
     (non-interpret): the StableHLO must embed a tpu_custom_call."""

@@ -587,12 +587,15 @@ class GenericModel:
         if cache is None:
             cache = self._qs_cache = {}
         forced = getattr(self, "_forced_engine", None)
-        # The env force-flag participates in compatibility gating
-        # (registry._qs_allowed) and tests toggle it mid-process — it
-        # must be part of the key or a stale selection would be served.
+        # The env force-flag and the serving-impl switch participate in
+        # compatibility gating (registry._qs_allowed /
+        # registry._native_compatible) and tests toggle them
+        # mid-process — they must be part of the key or a stale
+        # selection would be served.
         key = (
             forced,
             os.environ.get("YDF_TPU_FORCE_QUICKSCORER"),
+            os.environ.get("YDF_TPU_SERVE_IMPL"),
             id(self.forest.feature),
         )
         hit = cache.get(key)
@@ -892,10 +895,12 @@ class GenericModel:
         engines=True additionally times each applicable serving engine on
         the pre-encoded inputs (reference benchmark_inference.cc runs
         every compatible engine): `routed` (flat-node traversal,
-        ops/routing.py), `quickscorer` (leaf-mask Pallas kernel) and
-        `binned_quickscorer` (uint8-bin-matrix variant, the 8-bit-engine
-        analogue). Engine rows exclude host-side encoding, which the
-        `predict` row includes."""
+        ops/routing.py), `native_batch` / `native_binned` (the batched
+        data-bank kernel, serving/native_serve.py), `quickscorer`
+        (leaf-mask Pallas kernel) and `binned_quickscorer`
+        (uint8-bin-matrix variant, the 8-bit-engine analogue). Engine
+        rows exclude host-side encoding, which the `predict` row
+        includes."""
         import time
 
         if num_runs < 1:
@@ -985,6 +990,29 @@ class GenericModel:
                     )
             except Exception as e:  # engine inapplicable to this forest
                 eng["quickscorer_error"] = f"{type(e).__name__}: {e}"
+            try:
+                from ydf_tpu.serving.native_serve import (
+                    build_native_binned_engine,
+                    build_native_engine,
+                )
+
+                nb = build_native_engine(self)
+                if nb is not None:
+                    eng["native_batch"] = _time_engine(
+                        lambda: nb(x_num, x_cat)
+                    )
+                nbb = build_native_binned_engine(self)
+                if nbb is not None:
+                    bins_nb = np.ascontiguousarray(
+                        self.binner.transform(ds)[
+                            :, : self.binner.num_scalar
+                        ]
+                    )
+                    eng["native_binned"] = _time_engine(
+                        lambda: nbb(bins_nb)
+                    )
+            except Exception as e:  # engine inapplicable to this forest
+                eng["native_batch_error"] = f"{type(e).__name__}: {e}"
         out["engines_ns_per_example"] = eng
         return out
 

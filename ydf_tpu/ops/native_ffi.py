@@ -250,7 +250,10 @@ class NativeLibrary:
 # (YDF_TPU_HIST_THREADS / YDF_TPU_BIN_THREADS / YDF_TPU_ROUTE_THREADS)
 # still bound each call's task wave.
 KERNELS_LIB = NativeLibrary(
-    src_name=("histogram_ffi.cc", "binning_ffi.cc", "routing_ffi.cc"),
+    src_name=(
+        "histogram_ffi.cc", "binning_ffi.cc", "routing_ffi.cc",
+        "serving_ffi.cc",
+    ),
     lib_name="libydfkernels.so",
     ffi_targets={
         "ydf_histogram": "YdfHistogram",
@@ -262,6 +265,10 @@ KERNELS_LIB = NativeLibrary(
         "ydf_leaf_update": "YdfLeafUpdate",
         "ydf_leaf_update_grad": "YdfLeafUpdateGrad",
         "ydf_route_tree": "YdfRouteTree",
+        # Batched data-bank serving (native/serving_ffi.cc): the FFI
+        # surface of the production serving engine; the ctypes handle
+        # surface (serving/native_serve.py) rides the same .so.
+        "ydf_serve_batch": "YdfServeBatch",
     },
     extra_cflags=("-pthread",),
     extra_deps=("thread_pool.h",),

@@ -1,13 +1,37 @@
+from ydf_tpu.serving.native_serve import (
+    NativeBatchEngine,
+    NativeBinnedEngine,
+    build_native_binned_engine,
+    build_native_engine,
+)
+from ydf_tpu.serving.pallas_scorer import (
+    PallasBankEngine,
+    build_pallas_scorer,
+)
 from ydf_tpu.serving.quickscorer import (
     BinnedQuickScorerEngine,
     QuickScorerEngine,
     build_binned_quickscorer,
     build_quickscorer,
 )
+from ydf_tpu.serving.registry import (
+    CoalescingBatcher,
+    model_batcher,
+    resolve_serve_impl,
+)
 
 __all__ = [
     "BinnedQuickScorerEngine",
+    "CoalescingBatcher",
+    "NativeBatchEngine",
+    "NativeBinnedEngine",
+    "PallasBankEngine",
     "QuickScorerEngine",
     "build_binned_quickscorer",
+    "build_native_binned_engine",
+    "build_native_engine",
+    "build_pallas_scorer",
     "build_quickscorer",
+    "model_batcher",
+    "resolve_serve_impl",
 ]

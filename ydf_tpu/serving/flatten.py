@@ -1,7 +1,9 @@
 """Forest → flat data-bank node tables, shared by the embed ROUTING
-lowering (serving/embed.py) and the portable blob writer
-(serving/portable.py) — one implementation of the node encoding so the
-two export backends cannot drift apart.
+lowering (serving/embed.py), the portable blob writer
+(serving/portable.py) and the native batched serving engine
+(serving/native_serve.py over native/serving_ffi.cc) — one
+implementation of the node encoding so the export backends and the
+production engine cannot drift apart.
 
 Per-entry encoding (mirrors the reference's data-bank routing tables,
 cpp_target_lowering.cc):
@@ -29,6 +31,7 @@ class DataBank:
     aux: np.ndarray            # u32 [total]
     cat_feature: np.ndarray    # u32 [total]
     thresh: np.ndarray         # f32 [total]
+    thresh_bin: np.ndarray     # i32 [total] bin-space cut (bin <= t → left)
     left: np.ndarray           # u32 [total]
     right: np.ndarray          # u32 [total]
     na_left: np.ndarray        # u8  [total]
@@ -65,6 +68,7 @@ def flatten_forest_data_bank(
         aux=np.zeros((total,), np.uint32),
         cat_feature=np.zeros((total,), np.uint32),
         thresh=np.zeros((total,), np.float32),
+        thresh_bin=np.zeros((total,), np.int32),
         left=np.zeros((total,), np.uint32),
         right=np.zeros((total,), np.uint32),
         na_left=np.zeros((total,), np.uint8),
@@ -120,6 +124,13 @@ def flatten_forest_data_bank(
             else:
                 bank.feature[e] = feat
                 bank.thresh[e] = np.float32(f["threshold"][t, nid])
+                # Bin-space cut for the binned serving fast path; forests
+                # carry it natively (threshold = boundaries[threshold_bin]
+                # by binner construction, so the two modes route
+                # identically). Absent on hand-built dicts (embed tests).
+                tb = f.get("threshold_bin")
+                if tb is not None:
+                    bank.thresh_bin[e] = int(tb[t, nid])
             bank.left[e] = int(f["left"][t, nid])
             bank.right[e] = int(f["right"][t, nid])
             e += 1

@@ -1,4 +1,4 @@
-"""Speed-ranked serving-engine registry.
+"""Speed-ranked serving-engine registry + the request-coalescing batcher.
 
 Counterpart of the reference's FastEngineFactory registry
 (`ydf/serving/decision_forest/register_engines.cc:172-875`: per model
@@ -11,13 +11,104 @@ PYDF API (`model/generic_model.py` same-named methods).
 
 The generic routed engine (ops/routing.py value-mode scan) is rank 0 and
 compatible with everything — it is the fallback the reference calls the
-"generic engine"."""
+"generic engine". Above it: the native batched data-bank engine
+(serving/native_serve.py, rank 200, the CPU production path), the
+Pallas data-bank scorer (serving/pallas_scorer.py, rank 250, TPU) and
+QuickScorer (rank 300, TPU / forced).
+
+Serving env knobs are validated EAGERLY AT IMPORT (the
+YDF_TPU_HIST_IMPL / failpoints contract — a typo must fail at the env
+boundary, never silently fall back to the generic engine):
+
+  * YDF_TPU_SERVE_IMPL={auto|xla|native} — engine-impl switch mirroring
+    YDF_TPU_ROUTE_IMPL: "auto" prefers the native engine when built,
+    "xla" pins the XLA paths (generic / QuickScorer), "native" demands
+    the native kernel (registers-or-raises at engine build).
+  * YDF_TPU_FORCE_QUICKSCORER={0|1} — CPU QuickScorer gate (tests).
+  * YDF_TPU_SERVE_MAX_BATCH (int >= 1, default 256) and
+    YDF_TPU_SERVE_BATCH_TIMEOUT_US (float > 0, default 2000) — the
+    request-coalescing batcher's size/deadline bounds.
+"""
 
 from __future__ import annotations
 
 import dataclasses
 import os
+import threading
+import time
 from typing import Callable, List, Optional
+
+
+# --------------------------------------------------------------------- #
+# Serving env knobs — eager validation at import
+# --------------------------------------------------------------------- #
+
+_SERVE_IMPLS = ("auto", "xla", "native")
+
+
+def resolve_serve_impl(value: Optional[str] = None) -> str:
+    """Resolves the serving-impl switch. An explicit value wins;
+    YDF_TPU_SERVE_IMPL selects globally; default is "auto" (fastest
+    compatible engine, native preferred when built). Invalid values
+    raise — here AND at registry import."""
+    if value is None:
+        value = os.environ.get("YDF_TPU_SERVE_IMPL")
+    if value is None:
+        return "auto"
+    low = value.strip().lower()
+    if low not in _SERVE_IMPLS:
+        raise ValueError(
+            f"YDF_TPU_SERVE_IMPL={value!r} is not a serving impl; "
+            f"expected one of {list(_SERVE_IMPLS)}"
+        )
+    return low
+
+
+def _parse_serve_max_batch() -> int:
+    env = os.environ.get("YDF_TPU_SERVE_MAX_BATCH")
+    if env is None:
+        return 256
+    try:
+        v = int(env)
+    except ValueError:
+        v = 0
+    if v < 1:
+        raise ValueError(
+            f"YDF_TPU_SERVE_MAX_BATCH={env!r} must be an integer >= 1"
+        )
+    return v
+
+
+def _parse_serve_batch_timeout_us() -> float:
+    env = os.environ.get("YDF_TPU_SERVE_BATCH_TIMEOUT_US")
+    if env is None:
+        return 2000.0
+    try:
+        v = float(env)
+    except ValueError:
+        v = -1.0
+    if v <= 0:
+        raise ValueError(
+            f"YDF_TPU_SERVE_BATCH_TIMEOUT_US={env!r} must be a number > 0"
+        )
+    return v
+
+
+def _parse_force_quickscorer() -> None:
+    env = os.environ.get("YDF_TPU_FORCE_QUICKSCORER")
+    if env is not None and env not in ("", "0", "1"):
+        raise ValueError(
+            f"YDF_TPU_FORCE_QUICKSCORER={env!r} must be 0 or 1 (or unset)"
+        )
+
+
+# Import-time eager parse: a malformed serving knob fails the first
+# `import ydf_tpu.serving.registry` of the process, not a predict call
+# hours into serving (the YDF_TPU_HIST_IMPL / failpoints contract).
+SERVE_IMPL = resolve_serve_impl()
+SERVE_MAX_BATCH = _parse_serve_max_batch()
+SERVE_BATCH_TIMEOUT_US = _parse_serve_batch_timeout_us()
+_parse_force_quickscorer()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -150,6 +241,58 @@ def _build_routed(model):
     return None
 
 
+def _native_compatible(model) -> bool:
+    """Native batched data-bank engine (serving/native_serve.py): the
+    CPU production path. YDF_TPU_SERVE_IMPL=xla disables it;
+    =native claims compatibility for every in-envelope model and lets
+    build() raise loudly when the kernel cannot register (the
+    no-silent-fallback contract — compatible_engines swallows
+    is_compatible exceptions, build exceptions propagate)."""
+    from ydf_tpu.config import is_tpu_backend
+    from ydf_tpu.serving import native_serve
+
+    impl = resolve_serve_impl()
+    if impl == "xla":
+        return False
+    if not native_serve.in_envelope(model):
+        return False
+    if impl == "native":
+        return True  # build() registers-or-raises
+    # auto: a CPU engine — on a TPU backend the compiled kernels win.
+    if is_tpu_backend():
+        return False
+    return native_serve.available()
+
+
+def _build_native(model):
+    from ydf_tpu.serving import native_serve
+
+    if resolve_serve_impl() == "native":
+        native_serve._require_registered()
+    eng = native_serve.build_native_engine(model)
+    if eng is None:
+        raise RuntimeError(
+            "native serving engine selected but could not be built"
+        )
+    return eng
+
+
+def _pallas_compatible(model) -> bool:
+    """Pallas data-bank scorer (serving/pallas_scorer.py): TPU serving
+    of forests beyond the QuickScorer envelope (any leaf count). CPU
+    runs it only in interpret mode — tests build it directly."""
+    from ydf_tpu.config import is_tpu_backend
+    from ydf_tpu.serving import pallas_scorer
+
+    return is_tpu_backend() and pallas_scorer.in_envelope(model)
+
+
+def _build_pallas(model):
+    from ydf_tpu.serving.pallas_scorer import build_pallas_scorer
+
+    return build_pallas_scorer(model)
+
+
 register_engine(EngineFactory(
     name="QuickScorer",  # leaf-mask Pallas kernel (quickscorer.py)
     rank=300,
@@ -158,8 +301,204 @@ register_engine(EngineFactory(
 ))
 
 register_engine(EngineFactory(
+    name="PallasBank",  # data-bank Pallas scorer (pallas_scorer.py)
+    rank=250,
+    is_compatible=_pallas_compatible,
+    build=_build_pallas,
+))
+
+register_engine(EngineFactory(
+    name="NativeBatch",  # native data-bank walk (native_serve.py)
+    rank=200,
+    is_compatible=_native_compatible,
+    build=_build_native,
+))
+
+register_engine(EngineFactory(
     name="Routed",  # generic value-mode tree scan (ops/routing.py)
     rank=0,
     is_compatible=lambda model: True,
     build=_build_routed,
 ))
+
+
+# --------------------------------------------------------------------- #
+# Request-coalescing batcher — the production-traffic front
+# --------------------------------------------------------------------- #
+
+
+class _Slot:
+    """One pending single-row request."""
+
+    __slots__ = ("row", "result", "error", "event", "t0_ns")
+
+    def __init__(self, row):
+        self.row = row
+        self.result = None
+        self.error = None
+        self.event = threading.Event()
+        self.t0_ns = time.perf_counter_ns()
+
+
+class CoalescingBatcher:
+    """Gathers concurrent single-row predict calls into kernel-sized
+    batches (the reference's ExampleSet batch API turned into a serving
+    front): callers block on `predict_one(*row)` while a background
+    flusher coalesces up to `max_batch` rows or until the oldest row
+    has waited `timeout_us`, runs ONE batched kernel call, and fans the
+    results back out. Every row is answered exactly once, in
+    submission order within its batch (tests/test_serving_engine.py).
+
+    `batch_fn(*stacked)` receives each row position stacked on axis 0
+    (np.stack) and returns an array whose leading axis matches the
+    batch. Bounds default to YDF_TPU_SERVE_MAX_BATCH /
+    YDF_TPU_SERVE_BATCH_TIMEOUT_US (validated at import).
+
+    Instrumented with the per-engine serving telemetry: each answered
+    row observes its whole queue+kernel latency into
+    ydf_serve_latency_ns{engine="Batcher", batch_pow2} so p50/p99
+    under concurrent load is measurable (docs/observability.md)."""
+
+    def __init__(
+        self,
+        batch_fn: Callable,
+        max_batch: Optional[int] = None,
+        timeout_us: Optional[float] = None,
+    ):
+        self.batch_fn = batch_fn
+        self.max_batch = int(max_batch or SERVE_MAX_BATCH)
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        timeout_us = (
+            SERVE_BATCH_TIMEOUT_US if timeout_us is None else timeout_us
+        )
+        if timeout_us <= 0:
+            raise ValueError("timeout_us must be > 0")
+        self.timeout_s = float(timeout_us) / 1e6
+        self._cv = threading.Condition()
+        self._queue: List[_Slot] = []
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._flusher_loop, daemon=True,
+            name="ydf-serve-batcher",
+        )
+        self._thread.start()
+
+    # -- caller side --------------------------------------------------- #
+
+    def predict_one(self, *row):
+        """Submits one row (its per-position arrays/scalars) and blocks
+        until the coalesced batch containing it is served."""
+        slot = _Slot(row)
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            self._queue.append(slot)
+            self._cv.notify_all()
+        slot.event.wait()
+        if slot.error is not None:
+            raise slot.error
+        return slot.result
+
+    # -- flusher side -------------------------------------------------- #
+
+    def _flusher_loop(self):
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait()
+                if self._closed and not self._queue:
+                    return
+                # Deadline is anchored on the OLDEST pending row.
+                deadline = self._queue[0].t0_ns / 1e9 + self.timeout_s
+                while (
+                    len(self._queue) < self.max_batch and not self._closed
+                ):
+                    remaining = deadline - time.perf_counter_ns() / 1e9
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(remaining)
+                batch = self._queue[: self.max_batch]
+                del self._queue[: len(batch)]
+            if batch:
+                self._flush(batch)
+
+    def _flush(self, batch: List[_Slot]):
+        import numpy as np
+
+        from ydf_tpu.utils import telemetry
+
+        try:
+            stacked = tuple(
+                np.stack([s.row[k] for s in batch])
+                for k in range(len(batch[0].row))
+            )
+            out = np.asarray(self.batch_fn(*stacked))
+            for j, s in enumerate(batch):
+                s.result = out[j]
+        except BaseException as e:  # noqa: BLE001 - fanned back to callers
+            for s in batch:
+                s.error = e
+        finally:
+            if telemetry.ENABLED:
+                now = time.perf_counter_ns()
+                b = telemetry.pow2_bucket(len(batch))
+                hist = telemetry.histogram(
+                    "ydf_serve_latency_ns", engine="Batcher", batch_pow2=b
+                )
+                for s in batch:
+                    hist.observe_ns(now - s.t0_ns)
+                telemetry.counter(
+                    "ydf_serve_batcher_flushes_total"
+                ).inc()
+                telemetry.counter(
+                    "ydf_serve_batcher_rows_total"
+                ).inc(len(batch))
+            for s in batch:
+                s.event.set()
+
+    # -- lifecycle ----------------------------------------------------- #
+
+    def close(self):
+        """Serves the remaining queue, then stops the flusher."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def model_batcher(
+    model,
+    max_batch: Optional[int] = None,
+    timeout_us: Optional[float] = None,
+) -> CoalescingBatcher:
+    """A CoalescingBatcher over the model's fastest compatible engine:
+    rows are pre-encoded (x_num_row [Fn], x_cat_row [Fc]) vectors (the
+    engine input contract); results are raw scores. Falls back to the
+    generic routed scan when no fast engine is compatible."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    eng = model._fast_engine()
+    if eng is not None:
+        fn = eng
+    else:
+        from ydf_tpu.ops.routing import forest_predict_values
+
+        def fn(x_num, x_cat):
+            return np.asarray(
+                forest_predict_values(
+                    model.forest,
+                    jnp.asarray(x_num), jnp.asarray(x_cat),
+                    num_numerical=model.binner.num_numerical,
+                    max_depth=model.max_depth, combine="sum",
+                )
+            )[:, 0]
+
+    return CoalescingBatcher(fn, max_batch=max_batch, timeout_us=timeout_us)

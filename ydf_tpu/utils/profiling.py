@@ -187,6 +187,14 @@ def native_kernel_metrics() -> Dict[str, float]:
         )
     except Exception:
         out["ydf_native_fused_kernel_seconds"] = 0.0
+    try:
+        from ydf_tpu.serving import native_serve
+
+        out["ydf_native_serve_kernel_seconds"] = (
+            native_serve.serve_kernel_seconds()
+        )
+    except Exception:
+        out["ydf_native_serve_kernel_seconds"] = 0.0
     return out
 
 

@@ -41,6 +41,7 @@ __all__ = [
     "export_grow_tree",
     "export_binning_pallas",
     "export_quickscorer",
+    "export_serve_bank",
     "export_vector_sequence",
     "grow_tree_cost",
     "tpu_projection",
@@ -267,6 +268,41 @@ def export_quickscorer(n_examples: int = 4096, platforms=("tpu",)):
     return jax.export.export(
         jax.jit(lambda xs: eng(xs)), platforms=tuple(platforms)
     )(x)
+
+
+def export_serve_bank(n_examples: int = 4096, platforms=("tpu",)):
+    """jax.export of the batched data-bank serving kernel
+    (serving/pallas_scorer.py:_bank_kernel) — the TPU serving engine
+    for forests beyond the QuickScorer 64-leaf envelope. Compiled from
+    a real trained model (with categorical conditions, so the
+    mask-half-word unroll is in the lowering), like export_quickscorer."""
+    import pandas as pd
+
+    import ydf_tpu as ydf
+    from ydf_tpu.config import Task
+    from ydf_tpu.serving.pallas_scorer import build_pallas_scorer
+
+    rng = np.random.default_rng(0)
+    df = pd.DataFrame({f"f{i}": rng.normal(size=600) for i in range(6)})
+    df["c"] = np.asarray(rng.choice(list("abcd"), size=600))
+    df["y"] = (
+        df["f0"] + df["f1"] * df["f2"] + (df["c"] == "a")
+    ).astype(np.float32)
+    m = ydf.GradientBoostedTreesLearner(
+        label="y", task=Task.REGRESSION, num_trees=8, max_depth=5,
+        validation_ratio=0.0, early_stopping="NONE",
+    ).train(df)
+    eng = build_pallas_scorer(m, interpret=False)
+    assert eng is not None, "tiny model fell outside the PallasBank envelope"
+    x = jax.ShapeDtypeStruct(
+        (n_examples, m.binner.num_numerical), jnp.float32
+    )
+    xc = jax.ShapeDtypeStruct(
+        (n_examples, m.binner.num_categorical), jnp.int32
+    )
+    return jax.export.export(
+        jax.jit(lambda a, b: eng._score(a, b)), platforms=tuple(platforms)
+    )(x, xc)
 
 
 def export_vector_sequence(
@@ -530,6 +566,10 @@ def write_artifacts(outdir: str | Path, full_scale: bool = True) -> dict:
         # loop that consumes them.
         "binning_pallas_kernel": export_binning_pallas,
         "quickscorer_kernel": export_quickscorer,
+        # Serving beyond the QuickScorer envelope: the batched
+        # data-bank scorer (serving/pallas_scorer.py) — TPU serving of
+        # any tree shape.
+        "serve_bank_pallas_kernel": export_serve_bank,
         "vector_sequence_kernel": export_vector_sequence,
     }
     summary = {"platforms": ["tpu"], "artifacts": {}}
