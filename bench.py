@@ -664,6 +664,103 @@ def measure_serving_family(model, data, rows, record):
         record["serve_family_error"] = f"{type(e).__name__}: {e}"
 
 
+def measure_distributed_family(rows, trees, depth, features, record):
+    """Feature-parallel distributed training measurement (ROADMAP
+    item 2's bench half), gated on YDF_TPU_BENCH_DIST_WORKERS=N
+    (N >= 2): spins N in-process localhost workers, streams the bench
+    table into a feature-sharded dataset cache, trains the same
+    (trees, depth) GBT through the manager–worker exchange
+    (parallel/dist_gbt.py), and records
+
+      dist_workers            worker count
+      dist_train_s            steady-state distributed train wall
+      dist_reduce_bytes       total histogram bytes reduced at the
+                              manager (the wire the sibling-subtraction
+                              halving and YDF_TPU_HIST_QUANT shrink)
+      dist_reduce_bytes_per_layer   the per-layer average of the same
+      dist_rpc_p50_ns         per-verb RPC p50 from the run's latency
+                              histograms (telemetry-keyed by verb)
+      dist_recoveries         reassignments the run needed (0 healthy)
+
+    on the headline record. In-process workers measure PROTOCOL cost
+    (serialization, reduction, routing exchange) — they share this
+    box's core, so dist_train_s is an overhead figure, not a scaling
+    figure; a multi-host run is where speedup appears
+    (docs/distributed_training.md). Failures recorded, never fatal."""
+    env = os.environ.get("YDF_TPU_BENCH_DIST_WORKERS")
+    if not env:
+        return
+    try:
+        nw = int(env)
+        if nw < 2:
+            raise ValueError
+    except ValueError:
+        record["dist_family_error"] = (
+            f"YDF_TPU_BENCH_DIST_WORKERS={env!r} must be an integer >= 2"
+        )
+        return
+    try:
+        import socket as _socket
+        import tempfile
+
+        import numpy as np
+
+        import ydf_tpu as ydf
+        from ydf_tpu.config import Task
+        from ydf_tpu.dataset.cache import create_dataset_cache
+        from ydf_tpu.parallel.worker_service import (
+            WorkerPool,
+            start_worker,
+        )
+
+        rng = np.random.RandomState(0xD157)
+        x, y = synth_higgs_chunk(rng, rows, features)
+        frame = {f"f{i}": x[:, i] for i in range(features)}
+        frame["label"] = y
+        ports = []
+        for _ in range(nw):
+            s = _socket.socket()
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+            s.close()
+        for p in ports:
+            start_worker(p, host="127.0.0.1", blocking=False)
+        addrs = [f"127.0.0.1:{p}" for p in ports]
+        with tempfile.TemporaryDirectory() as td:
+            cache = create_dataset_cache(
+                frame, os.path.join(td, "cache"), label="label",
+                task=Task.CLASSIFICATION, feature_shards=nw,
+            )
+
+            def train_dist():
+                learner = ydf.GradientBoostedTreesLearner(
+                    label="label", num_trees=trees, max_depth=depth,
+                    validation_ratio=0.0, early_stopping="NONE",
+                    distributed_workers=addrs,
+                )
+                t0 = time.time()
+                model = learner.train(cache)
+                return model, time.time() - t0
+
+            train_dist()                  # compile + shard placement
+            model, wall = train_dist()    # steady state
+            d = model.training_logs["distributed"]
+            record["dist_workers"] = nw
+            record["dist_train_s"] = round(wall, 2)
+            record["dist_reduce_bytes"] = int(d["reduce_bytes"])
+            record["dist_reduce_bytes_per_layer"] = round(
+                d["reduce_bytes"] / max(trees * depth, 1), 1
+            )
+            record["dist_rpc_p50_ns"] = d["rpc_p50_ns"]
+            record["dist_recoveries"] = int(d["recoveries"])
+        try:
+            WorkerPool(addrs).shutdown_all()
+        except Exception:
+            pass
+    except Exception as e:
+        record["dist_family_error"] = f"{type(e).__name__}: {e}"
+
+
 def synth_higgs_chunk(rng, rows, features):
     """One chunk of the synthetic Higgs-shaped table — the ONE label
     model shared by the bench rows and the north-star flow, so their AUC
@@ -815,6 +912,10 @@ def run_bench(backend, rows, trees, depth, features, with_baseline, probe_log):
     # which engine actually serves (serve_engine) — rides every headline
     # record (ROADMAP item 1's "millions of users" measurement).
     measure_serving_family(model, data, rows, record)
+    _PARTIAL = dict(record)
+    # Distributed-training family (ROADMAP item 2's measurement half):
+    # only runs when YDF_TPU_BENCH_DIST_WORKERS is set.
+    measure_distributed_family(rows, trees, depth, features, record)
     _PARTIAL = dict(record)
     if backend not in ("cpu",):
         hardware_extras(model, data, record)

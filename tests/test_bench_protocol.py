@@ -106,6 +106,49 @@ def test_small_cpu_run_emits_parseable_record():
             assert rec["fused_s"] >= 0
 
 
+@pytest.mark.slow
+def test_small_cpu_run_with_distributed_family():
+    """YDF_TPU_BENCH_DIST_WORKERS=2 adds the distributed-training
+    family to the headline record: worker count, steady train wall,
+    reduce bytes (total + per-layer), per-verb RPC p50s from the
+    exchange's latency histograms, and the recovery count (0 on a
+    healthy in-process fleet)."""
+    env = dict(os.environ, YDF_TPU_BENCH_DIST_WORKERS="2")
+    out = subprocess.run(
+        [sys.executable, BENCH, "--cpu", "--small", "--no-baseline"],
+        capture_output=True, text=True, timeout=900, cwd=REPO, env=env,
+    )
+    assert out.returncode == 0
+    rec = _last_json(out.stdout)
+    assert rec.get("dist_family_error") is None, rec.get(
+        "dist_family_error"
+    )
+    assert rec["dist_workers"] == 2
+    assert rec["dist_train_s"] > 0
+    assert rec["dist_reduce_bytes"] > 0
+    assert rec["dist_reduce_bytes_per_layer"] > 0
+    p50 = rec["dist_rpc_p50_ns"]
+    assert p50.get("build_histograms", 0) > 0
+    assert p50.get("load_cache_shard", 0) > 0
+    assert rec["dist_recoveries"] == 0
+
+
+def test_bench_dist_workers_env_validation(tmp_path):
+    """A malformed YDF_TPU_BENCH_DIST_WORKERS lands as a recorded
+    family error, never a crashed bench (artifact protocol)."""
+    mod = _load_bench(tmp_path)
+    rec = {}
+    os.environ["YDF_TPU_BENCH_DIST_WORKERS"] = "banana"
+    try:
+        mod.measure_distributed_family(1000, 2, 3, 4, rec)
+    finally:
+        del os.environ["YDF_TPU_BENCH_DIST_WORKERS"]
+    assert "must be an integer >= 2" in rec["dist_family_error"]
+    rec2 = {}
+    mod.measure_distributed_family(1000, 2, 3, 4, rec2)  # unset: no-op
+    assert rec2 == {}
+
+
 def _load_bench(tmp_path):
     """Imports bench.py as a module (its top level only defines) with
     the probe cache redirected into the test's tmp dir."""

@@ -165,6 +165,42 @@ xn_num, xn_cat, _ = mn._encode_inputs(dsn)
 engn = native_serve.build_native_engine(mn)
 assert engn is not None
 np.asarray(engn(xn_num, xn_cat))
+
+# Worker RPC paths under the sanitizer (distributed round): an
+# in-process worker serves the feature-parallel verbs — shard load,
+# per-layer histogram (the native kernel through the RPC path), split
+# routing — for a short distributed train that must match the local
+# model bit for bit.
+import socket as _socket
+import tempfile as _tempfile
+from ydf_tpu.dataset.cache import create_dataset_cache
+from ydf_tpu.parallel.worker_service import WorkerPool, start_worker
+
+_s = _socket.socket(); _s.bind(("127.0.0.1", 0))
+_port = _s.getsockname()[1]; _s.close()
+start_worker(_port, host="127.0.0.1", blocking=False)
+with _tempfile.TemporaryDirectory() as _td:
+    _frame = {f"g{i}": np.asarray(df[f"g{i}"]) for i in range(5)}
+    _frame["y"] = np.asarray(df["y"], np.float32)
+    _cache = create_dataset_cache(
+        _frame, _td + "/cache", label="y", task=Task.REGRESSION,
+        feature_shards=2,
+    )
+    def _mk(**kw):
+        return ydf.GradientBoostedTreesLearner(
+            label="y", task=Task.REGRESSION, num_trees=2, max_depth=3,
+            validation_ratio=0.0, early_stopping="NONE", **kw,
+        )
+    _m_local = _mk().train(_cache)
+    _m_dist = _mk(
+        distributed_workers=[f"127.0.0.1:{_port}"]
+    ).train(_cache)
+    _fl, _fd = _m_local.forest.to_numpy(), _m_dist.forest.to_numpy()
+    for _k in _fl:
+        if _fl[_k] is not None:
+            assert np.array_equal(np.asarray(_fl[_k]),
+                                  np.asarray(_fd[_k])), _k
+    WorkerPool([f"127.0.0.1:{_port}"]).shutdown_all()
 print("SANITIZE_RUN_OK", mode)
 """
 

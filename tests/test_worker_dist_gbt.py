@@ -1,0 +1,482 @@
+"""Feature-parallel distributed GBT training (parallel/dist_gbt.py):
+2- and 3-worker training over in-process localhost workers must be
+BIT-IDENTICAL to the single-machine grower — same chosen splits, same
+leaf values, same predictions — across YDF_TPU_HIST_QUANT modes and
+with NaN + categorical features; and every chaos scenario (worker loss
+mid-layer, straggler timeout, corrupted cache shard) must recover to
+the same bits (docs/distributed_training.md, docs/fault_tolerance.md).
+"""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+from ydf_tpu.dataset.cache import create_dataset_cache
+from ydf_tpu.parallel import dist_worker
+from ydf_tpu.parallel.worker_service import WorkerPool, start_worker
+from ydf_tpu.utils import failpoints
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def workers():
+    """In-process localhost worker fleet; yields a factory so each test
+    picks its size. All threads are daemons; shutdown is best-effort."""
+    started = []
+
+    def start(n):
+        ports = [_free_port() for _ in range(n)]
+        for p in ports:
+            start_worker(p, host="127.0.0.1", blocking=False)
+        addrs = [f"127.0.0.1:{p}" for p in ports]
+        WorkerPool(addrs).ping_all()
+        started.extend(addrs)
+        return addrs
+
+    yield start
+    try:
+        WorkerPool(started).shutdown_all() if started else None
+    except Exception:
+        pass
+    dist_worker.reset_state()
+
+
+def _frame(n=3000, seed=7):
+    """Regression frame with NaN numericals and a categorical column —
+    the feature kinds the acceptance criteria name."""
+    rng = np.random.RandomState(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float64)
+    x[rng.rand(n) < 0.08, 0] = np.nan  # missing values
+    cat = rng.choice(["aa", "bb", "cc", "dd"], size=n)
+    y = (
+        x[:, 1] * 1.5
+        - np.nan_to_num(x[:, 0])
+        + (cat == "aa") * 2.0
+        + rng.normal(scale=0.3, size=n)
+    )
+    return {
+        "f0": x[:, 0], "f1": x[:, 1], "f2": x[:, 2], "f3": x[:, 3],
+        "c0": cat, "y": y.astype(np.float32),
+    }
+
+
+def _make_cache(tmp_path, shards, frame=None, name="cache"):
+    return create_dataset_cache(
+        frame if frame is not None else _frame(),
+        str(tmp_path / name), label="y", task=Task.REGRESSION,
+        feature_shards=shards,
+    )
+
+
+def _learner(num_trees=4, **kw):
+    return ydf.GradientBoostedTreesLearner(
+        label="y", task=Task.REGRESSION, num_trees=num_trees,
+        max_depth=4, validation_ratio=0.0, early_stopping="NONE",
+        **kw,
+    )
+
+
+def _assert_bit_identical(m_dist, m_local, data=None):
+    """Same chosen splits, same leaf values — the acceptance criterion.
+    Every forest array must match exactly; predictions must too."""
+    f_d = m_dist.forest.to_numpy()
+    f_l = m_local.forest.to_numpy()
+    assert set(f_d) == set(f_l)
+    for k in sorted(f_l):
+        a, b = f_d[k], f_l[k]
+        if a is None or b is None:
+            assert a is b, k
+            continue
+        assert np.array_equal(
+            np.asarray(a), np.asarray(b)
+        ), f"forest field {k!r} differs"
+    assert np.array_equal(
+        np.asarray(m_dist.initial_predictions),
+        np.asarray(m_local.initial_predictions),
+    )
+    assert np.allclose(
+        m_dist.training_logs["train_loss"],
+        m_local.training_logs["train_loss"],
+        rtol=0, atol=0,
+    ), "per-iteration training losses differ"
+    if data is not None:
+        assert np.array_equal(
+            np.asarray(m_dist.predict(data)),
+            np.asarray(m_local.predict(data)),
+        )
+
+
+# --------------------------------------------------------------------- #
+# Bit-identity vs the single-machine grower
+# --------------------------------------------------------------------- #
+
+
+def test_dist_2workers_bit_identical(tmp_path, workers):
+    cache = _make_cache(tmp_path, shards=2)
+    addrs = workers(2)
+    m_local = _learner().train(cache)
+    m_dist = _learner(distributed_workers=addrs).train(cache)
+    _assert_bit_identical(m_dist, m_local, _frame(n=256, seed=11))
+    d = m_dist.training_logs["distributed"]
+    assert d["workers"] == 2
+    assert d["feature_shards"] == 2
+    assert d["reduce_bytes"] > 0
+    assert d["rpc_count"]["build_histograms"] > 0
+
+
+def test_dist_3workers_more_shards_than_workers(tmp_path, workers):
+    # 5 shards on 3 workers: multi-shard ownership + uneven slices.
+    cache = _make_cache(tmp_path, shards=5)
+    addrs = workers(3)
+    m_local = _learner().train(cache)
+    m_dist = _learner(distributed_workers=addrs).train(cache)
+    _assert_bit_identical(m_dist, m_local)
+
+
+@pytest.mark.parametrize(
+    "quant,trees", [("f32", 4), ("bf16x2", 3), ("int8", 5)]
+)
+def test_dist_bit_identical_across_quant_modes(
+    tmp_path, workers, monkeypatch, quant, trees
+):
+    """The int8/bf16x2 wire format (quantized stats broadcast, grower's
+    per-tree scale) must reproduce the single-machine quantized build
+    exactly. Tree counts differ per mode so the boosting-closure cache
+    (keyed on static config, not the env) can never serve a stale
+    quant mode."""
+    from ydf_tpu.learners.gbt import _make_boost_fn
+
+    monkeypatch.setenv("YDF_TPU_HIST_QUANT", quant)
+    _make_boost_fn.cache_clear()
+    cache = _make_cache(tmp_path, shards=2)
+    addrs = workers(2)
+    m_local = _learner(num_trees=trees).train(cache)
+    m_dist = _learner(
+        num_trees=trees, distributed_workers=addrs
+    ).train(cache)
+    _assert_bit_identical(m_dist, m_local)
+    assert m_dist.training_logs["distributed"]["hist_quant"] == quant
+    _make_boost_fn.cache_clear()
+
+
+def test_dist_with_subsample_and_feature_sampling(tmp_path, workers):
+    """Per-iteration Bernoulli row sampling and per-node feature
+    sampling are pure functions of the carried key — both must
+    replicate across the manager/worker split."""
+    cache = _make_cache(tmp_path, shards=2)
+    addrs = workers(2)
+    kw = dict(subsample=0.7, num_candidate_attributes=3)
+    m_local = _learner(**kw).train(cache)
+    m_dist = _learner(distributed_workers=addrs, **kw).train(cache)
+    _assert_bit_identical(m_dist, m_local)
+
+
+def test_dist_binary_classification(tmp_path, workers):
+    frame = _frame()
+    frame["y"] = (np.asarray(frame["f1"]) > 0).astype(np.int64)
+    cache = create_dataset_cache(
+        frame, str(tmp_path / "cls"), label="y",
+        task=Task.CLASSIFICATION, feature_shards=2,
+    )
+
+    def learner(**kw):
+        return ydf.GradientBoostedTreesLearner(
+            label="y", task=Task.CLASSIFICATION, num_trees=4,
+            max_depth=4, validation_ratio=0.0, early_stopping="NONE",
+            **kw,
+        )
+
+    addrs = workers(2)
+    m_local = learner().train(cache)
+    m_dist = learner(distributed_workers=addrs).train(cache)
+    _assert_bit_identical(m_dist, m_local)
+
+
+# --------------------------------------------------------------------- #
+# Configuration guard rails
+# --------------------------------------------------------------------- #
+
+
+def test_dist_requires_sharded_cache(tmp_path, workers):
+    cache = _make_cache(tmp_path, shards=0)
+    addrs = workers(2)
+    with pytest.raises(ValueError, match="feature_shards"):
+        _learner(distributed_workers=addrs).train(cache)
+
+
+def test_dist_requires_cache_input(workers):
+    addrs = workers(2)
+    with pytest.raises(ValueError, match="DatasetCache"):
+        _learner(distributed_workers=addrs).train(_frame())
+
+
+def test_dist_unsupported_configs_raise(tmp_path, workers):
+    cache = _make_cache(tmp_path, shards=2)
+    addrs = workers(2)
+    with pytest.raises(ValueError, match="validation"):
+        ydf.GradientBoostedTreesLearner(
+            label="y", task=Task.REGRESSION, num_trees=3,
+            distributed_workers=addrs,
+        ).train(cache)
+    with pytest.raises(ValueError, match="sampling_method"):
+        _learner(
+            distributed_workers=addrs, sampling_method="GOSS"
+        ).train(cache)
+    with pytest.raises(ValueError, match="SPARSE_OBLIQUE"):
+        _learner(
+            distributed_workers=addrs, split_axis="SPARSE_OBLIQUE"
+        ).train(cache)
+
+
+def test_shard_count_validation(tmp_path):
+    with pytest.raises(ValueError, match="exceeds"):
+        _make_cache(tmp_path, shards=64)  # only 5 feature columns
+
+
+# --------------------------------------------------------------------- #
+# Chaos: the three new failpoint sites + real failures
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.chaos
+def test_chaos_worker_loss_mid_layer_recovers_bit_identical(
+    tmp_path, workers
+):
+    """dist.histogram_rpc=drop_conn mid-tree: the shard moves to
+    another worker WITH the manager's authoritative state, and the
+    model is bit-identical to the fault-free run."""
+    cache = _make_cache(tmp_path, shards=2)
+    addrs = workers(2)
+    m_ref = _learner().train(cache)
+    with failpoints.active("dist.histogram_rpc=drop_conn@5"):
+        m_dist = _learner(distributed_workers=addrs).train(cache)
+        assert "dist.histogram_rpc" in failpoints.fired_sites()
+    _assert_bit_identical(m_dist, m_ref)
+    assert m_dist.training_logs["distributed"]["recoveries"] >= 1
+
+
+@pytest.mark.chaos
+def test_chaos_split_broadcast_drop_recovers_bit_identical(
+    tmp_path, workers
+):
+    cache = _make_cache(tmp_path, shards=2)
+    addrs = workers(2)
+    m_ref = _learner().train(cache)
+    with failpoints.active("dist.split_broadcast=drop_conn@2"):
+        m_dist = _learner(distributed_workers=addrs).train(cache)
+        assert "dist.split_broadcast" in failpoints.fired_sites()
+    _assert_bit_identical(m_dist, m_ref)
+
+
+@pytest.mark.chaos
+def test_chaos_shard_load_drop_recovers_bit_identical(
+    tmp_path, workers
+):
+    cache = _make_cache(tmp_path, shards=2)
+    addrs = workers(2)
+    m_ref = _learner().train(cache)
+    with failpoints.active("dist.shard_load=drop_conn"):
+        m_dist = _learner(distributed_workers=addrs).train(cache)
+        assert "dist.shard_load" in failpoints.fired_sites()
+    _assert_bit_identical(m_dist, m_ref)
+
+
+@pytest.mark.chaos
+def test_chaos_corrupt_cache_shard_rebuilt_bit_identical(
+    tmp_path, workers
+):
+    """A bit-flipped shard file is caught by the worker's crc check at
+    load, re-sliced from the verified bins.npy (byte-identical), and
+    training proceeds to the same model."""
+    cache = _make_cache(tmp_path, shards=2)
+    m_ref = _learner().train(cache)
+    shard_path = os.path.join(cache.path, "bins_shard_0.npy")
+    before = open(shard_path, "rb").read()
+    with open(shard_path, "r+b") as f:
+        f.seek(os.path.getsize(shard_path) // 2)
+        b = f.read(1)
+        f.seek(-1, 1)
+        f.write(bytes([b[0] ^ 0xFF]))
+    addrs = workers(2)
+    m_dist = _learner(distributed_workers=addrs).train(cache)
+    _assert_bit_identical(m_dist, m_ref)
+    assert m_dist.training_logs["distributed"]["shard_rebuilds"] >= 1
+    assert open(shard_path, "rb").read() == before  # byte-identical
+
+
+@pytest.mark.chaos
+def test_chaos_straggler_timeout_recovers_bit_identical(
+    tmp_path, workers, monkeypatch
+):
+    """A straggler — a worker that answers pings but hangs on real
+    work (hung host) — must be timed out by YDF_TPU_DIST_RPC_TIMEOUT_S,
+    quarantined, and its shards re-placed on the healthy workers."""
+    from ydf_tpu.parallel import dist_gbt
+    from ydf_tpu.parallel.worker_service import _recv_msg, _send_msg
+
+    hung = socket.socket()
+    hung.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    hung.bind(("127.0.0.1", 0))
+    hung.listen(8)
+    stop = threading.Event()
+
+    def serve_conn(conn):
+        try:
+            req = _recv_msg(conn)
+            if req.get("verb") == "ping":
+                _send_msg(conn, {"ok": True})
+            else:
+                stop.wait(60.0)  # hang: never answer real work
+        except Exception:
+            pass
+        finally:
+            conn.close()
+
+    def absorb():
+        while not stop.is_set():
+            try:
+                c, _ = hung.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=serve_conn, args=(c,), daemon=True
+            ).start()
+
+    threading.Thread(target=absorb, daemon=True).start()
+    # 3 shards over (2 healthy + 1 straggler): shard 2 lands on the
+    # straggler at placement and must be timed out + re-placed.
+    cache = _make_cache(tmp_path, shards=3)
+    m_ref = _learner().train(cache)
+    addrs = workers(2) + [f"127.0.0.1:{hung.getsockname()[1]}"]
+    monkeypatch.setattr(dist_gbt, "_RPC_TIMEOUT_S", 2.0)
+    m_dist = _learner(distributed_workers=addrs).train(cache)
+    _assert_bit_identical(m_dist, m_ref)
+    assert m_dist.training_logs["distributed"]["recoveries"] >= 1
+    stop.set()
+    hung.close()
+
+
+@pytest.mark.chaos
+def test_chaos_real_worker_shutdown_mid_train(tmp_path, workers):
+    """A worker REALLY shut down during training (not an injected
+    fault): whichever layer the loss lands on, the run must finish
+    bit-identical."""
+    cache = _make_cache(tmp_path, shards=2)
+    m_ref = _learner(num_trees=6).train(cache)
+    addrs = workers(3)
+
+    def kill_one():
+        time.sleep(0.3)
+        try:
+            WorkerPool([addrs[2]]).shutdown_all()
+        except Exception:
+            pass
+
+    t = threading.Thread(target=kill_one, daemon=True)
+    t.start()
+    m_dist = _learner(
+        num_trees=6, distributed_workers=addrs
+    ).train(cache)
+    t.join()
+    _assert_bit_identical(m_dist, m_ref)
+
+
+@pytest.mark.chaos
+def test_dist_verify_mode_cross_checks_workers(tmp_path, workers,
+                                               monkeypatch):
+    """YDF_TPU_DIST_VERIFY=1: the per-tree leaf_stats cross-check
+    passes on a healthy run (and the run stays bit-identical)."""
+    from ydf_tpu.parallel import dist_gbt
+
+    cache = _make_cache(tmp_path, shards=2)
+    addrs = workers(2)
+    m_ref = _learner().train(cache)
+    monkeypatch.setattr(dist_gbt, "_VERIFY", True)
+    m_dist = _learner(distributed_workers=addrs).train(cache)
+    _assert_bit_identical(m_dist, m_ref)
+    assert (
+        m_dist.training_logs["distributed"]["rpc_count"].get(
+            "leaf_stats", 0
+        )
+        >= 1
+    )
+
+
+# --------------------------------------------------------------------- #
+# Shard format (dataset/cache.py)
+# --------------------------------------------------------------------- #
+
+
+def test_shard_files_ride_integrity_format(tmp_path):
+    import json
+
+    cache = _make_cache(tmp_path, shards=3)
+    assert cache.feature_shards == 3
+    with open(os.path.join(cache.path, "cache_meta.json")) as f:
+        meta = json.load(f)
+    files = meta["integrity"]["files"]
+    full = np.asarray(cache.bins)
+    total_cols = 0
+    for k in range(3):
+        name = f"bins_shard_{k}.npy"
+        assert name in files and files[name]["size"] > 0
+        lo, hi = cache.shard_col_range(k)
+        sl = np.asarray(cache.shard_bins(k, verify=True))
+        assert np.array_equal(sl, full[:, lo:hi])
+        total_cols += hi - lo
+    assert total_cols == cache.binner.num_scalar
+    # A full open-time verification covers the shard files too.
+    cache.verify(full=True)
+
+
+def test_shard_rebuild_is_byte_identical(tmp_path):
+    from ydf_tpu.dataset.cache import CacheCorruptionError, DatasetCache
+
+    cache = _make_cache(tmp_path, shards=2)
+    p = os.path.join(cache.path, "bins_shard_1.npy")
+    before = open(p, "rb").read()
+    with open(p, "r+b") as f:
+        f.seek(len(before) // 2)
+        b = f.read(1)
+        f.seek(-1, 1)
+        f.write(bytes([b[0] ^ 0x5A]))
+    with pytest.raises(CacheCorruptionError):
+        cache.shard_bins(1, verify=True)
+    cache.rebuild_feature_shard(1)
+    assert open(p, "rb").read() == before
+    # The refreshed metadata still verifies end to end, including in a
+    # fresh handle.
+    DatasetCache(cache.path, verify="full")
+
+
+def test_unsharded_cache_shard_accessors_raise(tmp_path):
+    cache = _make_cache(tmp_path, shards=0, name="plain")
+    assert cache.feature_shards == 0
+    with pytest.raises(ValueError, match="feature_shards"):
+        cache.shard_bins(0)
+
+
+def test_shard_col_ranges_cover_and_validate():
+    from ydf_tpu.dataset.cache import shard_col_ranges
+
+    r = shard_col_ranges(7, 3)
+    assert r[0][0] == 0 and r[-1][1] == 7
+    assert all(a[1] == b[0] for a, b in zip(r, r[1:]))
+    assert max(hi - lo for lo, hi in r) - min(hi - lo for lo, hi in r) <= 1
+    with pytest.raises(ValueError):
+        shard_col_ranges(3, 0)
+    with pytest.raises(ValueError, match="exceeds"):
+        shard_col_ranges(2, 5)

@@ -64,9 +64,23 @@ def cmd_train(args):
         learner.working_dir = args.working_dir
     if getattr(args, "resume", False):
         learner.resume_training = True
+    data = args.dataset
+    if getattr(args, "workers", None):
+        # Feature-parallel distributed training: --dataset names a
+        # feature-sharded dataset cache directory and --workers the
+        # running `ydf_tpu.cli worker` fleet
+        # (docs/distributed_training.md).
+        from ydf_tpu.dataset.cache import DatasetCache
+
+        learner.distributed_workers = [
+            a.strip() for a in args.workers.split(",") if a.strip()
+        ]
+        if not learner.distributed_workers:
+            sys.exit("error: --workers lists no addresses")
+        data = DatasetCache(args.dataset)
     t0 = time.time()
     try:
-        model = learner.train(args.dataset)
+        model = learner.train(data)
     except Exception as e:
         # Preemption (SIGTERM/SIGINT during checkpointed training) is a
         # RESUMABLE outcome, not a failure: exit with its distinct code
@@ -428,6 +442,13 @@ def main(argv=None):
                         "metrics dump here (same as "
                         "YDF_TPU_TELEMETRY_DIR; see "
                         "docs/observability.md)")
+    p.add_argument("--workers",
+                   help="comma-separated host:port addresses of "
+                        "`ydf_tpu.cli worker` processes for feature-"
+                        "parallel distributed training; --dataset must "
+                        "then name a dataset cache directory created "
+                        "with feature_shards=N "
+                        "(docs/distributed_training.md)")
     p.add_argument("--cpu", action="store_true")
     p.set_defaults(fn=cmd_train)
 

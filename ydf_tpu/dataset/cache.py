@@ -241,6 +241,27 @@ def _resolve_verify(verify: Optional[str]) -> str:
     return verify
 
 
+def shard_col_ranges(num_scalar: int, num_shards: int) -> List[tuple]:
+    """Contiguous feature-column ranges [(lo, hi), ...] of a
+    `num_shards`-way feature sharding — np.array_split semantics, so
+    shard sizes differ by at most one column. The one place the shard
+    layout is defined: cache creation, shard rebuild, and the
+    distributed manager's reduce order all call this."""
+    if num_shards < 1:
+        raise ValueError(f"feature_shards must be >= 1, got {num_shards}")
+    if num_shards > max(num_scalar, 1):
+        raise ValueError(
+            f"feature_shards={num_shards} exceeds the {num_scalar} "
+            "scalar feature columns — each shard needs at least one"
+        )
+    edges = np.linspace(0, num_scalar, num_shards + 1).astype(np.int64)
+    return [(int(edges[k]), int(edges[k + 1])) for k in range(num_shards)]
+
+
+def _shard_file(k: int) -> str:
+    return f"bins_shard_{k}.npy"
+
+
 class DatasetCache:
     """Handle to a created cache directory; accepted by the learners.
 
@@ -275,6 +296,13 @@ class DatasetCache:
         #: Task-plumbing columns stored beside the bins (ranking groups,
         #: uplift treatment, survival event/entry) — name → dtype kind.
         self.extra_columns: List[str] = list(meta.get("extra_columns", []))
+        #: Feature-shard count of the distributed layout (0 = unsharded).
+        #: Shard k's file holds the row-major uint8 column slice
+        #: bins[:, lo:hi] (shard_col_ranges), riding the same
+        #: per-block-crc32 integrity records as every other data file —
+        #: the distributed-GBT workers each load exactly one slice
+        #: (ydf_tpu/parallel/dist_gbt.py).
+        self.feature_shards: int = int(meta.get("feature_shards", 0))
         self._meta = meta
         if verify != "off":
             self.verify(full=(verify == "full"))
@@ -304,6 +332,80 @@ class DatasetCache:
     def bins(self) -> np.ndarray:
         """uint8 [n, F] — memmapped, not resident."""
         return np.load(os.path.join(self.path, "bins.npy"), mmap_mode="r")
+
+    def shard_col_range(self, k: int) -> tuple:
+        """(lo, hi) feature-column range of shard k."""
+        ranges = shard_col_ranges(
+            self.binner.num_scalar, self._require_shards()
+        )
+        return ranges[k]
+
+    def shard_bins(self, k: int, verify: Optional[bool] = None) -> np.ndarray:
+        """uint8 [n, Fk] memmap of shard k's binned column slice.
+        `verify=True` re-checks THIS shard file's recorded crc blocks
+        first (the distributed worker's load-time check: a corrupt
+        shard must raise CacheCorruptionError, never feed garbage
+        histograms)."""
+        self._require_shards()
+        name = _shard_file(k)
+        if verify:
+            rec = (self._meta.get("integrity") or {}).get("files", {}).get(
+                name
+            )
+            if rec is not None:
+                _verify_file(os.path.join(self.path, name), rec, full=True)
+        return np.load(os.path.join(self.path, name), mmap_mode="r")
+
+    def _require_shards(self) -> int:
+        if self.feature_shards < 1:
+            raise ValueError(
+                f"dataset cache {self.path!r} was created without "
+                "feature shards; recreate it with "
+                "create_dataset_cache(..., feature_shards=N) for "
+                "distributed training"
+            )
+        return self.feature_shards
+
+    def rebuild_feature_shard(self, k: int) -> None:
+        """Re-slices shard k's file from the (verified) full bins.npy —
+        the recovery path for a corrupt cache shard: the slice is a pure
+        function of bins.npy, so the rebuilt file is byte-identical to
+        the original and training resumes bit-identically. The shard's
+        integrity record is refreshed and cache_meta.json republished
+        durably (same fsync-before-rename recipe as creation)."""
+        self._require_shards()
+        rec = (self._meta.get("integrity") or {}).get("files", {}).get(
+            "bins.npy"
+        )
+        if rec is not None:
+            _verify_file(
+                os.path.join(self.path, "bins.npy"), rec, full=True
+            )
+        lo, hi = self.shard_col_range(k)
+        full = self.bins
+        out = np.lib.format.open_memmap(
+            os.path.join(self.path, _shard_file(k)), mode="w+",
+            dtype=np.uint8, shape=(full.shape[0], hi - lo),
+        )
+        # Stream in row blocks: RSS stays O(block), not O(n·Fk).
+        step = max(1, (64 << 20) // max(hi - lo, 1))
+        for r in range(0, full.shape[0], step):
+            out[r: r + step] = full[r: r + step, lo:hi]
+        out.flush()
+        del out
+        integ = self._meta.setdefault("integrity", {"files": {}})
+        integ["files"][_shard_file(k)] = _file_integrity(
+            os.path.join(self.path, _shard_file(k))
+        )
+        if telemetry.ENABLED:
+            telemetry.counter("ydf_cache_shard_rebuilds_total").inc()
+        from ydf_tpu.utils.snapshot import _durable_replace
+
+        meta_path = os.path.join(self.path, "cache_meta.json")
+        tmp = meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._meta, f)
+        _durable_replace(tmp, meta_path)
 
     @property
     def labels(self) -> np.ndarray:
@@ -364,6 +466,7 @@ def create_dataset_cache(
     label_entry_age: Optional[str] = None,
     store_raw_numerical: bool = False,
     reuse: bool = False,
+    feature_shards: int = 0,
 ) -> DatasetCache:
     """Builds an on-disk binned cache from (sharded) CSV input, or from
     an in-memory columnar frame (pandas / polars DataFrame or dict of
@@ -384,7 +487,17 @@ def create_dataset_cache(
     integrity verification, it is returned as-is; a corrupt, truncated
     or mismatching cache is rebuilt from scratch instead of being
     trained on. In-memory frame input always rebuilds (no cheap content
-    identity to fingerprint)."""
+    identity to fingerprint).
+
+    `feature_shards=N` (N >= 1) additionally writes the distributed-GBT
+    shard layout (docs/distributed_training.md): N row-major uint8
+    column slices `bins_shard_k.npy` covering bins[:, lo:hi] per
+    shard_col_ranges, each with its own per-block-crc32 integrity
+    record. The full bins.npy is kept — it is the single-machine
+    training path AND the shard-rebuild source (a corrupt shard is
+    re-sliced from it byte-identically,
+    DatasetCache.rebuild_feature_shard). Labels/weights stay in their
+    single replicated files; every worker reads the same block."""
     if isinstance(data_path, str):
         fmt, _ = _split_typed_path(data_path)
         if fmt != "csv":
@@ -402,6 +515,11 @@ def create_dataset_cache(
             return iter_frame_chunks(frame, rows)
 
         files = None
+    feature_shards = int(feature_shards)
+    if feature_shards < 0:
+        raise ValueError(
+            f"feature_shards must be >= 0, got {feature_shards}"
+        )
     os.makedirs(cache_dir, exist_ok=True)
 
     # Request fingerprint: identifies (source content proxy, requested
@@ -421,7 +539,7 @@ def create_dataset_cache(
                 chunk_rows, max_vocab_count, min_vocab_frequency,
                 ranking_group, uplift_treatment, label_event_observed,
                 label_entry_age, store_raw_numerical,
-            )).encode()
+            ) + ((feature_shards,) if feature_shards else ())).encode()
         ).hexdigest()
     if reuse and request_fp is not None:
         existing = _try_reuse_cache(cache_dir, request_fp)
@@ -670,6 +788,25 @@ def create_dataset_cache(
     if raw_mm is not None:
         raw_mm.flush()
 
+    # ---- feature shards: the distributed-GBT column slices ---------- #
+    shard_files: List[str] = []
+    if feature_shards:
+        for k, (lo, hi) in enumerate(
+            shard_col_ranges(F, int(feature_shards))
+        ):
+            sm = np.lib.format.open_memmap(
+                os.path.join(cache_dir, _shard_file(k)), mode="w+",
+                dtype=np.uint8, shape=(num_rows, hi - lo),
+            )
+            # Row-block streaming keeps RSS at O(block) — the slice
+            # never materializes in host RAM.
+            step = max(1, (64 << 20) // max(hi - lo, 1))
+            for r in range(0, num_rows, step):
+                sm[r: r + step] = bins_mm[r: r + step, lo:hi]
+            sm.flush()
+            del sm
+            shard_files.append(_shard_file(k))
+
     # ---- finalize: integrity metadata + atomic publish -------------- #
     # The metadata is the cache's commit record: it is written LAST,
     # fsync-before-rename (same durability recipe as utils/snapshot.py),
@@ -681,6 +818,7 @@ def create_dataset_cache(
     data_files += [f"col_{name}.npy" for name in extra_mm]
     if raw_mm is not None:
         data_files.append("raw_numerical.npy")
+    data_files += shard_files
     integrity = {
         "algo": "crc32",
         "block_bytes": _CRC_BLOCK,
@@ -709,6 +847,7 @@ def create_dataset_cache(
                 "weights": weights,
                 "extra_columns": extra_cols,
                 "store_raw_numerical": bool(raw_mm is not None),
+                "feature_shards": int(feature_shards),
                 "source": data_path if isinstance(data_path, str) else
                 "<in-memory frame>",
                 "integrity": integrity,

@@ -210,6 +210,7 @@ class GenericLearner(HyperparameterValidationMixin):
             "dataset": Dataset(data, cache.dataspec),
             "binned": None,
             "binner": cache.binner,
+            "cache": cache,  # handle (distributed training shards off it)
             "bins": cache.bins,  # uint8 memmap [n, F]
             "set_bits": None,
             "vs": None,

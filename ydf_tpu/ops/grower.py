@@ -81,6 +81,362 @@ def unpack_mask_bit(packed: jax.Array, bit: jax.Array) -> jax.Array:
     return ((word >> (bit.astype(jnp.uint32) & 31)) & 1).astype(jnp.bool_)
 
 
+# --------------------------------------------------------------------- #
+# Split-search seam
+#
+# The per-layer split search is factored into standalone functions so the
+# single-machine grower below and the feature-parallel distributed
+# manager (ydf_tpu/parallel/dist_gbt.py) run the SAME gain/argmax/
+# child-allocation code: the distributed manager assembles the layer
+# histogram from per-worker feature slices and then calls exactly these
+# functions, so a distributed train chooses bit-identical splits to the
+# single-machine build by construction. _grow_tree_jit calls them inline
+# (traced into its one jitted program, unchanged ops); dist_gbt jits
+# them per layer.
+# --------------------------------------------------------------------- #
+
+
+def prepare_stats_for_hist(stats, hist_quant: str):
+    """Per-tree stats preparation shared by the grower and the
+    distributed manager: returns (hist_stats, qscale, total) — the
+    (possibly quantized/split) histogram operand, the int8 per-tree
+    scale (None otherwise), and the root stat totals [S] on the SAME
+    grid every layer's histograms will sum (see the per-tree-scale
+    design note at the call site in _grow_tree_jit)."""
+    f32 = jnp.float32
+    if hist_quant == "int8":
+        qscale = jnp.max(jnp.abs(stats), axis=0) / 127.0
+        qscale = jnp.maximum(
+            qscale.astype(f32), jnp.finfo(jnp.float32).tiny
+        )
+        qscale = jnp.exp2(jnp.ceil(jnp.log2(qscale)))
+        # Multiply by the exact pow2 reciprocal (≡ divide, bit for bit).
+        stats_q = jnp.clip(
+            jnp.round(stats * (1.0 / qscale)[None, :]), -127.0, 127.0
+        )
+        total = jnp.sum(stats_q, axis=0) * qscale  # [S] dequantized
+        hist_stats = stats_q.astype(jnp.int8)
+    elif hist_quant == "bf16x2":
+        qscale = None
+        total = jnp.sum(stats, axis=0)  # [S]
+        s_hi = stats.astype(jnp.bfloat16)
+        s_lo = (stats - s_hi.astype(f32)).astype(jnp.bfloat16)
+        hist_stats = jnp.concatenate([s_hi, s_lo], axis=1)  # [n, 2S]
+    else:
+        qscale = None
+        total = jnp.sum(stats, axis=0)  # [S]
+        hist_stats = stats
+    return hist_stats, qscale, total
+
+
+def sibling_reconstruct(hist_small, parent_hist, small_is_left, Ld: int):
+    """Sibling-subtraction reconstruction: the [Lh, F, B, S] histograms
+    of the SMALLER children plus the carried parent histograms →
+    the full [Ld, F, B, S] layer histogram (larger sibling = parent −
+    child). Shared seam: the distributed manager reduces only the
+    smaller-child slices from its workers and reconstructs here."""
+    Lh = hist_small.shape[0]
+    hist_big = parent_hist - hist_small
+    sil = small_is_left[:, None, None, None, None]
+    # Split s's children live at slots (2s, 2s+1) = (left, right).
+    hist = jnp.where(
+        sil,
+        jnp.stack([hist_small, hist_big], axis=1),
+        jnp.stack([hist_big, hist_small], axis=1),
+    ).reshape(2 * Lh, *hist_small.shape[1:])
+    if 2 * Lh < Ld:  # odd frontier cap: top slots never occupied
+        hist = jnp.pad(
+            hist, ((0, Ld - 2 * Lh),) + ((0, 0),) * (hist.ndim - 1)
+        )
+    return hist
+
+
+def scalar_candidates(hist, *, Fn: int, O: int, rule, rule_ctx):
+    """Candidate left-stats for every cut of the scalar features:
+    numerical prefix cumsums plus the sorted-order categorical prefixes
+    (O orderings per categorical feature). Returns (left_all
+    [Ld, Fn + Fc·O, B, S], ranks [Ld, Fc, O, B] or None)."""
+    Ld, F, B, S = hist.shape
+    Fc = F - Fn
+    csum_num = jnp.cumsum(hist[:, :Fn], axis=2)  # [Ld, Fn, B, S]
+    if Fc == 0:
+        return csum_num, None
+    hist_cat = hist[:, Fn:]  # [Ld, Fc, B, S]
+    # O orderings per categorical feature (reference
+    # FindSplitLabelClassificationFeatureCategorical,
+    # training.cc:3933-3975: multiclass scans one sorted order PER
+    # label class — "one label value vs others"); binary and
+    # non-classification rules keep the single exact order. Each
+    # ordering becomes its own candidate column.
+    if O > 1:
+        cat_key = rule.cat_sort_keys(hist_cat, rule_ctx)
+    else:
+        cat_key = rule.cat_sort_key(hist_cat, rule_ctx)[:, :, None]
+    # [Ld, Fc, O, B]. Empty bins sort last → they land on the
+    # right side, so unseen categories at serving time route right.
+    cat_key = jnp.where(
+        (hist_cat[..., -1] > 0)[:, :, None, :], cat_key, jnp.inf
+    )
+    order = jnp.argsort(cat_key, axis=-1)  # [Ld, Fc, O, B]
+    ranks = jnp.argsort(order, axis=-1)    # rank of each bin
+    sorted_hist = jnp.take_along_axis(
+        hist_cat[:, :, None], order[..., None], axis=3
+    )  # [Ld, Fc, O, B, S]
+    csum_cat = jnp.cumsum(sorted_hist, axis=3).reshape(
+        Ld, Fc * O, B, S
+    )
+    return jnp.concatenate([csum_num, csum_cat], axis=1), ranks
+
+
+class LayerDecision(NamedTuple):
+    """Output of layer_decide — everything a layer's split search
+    determines: which frontier slots split, where the children live,
+    the per-slot routing tables, and the node-array write payloads."""
+
+    do_split: jax.Array      # bool [Ld]
+    split_rank: jax.Array    # int32 [Ld] rank among this layer's splits
+    wid: jax.Array           # int32 [Ld] node write index (N = trash)
+    left_id: jax.Array       # int32 [Ld] child node ids (N = none)
+    right_id: jax.Array
+    best_t: jax.Array        # int32 [Ld] chosen cut
+    best_f: jax.Array        # int32 [Ld] raw candidate-column index
+    best_f_scalar: jax.Array  # collapsed onto the real scalar features
+    best_f_store: jax.Array  # stored feature id (set ids offset by nvf)
+    is_cat_split: jax.Array
+    is_set_split: jax.Array
+    fset: jax.Array          # real set-feature index (set splits)
+    set_dir: jax.Array       # False = ascending order column
+    route_f: jax.Array       # int32 [Ld] bins column the routing gathers
+    go_left_bins: jax.Array  # bool [Ld, B] per-bin left decision
+    store_mask: jax.Array    # bool [Ld, 32·W] stored cat/set mask bits
+    left_stats: jax.Array    # f32 [Ld, S] chosen-cut child stats
+    right_stats: jax.Array
+    num_nodes: jax.Array     # updated node count
+
+
+def layer_decide(
+    left_all, ranks, sranks_dirs, parent, active, nid, num_nodes,
+    k_gain, k_feat, dirs, rule_ctx=None, *,
+    rule, L: int, B: int, N: int, Fn: int, Fc: int, O: int, Fs: int,
+    W: int, min_examples: int, min_split_gain: float,
+    candidate_features: int, num_valid_features, children_in_frontier,
+):
+    """One layer's split search: gain → validity/sampling masks →
+    per-slot argmax → frontier-overflow cap → child allocation → chosen
+    stats + routing tables. Pure function of its inputs; shared by the
+    single-machine grower (traced into its program) and the distributed
+    manager's reduction (jitted per layer over the histogram assembled
+    from worker feature slices)."""
+    i32 = jnp.int32
+    Ld = left_all.shape[0]
+    F = Fn + Fc
+    Fcand = Fn + Fc * O
+    cut_ids = jnp.arange(B, dtype=i32)
+
+    Fa = Fcand + 2 * Fs  # total candidate columns
+    right_all = parent[:, None, None, :] - left_all  # [Ld, Fa, B, S]
+
+    gain = rule.gain(left_all, right_all, parent[:, None, None, :],
+                     k_gain, rule_ctx)  # [Ld, F, B]
+
+    valid = (
+        (left_all[..., -1] >= min_examples)
+        & (right_all[..., -1] >= min_examples)
+        & active[:, None, None]
+    )
+    if hasattr(rule, "split_valid"):
+        # Rule-specific validity (e.g. uplift's per-treatment-arm
+        # minimum example counts).
+        valid &= rule.split_valid(left_all, right_all)
+    if candidate_features > 0 and candidate_features < F + Fs:
+        # Exact per-node sampling of `candidate_features` features
+        # without replacement (reference: per-node attribute sampling,
+        # ydf/learner/decision_tree/training.cc FindBestCondition).
+        # Each set feature is ONE candidate — its two direction
+        # columns share a score.
+        base = jax.random.uniform(k_feat, (Ld, F + Fs))
+        if num_valid_features is not None and num_valid_features < F:
+            # Constant-zero pad columns (feature-parallel padding) must
+            # not consume sample slots — they'd dilute the real
+            # candidate set relative to the unpadded configuration.
+            # Set features (always real) keep their scores.
+            col_real = jnp.concatenate(
+                [
+                    jnp.arange(F) < num_valid_features,
+                    jnp.ones((Fs,), jnp.bool_),
+                ]
+            )
+            base = jnp.where(col_real, base, -1.0)
+        kth = jax.lax.top_k(base, candidate_features)[0][:, -1]
+        # Expand per-FEATURE scores onto candidate columns: the O
+        # orderings of one categorical (and a set feature's two
+        # direction columns) share a single sampling score.
+        scores = jnp.concatenate(
+            [
+                base[:, :Fn],
+                jnp.repeat(base[:, Fn:F], O, axis=1),
+                base[:, F:],
+                base[:, F:],
+            ],
+            axis=1,
+        ) if (Fs or O > 1) else base
+        valid &= (scores >= kth[:, None])[:, :, None]
+    if dirs is not None:
+        leaf_l = rule.leaf_value(left_all, rule_ctx)[..., 0]
+        leaf_r = rule.leaf_value(right_all, rule_ctx)[..., 0]
+        mono_ok = (dirs[None, :, None] == 0) | (
+            dirs[None, :, None] * (leaf_r - leaf_l) >= 0
+        )
+        valid &= mono_ok
+    gain = jnp.where(valid, gain, -jnp.inf)
+
+    # ---- best cut per frontier slot --------------------------------- #
+    flat = gain.reshape(Ld, Fa * B)
+    best_idx = jnp.argmax(flat, axis=1)
+    best_gain = jnp.take_along_axis(flat, best_idx[:, None], 1)[:, 0]
+    best_f = (best_idx // B).astype(i32)
+    best_t = (best_idx % B).astype(i32)
+
+    do_split = active & jnp.isfinite(best_gain) & (best_gain > min_split_gain)
+    if children_in_frontier and 2 * Ld > L:
+        # Frontier overflow: keep the top-L/2 splits by gain, the rest
+        # become leaves (breadth-first analogue of the reference's
+        # best-first growth cap, training.cc:4580).
+        order_by_gain = jnp.argsort(
+            jnp.where(do_split, -best_gain, jnp.inf)
+        )
+        rank_by_gain = jnp.argsort(order_by_gain)
+        do_split &= rank_by_gain < (L // 2)
+
+    # ---- allocate children ------------------------------------------ #
+    # Node-capacity guard: children that would not fit in N become
+    # leaves. The masked-out slots form a suffix in cumsum order, so
+    # ranks of surviving slots are unchanged.
+    rank0 = jnp.cumsum(do_split.astype(i32)) - 1
+    do_split &= num_nodes + 2 * (rank0 + 1) <= N
+    split_rank = jnp.cumsum(do_split.astype(i32)) - 1  # [Ld]
+    wid = jnp.where(do_split, nid, N)  # write index (trash when no split)
+    left_id = jnp.where(do_split, num_nodes + 2 * split_rank, N)
+    right_id = jnp.where(do_split, left_id + 1, N)
+
+    # Left-stats of the chosen cut (gather from the candidate cumsums).
+    chosen = jnp.take_along_axis(
+        left_all, best_f[:, None, None, None], axis=1
+    )[:, 0]  # [Ld, B, S]
+    left_stats = jnp.take_along_axis(
+        chosen, best_t[:, None, None], axis=1
+    )[:, 0]  # [Ld, S]
+    right_stats = parent - left_stats
+
+    is_set_split = best_f >= Fcand
+    # Direction column → (direction, real set-feature index).
+    set_dir = (best_f - Fcand) >= Fs      # False = asc, True = desc
+    fset = jnp.where(set_dir, best_f - Fcand - Fs, best_f - Fcand)
+    is_cat_split = (best_f >= Fn) & ~is_set_split
+    # Per-slot routing mask over bins: numerical → prefix of bin ids,
+    # categorical → prefix of the sorted order (rank <= cut) in the
+    # CHOSEN ordering's column.
+    if Fc > 0:
+        ranks_flat = ranks.reshape(Ld, Fc * O, B)
+        chosen_rank = jnp.take_along_axis(
+            ranks_flat,
+            jnp.clip(best_f - Fn, 0, Fc * O - 1)[:, None, None],
+            axis=1,
+        )[:, 0]  # [Ld, B]
+        go_left_bins = jnp.where(
+            is_cat_split[:, None],
+            chosen_rank <= best_t[:, None],
+            cut_ids[None, :] <= best_t[:, None],
+        )  # [Ld, B]
+    else:
+        go_left_bins = cut_ids[None, :] <= best_t[:, None]
+    if Fs > 0:
+        # Stored set mask: bit = item in the selected subset
+        # (rank <= cut in the chosen direction); intersecting
+        # examples go RIGHT.
+        Vs = sranks_dirs[0].shape[-1]
+        fclip = jnp.clip(fset, 0, Fs - 1)[:, None, None]
+        cs0 = jnp.take_along_axis(sranks_dirs[0], fclip, axis=1)[:, 0]
+        cs1 = jnp.take_along_axis(sranks_dirs[1], fclip, axis=1)[:, 0]
+        chosen_srank = jnp.where(set_dir[:, None], cs1, cs0)  # [Ld, Vs]
+        sel = chosen_srank <= best_t[:, None]
+        Wb = 32 * W
+        if Vs < Wb:
+            sel = jnp.pad(sel, ((0, 0), (0, Wb - Vs)))
+        glb = go_left_bins
+        if B < Wb:
+            glb = jnp.pad(glb, ((0, 0), (0, Wb - B)))
+        store_mask = jnp.where(is_set_split[:, None], sel, glb)
+    else:
+        store_mask = go_left_bins
+
+    # The stored feature id collapses the two direction columns back
+    # onto the real feature block — offset by the UNPADDED scalar
+    # count (feature-parallel padding appends zero columns to `bins`;
+    # serving decodes set ids against the unpadded layout).
+    nvf = F if num_valid_features is None else num_valid_features
+    # Collapse ordering columns back onto the real categorical id and
+    # the set direction columns onto the real set id.
+    best_f_scalar = jnp.where(
+        is_cat_split, Fn + (best_f - Fn) // O, best_f
+    )
+    best_f_store = jnp.where(is_set_split, nvf + fset, best_f_scalar)
+    num_nodes_new = num_nodes + 2 * jnp.sum(do_split.astype(i32))
+    route_f = jnp.clip(best_f_scalar, 0, max(F - 1, 0))
+    return LayerDecision(
+        do_split=do_split, split_rank=split_rank, wid=wid,
+        left_id=left_id, right_id=right_id, best_t=best_t,
+        best_f=best_f, best_f_scalar=best_f_scalar,
+        best_f_store=best_f_store, is_cat_split=is_cat_split,
+        is_set_split=is_set_split, fset=fset, set_dir=set_dir,
+        route_f=route_f, go_left_bins=go_left_bins,
+        store_mask=store_mask, left_stats=left_stats,
+        right_stats=right_stats, num_nodes=num_nodes_new,
+    )
+
+
+def sibling_next_state(
+    hist, do_split, split_rank, left_stats, right_stats, *,
+    Ld: int, L: int,
+):
+    """Sibling-subtraction bookkeeping for the NEXT layer (shared
+    seam): scatters this layer's histograms by split rank into the
+    parent-histogram carry, flags each split's smaller child, and builds
+    the slot→hist-slot map. Returns (parent_next, small_is_left_next,
+    Lh_next, hmap). The caller guards on hist_subtract / F > 0 /
+    children_in_frontier."""
+    i32 = jnp.int32
+    Lh_next = min(Ld, L // 2)  # static bound on this layer's splits
+    # Index each split's data by its rank (children of rank s sit at
+    # slots 2s / 2s+1 next layer); rank Lh_next is the scatter trash
+    # row, sliced off.
+    ridx = jnp.where(do_split, split_rank, Lh_next)
+    parent_next = (
+        jnp.zeros((Lh_next + 1,) + hist.shape[1:], hist.dtype)
+        .at[ridx].set(hist)[:Lh_next]
+    )
+    # Smaller child by the count-like last stat column (the same column
+    # the min_examples validity check uses). The choice only steers
+    # WORK, not results: parent − child is exact for any additive
+    # stats, so a skewed weighting costs speed, never correctness.
+    small_left = left_stats[:, -1] <= right_stats[:, -1]  # [Ld]
+    small_is_left_next = (
+        jnp.zeros((Lh_next + 1,), jnp.bool_)
+        .at[ridx].set(small_left)[:Lh_next]
+    )
+    tgt_l_pre = jnp.where(do_split, 2 * split_rank, L)
+    tgt_r_pre = jnp.where(do_split, 2 * split_rank + 1, L)
+    hmap = jnp.full((L + 1,), Lh_next, i32)
+    hmap = hmap.at[tgt_l_pre].set(
+        jnp.where(do_split & small_left, split_rank, Lh_next)
+    )
+    hmap = hmap.at[tgt_r_pre].set(
+        jnp.where(do_split & ~small_left, split_rank, Lh_next)
+    )
+    hmap = hmap.at[L].set(Lh_next)
+    return parent_next, small_is_left_next, Lh_next, hmap
+
+
 def grow_tree(
     bins, stats, key, *, hist_impl: str = "auto",
     hist_subtract: Optional[bool] = None,
@@ -303,32 +659,11 @@ def _grow_tree_jit(
     # a 2.5x-too-large bogus root gain on the bench-like shape.) The
     # scale is snapped to a power of two inside histogram(); mirror
     # that here so the root total uses the identical grid.
-    if hist_quant == "int8":
-        qscale = jnp.max(jnp.abs(stats), axis=0) / 127.0
-        qscale = jnp.maximum(
-            qscale.astype(f32), jnp.finfo(jnp.float32).tiny
-        )
-        qscale = jnp.exp2(jnp.ceil(jnp.log2(qscale)))
-        # Multiply by the exact pow2 reciprocal (≡ divide, bit for bit).
-        stats_q = jnp.clip(
-            jnp.round(stats * (1.0 / qscale)[None, :]), -127.0, 127.0
-        )
-        total = jnp.sum(stats_q, axis=0) * qscale  # [S] dequantized
-        # Quantize ONCE per tree; every layer's histogram takes the
-        # int8 operand directly (histogram() detects the dtype) instead
-        # of re-paying the O(n·S) transform per layer.
-        hist_stats = stats_q.astype(jnp.int8)
-    elif hist_quant == "bf16x2":
-        qscale = None
-        total = jnp.sum(stats, axis=0)  # [S]
-        # Split ONCE per tree into the bf16 high/residual halves.
-        s_hi = stats.astype(jnp.bfloat16)
-        s_lo = (stats - s_hi.astype(f32)).astype(jnp.bfloat16)
-        hist_stats = jnp.concatenate([s_hi, s_lo], axis=1)  # [n, 2S]
-    else:
-        qscale = None
-        total = jnp.sum(stats, axis=0)  # [S]
-        hist_stats = stats
+    # Quantize/split ONCE per tree (prepare_stats_for_hist, the shared
+    # seam); every layer's histogram takes the transformed operand
+    # directly (histogram() detects the dtype) instead of re-paying the
+    # O(n·S) transform per layer.
+    hist_stats, qscale, total = prepare_stats_for_hist(stats, hist_quant)
     tree["leaf_stats"] = tree["leaf_stats"].at[0].set(total)
 
     # Frontier state, padded with one trash slot at index L.
@@ -337,8 +672,6 @@ def _grow_tree_jit(
     slot = jnp.zeros((n,), i32)  # every example starts at the root slot 0
     leaf_id = jnp.zeros((n,), i32)
     num_nodes = jnp.asarray(1, i32)
-
-    cut_ids = jnp.arange(B, dtype=i32)
 
     if Fs > 0:
         # Unpacked multi-hot membership, bool [n, Fs, Vs] — input-derived,
@@ -352,10 +685,16 @@ def _grow_tree_jit(
         # exact per-item stats against the quantized parent chain would
         # re-open the phantom-mass hazard the per-tree scale closes
         # (left_set = parent − prefix with operands on different grids).
+        # hist_stats holds the int8 grid points / bf16 halves; the
+        # casts below are exact, so these equal the pre-seam
+        # stats_q·scale and s_hi+s_lo expressions bit for bit.
         if hist_quant == "int8":
-            stats_set = stats_q * qscale
+            stats_set = hist_stats.astype(f32) * qscale
         elif hist_quant == "bf16x2":
-            stats_set = s_hi.astype(f32) + s_lo.astype(f32)
+            stats_set = (
+                hist_stats[:, :S].astype(f32)
+                + hist_stats[:, S:].astype(f32)
+            )
         else:
             stats_set = stats
 
@@ -404,13 +743,14 @@ def _grow_tree_jit(
             # alone below.
             left_all = jnp.zeros((Ld, 0, B, S), f32)
             hist = None
+            ranks = None
         elif sub_state is not None:
             # Sibling subtraction: histogram ONLY the smaller child of
             # every previous-layer split (Lh ≤ ceil(Ld/2) live slots; all
             # other rows carry the trash slot Lh), then reconstruct the
-            # larger sibling as parent − child. The matmul/segment/pallas
-            # contraction width halves; the native kernel early-continues
-            # the trash rows.
+            # larger sibling as parent − child (sibling_reconstruct, the
+            # shared seam). The matmul/segment/pallas contraction width
+            # halves; the native kernel early-continues the trash rows.
             parent_hist, hslot_e, small_is_left, Lh = sub_state
             if fuse_route:
                 # Fully-fused: the kernel routes each row through the
@@ -430,19 +770,9 @@ def _grow_tree_jit(
                     num_bins=B, impl=hist_impl, quant=hist_quant,
                     quant_scale=qscale, compact=_compact_cap(Lh),
                 )  # [Lh, F, B, S] (dequantized f32 under quantization)
-            hist_big = parent_hist - hist_small
-            sil = small_is_left[:, None, None, None, None]
-            # Split s's children live at slots (2s, 2s+1) = (left, right).
-            hist = jnp.where(
-                sil,
-                jnp.stack([hist_small, hist_big], axis=1),
-                jnp.stack([hist_big, hist_small], axis=1),
-            ).reshape(2 * Lh, F, B, S)
-            if 2 * Lh < Ld:  # odd frontier cap: top slots never occupied
-                hist = jnp.pad(
-                    hist, ((0, Ld - 2 * Lh), (0, 0), (0, 0), (0, 0))
-                )
-            csum_num = jnp.cumsum(hist[:, :Fn], axis=2)  # [Ld, Fn, B, S]
+            hist = sibling_reconstruct(
+                hist_small, parent_hist, small_is_left, Ld
+            )
         elif fuse_route and depth > 0:
             # Subtraction off, fused: route the previous layer's splits
             # and histogram the resulting frontier slots in one pass
@@ -454,43 +784,15 @@ def _grow_tree_jit(
                 stats=hist_stats, num_slots=Ld, num_bins=B,
                 quant_scale=qscale,
             )
-            csum_num = jnp.cumsum(hist[:, :Fn], axis=2)  # [Ld, Fn, B, S]
         else:
             hist = histogram(
                 bins, slot, hist_stats, num_slots=Ld, num_bins=B,
                 impl=hist_impl, quant=hist_quant, quant_scale=qscale,
             )  # [Ld, F, B, S]
-            csum_num = jnp.cumsum(hist[:, :Fn], axis=2)  # [Ld, Fn, B, S]
-        if F == 0:
-            pass
-        elif Fc > 0:
-            hist_cat = hist[:, Fn:]  # [Ld, Fc, B, S]
-            # O orderings per categorical feature (reference
-            # FindSplitLabelClassificationFeatureCategorical,
-            # training.cc:3933-3975: multiclass scans one sorted order PER
-            # label class — "one label value vs others"); binary and
-            # non-classification rules keep the single exact order. Each
-            # ordering becomes its own candidate column.
-            if O > 1:
-                cat_key = rule.cat_sort_keys(hist_cat, rule_ctx)
-            else:
-                cat_key = rule.cat_sort_key(hist_cat, rule_ctx)[:, :, None]
-            # [Ld, Fc, O, B]. Empty bins sort last → they land on the
-            # right side, so unseen categories at serving time route right.
-            cat_key = jnp.where(
-                (hist_cat[..., -1] > 0)[:, :, None, :], cat_key, jnp.inf
+        if F > 0:
+            left_all, ranks = scalar_candidates(
+                hist, Fn=Fn, O=O, rule=rule, rule_ctx=rule_ctx
             )
-            order = jnp.argsort(cat_key, axis=-1)  # [Ld, Fc, O, B]
-            ranks = jnp.argsort(order, axis=-1)    # rank of each bin
-            sorted_hist = jnp.take_along_axis(
-                hist_cat[:, :, None], order[..., None], axis=3
-            )  # [Ld, Fc, O, B, S]
-            csum_cat = jnp.cumsum(sorted_hist, axis=3).reshape(
-                Ld, Fc * O, B, S
-            )
-            left_all = jnp.concatenate([csum_num, csum_cat], axis=1)
-        else:
-            left_all = csum_num
 
         if Fs > 0:
             # ---- categorical-set candidates ------------------------- #
@@ -551,54 +853,8 @@ def _grow_tree_jit(
                 left_set_blocks.append(left_set)
             left_all = jnp.concatenate([left_all] + left_set_blocks, axis=1)
 
+        # ---- split search (shared seam: ops/grower.py layer_decide) ----- #
         Fa = Fcand + 2 * Fs  # total candidate columns
-        right_all = parent[:, None, None, :] - left_all  # [Ld, Fa, B, S]
-
-        gain = rule.gain(left_all, right_all, parent[:, None, None, :],
-                         k_gain, rule_ctx)  # [Ld, F, B]
-
-        valid = (
-            (left_all[..., -1] >= min_examples)
-            & (right_all[..., -1] >= min_examples)
-            & active[:, None, None]
-        )
-        if hasattr(rule, "split_valid"):
-            # Rule-specific validity (e.g. uplift's per-treatment-arm
-            # minimum example counts).
-            valid &= rule.split_valid(left_all, right_all)
-        if candidate_features > 0 and candidate_features < F + Fs:
-            # Exact per-node sampling of `candidate_features` features
-            # without replacement (reference: per-node attribute sampling,
-            # ydf/learner/decision_tree/training.cc FindBestCondition).
-            # Each set feature is ONE candidate — its two direction
-            # columns share a score.
-            base = jax.random.uniform(k_feat, (Ld, F + Fs))
-            if num_valid_features is not None and num_valid_features < F:
-                # Constant-zero pad columns (feature-parallel padding) must
-                # not consume sample slots — they'd dilute the real
-                # candidate set relative to the unpadded configuration.
-                # Set features (always real) keep their scores.
-                col_real = jnp.concatenate(
-                    [
-                        jnp.arange(F) < num_valid_features,
-                        jnp.ones((Fs,), jnp.bool_),
-                    ]
-                )
-                base = jnp.where(col_real, base, -1.0)
-            kth = jax.lax.top_k(base, candidate_features)[0][:, -1]
-            # Expand per-FEATURE scores onto candidate columns: the O
-            # orderings of one categorical (and a set feature's two
-            # direction columns) share a single sampling score.
-            scores = jnp.concatenate(
-                [
-                    base[:, :Fn],
-                    jnp.repeat(base[:, Fn:F], O, axis=1),
-                    base[:, F:],
-                    base[:, F:],
-                ],
-                axis=1,
-            ) if (Fs or O > 1) else base
-            valid &= (scores >= kth[:, None])[:, :, None]
         dirs = None
         if monotone_dirs is not None:
             dirs = jnp.zeros((Fa,), f32).at[
@@ -608,117 +864,38 @@ def _grow_tree_jit(
             dirs_np = np.zeros((Fa,), np.float32)
             dirs_np[: len(monotone)] = np.array(monotone, np.float32)
             dirs = jnp.asarray(dirs_np)  # [Fa]; set features always 0
-        if dirs is not None:
-            leaf_l = rule.leaf_value(left_all, rule_ctx)[..., 0]
-            leaf_r = rule.leaf_value(right_all, rule_ctx)[..., 0]
-            mono_ok = (dirs[None, :, None] == 0) | (
-                dirs[None, :, None] * (leaf_r - leaf_l) >= 0
-            )
-            valid &= mono_ok
-        gain = jnp.where(valid, gain, -jnp.inf)
-
-        # ---- best cut per frontier slot --------------------------------- #
-        flat = gain.reshape(Ld, Fa * B)
-        best_idx = jnp.argmax(flat, axis=1)
-        best_gain = jnp.take_along_axis(flat, best_idx[:, None], 1)[:, 0]
-        best_f = (best_idx // B).astype(i32)
-        best_t = (best_idx % B).astype(i32)
-
-        do_split = active & jnp.isfinite(best_gain) & (best_gain > min_split_gain)
-        if children_in_frontier and 2 * Ld > L:
-            # Frontier overflow: keep the top-L/2 splits by gain, the rest
-            # become leaves (breadth-first analogue of the reference's
-            # best-first growth cap, training.cc:4580).
-            order_by_gain = jnp.argsort(
-                jnp.where(do_split, -best_gain, jnp.inf)
-            )
-            rank_by_gain = jnp.argsort(order_by_gain)
-            do_split &= rank_by_gain < (L // 2)
-
-        # ---- allocate children ------------------------------------------ #
-        # Node-capacity guard: children that would not fit in N become
-        # leaves. The masked-out slots form a suffix in cumsum order, so
-        # ranks of surviving slots are unchanged.
-        rank0 = jnp.cumsum(do_split.astype(i32)) - 1
-        do_split &= num_nodes + 2 * (rank0 + 1) <= N
-        split_rank = jnp.cumsum(do_split.astype(i32)) - 1  # [Ld]
-        nid = frontier_id[:Ld]
-        wid = jnp.where(do_split, nid, N)  # write index (trash when no split)
-        left_id = jnp.where(do_split, num_nodes + 2 * split_rank, N)
-        right_id = jnp.where(do_split, left_id + 1, N)
-
-        # Left-stats of the chosen cut (gather from the candidate cumsums).
-        chosen = jnp.take_along_axis(
-            left_all, best_f[:, None, None, None], axis=1
-        )[:, 0]  # [Ld, B, S]
-        left_stats = jnp.take_along_axis(
-            chosen, best_t[:, None, None], axis=1
-        )[:, 0]  # [Ld, S]
-        right_stats = parent - left_stats
-
-        is_set_split = best_f >= Fcand
-        # Direction column → (direction, real set-feature index).
-        set_dir = (best_f - Fcand) >= Fs      # False = asc, True = desc
-        fset = jnp.where(set_dir, best_f - Fcand - Fs, best_f - Fcand)
-        is_cat_split = (best_f >= Fn) & ~is_set_split
-        # Per-slot routing mask over bins: numerical → prefix of bin ids,
-        # categorical → prefix of the sorted order (rank <= cut) in the
-        # CHOSEN ordering's column.
-        if Fc > 0:
-            ranks_flat = ranks.reshape(Ld, Fc * O, B)
-            chosen_rank = jnp.take_along_axis(
-                ranks_flat,
-                jnp.clip(best_f - Fn, 0, Fc * O - 1)[:, None, None],
-                axis=1,
-            )[:, 0]  # [Ld, B]
-            go_left_bins = jnp.where(
-                is_cat_split[:, None],
-                chosen_rank <= best_t[:, None],
-                cut_ids[None, :] <= best_t[:, None],
-            )  # [Ld, B]
-        else:
-            go_left_bins = cut_ids[None, :] <= best_t[:, None]
-        if Fs > 0:
-            # Stored set mask: bit = item in the selected subset
-            # (rank <= cut in the chosen direction); intersecting
-            # examples go RIGHT.
-            fclip = jnp.clip(fset, 0, Fs - 1)[:, None, None]
-            cs0 = jnp.take_along_axis(sranks_dirs[0], fclip, axis=1)[:, 0]
-            cs1 = jnp.take_along_axis(sranks_dirs[1], fclip, axis=1)[:, 0]
-            chosen_srank = jnp.where(set_dir[:, None], cs1, cs0)  # [Ld, Vs]
-            sel = chosen_srank <= best_t[:, None]
-            Wb = 32 * W
-            if Vs < Wb:
-                sel = jnp.pad(sel, ((0, 0), (0, Wb - Vs)))
-            glb = go_left_bins
-            if B < Wb:
-                glb = jnp.pad(glb, ((0, 0), (0, Wb - B)))
-            store_mask = jnp.where(is_set_split[:, None], sel, glb)
-        else:
-            store_mask = go_left_bins
-
-        # The stored feature id collapses the two direction columns back
-        # onto the real feature block — offset by the UNPADDED scalar
-        # count (feature-parallel padding appends zero columns to `bins`;
-        # serving decodes set ids against the unpadded layout).
-        nvf = F if num_valid_features is None else num_valid_features
-        # Collapse ordering columns back onto the real categorical id and
-        # the set direction columns onto the real set id.
-        best_f_scalar = jnp.where(
-            is_cat_split, Fn + (best_f - Fn) // O, best_f
+        dec = layer_decide(
+            left_all, ranks, sranks_dirs if Fs > 0 else None,
+            parent, active, frontier_id[:Ld], num_nodes,
+            k_gain, k_feat, dirs, rule_ctx,
+            rule=rule, L=L, B=B, N=N, Fn=Fn, Fc=Fc, O=O, Fs=Fs, W=W,
+            min_examples=min_examples, min_split_gain=min_split_gain,
+            candidate_features=candidate_features,
+            num_valid_features=num_valid_features,
+            children_in_frontier=children_in_frontier,
         )
-        best_f_store = jnp.where(is_set_split, nvf + fset, best_f_scalar)
-        tree["feature"] = tree["feature"].at[wid].set(best_f_store)
+        do_split, split_rank = dec.do_split, dec.split_rank
+        wid, left_id, right_id = dec.wid, dec.left_id, dec.right_id
+        best_t = dec.best_t
+        is_set_split, fset, set_dir = (
+            dec.is_set_split, dec.fset, dec.set_dir
+        )
+        go_left_bins = dec.go_left_bins
+        left_stats, right_stats = dec.left_stats, dec.right_stats
+
+        tree["feature"] = tree["feature"].at[wid].set(dec.best_f_store)
         tree["threshold_bin"] = tree["threshold_bin"].at[wid].set(best_t)
-        tree["is_cat"] = tree["is_cat"].at[wid].set(is_cat_split)
+        tree["is_cat"] = tree["is_cat"].at[wid].set(dec.is_cat_split)
         tree["is_set"] = tree["is_set"].at[wid].set(is_set_split)
-        tree["cat_mask"] = tree["cat_mask"].at[wid].set(_pack_mask(store_mask))
+        tree["cat_mask"] = tree["cat_mask"].at[wid].set(
+            _pack_mask(dec.store_mask)
+        )
         tree["left"] = tree["left"].at[wid].set(left_id)
         tree["right"] = tree["right"].at[wid].set(right_id)
         tree["is_leaf"] = tree["is_leaf"].at[wid].set(False)
         tree["leaf_stats"] = tree["leaf_stats"].at[left_id].set(left_stats)
         tree["leaf_stats"] = tree["leaf_stats"].at[right_id].set(right_stats)
-        num_nodes = num_nodes + 2 * jnp.sum(do_split.astype(i32))
+        num_nodes = dec.num_nodes
 
         # ---- sibling-subtraction bookkeeping for the NEXT layer --------- #
         # Computed BEFORE routing so the fused native kernel can emit
@@ -730,34 +907,12 @@ def _grow_tree_jit(
         if children_in_frontier:
             Lh_next = min(Ld, L // 2)  # static bound on this layer's splits
             if hist_subtract and F > 0 and Lh_next >= 1:
-                # Index each split's data by its rank (children of rank s
-                # sit at slots 2s / 2s+1 next layer); rank Lh_next is the
-                # scatter trash row, sliced off.
-                ridx = jnp.where(do_split, split_rank, Lh_next)
-                parent_next = (
-                    jnp.zeros((Lh_next + 1, F, B, S), hist.dtype)
-                    .at[ridx].set(hist)[:Lh_next]
+                parent_next, small_is_left_next, Lh_next, hmap = (
+                    sibling_next_state(
+                        hist, do_split, split_rank, left_stats,
+                        right_stats, Ld=Ld, L=L,
+                    )
                 )
-                # Smaller child by the count-like last stat column (the
-                # same column the min_examples validity check uses). The
-                # choice only steers WORK, not results: parent − child is
-                # exact for any additive stats, so a skewed weighting
-                # costs speed, never correctness.
-                small_left = left_stats[:, -1] <= right_stats[:, -1]  # [Ld]
-                small_is_left_next = (
-                    jnp.zeros((Lh_next + 1,), jnp.bool_)
-                    .at[ridx].set(small_left)[:Lh_next]
-                )
-                tgt_l_pre = jnp.where(do_split, 2 * split_rank, L)
-                tgt_r_pre = jnp.where(do_split, 2 * split_rank + 1, L)
-                hmap = jnp.full((L + 1,), Lh_next, i32)
-                hmap = hmap.at[tgt_l_pre].set(
-                    jnp.where(do_split & small_left, split_rank, Lh_next)
-                )
-                hmap = hmap.at[tgt_r_pre].set(
-                    jnp.where(do_split & ~small_left, split_rank, Lh_next)
-                )
-                hmap = hmap.at[L].set(Lh_next)
                 next_sub = (parent_next, small_is_left_next, Lh_next)
 
         # ---- route examples --------------------------------------------- #
@@ -773,7 +928,7 @@ def _grow_tree_jit(
         # to be clipped into a NEIGHBORING feature's column — a
         # train-time mis-route for multiclass forests with 2+ categorical
         # features; tests/test_routing_native.py has the regression.)
-        route_f = jnp.clip(best_f_scalar, 0, max(F - 1, 0))
+        route_f = dec.route_f
         if Fs > 0:
             # Per-example set-split decision (shared by both routing
             # impls): not-contains (min rank beyond the cut) → LEFT.

@@ -1,0 +1,887 @@
+"""Feature-parallel distributed GBT training — the manager driver.
+
+Reproduces the reference's L4 distributed trainer
+(`ydf/learner/distributed_gradient_boosted_trees/`: a manager reduces
+per-feature best splits from workers that each own a feature slice of
+the dataset-cache, then broadcasts the chosen split for routing — the
+TF Boosted Trees exchange, arxiv 1710.11555) on top of this repo's
+hardened worker substrate (WorkerPool retry/backoff/quarantine,
+checksummed dataset cache, failpoints).
+
+Protocol per boosting tree (verbs in parallel/dist_worker.py):
+
+  tree start   manager computes gradients/stats from its own preds
+               (labels are replicated; the bins never leave the
+               workers), quantizes them once per tree on the grower's
+               exact per-tree int8/bf16x2 grid
+               (ops/grower.py:prepare_stats_for_hist — the
+               YDF_TPU_HIST_QUANT wire format: int8 ships 1 byte per
+               stat), and broadcasts them with the first
+               build_histograms of the tree.
+  per layer    1. build_histograms fan-out: worker k returns the
+                  [num_slots, F_k, B, S] histogram of its feature
+                  slice (under sibling subtraction only the
+                  smaller-child slots cross the wire — the halved
+                  reduced tensor). The request piggy-backs the
+                  PREVIOUS layer's routing broadcast.
+               2. the manager concatenates slices in shard order —
+                  bit-identical to the single-machine histogram,
+                  because every impl accumulates per-feature
+                  independently in fixed row order — and runs the
+                  grower's OWN split search on it
+                  (ops/grower.py:layer_decide, the shared seam).
+               3. apply_split fan-out to the workers owning split
+                  features: each returns the go-left bitmap of the
+                  rows it routed — only ONE worker routes per split.
+               4. the manager ORs the owner bitmaps, applies the
+                  routing to its authoritative slot/leaf state
+                  (dist_worker.apply_route_tables — exact integer
+                  bookkeeping shared with the workers), and carries
+                  the merged bitmap into the next layer's requests.
+  tree end     the manager updates its predictions from its own leaf
+               assignment; YDF_TPU_DIST_VERIFY=1 additionally asks one
+               worker for leaf_stats and cross-checks counts/sums.
+
+Fault tolerance: every RPC rides the pool's retry machinery, and shard
+ownership is DYNAMIC — a worker that times out (straggler,
+YDF_TPU_DIST_RPC_TIMEOUT_S), drops its connection, or restarts has its
+shards reassigned to the next healthy worker, which receives the shard
+plus the manager's authoritative mid-tree state (slot/leaf/stats/
+position) and resumes exactly where the lost worker stood; a corrupt
+cache shard is detected by the worker's crc check and re-sliced from
+the verified bins.npy (byte-identical). Failpoint sites
+dist.shard_load / dist.histogram_rpc / dist.split_broadcast inject
+faults into each exchange; the chaos suite asserts every recovery
+produces a bit-identical model (docs/distributed_training.md).
+
+Because the float split search runs ONLY on the manager — through the
+grower's own seam functions — and workers contribute exact per-feature
+histogram slices plus integer routing, the distributed model equals
+the single-machine model bit for bit (same chosen splits, same leaf
+values); tests/test_worker_dist_gbt.py asserts it across quant modes.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ydf_tpu.utils import failpoints, log, telemetry
+from ydf_tpu.utils.telemetry import LatencyHistogram
+
+
+class DistributedTrainingError(RuntimeError):
+    """Distributed training could not complete: every worker is
+    unreachable past the retry budget, or a worker reported a
+    non-recoverable protocol error."""
+
+
+def _parse_rpc_timeout() -> float:
+    """YDF_TPU_DIST_RPC_TIMEOUT_S — per-RPC deadline (straggler bound),
+    eagerly validated at import like YDF_TPU_HIST_IMPL. A worker that
+    does not answer within it is treated exactly like a dropped
+    connection: quarantined, and its shards reassigned."""
+    raw = os.environ.get("YDF_TPU_DIST_RPC_TIMEOUT_S")
+    if raw is None:
+        return 600.0
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"YDF_TPU_DIST_RPC_TIMEOUT_S={raw!r} is not a number of "
+            "seconds"
+        ) from None
+    if not v > 0:
+        raise ValueError(
+            f"YDF_TPU_DIST_RPC_TIMEOUT_S={raw} must be > 0"
+        )
+    return v
+
+
+def _parse_verify() -> bool:
+    """YDF_TPU_DIST_VERIFY — per-tree worker-state cross-check
+    (leaf_stats verb), eagerly validated."""
+    raw = os.environ.get("YDF_TPU_DIST_VERIFY")
+    if raw is None:
+        return False
+    low = raw.strip().lower()
+    if low in ("1", "true", "yes", "on"):
+        return True
+    if low in ("0", "false", "no", "off"):
+        return False
+    raise ValueError(
+        f"YDF_TPU_DIST_VERIFY={raw!r} is not a boolean; expected one of "
+        "1/0/true/false/yes/no/on/off"
+    )
+
+
+_RPC_TIMEOUT_S: float = _parse_rpc_timeout()
+_VERIFY: bool = _parse_verify()
+
+
+# ------------------------------------------------------------------ #
+# Jitted manager-side pieces. Each mirrors the exact op sequence the
+# single-machine boosting scan traces (learners/gbt.py boost_step and
+# ops/grower.py), so the compiled arithmetic matches bit for bit.
+# ------------------------------------------------------------------ #
+
+
+@functools.partial(jax.jit, static_argnames=("loss_obj", "n"))
+def _j_init(y_tr, w_tr, *, loss_obj, n):
+    y_f = y_tr.astype(jnp.float32)
+    init_pred = loss_obj.initial_predictions(y_f, w_tr)  # [K]
+    preds0 = jnp.broadcast_to(init_pred[None, :], (n, 1)).astype(
+        jnp.float32
+    )
+    return preds0, init_pred
+
+
+@functools.partial(
+    jax.jit, static_argnames=("loss_obj", "subsample", "hist_quant")
+)
+def _j_tree_prologue(y_tr, w_tr, preds, key, it, *, loss_obj, subsample,
+                     hist_quant):
+    """Gradients → sampled stats → per-tree quantized operand, with the
+    SAME ops and key evolution as the single-machine boost_step."""
+    from ydf_tpu.ops.grower import prepare_stats_for_hist
+
+    key, k_sub = jax.random.split(jax.random.fold_in(key, it))
+    g, h = loss_obj.grad_hess(y_tr, preds)  # [n, 1]
+    if subsample < 1.0:
+        m = jax.random.bernoulli(
+            k_sub, subsample, (y_tr.shape[0],)
+        ).astype(jnp.float32)
+    else:
+        m = jnp.ones((y_tr.shape[0],), jnp.float32)
+    w_eff = w_tr * m
+    stats = jnp.stack(
+        [g[:, 0] * w_eff, h[:, 0] * w_eff, w_eff], axis=1
+    )
+    kk = jax.random.fold_in(key, 0)  # K == 1: class column 0
+    hist_stats, qscale, total = prepare_stats_for_hist(stats, hist_quant)
+    return key, kk, hist_stats, qscale, total
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "rule", "L", "B", "N", "Fn", "Fc", "O", "min_examples",
+        "min_split_gain", "candidate_features", "num_valid_features",
+        "children", "subtract",
+    ),
+)
+def _j_layer_step(
+    hist, parent, active, nid, num_nodes, k_gain, k_feat, *,
+    rule, L, B, N, Fn, Fc, O, min_examples, min_split_gain,
+    candidate_features, num_valid_features, children, subtract,
+):
+    """One layer of the split search over the assembled [Ld, F, B, S]
+    histogram — scalar_candidates + layer_decide + (optionally) the
+    sibling bookkeeping, all straight from the grower's seam."""
+    from ydf_tpu.ops import grower
+
+    Ld = hist.shape[0]
+    left_all, ranks = grower.scalar_candidates(
+        hist, Fn=Fn, O=O, rule=rule, rule_ctx=None
+    )
+    dec = grower.layer_decide(
+        left_all, ranks, None, parent, active, nid, num_nodes,
+        k_gain, k_feat, None, None,
+        rule=rule, L=L, B=B, N=N, Fn=Fn, Fc=Fc, O=O, Fs=0,
+        W=(B + 31) // 32, min_examples=min_examples,
+        min_split_gain=min_split_gain,
+        candidate_features=candidate_features,
+        num_valid_features=num_valid_features,
+        children_in_frontier=children,
+    )
+    out = {"dec": dec, "mask": grower._pack_mask(dec.store_mask)}
+    if children and subtract and min(Ld, L // 2) >= 1:
+        parent_next, small_is_left, _Lh, hmap = grower.sibling_next_state(
+            hist, dec.do_split, dec.split_rank, dec.left_stats,
+            dec.right_stats, Ld=Ld, L=L,
+        )
+        out["sub"] = (parent_next, small_is_left)
+        out["hmap"] = hmap
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("Ld",))
+def _j_sibling_reconstruct(hist_small, parent_hist, small_is_left, *, Ld):
+    from ydf_tpu.ops.grower import sibling_reconstruct
+
+    return sibling_reconstruct(hist_small, parent_hist, small_is_left, Ld)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("rule", "loss_obj", "shrinkage")
+)
+def _j_tree_epilogue(leaf_stats, leaf_id, preds, y_tr, w_tr, *, rule,
+                     loss_obj, shrinkage):
+    """End-of-tree update: leaf values, prediction update, training
+    loss — the same gather/set/add chain as the single-machine
+    boost_step's K == 1 unfused path."""
+    lv_raw = rule.leaf_value(leaf_stats, None)  # [N, 1]
+    lv = lv_raw * shrinkage
+    n = leaf_id.shape[0]
+    new_contrib = jnp.zeros((n, 1), jnp.float32)
+    new_contrib = new_contrib.at[:, 0].set(lv[leaf_id, 0])
+    preds = preds + new_contrib
+    tl = loss_obj.loss(y_tr, preds, w_tr, tag="train")
+    return preds, lv, tl
+
+
+def _pad_to(a: np.ndarray, length: int, fill) -> np.ndarray:
+    out = np.full((length,) + a.shape[1:], fill, a.dtype)
+    out[: a.shape[0]] = a
+    return out
+
+
+class _DistStats:
+    """Always-on manager-side exchange accounting (the bench family's
+    source; mirrored into telemetry when it is armed)."""
+
+    def __init__(self):
+        self.rpc_ns: Dict[str, LatencyHistogram] = {}
+        self.reduce_bytes = 0
+        self.stats_bytes = 0
+        self.recoveries = 0
+        self.shard_rebuilds = 0
+
+    def observe_rpc(self, verb: str, dur_ns: int) -> None:
+        self.rpc_ns.setdefault(verb, LatencyHistogram()).observe_ns(dur_ns)
+        if telemetry.ENABLED:
+            telemetry.histogram(
+                "ydf_dist_rpc_latency_ns", verb=verb
+            ).observe_ns(dur_ns)
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "reduce_bytes": int(self.reduce_bytes),
+            "stats_bytes": int(self.stats_bytes),
+            "recoveries": int(self.recoveries),
+            "shard_rebuilds": int(self.shard_rebuilds),
+            "rpc_p50_ns": {
+                v: round(h.percentile_ns(50), 1)
+                for v, h in sorted(self.rpc_ns.items())
+            },
+            "rpc_count": {
+                v: int(h.count) for v, h in sorted(self.rpc_ns.items())
+            },
+        }
+
+
+class DistGBTManager:
+    """Drives one distributed GBT train over a WorkerPool + feature-
+    sharded DatasetCache. See the module docstring for the protocol."""
+
+    def __init__(
+        self, pool, cache, *, loss_obj, rule, tree_cfg, num_trees: int,
+        shrinkage: float, subsample: float, candidate_features: int,
+        num_numerical: int, seed: int, hist_impl: str,
+        hist_subtract: bool, hist_quant: str,
+        min_split_gain: float = 1e-9,
+        rpc_timeout_s: Optional[float] = None,
+        verify: Optional[bool] = None,
+    ):
+        self.pool = pool
+        self.cache = cache
+        self.loss_obj = loss_obj
+        self.rule = rule
+        self.cfg = tree_cfg
+        self.num_trees = num_trees
+        self.shrinkage = float(shrinkage)
+        self.subsample = float(subsample)
+        self.candidate_features = int(candidate_features)
+        self.seed = seed
+        self.hist_impl = hist_impl
+        self.hist_subtract = bool(hist_subtract)
+        self.hist_quant = hist_quant
+        self.min_split_gain = float(min_split_gain)
+        self.rpc_timeout_s = (
+            _RPC_TIMEOUT_S if rpc_timeout_s is None else rpc_timeout_s
+        )
+        self.verify = _VERIFY if verify is None else verify
+
+        self.num_shards = cache._require_shards()
+        self.col_ranges = [
+            cache.shard_col_range(k) for k in range(self.num_shards)
+        ]
+        self.F = cache.binner.num_scalar
+        self.Fn = int(num_numerical)
+        self.Fc = self.F - self.Fn
+        self.n = cache.num_rows
+        self.key_id = f"dist-{uuid.uuid4().hex[:12]}"
+        # Dynamic shard ownership: shard k starts on worker k % W and
+        # moves on failure (the recovery path re-ships shard + state).
+        self.owner: List[int] = [
+            k % len(pool.addresses) for k in range(self.num_shards)
+        ]
+        self.stats = _DistStats()
+        # Manager-side authoritative per-example state (what makes a
+        # lost worker recoverable mid-tree).
+        self.slot = np.zeros(self.n, np.int32)
+        self.hist_slot = np.zeros(self.n, np.int32)
+        self.leaf_id = np.zeros(self.n, np.int32)
+        self.pos = (-1, 0)
+        self.cur_hist_stats: Optional[np.ndarray] = None
+        self.cur_qscale: Optional[np.ndarray] = None
+
+    # ---- RPC plumbing ------------------------------------------------ #
+
+    def _request(self, widx: int, req: Dict[str, Any], site: str):
+        """One RPC with failpoint injection + latency accounting.
+        Transport failures (including the straggler timeout) raise
+        ConnectionError/OSError for the caller's recovery logic."""
+        failpoints.hit(site)
+        t0 = time.perf_counter_ns()
+        resp = self.pool.request(
+            widx, req, timeout_s=self.rpc_timeout_s
+        )
+        self.stats.observe_rpc(req["verb"], time.perf_counter_ns() - t0)
+        return resp
+
+    def _state_payload(self) -> Dict[str, Any]:
+        return {
+            "slot": self.slot, "hist_slot": self.hist_slot,
+            "leaf_id": self.leaf_id, "pos": self.pos,
+            "hist_stats": self.cur_hist_stats,
+            "qscale": self.cur_qscale,
+        }
+
+    def _pick_replacement(self, after: int) -> int:
+        """Next healthy worker for a reassigned shard, waiting out
+        quarantines with the pool's jittered backoff. Raises when the
+        whole fleet stays unreachable past the retry budget."""
+        for attempt in range(self.pool.retry_attempts):
+            idx = self.pool.pick_worker(after)
+            if idx is not None:
+                return idx
+            time.sleep(self.pool.backoff_delay(attempt))
+        raise DistributedTrainingError(
+            "no reachable worker to take over a feature shard "
+            f"(all {len(self.pool.addresses)} quarantined)"
+        )
+
+    def _load_shards(self, widx: int, sids: List[int],
+                     with_state: bool) -> int:
+        """Delivers shards (plus, on recovery, the authoritative state)
+        to a worker; on transport failure moves on to the next healthy
+        worker; on a corruption report re-slices the shard from the
+        verified bins.npy (byte-identical) and retries. Returns the
+        worker index that ended up owning the shards."""
+        rebuilt = False
+        for attempt in range(self.pool.retry_attempts):
+            req = {
+                "verb": "load_cache_shard", "key": self.key_id,
+                "shards": list(sids), "cache_dir": self.cache.path,
+            }
+            if with_state:
+                req["state"] = self._state_payload()
+            try:
+                resp = self._request(widx, req, "dist.shard_load")
+            except (OSError, ConnectionError) as e:
+                log.debug(
+                    f"dist: shard load on {self.pool.addr_str(widx)} "
+                    f"failed ({e}); reassigning"
+                )
+                self.pool.mark_failed(widx)
+                self.stats.recoveries += 1
+                widx = self._pick_replacement(widx + 1)
+                continue
+            if resp.get("ok"):
+                self.pool.mark_ok(widx)
+                for sid in sids:
+                    self.owner[sid] = widx
+                return widx
+            if resp.get("corrupt") and not rebuilt:
+                # Worker-side crc caught a corrupt slice: re-slice it
+                # from the (fully verified) bins.npy and try again —
+                # the rebuilt bytes are identical, so training stays
+                # bit-identical.
+                log.info(
+                    f"dist: cache shard(s) {sids} corrupt on load "
+                    f"({resp.get('error')}); rebuilding from bins.npy"
+                )
+                if telemetry.ENABLED:
+                    telemetry.counter(
+                        "ydf_dist_shard_corruption_total"
+                    ).inc()
+                for sid in sids:
+                    self.cache.rebuild_feature_shard(sid)
+                self.stats.shard_rebuilds += len(sids)
+                rebuilt = True
+                continue
+            raise DistributedTrainingError(
+                f"worker {self.pool.addr_str(widx)} failed shard load: "
+                f"{resp}"
+            )
+        raise DistributedTrainingError(
+            f"could not place shards {sids} on any worker within "
+            f"{self.pool.retry_attempts} attempts"
+        )
+
+    def _fan_out(self, groups: Dict[int, List[int]], make_req, site: str):
+        """Concurrent per-worker RPCs (the workers compute their
+        histogram slices in parallel); results are handled in sorted
+        worker order so recovery decisions stay deterministic. Returns
+        [(widx, sids, resp_or_exception)]."""
+        order = sorted(groups)
+        with ThreadPoolExecutor(max_workers=max(len(order), 1)) as ex:
+            futs = {
+                w: ex.submit(self._request, w, make_req(groups[w]), site)
+                for w in order
+            }
+            out = []
+            for w in order:
+                try:
+                    out.append((w, groups[w], futs[w].result()))
+                except BaseException as e:  # noqa: BLE001 — re-raised below
+                    out.append((w, groups[w], e))
+        return out
+
+    def _groups(self, sids) -> Dict[int, List[int]]:
+        g: Dict[int, List[int]] = {}
+        for sid in sids:
+            g.setdefault(self.owner[sid], []).append(sid)
+        return g
+
+    def _handle_failure(self, widx: int, sids: List[int]) -> None:
+        """Transport failure / straggler timeout on `widx`: quarantine
+        it and move its shards (with the authoritative state) to the
+        next healthy worker — the reference's worker-reassignment
+        semantics."""
+        self.pool.mark_failed(widx)
+        self.stats.recoveries += 1
+        if telemetry.ENABLED:
+            telemetry.counter("ydf_dist_recoveries_total").inc()
+        new_w = self._pick_replacement(widx + 1)
+        self._load_shards(new_w, sids, with_state=True)
+
+    def _exchange(self, sids: List[int], make_req, site: str,
+                  on_ok) -> None:
+        """Generic resilient fan-out: retries each shard group through
+        failures, reassignments, and worker-restart need_shard replies
+        until every shard in `sids` has answered."""
+        pending = set(sids)
+        for _attempt in range(4 * self.pool.retry_attempts):
+            if not pending:
+                return
+            for widx, group, resp in self._fan_out(
+                self._groups(sorted(pending)), make_req, site
+            ):
+                if isinstance(resp, failpoints.FailpointError):
+                    raise resp
+                if isinstance(resp, BaseException):
+                    if not isinstance(resp, (OSError, ConnectionError)):
+                        raise resp
+                    self._handle_failure(widx, group)
+                    continue
+                if resp.get("need_shard"):
+                    # Worker restarted in place: re-ship shard + state
+                    # to the SAME address and retry.
+                    self.stats.recoveries += 1
+                    self._load_shards(widx, group, with_state=True)
+                    continue
+                if not resp.get("ok"):
+                    raise DistributedTrainingError(
+                        f"worker {self.pool.addr_str(widx)} failed "
+                        f"{site}: {resp}"
+                    )
+                on_ok(widx, group, resp)
+                pending -= set(group)
+        raise DistributedTrainingError(
+            f"shards {sorted(pending)} unanswered after retries ({site})"
+        )
+
+    # ---- the training loop ------------------------------------------ #
+
+    def train(self):
+        """Runs the boosting loop; returns (stacked TreeArrays
+        [T, 1, ...], leaf_values [T, 1, N, 1], logs) in the exact
+        layout learners/gbt.py:_train_gbt produces."""
+        cfg = self.cfg
+        L, B, N = cfg.frontier, cfg.num_bins, cfg.max_nodes
+        D = cfg.max_depth
+        S = self.rule.num_stats
+        labels = np.asarray(self.cache.labels)
+        w = self.cache.sample_weights
+        w_tr = (
+            np.asarray(w, np.float32) if w is not None
+            else np.ones((self.n,), np.float32)
+        )
+        y_j = jnp.asarray(labels)
+        w_j = jnp.asarray(w_tr)
+
+        t0_ns = time.perf_counter_ns()
+        # Keep going with the workers that answer (reference distribute
+        # semantics); raises only when NONE does. Shard ownership is
+        # (re)computed over the pruned rotation.
+        self.pool.ping_all(drop_unreachable=True)
+        self.owner = [
+            k % len(self.pool.addresses) for k in range(self.num_shards)
+        ]
+        # Initial shard placement: shard k → worker k % W.
+        for widx, sids in self._groups(range(self.num_shards)).items():
+            self._load_shards(widx, sids, with_state=False)
+
+        preds, init_pred = _j_init(
+            y_j, w_j, loss_obj=self.loss_obj, n=self.n
+        )
+        key = jax.random.PRNGKey(self.seed)
+        trees_acc: List[Dict[str, np.ndarray]] = []
+        lvs_acc: List[np.ndarray] = []
+        tls: List[float] = []
+
+        for it in range(self.num_trees):
+            with telemetry.span("dist.tree") as sp:
+                if telemetry.ENABLED:
+                    sp.set(iteration=it)
+                preds, key, tree_np, lv, tl = self._train_tree(
+                    it, key, preds, y_j, w_j, L, B, N, D, S
+                )
+            trees_acc.append(tree_np)
+            lvs_acc.append(np.asarray(lv))
+            tls.append(float(tl))
+            if log.is_debug():
+                log.debug(
+                    f"dist gbt: iter {it + 1}/{self.num_trees} "
+                    f"train_loss={tls[-1]:.6g}"
+                )
+
+        wall_ns = time.perf_counter_ns() - t0_ns
+        from ydf_tpu.ops.grower import TreeArrays
+
+        def stack(field):
+            return jnp.asarray(
+                np.stack([t[field] for t in trees_acc])[:, None]
+            )  # [T, K=1, ...]
+
+        forest_stacked = TreeArrays(
+            feature=stack("feature"),
+            threshold_bin=stack("threshold_bin"),
+            is_cat=stack("is_cat"),
+            is_set=stack("is_set"),
+            cat_mask=stack("cat_mask"),
+            left=stack("left"),
+            right=stack("right"),
+            is_leaf=stack("is_leaf"),
+            leaf_stats=stack("leaf_stats"),
+            num_nodes=jnp.asarray(
+                np.asarray([t["num_nodes"] for t in trees_acc])[:, None]
+            ),
+        )
+        leaf_values = jnp.asarray(np.stack(lvs_acc)[:, None])  # [T,1,N,1]
+        T = self.num_trees
+        logs = {
+            "train_loss": np.asarray(tls, np.float32),
+            "valid_loss": np.zeros((T,), np.float32),
+            "initial_predictions": np.asarray(init_pred),
+            "oblique_w": np.zeros((T, 0, 0), np.float32),
+            "oblique_b": np.zeros((T, 0, B - 1), np.float32),
+            "vs_a": np.zeros((T, 0, 0), np.float32),
+            "vs_b": np.zeros((T, 0, 0), np.float32),
+            "chunk_walls": [(0, T, t0_ns, wall_ns)],
+            "distributed": {
+                "workers": len(self.pool.addresses),
+                "feature_shards": self.num_shards,
+                "hist_quant": self.hist_quant,
+                **self.stats.summary(),
+            },
+        }
+        return forest_stacked, leaf_values, logs
+
+    def _train_tree(self, it, key, preds, y_j, w_j, L, B, N, D, S):
+        key, kk, hist_stats, qscale, total = _j_tree_prologue(
+            y_j, w_j, preds, key, it,
+            loss_obj=self.loss_obj, subsample=self.subsample,
+            hist_quant=self.hist_quant,
+        )
+        self.cur_hist_stats = np.asarray(hist_stats)
+        self.cur_qscale = None if qscale is None else np.asarray(qscale)
+        self.stats.stats_bytes += self.cur_hist_stats.nbytes
+        if telemetry.ENABLED:
+            telemetry.counter("ydf_dist_stats_bytes_total").inc(
+                self.cur_hist_stats.nbytes
+            )
+        total_np = np.asarray(total)
+
+        # Per-tree manager state (mirrors _grow_tree_jit's init).
+        i32 = np.int32
+        W_words = (B + 31) // 32
+        tree = {
+            "feature": np.full((N + 1,), -1, i32),
+            "threshold_bin": np.zeros((N + 1,), i32),
+            "is_cat": np.zeros((N + 1,), bool),
+            "is_set": np.zeros((N + 1,), bool),
+            "cat_mask": np.zeros((N + 1, W_words), np.uint32),
+            "left": np.zeros((N + 1,), i32),
+            "right": np.zeros((N + 1,), i32),
+            "is_leaf": np.ones((N + 1,), bool),
+            "leaf_stats": np.zeros((N + 1, S), np.float32),
+        }
+        tree["leaf_stats"][0] = total_np
+        frontier_id = np.full((L + 1,), N, i32)
+        frontier_id[0] = 0
+        node_stats = np.zeros((L + 1, S), np.float32)
+        node_stats[0] = total_np
+        self.slot[:] = 0
+        self.hist_slot[:] = 0
+        self.leaf_id[:] = 0
+        self.pos = (it, 0)
+        num_nodes = jnp.asarray(1, jnp.int32)
+        sub_state = None  # (parent_hist jnp, small_is_left jnp, Lh)
+        pending_route = None
+        key_t = kk
+
+        from ydf_tpu.parallel.dist_worker import (
+            apply_route_tables,
+            pack_bits,
+        )
+
+        for depth in range(D):
+            key_t, k_gain, k_feat = jax.random.split(
+                jax.random.fold_in(key_t, depth), 3
+            )
+            children = depth + 1 < D
+            Ld = min(2 ** depth, L)
+
+            # ---- 1. histogram gather (workers, feature-sliced) ----- #
+            if sub_state is not None:
+                _ph, _sil, Lh = sub_state
+                num_slots = Lh
+                compact = (
+                    (self.n // 2 + Lh + 8)
+                    if self.hist_impl == "segment" else 0
+                )
+            else:
+                num_slots = Ld
+                compact = 0
+            base_req = {
+                "verb": "build_histograms", "key": self.key_id,
+                "tree": it, "layer": depth, "reset": depth == 0,
+                "num_slots": num_slots, "num_bins": B,
+                "impl": self.hist_impl, "quant": self.hist_quant,
+                "compact": compact,
+            }
+            if depth == 0:
+                base_req["stats"] = {
+                    "hist_stats": self.cur_hist_stats,
+                    "qscale": self.cur_qscale,
+                }
+            if pending_route is not None:
+                base_req["route"] = pending_route
+
+            slices: Dict[int, np.ndarray] = {}
+
+            def on_hist(widx, group, resp, _slices=slices):
+                for k, h in resp["hists"].items():
+                    _slices[int(k)] = h
+                    self.stats.reduce_bytes += h.nbytes
+                if telemetry.ENABLED:
+                    telemetry.counter(
+                        "ydf_dist_reduce_bytes_total"
+                    ).inc(sum(h.nbytes for h in resp["hists"].values()))
+
+            self._exchange(
+                list(range(self.num_shards)),
+                lambda sids, _r=base_req: {**_r, "shards": sids},
+                "dist.histogram_rpc",
+                on_hist,
+            )
+            hist_np = np.concatenate(
+                [slices[k] for k in range(self.num_shards)], axis=1
+            )  # [num_slots, F, B, S] — shard order == feature order
+
+            if sub_state is not None:
+                parent_hist, small_is_left, Lh = sub_state
+                hist = _j_sibling_reconstruct(
+                    jnp.asarray(hist_np), parent_hist, small_is_left,
+                    Ld=Ld,
+                )
+            else:
+                hist = jnp.asarray(hist_np)
+
+            # ---- 2. split search (the grower's shared seam) -------- #
+            out = _j_layer_step(
+                hist, jnp.asarray(node_stats[:Ld]),
+                jnp.asarray(frontier_id[:Ld] < N),
+                jnp.asarray(frontier_id[:Ld]), num_nodes,
+                k_gain, k_feat,
+                rule=self.rule, L=L, B=B, N=N, Fn=self.Fn, Fc=self.Fc,
+                O=1, min_examples=self.cfg.min_examples,
+                min_split_gain=self.min_split_gain,
+                candidate_features=self.candidate_features,
+                num_valid_features=None, children=children,
+                subtract=self.hist_subtract,
+            )
+            dec = out["dec"]
+            num_nodes = dec.num_nodes
+            do_split = np.asarray(dec.do_split)
+            split_rank = np.asarray(dec.split_rank)
+            wid = np.asarray(dec.wid)
+            left_id = np.asarray(dec.left_id)
+            right_id = np.asarray(dec.right_id)
+            left_stats = np.asarray(dec.left_stats)
+            right_stats = np.asarray(dec.right_stats)
+            route_f = np.asarray(dec.route_f)
+            go_left_bins = np.asarray(dec.go_left_bins)
+
+            # ---- 3. node writes (manager-side tree arrays) --------- #
+            tree["feature"][wid] = np.asarray(dec.best_f_store)
+            tree["threshold_bin"][wid] = np.asarray(dec.best_t)
+            tree["is_cat"][wid] = np.asarray(dec.is_cat_split)
+            tree["is_set"][wid] = np.asarray(dec.is_set_split)
+            tree["cat_mask"][wid] = np.asarray(out["mask"])
+            tree["left"][wid] = left_id
+            tree["right"][wid] = right_id
+            tree["is_leaf"][wid] = False
+            tree["leaf_stats"][left_id] = left_stats
+            tree["leaf_stats"][right_id] = right_stats
+            # Trash row N collects every masked write; re-pin it.
+            tree["feature"][N] = -1
+            tree["is_leaf"][N] = True
+
+            # ---- 4. split broadcast / owner routing ---------------- #
+            hmap_np = (
+                np.asarray(out["hmap"]) if "hmap" in out
+                else np.arange(L + 1, dtype=i32)
+            )
+            tables = {
+                "L": L, "children": children,
+                "do_split": _pad_to(do_split, L + 1, False),
+                "route_f": _pad_to(route_f, L + 1, 0),
+                "go_left_bins": _pad_to(go_left_bins, L + 1, False),
+                "left_id": _pad_to(left_id, L + 1, N),
+                "right_id": _pad_to(right_id, L + 1, N),
+                "split_rank": _pad_to(split_rank, L + 1, 0),
+                "hmap": hmap_np,
+            }
+            merged = np.zeros(self.n, bool)
+            # Only shards owning a split feature route ("only one
+            # worker routes per split"); others receive the merged
+            # bitmap with the next layer's histogram request.
+            routing_sids = [
+                sid for sid, (lo, hi) in enumerate(self.col_ranges)
+                if np.any(do_split & (route_f >= lo) & (route_f < hi))
+            ]
+            split_req = {
+                "verb": "apply_split", "key": self.key_id,
+                "tree": it, "layer": depth,
+                "tables": {
+                    "do_split": tables["do_split"],
+                    "route_f": tables["route_f"],
+                    "go_left_bins": tables["go_left_bins"],
+                },
+            }
+
+            def on_bits(widx, group, resp, _m=merged):
+                from ydf_tpu.parallel.dist_worker import unpack_bits
+
+                _m |= unpack_bits(resp["bits"], self.n)
+
+            if routing_sids:
+                self._exchange(
+                    routing_sids,
+                    lambda sids, _r=split_req: {**_r, "shards": sids},
+                    "dist.split_broadcast",
+                    on_bits,
+                )
+            self.slot, self.leaf_id, self.hist_slot = apply_route_tables(
+                self.slot, self.leaf_id, merged, tables
+            )
+            self.pos = (it, depth + 1)
+            pending_route = {
+                "tables": tables, "go_left": pack_bits(merged)
+            }
+
+            # ---- 5. frontier + sibling carry for the next layer ---- #
+            if children:
+                tgt_l = np.where(do_split, 2 * split_rank, L)
+                tgt_r = np.where(do_split, 2 * split_rank + 1, L)
+                frontier_id = np.full((L + 1,), N, i32)
+                frontier_id[tgt_l] = left_id
+                frontier_id[tgt_r] = right_id
+                frontier_id[L] = N
+                node_stats = np.zeros((L + 1, S), np.float32)
+                node_stats[tgt_l] = left_stats
+                node_stats[tgt_r] = right_stats
+                node_stats[L] = 0.0
+                if "sub" in out:
+                    parent_next, small_next = out["sub"]
+                    sub_state = (
+                        parent_next, small_next, min(Ld, L // 2)
+                    )
+                else:
+                    sub_state = None
+
+        # ---- tree end: verify (optional) + prediction update -------- #
+        if self.verify:
+            self._verify_tree(it, D, N, pending_route, tree)
+        nn = int(np.asarray(num_nodes))
+        preds, lv, tl = _j_tree_epilogue(
+            jnp.asarray(tree["leaf_stats"][:N]),
+            jnp.asarray(self.leaf_id), preds, y_j, w_j,
+            rule=self.rule, loss_obj=self.loss_obj,
+            shrinkage=self.shrinkage,
+        )
+        tree_np = {k: v[:N] for k, v in tree.items()}
+        tree_np["num_nodes"] = np.asarray(nn, i32)
+        return preds, key, tree_np, np.asarray(lv), tl
+
+    def _verify_tree(self, it, D, N, final_route, tree) -> None:
+        """YDF_TPU_DIST_VERIFY: ask the worker owning shard 0 for its
+        leaf assignment digest and per-leaf sums; a drifted worker is a
+        protocol bug, surfaced loudly (never silently wrong trees)."""
+        req = {
+            "verb": "leaf_stats", "key": self.key_id,
+            "tree": it, "layer": D, "route": final_route,
+            "num_nodes_cap": N + 1,
+        }
+        resp = None
+
+        def on_leaf(widx, group, r):
+            nonlocal resp
+            resp = r
+
+        self._exchange([0], lambda sids: req, "dist.split_broadcast",
+                       on_leaf)
+        import zlib
+
+        want_crc = zlib.crc32(np.ascontiguousarray(self.leaf_id).tobytes())
+        if resp["leaf_crc"] != want_crc:
+            raise DistributedTrainingError(
+                f"worker leaf assignment diverged on tree {it}: "
+                f"crc {resp['leaf_crc']:#x} != manager {want_crc:#x}"
+            )
+        counts = np.bincount(self.leaf_id, minlength=N + 1)
+        if not np.array_equal(resp["leaf_counts"], counts):
+            raise DistributedTrainingError(
+                f"worker per-leaf counts diverged on tree {it}"
+            )
+        sums = resp.get("leaf_sums")
+        if sums is not None:
+            # Histogram-algebra leaf stats vs the worker's direct
+            # per-row sums: same values up to float association (NOT
+            # bit-compared), and only at populated LEAF nodes — the
+            # manager array also carries internal-node stats.
+            leafy = counts > 0
+            mine = tree["leaf_stats"][: N + 1].astype(np.float64)[leafy]
+            theirs = np.asarray(sums)[leafy]
+            scale = np.maximum(np.abs(mine), 1.0)
+            if not np.allclose(
+                theirs / scale, mine / scale, atol=1e-3
+            ):
+                raise DistributedTrainingError(
+                    f"worker per-leaf stat sums diverged on tree {it}"
+                )
+
+    def shutdown(self) -> None:
+        pass  # workers are shared infrastructure; the manager owns no fleet

@@ -1,0 +1,361 @@
+"""Worker half of feature-parallel distributed GBT training.
+
+Counterpart of the reference's distributed-decision-tree workers
+(`ydf/learner/distributed_gradient_boosted_trees/worker.cc`: each worker
+loads its dataset-cache columns, answers per-layer histogram requests,
+and applies the manager's chosen splits). The manager half — split
+reduction, broadcast, recovery — lives in `parallel/dist_gbt.py`; this
+module only holds per-key worker state and the four verb handlers the
+RPC service (`parallel/worker_service.py`) dispatches to:
+
+  load_cache_shard   load the binned column slices of one or more
+                     feature shards (from a shared dataset cache, or
+                     inline bytes when there is no shared filesystem),
+                     plus — on recovery — the manager's authoritative
+                     mid-tree state (slot/leaf/stats), so a replacement
+                     worker resumes exactly where the lost one stood.
+  build_histograms   one layer's [num_slots, Fk, B, S] histogram over
+                     the worker's feature slices, with the existing
+                     native/quantized kernels (ops/histogram.py). The
+                     request may carry the previous layer's routing
+                     (tables + the MERGED go-left bitmap — this worker
+                     does not recompute decisions it doesn't own) and,
+                     at tree start, the tree's (quantized) gradient
+                     stats.
+  apply_split        compute the go-left bit of every example whose
+                     frontier slot splits on a feature THIS worker
+                     owns — the "only one worker routes per split"
+                     half of the exchange. Returns a packed bitmap.
+  leaf_stats         apply the final layer's routing and return
+                     per-leaf example counts and (dequantized) stat
+                     sums plus state checksums — the manager's
+                     cross-check that worker state never drifted
+                     (used after recovery and by YDF_TPU_DIST_VERIFY).
+
+Everything here is exact integer/bool bookkeeping plus calls into the
+shared histogram kernels; the float split search happens only on the
+manager, which is what makes the distributed build bit-identical to the
+single-machine grower (docs/distributed_training.md).
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+VERBS = frozenset(
+    {"load_cache_shard", "build_histograms", "apply_split", "leaf_stats"}
+)
+
+# Worker-side distributed state, keyed by (worker instance id, manager
+# run key) — resident across requests like the tuner's _DATA_CACHE (the
+# reference workers keep their dataset cache resident the same way).
+# The worker-id half of the key matters for IN-PROCESS fleets (tests,
+# bench): several workers of one process must hold separate slot/leaf
+# arrays, exactly like separate worker processes would — a shared state
+# would let two workers' threads double-apply one routing transition.
+_STATE: Dict[tuple, "_DistState"] = {}
+_STATE_CAP = 8
+_STATE_LOCK = threading.Lock()
+
+
+class _ShardSlice:
+    __slots__ = ("lo", "hi", "bins")
+
+    def __init__(self, lo: int, hi: int, bins: np.ndarray):
+        self.lo = int(lo)
+        self.hi = int(hi)
+        self.bins = np.ascontiguousarray(bins, dtype=np.uint8)
+
+
+class _DistState:
+    def __init__(self, n: int):
+        self.n = int(n)
+        # Serializes handlers touching this state: one manager sends
+        # one request per worker at a time, but recovery replays can
+        # overlap a straggling original — mutations must not interleave.
+        self.lock = threading.Lock()
+        self.shards: Dict[int, _ShardSlice] = {}
+        self.slot = np.zeros(n, np.int32)
+        self.hist_slot = np.zeros(n, np.int32)
+        self.leaf_id = np.zeros(n, np.int32)
+        self.hist_stats: Optional[np.ndarray] = None
+        self.qscale: Optional[np.ndarray] = None
+        # (tree index, routing steps applied within it) — the manager
+        # stamps every request with its target position, so a request
+        # REPLAYED after a recovery re-ship (whose state already
+        # includes the transition) is detected and never double-applies
+        # a routing update, and a genuinely out-of-sync worker answers
+        # need_shard instead of producing silent garbage.
+        self.pos = (-1, 0)
+
+
+def pack_bits(bits: np.ndarray) -> bytes:
+    """bool [n] → packed little-bit-order bytes (the wire bitmap)."""
+    return np.packbits(bits.astype(np.uint8), bitorder="little").tobytes()
+
+
+def unpack_bits(data: bytes, n: int) -> np.ndarray:
+    return (
+        np.unpackbits(
+            np.frombuffer(data, np.uint8), count=n, bitorder="little"
+        ).astype(bool)
+    )
+
+
+def apply_route_tables(
+    slot: np.ndarray, leaf_id: np.ndarray, go_left: np.ndarray,
+    tables: Dict[str, np.ndarray],
+):
+    """The per-layer routing update as exact integer/bool numpy — the
+    same chain the grower's XLA routing applies (ops/grower.py "route
+    examples"): rows in a splitting slot move to the child their merged
+    go-left bit selects; others keep their state. Shared by the manager
+    (which merges the owner bitmaps) and every worker (which receives
+    the merged bitmap) so all parties hold identical state by
+    construction. Returns (new_slot, new_leaf_id, new_hist_slot).
+    Tables are padded to [L+1] (slot L = retired)."""
+    L = int(tables["L"])
+    do_split = tables["do_split"]
+    split_e = do_split[slot]
+    child = np.where(
+        go_left, tables["left_id"][slot], tables["right_id"][slot]
+    )
+    new_leaf = np.where(split_e, child, leaf_id).astype(np.int32)
+    if tables["children"]:
+        sr = tables["split_rank"][slot]
+        child_slot = np.where(go_left, 2 * sr, 2 * sr + 1)
+        new_slot = np.where(split_e, child_slot, L).astype(np.int32)
+        new_hist = tables["hmap"][new_slot].astype(np.int32)
+    else:
+        new_slot = np.full(slot.shape, L, np.int32)
+        new_hist = new_slot
+    return new_slot, new_leaf, new_hist
+
+
+def _dequantized_stats(st: _DistState) -> np.ndarray:
+    """The f32 per-example stats grid the tree is being grown on —
+    exact dequantization of whatever operand the manager shipped
+    (mirrors ops/grower.py's stats_set expressions)."""
+    hs = st.hist_stats
+    if hs.dtype == np.int8:
+        return hs.astype(np.float32) * st.qscale[None, :].astype(
+            np.float32
+        )
+    import ml_dtypes  # jax dependency; carries numpy's bfloat16
+
+    if hs.dtype == ml_dtypes.bfloat16:  # [n, 2S] high/residual halves
+        S = hs.shape[1] // 2
+        return hs[:, :S].astype(np.float32) + hs[:, S:].astype(np.float32)
+    return np.asarray(hs, np.float32)
+
+
+def _get_state(worker_id: str, key: str) -> Optional[_DistState]:
+    with _STATE_LOCK:
+        return _STATE.get((worker_id, key))
+
+
+def _need(msg: str) -> Dict[str, Any]:
+    # need_shard mirrors the tuner protocol's need_data: the manager
+    # re-ships the shard (plus its authoritative state) and retries.
+    return {"ok": False, "need_shard": True, "error": msg}
+
+
+def _load_cache_shard(req: Dict[str, Any], worker_id: str) -> Dict[str, Any]:
+    key = req["key"]
+    shard_ids = list(req["shards"])
+    if "cache_dir" in req:
+        from ydf_tpu.dataset.cache import CacheCorruptionError, DatasetCache
+
+        try:
+            cache = DatasetCache(req["cache_dir"], verify="off")
+            slices = {}
+            for k in shard_ids:
+                lo, hi = cache.shard_col_range(k)
+                # Per-shard crc verification at load: a corrupt slice
+                # must surface HERE (the manager rebuilds it from
+                # bins.npy), never as garbage histograms.
+                slices[k] = _ShardSlice(
+                    lo, hi, np.asarray(cache.shard_bins(k, verify=True))
+                )
+            n = cache.num_rows
+        except CacheCorruptionError as e:
+            return {"ok": False, "corrupt": True, "error": str(e)}
+    else:
+        slices = {
+            int(k): _ShardSlice(v["lo"], v["hi"], v["bins"])
+            for k, v in req["shard_data"].items()
+        }
+        n = int(req["n"])
+    with _STATE_LOCK:
+        st = _STATE.get((worker_id, key))
+        if st is None or st.n != n:
+            while len(_STATE) >= _STATE_CAP:
+                _STATE.pop(next(iter(_STATE)))
+            st = _STATE[(worker_id, key)] = _DistState(n)
+    with st.lock:
+        st.shards.update(slices)
+        state = req.get("state")
+        if state is not None:
+            # Recovery re-ship: adopt the manager's authoritative
+            # mid-tree state so this (new or restarted) worker resumes
+            # exactly where the lost one stood.
+            st.slot = np.asarray(state["slot"], np.int32).copy()
+            st.hist_slot = np.asarray(state["hist_slot"], np.int32).copy()
+            st.leaf_id = np.asarray(state["leaf_id"], np.int32).copy()
+            st.pos = tuple(state["pos"])
+            if state.get("hist_stats") is not None:
+                st.hist_stats = np.asarray(state["hist_stats"])
+                qs = state.get("qscale")
+                st.qscale = None if qs is None else np.asarray(qs)
+        return {"ok": True, "n": n, "shards": sorted(st.shards)}
+
+
+def _sync_to(st: _DistState, req: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Advances worker state to the request's (tree, layer) position:
+    applies the carried routing when the worker is exactly one step
+    behind, recognizes an already-applied transition (recovery replay)
+    as a no-op, and reports need_shard on any other gap. Returns an
+    error response or None."""
+    tree, layer = int(req["tree"]), int(req["layer"])
+    if req.get("reset"):
+        st.slot[:] = 0
+        st.hist_slot[:] = 0
+        st.leaf_id[:] = 0
+        st.pos = (tree, 0)
+        return None
+    if st.pos == (tree, layer):
+        return None  # re-shipped state already includes this transition
+    route = req.get("route")
+    if st.pos == (tree, layer - 1) and route is not None:
+        go_left = unpack_bits(route["go_left"], st.n)
+        st.slot, st.leaf_id, st.hist_slot = apply_route_tables(
+            st.slot, st.leaf_id, go_left, route["tables"]
+        )
+        st.pos = (tree, layer)
+        return None
+    return _need(
+        f"worker state at position {st.pos} cannot serve "
+        f"(tree, layer) = {(tree, layer)}"
+    )
+
+
+def _build_histograms(req: Dict[str, Any], worker_id: str) -> Dict[str, Any]:
+    import jax.numpy as jnp
+
+    from ydf_tpu.ops.histogram import histogram
+
+    st = _get_state(worker_id, req["key"])
+    if st is None:
+        return _need(f"unknown dist key {req['key']!r} (worker restarted?)")
+    with st.lock:
+        stats = req.get("stats")
+        if stats is not None:
+            st.hist_stats = np.asarray(stats["hist_stats"])
+            qs = stats.get("qscale")
+            st.qscale = None if qs is None else np.asarray(qs)
+        err = _sync_to(st, req)
+        if err is not None:
+            return err
+        if st.hist_stats is None:
+            return _need("no gradient stats loaded for this tree")
+        hists = {}
+        qscale = None if st.qscale is None else jnp.asarray(st.qscale)
+        j_hist_slot = jnp.asarray(st.hist_slot)
+        j_stats = jnp.asarray(st.hist_stats)
+        for k in req["shards"]:
+            sh = st.shards.get(int(k))
+            if sh is None:
+                return _need(f"shard {k} not loaded")
+            h = histogram(
+                jnp.asarray(sh.bins), j_hist_slot, j_stats,
+                num_slots=int(req["num_slots"]),
+                num_bins=int(req["num_bins"]),
+                impl=req.get("impl") or "auto",
+                quant=req.get("quant"),
+                quant_scale=qscale,
+                compact=int(req.get("compact", 0)),
+            )
+            hists[int(k)] = np.asarray(h)
+        return {"ok": True, "hists": hists}
+
+
+def _apply_split(req: Dict[str, Any], worker_id: str) -> Dict[str, Any]:
+    st = _get_state(worker_id, req["key"])
+    if st is None:
+        return _need(f"unknown dist key {req['key']!r} (worker restarted?)")
+    with st.lock:
+        pos = (int(req["tree"]), int(req["layer"]))
+        if st.pos != pos:
+            # apply_split routes with the CURRENT layer's slot state; a
+            # worker at any other position would compute garbage bits.
+            return _need(
+                f"worker state at position {st.pos} cannot route "
+                f"layer {pos}"
+            )
+        t = req["tables"]
+        do_split = np.asarray(t["do_split"])
+        route_f = np.asarray(t["route_f"])
+        glb = np.asarray(t["go_left_bins"])
+        bits = np.zeros(st.n, bool)
+        for k in req["shards"]:
+            sh = st.shards.get(int(k))
+            if sh is None:
+                return _need(f"shard {k} not loaded")
+            owned = do_split & (route_f >= sh.lo) & (route_f < sh.hi)
+            rows = np.flatnonzero(owned[st.slot])
+            if rows.size == 0:
+                continue
+            s_rows = st.slot[rows]
+            bin_e = sh.bins[rows, route_f[s_rows] - sh.lo]
+            bits[rows] = glb[s_rows, bin_e]
+        return {"ok": True, "bits": pack_bits(bits)}
+
+
+def _leaf_stats(req: Dict[str, Any], worker_id: str) -> Dict[str, Any]:
+    st = _get_state(worker_id, req["key"])
+    if st is None:
+        return _need(f"unknown dist key {req['key']!r} (worker restarted?)")
+    with st.lock:
+        err = _sync_to(st, req)
+        if err is not None:
+            return err
+        leaf_id = st.leaf_id
+        cap = int(req.get("num_nodes_cap", int(leaf_id.max()) + 1))
+        counts = np.bincount(leaf_id, minlength=cap)
+        sums = None
+        if st.hist_stats is not None:
+            deq = _dequantized_stats(st)
+            sums = np.zeros((cap, deq.shape[1]), np.float64)
+            np.add.at(sums, leaf_id, deq.astype(np.float64))
+        return {
+            "ok": True,
+            "leaf_counts": counts,
+            "leaf_sums": sums,
+            "slot_crc": zlib.crc32(
+                np.ascontiguousarray(st.slot).tobytes()
+            ),
+            "leaf_crc": zlib.crc32(np.ascontiguousarray(leaf_id).tobytes()),
+        }
+
+
+_HANDLERS = {
+    "load_cache_shard": _load_cache_shard,
+    "build_histograms": _build_histograms,
+    "apply_split": _apply_split,
+    "leaf_stats": _leaf_stats,
+}
+
+
+def handle(verb: str, req: Dict[str, Any],
+           worker_id: str = "local") -> Dict[str, Any]:
+    return _HANDLERS[verb](req, worker_id)
+
+
+def reset_state() -> None:
+    """Drops all per-key worker state (tests)."""
+    with _STATE_LOCK:
+        _STATE.clear()

@@ -62,12 +62,80 @@ def _env_secret() -> Optional[bytes]:
     return s.encode() if s else None
 
 
-def _send_msg(sock: socket.socket, obj: Any,
-              secret: Optional[bytes] = None) -> None:
+def _parse_max_frame() -> int:
+    """YDF_TPU_WORKER_MAX_FRAME, eagerly validated at import (same
+    policy as YDF_TPU_HIST_IMPL): the per-frame wire bound in bytes.
+    The original 4 GiB default was sized for tuner-trial payloads;
+    distributed training's per-layer histogram tensors can legitimately
+    exceed any fixed bound, so payloads above the cap are CHUNKED
+    (sender splits, receiver reassembles — `_send_payload` /
+    `_recv_payload`) and the cap's remaining job is the pre-auth
+    allocation bound per frame."""
+    raw = os.environ.get("YDF_TPU_WORKER_MAX_FRAME")
+    if raw is None:
+        return 4 << 30
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"YDF_TPU_WORKER_MAX_FRAME={raw!r} is not an integer byte "
+            "count"
+        ) from None
+    if v < (1 << 16):
+        raise ValueError(
+            f"YDF_TPU_WORKER_MAX_FRAME={raw} is below the 64 KiB "
+            "protocol minimum (frames carry pickled requests plus a "
+            "32-byte MAC)"
+        )
+    return v
+
+
+_MAX_FRAME: int = _parse_max_frame()
+#: A chunked transfer may assemble up to this many caps' worth of bytes
+#: — bounded so a bogus chunk header still cannot demand unbounded
+#: memory, while any realistic histogram payload fits.
+_CHUNK_FACTOR = 1024
+#: Length-prefix sentinel announcing a chunked frame.
+_CHUNK_SENTINEL = (1 << 64) - 1
+
+
+def _max_frame() -> int:
+    return _MAX_FRAME
+
+
+def _encode_frame(obj: Any, secret: Optional[bytes] = None) -> bytes:
+    """Request/response payload bytes (pickle + optional HMAC trailer).
+    Split from the socket write so a caller broadcasting one payload to
+    N workers serializes it ONCE (WorkerPool.load_data_all)."""
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     if secret:
         payload += hmac.new(secret, payload, hashlib.sha256).digest()
-    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+    return payload
+
+
+def _send_payload(sock: socket.socket, payload: bytes) -> None:
+    cap = _max_frame()
+    if len(payload) <= cap:
+        sock.sendall(struct.pack("<Q", len(payload)) + payload)
+        return
+    # Chunked framing: <sentinel><total><nchunks> then nchunks
+    # cap-bounded sub-frames. The MAC (already inside `payload`) covers
+    # the reassembled bytes, so chunking is invisible to authentication.
+    view = memoryview(payload)
+    nchunks = (len(payload) + cap - 1) // cap
+    sock.sendall(
+        struct.pack("<Q", _CHUNK_SENTINEL)
+        + struct.pack("<QQ", len(payload), nchunks)
+    )
+    for i in range(nchunks):
+        part = view[i * cap: (i + 1) * cap]
+        sock.sendall(struct.pack("<Q", len(part)))
+        sock.sendall(part)
+
+
+def _send_msg(sock: socket.socket, obj: Any,
+              secret: Optional[bytes] = None) -> None:
+    _send_payload(sock, _encode_frame(obj, secret))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -80,19 +148,59 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def _max_frame() -> int:
-    return int(os.environ.get("YDF_TPU_WORKER_MAX_FRAME", 4 << 30))
+def _recv_payload(sock: socket.socket) -> bytes:
+    cap = _max_frame()
+    (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
+    if n == _CHUNK_SENTINEL:
+        total, nchunks = struct.unpack("<QQ", _recv_exact(sock, 16))
+        if total > cap * _CHUNK_FACTOR:
+            raise ConnectionError(
+                f"chunked frame of {total} bytes exceeds the "
+                f"{cap * _CHUNK_FACTOR}-byte assembly bound "
+                f"(YDF_TPU_WORKER_MAX_FRAME={cap} x {_CHUNK_FACTOR}); "
+                "raise YDF_TPU_WORKER_MAX_FRAME on the receiving side"
+            )
+        if nchunks > _CHUNK_FACTOR or nchunks < 1:
+            raise ConnectionError(
+                f"chunked frame declares {nchunks} chunks (bound "
+                f"{_CHUNK_FACTOR}); peer speaks a different protocol "
+                "or its YDF_TPU_WORKER_MAX_FRAME is far smaller"
+            )
+        buf = bytearray()
+        for _ in range(nchunks):
+            (m,) = struct.unpack("<Q", _recv_exact(sock, 8))
+            if m > cap:
+                raise ConnectionError(
+                    f"frame chunk of {m} bytes exceeds the {cap}-byte "
+                    "cap; raise YDF_TPU_WORKER_MAX_FRAME on the "
+                    "receiving side to at least the sender's value"
+                )
+            if len(buf) + m > total:
+                raise ConnectionError(
+                    "chunked frame overruns its declared size"
+                )
+            buf += _recv_exact(sock, m)
+        if len(buf) != total:
+            raise ConnectionError(
+                f"chunked frame short: {len(buf)} of {total} bytes"
+            )
+        return bytes(buf)
+    if n > cap:
+        # Checked BEFORE allocation: a bogus length prefix (or a peer
+        # speaking another protocol) must not buffer gigabytes pre-auth.
+        raise ConnectionError(
+            f"frame of {n} bytes exceeds the {cap}-byte cap; raise the "
+            "YDF_TPU_WORKER_MAX_FRAME environment variable on the "
+            "receiving side (senders from this build chunk payloads "
+            "above their own cap automatically)"
+        )
+    return _recv_exact(sock, n)
 
 
 def _recv_msg(sock: socket.socket, secret: Optional[bytes] = None) -> Any:
-    (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
-    if n > _max_frame():
-        # Checked BEFORE allocation: a bogus length prefix (or a peer
-        # speaking another protocol) must not buffer gigabytes pre-auth.
-        raise ConnectionError(f"frame of {n} bytes exceeds the cap")
-    data = _recv_exact(sock, n)
+    data = _recv_payload(sock)
     if secret:
-        if n < _MAC_LEN:
+        if len(data) < _MAC_LEN:
             raise ConnectionError("authentication failed (frame too short)")
         body, mac = data[:-_MAC_LEN], data[-_MAC_LEN:]
         want = hmac.new(secret, body, hashlib.sha256).digest()
@@ -106,8 +214,11 @@ def _recv_msg(sock: socket.socket, secret: Optional[bytes] = None) -> Any:
 # ONCE per tuning run; every trial request then carries only the learner
 # config + the data key — the reference workers keep their dataset cache
 # resident across requests the same way (dataset_cache_reader.cc).
-_DATA_CACHE: Dict[str, Tuple[Any, Any]] = {}
-_DATA_CACHE_CAP = 4
+# Keyed by (worker instance id, data key): several in-process workers
+# (tests/bench) must hold separate entries once per-worker payloads
+# exist (load_data_each) — exactly like separate worker processes.
+_DATA_CACHE: Dict[Tuple[str, str], Tuple[Any, Any]] = {}
+_DATA_CACHE_CAP = 8
 # Requests are handled on per-connection threads; cache mutations are
 # tiny (dict insert/evict) so one lock suffices.
 _DATA_CACHE_LOCK = threading.Lock()
@@ -123,20 +234,28 @@ def _send_timeout() -> float:
     return float(os.environ.get("YDF_TPU_WORKER_SEND_TIMEOUT", 120.0))
 
 
-def _handle_request(req: Dict[str, Any]) -> Dict[str, Any]:
+def _handle_request(
+    req: Dict[str, Any], ctx: Optional[Dict[str, Any]] = None
+) -> Dict[str, Any]:
     """Executes one request. Verbs: ping; load_data (cache a
     train/holdout pair under a key); train_score (train a learner,
     evaluate on the holdout, return the signed primary-metric score —
     the reference GenericWorker's TrainModel+EvaluateModel fused; data
-    comes from the cache via data_key, or inline); shutdown."""
+    comes from the cache via data_key, or inline); shutdown; plus the
+    distributed-GBT verbs (dist_worker.VERBS). `ctx` carries this
+    worker INSTANCE's identity: several workers of one test/bench
+    process must not share distributed state (their slot/leaf arrays
+    are per-worker, and concurrent routing updates on shared state
+    would race)."""
     verb = req.get("verb")
+    wid = (ctx or {}).get("worker_id", "local")
     if verb == "ping":
         return {"ok": True}
     if verb == "load_data":
         with _DATA_CACHE_LOCK:
             if len(_DATA_CACHE) >= _DATA_CACHE_CAP:
                 _DATA_CACHE.pop(next(iter(_DATA_CACHE)))
-            _DATA_CACHE[req["key"]] = (
+            _DATA_CACHE[(wid, req["key"])] = (
                 req["train_data"], req["holdout_data"],
             )
         return {"ok": True}
@@ -145,7 +264,7 @@ def _handle_request(req: Dict[str, Any]) -> Dict[str, Any]:
 
         if "data_key" in req:
             with _DATA_CACHE_LOCK:
-                pair = _DATA_CACHE.get(req["data_key"])
+                pair = _DATA_CACHE.get((wid, req["data_key"]))
             if pair is None:
                 return {
                     "ok": False,
@@ -163,6 +282,16 @@ def _handle_request(req: Dict[str, Any]) -> Dict[str, Any]:
         return {"ok": True, "score": float(sign * value), "metric": metric}
     if verb == "shutdown":
         return {"ok": True, "shutdown": True}
+    from ydf_tpu.parallel import dist_worker
+
+    if verb in dist_worker.VERBS:
+        # Distributed-GBT verbs (load_cache_shard / build_histograms /
+        # apply_split / leaf_stats) — the worker half of the
+        # feature-parallel exchange, kept in its own module
+        # (parallel/dist_worker.py) so this service stays a transport.
+        return dist_worker.handle(
+            verb, req, worker_id=(ctx or {}).get("worker_id", "local")
+        )
     return {"ok": False, "error": f"unknown verb {verb!r}"}
 
 
@@ -182,6 +311,10 @@ def start_worker(
     srv.bind((host, port))
     srv.listen(16)
     stop_evt = threading.Event()
+    # Per-INSTANCE identity: distributed-GBT state is namespaced by it,
+    # so several in-process workers (tests, bench) hold separate
+    # slot/leaf arrays exactly like separate worker processes would.
+    ctx = {"worker_id": f"{host}:{srv.getsockname()[1]}"}
 
     def serve_conn(conn: socket.socket) -> None:
         """One connection, on its own thread: a stalled or dead manager
@@ -210,7 +343,7 @@ def start_worker(
                     ).inc()
                     t0 = time.perf_counter_ns()
                 try:
-                    resp = _handle_request(req)
+                    resp = _handle_request(req, ctx)
                 except Exception as e:  # worker stays alive on task errors
                     resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
                 if telemetry.ENABLED:
@@ -319,11 +452,21 @@ class WorkerPool:
         self, i: int, req: Dict[str, Any],
         timeout_s: Optional[float] = None,
     ) -> Dict[str, Any]:
+        return self.request_frame(
+            i, _encode_frame(req, self.secret), timeout_s=timeout_s
+        )
+
+    def request_frame(
+        self, i: int, frame: bytes, timeout_s: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """`request` over a pre-encoded payload (``_encode_frame``):
+        callers broadcasting one request to many workers serialize —
+        and MAC — it once instead of per worker."""
         host, port = self.addresses[i % len(self.addresses)]
         with socket.create_connection(
             (host, port), timeout=timeout_s or self.timeout_s
         ) as sock:
-            _send_msg(sock, req, self.secret)
+            _send_payload(sock, frame)
             return _recv_msg(sock, self.secret)
 
     # ---- retry / backoff / quarantine ------------------------------- #
@@ -474,27 +617,23 @@ class WorkerPool:
             )
         self.addresses = alive
 
-    def load_data_all(self, key: str, train_data, holdout_data) -> None:
-        """Ships the dataset pair to every worker ONCE; trial requests
-        then reference it by key instead of re-pickling gigabytes per
-        trial. Transport failures retry (pinned to the worker — the data
-        must land on THAT host) with backoff; a worker that stays
-        unreachable is quarantined and tolerated: the trial-time
-        need_data re-ship recovers it if it comes back."""
+    def _ship_frames(self, frames: List[bytes], what: str) -> None:
+        """Delivers frames[i] to worker i with the pinned-retry /
+        quarantine-and-tolerate policy shared by load_data_all and
+        load_data_each: the payload must land on THAT host, a worker
+        that stays unreachable is quarantined (the caller's on-demand
+        re-ship recovers it if it comes back), and a protocol-level
+        refusal raises."""
         import warnings
 
-        for i in range(len(self.addresses)):
+        for i, frame in enumerate(frames):
             resp = None
             last_err: Optional[BaseException] = None
             for attempt in range(min(3, self.retry_attempts)):
                 if attempt:
                     time.sleep(self.backoff_delay(attempt - 1))
                 try:
-                    resp = self.request(i, {
-                        "verb": "load_data", "key": key,
-                        "train_data": train_data,
-                        "holdout_data": holdout_data,
-                    })
+                    resp = self.request_frame(i, frame)
                     last_err = None
                     break
                 except (OSError, ConnectionError) as e:
@@ -503,15 +642,47 @@ class WorkerPool:
                 self.mark_failed(i)
                 warnings.warn(
                     f"worker {self.addr_str(i)} unreachable during "
-                    f"load_data ({last_err}); it is quarantined and the "
+                    f"{what} ({last_err}); it is quarantined and the "
                     "data will be re-shipped on demand if it returns",
-                    RuntimeWarning, stacklevel=2,
+                    RuntimeWarning, stacklevel=3,
                 )
                 continue
             if not resp.get("ok"):
                 raise ConnectionError(
-                    f"worker {self.addresses[i]} failed load_data: {resp}"
+                    f"worker {self.addresses[i]} failed {what}: {resp}"
                 )
+
+    def load_data_all(self, key: str, train_data, holdout_data) -> None:
+        """Ships the dataset pair to every worker ONCE; trial requests
+        then reference it by key instead of re-pickling gigabytes per
+        trial. The request is serialized (and MAC'd) a single time and
+        the same frame bytes go to each worker — broadcasting N copies
+        used to pay N full pickles of the dataset."""
+        frame = _encode_frame(
+            {
+                "verb": "load_data", "key": key,
+                "train_data": train_data, "holdout_data": holdout_data,
+            },
+            self.secret,
+        )
+        self._ship_frames([frame] * len(self.addresses), "load_data")
+
+    def load_data_each(self, key: str, items: List[Dict[str, Any]],
+                       verb: str = "load_data") -> None:
+        """Per-worker payloads: items[i] is merged into worker i's
+        request — the shard-distribution primitive (each worker gets
+        ITS slice instead of N serializations of the whole dataset).
+        Shares load_data_all's pinned-retry/quarantine policy."""
+        if len(items) != len(self.addresses):
+            raise ValueError(
+                f"load_data_each needs one payload per worker "
+                f"({len(self.addresses)}), got {len(items)}"
+            )
+        frames = [
+            _encode_frame({"verb": verb, "key": key, **item}, self.secret)
+            for item in items
+        ]
+        self._ship_frames(frames, verb)
 
     def shutdown_all(self) -> None:
         for i in range(len(self.addresses)):

@@ -98,6 +98,14 @@ KNOWN_SITES = frozenset(
         # learners/gbt.py — checkpointed boosting loop, after each
         # chunk's snapshot is durably saved.
         "gbt.chunk",
+        # parallel/dist_gbt.py — manager-side distributed-GBT RPCs:
+        # shard load/re-ship, per-layer histogram gather, and the
+        # split-broadcast/routing exchange. drop_conn surfaces as a
+        # transport failure and drives the shard-reassignment recovery
+        # path (chaos tests assert bit-identical models).
+        "dist.shard_load",
+        "dist.histogram_rpc",
+        "dist.split_broadcast",
         # utils/telemetry.py — span/metrics exporter. flush() swallows
         # the injected fault (export is observation): the chaos test
         # asserts a crashing exporter leaves training bit-identical.
