@@ -681,6 +681,14 @@ def measure_distributed_family(rows, trees, depth, features, record):
       dist_rpc_p50_ns         per-verb RPC p50 from the run's latency
                               histograms (telemetry-keyed by verb)
       dist_recoveries         reassignments the run needed (0 healthy)
+      dist_compute_s          per-layer wall attribution, summed over
+      dist_net_s              the run: compute (worker kernels +
+      dist_wait_s             manager search), network (median RPC −
+                              median worker handle), straggler wait
+                              (slowest − median histogram RPC); the
+                              three sum to dist_layer_wall_s
+                              (docs/observability.md)
+      dist_layer_wall_s       summed measured per-layer wall
 
     on the headline record. In-process workers measure PROTOCOL cost
     (serialization, reduction, routing exchange) — they share this
@@ -753,6 +761,10 @@ def measure_distributed_family(rows, trees, depth, features, record):
             )
             record["dist_rpc_p50_ns"] = d["rpc_p50_ns"]
             record["dist_recoveries"] = int(d["recoveries"])
+            record["dist_compute_s"] = round(d["compute_s"], 3)
+            record["dist_net_s"] = round(d["net_s"], 3)
+            record["dist_wait_s"] = round(d["wait_s"], 3)
+            record["dist_layer_wall_s"] = round(d["layer_wall_s"], 3)
         try:
             WorkerPool(addrs).shutdown_all()
         except Exception:

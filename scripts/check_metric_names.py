@@ -1,0 +1,171 @@
+"""Metric-name and failpoint-site lint: the observability inventory
+may not drift.
+
+Statically scans the `ydf_tpu/` tree for registry call sites
+(`telemetry.counter("…") / .gauge("…") / .histogram("…")` — any
+receiver, string literal first argument, multiline-tolerant) and
+failpoint sites (`failpoints.hit("…")` literals plus the authoritative
+`failpoints.KNOWN_SITES` registry), then enforces:
+
+  * naming convention (docs/observability.md "Metric naming
+    conventions"): every name starts `ydf_`, counters end `_total`,
+    latency histograms end `_ns` (byte-size histograms `_bytes`),
+    gauges never end `_total`, and unit suffixes (`_ns`, `_bytes`,
+    `_seconds`) sit immediately before a counter's `_total`;
+  * documentation: every metric name AND every failpoint site appears
+    LITERALLY in docs/observability.md — the inventory was already
+    drifting (serving metrics landed in PR 7 before the doc tables
+    were made exhaustive), and an undocumented name is how dashboards
+    rot.
+
+Run standalone (exit 0 clean, 1 with violations, JSON summary either
+way):
+
+    python scripts/check_metric_names.py
+
+tests/test_metric_names.py runs the same check in tier-1.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Set, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: Registry call with a literal name: any receiver (telemetry.counter,
+#: reg.histogram, self._registry.gauge, …), whitespace/newlines between
+#: the paren and the string tolerated.
+METRIC_RE = re.compile(r'\.(counter|gauge|histogram)\(\s*"([^"]+)"')
+FAILPOINT_RE = re.compile(r'failpoints\.hit\(\s*"([^"]+)"')
+NAME_RE = re.compile(r"^ydf_[a-z0-9_]+$")
+#: Unit suffixes the convention recognizes.
+UNITS = ("_ns", "_bytes", "_seconds")
+
+
+def _py_files(root: str) -> List[str]:
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        out.extend(
+            os.path.join(dirpath, f)
+            for f in filenames
+            if f.endswith(".py")
+        )
+    return sorted(out)
+
+
+def scan_tree(
+    root: str,
+) -> Tuple[Dict[Tuple[str, str], List[str]], Dict[str, List[str]]]:
+    """Returns ({(kind, metric_name): [files]}, {site: [files]})."""
+    metrics: Dict[Tuple[str, str], List[str]] = {}
+    sites: Dict[str, List[str]] = {}
+    for path in _py_files(root):
+        with open(path) as f:
+            text = f.read()
+        rel = os.path.relpath(path, REPO)
+        for m in METRIC_RE.finditer(text):
+            metrics.setdefault((m.group(1), m.group(2)), []).append(rel)
+        for m in FAILPOINT_RE.finditer(text):
+            sites.setdefault(m.group(1), []).append(rel)
+    return metrics, sites
+
+
+def known_failpoint_sites() -> Set[str]:
+    """The authoritative site registry: sites hit through a VARIABLE
+    (the dist.* manager sites) never appear as hit("…") literals, so
+    the lint also covers failpoints.KNOWN_SITES (stdlib-only import)."""
+    sys.path.insert(0, REPO)
+    try:
+        from ydf_tpu.utils import failpoints
+
+        return set(failpoints.KNOWN_SITES)
+    finally:
+        sys.path.pop(0)
+
+
+def doc_names(doc_path: str) -> Set[str]:
+    """Every `ydf_*` token and `area.site` token the doc mentions —
+    the inventory is written with LITERAL full names, one per metric."""
+    with open(doc_path) as f:
+        text = f.read()
+    names = set(re.findall(r"ydf_[a-z0-9_]+", text))
+    sites = set(re.findall(r"\b[a-z_]+\.[a-z_]+\b", text))
+    return names | sites
+
+
+def check(
+    root: str = None, doc_path: str = None
+) -> dict:
+    """Runs the lint; returns a JSON-able summary with `violations`."""
+    root = root or os.path.join(REPO, "ydf_tpu")
+    doc_path = doc_path or os.path.join(REPO, "docs", "observability.md")
+    metrics, hit_sites = scan_tree(root)
+    documented = doc_names(doc_path)
+    all_sites = set(hit_sites) | known_failpoint_sites()
+    violations: List[str] = []
+
+    for (kind, name), files in sorted(metrics.items()):
+        where = f"{name} ({kind} at {files[0]})"
+        if not NAME_RE.match(name):
+            violations.append(
+                f"{where}: does not match ydf_<area>_<what> "
+                "(lowercase, ydf_ prefix)"
+            )
+            continue
+        if kind == "counter" and not name.endswith("_total"):
+            violations.append(f"{where}: counters must end _total")
+        if kind == "gauge" and name.endswith("_total"):
+            violations.append(f"{where}: _total is reserved for counters")
+        if kind == "histogram" and not name.endswith(("_ns", "_bytes")):
+            violations.append(
+                f"{where}: histograms must carry a _ns/_bytes unit suffix"
+            )
+        if kind == "counter" and name.endswith("_total"):
+            # Time units are ambiguous mid-name (compute_ns_layer_total
+            # would not say what is counted): they must sit immediately
+            # before _total. Byte counters may read naturally
+            # (bytes_written_total).
+            stem = name[: -len("_total")]
+            parts = stem.split("_")
+            for unit in ("_ns", "_seconds"):
+                if unit.lstrip("_") in parts and not stem.endswith(unit):
+                    violations.append(
+                        f"{where}: time unit {unit} must sit "
+                        "immediately before _total"
+                    )
+        if name not in documented:
+            violations.append(
+                f"{where}: not documented in docs/observability.md "
+                "(add it to the metric inventory)"
+            )
+
+    for site in sorted(all_sites):
+        if site not in documented:
+            violations.append(
+                f"failpoint site {site!r}: not documented in "
+                "docs/observability.md (add it to the failpoint-site "
+                "inventory)"
+            )
+
+    return {
+        "metrics_scanned": len(metrics),
+        "failpoint_sites": len(all_sites),
+        "documented_names": len(documented),
+        "violations": violations,
+        "ok": not violations,
+    }
+
+
+def main(argv=None) -> int:
+    summary = check()
+    print(json.dumps(summary, indent=2))
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

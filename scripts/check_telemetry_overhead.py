@@ -51,6 +51,7 @@ def run_check(
     reps: int = 3,
     rel_budget: float = 0.03,
     abs_floor_s: float = 0.08,
+    with_http: bool = False,
 ) -> dict:
     import numpy as np
 
@@ -77,9 +78,32 @@ def run_check(
 
     disabled_a = measure_min_wall(train_once, reps)
     td = tempfile.mkdtemp(prefix="ydf_tel_overhead_")
+    enabled_http = None
     try:
         with telemetry.active(td):
             enabled = measure_min_wall(train_once, reps)
+            if with_http:
+                # Endpoint-enabled variant: the exposition thread
+                # (ephemeral port) serves /metrics while the SAME
+                # shared-jit train repeats — the HTTP thread must cost
+                # nothing on the train hot path (it only wakes per
+                # scrape, and the scrape reads the registry without
+                # touching the loop).
+                import urllib.request
+
+                from ydf_tpu.utils import telemetry_http
+
+                srv = telemetry_http.start_metrics_server(0)
+                try:
+                    urllib.request.urlopen(
+                        srv.url("/metrics"), timeout=5
+                    ).read()  # prove it actually serves during the run
+                    enabled_http = measure_min_wall(train_once, reps)
+                    urllib.request.urlopen(
+                        srv.url("/healthz"), timeout=5
+                    ).read()
+                finally:
+                    telemetry_http._reset_for_tests()
     finally:
         shutil.rmtree(td, ignore_errors=True)
     disabled_b = measure_min_wall(train_once, reps)
@@ -100,6 +124,12 @@ def run_check(
         "budget_s": round(budget, 4),
         "ok": overhead <= budget,
     }
+    if enabled_http is not None:
+        http_overhead = enabled_http - disabled
+        summary["enabled_http_min_s"] = round(enabled_http, 4)
+        summary["http_overhead_s"] = round(http_overhead, 4)
+        summary["ok_http"] = http_overhead <= budget
+        summary["ok"] = summary["ok"] and summary["ok_http"]
     return summary
 
 
@@ -110,10 +140,14 @@ def main(argv=None) -> int:
     ap.add_argument("--depth", type=int, default=4)
     ap.add_argument("--features", type=int, default=8)
     ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--with-http", action="store_true",
+                    help="additionally measure with the /metrics "
+                         "endpoint serving (utils/telemetry_http.py)")
     args = ap.parse_args(argv)
     summary = run_check(
         rows=args.rows, trees=args.trees, depth=args.depth,
         features=args.features, reps=args.reps,
+        with_http=args.with_http,
     )
     print(json.dumps(summary))
     return 0 if summary["ok"] else 1

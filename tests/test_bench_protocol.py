@@ -131,6 +131,19 @@ def test_small_cpu_run_with_distributed_family():
     assert p50.get("build_histograms", 0) > 0
     assert p50.get("load_cache_shard", 0) > 0
     assert rec["dist_recoveries"] == 0
+    # Per-layer wall attribution (this round): compute + net + wait
+    # partition the summed layer wall, so distributed slowness is
+    # attributable to compute, the network, or a straggler from the
+    # headline record alone.
+    assert rec["dist_layer_wall_s"] > 0
+    for f in ("dist_compute_s", "dist_net_s", "dist_wait_s"):
+        assert rec[f] >= 0
+    total = (
+        rec["dist_compute_s"] + rec["dist_net_s"] + rec["dist_wait_s"]
+    )
+    assert abs(total - rec["dist_layer_wall_s"]) <= 0.02 + 0.01 * rec[
+        "dist_layer_wall_s"
+    ]
 
 
 def test_bench_dist_workers_env_validation(tmp_path):

@@ -97,7 +97,14 @@ def test_preemption_stop_is_resumable(tmp_path):
     """SIGTERM semantics at the chunk boundary (via the deterministic
     trigger hook — real OS delivery is covered by the slow subprocess
     test and the guard unit test below): a preempted run stops with the
-    distinct resumable code and resume is bit-identical."""
+    distinct resumable code and resume is bit-identical. With telemetry
+    armed, the exit-75 guard must also flush the buffered spans AND
+    write the flight-recorder black box — a preempted run used to lose
+    everything buffered since the last flush."""
+    import json
+
+    from ydf_tpu.utils import telemetry
+
     data = _data()
     base = ydf.GradientBoostedTreesLearner(**_KW).train(data)
     learner = ydf.GradientBoostedTreesLearner(
@@ -106,10 +113,38 @@ def test_preemption_stop_is_resumable(tmp_path):
         **_KW,
     )
     learner._preempt_after_chunks = 1
-    with pytest.raises(ydf.TrainingPreempted) as ei:
-        learner.train(data)
+    td = str(tmp_path / "telemetry")
+    with telemetry.active(td):
+        with pytest.raises(ydf.TrainingPreempted) as ei:
+            learner.train(data)
     assert ei.value.exit_code == 75
     assert "resumable" in str(ei.value)
+
+    # The preempted process's trace exists and parses (the spans the
+    # old code lost), and nests: chunk spans inside nothing is fine,
+    # but every line must be a valid chrome event.
+    traces = [f for f in os.listdir(td) if f.startswith("trace-")]
+    assert traces, "preemption did not flush the telemetry trace"
+    evs = [
+        json.loads(line)
+        for line in open(os.path.join(td, traces[0]))
+    ]
+    assert any(e["name"] == "train.chunk" for e in evs)
+    # The flight recorder dumped with the preemption reason, and its
+    # ring holds the preempt marker.
+    flights = [f for f in os.listdir(td) if f.startswith("flight_")]
+    assert flights, "preemption did not write the flight recorder"
+    lines = [
+        json.loads(line)
+        for line in open(os.path.join(td, flights[0]))
+    ]
+    assert lines[0]["kind"] == "flight_dump"
+    assert lines[0]["reason"] == "preempt"
+    assert any(
+        e.get("kind") == "preempt" and e.get("signal") == "SIGTERM"
+        for e in lines[1:]
+    )
+
     resumed = ydf.GradientBoostedTreesLearner(
         working_dir=str(tmp_path), resume_training=True,
         resume_training_snapshot_interval_trees=4, **_KW,

@@ -1,0 +1,285 @@
+"""Cross-process observability of feature-parallel distributed GBT
+(the round's tentpole): trace propagation over the RPC frames, the
+`get_telemetry` drain, clock-corrected merge into ONE chrome-tracing
+file where worker histogram-RPC spans nest under the manager's layer
+spans, the compute/net/wait layer attribution, and /metrics staying
+serveable while a failpoint fires mid-train (docs/observability.md)."""
+
+import json
+import os
+import socket
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+from ydf_tpu.dataset.cache import create_dataset_cache
+from ydf_tpu.parallel import dist_worker
+from ydf_tpu.parallel.worker_service import WorkerPool, start_worker
+from ydf_tpu.utils import failpoints, telemetry, telemetry_http
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def workers():
+    started = []
+
+    def start(n):
+        ports = [_free_port() for _ in range(n)]
+        for p in ports:
+            start_worker(p, host="127.0.0.1", blocking=False)
+        addrs = [f"127.0.0.1:{p}" for p in ports]
+        WorkerPool(addrs).ping_all()
+        started.extend(addrs)
+        return addrs
+
+    yield start
+    try:
+        WorkerPool(started).shutdown_all() if started else None
+    except Exception:
+        pass
+    dist_worker.reset_state()
+    telemetry_http._reset_for_tests()
+
+
+def _frame(n=2000, seed=7):
+    rng = np.random.RandomState(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float64)
+    y = x[:, 1] * 1.5 - x[:, 0] + rng.normal(scale=0.3, size=n)
+    return {
+        "f0": x[:, 0], "f1": x[:, 1], "f2": x[:, 2], "f3": x[:, 3],
+        "y": y.astype(np.float32),
+    }
+
+
+def _learner(num_trees=3, **kw):
+    return ydf.GradientBoostedTreesLearner(
+        label="y", task=Task.REGRESSION, num_trees=num_trees,
+        max_depth=3, validation_ratio=0.0, early_stopping="NONE",
+        **kw,
+    )
+
+
+def _load_trace(td):
+    evs = []
+    for name in sorted(os.listdir(td)):
+        if name.startswith("trace-") and name.endswith(".jsonl"):
+            with open(os.path.join(td, name)) as f:
+                for line in f:
+                    evs.append(json.loads(line))
+    return evs
+
+
+def _contains(parent, child, slack_us=0.0):
+    return (
+        parent["ts"] - slack_us <= child["ts"]
+        and child["ts"] + child["dur"]
+        <= parent["ts"] + parent["dur"] + slack_us
+    )
+
+
+def _dist_train_with_trace(tmp_path, workers, n_workers=2, **kw):
+    cache = create_dataset_cache(
+        _frame(), str(tmp_path / "cache"), label="y",
+        task=Task.REGRESSION, feature_shards=n_workers,
+    )
+    addrs = workers(n_workers)
+    td = str(tmp_path / "telemetry")
+    with telemetry.active(td):
+        model = _learner(distributed_workers=addrs, **kw).train(cache)
+        telemetry.flush()
+    return model, td, addrs
+
+
+# --------------------------------------------------------------------- #
+# The merged trace (acceptance criterion)
+# --------------------------------------------------------------------- #
+
+
+def test_merged_trace_is_one_valid_chrome_file(tmp_path, workers):
+    model, td, addrs = _dist_train_with_trace(tmp_path, workers)
+    traces = [f for f in os.listdir(td) if f.startswith("trace-")]
+    assert len(traces) == 1, "manager + workers must merge to ONE file"
+    evs = _load_trace(td)
+    assert evs
+    for e in evs:
+        assert e.get("ph") in ("X", "M"), e
+        if e["ph"] == "X":
+            assert e["dur"] > 0 and "ts" in e and "pid" in e
+    # Per-worker pid rows, each named by a process_name metadata event.
+    meta = [e for e in evs if e["ph"] == "M"]
+    worker_pids = {e["pid"] for e in meta}
+    assert len(worker_pids) == len(addrs)
+    names = {e["args"]["name"] for e in meta}
+    assert names == {f"worker {a}" for a in addrs}
+    manager_pid = os.getpid()
+    assert manager_pid not in worker_pids
+
+
+def test_worker_spans_nest_under_manager_layer_spans(tmp_path, workers):
+    """The headline nesting assertion: every worker build_histograms
+    span sits, after clock correction, inside the manager's dist.layer
+    span for the SAME (tree, layer) — and carries the propagated trace
+    context pointing at that layer span."""
+    model, td, addrs = _dist_train_with_trace(tmp_path, workers)
+    evs = _load_trace(td)
+    layers = [e for e in evs if e["name"] == "dist.layer"]
+    trees = [e for e in evs if e["name"] == "dist.tree"]
+    worker_hists = [
+        e for e in evs
+        if e["name"] == "worker.request"
+        and e.get("args", {}).get("verb") == "build_histograms"
+    ]
+    assert trees and layers and worker_hists
+    # Every trained tree has max_depth layer spans.
+    assert len(layers) == len(trees) * 3
+    by_pos = {
+        (e["args"]["tree"], e["args"]["layer"]): e for e in layers
+    }
+    for w in worker_hists:
+        pos = (w["args"]["tree"], w["args"]["layer"])
+        layer = by_pos.get(pos)
+        assert layer is not None, f"no manager layer span for {pos}"
+        # Clock-corrected containment (in-process workers share the
+        # clock; the correction is exercised, the slack absorbs its
+        # ±rtt/2 residual).
+        assert _contains(layer, w, slack_us=2_000.0), (pos, layer, w)
+        # Trace propagation: the worker span points at the manager's
+        # trace and at the layer span that issued the RPC.
+        assert w["args"]["trace"] == telemetry.TRACE_ID
+        assert w["args"]["parent_span"] == layer["sid"]
+        assert w["args"]["worker_index"] in range(len(addrs))
+        assert w["args"]["worker"] in addrs
+    # Layer spans nest under their tree span on the manager row.
+    for lsp in layers:
+        assert any(_contains(t, lsp) for t in trees)
+
+
+def test_layer_wall_attribution_sums(tmp_path, workers):
+    """dist_compute_s + dist_net_s + dist_wait_s == the summed layer
+    wall (the attribution is a partition of it, clamped at zero)."""
+    model, td, _ = _dist_train_with_trace(tmp_path, workers)
+    d = model.training_logs["distributed"]
+    total = d["compute_s"] + d["net_s"] + d["wait_s"]
+    assert d["layer_wall_s"] > 0
+    assert total == pytest.approx(d["layer_wall_s"], abs=1e-3)
+    assert d["compute_s"] >= 0 and d["net_s"] >= 0 and d["wait_s"] >= 0
+    # The per-worker drain is accounted.
+    assert sum(d["telemetry_drained_events"].values()) > 0
+
+
+def test_get_telemetry_verb_drains_and_reports_clock(tmp_path, workers):
+    addrs = workers(1)
+    pool = WorkerPool(addrs)
+    with telemetry.active():
+        pool.request(0, {"verb": "ping"})
+        t0 = time.perf_counter_ns()
+        resp = pool.request(0, {"verb": "get_telemetry"})
+        t1 = time.perf_counter_ns()
+    assert resp["ok"] and resp["worker_id"] == addrs[0]
+    # In-process worker: its clock is this clock, so the sample must
+    # sit within the RPC window.
+    assert t0 <= resp["clock_ns"] <= t1
+    assert resp["pid"] == os.getpid()
+    drained = [
+        e for e in resp["events"] if e["name"] == "worker.request"
+    ]
+    assert any(e["args"]["verb"] == "ping" for e in drained)
+    # Drained means DRAINED: the spans are no longer in the buffer.
+    with telemetry.active():
+        again = pool.request(0, {"verb": "get_telemetry"})
+    assert not any(
+        e.get("args", {}).get("verb") == "ping"
+        for e in again["events"]
+    )
+
+
+def test_distributed_bit_identity_with_telemetry_on(tmp_path, workers):
+    """Tracing is observation: the distributed model with telemetry
+    armed equals the fault-free telemetry-off distributed model."""
+    cache = create_dataset_cache(
+        _frame(), str(tmp_path / "cache"), label="y",
+        task=Task.REGRESSION, feature_shards=2,
+    )
+    addrs = workers(2)
+    m_off = _learner(distributed_workers=addrs).train(cache)
+    with telemetry.active(str(tmp_path / "t")):
+        m_on = _learner(distributed_workers=addrs).train(cache)
+    f_off, f_on = m_off.forest.to_numpy(), m_on.forest.to_numpy()
+    for k in f_off:
+        if f_off[k] is None:
+            assert f_on[k] is None
+            continue
+        assert np.array_equal(np.asarray(f_off[k]), np.asarray(f_on[k]))
+
+
+# --------------------------------------------------------------------- #
+# /metrics scrape under chaos (satellite)
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.chaos
+def test_metrics_endpoint_serveable_under_chaos(tmp_path, workers):
+    """The exposition endpoint answers 200 throughout a distributed
+    train in which a dist.histogram_rpc failpoint fires, and the final
+    scrape carries the worker latency histogram as cumulative _bucket
+    series (the acceptance criterion's scrape)."""
+    cache = create_dataset_cache(
+        _frame(), str(tmp_path / "cache"), label="y",
+        task=Task.REGRESSION, feature_shards=2,
+    )
+    addrs = workers(2)
+    with telemetry.active(str(tmp_path / "t")):
+        srv = telemetry_http.start_metrics_server(0)
+        codes, stop = [], threading.Event()
+
+        def scrape_loop():
+            while not stop.is_set():
+                try:
+                    with urllib.request.urlopen(
+                        srv.url("/metrics"), timeout=5
+                    ) as r:
+                        codes.append(r.status)
+                except Exception as e:  # any failure fails the test
+                    codes.append(str(e))
+                time.sleep(0.02)
+
+        t = threading.Thread(target=scrape_loop, daemon=True)
+        t.start()
+        with failpoints.active("dist.histogram_rpc=drop_conn@3"):
+            model = _learner(distributed_workers=addrs).train(cache)
+            assert "dist.histogram_rpc" in failpoints.fired_sites()
+        stop.set()
+        t.join(timeout=10)
+        assert codes and all(c == 200 for c in codes), codes
+
+        final = urllib.request.urlopen(
+            srv.url("/metrics"), timeout=5
+        ).read().decode()
+        assert "ydf_worker_request_latency_ns_bucket{" in final
+        assert 'le="+Inf"' in final
+        assert "ydf_dist_recoveries_total" in final
+
+        # /statusz names each in-process worker with shard ownership
+        # and the position stamp.
+        st = json.loads(
+            urllib.request.urlopen(srv.url("/statusz"), timeout=5).read()
+        )
+        wkeys = [k for k in st if k.startswith("worker:")]
+        assert len(wkeys) >= 2
+        dists = [v["dist"] for k, v in st.items() if k in wkeys]
+        runs = [r for d in dists for r in d.values()]
+        assert any(r["shards"] for r in runs)
+        assert all(len(r["pos"]) == 2 for r in runs)
+    assert model.training_logs["distributed"]["recoveries"] >= 1

@@ -1,0 +1,78 @@
+"""Metric-name / failpoint-site lint (scripts/check_metric_names.py):
+the tree's registry call sites and failpoint sites must follow the
+naming convention and be documented in docs/observability.md — the
+drift guard the serving-metrics episode (PR 7) motivated."""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "check_metric_names.py")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "check_metric_names", SCRIPT
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_tree_is_clean():
+    mod = _load()
+    summary = mod.check()
+    assert summary["metrics_scanned"] > 20  # the scan actually scans
+    assert summary["failpoint_sites"] >= 14
+    assert summary["ok"], "\n".join(summary["violations"])
+
+
+def test_every_known_failpoint_site_documented():
+    mod = _load()
+    from ydf_tpu.utils import failpoints
+
+    documented = mod.doc_names(
+        os.path.join(REPO, "docs", "observability.md")
+    )
+    missing = sorted(failpoints.KNOWN_SITES - documented)
+    assert not missing, missing
+
+
+def test_violations_are_reported(tmp_path):
+    """Negative case: a tree with a mis-named counter, an undocumented
+    metric, an undocumented site, and a mis-suffixed histogram fails
+    with one violation each."""
+    mod = _load()
+    src = tmp_path / "tree"
+    src.mkdir()
+    (src / "bad.py").write_text(
+        'from ydf_tpu.utils import telemetry, failpoints\n'
+        'telemetry.counter("ydf_missing_suffix").inc()\n'
+        'telemetry.counter("bad_prefix_total").inc()\n'
+        'telemetry.histogram("ydf_undoc_latency_ns").observe_ns(1)\n'
+        'telemetry.histogram("ydf_no_unit_histogram").observe_ns(1)\n'
+        'telemetry.gauge("ydf_gauge_total").set(1)\n'
+        'telemetry.counter("ydf_compute_ns_layer_total").inc()\n'
+        'failpoints.hit("undoc.site")\n'
+    )
+    doc = tmp_path / "doc.md"
+    doc.write_text(
+        "inventory: `ydf_missing_suffix` `ydf_gauge_total` "
+        "`ydf_no_unit_histogram` `bad_prefix_total` "
+        "`ydf_compute_ns_layer_total`\n"
+        "(every real KNOWN_SITES entry, leaving one synthetic site out)\n"
+        "cache.write_chunk cache.finalize snapshot.save snapshot.index "
+        "worker.recv worker.handle worker.send native.build "
+        "native.register gbt.chunk dist.shard_load dist.histogram_rpc "
+        "dist.split_broadcast telemetry.flush\n"
+    )
+    summary = mod.check(root=str(src), doc_path=str(doc))
+    v = "\n".join(summary["violations"])
+    assert not summary["ok"]
+    assert "ydf_missing_suffix" in v and "_total" in v
+    assert "bad_prefix_total" in v
+    assert "ydf_undoc_latency_ns" in v and "not documented" in v
+    assert "ydf_no_unit_histogram" in v and "unit suffix" in v
+    assert "ydf_gauge_total" in v and "reserved for counters" in v
+    assert "ydf_compute_ns_layer_total" in v and "time unit" in v
+    assert "undoc.site" in v

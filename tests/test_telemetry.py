@@ -111,14 +111,48 @@ def test_counters_gauges_prometheus_text():
         txt = telemetry.metrics_text()
         assert 'ydf_test_total{kind="a"} 3' in txt
         assert "ydf_test_gauge 3.5" in txt
+        # Histograms export REAL cumulative Prometheus series from the
+        # log2 buckets (aggregatable by an actual scraper), not
+        # percentile gauges: _bucket at octave bounds, +Inf, _sum,
+        # _count.
+        assert "# TYPE ydf_test_latency_ns histogram" in txt
+        assert 'ydf_test_latency_ns_bucket{engine="X",le="1024"} 1' in txt
+        assert 'ydf_test_latency_ns_bucket{engine="X",le="+Inf"} 1' in txt
+        assert 'ydf_test_latency_ns_sum{engine="X"} 1000' in txt
         assert 'ydf_test_latency_ns_count{engine="X"} 1' in txt
-        assert 'quantile="0.5"' in txt
         snap = telemetry.snapshot()
         assert snap["counters"]['ydf_test_total{kind="a"}'] == 3
         # The native-kernel wall counters ride every dump as registered
         # gauges (profiling.native_kernel_metrics default collector).
         assert "ydf_native_hist_kernel_seconds" in snap["gauges"]
         assert "ydf_native_route_kernel_seconds" in snap["gauges"]
+
+
+def test_histogram_bucket_series_are_cumulative():
+    """The _bucket series is monotone, its +Inf sample equals _count,
+    and bucket boundaries are value-independent octave bounds — the
+    property a scraper needs to aggregate across workers."""
+    import re
+
+    with telemetry.active():
+        h = telemetry.histogram("ydf_test_latency_ns")
+        for v in (3, 100, 100, 5_000, 70_000, 70_001):
+            h.observe_ns(v)
+        txt = telemetry.metrics_text()
+    buckets = re.findall(
+        r'ydf_test_latency_ns_bucket\{le="([^"]+)"\} (\d+)', txt
+    )
+    assert buckets[-1][0] == "+Inf" and int(buckets[-1][1]) == 6
+    finite = [(float(le), int(c)) for le, c in buckets[:-1]]
+    # Monotone cumulative counts over increasing power-of-two bounds.
+    assert all(
+        b[0] > a[0] and b[1] >= a[1] for a, b in zip(finite, finite[1:])
+    )
+    assert all(le == float(int(le)) and (int(le) & (int(le) - 1)) == 0
+               for le, _ in finite)
+    # Spot-check: everything <= 128 is 3 observations (3, 100, 100).
+    by_le = dict(finite)
+    assert by_le[128.0] == 3
 
 
 def test_span_nesting_and_jsonl_roundtrip(tmp_path):

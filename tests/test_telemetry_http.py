@@ -1,0 +1,147 @@
+"""Exposition endpoints (utils/telemetry_http.py): eager env grammar,
+/metrics /healthz /statusz contents, status-provider robustness, the
+serving /statusz section, and the one-server-per-process contract."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ydf_tpu.utils import telemetry, telemetry_http
+
+
+@pytest.fixture(autouse=True)
+def _fresh_server():
+    yield
+    telemetry_http._reset_for_tests()
+
+
+def _get(srv, path):
+    with urllib.request.urlopen(srv.url(path), timeout=5) as r:
+        return r.status, r.read()
+
+
+# --------------------------------------------------------------------- #
+# Env grammar (eager, the YDF_TPU_HIST_IMPL policy)
+# --------------------------------------------------------------------- #
+
+
+def test_metrics_port_env_grammar():
+    p = telemetry_http._parse_metrics_port
+    assert p(None) is None
+    assert p("") is None
+    assert p("  ") is None
+    assert p("0") == 0
+    assert p("9100") == 9100
+    with pytest.raises(ValueError, match="YDF_TPU_METRICS_PORT"):
+        p("banana")
+    with pytest.raises(ValueError, match="outside"):
+        p("70000")
+    with pytest.raises(ValueError, match="outside"):
+        p("-1")
+
+
+def test_maybe_start_from_env_is_off_by_default():
+    # The suite runs without YDF_TPU_METRICS_PORT: the zero-overhead
+    # default means no server, no thread, no socket.
+    if telemetry_http.METRICS_PORT is None:
+        assert telemetry_http.maybe_start_from_env() is None
+
+
+# --------------------------------------------------------------------- #
+# Endpoints
+# --------------------------------------------------------------------- #
+
+
+def test_metrics_healthz_statusz_and_404():
+    with telemetry.active():
+        telemetry.counter("ydf_test_total").inc(2)
+        telemetry.histogram("ydf_test_latency_ns").observe_ns(500)
+        srv = telemetry_http.start_metrics_server(0)
+        assert srv.port > 0
+
+        code, body = _get(srv, "/metrics")
+        assert code == 200
+        txt = body.decode()
+        assert "ydf_test_total 2" in txt
+        assert 'ydf_test_latency_ns_bucket{le="+Inf"} 1' in txt
+
+        code, body = _get(srv, "/healthz")
+        assert code == 200 and body == b"ok\n"
+
+        telemetry_http.register_status("unit", lambda: {"a": 1})
+        code, body = _get(srv, "/statusz")
+        assert code == 200
+        st = json.loads(body)
+        assert st["unit"] == {"a": 1}
+        assert st["pid"] > 0 and st["trace"] == telemetry.TRACE_ID
+        telemetry_http.unregister_status("unit")
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv, "/nope")
+        assert ei.value.code == 404
+
+
+def test_broken_status_provider_degrades_not_fails():
+    def boom():
+        raise RuntimeError("kaput")
+
+    telemetry_http.register_status("broken", boom)
+    try:
+        st = telemetry_http.status_snapshot()
+        assert "kaput" in st["broken"]["error"]
+        srv = telemetry_http.start_metrics_server(0)
+        code, body = _get(srv, "/statusz")
+        assert code == 200 and b"kaput" in body
+    finally:
+        telemetry_http.unregister_status("broken")
+
+
+def test_one_server_per_process():
+    a = telemetry_http.start_metrics_server(0)
+    b = telemetry_http.start_metrics_server(0)
+    assert a is b
+
+
+def test_serving_status_section():
+    """The serving registry registers a /statusz section naming the
+    selected engine and live batcher depths."""
+    import numpy as np
+
+    import ydf_tpu as ydf
+    from ydf_tpu.serving import registry
+
+    rng = np.random.RandomState(0)
+    data = {
+        "x": rng.normal(size=400).astype(np.float32),
+        "y": (rng.normal(size=400) > 0).astype(np.int64),
+    }
+    model = ydf.GradientBoostedTreesLearner(
+        label="y", num_trees=2, max_depth=3
+    ).train(data)
+    registry.best_engine(model)
+    st = registry.serving_status()
+    assert st["engine"] in (
+        "NativeBatch", "QuickScorer", "PallasBank", "Routed"
+    )
+    with registry.CoalescingBatcher(lambda x: x, max_batch=4) as b:
+        st = registry.serving_status()
+        assert any(
+            row["max_batch"] == 4 and not row["closed"]
+            for row in st["batchers"]
+        )
+    # Registered into /statusz under "serving".
+    snap = telemetry_http.status_snapshot()
+    assert "serving" in snap and "engine" in snap["serving"]
+
+
+def test_scrape_counter_rides_metrics():
+    with telemetry.active():
+        srv = telemetry_http.start_metrics_server(0)
+        _get(srv, "/metrics")
+        _, body = _get(srv, "/metrics")
+        assert (
+            'ydf_metrics_http_requests_total{path="/metrics"}'
+            in body.decode()
+        )

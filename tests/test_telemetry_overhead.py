@@ -21,9 +21,15 @@ def _load():
 
 
 def test_enabled_overhead_within_budget():
+    """Enabled-path AND endpoint-enabled variants: with the /metrics
+    HTTP thread serving scrapes during the run, the train hot path
+    must still fit the same budget — the exposition thread costs
+    nothing on it."""
     mod = _load()
-    summary = mod.run_check(rows=8_000, trees=8, depth=4, reps=2)
+    summary = mod.run_check(rows=8_000, trees=8, depth=4, reps=2,
+                            with_http=True)
     assert summary["disabled_min_s"] > 0
+    assert "ok_http" in summary and summary["enabled_http_min_s"] > 0
     assert summary["ok"], (
         "telemetry enabled-path overhead exceeded its budget: "
         f"{summary}"

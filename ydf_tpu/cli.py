@@ -50,6 +50,13 @@ def cmd_train(args):
         # Post-import arming (the env var is parsed before argv exists);
         # train() flushes the trace + metrics dump there.
         telemetry.configure(directory=args.telemetry_dir)
+    from ydf_tpu.utils import telemetry_http
+
+    if getattr(args, "metrics_port", None) is not None:
+        srv = telemetry_http.start_metrics_server(args.metrics_port)
+        log.info(f"metrics endpoints on 127.0.0.1:{srv.port}")
+    else:
+        telemetry_http.maybe_start_from_env()
     cls = getattr(ydf, _LEARNERS[args.learner])
     kwargs = json.loads(args.hyperparameters) if args.hyperparameters else {}
     if args.learner == "ISOLATION_FOREST":
@@ -381,7 +388,10 @@ def cmd_worker(args):
     from ydf_tpu.parallel.worker_service import start_worker
 
     print(f"worker listening on {args.host}:{args.port}", flush=True)
-    start_worker(args.port, host=args.host)
+    start_worker(
+        args.port, host=args.host,
+        metrics_port=getattr(args, "metrics_port", None),
+    )
 
 
 def main(argv=None):
@@ -396,6 +406,10 @@ def main(argv=None):
     p.add_argument("--port", type=int, required=True)
     p.add_argument("--host", default="127.0.0.1",
                    help="bind address; 0.0.0.0 only on trusted networks")
+    p.add_argument("--metrics_port", type=int,
+                   help="serve /metrics /healthz /statusz on this "
+                        "loopback port (0 = ephemeral; same as "
+                        "YDF_TPU_METRICS_PORT — docs/observability.md)")
     p.add_argument("--cpu", action="store_true")
     p.set_defaults(fn=cmd_worker)
 
@@ -442,6 +456,10 @@ def main(argv=None):
                         "metrics dump here (same as "
                         "YDF_TPU_TELEMETRY_DIR; see "
                         "docs/observability.md)")
+    p.add_argument("--metrics_port", type=int,
+                   help="serve /metrics /healthz /statusz on this "
+                        "loopback port while training (0 = ephemeral; "
+                        "same as YDF_TPU_METRICS_PORT)")
     p.add_argument("--workers",
                    help="comma-separated host:port addresses of "
                         "`ydf_tpu.cli worker` processes for feature-"

@@ -24,6 +24,7 @@ final truncation.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import os
 import signal
@@ -2007,7 +2008,7 @@ def _train_gbt(
     from ydf_tpu.utils.snapshot import _durable_replace
 
     chunk_walls = []
-    with _PreemptionGuard() as guard:
+    with _PreemptionGuard() as guard, _flight_guard():
         while start < num_trees:
             clen = _chunk_len(
                 snapshot_interval, start, num_trees, use_dart
@@ -2063,6 +2064,21 @@ def _train_gbt(
             if guard.triggered:
                 # The snapshot just saved IS the forced final snapshot;
                 # exit resumable with a distinct (schedulable) outcome.
+                # Telemetry buffered since the last flush would die with
+                # this process: export it and write the flight-recorder
+                # black box BEFORE raising (the exit-75 path used to
+                # lose every span since the previous flush). Both are
+                # no-ops when telemetry is off / has no export dir.
+                if telemetry.ENABLED:
+                    _emit_train_spans(
+                        chunk_walls, start, tree_cfg.max_depth
+                    )
+                    telemetry.flight_record(
+                        "preempt", signal=guard.signal_name,
+                        completed_iters=start, num_trees=num_trees,
+                    )
+                    telemetry.flush()
+                    telemetry.flight_dump("preempt")
                 raise TrainingPreempted(
                     f"training preempted by {guard.signal_name}: "
                     f"snapshot at {start}/{num_trees} iterations in "
@@ -2196,7 +2212,32 @@ def _train_gbt_distributed(
         hist_subtract=resolve_hist_subtract(None),
         hist_quant=resolve_hist_quant(None),
     )
-    return mgr.train()
+    with _flight_guard():
+        return mgr.train()
+
+
+@contextlib.contextmanager
+def _flight_guard():
+    """Flight-recorder guard around a boosting loop: an exception that
+    escapes it (failpoint crash, worker-fleet loss, a real bug) flushes
+    buffered telemetry and writes the crash black box
+    (`flight_<pid>.jsonl`) before propagating — the run stays
+    diagnosable even though it died mid-chunk. TrainingPreempted is
+    excluded: the preemption path writes its own dump with the signal
+    name. Free no-op when telemetry is off; the dump itself never
+    raises."""
+    try:
+        yield
+    except TrainingPreempted:
+        raise
+    except BaseException as e:
+        if telemetry.ENABLED:
+            telemetry.flight_record(
+                "exception", error=f"{type(e).__name__}: {e}"
+            )
+            telemetry.flush()
+            telemetry.flight_dump("train_exception")
+        raise
 
 
 class _TrainingAborted(RuntimeError):

@@ -68,7 +68,7 @@ import os
 import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -254,6 +254,18 @@ class _DistStats:
         self.stats_bytes = 0
         self.recoveries = 0
         self.shard_rebuilds = 0
+        # Per-layer wall attribution (compute / network / straggler
+        # wait, summed over all layers of the run): the "was that layer
+        # slow because of compute, the network, or one straggler?"
+        # breakdown the headline bench records carry.
+        self.layer_wall_ns = 0
+        self.compute_ns = 0
+        self.net_ns = 0
+        self.wait_ns = 0
+        # Per-worker telemetry drained via get_telemetry (event counts
+        # by address; the events themselves are merged into the
+        # manager's trace buffer).
+        self.drained_events: Dict[str, int] = {}
 
     def observe_rpc(self, verb: str, dur_ns: int) -> None:
         self.rpc_ns.setdefault(verb, LatencyHistogram()).observe_ns(dur_ns)
@@ -262,12 +274,57 @@ class _DistStats:
                 "ydf_dist_rpc_latency_ns", verb=verb
             ).observe_ns(dur_ns)
 
+    def observe_layer(
+        self, wall_ns: int, hist_rpcs: Dict[int, Tuple[int, Optional[int]]]
+    ) -> None:
+        """Attributes one layer's wall into compute/net/wait from the
+        per-worker histogram-RPC walls (manager-measured) and worker
+        handle times (`_handle_ns` from the response):
+
+          wait    = slowest − median histogram RPC (straggler wait —
+                    the fan-out is a barrier, so everything past the
+                    median worker's finish is waiting on stragglers);
+          net     = median RPC wall − median worker handle time
+                    (serialization + transport of the typical RPC);
+          compute = the remainder (worker histogram kernels + the
+                    manager's own split search / routing merge).
+
+        The three sum to the layer wall by construction."""
+        from statistics import median
+
+        walls = sorted(w for w, _ in hist_rpcs.values())
+        wait = net = 0
+        if walls:
+            med_w = median(walls)
+            wait = int(max(walls[-1] - med_w, 0))
+            handles = sorted(
+                h for _, h in hist_rpcs.values() if h is not None
+            )
+            med_h = median(handles) if handles else med_w
+            net = int(max(med_w - med_h, 0))
+        wait = min(wait, wall_ns)
+        net = min(net, wall_ns - wait)
+        self.layer_wall_ns += wall_ns
+        self.wait_ns += wait
+        self.net_ns += net
+        self.compute_ns += wall_ns - wait - net
+        if telemetry.ENABLED:
+            telemetry.counter("ydf_dist_layer_wait_ns_total").inc(wait)
+            telemetry.counter("ydf_dist_layer_net_ns_total").inc(net)
+            telemetry.counter("ydf_dist_layer_compute_ns_total").inc(
+                wall_ns - wait - net
+            )
+
     def summary(self) -> Dict[str, Any]:
-        return {
+        out = {
             "reduce_bytes": int(self.reduce_bytes),
             "stats_bytes": int(self.stats_bytes),
             "recoveries": int(self.recoveries),
             "shard_rebuilds": int(self.shard_rebuilds),
+            "layer_wall_s": round(self.layer_wall_ns / 1e9, 6),
+            "compute_s": round(self.compute_ns / 1e9, 6),
+            "net_s": round(self.net_ns / 1e9, 6),
+            "wait_s": round(self.wait_ns / 1e9, 6),
             "rpc_p50_ns": {
                 v: round(h.percentile_ns(50), 1)
                 for v, h in sorted(self.rpc_ns.items())
@@ -276,6 +333,9 @@ class _DistStats:
                 v: int(h.count) for v, h in sorted(self.rpc_ns.items())
             },
         }
+        if self.drained_events:
+            out["telemetry_drained_events"] = dict(self.drained_events)
+        return out
 
 
 class DistGBTManager:
@@ -336,16 +396,39 @@ class DistGBTManager:
 
     # ---- RPC plumbing ------------------------------------------------ #
 
-    def _request(self, widx: int, req: Dict[str, Any], site: str):
+    def _stamp(self, req: Dict[str, Any], widx: int) -> Dict[str, Any]:
+        """Stamps the manager's trace context into the request frame
+        (`_trace` beside `verb` — just another dict key, so the
+        pickle+HMAC framing is untouched at the byte level): the
+        worker's per-request span records it, which is what makes the
+        merged cross-process trace attributable. Must be called on the
+        thread holding the open span (the training loop's), not the
+        fan-out executor's."""
+        if telemetry.ENABLED:
+            ctx = telemetry.current_context()
+            if ctx is not None:
+                req["_trace"] = {
+                    **ctx, "worker_index": widx % len(self.pool.addresses)
+                }
+        return req
+
+    def _request(self, widx: int, req: Dict[str, Any], site: str,
+                 rpc_record: Optional[Dict[int, Tuple[int, Optional[int]]]]
+                 = None):
         """One RPC with failpoint injection + latency accounting.
         Transport failures (including the straggler timeout) raise
-        ConnectionError/OSError for the caller's recovery logic."""
+        ConnectionError/OSError for the caller's recovery logic.
+        `rpc_record[widx] = (wall_ns, handle_ns)` collects per-worker
+        walls for the layer's compute/net/wait attribution."""
         failpoints.hit(site)
         t0 = time.perf_counter_ns()
         resp = self.pool.request(
             widx, req, timeout_s=self.rpc_timeout_s
         )
-        self.stats.observe_rpc(req["verb"], time.perf_counter_ns() - t0)
+        wall_ns = time.perf_counter_ns() - t0
+        self.stats.observe_rpc(req["verb"], wall_ns)
+        if rpc_record is not None and isinstance(resp, dict):
+            rpc_record[widx] = (wall_ns, resp.get("_handle_ns"))
         return resp
 
     def _state_payload(self) -> Dict[str, Any]:
@@ -386,7 +469,9 @@ class DistGBTManager:
             if with_state:
                 req["state"] = self._state_payload()
             try:
-                resp = self._request(widx, req, "dist.shard_load")
+                resp = self._request(
+                    widx, self._stamp(req, widx), "dist.shard_load"
+                )
             except (OSError, ConnectionError) as e:
                 log.debug(
                     f"dist: shard load on {self.pool.addr_str(widx)} "
@@ -428,15 +513,22 @@ class DistGBTManager:
             f"{self.pool.retry_attempts} attempts"
         )
 
-    def _fan_out(self, groups: Dict[int, List[int]], make_req, site: str):
+    def _fan_out(self, groups: Dict[int, List[int]], make_req, site: str,
+                 rpc_record=None):
         """Concurrent per-worker RPCs (the workers compute their
         histogram slices in parallel); results are handled in sorted
         worker order so recovery decisions stay deterministic. Returns
-        [(widx, sids, resp_or_exception)]."""
+        [(widx, sids, resp_or_exception)]. Requests are built AND
+        trace-stamped on this (the caller's) thread — the open
+        dist.layer span is thread-local."""
         order = sorted(groups)
         with ThreadPoolExecutor(max_workers=max(len(order), 1)) as ex:
             futs = {
-                w: ex.submit(self._request, w, make_req(groups[w]), site)
+                w: ex.submit(
+                    self._request, w,
+                    self._stamp(make_req(groups[w]), w), site,
+                    rpc_record,
+                )
                 for w in order
             }
             out = []
@@ -457,16 +549,116 @@ class DistGBTManager:
         """Transport failure / straggler timeout on `widx`: quarantine
         it and move its shards (with the authoritative state) to the
         next healthy worker — the reference's worker-reassignment
-        semantics."""
+        semantics. Before moving on, a best-effort telemetry drain
+        rescues the dying worker's last spans (a worker that dropped
+        one connection may still answer a short get_telemetry; one that
+        is really gone costs a bounded timeout)."""
         self.pool.mark_failed(widx)
         self.stats.recoveries += 1
         if telemetry.ENABLED:
             telemetry.counter("ydf_dist_recoveries_total").inc()
+            self._drain_worker_telemetry([widx], timeout_s=5.0)
         new_w = self._pick_replacement(widx + 1)
         self._load_shards(new_w, sids, with_state=True)
 
+    # ---- cross-process telemetry drain / trace merge ----------------- #
+
+    def _drain_worker_telemetry(
+        self, indices: Optional[List[int]] = None,
+        timeout_s: Optional[float] = None,
+    ) -> None:
+        """Drains each worker's span buffer + metrics snapshot via the
+        `get_telemetry` verb and merges the spans into the manager's
+        trace buffer, producing ONE chrome-tracing file at the next
+        flush. Worker clocks are corrected onto the manager's
+        perf_counter epoch by the PING RTT midpoint: ping handling is
+        a dict literal, so its clock sample sits at the RPC midpoint
+        within ~rtt/2, and taking the minimum-RTT of a few pings
+        bounds the error tightly. With the best (t_send, sample,
+        t_recv) triple,
+
+            offset = worker_clock − (t_send + rtt/2)
+
+        and every drained timestamp shifts by −offset — nesting under
+        the manager's layer spans survives cross-host clock skew. Each
+        worker gets its own pid row (real pid when the worker is a
+        separate process, synthetic for in-process fleets) plus a
+        process_name metadata event naming its address. Best-effort:
+        an unreachable worker is skipped, never an error."""
+        if not telemetry.ENABLED:
+            return
+        done = set()
+        for widx in (
+            indices if indices is not None
+            else range(len(self.pool.addresses))
+        ):
+            addr = self.pool.addr_str(widx)
+            if addr in done:
+                continue
+            done.add(addr)
+            t_out = timeout_s or min(30.0, self.rpc_timeout_s)
+            try:
+                # Clock offset from the minimum-RTT ping of a few: ping
+                # handling is trivial, so its sample sits at the RPC
+                # midpoint within ~rtt/2 (get_telemetry's own handling
+                # is drain + snapshot — tens of ms on first call, which
+                # would bias a midpoint estimate; measured +31 ms).
+                offset_ns = None
+                best_rtt = None
+                for _ in range(3):
+                    t_send = time.perf_counter_ns()
+                    pong = self.pool.request(
+                        widx, {"verb": "ping"},
+                        timeout_s=min(10.0, t_out),
+                    )
+                    t_recv = time.perf_counter_ns()
+                    if not pong.get("ok") or "clock_ns" not in pong:
+                        break
+                    rtt = t_recv - t_send
+                    if best_rtt is None or rtt < best_rtt:
+                        best_rtt = rtt
+                        offset_ns = pong["clock_ns"] - (
+                            t_send + rtt // 2
+                        )
+                resp = self.pool.request(
+                    widx, {"verb": "get_telemetry"}, timeout_s=t_out
+                )
+            except (OSError, ConnectionError):
+                continue
+            if not isinstance(resp, dict) or not resp.get("ok"):
+                continue
+            if offset_ns is None:
+                # No clock-bearing ping answered (protocol anomaly):
+                # merge uncorrected rather than apply a garbage offset.
+                offset_ns = 0
+            wpid = resp.get("pid")
+            if wpid is None or wpid == os.getpid():
+                # In-process fleet: synthesize a distinct pid row per
+                # worker so the trace still shows per-worker lanes.
+                wpid = 1_000_000 + (widx % len(self.pool.addresses))
+            merged = [{
+                "name": "process_name", "ph": "M", "pid": wpid,
+                "cat": "ydf_tpu",
+                "args": {"name": f"worker {addr}"},
+            }]
+            for ev in resp.get("events", []):
+                ev = dict(ev)
+                if "ts" in ev:
+                    ev["ts"] = ev["ts"] - offset_ns / 1000.0
+                ev["pid"] = wpid
+                merged.append(ev)
+            telemetry.ingest_events(merged)
+            n = len(merged) - 1
+            self.stats.drained_events[addr] = (
+                self.stats.drained_events.get(addr, 0) + n
+            )
+            if telemetry.ENABLED:
+                telemetry.counter(
+                    "ydf_dist_telemetry_drained_events_total"
+                ).inc(n)
+
     def _exchange(self, sids: List[int], make_req, site: str,
-                  on_ok) -> None:
+                  on_ok, rpc_record=None) -> None:
         """Generic resilient fan-out: retries each shard group through
         failures, reassignments, and worker-restart need_shard replies
         until every shard in `sids` has answered."""
@@ -475,7 +667,8 @@ class DistGBTManager:
             if not pending:
                 return
             for widx, group, resp in self._fan_out(
-                self._groups(sorted(pending)), make_req, site
+                self._groups(sorted(pending)), make_req, site,
+                rpc_record,
             ):
                 if isinstance(resp, failpoints.FailpointError):
                     raise resp
@@ -555,6 +748,12 @@ class DistGBTManager:
                     f"dist gbt: iter {it + 1}/{self.num_trees} "
                     f"train_loss={tls[-1]:.6g}"
                 )
+
+        # Cross-process observability: drain every worker's span buffer
+        # and metrics snapshot, clock-correct onto this host's epoch,
+        # and merge into the manager's buffer — the next flush writes
+        # ONE chrome-tracing file with per-worker pid rows.
+        self._drain_worker_telemetry()
 
         wall_ns = time.perf_counter_ns() - t0_ns
         from ydf_tpu.ops.grower import TreeArrays
@@ -647,180 +846,198 @@ class DistGBTManager:
         )
 
         for depth in range(D):
-            key_t, k_gain, k_feat = jax.random.split(
-                jax.random.fold_in(key_t, depth), 3
-            )
-            children = depth + 1 < D
-            Ld = min(2 ** depth, L)
-
-            # ---- 1. histogram gather (workers, feature-sliced) ----- #
-            if sub_state is not None:
-                _ph, _sil, Lh = sub_state
-                num_slots = Lh
-                compact = (
-                    (self.n // 2 + Lh + 8)
-                    if self.hist_impl == "segment" else 0
-                )
-            else:
-                num_slots = Ld
-                compact = 0
-            base_req = {
-                "verb": "build_histograms", "key": self.key_id,
-                "tree": it, "layer": depth, "reset": depth == 0,
-                "num_slots": num_slots, "num_bins": B,
-                "impl": self.hist_impl, "quant": self.hist_quant,
-                "compact": compact,
-            }
-            if depth == 0:
-                base_req["stats"] = {
-                    "hist_stats": self.cur_hist_stats,
-                    "qscale": self.cur_qscale,
-                }
-            if pending_route is not None:
-                base_req["route"] = pending_route
-
-            slices: Dict[int, np.ndarray] = {}
-
-            def on_hist(widx, group, resp, _slices=slices):
-                for k, h in resp["hists"].items():
-                    _slices[int(k)] = h
-                    self.stats.reduce_bytes += h.nbytes
+            # One manager span per layer: worker histogram-RPC spans
+            # nest under it in the merged trace, and the layer's wall
+            # is attributed into compute/net/wait from the fan-out's
+            # per-worker RPC walls (observe_layer).
+            t_layer0 = time.perf_counter_ns()
+            hist_rpcs: Dict[int, Tuple[int, Optional[int]]] = {}
+            with telemetry.span("dist.layer") as lsp:
                 if telemetry.ENABLED:
-                    telemetry.counter(
-                        "ydf_dist_reduce_bytes_total"
-                    ).inc(sum(h.nbytes for h in resp["hists"].values()))
-
-            self._exchange(
-                list(range(self.num_shards)),
-                lambda sids, _r=base_req: {**_r, "shards": sids},
-                "dist.histogram_rpc",
-                on_hist,
-            )
-            hist_np = np.concatenate(
-                [slices[k] for k in range(self.num_shards)], axis=1
-            )  # [num_slots, F, B, S] — shard order == feature order
-
-            if sub_state is not None:
-                parent_hist, small_is_left, Lh = sub_state
-                hist = _j_sibling_reconstruct(
-                    jnp.asarray(hist_np), parent_hist, small_is_left,
-                    Ld=Ld,
+                    lsp.set(tree=it, layer=depth)
+                key_t, k_gain, k_feat = jax.random.split(
+                    jax.random.fold_in(key_t, depth), 3
                 )
-            else:
-                hist = jnp.asarray(hist_np)
+                children = depth + 1 < D
+                Ld = min(2 ** depth, L)
 
-            # ---- 2. split search (the grower's shared seam) -------- #
-            out = _j_layer_step(
-                hist, jnp.asarray(node_stats[:Ld]),
-                jnp.asarray(frontier_id[:Ld] < N),
-                jnp.asarray(frontier_id[:Ld]), num_nodes,
-                k_gain, k_feat,
-                rule=self.rule, L=L, B=B, N=N, Fn=self.Fn, Fc=self.Fc,
-                O=1, min_examples=self.cfg.min_examples,
-                min_split_gain=self.min_split_gain,
-                candidate_features=self.candidate_features,
-                num_valid_features=None, children=children,
-                subtract=self.hist_subtract,
-            )
-            dec = out["dec"]
-            num_nodes = dec.num_nodes
-            do_split = np.asarray(dec.do_split)
-            split_rank = np.asarray(dec.split_rank)
-            wid = np.asarray(dec.wid)
-            left_id = np.asarray(dec.left_id)
-            right_id = np.asarray(dec.right_id)
-            left_stats = np.asarray(dec.left_stats)
-            right_stats = np.asarray(dec.right_stats)
-            route_f = np.asarray(dec.route_f)
-            go_left_bins = np.asarray(dec.go_left_bins)
-
-            # ---- 3. node writes (manager-side tree arrays) --------- #
-            tree["feature"][wid] = np.asarray(dec.best_f_store)
-            tree["threshold_bin"][wid] = np.asarray(dec.best_t)
-            tree["is_cat"][wid] = np.asarray(dec.is_cat_split)
-            tree["is_set"][wid] = np.asarray(dec.is_set_split)
-            tree["cat_mask"][wid] = np.asarray(out["mask"])
-            tree["left"][wid] = left_id
-            tree["right"][wid] = right_id
-            tree["is_leaf"][wid] = False
-            tree["leaf_stats"][left_id] = left_stats
-            tree["leaf_stats"][right_id] = right_stats
-            # Trash row N collects every masked write; re-pin it.
-            tree["feature"][N] = -1
-            tree["is_leaf"][N] = True
-
-            # ---- 4. split broadcast / owner routing ---------------- #
-            hmap_np = (
-                np.asarray(out["hmap"]) if "hmap" in out
-                else np.arange(L + 1, dtype=i32)
-            )
-            tables = {
-                "L": L, "children": children,
-                "do_split": _pad_to(do_split, L + 1, False),
-                "route_f": _pad_to(route_f, L + 1, 0),
-                "go_left_bins": _pad_to(go_left_bins, L + 1, False),
-                "left_id": _pad_to(left_id, L + 1, N),
-                "right_id": _pad_to(right_id, L + 1, N),
-                "split_rank": _pad_to(split_rank, L + 1, 0),
-                "hmap": hmap_np,
-            }
-            merged = np.zeros(self.n, bool)
-            # Only shards owning a split feature route ("only one
-            # worker routes per split"); others receive the merged
-            # bitmap with the next layer's histogram request.
-            routing_sids = [
-                sid for sid, (lo, hi) in enumerate(self.col_ranges)
-                if np.any(do_split & (route_f >= lo) & (route_f < hi))
-            ]
-            split_req = {
-                "verb": "apply_split", "key": self.key_id,
-                "tree": it, "layer": depth,
-                "tables": {
-                    "do_split": tables["do_split"],
-                    "route_f": tables["route_f"],
-                    "go_left_bins": tables["go_left_bins"],
-                },
-            }
-
-            def on_bits(widx, group, resp, _m=merged):
-                from ydf_tpu.parallel.dist_worker import unpack_bits
-
-                _m |= unpack_bits(resp["bits"], self.n)
-
-            if routing_sids:
-                self._exchange(
-                    routing_sids,
-                    lambda sids, _r=split_req: {**_r, "shards": sids},
-                    "dist.split_broadcast",
-                    on_bits,
-                )
-            self.slot, self.leaf_id, self.hist_slot = apply_route_tables(
-                self.slot, self.leaf_id, merged, tables
-            )
-            self.pos = (it, depth + 1)
-            pending_route = {
-                "tables": tables, "go_left": pack_bits(merged)
-            }
-
-            # ---- 5. frontier + sibling carry for the next layer ---- #
-            if children:
-                tgt_l = np.where(do_split, 2 * split_rank, L)
-                tgt_r = np.where(do_split, 2 * split_rank + 1, L)
-                frontier_id = np.full((L + 1,), N, i32)
-                frontier_id[tgt_l] = left_id
-                frontier_id[tgt_r] = right_id
-                frontier_id[L] = N
-                node_stats = np.zeros((L + 1, S), np.float32)
-                node_stats[tgt_l] = left_stats
-                node_stats[tgt_r] = right_stats
-                node_stats[L] = 0.0
-                if "sub" in out:
-                    parent_next, small_next = out["sub"]
-                    sub_state = (
-                        parent_next, small_next, min(Ld, L // 2)
+                # ---- 1. histogram gather (workers, feature-sliced) - #
+                if sub_state is not None:
+                    _ph, _sil, Lh = sub_state
+                    num_slots = Lh
+                    compact = (
+                        (self.n // 2 + Lh + 8)
+                        if self.hist_impl == "segment" else 0
                     )
                 else:
-                    sub_state = None
+                    num_slots = Ld
+                    compact = 0
+                base_req = {
+                    "verb": "build_histograms", "key": self.key_id,
+                    "tree": it, "layer": depth, "reset": depth == 0,
+                    "num_slots": num_slots, "num_bins": B,
+                    "impl": self.hist_impl, "quant": self.hist_quant,
+                    "compact": compact,
+                }
+                if depth == 0:
+                    base_req["stats"] = {
+                        "hist_stats": self.cur_hist_stats,
+                        "qscale": self.cur_qscale,
+                    }
+                if pending_route is not None:
+                    base_req["route"] = pending_route
+
+                slices: Dict[int, np.ndarray] = {}
+
+                def on_hist(widx, group, resp, _slices=slices):
+                    for k, h in resp["hists"].items():
+                        _slices[int(k)] = h
+                        self.stats.reduce_bytes += h.nbytes
+                    if telemetry.ENABLED:
+                        telemetry.counter(
+                            "ydf_dist_reduce_bytes_total"
+                        ).inc(
+                            sum(h.nbytes for h in resp["hists"].values())
+                        )
+
+                self._exchange(
+                    list(range(self.num_shards)),
+                    lambda sids, _r=base_req: {**_r, "shards": sids},
+                    "dist.histogram_rpc",
+                    on_hist,
+                    rpc_record=hist_rpcs,
+                )
+                hist_np = np.concatenate(
+                    [slices[k] for k in range(self.num_shards)], axis=1
+                )  # [num_slots, F, B, S] — shard order == feature order
+
+                if sub_state is not None:
+                    parent_hist, small_is_left, Lh = sub_state
+                    hist = _j_sibling_reconstruct(
+                        jnp.asarray(hist_np), parent_hist, small_is_left,
+                        Ld=Ld,
+                    )
+                else:
+                    hist = jnp.asarray(hist_np)
+
+                # ---- 2. split search (the grower's shared seam) ---- #
+                out = _j_layer_step(
+                    hist, jnp.asarray(node_stats[:Ld]),
+                    jnp.asarray(frontier_id[:Ld] < N),
+                    jnp.asarray(frontier_id[:Ld]), num_nodes,
+                    k_gain, k_feat,
+                    rule=self.rule, L=L, B=B, N=N, Fn=self.Fn,
+                    Fc=self.Fc,
+                    O=1, min_examples=self.cfg.min_examples,
+                    min_split_gain=self.min_split_gain,
+                    candidate_features=self.candidate_features,
+                    num_valid_features=None, children=children,
+                    subtract=self.hist_subtract,
+                )
+                dec = out["dec"]
+                num_nodes = dec.num_nodes
+                do_split = np.asarray(dec.do_split)
+                split_rank = np.asarray(dec.split_rank)
+                wid = np.asarray(dec.wid)
+                left_id = np.asarray(dec.left_id)
+                right_id = np.asarray(dec.right_id)
+                left_stats = np.asarray(dec.left_stats)
+                right_stats = np.asarray(dec.right_stats)
+                route_f = np.asarray(dec.route_f)
+                go_left_bins = np.asarray(dec.go_left_bins)
+
+                # ---- 3. node writes (manager-side tree arrays) ----- #
+                tree["feature"][wid] = np.asarray(dec.best_f_store)
+                tree["threshold_bin"][wid] = np.asarray(dec.best_t)
+                tree["is_cat"][wid] = np.asarray(dec.is_cat_split)
+                tree["is_set"][wid] = np.asarray(dec.is_set_split)
+                tree["cat_mask"][wid] = np.asarray(out["mask"])
+                tree["left"][wid] = left_id
+                tree["right"][wid] = right_id
+                tree["is_leaf"][wid] = False
+                tree["leaf_stats"][left_id] = left_stats
+                tree["leaf_stats"][right_id] = right_stats
+                # Trash row N collects every masked write; re-pin it.
+                tree["feature"][N] = -1
+                tree["is_leaf"][N] = True
+
+                # ---- 4. split broadcast / owner routing ------------ #
+                hmap_np = (
+                    np.asarray(out["hmap"]) if "hmap" in out
+                    else np.arange(L + 1, dtype=i32)
+                )
+                tables = {
+                    "L": L, "children": children,
+                    "do_split": _pad_to(do_split, L + 1, False),
+                    "route_f": _pad_to(route_f, L + 1, 0),
+                    "go_left_bins": _pad_to(go_left_bins, L + 1, False),
+                    "left_id": _pad_to(left_id, L + 1, N),
+                    "right_id": _pad_to(right_id, L + 1, N),
+                    "split_rank": _pad_to(split_rank, L + 1, 0),
+                    "hmap": hmap_np,
+                }
+                merged = np.zeros(self.n, bool)
+                # Only shards owning a split feature route ("only one
+                # worker routes per split"); others receive the merged
+                # bitmap with the next layer's histogram request.
+                routing_sids = [
+                    sid for sid, (lo, hi) in enumerate(self.col_ranges)
+                    if np.any(do_split & (route_f >= lo) & (route_f < hi))
+                ]
+                split_req = {
+                    "verb": "apply_split", "key": self.key_id,
+                    "tree": it, "layer": depth,
+                    "tables": {
+                        "do_split": tables["do_split"],
+                        "route_f": tables["route_f"],
+                        "go_left_bins": tables["go_left_bins"],
+                    },
+                }
+
+                def on_bits(widx, group, resp, _m=merged):
+                    from ydf_tpu.parallel.dist_worker import unpack_bits
+
+                    _m |= unpack_bits(resp["bits"], self.n)
+
+                if routing_sids:
+                    self._exchange(
+                        routing_sids,
+                        lambda sids, _r=split_req: {**_r, "shards": sids},
+                        "dist.split_broadcast",
+                        on_bits,
+                    )
+                self.slot, self.leaf_id, self.hist_slot = (
+                    apply_route_tables(
+                        self.slot, self.leaf_id, merged, tables
+                    )
+                )
+                self.pos = (it, depth + 1)
+                pending_route = {
+                    "tables": tables, "go_left": pack_bits(merged)
+                }
+
+                # ---- 5. frontier + sibling carry for the next layer  #
+                if children:
+                    tgt_l = np.where(do_split, 2 * split_rank, L)
+                    tgt_r = np.where(do_split, 2 * split_rank + 1, L)
+                    frontier_id = np.full((L + 1,), N, i32)
+                    frontier_id[tgt_l] = left_id
+                    frontier_id[tgt_r] = right_id
+                    frontier_id[L] = N
+                    node_stats = np.zeros((L + 1, S), np.float32)
+                    node_stats[tgt_l] = left_stats
+                    node_stats[tgt_r] = right_stats
+                    node_stats[L] = 0.0
+                    if "sub" in out:
+                        parent_next, small_next = out["sub"]
+                        sub_state = (
+                            parent_next, small_next, min(Ld, L // 2)
+                        )
+                    else:
+                        sub_state = None
+            self.stats.observe_layer(
+                time.perf_counter_ns() - t_layer0, hist_rpcs
+            )
 
         # ---- tree end: verify (optional) + prediction update -------- #
         if self.verify:

@@ -355,6 +355,25 @@ def handle(verb: str, req: Dict[str, Any],
     return _HANDLERS[verb](req, worker_id)
 
 
+def status(worker_id: str = "local") -> Dict[str, Any]:
+    """This worker instance's distributed state for /statusz: one entry
+    per resident run key with the (tree, layer) position stamp, owned
+    shard ids and row count (docs/observability.md "Endpoints")."""
+    out: Dict[str, Any] = {}
+    with _STATE_LOCK:
+        items = [
+            (key, st) for (wid, key), st in _STATE.items()
+            if wid == worker_id
+        ]
+    for key, st in items:
+        out[key] = {
+            "pos": list(st.pos),
+            "shards": sorted(st.shards),
+            "rows": st.n,
+        }
+    return out
+
+
 def reset_state() -> None:
     """Drops all per-key worker state (tests)."""
     with _STATE_LOCK:

@@ -52,7 +52,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from ydf_tpu.utils import failpoints, telemetry
+from ydf_tpu.utils import failpoints, telemetry, telemetry_http
 
 _MAC_LEN = hashlib.sha256().digest_size  # 32
 
@@ -250,7 +250,42 @@ def _handle_request(
     verb = req.get("verb")
     wid = (ctx or {}).get("worker_id", "local")
     if verb == "ping":
-        return {"ok": True}
+        # The clock sample rides the CHEAPEST verb on purpose: ping
+        # handling is a dict literal, so the sample sits at the RPC's
+        # RTT midpoint within ~rtt/2 — the clock-correction bound the
+        # manager's trace merge relies on. (get_telemetry also reports
+        # a sample, but its handling — drain + snapshot, with one-time
+        # collector imports on first call — is tens of ms and would
+        # bias a midpoint estimate.)
+        return {"ok": True, "clock_ns": time.perf_counter_ns()}
+    if verb == "get_telemetry":
+        # Observability drain: the manager pulls this worker's span
+        # buffer and metrics snapshot at end-of-train (and on
+        # quarantine, so a dying worker's last spans survive). Spans
+        # are matched by the `worker` label the per-request span sets —
+        # in an IN-PROCESS fleet (tests, bench) several workers share
+        # one process buffer and each drains only its own spans; in a
+        # dedicated worker process every request span carries this
+        # worker's id anyway. `clock_ns` samples this process's
+        # perf_counter mid-RPC: the manager corrects the drained
+        # timestamps onto its own clock by the RPC's RTT midpoint.
+        if telemetry.ENABLED:
+            events = telemetry.drain_events(
+                match=lambda ev: (
+                    ev.get("args", {}).get("worker") == wid
+                )
+            )
+            metrics = telemetry.snapshot()
+        else:
+            events, metrics = [], {}
+        return {
+            "ok": True,
+            "events": events,
+            "metrics": metrics,
+            "clock_ns": time.perf_counter_ns(),
+            "pid": os.getpid(),
+            "worker_id": wid,
+        }
     if verb == "load_data":
         with _DATA_CACHE_LOCK:
             if len(_DATA_CACHE) >= _DATA_CACHE_CAP:
@@ -297,13 +332,18 @@ def _handle_request(
 
 def start_worker(
     port: int, host: str = "127.0.0.1", blocking: bool = True,
-    secret: Optional[bytes] = None,
+    secret: Optional[bytes] = None, metrics_port: Optional[int] = None,
 ) -> Optional[threading.Thread]:
     """Serves train/evaluate requests until a shutdown request arrives
     (reference ydf.start_worker). blocking=False runs the accept loop in
     a daemon thread and returns it (for tests). When a secret is set
     (param or YDF_TPU_WORKER_SECRET), unauthenticated or wrong-MAC
-    connections are dropped without executing anything."""
+    connections are dropped without executing anything.
+
+    Observability: with `metrics_port` set (or YDF_TPU_METRICS_PORT in
+    the env), the process exposition server is started and a /statusz
+    section is registered for this worker — id, per-run (tree, layer)
+    position stamps and shard ownership (docs/observability.md)."""
     if secret is None:
         secret = _env_secret()
     srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -315,6 +355,24 @@ def start_worker(
     # so several in-process workers (tests, bench) hold separate
     # slot/leaf arrays exactly like separate worker processes would.
     ctx = {"worker_id": f"{host}:{srv.getsockname()[1]}"}
+
+    if metrics_port is not None:
+        telemetry_http.start_metrics_server(metrics_port)
+    else:
+        telemetry_http.maybe_start_from_env()
+
+    def _worker_status(wid=ctx["worker_id"]):
+        from ydf_tpu.parallel import dist_worker
+
+        return {
+            "worker_id": wid,
+            "listening": not stop_evt.is_set(),
+            "dist": dist_worker.status(wid),
+        }
+
+    telemetry_http.register_status(
+        f"worker:{ctx['worker_id']}", _worker_status
+    )
 
     def serve_conn(conn: socket.socket) -> None:
         """One connection, on its own thread: a stalled or dead manager
@@ -333,23 +391,50 @@ def start_worker(
             failpoints.hit("worker.handle")
             # Per-request span + counters — the telemetry the
             # distributed round's manager-side debugging stands on
-            # (reference per-stage Monitoring logs).
+            # (reference per-stage Monitoring logs). The span carries
+            # this worker's id (the get_telemetry drain filter), the
+            # manager's propagated trace context (`_trace`: trace id,
+            # parent span id, this worker's pool index) and the
+            # distributed verbs' (tree, layer) position stamp, so a
+            # merged trace is attributable without cross-referencing
+            # logs.
             verb = str(req.get("verb")) if isinstance(req, dict) else "?"
             with telemetry.span("worker.request") as sp:
                 if telemetry.ENABLED:
-                    sp.set(verb=verb)
+                    sp.set(verb=verb, worker=ctx["worker_id"])
+                    tr = (
+                        req.get("_trace") if isinstance(req, dict) else None
+                    )
+                    if isinstance(tr, dict):
+                        sp.set(
+                            trace=tr.get("trace"),
+                            parent_span=tr.get("span"),
+                            worker_index=tr.get("worker_index"),
+                        )
+                    if isinstance(req, dict) and "tree" in req:
+                        sp.set(
+                            tree=req.get("tree"), layer=req.get("layer")
+                        )
                     telemetry.counter(
                         "ydf_worker_requests_total", verb=verb
                     ).inc()
-                    t0 = time.perf_counter_ns()
+                # Handle wall is measured unconditionally (one clock
+                # read per RPC — failpoints-contract granularity) and
+                # returned to the manager as `_handle_ns`: the
+                # compute/net/wait layer attribution needs it even when
+                # the worker process has telemetry off.
+                t0 = time.perf_counter_ns()
                 try:
                     resp = _handle_request(req, ctx)
                 except Exception as e:  # worker stays alive on task errors
                     resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+                handle_ns = time.perf_counter_ns() - t0
+                if isinstance(resp, dict):
+                    resp.setdefault("_handle_ns", handle_ns)
                 if telemetry.ENABLED:
                     telemetry.histogram(
                         "ydf_worker_request_latency_ns", verb=verb
-                    ).observe_ns(time.perf_counter_ns() - t0)
+                    ).observe_ns(handle_ns)
                     if not resp.get("ok"):
                         telemetry.counter(
                             "ydf_worker_request_errors_total", verb=verb
@@ -397,6 +482,14 @@ def start_worker(
             srv.close()
         except OSError:
             pass
+        # Worker shutdown: export whatever telemetry is still buffered
+        # and write the flight-recorder black box — a worker that dies
+        # between manager drains must not take its last spans with it.
+        # Both calls are no-ops without an armed export dir and never
+        # raise.
+        telemetry.flush()
+        telemetry.flight_dump("worker_shutdown")
+        telemetry_http.unregister_status(f"worker:{ctx['worker_id']}")
 
     if blocking:
         loop()

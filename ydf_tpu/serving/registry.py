@@ -36,6 +36,7 @@ import dataclasses
 import os
 import threading
 import time
+import weakref
 from typing import Callable, List, Optional
 
 
@@ -147,9 +148,44 @@ def compatible_engines(model) -> List[EngineFactory]:
     return out
 
 
+# Last engine selection + live batchers — the /statusz "serving"
+# section (utils/telemetry_http.py). Tracking is a dict store / weak
+# add per selection or batcher construction, independent of telemetry.
+_LAST_ENGINE = {"engine": None, "forced": False}
+_BATCHERS: "weakref.WeakSet[CoalescingBatcher]" = weakref.WeakSet()
+
+
+def serving_status() -> dict:
+    """The serving process's /statusz section: selected engine and per-
+    batcher queue depth/bounds. Row/flush counters (the QPS source)
+    ride /metrics as ydf_serve_batcher_rows_total etc."""
+    return {
+        "engine": _LAST_ENGINE["engine"],
+        "forced": _LAST_ENGINE["forced"],
+        "batchers": [
+            {
+                "depth": len(b._queue),
+                "max_batch": b.max_batch,
+                "timeout_us": b.timeout_s * 1e6,
+                "closed": b._closed,
+            }
+            for b in list(_BATCHERS)
+        ],
+    }
+
+
+def _register_serving_status() -> None:
+    from ydf_tpu.utils import telemetry_http
+
+    telemetry_http.register_status("serving", serving_status)
+
+
 def _note_selected(factory: EngineFactory, forced: bool) -> None:
     from ydf_tpu.utils import telemetry
 
+    _LAST_ENGINE["engine"] = factory.name
+    _LAST_ENGINE["forced"] = forced
+    _register_serving_status()
     if telemetry.ENABLED:
         telemetry.counter(
             "ydf_serve_engine_selected_total",
@@ -378,6 +414,8 @@ class CoalescingBatcher:
         self._cv = threading.Condition()
         self._queue: List[_Slot] = []
         self._closed = False
+        _BATCHERS.add(self)  # /statusz queue-depth visibility
+        _register_serving_status()
         self._thread = threading.Thread(
             target=self._flusher_loop, daemon=True,
             name="ydf-serve-batcher",

@@ -209,6 +209,19 @@ def hit(site: str) -> Optional[str]:
             return None
         sp.fired = True
         action, at = sp.action, sp.at
+    try:
+        # A firing failpoint is exactly the kind of event a post-mortem
+        # wants in the flight recorder. Lazy import keeps this module
+        # pure-stdlib at import time (the eager-env-validation subprocess
+        # test relies on that), and flight_record is a free no-op when
+        # telemetry is off.
+        from ydf_tpu.utils import telemetry
+
+        telemetry.flight_record(
+            "failpoint", site=site, action=action, hit=at
+        )
+    except Exception:
+        pass
     if action == "error":
         raise FailpointError(f"injected fault at {site!r} (hit {at})")
     if action == "drop_conn":

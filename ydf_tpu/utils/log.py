@@ -61,6 +61,17 @@ def _write(msg: str) -> None:
             sys.stderr.flush()
         except (OSError, ValueError):
             pass  # closed/broken stderr must never crash the caller
+    try:
+        # Mirror every emitted line into the telemetry flight recorder
+        # (a bounded ring; flight_record is a free no-op when telemetry
+        # is off). Lazy import: log must stay importable stand-alone and
+        # a telemetry env error must surface from telemetry's own
+        # import, not from a log line.
+        from ydf_tpu.utils import telemetry
+
+        telemetry.flight_record("log", line=msg)
+    except Exception:
+        pass
 
 
 def info(msg: str) -> None:

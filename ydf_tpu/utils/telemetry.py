@@ -57,15 +57,35 @@ Sites therefore follow the pattern
 `flush()` NEVER raises: the exporter is observation, and a full disk or
 injected fault (failpoint site `telemetry.flush`) must not perturb the
 training result — tests/test_telemetry.py proves the trained model is
-bit-identical with telemetry off, on, and crashing. See
-docs/observability.md for metric naming conventions and the full
-contract.
+bit-identical with telemetry off, on, and crashing.
+
+Distributed-observability additions (docs/observability.md has the
+full contracts):
+
+  * **Span identity + propagation** — every span carries a process-
+    unique `sid` (and `parent` when nested); `current_context()`
+    returns the innermost open span on this thread as the `_trace`
+    context the distributed manager stamps into worker RPC frames.
+  * **Drain / merge** — `drain_events()` removes buffered spans (the
+    worker half of the `get_telemetry` verb); `ingest_events()`
+    appends pre-built, clock-corrected chrome dicts (the manager half
+    of the ONE-merged-trace contract).
+  * **Prometheus histograms done right** — `metrics_text()` exports
+    real cumulative `_bucket`/`_sum`/`_count` series over the log2
+    octave bounds, aggregatable across workers by an actual scraper.
+  * **Flight recorder** — a bounded ring of recent spans, log lines
+    and failpoint firings; `flight_dump(reason)` writes
+    `flight_<pid>.jsonl` on preemption, boosting-loop crash and worker
+    shutdown (never raises). The exposition endpoints live in
+    `utils/telemetry_http.py`.
 """
 
 from __future__ import annotations
 
 import atexit
+import collections
 import contextlib
+import itertools
 import json
 import math
 import os
@@ -93,6 +113,12 @@ __all__ = [
     "LatencyHistogram",
     "Counter",
     "Gauge",
+    "current_context",
+    "drain_events",
+    "ingest_events",
+    "flight_record",
+    "flight_events",
+    "flight_dump",
 ]
 
 
@@ -315,16 +341,58 @@ class _NoopSpan:
 
 _NOOP_SPAN = _NoopSpan()
 
+#: Process-stable trace id: every span of this process belongs to it,
+#: and the manager propagates it to workers in the RPC `_trace` field so
+#: merged cross-process traces share one trace identity.
+TRACE_ID = os.urandom(6).hex()
+
+#: Monotonic span-id source (enabled path only — the disabled singleton
+#: never allocates an id).
+_SPAN_IDS = itertools.count(1)
+
+#: Per-thread stack of OPEN span ids — the parent chain
+#: current_context() reads. Thread-local: spans nest by wall-clock
+#: containment per thread (module docstring), so the parent of a new
+#: span is whatever span is open on the SAME thread.
+_TLS = threading.local()
+
+
+def _span_stack() -> list:
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = _TLS.stack = []
+    return st
+
+
+def current_context() -> Optional[Dict[str, object]]:
+    """The innermost OPEN span on this thread as a propagation context
+    `{"trace": ..., "span": ...}` — what the distributed manager stamps
+    into each RPC frame (`_trace`) so worker spans are attributable as
+    children of the manager span that issued the request. None when
+    telemetry is disabled or no span is open."""
+    if not ENABLED:
+        return None
+    st = _span_stack()
+    if not st:
+        return None
+    return {"trace": TRACE_ID, "span": st[-1]}
+
 
 class _Span:
-    __slots__ = ("name", "args", "_t0")
+    __slots__ = ("name", "args", "_t0", "sid", "parent")
 
     def __init__(self, name: str, args: Optional[dict]) -> None:
         self.name = name
         self.args = args
         self._t0 = 0
+        self.sid = 0
+        self.parent = 0
 
     def __enter__(self):
+        st = _span_stack()
+        self.parent = st[-1] if st else 0
+        self.sid = next(_SPAN_IDS)
+        st.append(self.sid)
         self._t0 = time.perf_counter_ns()
         return self
 
@@ -335,39 +403,55 @@ class _Span:
 
     def __exit__(self, exc_type, exc, tb):
         t1 = time.perf_counter_ns()
-        _record_event(self.name, self._t0, t1 - self._t0, self.args)
+        st = _span_stack()
+        if st and st[-1] == self.sid:
+            st.pop()
+        elif self.sid in st:  # exotic unwind order: drop up to this span
+            del st[st.index(self.sid):]
+        _record_event(
+            self.name, self._t0, t1 - self._t0, self.args,
+            sid=self.sid, parent=self.parent,
+        )
         return False
 
 
 def _record_event(
     name: str, start_ns: int, dur_ns: int, args: Optional[dict],
-    tid: Optional[int] = None,
+    tid: Optional[int] = None, sid: int = 0, parent: int = 0,
 ) -> None:
+    entry = (
+        name,
+        start_ns,
+        max(int(dur_ns), 0),
+        tid if tid is not None else threading.get_ident(),
+        args,
+        sid,
+        parent,
+    )
+    _STATE["flight"].append(entry)  # bounded ring: recent-spans black box
     ev = _STATE["events"]
     if len(ev) >= _MAX_EVENTS:
         _STATE["registry"].counter(
             "ydf_telemetry_dropped_events_total"
         ).inc()
         return
-    ev.append(
-        (
-            name,
-            start_ns,
-            max(int(dur_ns), 0),
-            tid if tid is not None else threading.get_ident(),
-            args,
-        )
-    )
+    ev.append(entry)
 
 
 # --------------------------------------------------------------------- #
 # Module state
 # --------------------------------------------------------------------- #
 
+#: Flight-recorder ring capacity: recent spans, log lines and failpoint
+#: firings kept for the crash-safe dump (flight_dump). A deque(maxlen)
+#: append is O(1) and allocation-bounded — the ring can run for days.
+_FLIGHT_CAP = 2048
+
 _STATE: Dict[str, object] = {
     "registry": _Registry(),
     "events": [],
     "collectors": [],
+    "flight": collections.deque(maxlen=_FLIGHT_CAP),
 }
 _FLUSH_LOCK = threading.Lock()
 
@@ -456,8 +540,52 @@ def events() -> List[dict]:
     return [_event_json(e) for e in list(_STATE["events"])]
 
 
+def drain_events(match: Optional[Callable[[dict], bool]] = None) -> List[dict]:
+    """Removes and returns buffered span events as chrome-tracing dicts
+    — the worker half of the `get_telemetry` RPC. `match` filters on
+    the chrome form (e.g. spans labeled with this worker's id so an
+    IN-PROCESS fleet drains only its own worker's spans); None drains
+    everything. Synchronized with flush() so a concurrent exporter
+    never double-writes a drained span."""
+    with _FLUSH_LOCK:
+        ev = _STATE["events"]
+        if match is None:
+            out = [_event_json(e) for e in ev]
+            del ev[:]
+            return out
+        keep: List[object] = []
+        out = []
+        for e in ev:
+            j = _event_json(e)
+            if match(j):
+                out.append(j)
+            else:
+                keep.append(e)
+        ev[:] = keep
+        return out
+
+
+def ingest_events(chrome_events: List[dict]) -> None:
+    """Appends pre-built chrome-tracing event dicts to the buffer — how
+    the distributed manager merges clock-corrected worker spans into
+    ONE trace file (its next flush writes them beside its own spans).
+    Subject to the same buffer cap as locally recorded spans."""
+    if not ENABLED:
+        return
+    ev = _STATE["events"]
+    for i, e in enumerate(chrome_events):
+        if len(ev) >= _MAX_EVENTS:
+            _STATE["registry"].counter(
+                "ydf_telemetry_dropped_events_total"
+            ).inc(len(chrome_events) - i)
+            return
+        ev.append(dict(e))
+
+
 def _event_json(e) -> dict:
-    name, start_ns, dur_ns, tid, args = e
+    if isinstance(e, dict):
+        return e  # ingested pre-built chrome event (remote drain)
+    name, start_ns, dur_ns, tid, args = e[:5]
     ev = {
         "name": name,
         "cat": "ydf_tpu",
@@ -470,6 +598,13 @@ def _event_json(e) -> dict:
         "pid": os.getpid(),
         "tid": tid,
     }
+    if len(e) > 5 and e[5]:
+        # Span identity as top-level fields (viewers ignore unknown
+        # keys; args stay exactly what the site set): "sid" matches the
+        # "parent_span" workers attach to propagated-context spans.
+        ev["sid"] = e[5]
+        if e[6]:
+            ev["parent"] = e[6]
     if args:
         ev["args"] = args
     return ev
@@ -510,9 +645,37 @@ def _ensure_default_collectors() -> None:
         pass  # ops import failure must not break telemetry itself
 
 
+def _hist_exposition(name: str, labels, h: LatencyHistogram,
+                     lines: List[str]) -> None:
+    """One histogram as REAL cumulative Prometheus series: `_bucket`
+    samples at octave upper bounds (le = 2^(e+1), derived from the log2
+    buckets — boundaries are value-independent so a scraper can
+    aggregate `_bucket` across workers), then `+Inf`, `_sum`, `_count`.
+    Octaves are emitted from the first to the last non-empty one; the
+    implied leading buckets are all zero-cumulative."""
+    lines.append(f"# TYPE {name} histogram")
+    per_octave = [
+        sum(h.buckets[e << 3: (e + 1) << 3]) for e in range(64)
+    ]
+    nonzero = [e for e, c in enumerate(per_octave) if c]
+    cum = 0
+    if nonzero:
+        for e in range(nonzero[0], nonzero[-1] + 1):
+            cum += per_octave[e]
+            lab = _fmt_labels(labels, 'le="%g"' % float(1 << (e + 1)))
+            lines.append(f"{name}_bucket{lab} {cum}")
+    inf_lab = _fmt_labels(labels, 'le="+Inf"')
+    lines.append(f"{name}_bucket{inf_lab} {h.count}")
+    lines.append(f"{name}_sum{_fmt_labels(labels)} {h.total}")
+    lines.append(f"{name}_count{_fmt_labels(labels)} {h.count}")
+
+
 def metrics_text() -> str:
-    """Prometheus text exposition of the registry. Histograms export
-    summary-style: _count, _sum (ns) and quantile samples 0.5/0.9/0.99."""
+    """Prometheus text exposition of the registry. Histograms export as
+    real cumulative `_bucket`/`_sum`/`_count` series over the log2
+    octave boundaries (aggregatable across workers by an actual
+    scraper), not percentile gauges — percentiles stay available via
+    snapshot()/summary()."""
     _ensure_default_collectors()
     reg: _Registry = _STATE["registry"]
     lines: List[str] = []
@@ -526,14 +689,7 @@ def metrics_text() -> str:
         lines.append(f"# TYPE {mname} gauge")
         lines.append(f"{mname} {value:g}")
     for (name, labels), h in sorted(reg._hists.items()):
-        lines.append(f"# TYPE {name} summary")
-        lines.append(f"{name}_count{_fmt_labels(labels)} {h.count}")
-        lines.append(f"{name}_sum{_fmt_labels(labels)} {h.total}")
-        for q in (0.5, 0.9, 0.99):
-            v = h.percentile_ns(q * 100)
-            if v is not None:
-                qlab = _fmt_labels(labels, 'quantile="%s"' % q)
-                lines.append(f"{name}{qlab} {v:g}")
+        _hist_exposition(name, labels, h, lines)
     return "\n".join(lines) + "\n"
 
 
@@ -587,10 +743,83 @@ def flush(directory: Optional[str] = None) -> None:
                 pass
 
 
+# --------------------------------------------------------------------- #
+# Flight recorder — the crash-safe black box
+# --------------------------------------------------------------------- #
+#
+# A bounded ring of the most recent spans (_record_event appends every
+# completed span), log lines (utils/log.py writes through flight_record)
+# and failpoint firings (utils/failpoints.py). flight_dump() writes the
+# ring to `<dir>/flight_<pid>.jsonl` at the moments a normal flush would
+# be lost: SIGTERM/exit-75 preemption, an unhandled exception in the
+# boosting loop, and worker shutdown — so a chaos scenario that round 10
+# proved *recoverable* is also *diagnosable*. Like flush(), the dump
+# NEVER raises.
+
+
+def flight_record(kind: str, **fields) -> None:
+    """Appends one non-span entry (log line, failpoint firing, custom
+    marker) to the flight ring. Free no-op when telemetry is off."""
+    if not ENABLED:
+        return
+    _STATE["flight"].append((kind, time.perf_counter_ns(), fields))
+
+
+def _flight_json(e) -> dict:
+    if isinstance(e, tuple) and len(e) == 3 and isinstance(e[2], dict):
+        kind, t_ns, fields = e
+        return {"kind": kind, "ts": t_ns / 1000, **fields}
+    j = _event_json(e)
+    j["kind"] = "span"
+    return j
+
+
+def flight_events() -> List[dict]:
+    """The current flight ring as JSON-able dicts (oldest first)."""
+    return [_flight_json(e) for e in list(_STATE["flight"])]
+
+
+def flight_dump(reason: str, directory: Optional[str] = None) -> Optional[str]:
+    """Writes the flight ring to `<directory>/flight_<pid>.jsonl`
+    (default: the armed EXPORT_DIR; no-op without one). The first line
+    is a header naming the dump reason; each following line is one ring
+    entry. Rewritten on every dump — the file always holds the LAST
+    moments before the event that triggered it. NEVER raises; returns
+    the path written, or None."""
+    d = directory or EXPORT_DIR
+    if d is None or not ENABLED:
+        return None
+    try:
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"flight_{os.getpid()}.jsonl")
+        entries = flight_events()
+        with open(path, "w") as f:
+            f.write(json.dumps({
+                "kind": "flight_dump",
+                "reason": reason,
+                "pid": os.getpid(),
+                "trace": TRACE_ID,
+                "entries": len(entries),
+            }) + "\n")
+            for e in entries:
+                f.write(json.dumps(e, default=str) + "\n")
+        _STATE["registry"].counter(
+            "ydf_telemetry_flight_dumps_total"
+        ).inc()
+        return path
+    except Exception:
+        _STATE["registry"].counter(
+            "ydf_telemetry_flush_errors_total"
+        ).inc()
+        return None
+
+
 def reset() -> None:
-    """Clears the CURRENT registry and event buffer (tests, bench)."""
+    """Clears the CURRENT registry, event buffer and flight ring
+    (tests, bench)."""
     _STATE["registry"] = _Registry()
     _STATE["events"] = []
+    _STATE["flight"] = collections.deque(maxlen=_FLIGHT_CAP)
 
 
 def configure(
@@ -616,7 +845,7 @@ def active(directory: Optional[str] = None):
     global ENABLED, EXPORT_DIR
     old = (
         ENABLED, EXPORT_DIR, _STATE["registry"], _STATE["events"],
-        _STATE["collectors"],
+        _STATE["collectors"], _STATE["flight"],
     )
     global _DEFAULTS_REGISTERED
     old_defaults = _DEFAULTS_REGISTERED
@@ -624,6 +853,7 @@ def active(directory: Optional[str] = None):
     _STATE["registry"] = _Registry()
     _STATE["events"] = []
     _STATE["collectors"] = []
+    _STATE["flight"] = collections.deque(maxlen=_FLIGHT_CAP)
     _DEFAULTS_REGISTERED = False
     ENABLED, EXPORT_DIR = True, d
     try:
@@ -631,7 +861,7 @@ def active(directory: Optional[str] = None):
     finally:
         (
             ENABLED, EXPORT_DIR, _STATE["registry"], _STATE["events"],
-            _STATE["collectors"],
+            _STATE["collectors"], _STATE["flight"],
         ) = old
         _DEFAULTS_REGISTERED = old_defaults
 
