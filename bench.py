@@ -71,6 +71,15 @@ INFER_P50_FLOOR_NS = {
     (500_000, 20): 1380.7,  # BENCH_r05 full record
 }
 
+# Same guard for serving MEMORY: recorded per-shape ceilings on the
+# peak-RSS growth across model.benchmark()'s measured (post-warmup)
+# predict runs (`infer_peak_rss_delta_bytes`). Populated the same way
+# the latency floors were — from observed rounds; shapes without an
+# entry emit the measurement only. A steady-state serving path should
+# allocate ~nothing: a delta regression here is caught by the identical
+# floor machinery as the latency guard (infer_rss_within_floor).
+INFER_RSS_DELTA_FLOOR_BYTES = {}
+
 _RESULT_EMITTED = False
 _LAST_EMITTED = None
 # Best record assembled so far — the watchdog/SIGTERM handler emits this
@@ -418,13 +427,35 @@ def measure_in_loop_hist(train, record):
         trace_event_seconds,
     )
 
+    from ydf_tpu.utils.profiling import (
+        native_pool_stats,
+        reset_native_pool_stats,
+    )
+
     td = tempfile.mkdtemp(prefix="ydf_hist_trace_")
     try:
         reset_native_hist_kernel_counters()
         reset_native_route_kernel_counters()
+        reset_native_pool_stats()
         with jax.profiler.trace(td):
             _, wall, _ = train()
         record["hist_profiled_train_wall_s"] = round(wall, 2)
+        # Thread-pool utilization per training stage (busy ÷ (lanes ×
+        # pooled wall), native/thread_pool.h stats): THE number ROADMAP
+        # item 3's native-vs-XLA flip is judged by — a stage whose
+        # utilization stays low on a many-core box is not saturating it,
+        # whatever its wall says. Serving utilization is added by
+        # measure_serving_family from its own bracketed reset.
+        ps = native_pool_stats()
+        if ps:
+            record["pool_size"] = ps["size"]
+            util = {
+                fam: f["utilization"]
+                for fam, f in ps["families"].items()
+                if f["runs"] > 0 and fam != "serve"
+            }
+            if util:
+                record["pool_utilization"] = util
         native_s = native_hist_kernel_seconds()
         if native_s > 0:
             record["hist_s"] = round(native_s, 3)
@@ -546,6 +577,12 @@ def measure_serving_family(model, data, rows, record):
     SIZES = (1, 16, 256, 4096)
     CALLS = {1: 200, 16: 100, 256: 40, 4096: 15}
     try:
+        from ydf_tpu.utils.profiling import (
+            native_pool_stats,
+            reset_native_pool_stats,
+        )
+
+        reset_native_pool_stats()  # serve-stage utilization bracketing
         sample = {k: v[: min(rows, 8192)] for k, v in data.items()}
         ds = Dataset.from_data(sample, dataspec=model.dataspec)
         x_num, x_cat, _ = model._encode_inputs(ds)
@@ -660,6 +697,22 @@ def measure_serving_family(model, data, rows, record):
         record["infer_batch_p99_ns"] = {
             b: v["p99_ns"] for b, v in chosen.items()
         }
+        # Serving memory accounting: bytes held by the flat serving
+        # data banks built above (the flatten-at-load footprint — what
+        # a serving host pays per loaded model), and the serve-stage
+        # pool utilization over the measured loops.
+        try:
+            from ydf_tpu.serving.native_serve import bank_bytes_total
+
+            record["serve_bank_bytes"] = int(bank_bytes_total())
+        except Exception:
+            record["serve_bank_bytes"] = 0
+        ps = native_pool_stats()
+        if ps and ps["families"].get("serve", {}).get("runs"):
+            record.setdefault("pool_size", ps["size"])
+            record.setdefault("pool_utilization", {})["serve"] = (
+                ps["families"]["serve"]["utilization"]
+            )
     except Exception as e:
         record["serve_family_error"] = f"{type(e).__name__}: {e}"
 
@@ -761,6 +814,10 @@ def measure_distributed_family(rows, trees, depth, features, record):
             )
             record["dist_rpc_p50_ns"] = d["rpc_p50_ns"]
             record["dist_recoveries"] = int(d["recoveries"])
+            # Fleet-total resident shard/state bytes the workers
+            # reported at shard load — the distributed row of the
+            # memory headline (docs/observability.md).
+            record["dist_shard_bytes"] = int(d.get("shard_bytes", 0))
             record["dist_compute_s"] = round(d["compute_s"], 3)
             record["dist_net_s"] = round(d["net_s"], 3)
             record["dist_wait_s"] = round(d["wait_s"], 3)
@@ -831,6 +888,16 @@ def run_bench(backend, rows, trees, depth, features, with_baseline, probe_log):
 
     _, wall_compile, cold_timings = train()  # compile + cold ingest/bin
     model, wall, _ = train()                 # cached steady state
+    # Process peak RSS right after the steady-state train: the training
+    # half of the memory headline (an absolute process-lifetime figure —
+    # the compile pass above is included by construction, which is the
+    # honest bound a box must provision for).
+    try:
+        from ydf_tpu.utils.telemetry import peak_rss_bytes
+
+        train_peak_rss = int(peak_rss_bytes())
+    except Exception:
+        train_peak_rss = 0
 
     from ydf_tpu.ops.histogram import resolve_hist_quant
     from ydf_tpu.ops.routing_native import (
@@ -873,6 +940,7 @@ def run_bench(backend, rows, trees, depth, features, with_baseline, probe_log):
         "route_impl": resolve_route_impl(None),
         "route_threads": resolved_route_threads(),
         "hist_threads": _resolved_env_threads("YDF_TPU_HIST_THREADS"),
+        "train_peak_rss_bytes": train_peak_rss,
         "vs_ydf64_estimate": round(
             value / BASELINE_YDF64_ESTIMATE_ROWS_TREES_PER_SEC, 3
         ),
@@ -907,6 +975,19 @@ def run_bench(backend, rows, trees, depth, features, with_baseline, probe_log):
         record["infer_ns_per_example"] = round(bres["ns_per_example"], 1)
         record["infer_p50_ns"] = round(bres["p50_ns_per_example"], 1)
         record["infer_p99_ns"] = round(bres["p99_ns_per_example"], 1)
+        # Serving memory guard: how much the process RSS peak grew
+        # across the measured (post-warmup) predict runs — a serving
+        # path that allocates per call regresses HERE, under the same
+        # per-shape floor machinery as the latency guard.
+        record["infer_peak_rss_delta_bytes"] = int(
+            bres.get("peak_rss_delta_bytes", 0)
+        )
+        rss_floor = INFER_RSS_DELTA_FLOOR_BYTES.get((rows, trees))
+        if rss_floor is not None:
+            record["infer_rss_delta_floor_bytes"] = rss_floor
+            record["infer_rss_within_floor"] = bool(
+                record["infer_peak_rss_delta_bytes"] <= rss_floor
+            )
         # Serving-regression guard (ROADMAP item 1): compare against the
         # recorded same-shape floor — floors at different (rows, trees)
         # shapes are NOT comparable (the r04→r05 "regression" was a

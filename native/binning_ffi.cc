@@ -161,15 +161,18 @@ extern "C" void ydf_bin_columns(const float* values, const float* boundaries,
   if (n <= 0 || F <= 0) return;
   const int threads = ResolveThreads(num_threads, n);
   if (threads <= 1) {
-    BinRows(values, boundaries, nbounds, impute, out, n, F, max_b,
-            out_stride, 0, n);
+    // Run(m=1) executes inline; it only adds the utilization accounting.
+    ydf_native::ThreadPool::Get().Run(ydf_native::kPoolBin, 1, [&](int) {
+      BinRows(values, boundaries, nbounds, impute, out, n, F, max_b,
+              out_stride, 0, n);
+    });
     return;
   }
   // Fixed row-range partition per task; execution order is irrelevant
   // (tasks write disjoint output rows), so the pool cannot change the
   // result.
   const int64_t per = (n + threads - 1) / threads;
-  ydf_native::ThreadPool::Get().Run(threads, [&](int t) {
+  ydf_native::ThreadPool::Get().Run(ydf_native::kPoolBin, threads, [&](int t) {
     const int64_t r0 = t * per;
     const int64_t r1 = std::min(r0 + per, n);
     if (r0 < r1) {

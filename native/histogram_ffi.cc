@@ -98,17 +98,83 @@ static std::atomic<int64_t> g_hist_calls{0};
 static std::atomic<int64_t> g_fused_ns{0};
 static std::atomic<int64_t> g_fused_calls{0};
 
+// Peak bytes of the per-thread partial/accumulator arenas (f32 f64
+// scratch AND the q8 int32 partials + packed-lane scratch the watermark
+// spills land in) — the "hist_arena" row of the memory ledger
+// (utils/telemetry.py:MemoryLedger; docs/observability.md). A global
+// high-watermark over per-call scratch footprints: grow-only
+// thread_local vectors mean the peak is also the resident figure.
+static std::atomic<int64_t> g_arena_bytes_peak{0};
+
+static void NoteArenaBytes(int64_t bytes) {
+  int64_t prev = g_arena_bytes_peak.load(std::memory_order_relaxed);
+  while (bytes > prev && !g_arena_bytes_peak.compare_exchange_weak(
+                             prev, bytes, std::memory_order_relaxed)) {
+  }
+}
+
 extern "C" int64_t ydf_hist_ns_total() { return g_hist_ns.load(); }
 extern "C" int64_t ydf_hist_calls_total() { return g_hist_calls.load(); }
 extern "C" int64_t ydf_hist_fused_ns_total() { return g_fused_ns.load(); }
 extern "C" int64_t ydf_hist_fused_calls_total() {
   return g_fused_calls.load();
 }
+extern "C" int64_t ydf_hist_arena_bytes_peak() {
+  return g_arena_bytes_peak.load();
+}
 extern "C" void ydf_hist_counters_reset() {
   g_hist_ns.store(0);
   g_hist_calls.store(0);
   g_fused_ns.store(0);
   g_fused_calls.store(0);
+  g_arena_bytes_peak.store(0);
+}
+
+// ---------------------------------------------------------------------
+// Thread-pool utilization exports (the stats block lives in
+// thread_pool.h, shared by every kernel family of this library; the
+// extern "C" surface is defined HERE, once, because the header is
+// included by four TUs). Read by ydf_tpu/ops/pool_stats.py.
+// ---------------------------------------------------------------------
+extern "C" int64_t ydf_pool_busy_ns_total(int family, int lane) {
+  if (family < 0 || family >= ydf_native::kPoolFamilies || lane < 0 ||
+      lane >= ydf_native::PoolStats::kMaxLanes) {
+    return 0;
+  }
+  return ydf_native::ThreadPool::Stats().busy_ns[family][lane].load();
+}
+extern "C" int64_t ydf_pool_tasks_total(int family, int lane) {
+  if (family < 0 || family >= ydf_native::kPoolFamilies || lane < 0 ||
+      lane >= ydf_native::PoolStats::kMaxLanes) {
+    return 0;
+  }
+  return ydf_native::ThreadPool::Stats().tasks[family][lane].load();
+}
+extern "C" int64_t ydf_pool_queue_wait_ns_total(int family) {
+  if (family < 0 || family >= ydf_native::kPoolFamilies) return 0;
+  return ydf_native::ThreadPool::Stats().queue_wait_ns[family].load();
+}
+extern "C" int64_t ydf_pool_run_wall_ns_total(int family) {
+  if (family < 0 || family >= ydf_native::kPoolFamilies) return 0;
+  return ydf_native::ThreadPool::Stats().run_wall_ns[family].load();
+}
+extern "C" int64_t ydf_pool_runs_total(int family) {
+  if (family < 0 || family >= ydf_native::kPoolFamilies) return 0;
+  return ydf_native::ThreadPool::Stats().runs[family].load();
+}
+// Resolved lane count (callers + workers) WITHOUT constructing the
+// pool — the utilization denominator.
+extern "C" int32_t ydf_pool_size() {
+  return ydf_native::ThreadPool::ResolvedSize();
+}
+extern "C" int32_t ydf_pool_max_lanes() {
+  return ydf_native::PoolStats::kMaxLanes;
+}
+extern "C" int32_t ydf_pool_stats_enabled() {
+  return ydf_native::ThreadPool::StatsEnabled() ? 1 : 0;
+}
+extern "C" void ydf_pool_stats_reset() {
+  ydf_native::ThreadPool::Stats().Reset();
 }
 
 namespace {
@@ -443,7 +509,8 @@ void ReduceWave(const PartT* arena, AccT* acc, int64_t need, int m,
     reduce(0, need);
   } else {
     const int64_t per = (need + threads - 1) / threads;
-    ydf_native::ThreadPool::Get().Run(threads, [&](int t) {
+    ydf_native::ThreadPool::Get().Run(ydf_native::kPoolHist, threads,
+                                      [&](int t) {
       const int64_t c0 = t * per;
       const int64_t c1 = std::min(c0 + per, need);
       if (c0 < c1) reduce(c0, c1);
@@ -481,6 +548,8 @@ ffi::Error RunHistogramF32(const uint8_t* bp, const SlotFn& slot_of,
     return ffi::Error(ffi::ErrorCode::kResourceExhausted,
                       "histogram scratch allocation failed");
   }
+  NoteArenaBytes(static_cast<int64_t>(acc.capacity()) * 8 +
+                 static_cast<int64_t>(arena.capacity()) * 8);
   // Raw pointers for the worker lambdas: `acc`/`arena` are thread_local,
   // and thread_locals are NOT captured by lambdas — a pool thread
   // naming them would resolve its OWN (empty) instances and fault.
@@ -490,13 +559,18 @@ ffi::Error RunHistogramF32(const uint8_t* bp, const SlotFn& slot_of,
 
   if (nblocks <= 1) {
     // Single block: accumulating straight into the (zeroed) result is
-    // bit-identical to partial-then-reduce.
-    AccumulateRows(bp, slot_of, stp, acc_p, F, L, B, S, 0, n);
+    // bit-identical to partial-then-reduce. Routed through Run(m=1)
+    // (which executes inline on this thread) so the pool utilization
+    // accounting covers small inputs too.
+    ydf_native::ThreadPool::Get().Run(ydf_native::kPoolHist, 1, [&](int) {
+      AccumulateRows(bp, slot_of, stp, acc_p, F, L, B, S, 0, n);
+    });
   } else {
     for (int64_t wave0 = 0; wave0 < nblocks; wave0 += wave) {
       const int m = static_cast<int>(
           std::min<int64_t>(wave, nblocks - wave0));
-      ydf_native::ThreadPool::Get().Run(m, [&, arena_p](int j) {
+      ydf_native::ThreadPool::Get().Run(
+          ydf_native::kPoolHist, m, [&, arena_p](int j) {
         double* part = arena_p + static_cast<size_t>(j) * need;
         std::memset(part, 0, sizeof(double) * need);
         const int64_t r0 = (wave0 + j) * kRowBlock;
@@ -573,6 +647,9 @@ ffi::Error RunHistogramQ8(const uint8_t* bp, const SlotFn& slot_of,
     return ffi::Error(ffi::ErrorCode::kResourceExhausted,
                       "histogram_q8 scratch allocation failed");
   }
+  NoteArenaBytes(static_cast<int64_t>(acc_q8.capacity()) * 8 +
+                 static_cast<int64_t>(arena_q8.capacity()) * 4 +
+                 static_cast<int64_t>(packed_q8.capacity()) * 8);
   // thread_local not captured by lambdas — see HistogramImpl.
   int64_t* const acc_p = acc_q8.data();
   int32_t* const arena_p = arena_q8.data();
@@ -592,8 +669,11 @@ ffi::Error RunHistogramQ8(const uint8_t* bp, const SlotFn& slot_of,
     if (packed_p != nullptr) {
       std::memset(packed_p, 0, sizeof(uint64_t) * ncells);
     }
-    AccumulateRowsQ8(bp, slot_of, qp, arena_p, packed_p, F, L, B, S, 0, n,
-                     /*flush_packed=*/false);
+    // Run(m=1) executes inline; it only adds the utilization accounting.
+    ydf_native::ThreadPool::Get().Run(ydf_native::kPoolHist, 1, [&](int) {
+      AccumulateRowsQ8(bp, slot_of, qp, arena_p, packed_p, F, L, B, S, 0, n,
+                       /*flush_packed=*/false);
+    });
     if (packed_p != nullptr) FlushPacked(packed_p, arena_p, ncells);
     for (int64_t i = 0; i < need; ++i) {
       outp[i] = static_cast<float>(static_cast<double>(arena_p[i]) *
@@ -606,7 +686,8 @@ ffi::Error RunHistogramQ8(const uint8_t* bp, const SlotFn& slot_of,
   for (int64_t wave0 = 0; wave0 < nblocks; wave0 += wave) {
     const int m =
         static_cast<int>(std::min<int64_t>(wave, nblocks - wave0));
-    ydf_native::ThreadPool::Get().Run(m, [&, arena_p, packed_p](int j) {
+    ydf_native::ThreadPool::Get().Run(
+        ydf_native::kPoolHist, m, [&, arena_p, packed_p](int j) {
       int32_t* part = arena_p + static_cast<size_t>(j) * need;
       std::memset(part, 0, sizeof(int32_t) * need);
       uint64_t* packed = nullptr;

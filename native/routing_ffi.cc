@@ -161,13 +161,16 @@ int ResolveRouteThreads(int64_t nblocks) {
 template <typename Fn>
 void RunBlocks(int64_t nblocks, int threads, const Fn& fn) {
   if (nblocks <= 1 || threads <= 1) {
-    for (int64_t blk = 0; blk < nblocks; ++blk) fn(blk);
+    // Run(m=1) executes inline; it only adds the utilization accounting.
+    ydf_native::ThreadPool::Get().Run(ydf_native::kPoolRoute, 1, [&](int) {
+      for (int64_t blk = 0; blk < nblocks; ++blk) fn(blk);
+    });
     return;
   }
   for (int64_t w0 = 0; w0 < nblocks; w0 += threads) {
     const int m = static_cast<int>(std::min<int64_t>(threads, nblocks - w0));
     ydf_native::ThreadPool::Get().Run(
-        m, [&, w0](int j) { fn(w0 + j); });
+        ydf_native::kPoolRoute, m, [&, w0](int j) { fn(w0 + j); });
   }
 }
 

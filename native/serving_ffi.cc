@@ -275,19 +275,26 @@ void ServeBatch(const BankView& b, const Input& in, int64_t n, float* out) {
     ServeRows(b, in, r0, r1, out);
   };
   if (nblocks <= 1) {  // single block: no thread resolution at all
-    run_block(0);
+    // Run(m=1) executes inline (no pool wakeup, no thread resolution);
+    // it only adds the utilization accounting, and with
+    // YDF_TPU_POOL_STATS=0 not even the two clock reads.
+    ydf_native::ThreadPool::Get().Run(ydf_native::kPoolServe, 1,
+                                      [&](int) { run_block(0); });
     return;
   }
   const int threads = ResolveServeThreads(nblocks);
   if (threads <= 1) {
-    for (int64_t blk = 0; blk < nblocks; ++blk) run_block(blk);
+    ydf_native::ThreadPool::Get().Run(ydf_native::kPoolServe, 1, [&](int) {
+      for (int64_t blk = 0; blk < nblocks; ++blk) run_block(blk);
+    });
     return;
   }
   for (int64_t w0 = 0; w0 < nblocks; w0 += threads) {
     const int m =
         static_cast<int>(std::min<int64_t>(threads, nblocks - w0));
-    ydf_native::ThreadPool::Get().Run(m,
-                                      [&, w0](int j) { run_block(w0 + j); });
+    ydf_native::ThreadPool::Get().Run(
+        ydf_native::kPoolServe, m,
+        [&, w0](int j) { run_block(w0 + j); });
   }
 }
 
@@ -500,19 +507,23 @@ void ydf_serve_batch(const void* h, const float* x_num, const int32_t* x_cat,
                            std::min(r0 + kServeRowBlock, n), out);
     };
     if (nblocks <= 1) {
-      run_block(0);
+      // Run(m=1) is inline; only the utilization accounting rides it.
+      ydf_native::ThreadPool::Get().Run(ydf_native::kPoolServe, 1,
+                                        [&](int) { run_block(0); });
       return;
     }
     const int threads = ResolveServeThreads(nblocks);
     if (threads <= 1) {
-      for (int64_t blk = 0; blk < nblocks; ++blk) run_block(blk);
+      ydf_native::ThreadPool::Get().Run(ydf_native::kPoolServe, 1, [&](int) {
+        for (int64_t blk = 0; blk < nblocks; ++blk) run_block(blk);
+      });
       return;
     }
     for (int64_t w0 = 0; w0 < nblocks; w0 += threads) {
       const int m =
           static_cast<int>(std::min<int64_t>(threads, nblocks - w0));
       ydf_native::ThreadPool::Get().Run(
-          m, [&, w0](int j) { run_block(w0 + j); });
+          ydf_native::kPoolServe, m, [&, w0](int j) { run_block(w0 + j); });
     }
     return;
   }

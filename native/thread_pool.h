@@ -23,13 +23,28 @@
 // claim contention is noise, and the mutex closes the stale-worker race
 // (a worker waking from a PREVIOUS run can never claim a task of the
 // current one — claims are generation-checked under the lock).
+//
+// Utilization stats: every Run() is tagged with a kernel FAMILY
+// (PoolFamily below) and the pool accumulates per-(family, lane)
+// busy-ns and task counts plus per-family queue-wait-ns and run-wall-ns
+// into a shared atomic stats block (PoolStats). That block is the
+// measurement ROADMAP item 3 ("saturate a many-core box") is judged by:
+// busy / (lanes x run-wall) is the per-stage pool_utilization the bench
+// headline records carry. Exported via extern "C" accessors defined in
+// histogram_ffi.cc (one TU), read by ydf_tpu/ops/pool_stats.py;
+// YDF_TPU_POOL_STATS=0 removes the per-task clock reads entirely.
+// Recording never changes partitioning or reduction order, so results
+// are bit-identical with stats on or off.
 
 #ifndef YDF_TPU_NATIVE_THREAD_POOL_H_
 #define YDF_TPU_NATIVE_THREAD_POOL_H_
 
+#include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdlib>
+#include <cstring>
 #include <functional>
 #include <mutex>
 #include <thread>
@@ -37,40 +52,158 @@
 
 namespace ydf_native {
 
+// Kernel families a Run() call is attributed to — the {pool=...} label
+// of the exported utilization metrics (ydf_pool_busy_ns_total etc.,
+// read by ydf_tpu/ops/pool_stats.py; docs/observability.md "Resource
+// observability"). One family per native kernel .cc.
+enum PoolFamily : int {
+  kPoolHist = 0,   // histogram_ffi.cc (incl. the fused *_routed calls)
+  kPoolBin = 1,    // binning_ffi.cc
+  kPoolRoute = 2,  // routing_ffi.cc
+  kPoolServe = 3,  // serving_ffi.cc
+  kPoolFamilies = 4,
+};
+
+// Per-(family, lane) utilization accounting. Lane 0 is always the
+// CALLING thread (it participates in every Run); lanes 1..N are the
+// parked workers; lanes beyond kMaxLanes-1 fold into the last slot so
+// the export stays bounded on very wide boxes.
+//
+// Semantics (docs/observability.md has the full contract):
+//   busy_ns[f][l]     wall time lane l spent INSIDE task bodies of
+//                     family f (what "utilization" divides by
+//                     lanes x run-wall);
+//   tasks[f][l]       task bodies lane l executed for family f;
+//   queue_wait_ns[f]  sum over tasks of (claim time - submit time):
+//                     total time family-f tasks sat queued before a
+//                     lane picked them up (backlog + wakeup latency);
+//   run_wall_ns[f]    wall time of whole Run() calls (submit to
+//                     all-done) — the utilization denominator;
+//   runs[f]           Run() calls.
+//
+// The block is plain atomics: recording never takes a lock beyond what
+// Run already holds, and reading is tear-free per counter. Counters
+// NEVER influence task partitioning or reduction order, so results
+// stay bit-identical with stats on, off, or concurrently read
+// (tests/test_resource_observability.py proves the model-level claim).
+struct PoolStats {
+  static constexpr int kMaxLanes = 64;
+  std::atomic<int64_t> busy_ns[kPoolFamilies][kMaxLanes];
+  std::atomic<int64_t> tasks[kPoolFamilies][kMaxLanes];
+  std::atomic<int64_t> queue_wait_ns[kPoolFamilies];
+  std::atomic<int64_t> run_wall_ns[kPoolFamilies];
+  std::atomic<int64_t> runs[kPoolFamilies];
+
+  void Reset() {
+    for (int f = 0; f < kPoolFamilies; ++f) {
+      for (int l = 0; l < kMaxLanes; ++l) {
+        busy_ns[f][l].store(0, std::memory_order_relaxed);
+        tasks[f][l].store(0, std::memory_order_relaxed);
+      }
+      queue_wait_ns[f].store(0, std::memory_order_relaxed);
+      run_wall_ns[f].store(0, std::memory_order_relaxed);
+      runs[f].store(0, std::memory_order_relaxed);
+    }
+  }
+};
+
 class ThreadPool {
  public:
   // Lazily-created singleton (one per loaded shared library).
   static ThreadPool& Get() {
-    static ThreadPool pool(ResolveSize());
+    static ThreadPool pool(ResolvedSize() - 1);
     return pool;
+  }
+
+  // The lane count a constructed pool will have (callers + workers),
+  // WITHOUT constructing the pool — the utilization denominator must be
+  // readable from a stats query that should not spawn threads.
+  static int ResolvedSize() {
+    static const int n = ResolveSize();
+    return n;
+  }
+
+  // Shared stats block (zero-initialized static storage; one instance
+  // per loaded library, like the pool itself). Readable before the
+  // pool exists.
+  static PoolStats& Stats() {
+    static PoolStats stats;
+    return stats;
+  }
+
+  // YDF_TPU_POOL_STATS=0|off disables the per-task clock reads (two
+  // steady_clock samples per ~ms task — noise, but the zero-overhead
+  // contract wants a hard off switch). Resolved once at first use; the
+  // Python env boundary (ops/pool_stats.py) validates the value
+  // eagerly at import.
+  static bool StatsEnabled() {
+    static const bool on = [] {
+      const char* env = std::getenv("YDF_TPU_POOL_STATS");
+      if (env == nullptr) return true;
+      return !(std::strcmp(env, "0") == 0 || std::strcmp(env, "off") == 0 ||
+               std::strcmp(env, "OFF") == 0);
+    }();
+    return on;
+  }
+
+  static int64_t NowNs() {
+    return std::chrono::duration_cast<std::chrono::nanoseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
   }
 
   // Runs fn(0) .. fn(m-1) across the pool and the calling thread;
   // returns when all m tasks finished. At most min(m, size+1) tasks run
   // concurrently. Whole Run() calls are serialized (two concurrent XLA
-  // custom calls queue rather than interleave task sets).
-  void Run(int m, const std::function<void(int)>& fn) {
+  // custom calls queue rather than interleave task sets). `family`
+  // attributes the call's utilization (PoolFamily above).
+  void Run(int family, int m, const std::function<void(int)>& fn) {
     if (m <= 0) return;
+    const bool stats = StatsEnabled();
     if (m == 1 || workers_.empty()) {
+      // Inline path (single task, or a 1-lane pool): the caller IS the
+      // pool. Timed as lane-0 busy so single-core boxes still report
+      // utilization (~1.0 by construction).
+      if (!stats) {
+        for (int i = 0; i < m; ++i) fn(i);
+        return;
+      }
+      const int64_t t0 = NowNs();
       for (int i = 0; i < m; ++i) fn(i);
+      const int64_t dt = NowNs() - t0;
+      PoolStats& s = Stats();
+      s.busy_ns[family][0].fetch_add(dt, std::memory_order_relaxed);
+      s.tasks[family][0].fetch_add(m, std::memory_order_relaxed);
+      s.run_wall_ns[family].fetch_add(dt, std::memory_order_relaxed);
+      s.runs[family].fetch_add(1, std::memory_order_relaxed);
       return;
     }
     std::lock_guard<std::mutex> run_lock(run_mutex_);
     uint64_t gen;
+    const int64_t t_submit = stats ? NowNs() : 0;
     {
       std::lock_guard<std::mutex> lk(mutex_);
       task_fn_ = fn;
       total_ = m;
       next_ = 0;
       completed_ = 0;
+      family_ = family;
+      submit_ns_ = t_submit;
+      stats_on_ = stats;
       gen = ++generation_;
     }
     wake_.notify_all();
-    Work(fn, gen);  // the caller participates
+    Work(fn, gen, family, /*lane=*/0, stats, t_submit);  // caller joins
     {
       std::unique_lock<std::mutex> lk(mutex_);
       done_.wait(lk, [&] { return completed_ == total_; });
       task_fn_ = nullptr;
+    }
+    if (stats) {
+      PoolStats& s = Stats();
+      s.run_wall_ns[family].fetch_add(NowNs() - t_submit,
+                                      std::memory_order_relaxed);
+      s.runs[family].fetch_add(1, std::memory_order_relaxed);
     }
   }
 
@@ -86,13 +219,14 @@ class ThreadPool {
     if (n < 1) n = 1;
     // The caller thread participates in every Run, so n-1 workers give
     // an n-lane pool.
-    return n - 1;
+    return n;
   }
 
   explicit ThreadPool(int workers) {
     workers_.reserve(workers > 0 ? workers : 0);
     for (int i = 0; i < workers; ++i) {
-      workers_.emplace_back([this] { WorkerLoop(); });
+      // Lane i+1: lane 0 is reserved for whichever thread calls Run.
+      workers_.emplace_back([this, i] { WorkerLoop(i + 1); });
     }
   }
 
@@ -105,19 +239,25 @@ class ThreadPool {
     for (auto& t : workers_) t.join();
   }
 
-  void WorkerLoop() {
+  void WorkerLoop(int lane) {
     uint64_t seen = 0;
     while (true) {
       std::function<void(int)> task;
       uint64_t gen;
+      int family;
+      int64_t submit_ns;
+      bool stats;
       {
         std::unique_lock<std::mutex> lk(mutex_);
         wake_.wait(lk, [&] { return stop_ || generation_ != seen; });
         if (stop_) return;
         seen = gen = generation_;
         task = task_fn_;  // copy: outlives the caller's reference
+        family = family_;
+        submit_ns = submit_ns_;
+        stats = stats_on_;
       }
-      if (task) Work(task, gen);
+      if (task) Work(task, gen, family, lane, stats, submit_ns);
     }
   }
 
@@ -129,11 +269,25 @@ class ThreadPool {
     return next_++;
   }
 
-  void Work(const std::function<void(int)>& fn, uint64_t gen) {
+  void Work(const std::function<void(int)>& fn, uint64_t gen, int family,
+            int lane, bool stats, int64_t submit_ns) {
+    const int slot =
+        lane < PoolStats::kMaxLanes ? lane : PoolStats::kMaxLanes - 1;
     while (true) {
       const int i = Claim(gen);
       if (i < 0) return;
-      fn(i);
+      if (stats) {
+        PoolStats& s = Stats();
+        const int64_t t0 = NowNs();
+        s.queue_wait_ns[family].fetch_add(t0 - submit_ns,
+                                          std::memory_order_relaxed);
+        fn(i);
+        s.busy_ns[family][slot].fetch_add(NowNs() - t0,
+                                          std::memory_order_relaxed);
+        s.tasks[family][slot].fetch_add(1, std::memory_order_relaxed);
+      } else {
+        fn(i);
+      }
       std::lock_guard<std::mutex> lk(mutex_);
       if (gen == generation_ && ++completed_ == total_) {
         done_.notify_all();
@@ -150,6 +304,9 @@ class ThreadPool {
   int total_ = 0;
   int next_ = 0;
   int completed_ = 0;
+  int family_ = 0;
+  int64_t submit_ns_ = 0;
+  bool stats_on_ = false;
   uint64_t generation_ = 0;
   bool stop_ = false;
 };

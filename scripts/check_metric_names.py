@@ -88,6 +88,25 @@ def known_failpoint_sites() -> Set[str]:
         sys.path.pop(0)
 
 
+def collector_metrics() -> Dict[str, str]:
+    """The collector-produced metric registry (name -> kind) — the
+    pull-model families (`ydf_pool_*`, `ydf_mem_*`, the
+    `ydf_native_*_kernel_seconds` gauges) have no `.counter("…")` call
+    site to scan, so telemetry.COLLECTOR_METRICS is their authoritative
+    declaration (stdlib-only import, like KNOWN_SITES). A collector
+    gauge registered there but absent from the docs inventory fails the
+    lint exactly like a call-site metric would;
+    tests/test_resource_observability.py closes the other direction
+    (a collector EMITTING a name missing from the registry)."""
+    sys.path.insert(0, REPO)
+    try:
+        from ydf_tpu.utils import telemetry
+
+        return dict(telemetry.COLLECTOR_METRICS)
+    finally:
+        sys.path.pop(0)
+
+
 def doc_names(doc_path: str) -> Set[str]:
     """Every `ydf_*` token and `area.site` token the doc mentions —
     the inventory is written with LITERAL full names, one per metric."""
@@ -107,6 +126,11 @@ def check(
     metrics, hit_sites = scan_tree(root)
     documented = doc_names(doc_path)
     all_sites = set(hit_sites) | known_failpoint_sites()
+    collectors = collector_metrics()
+    for name, kind in collectors.items():
+        metrics.setdefault(
+            (kind, name), ["ydf_tpu/utils/telemetry.py (collector)"]
+        )
     violations: List[str] = []
 
     for (kind, name), files in sorted(metrics.items()):
@@ -154,6 +178,7 @@ def check(
 
     return {
         "metrics_scanned": len(metrics),
+        "collector_metrics": len(collectors),
         "failpoint_sites": len(all_sites),
         "documented_names": len(documented),
         "violations": violations,

@@ -52,6 +52,7 @@ def run_check(
     rel_budget: float = 0.03,
     abs_floor_s: float = 0.08,
     with_http: bool = False,
+    with_ledger: bool = False,
 ) -> dict:
     import numpy as np
 
@@ -79,9 +80,31 @@ def run_check(
     disabled_a = measure_min_wall(train_once, reps)
     td = tempfile.mkdtemp(prefix="ydf_tel_overhead_")
     enabled_http = None
+    enabled_ledger = None
+    ledger_snap = None
     try:
         with telemetry.active(td):
             enabled = measure_min_wall(train_once, reps)
+            if with_ledger:
+                # Ledger-accounting variant: RSS sampling at span
+                # boundaries FORCED on (it defaults on, but the check
+                # must hold even if the env disabled it) plus one full
+                # ledger snapshot per rep — a scraper pulling /statusz
+                # mid-train. The memory accounting must fit the same
+                # budget as the rest of the instrumentation.
+                old_sample = telemetry.MEM_SAMPLE
+                telemetry.configure(mem_sample=True)
+                try:
+                    def train_and_scrape():
+                        train_once()
+                        telemetry.ledger().snapshot()
+
+                    enabled_ledger = measure_min_wall(
+                        train_and_scrape, reps
+                    )
+                    ledger_snap = telemetry.ledger().snapshot()
+                finally:
+                    telemetry.configure(mem_sample=old_sample)
             if with_http:
                 # Endpoint-enabled variant: the exposition thread
                 # (ephemeral port) serves /metrics while the SAME
@@ -130,6 +153,23 @@ def run_check(
         summary["http_overhead_s"] = round(http_overhead, 4)
         summary["ok_http"] = http_overhead <= budget
         summary["ok"] = summary["ok"] and summary["ok_http"]
+    if enabled_ledger is not None:
+        ledger_overhead = enabled_ledger - disabled
+        summary["enabled_ledger_min_s"] = round(enabled_ledger, 4)
+        summary["ledger_overhead_s"] = round(ledger_overhead, 4)
+        summary["ok_ledger"] = ledger_overhead <= budget
+        # The accounting must also have actually accounted: the span
+        # exits sampled an RSS watermark and the ledger saw sources.
+        summary["ledger_sampled_peak_rss_bytes"] = int(
+            (ledger_snap or {}).get("sampled_peak_rss_bytes", 0)
+        )
+        summary["ok_ledger_populated"] = (
+            summary["ledger_sampled_peak_rss_bytes"] > 0
+        )
+        summary["ok"] = (
+            summary["ok"] and summary["ok_ledger"]
+            and summary["ok_ledger_populated"]
+        )
     return summary
 
 
@@ -143,11 +183,16 @@ def main(argv=None) -> int:
     ap.add_argument("--with-http", action="store_true",
                     help="additionally measure with the /metrics "
                          "endpoint serving (utils/telemetry_http.py)")
+    ap.add_argument("--with-ledger", action="store_true",
+                    help="additionally measure with memory-ledger RSS "
+                         "sampling forced on plus a per-rep ledger "
+                         "snapshot (the accounting must fit the same "
+                         "3%% budget)")
     args = ap.parse_args(argv)
     summary = run_check(
         rows=args.rows, trees=args.trees, depth=args.depth,
         features=args.features, reps=args.reps,
-        with_http=args.with_http,
+        with_http=args.with_http, with_ledger=args.with_ledger,
     )
     print(json.dumps(summary))
     return 0 if summary["ok"] else 1

@@ -1,0 +1,227 @@
+"""scripts/bench_diff.py — the cross-round bench regression sentinel.
+
+Tier-1 (pure python, no jax): the sentinel must (a) run over the REAL
+checked-in BENCH_r04/BENCH_r05 rounds and structurally kill the 640 ns
+shape confound (quick-floor record unpaired, same-shape serving NOT a
+regression), and (b) flag a synthetically injected per-stage regression
+past its noise threshold.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "bench_diff.py")
+R04 = os.path.join(REPO, "BENCH_r04.json")
+R05 = os.path.join(REPO, "BENCH_r05.json")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("bench_diff", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def bd():
+    return _load()
+
+
+# ---------------------------------------------------------------------- #
+# Loading
+# ---------------------------------------------------------------------- #
+
+
+def test_loads_driver_wrapper_and_drops_projections(bd):
+    recs = bd.load_records(R04)
+    # r04's tail holds the quick floor + the full record; projections
+    # (if any) and error records must never survive loading.
+    assert len(recs) >= 2
+    assert all("PROJECTED" not in r["metric"] for r in recs)
+    shapes = {bd.shape_key(r) for r in recs}
+    assert len(shapes) == 2  # quick (20k, 5) and full (500k, 20)
+
+
+def test_loads_jsonl_and_single_record(bd, tmp_path):
+    rec = {"metric": "m", "backend": "cpu", "rows": 10, "trees": 2,
+           "depth": 3, "value": 1.0, "train_wall_s": 2.0}
+    p1 = tmp_path / "one.json"
+    p1.write_text(json.dumps(rec))
+    assert len(bd.load_records(str(p1))) == 1
+    p2 = tmp_path / "many.jsonl"
+    p2.write_text(json.dumps(rec) + "\n" + json.dumps(rec) + "\n")
+    assert len(bd.load_records(str(p2))) == 2
+
+
+def test_error_records_dropped(bd, tmp_path):
+    bad = {"metric": "m", "value": 0.0, "error": "backend down"}
+    p = tmp_path / "err.jsonl"
+    p.write_text(json.dumps(bad) + "\n")
+    assert bd.load_records(str(p)) == []
+
+
+# ---------------------------------------------------------------------- #
+# The real r04 → r05 confound
+# ---------------------------------------------------------------------- #
+
+
+def test_r04_r05_pairs_by_shape_and_flags_no_false_regression(bd):
+    """The acceptance criterion verbatim: run on the checked-in rounds,
+    the quick-floor shape must be UNPAIRED (never compared — the 640 ns
+    confound class is dead structurally) and the same-shape serving
+    fields must not be flagged as a regression (they improved 5%)."""
+    doc = bd.diff(R04, R05)
+    assert doc["ok"], doc["regressions"]
+    assert doc["regressions"] == []
+    # Exactly one shared shape: the (500000, 20) full record.
+    assert len(doc["pairs"]) == 1
+    shape = doc["pairs"][0]["shape"]
+    assert (shape["rows"], shape["trees"]) == (500_000, 20)
+    # The 640.5 ns quick-floor record exists only in r04: unpaired.
+    assert any("rows=20000" in s for s in doc["unpaired_a"])
+    # Same-shape serving: 1451.2 -> 1380.7 is an improvement-direction
+    # move inside the noise band — anything but "regression".
+    infer = doc["pairs"][0]["fields"]["infer_ns_per_example"]
+    assert infer["a"] == pytest.approx(1451.2)
+    assert infer["b"] == pytest.approx(1380.7)
+    assert infer["verdict"] != "regression"
+    # And the train-side fields register the real 2.4x improvement.
+    assert (
+        doc["pairs"][0]["fields"]["train_wall_s"]["verdict"]
+        == "improvement"
+    )
+
+
+# ---------------------------------------------------------------------- #
+# Synthetic injected regression
+# ---------------------------------------------------------------------- #
+
+
+def _full_record():
+    """A headline-shaped record with the per-stage + resource fields."""
+    return {
+        "metric": "gbt_train_rows_x_trees_per_sec_per_chip",
+        "backend": "cpu", "rows": 500_000, "trees": 20, "depth": 6,
+        "value": 1_000_000.0, "train_wall_s": 10.0, "ingest_s": 1.0,
+        "bin_s": 0.5, "hist_s": 4.0, "route_s": 1.0, "update_s": 0.5,
+        "fused_s": 3.0, "infer_ns_per_example": 1000.0,
+        "infer_p50_ns": 950.0, "infer_p99_ns": 1200.0,
+        "infer_qps": 2_000_000.0,
+        "pool_utilization": {"hist": 0.9, "serve": 0.8},
+        "pool_size": 8,
+        "train_peak_rss_bytes": 2 << 30,
+        "serve_bank_bytes": 40 << 20,
+        "infer_peak_rss_delta_bytes": 0,
+        "infer_batch_p50_ns": {"1": 15000.0, "256": 200000.0},
+    }
+
+
+def test_injected_per_stage_regression_is_flagged(bd, tmp_path):
+    a, b = _full_record(), _full_record()
+    b["hist_s"] = a["hist_s"] * 1.5          # +50% in-loop histogram
+    b["value"] = a["value"] * 0.8            # throughput drop rides along
+    pa, pb = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    pa.write_text(json.dumps(a) + "\n")
+    pb.write_text(json.dumps(b) + "\n")
+    doc = bd.diff(str(pa), str(pb))
+    assert not doc["ok"]
+    flagged = " ".join(doc["regressions"])
+    assert "hist_s" in flagged and "value" in flagged
+    assert doc["pairs"][0]["fields"]["hist_s"]["verdict"] == "regression"
+
+
+def test_noise_band_suppresses_small_moves(bd, tmp_path):
+    a, b = _full_record(), _full_record()
+    b["hist_s"] = a["hist_s"] * 1.04   # +4% < the 15% band: unchanged
+    b["train_wall_s"] = a["train_wall_s"] + 0.1  # under the 0.2s floor
+    pa, pb = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    pa.write_text(json.dumps(a) + "\n")
+    pb.write_text(json.dumps(b) + "\n")
+    doc = bd.diff(str(pa), str(pb))
+    assert doc["ok"], doc["regressions"]
+    assert doc["pairs"][0]["fields"]["hist_s"]["verdict"] == "unchanged"
+
+
+def test_resource_fields_diff_directionally(bd, tmp_path):
+    """The new utilization/memory fields carry direction: utilization
+    DROP and memory GROWTH are the regressions."""
+    a, b = _full_record(), _full_record()
+    b["pool_utilization"] = {"hist": 0.45, "serve": 0.8}  # halved
+    b["serve_bank_bytes"] = a["serve_bank_bytes"] * 2     # doubled
+    b["infer_peak_rss_delta_bytes"] = 64 << 20            # 0 -> 64MB
+    pa, pb = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    pa.write_text(json.dumps(a) + "\n")
+    pb.write_text(json.dumps(b) + "\n")
+    doc = bd.diff(str(pa), str(pb))
+    fields = doc["pairs"][0]["fields"]
+    assert fields["pool_utilization.hist"]["verdict"] == "regression"
+    assert fields["pool_utilization.serve"]["verdict"] == "unchanged"
+    assert fields["serve_bank_bytes"]["verdict"] == "regression"
+    assert fields["infer_peak_rss_delta_bytes"]["verdict"] == "regression"
+    # ...and the improvement direction is symmetric.
+    doc2 = bd.diff(str(pb), str(pa))
+    assert (
+        doc2["pairs"][0]["fields"]["pool_utilization.hist"]["verdict"]
+        == "improvement"
+    )
+
+
+def test_different_shapes_never_compare(bd, tmp_path):
+    a = _full_record()
+    b = _full_record()
+    b["trees"] = 5
+    b["infer_ns_per_example"] = 640.5  # the confound, synthesized
+    pa, pb = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    pa.write_text(json.dumps(a) + "\n")
+    pb.write_text(json.dumps(b) + "\n")
+    doc = bd.diff(str(pa), str(pb))
+    assert doc["pairs"] == []
+    assert doc["ok"]
+    assert len(doc["unpaired_a"]) == 1 and len(doc["unpaired_b"]) == 1
+
+
+# ---------------------------------------------------------------------- #
+# CLI + report
+# ---------------------------------------------------------------------- #
+
+
+def test_cli_markdown_json_and_exit_codes(bd, tmp_path):
+    a, b = _full_record(), _full_record()
+    b["hist_s"] = a["hist_s"] * 2
+    pa, pb = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    pa.write_text(json.dumps(a) + "\n")
+    pb.write_text(json.dumps(b) + "\n")
+    md_out = tmp_path / "diff.md"
+    json_out = tmp_path / "diff.json"
+    out = subprocess.run(
+        [sys.executable, SCRIPT, str(pa), str(pb),
+         "--md", str(md_out), "--json", str(json_out),
+         "--fail-on-regression"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert out.returncode == 1  # regression + --fail-on-regression
+    summary = json.loads(out.stdout.strip().splitlines()[-1])
+    assert not summary["ok"]
+    doc = json.loads(json_out.read_text())
+    assert doc["pairs"][0]["fields"]["hist_s"]["verdict"] == "regression"
+    md = md_out.read_text()
+    assert "REGRESSION" in md and "hist_s" in md
+    # Without --fail-on-regression the exit code stays 0 (report tool).
+    out2 = subprocess.run(
+        [sys.executable, SCRIPT, str(pa), str(pb)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert out2.returncode == 0
+
+
+def test_markdown_mentions_unpaired_confound_warning(bd):
+    doc = bd.diff(R04, R05)
+    md = bd.to_markdown(doc)
+    assert "NOT compared" in md
+    assert "640" in md  # the lesson is named in the report itself

@@ -92,6 +92,20 @@ def test_small_cpu_run_emits_parseable_record():
     # On this CPU image the native engine must actually be the one
     # serving — anything else means the build silently degraded.
     assert rec["serve_engine"] == "NativeBatch"
+    # Resource observability (round 15): pool utilization per stage —
+    # busy / (lanes x pooled wall) from native/thread_pool.h's stats
+    # block — and the memory headline fields. On this image the native
+    # hist kernel and the NativeBatch serving engine both run, so the
+    # hist and serve stages must report; utilization is a ratio
+    # (clock-granularity slack allowed above 1.0).
+    assert rec["pool_size"] >= 1
+    util = rec["pool_utilization"]
+    assert "hist" in util and "serve" in util, util
+    for stage, u in util.items():
+        assert 0.0 < u <= 1.2, (stage, u)
+    assert rec["train_peak_rss_bytes"] > 0
+    assert rec["serve_bank_bytes"] > 0
+    assert rec["infer_peak_rss_delta_bytes"] >= 0
     # The backend-probe outcome is persisted across rounds; the record
     # names whether this run used the cache (--cpu skips the probe, so
     # here it is simply present and False).
@@ -131,6 +145,9 @@ def test_small_cpu_run_with_distributed_family():
     assert p50.get("build_histograms", 0) > 0
     assert p50.get("load_cache_shard", 0) > 0
     assert rec["dist_recoveries"] == 0
+    # Fleet-total resident shard/state bytes the workers reported at
+    # shard load (round 15's distributed memory headline).
+    assert rec["dist_shard_bytes"] > 0
     # Per-layer wall attribution (this round): compute + net + wait
     # partition the summed layer wall, so distributed slowness is
     # attributable to compute, the network, or a straggler from the

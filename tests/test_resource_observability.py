@@ -1,0 +1,474 @@
+"""Resource observability (round 15): thread-pool utilization stats,
+the memory ledger, the /statusz config section, and the OOM flight
+dump.
+
+The contracts under test (docs/observability.md "Resource
+observability"):
+
+  * the native pool accumulates per-family busy/task/queue-wait/wall
+    counters and derives utilization = busy / (lanes x wall);
+  * models and kernel outputs are BIT-IDENTICAL with the counters on
+    vs off (YDF_TPU_POOL_STATS — the zero-overhead contract's
+    correctness half);
+  * every collector-emitted metric name is declared in
+    telemetry.COLLECTOR_METRICS (the static lint's registry) — the
+    runtime direction scripts/check_metric_names.py cannot see;
+  * the MemoryLedger's push/pull/RSS surfaces, its ENABLED gating,
+    and its appearance on /statusz, training_logs and flight dumps;
+  * resolved-env config on /statusz and the manager-side mismatch
+    check;
+  * an injected OOM (failpoint `telemetry.oom`) leaves a parseable
+    flight dump with reason "oom" and the ledger snapshot.
+"""
+
+import hashlib
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+from ydf_tpu.utils import failpoints, telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tiny_ds(rows=3000, features=4, seed=0):
+    from ydf_tpu.dataset.dataset import Dataset
+
+    rng = np.random.RandomState(seed)
+    x = rng.normal(size=(rows, features)).astype(np.float32)
+    y = (x[:, 0] - 0.3 * x[:, 1] > 0).astype(np.int64)
+    data = {f"f{i}": x[:, i] for i in range(features)}
+    data["label"] = y
+    return Dataset.from_data(data, label="label"), data
+
+
+def _train(ds, trees=4, depth=3):
+    import ydf_tpu as ydf
+
+    return ydf.GradientBoostedTreesLearner(
+        label="label", num_trees=trees, max_depth=depth,
+        validation_ratio=0.0, early_stopping="NONE",
+    ).train(ds)
+
+
+# ---------------------------------------------------------------------- #
+# Thread-pool utilization
+# ---------------------------------------------------------------------- #
+
+
+def test_pool_stats_env_validation():
+    from ydf_tpu.ops.pool_stats import resolve_pool_stats
+
+    assert resolve_pool_stats("1") is True
+    assert resolve_pool_stats("on") is True
+    assert resolve_pool_stats("0") is False
+    assert resolve_pool_stats("off") is False
+    assert resolve_pool_stats("") is True  # unset-equivalent: default on
+    with pytest.raises(ValueError, match="YDF_TPU_POOL_STATS"):
+        resolve_pool_stats("sideways")
+
+
+def test_pool_stats_accumulate_and_reset():
+    """A native histogram call advances the hist family's counters and
+    the derived utilization is sane; reset zeroes everything."""
+    import jax.numpy as jnp
+
+    from ydf_tpu.ops import pool_stats
+    from ydf_tpu.ops.histogram import histogram
+
+    if not pool_stats.available():
+        pytest.skip("native kernel library unavailable")
+    pool_stats.reset_pool_stats()
+    rng = np.random.RandomState(0)
+    n, F = 70_000, 6
+    bins = jnp.asarray(rng.randint(0, 256, size=(n, F)).astype(np.uint8))
+    slot = jnp.asarray(rng.randint(0, 4, size=(n,)).astype(np.int32))
+    stats = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32))
+    out = histogram(bins, slot, stats, num_slots=4, num_bins=256,
+                    impl="native")
+    out.block_until_ready()
+    ps = pool_stats.pool_stats()
+    assert ps["size"] >= 1
+    fam = ps["families"]["hist"]
+    assert fam["runs"] > 0
+    assert fam["tasks"] > 0
+    assert fam["busy_ns"] > 0
+    assert fam["run_wall_ns"] >= fam["busy_ns"] / max(ps["size"], 1) * 0.5
+    # busy cannot exceed lanes x wall by construction (utilization <= 1
+    # up to clock granularity).
+    assert 0.0 < fam["utilization"] <= 1.05
+    # Per-lane breakdown sums to the family busy total.
+    assert sum(fam["per_lane_busy_ns"]) == fam["busy_ns"]
+    pool_stats.reset_pool_stats()
+    ps2 = pool_stats.pool_stats()
+    assert ps2["families"]["hist"]["busy_ns"] == 0
+    assert ps2["families"]["hist"]["runs"] == 0
+
+
+def test_pool_metrics_labeled_samples_and_registry_closure():
+    """pool_metrics() emits label-suffixed sample keys; EVERY base name
+    any collector emits must be declared in telemetry.COLLECTOR_METRICS
+    (the static lint checks declared -> documented; this closes
+    emitted -> declared)."""
+    from ydf_tpu.ops import pool_stats
+    from ydf_tpu.utils import profiling
+
+    if pool_stats.available():
+        pool_stats.reset_pool_stats()
+        # Make at least one family non-empty so labeled keys appear.
+        import jax.numpy as jnp
+
+        from ydf_tpu.ops.histogram import histogram
+
+        rng = np.random.RandomState(1)
+        bins = jnp.asarray(
+            rng.randint(0, 256, size=(2000, 3)).astype(np.uint8)
+        )
+        slot = jnp.asarray(np.zeros(2000, np.int32))
+        stats = jnp.asarray(rng.normal(size=(2000, 3)).astype(np.float32))
+        histogram(bins, slot, stats, num_slots=1, num_bins=256,
+                  impl="native").block_until_ready()
+        pm = pool_stats.pool_metrics()
+        assert any(
+            k.startswith('ydf_pool_busy_ns_total{pool="hist"') for k in pm
+        ), sorted(pm)
+    metrics = profiling.native_kernel_metrics()
+    metrics.update(telemetry._ledger_metrics())
+    for key in metrics:
+        base = key.split("{", 1)[0]
+        assert base in telemetry.COLLECTOR_METRICS, (
+            f"collector emits {base!r} which is not declared in "
+            "telemetry.COLLECTOR_METRICS (the lint registry)"
+        )
+
+
+def test_metrics_text_splits_labeled_collector_keys():
+    """The Prometheus exposition emits ONE TYPE line per base name and
+    the labeled samples verbatim — a labeled key must never produce a
+    malformed `# TYPE name{...}` line."""
+    with telemetry.active():
+        telemetry.register_mem_source("ro_test_src", lambda: 7)
+        text = telemetry.metrics_text()
+    assert 'ydf_mem_bytes{subsystem="ro_test_src"} 7' in text
+    for line in text.splitlines():
+        if line.startswith("# TYPE"):
+            assert "{" not in line, line
+            kind = line.split()[-1]
+            assert kind in ("counter", "gauge", "histogram")
+    telemetry._MEM_SOURCES.pop("ro_test_src", None)
+
+
+def test_bit_identical_with_pool_stats_on_vs_off():
+    """THE correctness half of the contract: the same training run,
+    once with utilization counters on and once off (and once with the
+    ledger RSS sampling off for good measure), must produce
+    bit-identical predictions and tree arrays. Subprocesses: the C++
+    side caches YDF_TPU_POOL_STATS at first use."""
+    code = r"""
+import hashlib, os, sys
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import ydf_tpu as ydf
+from ydf_tpu.dataset.dataset import Dataset
+
+rng = np.random.RandomState(7)
+x = rng.normal(size=(20000, 6)).astype(np.float32)
+y = (x[:, 0] + 0.5 * x[:, 1] * x[:, 2] > 0).astype(np.int64)
+data = {f"f{i}": x[:, i] for i in range(6)}
+data["label"] = y
+ds = Dataset.from_data(data, label="label")
+m = ydf.GradientBoostedTreesLearner(
+    label="label", num_trees=5, max_depth=4,
+    validation_ratio=0.0, early_stopping="NONE",
+).train(ds)
+h = hashlib.sha256()
+h.update(np.ascontiguousarray(np.asarray(m.predict(ds))).tobytes())
+for k, v in sorted(m.forest.to_numpy().items()):
+    h.update(np.ascontiguousarray(np.asarray(v)).tobytes())
+print("HASH", h.hexdigest())
+"""
+    digests = {}
+    for label, env_extra in (
+        ("stats_on", {"YDF_TPU_POOL_STATS": "1"}),
+        ("stats_off", {"YDF_TPU_POOL_STATS": "0",
+                       "YDF_TPU_MEM_SAMPLE": "0"}),
+    ):
+        env = dict(os.environ)
+        env.update(env_extra)
+        env["JAX_PLATFORMS"] = "cpu"
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True,
+            text=True, env=env, cwd=REPO, timeout=300,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        m = re.search(r"HASH ([0-9a-f]{64})", out.stdout)
+        assert m, out.stdout
+        digests[label] = m.group(1)
+    assert digests["stats_on"] == digests["stats_off"], digests
+
+
+# ---------------------------------------------------------------------- #
+# Memory ledger
+# ---------------------------------------------------------------------- #
+
+
+def test_ledger_push_pull_and_snapshot_fields():
+    with telemetry.active():
+        telemetry.mem_set("ro_sub_a", 100)
+        telemetry.mem_add("ro_sub_a", 50)
+        telemetry.mem_add("ro_sub_a", -200)  # clamps at 0
+        telemetry.register_mem_source("ro_sub_b", lambda: 42)
+        snap = telemetry.ledger().snapshot()
+        assert snap["subsystems"]["ro_sub_a"] == 0
+        assert snap["subsystems"]["ro_sub_b"] == 42
+        assert snap["rss_bytes"] > 0
+        assert snap["peak_rss_bytes"] >= snap["rss_bytes"] // 2
+        with telemetry.span("ro.sample"):
+            pass
+        assert telemetry.ledger().snapshot()[
+            "sampled_peak_rss_bytes"] > 0
+    telemetry._MEM_SOURCES.pop("ro_sub_b", None)
+
+
+def test_ledger_push_is_enabled_gated_but_sources_are_not():
+    """mem_set/mem_add follow the zero-overhead contract (no-op when
+    telemetry is off); pull sources answer regardless — they are
+    process facts, and get_telemetry reports them even from a
+    telemetry-off worker."""
+    assert not telemetry.ENABLED
+    telemetry.mem_set("ro_gated", 123)
+    assert telemetry.ledger().get_bytes("ro_gated") == 0
+    telemetry.register_mem_source("ro_pull", lambda: 9)
+    try:
+        assert telemetry.ledger().snapshot()["subsystems"]["ro_pull"] == 9
+    finally:
+        telemetry._MEM_SOURCES.pop("ro_pull", None)
+
+
+def test_broken_mem_source_degrades_silently():
+    def boom():
+        raise RuntimeError("broken source")
+
+    telemetry.register_mem_source("ro_broken", boom)
+    try:
+        snap = telemetry.ledger().snapshot()
+        assert "ro_broken" not in snap["subsystems"]
+    finally:
+        telemetry._MEM_SOURCES.pop("ro_broken", None)
+
+
+def test_default_subsystem_sources_registered():
+    """Importing the instrumented modules registers their pull sources;
+    a train + a serving-bank build populate them."""
+    import ydf_tpu.parallel.worker_service  # noqa: F401 — dist_frames
+    from ydf_tpu.parallel import dist_worker  # noqa: F401 — dist_shard
+    from ydf_tpu.serving import native_serve
+
+    ds, _ = _tiny_ds()
+    with telemetry.active():
+        model = _train(ds)
+        snap = telemetry.ledger().snapshot()
+        subs = snap["subsystems"]
+        for name in ("bin_matrix", "dataset_cache", "serve_bank",
+                     "serve_batcher", "dist_shard", "dist_frames"):
+            assert name in subs, sorted(subs)
+        # A Binner.transform over the Dataset populates its bin-matrix
+        # memo, which the bin_matrix row accounts.
+        bins = model.binner.transform(ds)
+        after_bins = telemetry.ledger().snapshot()["subsystems"]
+        assert after_bins["bin_matrix"] >= bins.nbytes
+        # Building the native serving bank moves the serve_bank row.
+        bank = native_serve.model_serve_bank(model)
+        assert bank.nbytes > 0
+        after = telemetry.ledger().snapshot()["subsystems"]
+        assert after["serve_bank"] >= bank.nbytes
+        # hist_arena rides the default collectors once a native
+        # histogram ran (the train above used impl=native on this box).
+        from ydf_tpu.ops.histogram import resolve_hist_impl
+
+        if resolve_hist_impl("auto") == "native":
+            assert after.get("hist_arena", 0) > 0
+
+
+def test_training_logs_carry_memory_snapshot():
+    ds, _ = _tiny_ds()
+    with telemetry.active():
+        model = _train(ds)
+        mem = model.training_logs.get("memory")
+        assert isinstance(mem, dict)
+        assert "subsystems" in mem and mem["rss_bytes"] > 0
+    # Telemetry off: the key is absent (zero-overhead contract).
+    model2 = _train(ds)
+    assert "memory" not in model2.training_logs
+
+
+def test_mem_sample_env_validation():
+    assert telemetry._parse_mem_sample(None) is True
+    assert telemetry._parse_mem_sample("0") is False
+    assert telemetry._parse_mem_sample("on") is True
+    with pytest.raises(ValueError, match="YDF_TPU_MEM_SAMPLE"):
+        telemetry._parse_mem_sample("maybe")
+
+
+def test_benchmark_reports_peak_rss_delta():
+    ds, data = _tiny_ds()
+    model = _train(ds)
+    res = model.benchmark({k: v[:500] for k, v in data.items()},
+                          num_runs=3)
+    assert "peak_rss_delta_bytes" in res
+    assert res["peak_rss_delta_bytes"] >= 0
+
+
+# ---------------------------------------------------------------------- #
+# get_telemetry drain + worker/manager memory plumbing
+# ---------------------------------------------------------------------- #
+
+
+def test_get_telemetry_reports_rss_and_ledger():
+    from ydf_tpu.parallel.worker_service import _handle_request
+
+    resp = _handle_request({"verb": "get_telemetry"})
+    assert resp["ok"]
+    assert resp["rss_bytes"] > 0
+    assert resp["peak_rss_bytes"] > 0
+    assert "subsystems" in resp["memory"]
+
+
+def test_manager_notes_shard_bytes_and_config_mismatch(caplog):
+    """_note_shard_load records worker shard bytes and logs + counts a
+    resolved-config mismatch at load time (satellite: config drift was
+    invisible)."""
+    import types
+
+    from ydf_tpu.config import DIST_CONFIG_KEYS, resolved_env_config
+    from ydf_tpu.parallel.dist_gbt import DistGBTManager, _DistStats
+
+    mgr = types.SimpleNamespace(
+        pool=types.SimpleNamespace(addr_str=lambda i: f"w{i}"),
+        stats=_DistStats(),
+    )
+    mine = resolved_env_config()
+    wcfg = {k: mine.get(k) for k in DIST_CONFIG_KEYS}
+    key = DIST_CONFIG_KEYS[0]
+    with telemetry.active():
+        # Matching config: no mismatch.
+        DistGBTManager._note_shard_load(
+            mgr, 0, {"shard_bytes": 1234, "config": dict(wcfg)}
+        )
+        assert mgr.stats.shard_bytes == {"w0": 1234}
+        assert mgr.stats.config_mismatches == 0
+        # Drifted worker: logged and counted.
+        wcfg[key] = "something_else"
+        DistGBTManager._note_shard_load(
+            mgr, 1, {"shard_bytes": 99, "config": wcfg}
+        )
+        assert mgr.stats.config_mismatches == 1
+        snap = telemetry.snapshot()
+        assert any(
+            "ydf_dist_config_mismatch_total" in k
+            for k in snap["counters"]
+        ), snap["counters"]
+    summary = mgr.stats.summary()
+    assert summary["shard_bytes"] == 1234 + 99
+    assert summary["config_mismatches"] == 1
+
+
+def test_worker_dist_status_includes_shard_bytes():
+    from ydf_tpu.parallel import dist_worker
+
+    st = dist_worker._DistState(100)
+    st.shards[0] = dist_worker._ShardSlice(
+        0, 2, np.zeros((100, 2), np.uint8)
+    )
+    with dist_worker._STATE_LOCK:
+        dist_worker._STATE[("ro_wid", "ro_key")] = st
+    try:
+        out = dist_worker.status("ro_wid")
+        assert out["ro_key"]["shard_bytes"] >= 200
+        assert dist_worker.shard_bytes_total("ro_wid") >= 200
+    finally:
+        with dist_worker._STATE_LOCK:
+            dist_worker._STATE.pop(("ro_wid", "ro_key"), None)
+
+
+# ---------------------------------------------------------------------- #
+# /statusz sections
+# ---------------------------------------------------------------------- #
+
+
+def test_statusz_has_config_and_memory_sections():
+    from ydf_tpu.utils import telemetry_http
+
+    snap = telemetry_http.status_snapshot()
+    cfg = snap["config"]
+    # Resolved values, not raw env, and no error strings for the core
+    # knobs on a healthy box.
+    assert cfg["YDF_TPU_HIST_QUANT"] in ("f32", "bf16x2", "int8")
+    assert cfg["YDF_TPU_ROUTE_IMPL"] in ("xla", "native")
+    assert isinstance(cfg["YDF_TPU_POOL_STATS"], bool)
+    assert isinstance(cfg["YDF_TPU_MEM_SAMPLE"], bool)
+    assert isinstance(cfg["YDF_TPU_WORKER_SECRET"], bool)  # never bytes
+    assert "subsystems" in snap["memory"]
+
+
+# ---------------------------------------------------------------------- #
+# OOM flight dump (chaos via the telemetry.oom failpoint)
+# ---------------------------------------------------------------------- #
+
+
+def test_oom_leaves_flight_dump_with_memory_snapshot():
+    ds, _ = _tiny_ds()
+    td = tempfile.mkdtemp(prefix="ydf_ro_oom_")
+    with telemetry.active(td), failpoints.active("telemetry.oom=error"):
+        with pytest.raises(MemoryError):
+            _train(ds)
+        path = os.path.join(td, f"flight_{os.getpid()}.jsonl")
+        assert os.path.exists(path), "OOM left no flight dump"
+        lines = [json.loads(l) for l in open(path)]
+        header = lines[0]
+        assert header["kind"] == "flight_dump"
+        assert header["reason"] == "oom"
+        assert isinstance(header["memory"], dict)
+        assert "subsystems" in header["memory"]
+        assert any(e.get("kind") == "oom" for e in lines[1:])
+        assert any(e.get("kind") == "failpoint" for e in lines[1:])
+
+
+def test_oom_failpoint_fires_in_checkpointed_driver(tmp_path):
+    """The chunked (working_dir) driver hits the same site at its chunk
+    boundary — an OOM mid-checkpointed-train dumps too."""
+    import ydf_tpu as ydf
+
+    ds, _ = _tiny_ds()
+    td = tempfile.mkdtemp(prefix="ydf_ro_oom_ckpt_")
+    with telemetry.active(td), failpoints.active("telemetry.oom=error"):
+        with pytest.raises(MemoryError):
+            ydf.GradientBoostedTreesLearner(
+                label="label", num_trees=6, max_depth=3,
+                validation_ratio=0.0, early_stopping="NONE",
+                working_dir=str(tmp_path),
+                resume_training_snapshot_interval_trees=2,
+            ).train(ds)
+        path = os.path.join(td, f"flight_{os.getpid()}.jsonl")
+        assert os.path.exists(path)
+        header = json.loads(open(path).readline())
+        assert header["reason"] == "oom"
+
+
+def test_oom_recovery_bit_identical_when_failpoint_clears():
+    """Chaos-suite style: a fail_once OOM costs the run, but a rerun
+    (failpoint exhausted) produces predictions bit-identical to a run
+    that never faulted."""
+    ds, data = _tiny_ds()
+    baseline = np.asarray(_train(ds).predict(ds))
+    with failpoints.active("telemetry.oom=fail_once"):
+        with pytest.raises(MemoryError):
+            _train(ds)
+        rerun = np.asarray(_train(ds).predict(ds))
+    assert rerun.tobytes() == baseline.tobytes()

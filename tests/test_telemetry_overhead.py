@@ -21,15 +21,21 @@ def _load():
 
 
 def test_enabled_overhead_within_budget():
-    """Enabled-path AND endpoint-enabled variants: with the /metrics
-    HTTP thread serving scrapes during the run, the train hot path
-    must still fit the same budget — the exposition thread costs
-    nothing on it."""
+    """Enabled-path, endpoint-enabled AND ledger-enabled variants: with
+    the /metrics HTTP thread serving scrapes during the run, and with
+    memory-ledger RSS sampling forced on plus a per-rep ledger
+    snapshot (`--with-ledger`), the train hot path must still fit the
+    same budget — exposition and accounting cost nothing on it. The
+    ledger variant additionally proves the accounting POPULATED
+    (sampled RSS watermark > 0): a zero-cost ledger that measured
+    nothing would pass the budget vacuously."""
     mod = _load()
     summary = mod.run_check(rows=8_000, trees=8, depth=4, reps=2,
-                            with_http=True)
+                            with_http=True, with_ledger=True)
     assert summary["disabled_min_s"] > 0
     assert "ok_http" in summary and summary["enabled_http_min_s"] > 0
+    assert "ok_ledger" in summary and summary["enabled_ledger_min_s"] > 0
+    assert summary["ok_ledger_populated"], summary
     assert summary["ok"], (
         "telemetry enabled-path overhead exceeded its budget: "
         f"{summary}"

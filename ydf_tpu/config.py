@@ -134,3 +134,120 @@ class TreeConfig:
                 total += (depth - d) * 2 * self.frontier
                 break
         return int(total)
+
+
+def resolved_env_config() -> dict:
+    """Every YDF_TPU_* knob as the subsystems actually RESOLVED it —
+    the eagerly-validated values, not raw os.environ (a typo'd env var
+    raised at import; what shows here is what runs). The /statusz
+    `config` section (utils/telemetry_http.py) and each distributed
+    worker's status/shard-load response carry this dict, so config
+    drift between manager and workers is visible instead of surfacing
+    as a confusing perf or bit-identity report days later
+    (docs/observability.md "Resource observability").
+
+    Best-effort per knob: a subsystem that cannot import here (no
+    toolchain, no jax) degrades that one entry to an `error: ...`
+    string, never the whole page."""
+    out = {}
+
+    def put(key, fn):
+        try:
+            out[key] = fn()
+        except Exception as e:  # noqa: BLE001 — page must render
+            out[key] = f"error: {type(e).__name__}: {e}"
+
+    def _telemetry():
+        from ydf_tpu.utils import telemetry
+
+        return telemetry
+
+    put("YDF_TPU_TELEMETRY", lambda: _telemetry().ENABLED)
+    put("YDF_TPU_TELEMETRY_DIR", lambda: _telemetry().EXPORT_DIR)
+    put("YDF_TPU_MEM_SAMPLE", lambda: _telemetry().MEM_SAMPLE)
+    put("YDF_TPU_LOG", lambda: __import__(
+        "ydf_tpu.utils.log", fromlist=["LEVEL"]).LEVEL)
+    put("YDF_TPU_METRICS_PORT", lambda: __import__(
+        "ydf_tpu.utils.telemetry_http",
+        fromlist=["METRICS_PORT"]).METRICS_PORT)
+
+    def _failpoints():
+        from ydf_tpu.utils import failpoints
+
+        return sorted(failpoints._SPECS) if failpoints.ENABLED else []
+
+    put("YDF_TPU_FAILPOINTS", _failpoints)
+
+    def _hist():
+        from ydf_tpu.ops import histogram
+
+        return histogram
+
+    put("YDF_TPU_HIST_IMPL", lambda: _hist().resolve_hist_impl("auto"))
+    put("YDF_TPU_HIST_QUANT", lambda: _hist().resolve_hist_quant(None))
+    put("YDF_TPU_HIST_SUBTRACT",
+        lambda: _hist().resolve_hist_subtract(None))
+
+    def _route():
+        from ydf_tpu.ops import routing_native
+
+        return routing_native
+
+    put("YDF_TPU_ROUTE_IMPL", lambda: _route().resolve_route_impl(None))
+    put("YDF_TPU_ROUTE_FUSE", lambda: _route().resolve_route_fuse())
+    put("YDF_TPU_ROUTE_THREADS",
+        lambda: _route().resolved_route_threads())
+    put("YDF_TPU_POOL_STATS", lambda: __import__(
+        "ydf_tpu.ops.pool_stats",
+        fromlist=["POOL_STATS_ENABLED"]).POOL_STATS_ENABLED)
+
+    def _serving():
+        from ydf_tpu.serving import registry
+
+        return registry
+
+    put("YDF_TPU_SERVE_IMPL", lambda: _serving().resolve_serve_impl())
+    put("YDF_TPU_SERVE_MAX_BATCH", lambda: _serving().SERVE_MAX_BATCH)
+    put("YDF_TPU_SERVE_BATCH_TIMEOUT_US",
+        lambda: _serving().SERVE_BATCH_TIMEOUT_US)
+
+    def _cache_verify():
+        from ydf_tpu.dataset import cache
+
+        return cache._resolve_verify(None)
+
+    put("YDF_TPU_CACHE_VERIFY", _cache_verify)
+
+    def _worker():
+        from ydf_tpu.parallel import worker_service
+
+        return worker_service
+
+    put("YDF_TPU_WORKER_MAX_FRAME", lambda: _worker()._max_frame())
+    put("YDF_TPU_WORKER_SEND_TIMEOUT",
+        lambda: _worker()._send_timeout())
+    put("YDF_TPU_WORKER_SECRET",
+        lambda: _worker()._env_secret() is not None)
+
+    def _dist():
+        from ydf_tpu.parallel import dist_gbt
+
+        return dist_gbt
+
+    put("YDF_TPU_DIST_RPC_TIMEOUT_S",
+        lambda: _dist()._parse_rpc_timeout())
+    put("YDF_TPU_DIST_VERIFY", lambda: _dist()._parse_verify())
+    return out
+
+
+#: Knobs that must agree between a distributed manager and its workers
+#: for bit-identity / comparable perf — the subset the manager checks
+#: against each worker's shard-load response (parallel/dist_gbt.py
+#: logs a mismatch at load time; see resolved_env_config).
+DIST_CONFIG_KEYS = (
+    "YDF_TPU_HIST_IMPL",
+    "YDF_TPU_HIST_QUANT",
+    "YDF_TPU_HIST_SUBTRACT",
+    "YDF_TPU_CACHE_VERIFY",
+    "YDF_TPU_WORKER_MAX_FRAME",
+)

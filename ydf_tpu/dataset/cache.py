@@ -262,6 +262,21 @@ def _shard_file(k: int) -> str:
     return f"bins_shard_{k}.npy"
 
 
+# Live cache handles for the memory ledger's "dataset_cache" pull
+# source: the memmap-backed byte footprint of every open cache, sampled
+# only at ledger snapshots (never on an IO path).
+import weakref as _weakref  # noqa: E402
+
+_OPEN_CACHES: "_weakref.WeakSet" = _weakref.WeakSet()
+
+
+def open_cache_bytes_total() -> int:
+    return sum(c.resident_bytes() for c in list(_OPEN_CACHES))
+
+
+telemetry.register_mem_source("dataset_cache", open_cache_bytes_total)
+
+
 class DatasetCache:
     """Handle to a created cache directory; accepted by the learners.
 
@@ -304,8 +319,29 @@ class DatasetCache:
         #: (ydf_tpu/parallel/dist_gbt.py).
         self.feature_shards: int = int(meta.get("feature_shards", 0))
         self._meta = meta
+        _OPEN_CACHES.add(self)  # memory-ledger "dataset_cache" source
         if verify != "off":
             self.verify(full=(verify == "full"))
+
+    def resident_bytes(self) -> int:
+        """On-disk bytes of this cache's data files (bins/labels/
+        weights/shards/raw) — the memmap-backed footprint the
+        "dataset_cache" memory-ledger row reports. Page-cache residency
+        is the kernel's call; this is the upper bound the box must
+        hold. Best-effort (a concurrently rebuilt file returns 0)."""
+        total = 0
+        try:
+            for name in os.listdir(self.path):
+                if name.endswith(".npy"):
+                    try:
+                        total += os.path.getsize(
+                            os.path.join(self.path, name)
+                        )
+                    except OSError:
+                        continue
+        except OSError:
+            return 0
+        return int(total)
 
     def verify(self, full: bool = True) -> None:
         """Checks every data file against the integrity metadata; raises

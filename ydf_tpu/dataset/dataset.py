@@ -90,6 +90,28 @@ def _resolve_typed_path(path: str) -> List[str]:
     return files
 
 
+# Live Datasets for the memory ledger's "bin_matrix" pull source — the
+# tuner/CV bin-matrix memo is the one in-memory structure that can
+# silently hold hundreds of MB per Dataset (utils/telemetry.py:
+# MemoryLedger; sampled only at ledger snapshots).
+import weakref as _weakref  # noqa: E402
+
+_LIVE_DATASETS: "_weakref.WeakSet" = _weakref.WeakSet()
+
+
+def bin_matrix_bytes_total() -> int:
+    return sum(d.bin_cache_bytes() for d in list(_LIVE_DATASETS))
+
+
+def _register_mem_source() -> None:
+    from ydf_tpu.utils import telemetry
+
+    telemetry.register_mem_source("bin_matrix", bin_matrix_bytes_total)
+
+
+_register_mem_source()
+
+
 class Dataset:
     """Columnar dataset: name → 1-D numpy array + dataspec."""
 
@@ -110,6 +132,16 @@ class Dataset:
         # consumer side.
         self._binner_cache: Dict = {}
         self._bin_cache: Dict = {}
+        _LIVE_DATASETS.add(self)  # memory-ledger "bin_matrix" source
+
+    def bin_cache_bytes(self) -> int:
+        """Bytes held by this Dataset's cached bin matrices / encodings
+        (the tuner/CV memo) — its share of the memory ledger's
+        "bin_matrix" row."""
+        total = 0
+        for v in self._bin_cache.values():
+            total += int(getattr(v, "nbytes", 0))
+        return total
 
     # ---- binning memo (see dataset/binning.py) ----------------------- #
 

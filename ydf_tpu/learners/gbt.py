@@ -753,7 +753,12 @@ class GradientBoostedTreesLearner(GenericLearner):
             vs_tr = vs_va = None
         vs_Pv = (vs_Ac + vs_Ap) * binner.num_vs if vs_tr is not None else 0
 
-        with timer.stage("device_loop"), maybe_trace("gbt_train"):
+        # _flight_guard covers EVERY boosting driver (the in-memory
+        # single-scan and early-stop drivers used to run unguarded — an
+        # OOM there died without a flight-recorder post-mortem; the
+        # checkpointed/distributed drivers keep their inner guards).
+        with timer.stage("device_loop"), maybe_trace("gbt_train"), \
+                _flight_guard():
             if self.distributed_workers:
                 # Feature-parallel manager–worker training: the bins
                 # never materialize on this host (workers hold the
@@ -994,6 +999,15 @@ class GradientBoostedTreesLearner(GenericLearner):
                     "learner": "GRADIENT_BOOSTED_TREES",
                 },
             )
+            # End-of-train memory accounting: the MemoryLedger snapshot
+            # (per-subsystem bytes + RSS figures) rides training_logs
+            # beside the per-iteration records — the training half of
+            # bench.py's train_peak_rss_bytes headline field.
+            try:
+                model.training_logs["memory"] = telemetry.ledger(
+                ).snapshot()
+            except Exception:
+                pass
             telemetry.flush()
         return model
 
@@ -1880,6 +1894,7 @@ def _train_gbt(
                     chunk_walls, start, c, num_trees, t0_ns, parts[-1],
                     nv_rows,
                 )
+                _oom_failpoint()
                 start += c
                 vls_seen.append(parts[-1]["vls"])
                 if nv_rows > 0 and _early_stop_hit(
@@ -1910,6 +1925,7 @@ def _train_gbt(
         # and every output is materialized a few lines later anyway —
         # this just keeps the single "chunk" wall honest.
         jax.block_until_ready(tls)
+        _oom_failpoint()
         single_wall = [(0, num_trees, t0_ns, time.perf_counter_ns() - t0_ns)]
         logs = {
             "train_loss": tls,
@@ -2056,6 +2072,7 @@ def _train_gbt(
             start = start_next
             chunks_done += 1
             failpoints.hit("gbt.chunk")
+            _oom_failpoint()
             if (
                 preempt_after_chunks is not None
                 and chunks_done >= preempt_after_chunks
@@ -2216,27 +2233,45 @@ def _train_gbt_distributed(
         return mgr.train()
 
 
+def _oom_failpoint():
+    """The `telemetry.oom` chaos hook: converts an injected fault at
+    the chunk boundary into a REAL MemoryError, so the chaos suite can
+    prove an OOM mid-train leaves a usable flight-recorder post-mortem
+    (reason "oom", MemoryLedger snapshot in the dump header) — the
+    guard used to be exercised only by ordinary exceptions. Free
+    module-constant check when failpoints are unarmed."""
+    try:
+        failpoints.hit("telemetry.oom")
+    except failpoints.FailpointError as e:
+        raise MemoryError(f"injected OOM: {e}") from None
+
+
 @contextlib.contextmanager
 def _flight_guard():
     """Flight-recorder guard around a boosting loop: an exception that
-    escapes it (failpoint crash, worker-fleet loss, a real bug) flushes
-    buffered telemetry and writes the crash black box
+    escapes it (failpoint crash, worker-fleet loss, a real bug, an
+    OOM) flushes buffered telemetry and writes the crash black box
     (`flight_<pid>.jsonl`) before propagating — the run stays
-    diagnosable even though it died mid-chunk. TrainingPreempted is
-    excluded: the preemption path writes its own dump with the signal
-    name. Free no-op when telemetry is off; the dump itself never
-    raises."""
+    diagnosable even though it died mid-chunk. MemoryError dumps with
+    reason "oom" and, like every dump, the header carries the
+    MemoryLedger snapshot — the post-mortem that says WHO held the
+    bytes. TrainingPreempted is excluded: the preemption path writes
+    its own dump with the signal name. Free no-op when telemetry is
+    off; the dump itself never raises."""
     try:
         yield
     except TrainingPreempted:
         raise
     except BaseException as e:
         if telemetry.ENABLED:
+            kind = "oom" if isinstance(e, MemoryError) else "exception"
             telemetry.flight_record(
-                "exception", error=f"{type(e).__name__}: {e}"
+                kind, error=f"{type(e).__name__}: {e}"
             )
             telemetry.flush()
-            telemetry.flight_dump("train_exception")
+            telemetry.flight_dump(
+                "oom" if kind == "oom" else "train_exception"
+            )
         raise
 
 

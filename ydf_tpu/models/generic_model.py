@@ -907,6 +907,12 @@ class GenericModel:
             raise ValueError("num_runs must be >= 1")
         ds = Dataset.from_data(data, dataspec=self.dataspec)
         self.predict(ds)  # warmup + compile
+        # Peak-RSS bracketing AFTER warmup (compile allocations are
+        # excluded, like the timing): a serving path that grows the
+        # process peak during steady-state predicts is a memory
+        # regression, caught by the same floor-guard machinery as
+        # latency (bench.py infer_peak_rss_delta_bytes).
+        rss0 = telemetry.peak_rss_bytes()
         times = []
         # Per-run latencies feed the serving latency histogram class
         # (utils/telemetry.py), which derives the p50/p99 per-example
@@ -931,6 +937,12 @@ class GenericModel:
             # tail the QPS story cares about.
             "p50_ns_per_example": hist.percentile_ns(50) / n,
             "p99_ns_per_example": hist.percentile_ns(99) / n,
+            # How much the process-lifetime RSS peak grew across the
+            # measured runs; 0 = steady-state serving allocated nothing
+            # the process had not already peaked at.
+            "peak_rss_delta_bytes": max(
+                telemetry.peak_rss_bytes() - rss0, 0
+            ),
         }
         if not engines:
             return out

@@ -143,6 +143,21 @@ def kernel_calls() -> int:
     return int(fn())
 
 
+def arena_bytes_peak() -> int:
+    """Peak bytes of the kernels' per-thread partial/accumulator arenas
+    (f32 f64 scratch AND the q8 int32 partials + packed-lane scratch the
+    watermark spills land in) — the "hist_arena" row of the memory
+    ledger (utils/telemetry.py:MemoryLedger). 0 when unavailable."""
+    lib = _LIB.load()
+    if lib is None:
+        return 0
+    import ctypes
+
+    fn = lib.ydf_hist_arena_bytes_peak
+    fn.restype = ctypes.c_int64
+    return int(fn())
+
+
 def reset_kernel_counters() -> None:
     lib = _LIB.load()
     if lib is not None:

@@ -1,0 +1,191 @@
+"""Thread-pool utilization accessors (native/thread_pool.h stats block).
+
+ROADMAP item 3 ("saturate a many-core box") has been flying blind: the
+persistent worker pool shared by every native kernel family exported
+nothing, so "how busy were the lanes?" — the number the native-vs-XLA
+flip decision hangs on — was unmeasurable. The pool now accumulates,
+per kernel family (histogram / binning / routing / serving) and per
+lane, busy-ns, task counts, queue-wait-ns and whole-Run wall-ns; this
+module is the ctypes read side:
+
+  * `pool_stats()` — structured snapshot per family (+ per-lane busy
+    breakdown), including `utilization` = busy / (lanes × run-wall),
+    the bench headline's `pool_utilization` figure;
+  * `pool_metrics()` — the same counters as labeled metric samples
+    (`ydf_pool_busy_ns_total{pool="hist",worker="0"}` …), merged into
+    the `profiling.native_kernel_metrics` collector so every metrics
+    dump / scrape carries them (docs/observability.md "Resource
+    observability");
+  * `reset_pool_stats()` — bench/test bracketing, like the kernel wall
+    counters.
+
+Env boundary: YDF_TPU_POOL_STATS ∈ {1, on, 0, off, unset} is validated
+EAGERLY at import (the YDF_TPU_HIST_IMPL policy); default ON — the cost
+is two steady_clock reads per ~ms pool task, noise next to the task
+bodies, and 0 when disabled. The counters never influence task
+partitioning or reduction order, so models and kernel outputs are
+bit-identical with stats on or off
+(tests/test_resource_observability.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Dict, List, Optional
+
+from ydf_tpu.ops.native_ffi import KERNELS_LIB
+
+#: PoolFamily enum order of native/thread_pool.h — keep in lockstep.
+FAMILIES = ("hist", "bin", "route", "serve")
+
+_ON_VALUES = ("1", "on")
+_OFF_VALUES = ("", "0", "off")
+
+
+def resolve_pool_stats(value: Optional[str]) -> bool:
+    """Validates a YDF_TPU_POOL_STATS value (None reads the env).
+    Unset/empty defaults to ON — utilization is cheap and the many-core
+    rounds need it by default; "0"/"off" disables the per-task clock
+    reads in the kernels (native/thread_pool.h:StatsEnabled)."""
+    raw = os.environ.get("YDF_TPU_POOL_STATS", "1") if value is None else value
+    v = raw.strip().lower()
+    if v in _ON_VALUES:
+        return True
+    if v in _OFF_VALUES and v != "":
+        return False
+    if v == "":
+        return True
+    raise ValueError(
+        f"YDF_TPU_POOL_STATS={raw!r} is not one of "
+        f"{sorted(set(_ON_VALUES + _OFF_VALUES) - {''})} (or unset)"
+    )
+
+
+#: Eager env validation at import (the value itself is consumed by the
+#: native side; this constant is the Python-visible resolution).
+POOL_STATS_ENABLED: bool = resolve_pool_stats(None)
+
+_setup_done = False
+
+
+def _lib():
+    global _setup_done
+    lib = KERNELS_LIB.load()
+    if lib is None:
+        return None
+    if not _setup_done:
+        i64, i32 = ctypes.c_int64, ctypes.c_int32
+        lib.ydf_pool_busy_ns_total.restype = i64
+        lib.ydf_pool_busy_ns_total.argtypes = [i32, i32]
+        lib.ydf_pool_tasks_total.restype = i64
+        lib.ydf_pool_tasks_total.argtypes = [i32, i32]
+        lib.ydf_pool_queue_wait_ns_total.restype = i64
+        lib.ydf_pool_queue_wait_ns_total.argtypes = [i32]
+        lib.ydf_pool_run_wall_ns_total.restype = i64
+        lib.ydf_pool_run_wall_ns_total.argtypes = [i32]
+        lib.ydf_pool_runs_total.restype = i64
+        lib.ydf_pool_runs_total.argtypes = [i32]
+        lib.ydf_pool_size.restype = i32
+        lib.ydf_pool_max_lanes.restype = i32
+        lib.ydf_pool_stats_enabled.restype = i32
+        _setup_done = True
+    return lib
+
+
+def available() -> bool:
+    return _lib() is not None
+
+
+def pool_size() -> int:
+    """Resolved lane count of the kernel pool (callers + workers) —
+    the utilization denominator; 0 when the library is unavailable."""
+    lib = _lib()
+    return int(lib.ydf_pool_size()) if lib is not None else 0
+
+
+def reset_pool_stats() -> None:
+    """Zeroes the shared stats block (bench/test bracketing)."""
+    lib = _lib()
+    if lib is not None:
+        lib.ydf_pool_stats_reset()
+
+
+def pool_stats() -> Dict[str, object]:
+    """Structured snapshot: {"size", "enabled", "families": {name:
+    {"busy_ns", "tasks", "queue_wait_ns", "run_wall_ns", "runs",
+    "utilization", "per_lane_busy_ns"}}}. Empty dict when the native
+    library is unavailable. `utilization` = busy / (size × run_wall) —
+    1.0 means every lane was inside a task body for the family's whole
+    pooled wall; low values mean lanes idled (queue starvation, serial
+    reduction tails, or a task count below the lane count)."""
+    lib = _lib()
+    if lib is None:
+        return {}
+    size = int(lib.ydf_pool_size())
+    lanes = min(max(size, 1), int(lib.ydf_pool_max_lanes()))
+    fams: Dict[str, Dict[str, object]] = {}
+    for fi, name in enumerate(FAMILIES):
+        per_lane: List[int] = [
+            int(lib.ydf_pool_busy_ns_total(fi, l)) for l in range(lanes)
+        ]
+        busy = sum(per_lane)
+        tasks = sum(
+            int(lib.ydf_pool_tasks_total(fi, l)) for l in range(lanes)
+        )
+        wall = int(lib.ydf_pool_run_wall_ns_total(fi))
+        fams[name] = {
+            "busy_ns": busy,
+            "tasks": tasks,
+            "queue_wait_ns": int(lib.ydf_pool_queue_wait_ns_total(fi)),
+            "run_wall_ns": wall,
+            "runs": int(lib.ydf_pool_runs_total(fi)),
+            "utilization": (
+                round(busy / (size * wall), 4) if wall > 0 and size else 0.0
+            ),
+            "per_lane_busy_ns": per_lane,
+        }
+    return {
+        "size": size,
+        "enabled": bool(lib.ydf_pool_stats_enabled()),
+        "families": fams,
+    }
+
+
+def pool_metrics() -> Dict[str, float]:
+    """The stats block as labeled metric samples for the telemetry
+    collector (profiling.native_kernel_metrics): per-(family, lane)
+    `ydf_pool_busy_ns_total{pool=...,worker=...}` and
+    `ydf_pool_tasks_total{...}`, per-family
+    `ydf_pool_queue_wait_ns_total{pool=...}` /
+    `ydf_pool_run_wall_ns_total{pool=...}` / `ydf_pool_runs_total{...}`,
+    plus the unlabeled `ydf_pool_size` gauge. Lanes that never ran a
+    task are omitted so a 128-core box does not dump 128 zero series
+    per family."""
+    lib = _lib()
+    if lib is None:
+        return {}
+    size = int(lib.ydf_pool_size())
+    lanes = min(max(size, 1), int(lib.ydf_pool_max_lanes()))
+    out: Dict[str, float] = {"ydf_pool_size": float(size)}
+    for fi, name in enumerate(FAMILIES):
+        runs = int(lib.ydf_pool_runs_total(fi))
+        if runs == 0:
+            continue
+        for l in range(lanes):
+            busy = int(lib.ydf_pool_busy_ns_total(fi, l))
+            tasks = int(lib.ydf_pool_tasks_total(fi, l))
+            if busy == 0 and tasks == 0:
+                continue
+            lab = f'{{pool="{name}",worker="{l}"}}'
+            out[f"ydf_pool_busy_ns_total{lab}"] = float(busy)
+            out[f"ydf_pool_tasks_total{lab}"] = float(tasks)
+        lab = f'{{pool="{name}"}}'
+        out[f"ydf_pool_queue_wait_ns_total{lab}"] = float(
+            lib.ydf_pool_queue_wait_ns_total(fi)
+        )
+        out[f"ydf_pool_run_wall_ns_total{lab}"] = float(
+            lib.ydf_pool_run_wall_ns_total(fi)
+        )
+        out[f"ydf_pool_runs_total{lab}"] = float(runs)
+    return out

@@ -266,6 +266,13 @@ class _DistStats:
         # by address; the events themselves are merged into the
         # manager's trace buffer).
         self.drained_events: Dict[str, int] = {}
+        # Resource accounting (this round): latest shard/state bytes
+        # each worker reported at shard load (fleet total = the
+        # dist_shard_bytes headline field), and per-worker RSS from the
+        # get_telemetry drain.
+        self.shard_bytes: Dict[str, int] = {}
+        self.worker_rss_bytes: Dict[str, int] = {}
+        self.config_mismatches = 0
 
     def observe_rpc(self, verb: str, dur_ns: int) -> None:
         self.rpc_ns.setdefault(verb, LatencyHistogram()).observe_ns(dur_ns)
@@ -333,6 +340,13 @@ class _DistStats:
                 v: int(h.count) for v, h in sorted(self.rpc_ns.items())
             },
         }
+        out["shard_bytes"] = int(sum(self.shard_bytes.values()))
+        if self.shard_bytes:
+            out["worker_shard_bytes"] = dict(self.shard_bytes)
+        if self.worker_rss_bytes:
+            out["worker_rss_bytes"] = dict(self.worker_rss_bytes)
+        if self.config_mismatches:
+            out["config_mismatches"] = int(self.config_mismatches)
         if self.drained_events:
             out["telemetry_drained_events"] = dict(self.drained_events)
         return out
@@ -485,6 +499,7 @@ class DistGBTManager:
                 self.pool.mark_ok(widx)
                 for sid in sids:
                     self.owner[sid] = widx
+                self._note_shard_load(widx, resp)
                 return widx
             if resp.get("corrupt") and not rebuilt:
                 # Worker-side crc caught a corrupt slice: re-slice it
@@ -561,6 +576,42 @@ class DistGBTManager:
         new_w = self._pick_replacement(widx + 1)
         self._load_shards(new_w, sids, with_state=True)
 
+    def _note_shard_load(self, widx: int, resp: Dict[str, Any]) -> None:
+        """Resource + config bookkeeping on a successful shard load:
+        records the worker's reported resident shard/state bytes (the
+        dist_shard_bytes accounting) and compares the worker's resolved
+        bit-identity-relevant env knobs against the manager's — drift
+        (e.g. a worker still running YDF_TPU_HIST_QUANT=f32 under an
+        int8 manager) is logged HERE, at load_data time, instead of
+        surfacing as a confusing report later."""
+        addr = self.pool.addr_str(widx)
+        sb = resp.get("shard_bytes")
+        if isinstance(sb, int):
+            self.stats.shard_bytes[addr] = sb
+            if telemetry.ENABLED:
+                telemetry.mem_set("dist_shard_fleet",
+                                  sum(self.stats.shard_bytes.values()))
+        wcfg = resp.get("config")
+        if not isinstance(wcfg, dict) or not wcfg:
+            return
+        try:
+            from ydf_tpu.config import DIST_CONFIG_KEYS, resolved_env_config
+
+            mine = resolved_env_config()
+        except Exception:
+            return
+        for key in DIST_CONFIG_KEYS:
+            if key in wcfg and wcfg[key] != mine.get(key):
+                self.stats.config_mismatches += 1
+                log.info(
+                    f"dist: config mismatch with worker {addr}: "
+                    f"{key}={wcfg[key]!r} (manager: {mine.get(key)!r})"
+                )
+                if telemetry.ENABLED:
+                    telemetry.counter(
+                        "ydf_dist_config_mismatch_total", key=key
+                    ).inc()
+
     # ---- cross-process telemetry drain / trace merge ----------------- #
 
     def _drain_worker_telemetry(
@@ -627,6 +678,11 @@ class DistGBTManager:
                 continue
             if not isinstance(resp, dict) or not resp.get("ok"):
                 continue
+            if isinstance(resp.get("rss_bytes"), int):
+                # Per-worker RSS rides the drain — the distributed half
+                # of the memory ledger (training_logs["distributed"]
+                # worker_rss_bytes).
+                self.stats.worker_rss_bytes[addr] = resp["rss_bytes"]
             if offset_ns is None:
                 # No clock-bearing ping answered (protocol anomaly):
                 # merge uncorrected rather than apply a garbage offset.

@@ -211,7 +211,16 @@ def _load_cache_shard(req: Dict[str, Any], worker_id: str) -> Dict[str, Any]:
                 st.hist_stats = np.asarray(state["hist_stats"])
                 qs = state.get("qscale")
                 st.qscale = None if qs is None else np.asarray(qs)
-        return {"ok": True, "n": n, "shards": sorted(st.shards)}
+        # shard_bytes: the resident footprint this load left on the
+        # worker — the manager sums it into training_logs["distributed"]
+        # (and bench.py's dist_shard_bytes headline field). config: the
+        # bit-identity-relevant resolved knobs, so the manager can log
+        # drift at load time instead of chasing it post-hoc.
+        return {
+            "ok": True, "n": n, "shards": sorted(st.shards),
+            "shard_bytes": _state_bytes(st),
+            "config": _dist_config(),
+        }
 
 
 def _sync_to(st: _DistState, req: Dict[str, Any]) -> Optional[Dict[str, Any]]:
@@ -355,10 +364,54 @@ def handle(verb: str, req: Dict[str, Any],
     return _HANDLERS[verb](req, worker_id)
 
 
+def _dist_config() -> Dict[str, Any]:
+    """This worker's resolved values of the knobs that must agree with
+    the manager (config.DIST_CONFIG_KEYS); best-effort."""
+    try:
+        from ydf_tpu.config import DIST_CONFIG_KEYS, resolved_env_config
+
+        cfg = resolved_env_config()
+        return {k: cfg.get(k) for k in DIST_CONFIG_KEYS}
+    except Exception:
+        return {}
+
+
+def _state_bytes(st: "_DistState") -> int:
+    """Resident bytes of one run's worker state: shard bin slices plus
+    the routing/stat arrays — the "dist_shard" memory-ledger row."""
+    total = st.slot.nbytes + st.hist_slot.nbytes + st.leaf_id.nbytes
+    if st.hist_stats is not None:
+        total += st.hist_stats.nbytes
+    for sl in st.shards.values():
+        total += sl.bins.nbytes
+    return int(total)
+
+
+def shard_bytes_total(worker_id: Optional[str] = None) -> int:
+    """Bytes resident in this process's distributed worker state —
+    all worker instances, or one `worker_id` (in-process fleets share
+    the process, so the ledger row is the process total)."""
+    with _STATE_LOCK:
+        items = [
+            st for (wid, _), st in _STATE.items()
+            if worker_id is None or wid == worker_id
+        ]
+    return sum(_state_bytes(st) for st in items)
+
+
+# Pull-model memory accounting: sampled only at ledger snapshots
+# (/statusz, metrics dumps, get_telemetry) — zero cost on the verb hot
+# path (docs/observability.md "Resource observability").
+from ydf_tpu.utils import telemetry as _telemetry  # noqa: E402
+
+_telemetry.register_mem_source("dist_shard", shard_bytes_total)
+
+
 def status(worker_id: str = "local") -> Dict[str, Any]:
     """This worker instance's distributed state for /statusz: one entry
     per resident run key with the (tree, layer) position stamp, owned
-    shard ids and row count (docs/observability.md "Endpoints")."""
+    shard ids, row count and resident shard/state bytes
+    (docs/observability.md "Endpoints")."""
     out: Dict[str, Any] = {}
     with _STATE_LOCK:
         items = [
@@ -370,6 +423,7 @@ def status(worker_id: str = "local") -> Dict[str, Any]:
             "pos": list(st.pos),
             "shards": sorted(st.shards),
             "rows": st.n,
+            "shard_bytes": _state_bytes(st),
         }
     return out
 

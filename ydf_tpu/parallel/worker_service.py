@@ -167,24 +167,33 @@ def _recv_payload(sock: socket.socket) -> bytes:
                 "or its YDF_TPU_WORKER_MAX_FRAME is far smaller"
             )
         buf = bytearray()
-        for _ in range(nchunks):
-            (m,) = struct.unpack("<Q", _recv_exact(sock, 8))
-            if m > cap:
+        # Assembly-buffer accounting for the memory ledger's
+        # "dist_frames" row: the declared total is reserved up front
+        # (the bound the cap check above enforces) and released when
+        # assembly ends, so a snapshot taken mid-receive shows the
+        # bytes a large histogram frame is pinning.
+        _note_frame_bytes(total)
+        try:
+            for _ in range(nchunks):
+                (m,) = struct.unpack("<Q", _recv_exact(sock, 8))
+                if m > cap:
+                    raise ConnectionError(
+                        f"frame chunk of {m} bytes exceeds the {cap}-byte "
+                        "cap; raise YDF_TPU_WORKER_MAX_FRAME on the "
+                        "receiving side to at least the sender's value"
+                    )
+                if len(buf) + m > total:
+                    raise ConnectionError(
+                        "chunked frame overruns its declared size"
+                    )
+                buf += _recv_exact(sock, m)
+            if len(buf) != total:
                 raise ConnectionError(
-                    f"frame chunk of {m} bytes exceeds the {cap}-byte "
-                    "cap; raise YDF_TPU_WORKER_MAX_FRAME on the "
-                    "receiving side to at least the sender's value"
+                    f"chunked frame short: {len(buf)} of {total} bytes"
                 )
-            if len(buf) + m > total:
-                raise ConnectionError(
-                    "chunked frame overruns its declared size"
-                )
-            buf += _recv_exact(sock, m)
-        if len(buf) != total:
-            raise ConnectionError(
-                f"chunked frame short: {len(buf)} of {total} bytes"
-            )
-        return bytes(buf)
+            return bytes(buf)
+        finally:
+            _note_frame_bytes(-total)
     if n > cap:
         # Checked BEFORE allocation: a bogus length prefix (or a peer
         # speaking another protocol) must not buffer gigabytes pre-auth.
@@ -195,6 +204,26 @@ def _recv_payload(sock: socket.socket) -> bytes:
             "above their own cap automatically)"
         )
     return _recv_exact(sock, n)
+
+
+# Bytes currently pinned by in-flight chunked-frame assemblies — the
+# "dist_frames" memory-ledger row (pull source; the per-frame update is
+# two int ops per multi-MB frame, not per chunk).
+_FRAME_BYTES_LOCK = threading.Lock()
+_FRAME_BYTES = 0
+
+
+def _note_frame_bytes(delta: int) -> None:
+    global _FRAME_BYTES
+    with _FRAME_BYTES_LOCK:
+        _FRAME_BYTES = max(_FRAME_BYTES + int(delta), 0)
+
+
+def frame_assembly_bytes() -> int:
+    return _FRAME_BYTES
+
+
+telemetry.register_mem_source("dist_frames", frame_assembly_bytes)
 
 
 def _recv_msg(sock: socket.socket, secret: Optional[bytes] = None) -> Any:
@@ -285,6 +314,13 @@ def _handle_request(
             "clock_ns": time.perf_counter_ns(),
             "pid": os.getpid(),
             "worker_id": wid,
+            # Per-worker resource accounting rides the drain (pull
+            # model, once per train — not gated on ENABLED: the
+            # manager's memory ledger wants worker RSS even when the
+            # worker process runs with telemetry off).
+            "rss_bytes": telemetry.rss_bytes(),
+            "peak_rss_bytes": telemetry.peak_rss_bytes(),
+            "memory": telemetry.ledger().snapshot(),
         }
     if verb == "load_data":
         with _DATA_CACHE_LOCK:
@@ -362,12 +398,17 @@ def start_worker(
         telemetry_http.maybe_start_from_env()
 
     def _worker_status(wid=ctx["worker_id"]):
+        from ydf_tpu.config import resolved_env_config
         from ydf_tpu.parallel import dist_worker
 
         return {
             "worker_id": wid,
             "listening": not stop_evt.is_set(),
             "dist": dist_worker.status(wid),
+            # Resolved env knobs: the manager compares its own against
+            # each worker's at shard-load time (config drift used to be
+            # invisible until it surfaced as a perf/bit report).
+            "config": resolved_env_config(),
         }
 
     telemetry_http.register_status(

@@ -99,6 +99,30 @@ def _require_registered() -> None:
 # Bank: flatten once at model load, cache on the model
 # ---------------------------------------------------------------------- #
 
+# Running total of live ServeBank table bytes — the "serve_bank" row of
+# the memory ledger (pull source, sampled at snapshot only) and the
+# bench headline's serve_bank_bytes. Plain int under a lock: bank
+# create/close is model-load-rate, never the predict hot path.
+_BANK_BYTES_LOCK = threading.Lock()
+_BANK_BYTES_TOTAL = 0
+
+
+def _note_bank_bytes(delta: int) -> None:
+    global _BANK_BYTES_TOTAL
+    with _BANK_BYTES_LOCK:
+        _BANK_BYTES_TOTAL = max(_BANK_BYTES_TOTAL + int(delta), 0)
+
+
+def bank_bytes_total() -> int:
+    """Bytes held by live serving data banks in this process (host-side
+    tables; the native handle mirrors them once more)."""
+    return _BANK_BYTES_TOTAL
+
+
+from ydf_tpu.utils import telemetry as _telemetry  # noqa: E402
+
+_telemetry.register_mem_source("serve_bank", bank_bytes_total)
+
 
 def _ptr(a: np.ndarray):
     return a.ctypes.data_as(ctypes.c_void_p)
@@ -158,6 +182,22 @@ class ServeBank:
         self.proj_feature = np.asarray(bank.proj_feature, np.uint32)
         self.proj_weight = np.asarray(bank.proj_weight, np.float32)
 
+        # Host-side table bytes of this bank; the native handle copies
+        # the same tables once more, so the process holds ~2x this while
+        # the handle lives. Tracked in the module total the "serve_bank"
+        # memory-ledger row reports (and bench.py's serve_bank_bytes).
+        self.nbytes = int(
+            self.tree_offset.nbytes + self.feature.nbytes
+            + self.aux.nbytes + self.cat_feature.nbytes
+            + self.thresh.nbytes + self.thresh_bin.nbytes
+            + self.left.nbytes + self.right.nbytes + self.na_left.nbytes
+            + self.leaf_values.nbytes + self.masks.nbytes
+            + self.proj_start.nbytes + self.proj_feature.nbytes
+            + self.proj_weight.nbytes
+        )
+        _note_bank_bytes(self.nbytes)
+        self._counted = True
+
         self._h = None
         lib = _lib()
         if lib is not None:
@@ -182,6 +222,9 @@ class ServeBank:
             if lib is not None:
                 lib.ydf_serve_bank_free(self._h)
             self._h = None
+        if getattr(self, "_counted", False):
+            _note_bank_bytes(-self.nbytes)
+            self._counted = False
 
     def __del__(self):  # pragma: no cover - interpreter shutdown order
         try:

@@ -155,6 +155,28 @@ _LAST_ENGINE = {"engine": None, "forced": False}
 _BATCHERS: "weakref.WeakSet[CoalescingBatcher]" = weakref.WeakSet()
 
 
+def batcher_queue_bytes() -> int:
+    """Bytes of rows currently queued in live CoalescingBatchers — the
+    "serve_batcher" row of the memory ledger (pull source: sampled at
+    snapshot time only, never on the predict_one hot path). Scalars
+    count their numpy itemsize, plain Python scalars a nominal 8."""
+    total = 0
+    for b in list(_BATCHERS):
+        for slot in list(b._queue):
+            for x in slot.row:
+                total += int(getattr(x, "nbytes", 8))
+    return total
+
+
+def _register_mem_source() -> None:
+    from ydf_tpu.utils import telemetry
+
+    telemetry.register_mem_source("serve_batcher", batcher_queue_bytes)
+
+
+_register_mem_source()
+
+
 def serving_status() -> dict:
     """The serving process's /statusz section: selected engine and per-
     batcher queue depth/bounds. Row/flush counters (the QPS source)

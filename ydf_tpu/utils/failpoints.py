@@ -98,6 +98,11 @@ KNOWN_SITES = frozenset(
         # learners/gbt.py — checkpointed boosting loop, after each
         # chunk's snapshot is durably saved.
         "gbt.chunk",
+        # learners/gbt.py — OOM chaos hook at the boosting drivers'
+        # chunk boundaries: the injected fault is converted to a REAL
+        # MemoryError so the flight-recorder's OOM path (reason "oom",
+        # MemoryLedger snapshot in the dump header) is provable.
+        "telemetry.oom",
         # parallel/dist_gbt.py — manager-side distributed-GBT RPCs:
         # shard load/re-ship, per-layer histogram gather, and the
         # split-broadcast/routing exchange. drop_conn surfaces as a

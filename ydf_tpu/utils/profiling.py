@@ -167,13 +167,33 @@ def reset_native_route_kernel_counters() -> None:
     routing_native.reset_kernel_counters()
 
 
+def native_pool_stats() -> Dict[str, object]:
+    """Structured thread-pool utilization snapshot (per kernel family:
+    busy-ns, tasks, queue-wait-ns, run-wall-ns and the derived
+    busy / (lanes × wall) utilization) — the read side of
+    native/thread_pool.h's stats block, via ops/pool_stats.py. Empty
+    when the native library is unavailable."""
+    from ydf_tpu.ops import pool_stats
+
+    return pool_stats.pool_stats()
+
+
+def reset_native_pool_stats() -> None:
+    from ydf_tpu.ops import pool_stats
+
+    pool_stats.reset_pool_stats()
+
+
 def native_kernel_metrics() -> Dict[str, float]:
     """The native kernels' cumulative in-kernel wall counters as
     registered telemetry gauges — the accessor functions above, exposed
     through the metrics registry (utils/telemetry.py registers this as
     a default collector, so every metrics dump carries them instead of
     callers knowing five one-off functions). Unavailable kernels report
-    0.0, matching the accessors."""
+    0.0, matching the accessors. The thread-pool utilization family
+    (`ydf_pool_busy_ns_total{pool,worker}` etc., ops/pool_stats.py)
+    rides the same collector with label-suffixed sample keys, which
+    telemetry's exposition splits back into name + labels."""
     from ydf_tpu.ops import routing_native
 
     out = {
@@ -195,6 +215,12 @@ def native_kernel_metrics() -> Dict[str, float]:
         )
     except Exception:
         out["ydf_native_serve_kernel_seconds"] = 0.0
+    try:
+        from ydf_tpu.ops import pool_stats
+
+        out.update(pool_stats.pool_metrics())
+    except Exception:
+        pass  # pool metrics degrade silently like the kernel counters
     return out
 
 

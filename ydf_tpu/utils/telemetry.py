@@ -96,6 +96,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 __all__ = [
     "ENABLED",
     "EXPORT_DIR",
+    "MEM_SAMPLE",
     "span",
     "counter",
     "gauge",
@@ -119,6 +120,14 @@ __all__ = [
     "flight_record",
     "flight_events",
     "flight_dump",
+    "MemoryLedger",
+    "ledger",
+    "mem_set",
+    "mem_add",
+    "register_mem_source",
+    "rss_bytes",
+    "peak_rss_bytes",
+    "COLLECTOR_METRICS",
 ]
 
 
@@ -153,6 +162,23 @@ def _parse_env(
                 f"{type(e).__name__}: {e}"
             ) from e
     return (f in _ON_VALUES) or (d is not None), d
+
+
+def _parse_mem_sample(raw: Optional[str]) -> bool:
+    """Validates YDF_TPU_MEM_SAMPLE eagerly: whether span exits sample
+    the process RSS into the memory ledger's resettable high-watermark
+    (sampled_peak_rss_bytes). Default ON — the sample is throttled to
+    one /proc read per 10 ms, and it only ever runs when telemetry
+    itself is enabled (zero cost on the disabled path)."""
+    v = ("1" if raw is None else raw).strip().lower()
+    if v in _ON_VALUES or v == "":
+        return True
+    if v in _OFF_VALUES:
+        return False
+    raise ValueError(
+        f"YDF_TPU_MEM_SAMPLE={raw!r} is not one of "
+        f"{sorted(set(_ON_VALUES + _OFF_VALUES) - {''})} (or unset)"
+    )
 
 
 # --------------------------------------------------------------------- #
@@ -315,6 +341,196 @@ def _fmt_labels(items: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
 
 
 # --------------------------------------------------------------------- #
+# Memory ledger
+# --------------------------------------------------------------------- #
+
+#: Metric families produced by registered COLLECTORS (pull model) —
+#: they have no literal counter/gauge registry call site for
+#: scripts/check_metric_names.py to scan, so this dict is their
+#: authoritative registry (name -> kind), the collector-side analogue
+#: of failpoints.KNOWN_SITES. The lint validates naming AND doc
+#: presence for every entry; a collector that starts producing a name
+#: missing here fails tests/test_resource_observability.py.
+COLLECTOR_METRICS: Dict[str, str] = {
+    # native kernel wall counters (utils/profiling.py)
+    "ydf_native_hist_kernel_seconds": "gauge",
+    "ydf_native_route_kernel_seconds": "gauge",
+    "ydf_native_update_kernel_seconds": "gauge",
+    "ydf_native_fused_kernel_seconds": "gauge",
+    "ydf_native_serve_kernel_seconds": "gauge",
+    # thread-pool utilization (native/thread_pool.h via ops/pool_stats.py)
+    "ydf_pool_busy_ns_total": "counter",
+    "ydf_pool_tasks_total": "counter",
+    "ydf_pool_queue_wait_ns_total": "counter",
+    "ydf_pool_run_wall_ns_total": "counter",
+    "ydf_pool_runs_total": "counter",
+    "ydf_pool_size": "gauge",
+    # memory ledger (MemoryLedger below)
+    "ydf_mem_bytes": "gauge",
+    "ydf_mem_rss_bytes": "gauge",
+    "ydf_mem_peak_rss_bytes": "gauge",
+    "ydf_mem_sampled_peak_rss_bytes": "gauge",
+}
+
+
+def rss_bytes() -> int:
+    """Current resident set size of this process in bytes
+    (/proc/self/statm; 0 where unavailable — the accounting degrades,
+    never raises)."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * (os.sysconf("SC_PAGE_SIZE"))
+    except Exception:
+        return 0
+
+
+def peak_rss_bytes() -> int:
+    """Process-LIFETIME peak RSS in bytes (getrusage ru_maxrss; kB on
+    Linux). Monotone for the process — per-run peaks come from the
+    ledger's resettable sampled watermark instead."""
+    try:
+        import resource
+
+        return int(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        ) * 1024
+    except Exception:
+        return 0
+
+
+class MemoryLedger:
+    """Per-subsystem byte accounting — who holds how many bytes, the
+    number next to "how busy were the workers" that every many-core and
+    TPU round is judged on (docs/observability.md "Resource
+    observability").
+
+    Two feeds:
+
+      * **pushed gauges** — `mem_set(subsystem, n)` / `mem_add(...)`
+        from instrumented sites, gated on `telemetry.ENABLED` (the
+        zero-overhead contract);
+      * **pull sources** — `register_mem_source(subsystem, fn)` where
+        `fn()` returns the subsystem's CURRENT resident bytes, sampled
+        only at snapshot time (dataset-cache memmaps, serving
+        data-banks, batcher queues, distributed shards, the native
+        histogram arena). Sources are process-level facts and live in a
+        module registry that survives `active()` — a run-scoped swap
+        must not forget that a 2 GB cache is still open.
+
+    `snapshot()` additionally reports current RSS, lifetime peak RSS,
+    and the RESETTABLE `sampled_peak_rss_bytes` high-watermark fed by
+    span exits (throttled; YDF_TPU_MEM_SAMPLE). Surfaced on /statusz
+    (`memory` section), on `training_logs["memory"]`, in every metrics
+    dump (`ydf_mem_*`), in the `get_telemetry` worker drain, and as the
+    bench headline memory fields."""
+
+    __slots__ = ("_lock", "_gauges", "_sampled_peak_rss",
+                 "_last_sample_ns")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._gauges: Dict[str, int] = {}
+        self._sampled_peak_rss = 0
+        self._last_sample_ns = 0
+
+    def set_bytes(self, subsystem: str, n) -> None:
+        self._gauges[subsystem] = int(n)
+
+    def add_bytes(self, subsystem: str, delta) -> None:
+        with self._lock:
+            self._gauges[subsystem] = max(
+                self._gauges.get(subsystem, 0) + int(delta), 0
+            )
+
+    def get_bytes(self, subsystem: str) -> int:
+        v = self._gauges.get(subsystem)
+        if v is not None:
+            return v
+        fn = _MEM_SOURCES.get(subsystem)
+        if fn is None:
+            return 0
+        try:
+            return int(fn())
+        except Exception:
+            return 0
+
+    def note_rss(self, now_ns: int = 0) -> None:
+        """Samples current RSS into the resettable high-watermark; at
+        most one /proc read per 10 ms (span exits call this)."""
+        if now_ns and now_ns - self._last_sample_ns < 10_000_000:
+            return
+        self._last_sample_ns = now_ns or time.perf_counter_ns()
+        r = rss_bytes()
+        if r > self._sampled_peak_rss:
+            self._sampled_peak_rss = r
+
+    def snapshot(self) -> Dict[str, object]:
+        # A snapshot is itself a sample point: the watermark is "max
+        # RSS over every observation", and observing includes scraping.
+        self.note_rss()
+        subs = dict(self._gauges)
+        for name, fn in list(_MEM_SOURCES.items()):
+            try:
+                subs[name] = int(fn())
+            except Exception:
+                continue  # a broken source must never break the page
+        return {
+            "subsystems": subs,
+            "rss_bytes": rss_bytes(),
+            "peak_rss_bytes": peak_rss_bytes(),
+            "sampled_peak_rss_bytes": int(self._sampled_peak_rss),
+        }
+
+
+#: Pull sources OUTSIDE the swappable state: what is resident in this
+#: process does not change because a test armed a fresh registry.
+_MEM_SOURCES: Dict[str, Callable[[], int]] = {}
+
+
+def register_mem_source(subsystem: str, fn: Callable[[], int]) -> None:
+    """Registers (or replaces) a pull source: `fn()` -> current bytes
+    held by `subsystem`, sampled at snapshot()/metrics dumps only.
+    Registration is cheap and unconditional (no ENABLED gate — the
+    cost model is pull, not push)."""
+    _MEM_SOURCES[subsystem] = fn
+
+
+def ledger() -> MemoryLedger:
+    return _STATE["ledger"]
+
+
+def mem_set(subsystem: str, n) -> None:
+    """Pushes a subsystem byte gauge; free no-op when telemetry is
+    off (module-constant bool check, the failpoints contract)."""
+    if not ENABLED:
+        return
+    _STATE["ledger"].set_bytes(subsystem, n)
+
+
+def mem_add(subsystem: str, delta) -> None:
+    if not ENABLED:
+        return
+    _STATE["ledger"].add_bytes(subsystem, delta)
+
+
+def _ledger_metrics() -> Dict[str, float]:
+    """The ledger as labeled collector samples (`ydf_mem_bytes{
+    subsystem="…"}` + the RSS gauges) — registered as a default
+    collector next to the native-kernel counters."""
+    snap = _STATE["ledger"].snapshot()
+    out: Dict[str, float] = {
+        "ydf_mem_rss_bytes": float(snap["rss_bytes"]),
+        "ydf_mem_peak_rss_bytes": float(snap["peak_rss_bytes"]),
+        "ydf_mem_sampled_peak_rss_bytes": float(
+            snap["sampled_peak_rss_bytes"]
+        ),
+    }
+    for sub, n in snap["subsystems"].items():
+        out[f'ydf_mem_bytes{{subsystem="{sub}"}}'] = float(n)
+    return out
+
+
+# --------------------------------------------------------------------- #
 # Spans
 # --------------------------------------------------------------------- #
 
@@ -412,6 +628,11 @@ class _Span:
             self.name, self._t0, t1 - self._t0, self.args,
             sid=self.sid, parent=self.parent,
         )
+        if MEM_SAMPLE:
+            # Span boundaries are the ledger's RSS sample points (the
+            # resettable per-run peak estimate); note_rss throttles to
+            # one /proc read per 10 ms so span-dense paths pay ~nothing.
+            _STATE["ledger"].note_rss(t1)
         return False
 
 
@@ -452,6 +673,7 @@ _STATE: Dict[str, object] = {
     "events": [],
     "collectors": [],
     "flight": collections.deque(maxlen=_FLIGHT_CAP),
+    "ledger": MemoryLedger(),
 }
 _FLUSH_LOCK = threading.Lock()
 
@@ -459,6 +681,7 @@ ENABLED, EXPORT_DIR = _parse_env(
     os.environ.get("YDF_TPU_TELEMETRY"),
     os.environ.get("YDF_TPU_TELEMETRY_DIR"),
 )
+MEM_SAMPLE = _parse_mem_sample(os.environ.get("YDF_TPU_MEM_SAMPLE"))
 
 
 def span(name: str, args: Optional[dict] = None):
@@ -494,6 +717,11 @@ def emit_span(
     if not ENABLED:
         return
     _record_event(name, start_ns, dur_ns, args, tid=tid)
+    if MEM_SAMPLE:
+        # Attributed spans are sample points too: the fused single-scan
+        # driver emits ONLY these, and its train must still feed the
+        # sampled RSS watermark (throttled like the span-exit hook).
+        _STATE["ledger"].note_rss(time.perf_counter_ns())
 
 
 def register_collector(fn: Callable[[], Dict[str, float]]) -> None:
@@ -516,11 +744,22 @@ def _collected() -> Dict[str, float]:
 
 
 def _default_collectors() -> None:
-    """Registers the built-in native-kernel collectors once per state.
-    Lazy import: profiling pulls in the ops modules."""
+    """Registers the built-in collectors once per state: the native
+    kernel/pool counters (lazy import: profiling pulls in the ops
+    modules) and the memory ledger — plus the native histogram arena's
+    peak-bytes pull source (the one ledger row that lives in C++)."""
+    register_collector(_ledger_metrics)
     from ydf_tpu.utils import profiling
 
     register_collector(profiling.native_kernel_metrics)
+    try:
+        from ydf_tpu.ops import histogram_native
+
+        register_mem_source(
+            "hist_arena", histogram_native.arena_bytes_peak
+        )
+    except Exception:
+        pass
 
 
 def pow2_bucket(n: int) -> int:
@@ -685,8 +924,18 @@ def metrics_text() -> str:
     for (name, labels), g in sorted(reg._gauges.items()):
         lines.append(f"# TYPE {name} gauge")
         lines.append(f"{name}{_fmt_labels(labels)} {g.value:g}")
+    # Collector samples may carry inline labels (`name{k="v"}` keys —
+    # the pool/ledger families): the TYPE line names the BASE metric,
+    # once, with the kind from the COLLECTOR_METRICS registry.
+    seen_bases = set()
     for mname, value in sorted(_collected().items()):
-        lines.append(f"# TYPE {mname} gauge")
+        base = mname.split("{", 1)[0]
+        if base not in seen_bases:
+            seen_bases.add(base)
+            kind = COLLECTOR_METRICS.get(
+                base, "counter" if base.endswith("_total") else "gauge"
+            )
+            lines.append(f"# TYPE {base} {kind}")
         lines.append(f"{mname} {value:g}")
     for (name, labels), h in sorted(reg._hists.items()):
         _hist_exposition(name, labels, h, lines)
@@ -793,6 +1042,13 @@ def flight_dump(reason: str, directory: Optional[str] = None) -> Optional[str]:
         os.makedirs(d, exist_ok=True)
         path = os.path.join(d, f"flight_{os.getpid()}.jsonl")
         entries = flight_events()
+        # The header carries the MemoryLedger snapshot: a post-mortem
+        # for an OOM (or any crash) must say WHO held the bytes. Built
+        # defensively — a broken source must not cost the dump.
+        try:
+            memory = _STATE["ledger"].snapshot()
+        except Exception:
+            memory = None
         with open(path, "w") as f:
             f.write(json.dumps({
                 "kind": "flight_dump",
@@ -800,6 +1056,7 @@ def flight_dump(reason: str, directory: Optional[str] = None) -> Optional[str]:
                 "pid": os.getpid(),
                 "trace": TRACE_ID,
                 "entries": len(entries),
+                "memory": memory,
             }) + "\n")
             for e in entries:
                 f.write(json.dumps(e, default=str) + "\n")
@@ -815,25 +1072,30 @@ def flight_dump(reason: str, directory: Optional[str] = None) -> Optional[str]:
 
 
 def reset() -> None:
-    """Clears the CURRENT registry, event buffer and flight ring
-    (tests, bench)."""
+    """Clears the CURRENT registry, event buffer, flight ring and
+    memory-ledger gauges (tests, bench). Pull sources persist — they
+    describe what is resident in the process, not a run."""
     _STATE["registry"] = _Registry()
     _STATE["events"] = []
     _STATE["flight"] = collections.deque(maxlen=_FLIGHT_CAP)
+    _STATE["ledger"] = MemoryLedger()
 
 
 def configure(
-    enabled: Optional[bool] = None, directory: Optional[str] = None
+    enabled: Optional[bool] = None, directory: Optional[str] = None,
+    mem_sample: Optional[bool] = None,
 ) -> None:
     """Programmatic arming — the post-import equivalent of the env vars
     (`cli train --telemetry_dir` uses this; the env is parsed once at
     import, before argv exists). Validates like the env boundary."""
-    global ENABLED, EXPORT_DIR
+    global ENABLED, EXPORT_DIR, MEM_SAMPLE
     if directory is not None:
         _, EXPORT_DIR = _parse_env(None, directory)
         ENABLED = True
     if enabled is not None:
         ENABLED = bool(enabled)
+    if mem_sample is not None:
+        MEM_SAMPLE = bool(mem_sample)
 
 
 @contextlib.contextmanager
@@ -845,7 +1107,7 @@ def active(directory: Optional[str] = None):
     global ENABLED, EXPORT_DIR
     old = (
         ENABLED, EXPORT_DIR, _STATE["registry"], _STATE["events"],
-        _STATE["collectors"], _STATE["flight"],
+        _STATE["collectors"], _STATE["flight"], _STATE["ledger"],
     )
     global _DEFAULTS_REGISTERED
     old_defaults = _DEFAULTS_REGISTERED
@@ -854,6 +1116,7 @@ def active(directory: Optional[str] = None):
     _STATE["events"] = []
     _STATE["collectors"] = []
     _STATE["flight"] = collections.deque(maxlen=_FLIGHT_CAP)
+    _STATE["ledger"] = MemoryLedger()
     _DEFAULTS_REGISTERED = False
     ENABLED, EXPORT_DIR = True, d
     try:
@@ -861,7 +1124,7 @@ def active(directory: Optional[str] = None):
     finally:
         (
             ENABLED, EXPORT_DIR, _STATE["registry"], _STATE["events"],
-            _STATE["collectors"], _STATE["flight"],
+            _STATE["collectors"], _STATE["flight"], _STATE["ledger"],
         ) = old
         _DEFAULTS_REGISTERED = old_defaults
 

@@ -102,6 +102,28 @@ def unregister_status(name: str) -> None:
         _STATUS.pop(name, None)
 
 
+def _config_status() -> dict:
+    """The /statusz `config` section: every resolved YDF_TPU_* knob
+    (the eagerly-validated values, not raw os.environ) — config drift
+    between manager and workers used to be invisible
+    (ydf_tpu/config.py:resolved_env_config)."""
+    from ydf_tpu.config import resolved_env_config
+
+    return resolved_env_config()
+
+
+def _memory_status() -> dict:
+    """The /statusz `memory` section: the MemoryLedger snapshot —
+    per-subsystem byte gauges plus current/peak RSS."""
+    return telemetry.ledger().snapshot()
+
+
+# Default sections every process serves (cheap registration; sampled
+# only when a scrape asks).
+register_status("config", _config_status)
+register_status("memory", _memory_status)
+
+
 def status_snapshot() -> dict:
     """All registered sections; a broken provider degrades to an error
     string instead of failing the whole page."""
