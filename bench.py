@@ -718,19 +718,32 @@ def measure_serving_family(model, data, rows, record):
 
 
 def measure_distributed_family(rows, trees, depth, features, record):
-    """Feature-parallel distributed training measurement (ROADMAP
-    item 2's bench half), gated on YDF_TPU_BENCH_DIST_WORKERS=N
-    (N >= 2): spins N in-process localhost workers, streams the bench
-    table into a feature-sharded dataset cache, trains the same
-    (trees, depth) GBT through the manager–worker exchange
-    (parallel/dist_gbt.py), and records
+    """Distributed training measurement (ROADMAP item 2's bench half),
+    gated on YDF_TPU_BENCH_DIST_WORKERS=N (N >= 2): spins N in-process
+    localhost workers, streams the bench table into a sharded dataset
+    cache, trains the same (trees, depth) GBT through the
+    manager–worker exchange, and records
 
+      dist_mode               {feature,row,hybrid} — the sharding mode
+                              (YDF_TPU_BENCH_DIST_MODE, default
+                              feature; part of the bench-diff pairing
+                              shape so modes never cross-compare)
       dist_workers            worker count
       dist_train_s            steady-state distributed train wall
       dist_reduce_bytes       total histogram bytes reduced at the
-                              manager (the wire the sibling-subtraction
-                              halving and YDF_TPU_HIST_QUANT shrink)
+                              manager (feature mode: f32 slices; row
+                              mode: accumulation-domain f64 partials)
       dist_reduce_bytes_per_layer   the per-layer average of the same
+      dist_merge_s            manager-side histogram merge wall
+                              (row-mode fixed-order sum / feature-mode
+                              concat), summed over layers
+      dist_shard_rows         rows per row shard (row/hybrid; rows for
+                              feature mode — every worker holds all)
+      dist_shard_bytes        fleet-total resident worker shard/state
+      dist_shard_bytes_per_worker   ... and the per-worker maximum —
+                              row mode's ~1/N-of-the-bin-matrix memory
+                              contract, straight from the workers'
+                              `dist_shard` ledger reports
       dist_rpc_p50_ns         per-verb RPC p50 from the run's latency
                               histograms (telemetry-keyed by verb)
       dist_recoveries         reassignments the run needed (0 healthy)
@@ -758,6 +771,16 @@ def measure_distributed_family(rows, trees, depth, features, record):
     except ValueError:
         record["dist_family_error"] = (
             f"YDF_TPU_BENCH_DIST_WORKERS={env!r} must be an integer >= 2"
+        )
+        return
+    mode = (
+        os.environ.get("YDF_TPU_BENCH_DIST_MODE", "").strip().lower()
+        or "feature"
+    )
+    if mode not in ("feature", "row", "hybrid"):
+        record["dist_family_error"] = (
+            f"YDF_TPU_BENCH_DIST_MODE={mode!r} must be one of "
+            "feature/row/hybrid"
         )
         return
     try:
@@ -788,9 +811,18 @@ def measure_distributed_family(rows, trees, depth, features, record):
             start_worker(p, host="127.0.0.1", blocking=False)
         addrs = [f"127.0.0.1:{p}" for p in ports]
         with tempfile.TemporaryDirectory() as td:
+            shard_kw = {"feature_shards": nw}
+            if mode == "row":
+                shard_kw = {"row_shards": nw}
+            elif mode == "hybrid":
+                # R×C grid sized to the fleet: 2 row groups × the rest
+                # as column groups.
+                shard_kw = {
+                    "row_shards": 2, "feature_shards": max(nw // 2, 2),
+                }
             cache = create_dataset_cache(
                 frame, os.path.join(td, "cache"), label="label",
-                task=Task.CLASSIFICATION, feature_shards=nw,
+                task=Task.CLASSIFICATION, **shard_kw,
             )
 
             def train_dist():
@@ -806,18 +838,29 @@ def measure_distributed_family(rows, trees, depth, features, record):
             train_dist()                  # compile + shard placement
             model, wall = train_dist()    # steady state
             d = model.training_logs["distributed"]
+            record["dist_mode"] = d.get("mode", "feature")
             record["dist_workers"] = nw
             record["dist_train_s"] = round(wall, 2)
             record["dist_reduce_bytes"] = int(d["reduce_bytes"])
             record["dist_reduce_bytes_per_layer"] = round(
                 d["reduce_bytes"] / max(trees * depth, 1), 1
             )
+            record["dist_merge_s"] = round(d.get("merge_s", 0.0), 4)
+            record["dist_shard_rows"] = int(d.get("shard_rows", rows))
             record["dist_rpc_p50_ns"] = d["rpc_p50_ns"]
             record["dist_recoveries"] = int(d["recoveries"])
             # Fleet-total resident shard/state bytes the workers
             # reported at shard load — the distributed row of the
-            # memory headline (docs/observability.md).
+            # memory headline (docs/observability.md) — plus the
+            # per-worker maximum: row mode's memory contract is that
+            # each worker holds ~1/N of the single-machine bin matrix
+            # (streamed loads, no full-slice materialization).
             record["dist_shard_bytes"] = int(d.get("shard_bytes", 0))
+            per_worker = d.get("worker_shard_bytes") or {}
+            record["dist_shard_bytes_per_worker"] = int(
+                max(per_worker.values()) if per_worker
+                else d.get("shard_bytes", 0)
+            )
             record["dist_compute_s"] = round(d["compute_s"], 3)
             record["dist_net_s"] = round(d["net_s"], 3)
             record["dist_wait_s"] = round(d["wait_s"], 3)

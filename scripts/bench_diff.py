@@ -48,7 +48,13 @@ import sys
 from typing import Dict, List, Optional, Tuple
 
 #: Shape key: records are only comparable at identical workload shape.
-SHAPE_FIELDS = ("metric", "backend", "rows", "trees", "depth")
+#: dist_mode joins the key so a row-parallel round can never be diffed
+#: against a feature-parallel one (their dist_* fields measure
+#: different exchanges — protocol bytes, merge domains, shard
+#: residency); records without a distributed family carry no dist_mode
+#: and pair exactly as before.
+SHAPE_FIELDS = ("metric", "backend", "rows", "trees", "depth",
+                "dist_mode")
 
 #: field (or dotted-prefix, trailing ".") -> (direction, rel_noise,
 #: abs_floor). direction "lower" = smaller is better. A change is a
@@ -75,6 +81,9 @@ FIELD_SPECS: Dict[str, Tuple[str, float, float]] = {
     "train_peak_rss_bytes": ("lower", 0.10, float(64 << 20)),
     "serve_bank_bytes": ("lower", 0.10, float(1 << 20)),
     "dist_shard_bytes": ("lower", 0.10, float(1 << 20)),
+    "dist_shard_bytes_per_worker": ("lower", 0.10, float(1 << 20)),
+    "dist_shard_rows": ("lower", 0.05, 1024.0),
+    "dist_merge_s": ("lower", 0.25, 0.05),
     "dist_train_s": ("lower", 0.15, 0.2),
     "dist_compute_s": ("lower", 0.20, 0.1),
     "dist_net_s": ("lower", 0.25, 0.1),

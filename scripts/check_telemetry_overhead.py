@@ -53,6 +53,7 @@ def run_check(
     abs_floor_s: float = 0.08,
     with_http: bool = False,
     with_ledger: bool = False,
+    with_dist_row: bool = False,
 ) -> dict:
     import numpy as np
 
@@ -77,14 +78,69 @@ def run_check(
 
     train_once()  # compile + cold binning: excluded, like bench.py
 
+    train_dist = None
+    dist_cleanup = None
+    if with_dist_row:
+        # Row-parallel distributed variant: a 2-worker in-process fleet
+        # over a row-sharded cache of the SAME data. The per-layer
+        # dist.layer spans, merge accounting, and RPC instrumentation
+        # must fit the same 3% budget as the single-machine path —
+        # the distributed train is its OWN baseline (telemetry off vs
+        # on over the identical exchange).
+        import socket
+
+        from ydf_tpu.config import Task
+        from ydf_tpu.dataset.cache import create_dataset_cache
+        from ydf_tpu.parallel.worker_service import (
+            WorkerPool,
+            start_worker,
+        )
+
+        ports = []
+        for _ in range(2):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+            s.close()
+        for p in ports:
+            start_worker(p, host="127.0.0.1", blocking=False)
+        addrs = [f"127.0.0.1:{p}" for p in ports]
+        dist_dir = tempfile.mkdtemp(prefix="ydf_tel_dist_")
+        cache = create_dataset_cache(
+            data, os.path.join(dist_dir, "cache"), label="label",
+            task=Task.CLASSIFICATION, row_shards=2,
+        )
+
+        def train_dist():
+            ydf.GradientBoostedTreesLearner(
+                label="label", num_trees=trees, max_depth=depth,
+                validation_ratio=0.0, early_stopping="NONE",
+                distributed_workers=addrs,
+            ).train(cache)
+
+        def dist_cleanup():
+            try:
+                WorkerPool(addrs).shutdown_all()
+            except Exception:
+                pass
+            shutil.rmtree(dist_dir, ignore_errors=True)
+
+        train_dist()  # compile + shard placement: excluded
+
     disabled_a = measure_min_wall(train_once, reps)
+    disabled_dist = (
+        measure_min_wall(train_dist, reps) if train_dist else None
+    )
     td = tempfile.mkdtemp(prefix="ydf_tel_overhead_")
     enabled_http = None
     enabled_ledger = None
     ledger_snap = None
+    enabled_dist = None
     try:
         with telemetry.active(td):
             enabled = measure_min_wall(train_once, reps)
+            if train_dist is not None:
+                enabled_dist = measure_min_wall(train_dist, reps)
             if with_ledger:
                 # Ledger-accounting variant: RSS sampling at span
                 # boundaries FORCED on (it defaults on, but the check
@@ -170,6 +226,21 @@ def run_check(
             summary["ok"] and summary["ok_ledger"]
             and summary["ok_ledger_populated"]
         )
+    if enabled_dist is not None:
+        # The distributed run is its own baseline: the telemetry-off
+        # fleet pays the same RPC/merge exchange, so the delta is
+        # exactly the instrumentation (per-layer spans, RPC latency
+        # histograms, merge/reduce counters).
+        dist_overhead = enabled_dist - disabled_dist
+        dist_budget = rel_budget * disabled_dist + noise + abs_floor_s
+        summary["disabled_dist_min_s"] = round(disabled_dist, 4)
+        summary["enabled_dist_min_s"] = round(enabled_dist, 4)
+        summary["dist_overhead_s"] = round(dist_overhead, 4)
+        summary["dist_budget_s"] = round(dist_budget, 4)
+        summary["ok_dist_row"] = dist_overhead <= dist_budget
+        summary["ok"] = summary["ok"] and summary["ok_dist_row"]
+    if dist_cleanup is not None:
+        dist_cleanup()
     return summary
 
 
@@ -188,11 +259,18 @@ def main(argv=None) -> int:
                          "sampling forced on plus a per-rep ledger "
                          "snapshot (the accounting must fit the same "
                          "3%% budget)")
+    ap.add_argument("--with-dist-row", action="store_true",
+                    help="additionally measure a row-parallel "
+                         "distributed train (2 in-process workers, "
+                         "row-sharded cache) telemetry-off vs on — the "
+                         "per-layer merge spans and RPC accounting "
+                         "must fit the same 3%% budget")
     args = ap.parse_args(argv)
     summary = run_check(
         rows=args.rows, trees=args.trees, depth=args.depth,
         features=args.features, reps=args.reps,
         with_http=args.with_http, with_ledger=args.with_ledger,
+        with_dist_row=args.with_dist_row,
     )
     print(json.dumps(summary))
     return 0 if summary["ok"] else 1

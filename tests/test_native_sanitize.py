@@ -200,6 +200,39 @@ with _tempfile.TemporaryDirectory() as _td:
         if _fl[_k] is not None:
             assert np.array_equal(np.asarray(_fl[_k]),
                                   np.asarray(_fd[_k])), _k
+    # Row-parallel + hybrid verbs under the sanitizer: streamed shard
+    # loads, the fixed-order f64 sum-merge path (row_histograms /
+    # route_validation), and the hybrid owner-bitmap exchange
+    # (row_apply_split) all run through the same (sanitized) native
+    # histogram/serving build's process — distributed-vs-local
+    # bit-equality asserted in-sanitizer for both layouts.
+    _cache_r = create_dataset_cache(
+        _frame, _td + "/cache_rows", label="y", task=Task.REGRESSION,
+        row_shards=2,
+    )
+    _m_row = _mk(
+        distributed_workers=[f"127.0.0.1:{_port}"]
+    ).train(_cache_r)
+    _m_local_r = _mk().train(_cache_r)
+    _fr, _flr = _m_row.forest.to_numpy(), _m_local_r.forest.to_numpy()
+    for _k in _flr:
+        if _flr[_k] is not None:
+            assert np.array_equal(np.asarray(_flr[_k]),
+                                  np.asarray(_fr[_k])), _k
+    assert _m_row.training_logs["distributed"]["mode"] == "row"
+    _cache_h = create_dataset_cache(
+        _frame, _td + "/cache_hybrid", label="y", task=Task.REGRESSION,
+        row_shards=2, feature_shards=2,
+    )
+    _m_hyb = _mk(
+        distributed_workers=[f"127.0.0.1:{_port}"]
+    ).train(_cache_h)
+    _fh = _m_hyb.forest.to_numpy()
+    for _k in _flr:
+        if _flr[_k] is not None:
+            assert np.array_equal(np.asarray(_flr[_k]),
+                                  np.asarray(_fh[_k])), _k
+    assert _m_hyb.training_logs["distributed"]["mode"] == "hybrid"
     WorkerPool([f"127.0.0.1:{_port}"]).shutdown_all()
 print("SANITIZE_RUN_OK", mode)
 """

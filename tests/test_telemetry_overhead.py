@@ -40,3 +40,20 @@ def test_enabled_overhead_within_budget():
         "telemetry enabled-path overhead exceeded its budget: "
         f"{summary}"
     )
+
+
+def test_dist_row_overhead_within_budget():
+    """Row-parallel distributed variant (`--with-dist-row`): the
+    per-layer dist.layer spans, merge accounting and RPC latency
+    histograms of a 2-worker row-mode train must fit the same 3% +
+    noise budget against the telemetry-off distributed baseline — the
+    distributed instrumentation may not eat the exchange it
+    measures."""
+    mod = _load()
+    summary = mod.run_check(rows=4_000, trees=4, depth=4, reps=2,
+                            with_dist_row=True)
+    assert summary["disabled_dist_min_s"] > 0
+    assert summary["ok_dist_row"], (
+        "row-parallel distributed telemetry overhead exceeded its "
+        f"budget: {summary}"
+    )

@@ -1,0 +1,509 @@
+"""Row-parallel distributed GBT training (parallel/dist_row.py):
+row-sharded workers answer full-width histogram PARTIALS merged by
+fixed-order summation, route their own rows locally (no bitmap
+broadcast), and row-shard the validation split through the
+route_validation verb. The headline guarantees under test:
+
+  * row-parallel (and hybrid row×feature) models are BIT-IDENTICAL to
+    the single-machine grower — same splits, leaf values, per-iteration
+    train losses — across YDF_TPU_HIST_QUANT modes, with NaN +
+    categorical features and subsampling (the int8 case is exact by
+    integer associativity; f32 by the near-exact f64 merge — see
+    docs/distributed_training.md "Sum-merge bit-stability");
+  * distributed early stopping produces the same stop iteration as the
+    single-machine early-stop driver;
+  * every chaos scenario (worker loss mid-layer, dropped shard loads,
+    corrupt row shards, real worker shutdown) recovers bit-identically
+    via route-history replay;
+  * streamed shard loads keep each worker's resident `dist_shard`
+    footprint at ~1/N of the bin matrix, and the manager's shard-fleet
+    accounting follows migrations instead of summing stale reports.
+"""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+from ydf_tpu.dataset.cache import create_dataset_cache
+from ydf_tpu.parallel import dist_worker
+from ydf_tpu.parallel.worker_service import WorkerPool, start_worker
+from ydf_tpu.utils import failpoints
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def workers():
+    started = []
+
+    def start(n):
+        ports = [_free_port() for _ in range(n)]
+        for p in ports:
+            start_worker(p, host="127.0.0.1", blocking=False)
+        addrs = [f"127.0.0.1:{p}" for p in ports]
+        WorkerPool(addrs).ping_all()
+        started.extend(addrs)
+        return addrs
+
+    yield start
+    try:
+        WorkerPool(started).shutdown_all() if started else None
+    except Exception:
+        pass
+    dist_worker.reset_state()
+
+
+def _frame(n=2000, seed=7):
+    """NaN numericals + a categorical column — the feature kinds the
+    acceptance criteria name."""
+    rng = np.random.RandomState(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float64)
+    x[rng.rand(n) < 0.08, 0] = np.nan
+    cat = rng.choice(["aa", "bb", "cc", "dd"], size=n)
+    y = (
+        x[:, 1] * 1.5
+        - np.nan_to_num(x[:, 0])
+        + (cat == "aa") * 2.0
+        + rng.normal(scale=0.3, size=n)
+    )
+    return {
+        "f0": x[:, 0], "f1": x[:, 1], "f2": x[:, 2], "f3": x[:, 3],
+        "c0": cat, "y": y.astype(np.float32),
+    }
+
+
+def _make_cache(tmp_path, row_shards, feature_shards=0, frame=None,
+                name="cache"):
+    return create_dataset_cache(
+        frame if frame is not None else _frame(),
+        str(tmp_path / name), label="y", task=Task.REGRESSION,
+        row_shards=row_shards, feature_shards=feature_shards,
+    )
+
+
+def _learner(num_trees=3, **kw):
+    return ydf.GradientBoostedTreesLearner(
+        label="y", task=Task.REGRESSION, num_trees=num_trees,
+        max_depth=4, validation_ratio=0.0, early_stopping="NONE",
+        **kw,
+    )
+
+
+def _assert_bit_identical(m_dist, m_local, data=None):
+    f_d = m_dist.forest.to_numpy()
+    f_l = m_local.forest.to_numpy()
+    assert set(f_d) == set(f_l)
+    for k in sorted(f_l):
+        a, b = f_d[k], f_l[k]
+        if a is None or b is None:
+            assert a is b, k
+            continue
+        assert np.array_equal(
+            np.asarray(a), np.asarray(b)
+        ), f"forest field {k!r} differs"
+    assert np.array_equal(
+        np.asarray(m_dist.initial_predictions),
+        np.asarray(m_local.initial_predictions),
+    )
+    assert np.allclose(
+        m_dist.training_logs["train_loss"],
+        m_local.training_logs["train_loss"],
+        rtol=0, atol=0,
+    ), "per-iteration training losses differ"
+    if data is not None:
+        assert np.array_equal(
+            np.asarray(m_dist.predict(data)),
+            np.asarray(m_local.predict(data)),
+        )
+
+
+# --------------------------------------------------------------------- #
+# Bit-identity vs the single-machine grower
+# --------------------------------------------------------------------- #
+
+
+def test_row_2workers_bit_identical(tmp_path, workers):
+    cache = _make_cache(tmp_path, row_shards=2)
+    addrs = workers(2)
+    m_local = _learner().train(cache)
+    m_dist = _learner(distributed_workers=addrs).train(cache)
+    _assert_bit_identical(m_dist, m_local, _frame(n=256, seed=11))
+    d = m_dist.training_logs["distributed"]
+    assert d["mode"] == "row"
+    assert d["workers"] == 2
+    assert d["row_shards"] == 2 and d["col_shards"] == 1
+    assert d["reduce_bytes"] > 0
+    assert d["rpc_count"]["row_histograms"] > 0
+    assert d["rpc_count"]["route_validation"] > 0
+    # Pure row mode never exchanges a routing bitmap.
+    assert "row_apply_split" not in d["rpc_count"]
+    assert d["merge_s"] >= 0
+
+
+def test_row_3shards_on_2workers_uneven(tmp_path, workers):
+    # 3 row shards on 2 workers: multi-unit ownership + uneven slices.
+    cache = _make_cache(tmp_path, row_shards=3)
+    addrs = workers(2)
+    m_local = _learner().train(cache)
+    m_dist = _learner(distributed_workers=addrs).train(cache)
+    _assert_bit_identical(m_dist, m_local)
+
+
+@pytest.mark.parametrize(
+    "quant,trees", [("int8", 4), ("bf16x2", 3)]
+)
+def test_row_bit_identical_across_quant_modes(
+    tmp_path, workers, monkeypatch, quant, trees
+):
+    """int8 is the provably exact case (integer partials, associative
+    merge); bf16x2 rides the same f64 wire. Tree counts differ per mode
+    so the boosting-closure cache can never serve a stale quant mode
+    (same discipline as the feature-parallel suite)."""
+    from ydf_tpu.learners.gbt import _make_boost_fn
+
+    monkeypatch.setenv("YDF_TPU_HIST_QUANT", quant)
+    _make_boost_fn.cache_clear()
+    cache = _make_cache(tmp_path, row_shards=2)
+    addrs = workers(2)
+    m_local = _learner(num_trees=trees).train(cache)
+    m_dist = _learner(
+        num_trees=trees, distributed_workers=addrs
+    ).train(cache)
+    _assert_bit_identical(m_dist, m_local)
+    assert m_dist.training_logs["distributed"]["hist_quant"] == quant
+    _make_boost_fn.cache_clear()
+
+
+def test_row_with_subsample_and_feature_sampling(tmp_path, workers):
+    cache = _make_cache(tmp_path, row_shards=2)
+    addrs = workers(2)
+    kw = dict(subsample=0.7, num_candidate_attributes=3)
+    m_local = _learner(**kw).train(cache)
+    m_dist = _learner(distributed_workers=addrs, **kw).train(cache)
+    _assert_bit_identical(m_dist, m_local)
+
+
+@pytest.mark.parametrize("quant", ["f32", "int8"])
+def test_hybrid_2x2_bit_identical(tmp_path, workers, monkeypatch, quant):
+    """Hybrid row×feature sharding: 2 row groups × 2 column groups on 2
+    workers — concat-of-sums merge plus the per-row-group owner-bitmap
+    exchange — must reproduce the single-machine grower exactly, across
+    quant modes."""
+    from ydf_tpu.learners.gbt import _make_boost_fn
+
+    monkeypatch.setenv("YDF_TPU_HIST_QUANT", quant)
+    _make_boost_fn.cache_clear()
+    cache = _make_cache(
+        tmp_path, row_shards=2, feature_shards=2, name=f"hyb_{quant}"
+    )
+    addrs = workers(2)
+    m_local = _learner().train(cache)
+    m_dist = _learner(distributed_workers=addrs).train(cache)
+    _assert_bit_identical(m_dist, m_local)
+    d = m_dist.training_logs["distributed"]
+    assert d["mode"] == "hybrid"
+    assert d["row_shards"] == 2 and d["col_shards"] == 2
+    assert d["rpc_count"].get("row_apply_split", 0) > 0
+    _make_boost_fn.cache_clear()
+
+
+# --------------------------------------------------------------------- #
+# Distributed validation + early stopping
+# --------------------------------------------------------------------- #
+
+
+def test_row_validation_early_stopping_matches_single_machine(
+    tmp_path, workers
+):
+    """The validation-routing verb row-shards the validation split;
+    the manager mirrors the single-machine early-stop driver (same
+    split expressions, same chunked stop boundaries) — the stop
+    iteration, trained-tree count, and model must all match. The valid
+    LOSS scalar matches to one ulp (its reduction compiles in two
+    different XLA programs — documented whim); the models and train
+    losses are exact."""
+    rng = np.random.RandomState(3)
+    n = 800
+    x = rng.normal(size=(n, 3))
+    frame = {
+        "f0": x[:, 0], "f1": x[:, 1], "f2": x[:, 2],
+        "y": (x[:, 0] + rng.normal(scale=2.0, size=n)).astype(
+            np.float32
+        ),
+    }
+    cache = _make_cache(tmp_path, row_shards=2, frame=frame)
+    addrs = workers(2)
+
+    def learner(**kw):
+        # max_depth matches the rest of the suite so the jitted layer
+        # programs are shared (the tier-1 gate is timeout-bound).
+        return ydf.GradientBoostedTreesLearner(
+            label="y", task=Task.REGRESSION, num_trees=60, max_depth=4,
+            shrinkage=0.3, validation_ratio=0.25,
+            early_stopping="LOSS_INCREASE",
+            early_stopping_num_trees_look_ahead=5, **kw,
+        )
+
+    m_local = learner().train(cache)
+    m_dist = learner(distributed_workers=addrs).train(cache)
+    # Early stopping actually fired (the scenario is built to overfit)
+    # and both sides stopped at the same place.
+    assert m_local.training_logs["num_trees_trained"] < 60
+    assert (
+        m_dist.training_logs["num_trees_trained"]
+        == m_local.training_logs["num_trees_trained"]
+    )
+    assert (
+        m_dist.training_logs["num_trees"]
+        == m_local.training_logs["num_trees"]
+    )
+    _assert_bit_identical(m_dist, m_local)
+    vl_l = np.asarray(m_local.training_logs["valid_loss"], np.float32)
+    vl_d = np.asarray(m_dist.training_logs["valid_loss"], np.float32)
+    assert vl_l.shape == vl_d.shape
+    assert np.allclose(vl_l, vl_d, rtol=0, atol=2e-7)
+    assert m_dist.training_logs["distributed"]["has_valid"]
+    assert m_dist.training_logs["distributed"]["valid_rows"] > 0
+
+
+def test_feature_mode_still_rejects_validation(tmp_path, workers):
+    cache = _make_cache(
+        tmp_path, row_shards=0, feature_shards=2, name="feat"
+    )
+    addrs = workers(2)
+    with pytest.raises(ValueError, match="row_shards"):
+        ydf.GradientBoostedTreesLearner(
+            label="y", task=Task.REGRESSION, num_trees=3,
+            distributed_workers=addrs,
+        ).train(cache)
+
+
+# --------------------------------------------------------------------- #
+# Chaos: failpoints + real failures recover bit-identically
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.chaos
+def test_chaos_row_worker_loss_mid_layer(tmp_path, workers):
+    """dist.histogram_rpc=drop_conn mid-tree: the row shard moves to a
+    healthy worker which replays the manager's route history — the
+    model is bit-identical to the fault-free run."""
+    cache = _make_cache(tmp_path, row_shards=2)
+    addrs = workers(2)
+    m_ref = _learner().train(cache)
+    with failpoints.active("dist.histogram_rpc=drop_conn@5"):
+        m_dist = _learner(distributed_workers=addrs).train(cache)
+        assert "dist.histogram_rpc" in failpoints.fired_sites()
+    _assert_bit_identical(m_dist, m_ref)
+    assert m_dist.training_logs["distributed"]["recoveries"] >= 1
+
+
+@pytest.mark.chaos
+def test_chaos_row_shard_load_drop(tmp_path, workers):
+    cache = _make_cache(tmp_path, row_shards=2)
+    addrs = workers(2)
+    m_ref = _learner().train(cache)
+    with failpoints.active("dist.shard_load=drop_conn"):
+        m_dist = _learner(distributed_workers=addrs).train(cache)
+        assert "dist.shard_load" in failpoints.fired_sites()
+    _assert_bit_identical(m_dist, m_ref)
+
+
+@pytest.mark.chaos
+def test_chaos_row_validation_rpc_drop(tmp_path, workers):
+    """A connection dropped on the tree-end route_validation exchange:
+    the leaf gather retries through the recovery path (replayed units
+    answer identically) and the model stays bit-identical."""
+    cache = _make_cache(tmp_path, row_shards=2)
+    addrs = workers(2)
+    m_ref = _learner().train(cache)
+    with failpoints.active("dist.validation_rpc=drop_conn@2"):
+        m_dist = _learner(distributed_workers=addrs).train(cache)
+        assert "dist.validation_rpc" in failpoints.fired_sites()
+    _assert_bit_identical(m_dist, m_ref)
+
+
+@pytest.mark.chaos
+def test_chaos_corrupt_row_shard_rebuilt_bit_identical(tmp_path, workers):
+    """A bit-flipped row shard is caught by the STREAMED crc check at
+    load (the block fails as it is consumed, before any row reaches a
+    histogram), re-sliced from the verified bins.npy byte-identically,
+    and training proceeds to the same model."""
+    cache = _make_cache(tmp_path, row_shards=2)
+    m_ref = _learner().train(cache)
+    shard_path = os.path.join(cache.path, "bins_rows_1.npy")
+    before = open(shard_path, "rb").read()
+    with open(shard_path, "r+b") as f:
+        f.seek(os.path.getsize(shard_path) // 2)
+        b = f.read(1)
+        f.seek(-1, 1)
+        f.write(bytes([b[0] ^ 0xFF]))
+    addrs = workers(2)
+    m_dist = _learner(distributed_workers=addrs).train(cache)
+    _assert_bit_identical(m_dist, m_ref)
+    assert m_dist.training_logs["distributed"]["shard_rebuilds"] >= 1
+    assert open(shard_path, "rb").read() == before
+
+
+@pytest.mark.chaos
+def test_chaos_row_real_worker_shutdown_mid_train(tmp_path, workers):
+    """A worker REALLY shut down mid-train (the in-process analogue of
+    a SIGKILLed worker host: its sockets go away and every RPC to it
+    fails) — whichever layer the loss lands on, the run must finish
+    bit-identical."""
+    cache = _make_cache(tmp_path, row_shards=2)
+    m_ref = _learner(num_trees=6).train(cache)
+    addrs = workers(3)
+
+    def kill_one():
+        time.sleep(0.3)
+        try:
+            WorkerPool([addrs[2]]).shutdown_all()
+        except Exception:
+            pass
+
+    t = threading.Thread(target=kill_one, daemon=True)
+    t.start()
+    m_dist = _learner(
+        num_trees=6, distributed_workers=addrs
+    ).train(cache)
+    t.join()
+    _assert_bit_identical(m_dist, m_ref)
+
+
+@pytest.mark.chaos
+def test_shard_fleet_accounting_tracks_migration(tmp_path, workers):
+    """Satellite regression: the manager-side `dist_shard_fleet` ledger
+    used to sum every load_cache_shard response ever seen — after a
+    migration the quarantined worker's stale report stayed in the
+    total. Now the failed worker's entry is dropped when its shards
+    move, so the per-worker map (and the fleet sum bench.py records)
+    reflects CURRENT ownership only."""
+    cache = _make_cache(tmp_path, row_shards=2)
+    addrs = workers(2)
+    with failpoints.active("dist.histogram_rpc=drop_conn@3"):
+        m_dist = _learner(distributed_workers=addrs).train(cache)
+    d = m_dist.training_logs["distributed"]
+    assert d["recoveries"] >= 1
+    per_worker = d["worker_shard_bytes"]
+    # The fleet total is exactly the sum of the CURRENT per-worker
+    # reports (no stale entries from pre-migration owners).
+    assert d["shard_bytes"] == sum(per_worker.values())
+    # After the drop_conn recovery, both shards live on ONE worker —
+    # the quarantined one's report must be gone.
+    assert len(per_worker) == 1
+
+
+# --------------------------------------------------------------------- #
+# Shard format + streamed loads (dataset/cache.py)
+# --------------------------------------------------------------------- #
+
+
+def test_row_shard_files_ride_integrity_format(tmp_path):
+    import json
+
+    cache = _make_cache(tmp_path, row_shards=3)
+    assert cache.row_shards == 3
+    with open(os.path.join(cache.path, "cache_meta.json")) as f:
+        meta = json.load(f)
+    files = meta["integrity"]["files"]
+    full = np.asarray(cache.bins)
+    total_rows = 0
+    for k in range(3):
+        name = f"bins_rows_{k}.npy"
+        assert name in files and files[name]["size"] > 0
+        lo, hi = cache.row_shard_range(k)
+        sl = cache.load_row_shard_streamed(k)
+        assert np.array_equal(sl, full[lo:hi])
+        total_rows += hi - lo
+    assert total_rows == cache.num_rows
+    cache.verify(full=True)
+
+
+def test_streamed_load_column_slice_and_corruption(tmp_path):
+    from ydf_tpu.dataset.cache import CacheCorruptionError, DatasetCache
+
+    cache = _make_cache(tmp_path, row_shards=2, feature_shards=2)
+    full = np.asarray(cache.bins)
+    lo, hi = cache.row_shard_range(0)
+    clo, chi = cache.shard_col_range(1)
+    sl = cache.load_row_shard_streamed(0, col_range=(clo, chi))
+    assert np.array_equal(sl, full[lo:hi, clo:chi])
+    # Corrupt the shard: the streamed load must raise on the block, and
+    # the rebuild must restore identical bytes.
+    p = os.path.join(cache.path, "bins_rows_0.npy")
+    before = open(p, "rb").read()
+    with open(p, "r+b") as f:
+        f.seek(len(before) // 2)
+        b = f.read(1)
+        f.seek(-1, 1)
+        f.write(bytes([b[0] ^ 0x5A]))
+    with pytest.raises(CacheCorruptionError):
+        cache.load_row_shard_streamed(0)
+    cache.rebuild_row_shard(0)
+    assert open(p, "rb").read() == before
+    DatasetCache(cache.path, verify="full")
+
+
+def test_unsharded_cache_row_accessors_raise(tmp_path):
+    cache = _make_cache(tmp_path, row_shards=0, name="plain")
+    assert cache.row_shards == 0
+    with pytest.raises(ValueError, match="row_shards"):
+        cache.load_row_shard_streamed(0)
+
+
+def test_row_shard_ranges_cover_and_validate():
+    from ydf_tpu.dataset.cache import row_shard_ranges
+
+    r = row_shard_ranges(10, 3)
+    assert r[0][0] == 0 and r[-1][1] == 10
+    assert all(a[1] == b[0] for a, b in zip(r, r[1:]))
+    with pytest.raises(ValueError):
+        row_shard_ranges(3, 0)
+    with pytest.raises(ValueError, match="exceeds"):
+        row_shard_ranges(2, 5)
+
+
+# --------------------------------------------------------------------- #
+# Memory contract: resident worker footprint ≈ 1/N of the bin matrix
+# --------------------------------------------------------------------- #
+
+
+def test_row_worker_memory_contract(tmp_path, workers):
+    """Streamed loads, no full-slice materialization: each worker's
+    `dist_shard` ledger bytes are its row slice of the bin matrix plus
+    O(rows/N) routing/stat state — never the full matrix."""
+    cache = _make_cache(tmp_path, row_shards=2)
+    addrs = workers(2)
+    m_dist = _learner(distributed_workers=addrs).train(cache)
+    d = m_dist.training_logs["distributed"]
+    bins_bytes = np.asarray(cache.bins).nbytes
+    n = cache.num_rows
+    per_worker = d["worker_shard_bytes"]
+    assert len(per_worker) == 2
+    # Per worker: half the bin matrix + bounded per-row state
+    # (slot/hist_slot/leaf i32 + valid mask + the tree's stat slice,
+    # ≤ 32 bytes/row at S = 3 f32) — and nowhere near the full matrix.
+    for b in per_worker.values():
+        assert b >= bins_bytes // 2  # holds its slice
+        assert b <= bins_bytes // 2 + (n // 2) * 32
+    # The worker-side pull source (the `dist_shard` MemoryLedger row):
+    # the in-process fleet's total is the whole sharded footprint —
+    # bins coverage plus bounded per-row state, never a second full
+    # matrix. (It can exceed the load-time reports: the per-tree stat
+    # slices arrive after load and stay resident for the tree.)
+    total = dist_worker.shard_bytes_total()
+    assert total >= sum(per_worker.values())
+    assert total <= bins_bytes + n * 32

@@ -462,10 +462,11 @@ def main(argv=None):
                         "same as YDF_TPU_METRICS_PORT)")
     p.add_argument("--workers",
                    help="comma-separated host:port addresses of "
-                        "`ydf_tpu.cli worker` processes for feature-"
-                        "parallel distributed training; --dataset must "
-                        "then name a dataset cache directory created "
-                        "with feature_shards=N "
+                        "`ydf_tpu.cli worker` processes for "
+                        "distributed training; --dataset must then "
+                        "name a dataset cache directory created with "
+                        "feature_shards=N (feature-parallel) or "
+                        "row_shards=N (row-parallel; both = hybrid) "
                         "(docs/distributed_training.md)")
     p.add_argument("--cpu", action="store_true")
     p.set_defaults(fn=cmd_train)

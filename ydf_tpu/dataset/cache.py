@@ -258,8 +258,29 @@ def shard_col_ranges(num_scalar: int, num_shards: int) -> List[tuple]:
     return [(int(edges[k]), int(edges[k + 1])) for k in range(num_shards)]
 
 
+def row_shard_ranges(num_rows: int, num_shards: int) -> List[tuple]:
+    """Contiguous example-row ranges [(lo, hi), ...] of a
+    `num_shards`-way ROW sharding — the row-parallel counterpart of
+    shard_col_ranges, and likewise the one place the layout is defined
+    (cache creation, shard rebuild, streamed loads and the row-parallel
+    manager's fixed sum-merge order all call this)."""
+    if num_shards < 1:
+        raise ValueError(f"row_shards must be >= 1, got {num_shards}")
+    if num_shards > max(num_rows, 1):
+        raise ValueError(
+            f"row_shards={num_shards} exceeds the {num_rows} rows — "
+            "each shard needs at least one"
+        )
+    edges = np.linspace(0, num_rows, num_shards + 1).astype(np.int64)
+    return [(int(edges[k]), int(edges[k + 1])) for k in range(num_shards)]
+
+
 def _shard_file(k: int) -> str:
     return f"bins_shard_{k}.npy"
+
+
+def _row_shard_file(k: int) -> str:
+    return f"bins_rows_{k}.npy"
 
 
 # Live cache handles for the memory ledger's "dataset_cache" pull
@@ -318,6 +339,13 @@ class DatasetCache:
         #: the distributed-GBT workers each load exactly one slice
         #: (ydf_tpu/parallel/dist_gbt.py).
         self.feature_shards: int = int(meta.get("feature_shards", 0))
+        #: Row-shard count of the row-parallel layout (0 = unsharded).
+        #: Row shard k's file holds bins[lo:hi, :] (row_shard_ranges,
+        #: ALL feature columns) in the same integrity format; the
+        #: row-parallel workers stream it block-wise
+        #: (load_row_shard_streamed) so no full-matrix copy ever
+        #: materializes (ydf_tpu/parallel/dist_row.py).
+        self.row_shards: int = int(meta.get("row_shards", 0))
         self._meta = meta
         _OPEN_CACHES.add(self)  # memory-ledger "dataset_cache" source
         if verify != "off":
@@ -401,6 +429,146 @@ class DatasetCache:
                 "distributed training"
             )
         return self.feature_shards
+
+    def _require_row_shards(self) -> int:
+        if self.row_shards < 1:
+            raise ValueError(
+                f"dataset cache {self.path!r} was created without row "
+                "shards; recreate it with create_dataset_cache(..., "
+                "row_shards=N) for row-parallel distributed training"
+            )
+        return self.row_shards
+
+    def row_shard_range(self, k: int) -> tuple:
+        """(lo, hi) example-row range of row shard k."""
+        return row_shard_ranges(self.num_rows, self._require_row_shards())[k]
+
+    def load_row_shard_streamed(
+        self, k: int, col_range: Optional[tuple] = None,
+        verify: bool = True,
+    ) -> np.ndarray:
+        """Streamed, crc-verified load of row shard k: the shard file is
+        read ONCE, sequentially, in integrity-block-sized chunks; each
+        block's crc32 is checked as its bytes are CONSUMED (a mismatch
+        raises CacheCorruptionError before any of the block's rows can
+        reach a histogram), complete rows are copied straight into the
+        resident destination array, and — with `col_range=(lo, hi)`, the
+        hybrid row×feature case — only that column slice is kept. Peak
+        transient memory is one crc block (+ a sub-row carry), so a
+        worker's resident footprint is exactly its slice: the
+        `dist_shard` memory-ledger contract of row-parallel training
+        (~1/N of the single-machine bin matrix per worker). Caches
+        written before the integrity metadata verify nothing but still
+        stream."""
+        self._require_row_shards()
+        lo, hi = self.row_shard_range(k)
+        n_k = hi - lo
+        name = _row_shard_file(k)
+        path = os.path.join(self.path, name)
+        rec = (self._meta.get("integrity") or {}).get("files", {}).get(name)
+        if not os.path.isfile(path):
+            raise CacheCorruptionError(
+                f"row shard file {name!r} is missing"
+            )
+        if rec is not None and os.path.getsize(path) != rec["size"]:
+            raise CacheCorruptionError(
+                f"row shard file {name!r} is {os.path.getsize(path)} "
+                f"bytes, expected {rec['size']} (truncated)"
+            )
+        F = self.binner.num_scalar
+        clo, chi = (0, F) if col_range is None else col_range
+        out = np.empty((n_k, chi - clo), np.uint8)
+        row_bytes = F  # uint8 rows
+        with open(path, "rb") as f:
+            carry = b""
+            header_skipped = False
+            row = 0
+            block_idx = 0
+            while True:
+                block = f.read(_CRC_BLOCK)
+                if not block:
+                    break
+                if verify and rec is not None:
+                    crcs = rec["crc32"]
+                    if block_idx >= len(crcs) or (
+                        zlib.crc32(block) != crcs[block_idx]
+                    ):
+                        raise CacheCorruptionError(
+                            f"row shard {name!r} fails its checksum at "
+                            f"block {block_idx} (byte offset "
+                            f"{block_idx * _CRC_BLOCK}); rebuild it from "
+                            "bins.npy (DatasetCache.rebuild_row_shard)"
+                        )
+                block_idx += 1
+                buf = carry + block if carry else block
+                if not header_skipped:
+                    # npy header: magic + version + little-endian header
+                    # length; data starts right after. The first crc
+                    # block (4 MiB) always covers the whole header.
+                    if len(buf) < 10:
+                        carry = buf
+                        continue
+                    major = buf[6]
+                    if major >= 2:
+                        hlen = int.from_bytes(buf[8:12], "little")
+                        data_off = 12 + hlen
+                    else:
+                        hlen = int.from_bytes(buf[8:10], "little")
+                        data_off = 10 + hlen
+                    buf = buf[data_off:]
+                    header_skipped = True
+                nrows = min(len(buf) // row_bytes, n_k - row)
+                if nrows > 0:
+                    chunk = np.frombuffer(
+                        buf[: nrows * row_bytes], np.uint8
+                    ).reshape(nrows, F)
+                    out[row: row + nrows] = chunk[:, clo:chi]
+                    row += nrows
+                carry = buf[nrows * row_bytes:]
+        if row != n_k:
+            raise CacheCorruptionError(
+                f"row shard {name!r} yielded {row} rows, expected {n_k}"
+            )
+        return out
+
+    def rebuild_row_shard(self, k: int) -> None:
+        """Re-slices row shard k's file from the (verified) full
+        bins.npy — byte-identical, like rebuild_feature_shard; the
+        recovery path for a corrupt row shard."""
+        self._require_row_shards()
+        rec = (self._meta.get("integrity") or {}).get("files", {}).get(
+            "bins.npy"
+        )
+        if rec is not None:
+            _verify_file(
+                os.path.join(self.path, "bins.npy"), rec, full=True
+            )
+        lo, hi = self.row_shard_range(k)
+        full = self.bins
+        out = np.lib.format.open_memmap(
+            os.path.join(self.path, _row_shard_file(k)), mode="w+",
+            dtype=np.uint8, shape=(hi - lo, full.shape[1]),
+        )
+        step = max(1, (64 << 20) // max(full.shape[1], 1))
+        for r in range(lo, hi, step):
+            out[r - lo: min(r + step, hi) - lo] = full[
+                r: min(r + step, hi)
+            ]
+        out.flush()
+        del out
+        integ = self._meta.setdefault("integrity", {"files": {}})
+        integ["files"][_row_shard_file(k)] = _file_integrity(
+            os.path.join(self.path, _row_shard_file(k))
+        )
+        if telemetry.ENABLED:
+            telemetry.counter("ydf_cache_shard_rebuilds_total").inc()
+        from ydf_tpu.utils.snapshot import _durable_replace
+
+        meta_path = os.path.join(self.path, "cache_meta.json")
+        tmp = meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._meta, f)
+        _durable_replace(tmp, meta_path)
 
     def rebuild_feature_shard(self, k: int) -> None:
         """Re-slices shard k's file from the (verified) full bins.npy —
@@ -503,6 +671,7 @@ def create_dataset_cache(
     store_raw_numerical: bool = False,
     reuse: bool = False,
     feature_shards: int = 0,
+    row_shards: int = 0,
 ) -> DatasetCache:
     """Builds an on-disk binned cache from (sharded) CSV input, or from
     an in-memory columnar frame (pandas / polars DataFrame or dict of
@@ -533,7 +702,18 @@ def create_dataset_cache(
     training path AND the shard-rebuild source (a corrupt shard is
     re-sliced from it byte-identically,
     DatasetCache.rebuild_feature_shard). Labels/weights stay in their
-    single replicated files; every worker reads the same block."""
+    single replicated files; every worker reads the same block.
+
+    `row_shards=N` (N >= 1) writes the ROW-parallel layout
+    (docs/distributed_training.md "Row-parallel mode"): N row slices
+    `bins_rows_k.npy = bins[lo:hi, :]` per row_shard_ranges, every
+    feature column, each with its own per-block-crc32 integrity record.
+    Row-parallel workers stream these block-wise
+    (DatasetCache.load_row_shard_streamed) so a worker's resident
+    footprint is its slice, ~1/N of the bin matrix. Both shardings may
+    coexist on one cache: `row_shards=R, feature_shards=C` is the
+    hybrid row×feature layout (R row groups × C column groups; hybrid
+    workers stream a row slice and keep only their column range)."""
     if isinstance(data_path, str):
         fmt, _ = _split_typed_path(data_path)
         if fmt != "csv":
@@ -556,6 +736,9 @@ def create_dataset_cache(
         raise ValueError(
             f"feature_shards must be >= 0, got {feature_shards}"
         )
+    row_shards = int(row_shards)
+    if row_shards < 0:
+        raise ValueError(f"row_shards must be >= 0, got {row_shards}")
     os.makedirs(cache_dir, exist_ok=True)
 
     # Request fingerprint: identifies (source content proxy, requested
@@ -575,7 +758,8 @@ def create_dataset_cache(
                 chunk_rows, max_vocab_count, min_vocab_frequency,
                 ranking_group, uplift_treatment, label_event_observed,
                 label_entry_age, store_raw_numerical,
-            ) + ((feature_shards,) if feature_shards else ())).encode()
+            ) + ((feature_shards,) if feature_shards else ())
+              + (("rows", row_shards) if row_shards else ())).encode()
         ).hexdigest()
     if reuse and request_fp is not None:
         existing = _try_reuse_cache(cache_dir, request_fp)
@@ -842,6 +1026,24 @@ def create_dataset_cache(
             sm.flush()
             del sm
             shard_files.append(_shard_file(k))
+    if row_shards:
+        # Row-parallel slices: bins[lo:hi, :] per row_shard_ranges —
+        # written by row-block streaming like the column shards.
+        for k, (lo, hi) in enumerate(
+            row_shard_ranges(num_rows, int(row_shards))
+        ):
+            rm = np.lib.format.open_memmap(
+                os.path.join(cache_dir, _row_shard_file(k)), mode="w+",
+                dtype=np.uint8, shape=(hi - lo, F),
+            )
+            step = max(1, (64 << 20) // max(F, 1))
+            for r in range(lo, hi, step):
+                rm[r - lo: min(r + step, hi) - lo] = bins_mm[
+                    r: min(r + step, hi)
+                ]
+            rm.flush()
+            del rm
+            shard_files.append(_row_shard_file(k))
 
     # ---- finalize: integrity metadata + atomic publish -------------- #
     # The metadata is the cache's commit record: it is written LAST,
@@ -884,6 +1086,7 @@ def create_dataset_cache(
                 "extra_columns": extra_cols,
                 "store_raw_numerical": bool(raw_mm is not None),
                 "feature_shards": int(feature_shards),
+                "row_shards": int(row_shards),
                 "source": data_path if isinstance(data_path, str) else
                 "<in-memory frame>",
                 "integrity": integrity,

@@ -277,14 +277,17 @@ class GradientBoostedTreesLearner(GenericLearner):
         # via GSPMD sharding annotations (see ydf_tpu/parallel/mesh.py — the
         # TPU-native replacement of the reference's gRPC worker protocol).
         self.mesh = mesh
-        # Feature-parallel distributed training over the RPC worker
-        # substrate (reference distribute/ manager–worker protocol):
-        # "host:port" addresses of running `ydf_tpu.cli worker`
-        # processes. Requires training from a feature-sharded
-        # DatasetCache (create_dataset_cache(..., feature_shards=N));
-        # the manager reduces per-feature best splits and the model is
-        # bit-identical to the single-machine build
-        # (parallel/dist_gbt.py, docs/distributed_training.md).
+        # Distributed training over the RPC worker substrate
+        # (reference distribute/ manager–worker protocol): "host:port"
+        # addresses of running `ydf_tpu.cli worker` processes.
+        # Requires training from a sharded DatasetCache; the cache's
+        # layout selects the mode — feature_shards=N trains
+        # feature-parallel (parallel/dist_gbt.py), row_shards=N
+        # row-parallel with streamed shard loads, sum-merged
+        # histograms, and row-sharded validation / distributed early
+        # stopping (parallel/dist_row.py; both together = hybrid).
+        # Either way the model is bit-identical to the single-machine
+        # build (docs/distributed_training.md).
         self.distributed_workers = (
             list(distributed_workers) if distributed_workers else None
         )
@@ -380,7 +383,18 @@ class GradientBoostedTreesLearner(GenericLearner):
                 va_groups = np.asarray(
                     prep["valid_dataset"].data[self.ranking_group]
                 )
-        elif self.validation_ratio > 0 and self.early_stopping != "NONE":
+        elif (
+            self.validation_ratio > 0
+            and self.early_stopping != "NONE"
+            and not (self.distributed_workers and prep.get("cache"))
+        ):
+            # Distributed training from a cache skips this branch: the
+            # slice bins_all[tr_idx] would materialize the FULL bin
+            # matrix on the manager, defeating row-parallel memory
+            # scaling. The row-parallel entry point recomputes the
+            # identical deterministic split (same rng expressions) and
+            # ships index sets; feature-parallel still rejects
+            # validation with its targeted error.
             rng = np.random.RandomState(self.random_seed)
             if group_values is not None:
                 uniq = np.unique(group_values)
@@ -856,7 +870,13 @@ class GradientBoostedTreesLearner(GenericLearner):
         _t_fin = time.perf_counter()
         train_losses = np.asarray(logs["train_loss"])
         valid_losses = np.asarray(logs["valid_loss"])
-        has_valid = bins_va.shape[0] > 0
+        has_valid = bins_va.shape[0] > 0 or bool(
+            # Row-parallel distributed training row-shards the
+            # validation split onto the workers (bins_va never
+            # materializes here); its real per-iteration valid losses
+            # ride logs["valid_loss"] and drive the same argmin trim.
+            logs.get("distributed", {}).get("has_valid")
+        )
         if has_valid and self.early_stopping != "NONE":
             best_iter = int(np.argmin(valid_losses))
             num_iters = best_iter + 1
@@ -2148,13 +2168,19 @@ def _train_gbt_distributed(
     learner, prep, *, nv_rows, loss_obj, rule, tree_cfg, candidate_features,
     obl_P, vs_Pv, set_tr,
 ):
-    """Feature-parallel distributed training entry point: validates
-    the configuration down to the supported core (the bench family's
-    shape: K = 1 loss, RANDOM sampling, axis-aligned splits, no
-    validation split — everything else raises with the knob to flip),
-    then hands off to parallel/dist_gbt.DistGBTManager. Returns the
-    exact (stacked trees, leaf values, logs) layout _train_gbt
-    produces, so the model-assembly tail in train() is shared."""
+    """Distributed training entry point. The mode comes from the
+    cache's shard layout: `row_shards=N` selects ROW-parallel training
+    (parallel/dist_row.py — additive histogram sum-merge, streamed
+    shard loads, row-sharded validation with distributed early
+    stopping; `feature_shards=C > 1` on the same cache makes it hybrid
+    row×feature), a plain `feature_shards=N` cache keeps the
+    feature-parallel manager (parallel/dist_gbt.py). Validates the
+    configuration down to the supported core (K = 1 loss, RANDOM
+    sampling, axis-aligned splits — everything else raises with the
+    knob to flip; feature-parallel additionally rejects a validation
+    split), then hands off. Returns the exact (stacked trees, leaf
+    values, logs) layout _train_gbt produces, so the model-assembly
+    tail in train() is shared."""
     from ydf_tpu.dataset.cache import DatasetCache  # noqa: F401
     from ydf_tpu.ops.histogram import (
         resolve_hist_impl,
@@ -2162,27 +2188,36 @@ def _train_gbt_distributed(
         resolve_hist_subtract,
     )
     from ydf_tpu.parallel.dist_gbt import DistGBTManager
+    from ydf_tpu.parallel.dist_row import RowDistGBTManager
     from ydf_tpu.parallel.worker_service import WorkerPool
 
     cache = prep.get("cache")
     if cache is None:
         raise ValueError(
-            "distributed_workers= requires training from a feature-"
-            "sharded DatasetCache: create_dataset_cache(..., "
-            "feature_shards=N), then train(cache)"
+            "distributed_workers= requires training from a sharded "
+            "DatasetCache: create_dataset_cache(..., feature_shards=N) "
+            "or create_dataset_cache(..., row_shards=N), then "
+            "train(cache)"
         )
-    if cache.feature_shards < 1:
+    row_mode = getattr(cache, "row_shards", 0) > 0
+    if not row_mode and cache.feature_shards < 1:
         raise ValueError(
-            f"dataset cache {cache.path!r} has no feature shards; "
-            "recreate it with create_dataset_cache(..., "
-            f"feature_shards={len(learner.distributed_workers)})"
+            f"dataset cache {cache.path!r} has no shards; recreate it "
+            "with create_dataset_cache(..., "
+            f"feature_shards={len(learner.distributed_workers)}) or "
+            f"row_shards={len(learner.distributed_workers)}"
         )
+    wants_valid = (
+        learner.validation_ratio > 0 and learner.early_stopping != "NONE"
+    )
     unsupported = []
-    if nv_rows > 0:
+    if (nv_rows > 0 or wants_valid) and not row_mode:
         unsupported.append(
             "a validation split (set early_stopping='NONE' or "
-            "validation_ratio=0.0 — distributed early stopping is not "
-            "implemented)"
+            "validation_ratio=0.0 — feature-parallel training has no "
+            "validation routing; a row-sharded cache "
+            "(create_dataset_cache(..., row_shards=N)) supports "
+            "distributed early stopping)"
         )
     if loss_obj.num_dims != 1:
         unsupported.append(
@@ -2217,8 +2252,7 @@ def _train_gbt_distributed(
         )
     binner = prep["binner"]
     pool = WorkerPool(list(learner.distributed_workers))
-    mgr = DistGBTManager(
-        pool, cache,
+    common = dict(
         loss_obj=loss_obj, rule=rule, tree_cfg=tree_cfg,
         num_trees=learner.num_trees, shrinkage=learner.shrinkage,
         subsample=learner.subsample,
@@ -2229,6 +2263,30 @@ def _train_gbt_distributed(
         hist_subtract=resolve_hist_subtract(None),
         hist_quant=resolve_hist_quant(None),
     )
+    if row_mode:
+        # Deterministic train/validation split — the EXACT expressions
+        # of the single-machine branch in train() (which distributed
+        # cache training skips so the bin matrix never materializes on
+        # the manager): same seed, same permutation, same index sets.
+        tr_idx = va_idx = None
+        if wants_valid:
+            n = cache.num_rows
+            rng = np.random.RandomState(learner.random_seed)
+            perm = rng.permutation(n)
+            nv = min(max(int(n * learner.validation_ratio), 1), n - 1)
+            va_idx, tr_idx = perm[:nv], perm[nv:]
+        mgr = RowDistGBTManager(
+            pool, cache, tr_idx=tr_idx, va_idx=va_idx,
+            early_stop_lookahead=(
+                learner.early_stopping_num_trees_look_ahead
+                if learner.early_stopping == "LOSS_INCREASE"
+                and va_idx is not None
+                else 0
+            ),
+            **common,
+        )
+    else:
+        mgr = DistGBTManager(pool, cache, **common)
     with _flight_guard():
         return mgr.train()
 

@@ -254,6 +254,10 @@ class _DistStats:
         self.stats_bytes = 0
         self.recoveries = 0
         self.shard_rebuilds = 0
+        # Manager-side histogram merge wall (row-parallel sum-merge /
+        # feature-parallel concat), summed over all layers — the
+        # dist_merge_s headline bench field.
+        self.merge_ns = 0
         # Per-layer wall attribution (compute / network / straggler
         # wait, summed over all layers of the run): the "was that layer
         # slow because of compute, the network, or one straggler?"
@@ -280,6 +284,26 @@ class _DistStats:
             telemetry.histogram(
                 "ydf_dist_rpc_latency_ns", verb=verb
             ).observe_ns(dur_ns)
+
+    def observe_merge(self, dur_ns: int) -> None:
+        self.merge_ns += int(dur_ns)
+        if telemetry.ENABLED:
+            telemetry.counter("ydf_dist_merge_ns_total").inc(int(dur_ns))
+
+    def drop_worker_shards(self, addr: str) -> None:
+        """Shard-fleet accounting on migration: a quarantined worker's
+        resident-bytes report leaves the fleet total the moment its
+        shards move (the replacement's load response re-adds them).
+        Without this, `dist_shard_fleet` summed every load response
+        ever seen — a run with one migration double-counted the moved
+        shards, and a corrupt-shard rebuild's reload stacked a third
+        copy."""
+        if self.shard_bytes.pop(addr, None) is not None and (
+            telemetry.ENABLED
+        ):
+            telemetry.mem_set(
+                "dist_shard_fleet", sum(self.shard_bytes.values())
+            )
 
     def observe_layer(
         self, wall_ns: int, hist_rpcs: Dict[int, Tuple[int, Optional[int]]]
@@ -328,6 +352,7 @@ class _DistStats:
             "stats_bytes": int(self.stats_bytes),
             "recoveries": int(self.recoveries),
             "shard_rebuilds": int(self.shard_rebuilds),
+            "merge_s": round(self.merge_ns / 1e9, 6),
             "layer_wall_s": round(self.layer_wall_ns / 1e9, 6),
             "compute_s": round(self.compute_ns / 1e9, 6),
             "net_s": round(self.net_ns / 1e9, 6),
@@ -493,6 +518,7 @@ class DistGBTManager:
                 )
                 self.pool.mark_failed(widx)
                 self.stats.recoveries += 1
+                self.stats.drop_worker_shards(self.pool.addr_str(widx))
                 widx = self._pick_replacement(widx + 1)
                 continue
             if resp.get("ok"):
@@ -570,6 +596,10 @@ class DistGBTManager:
         is really gone costs a bounded timeout)."""
         self.pool.mark_failed(widx)
         self.stats.recoveries += 1
+        # The quarantined worker's resident-bytes report leaves the
+        # shard-fleet ledger now — its shards are about to live on the
+        # replacement, whose load response re-adds them.
+        self.stats.drop_worker_shards(self.pool.addr_str(widx))
         if telemetry.ENABLED:
             telemetry.counter("ydf_dist_recoveries_total").inc()
             self._drain_worker_telemetry([widx], timeout_s=5.0)
@@ -963,9 +993,11 @@ class DistGBTManager:
                     on_hist,
                     rpc_record=hist_rpcs,
                 )
+                t_m0 = time.perf_counter_ns()
                 hist_np = np.concatenate(
                     [slices[k] for k in range(self.num_shards)], axis=1
                 )  # [num_slots, F, B, S] — shard order == feature order
+                self.stats.observe_merge(time.perf_counter_ns() - t_m0)
 
                 if sub_state is not None:
                     parent_hist, small_is_left, Lh = sub_state

@@ -1,0 +1,790 @@
+"""Row-parallel (and hybrid row×feature) distributed GBT training.
+
+Feature-parallel training (parallel/dist_gbt.py) is YDF-faithful but
+caps at "every worker holds all rows of its columns" — the largest
+trainable dataset is bounded by one machine's bin-matrix memory. This
+manager shards the EXAMPLE axis instead, the design XGBoost-GPU
+(arXiv:1806.11248) and TF Boosted Trees (arXiv:1710.11555) use to scale
+rows: histograms are additive over rows, so worker k holds a row slice
+of ALL features (streamed crc-verified from the cache's
+`bins_rows_k.npy`, ~1/N of the bin matrix resident per worker), answers
+`row_histograms` with a full-width [num_slots, F, B, S] PARTIAL over
+its rows, and the manager merges by summation in fixed row-group order
+before feeding the unchanged grower seam (`ops/grower.py:layer_decide`).
+
+The sum-merge contract (docs/distributed_training.md "Row-parallel
+mode" has the full argument):
+
+  * Partials ride the wire in the ACCUMULATION domain — f64 per cell,
+    computed by each worker as deterministic fixed-chunk scatter-adds
+    (dist_worker._accum_partial) — and the manager folds them in
+    ascending row-group order with ONE final conversion to the f32
+    histogram the grower consumes.
+  * Under YDF_TPU_HIST_QUANT=int8 every per-row stat is an integer grid
+    point, every partial and merged cell is an integer below 2^53, and
+    f64 arithmetic on such integers is exact — the merge is therefore
+    associative and the row-parallel model is BIT-IDENTICAL to the
+    single-machine grower by the same integer argument that makes the
+    native q8 kernel thread-count-stable.
+  * f32 / bf16x2 keep the fixed-order f64 fold: the result is
+    bit-STABLE (a pure function of the shard layout — worker count,
+    placement, recovery and chaos schedules cannot change a bit), and
+    matches the single-machine histogram whenever the near-exact f64
+    accumulations round to the same f32 — measured identical on the
+    test and bench shapes under the native f32 kernel, with the honest
+    association-analysis in the docs.
+
+Routing is the inverse of the feature-parallel exchange: each worker
+owns ALL features of its rows, so there is NO per-layer bitmap
+broadcast — the manager ships only the layer's decision tables and
+every worker routes its own rows locally (exact integer bookkeeping,
+`dist_worker.apply_route_tables`). Hybrid row×feature sharding
+(row_shards=R, feature_shards=C on one cache) composes the two modes:
+units (r, c) answer column-slice partials, merge = concat-of-sums, and
+routing falls back to the feature-parallel owner-bitmap exchange WITHIN
+each row group (`row_apply_split`).
+
+Validation rows are row-sharded too: each worker's slice carries its
+validation rows (trash-slotted out of every histogram, routed through
+the same tables), and the tree-end `route_validation` verb returns the
+slice's leaf assignment — the manager assembles per-tree validation
+predictions/losses with the single-machine op sequence, enabling
+distributed early stopping (same stop iteration as the single-machine
+early-stop driver, mirrored chunk boundaries and all).
+
+Recovery rides the round-10/13 machinery with REPLAY-based state: the
+manager keeps this tree's route history (tables + hybrid bitmaps); a
+lost worker's units move to a healthy worker which re-streams the row
+shard and replays the history — deterministic integer routing, so the
+replacement lands in exactly the lost worker's state, replay-safe via
+the same (tree, layer) stamps.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ydf_tpu.utils import log, telemetry
+from ydf_tpu.parallel.dist_gbt import (
+    DistGBTManager,
+    DistributedTrainingError,
+    _DistStats,
+    _RPC_TIMEOUT_S,
+    _VERIFY,
+    _j_init,
+    _j_layer_step,
+    _j_sibling_reconstruct,
+    _j_tree_epilogue,
+    _j_tree_prologue,
+    _pad_to,
+)
+
+
+@functools.partial(jax.jit, static_argnames=("loss_obj",))
+def _j_valid_update(vleaves, lv, vpreds, y_va, w_va, *, loss_obj):
+    """Per-tree validation update — the same op sequence as the
+    single-machine boost_step's K == 1 unfused validation path
+    (learners/gbt.py: new_vcontrib gather → vpreds add → loss), so the
+    distributed per-iteration validation losses match the single-machine
+    driver's."""
+    nv = vleaves.shape[0]
+    new_vcontrib = jnp.zeros((nv, 1), jnp.float32)
+    new_vcontrib = new_vcontrib.at[:, 0].set(lv[vleaves, 0])
+    vpreds = vpreds + new_vcontrib
+    # The loss value matches the single-machine driver's to within one
+    # ulp: the scalar reduction compiles inside two different XLA
+    # programs (the boost_step scan there, this standalone jit here)
+    # whose reduction splits are compiler whim — the same class of
+    # unpinnable contraction choice docs/row_routing.md documents for
+    # K > 1 losses. vpreds itself, the models, and the train losses
+    # are exact; only the reported valid-loss scalar can sit one
+    # rounding step away on occasional iterations.
+    vl = loss_obj.loss(y_va, vpreds, w_va, tag="valid")
+    return vpreds, vl
+
+
+class RowDistGBTManager(DistGBTManager):
+    """Drives one row-parallel (C == 1) or hybrid (C > 1) distributed
+    GBT train over a WorkerPool + row-sharded DatasetCache. Reuses the
+    feature-parallel manager's RPC plumbing (fan-out, retry/reassign,
+    telemetry drain) wholesale; the training loop, merge, and state
+    model are row-parallel (module docstring)."""
+
+    def __init__(
+        self, pool, cache, *, loss_obj, rule, tree_cfg, num_trees: int,
+        shrinkage: float, subsample: float, candidate_features: int,
+        num_numerical: int, seed: int, hist_impl: str,
+        hist_subtract: bool, hist_quant: str,
+        min_split_gain: float = 1e-9,
+        rpc_timeout_s: Optional[float] = None,
+        verify: Optional[bool] = None,
+        tr_idx: Optional[np.ndarray] = None,
+        va_idx: Optional[np.ndarray] = None,
+        early_stop_lookahead: int = 0,
+    ):
+        from ydf_tpu.dataset.cache import (
+            row_shard_ranges,
+            shard_col_ranges,
+        )
+
+        # Deliberately NOT calling super().__init__: it requires the
+        # feature-shard layout. The RPC plumbing reused from the base
+        # class only needs the fields set here.
+        self.pool = pool
+        self.cache = cache
+        self.loss_obj = loss_obj
+        self.rule = rule
+        self.cfg = tree_cfg
+        self.num_trees = num_trees
+        self.shrinkage = float(shrinkage)
+        self.subsample = float(subsample)
+        self.candidate_features = int(candidate_features)
+        self.seed = seed
+        self.hist_impl = hist_impl
+        self.hist_subtract = bool(hist_subtract)
+        self.hist_quant = hist_quant
+        self.min_split_gain = float(min_split_gain)
+        self.rpc_timeout_s = (
+            _RPC_TIMEOUT_S if rpc_timeout_s is None else rpc_timeout_s
+        )
+        self.verify = _VERIFY if verify is None else verify
+
+        self.R = cache._require_row_shards()
+        self.C = cache.feature_shards if cache.feature_shards > 1 else 1
+        self.n = cache.num_rows
+        self.F = cache.binner.num_scalar
+        self.Fn = int(num_numerical)
+        self.Fc = self.F - self.Fn
+        self.row_ranges = row_shard_ranges(self.n, self.R)
+        self.col_ranges = shard_col_ranges(self.F, self.C)
+        self.num_units = self.R * self.C
+        self.key_id = f"distrow-{uuid.uuid4().hex[:12]}"
+        self.owner: List[int] = [
+            u % len(pool.addresses) for u in range(self.num_units)
+        ]
+        self.stats = _DistStats()
+
+        # Deterministic train/validation row split (cache-row index
+        # sets, identical expressions to the learner's single-machine
+        # split) — validation rows ride the worker slices, the manager
+        # holds only O(n) label/pred vectors.
+        self.tr_idx = (
+            np.arange(self.n, dtype=np.int64)
+            if tr_idx is None else np.asarray(tr_idx, np.int64)
+        )
+        self.va_idx = (
+            np.zeros((0,), np.int64)
+            if va_idx is None else np.asarray(va_idx, np.int64)
+        )
+        self.early_stop_lookahead = int(early_stop_lookahead)
+        # Current-tree recovery state: stats slices by unit id + the
+        # applied route history (tables [+ hybrid bitmaps]).
+        self._stats_by_unit: Dict[int, np.ndarray] = {}
+        self._route_history: List[Dict[str, Any]] = []
+        self._cur_tree = -1
+
+    # ---- unit geometry ------------------------------------------------ #
+
+    def _unit_spec(self, uid: int) -> Dict[str, Any]:
+        r, c = uid // self.C, uid % self.C
+        return {
+            "uid": uid, "r": r, "c": c,
+            "row_range": self.row_ranges[r],
+            "col_range": self.col_ranges[c],
+        }
+
+    def _unit_valid_local(self, uid: int) -> Optional[np.ndarray]:
+        if self.va_idx.size == 0:
+            return None
+        lo, hi = self.row_ranges[uid // self.C]
+        va = self.va_idx[(self.va_idx >= lo) & (self.va_idx < hi)]
+        return (va - lo).astype(np.int32)
+
+    # ---- shard placement / recovery (overrides) ----------------------- #
+
+    def _state_payload(self) -> Dict[str, Any]:
+        return {
+            "tree": self._cur_tree,
+            "stats": dict(self._stats_by_unit),
+            "replay": list(self._route_history),
+        }
+
+    def _load_shards(self, widx: int, uids: List[int],
+                     with_state: bool) -> int:
+        """Places units on a worker: the worker streams each row shard
+        crc-block-wise (corrupt slices surface as `corrupt` and are
+        re-sliced from bins.npy byte-identically); recovery re-ships the
+        current tree's stats + route history for replay."""
+        rebuilt = False
+        for _attempt in range(self.pool.retry_attempts):
+            req = {
+                "verb": "load_row_shard", "key": self.key_id,
+                "cache_dir": self.cache.path,
+                "layout": {
+                    "rows": self.n, "row_shards": self.R,
+                    "col_shards": self.C,
+                },
+                "units": [self._unit_spec(u) for u in uids],
+                "valid_rows": {
+                    u: self._unit_valid_local(u) for u in uids
+                },
+            }
+            if with_state:
+                req["state"] = {
+                    "tree": self._cur_tree,
+                    "stats": {
+                        u: self._stats_by_unit.get(u) for u in uids
+                    },
+                    "replay": list(self._route_history),
+                }
+            try:
+                resp = self._request(
+                    widx, self._stamp(req, widx), "dist.shard_load"
+                )
+            except (OSError, ConnectionError) as e:
+                log.debug(
+                    f"dist row: shard load on {self.pool.addr_str(widx)} "
+                    f"failed ({e}); reassigning"
+                )
+                self.pool.mark_failed(widx)
+                self.stats.recoveries += 1
+                self.stats.drop_worker_shards(self.pool.addr_str(widx))
+                widx = self._pick_replacement(widx + 1)
+                continue
+            if resp.get("ok"):
+                self.pool.mark_ok(widx)
+                for u in uids:
+                    self.owner[u] = widx
+                self._note_shard_load(widx, resp)
+                return widx
+            if resp.get("corrupt") and not rebuilt:
+                log.info(
+                    f"dist row: row shard(s) for units {uids} corrupt on "
+                    f"load ({resp.get('error')}); rebuilding from bins.npy"
+                )
+                if telemetry.ENABLED:
+                    telemetry.counter(
+                        "ydf_dist_shard_corruption_total"
+                    ).inc()
+                for u in sorted({u // self.C for u in uids}):
+                    self.cache.rebuild_row_shard(u)
+                self.stats.shard_rebuilds += len(
+                    {u // self.C for u in uids}
+                )
+                rebuilt = True
+                continue
+            raise DistributedTrainingError(
+                f"worker {self.pool.addr_str(widx)} failed row shard "
+                f"load: {resp}"
+            )
+        raise DistributedTrainingError(
+            f"could not place units {uids} on any worker within "
+            f"{self.pool.retry_attempts} attempts"
+        )
+
+    # ---- merge -------------------------------------------------------- #
+
+    def _merge_partials(
+        self, partials: Dict[int, np.ndarray], qscale: Optional[np.ndarray]
+    ) -> np.ndarray:
+        """Fixed-order sum-merge: per column group, fold the f64
+        partials in ASCENDING ROW-GROUP order (left fold — the
+        reduction order is a pure function of the shard layout, so the
+        result is bit-stable across worker counts, placements and
+        recoveries), finalize ONCE to the grower's f32 domain, and
+        concatenate column groups in order. The finalization mirrors
+        the single-machine expressions exactly (int8: f32 cast of the
+        exact integer totals × pow2 scale; bf16x2: f32 casts then the
+        hi + lo fold; f32: one f32 cast)."""
+        t0 = time.perf_counter_ns()
+        cols = []
+        for c in range(self.C):
+            acc = None
+            for r in range(self.R):
+                p = partials[r * self.C + c]
+                acc = p if acc is None else acc + p
+            if self.hist_quant == "int8":
+                out = acc.astype(np.float32) * np.asarray(
+                    qscale, np.float32
+                )[None, None, None, :]
+            elif self.hist_quant == "bf16x2":
+                m32 = acc.astype(np.float32)
+                S = m32.shape[-1] // 2
+                out = m32[..., :S] + m32[..., S:]
+            else:
+                out = acc.astype(np.float32)
+            cols.append(out)
+        merged = (
+            cols[0] if self.C == 1 else np.concatenate(cols, axis=1)
+        )
+        self.stats.observe_merge(time.perf_counter_ns() - t0)
+        return merged
+
+    # ---- the training loop -------------------------------------------- #
+
+    def train(self):
+        """Runs the row-parallel boosting loop; returns (stacked
+        TreeArrays [T, 1, ...], leaf_values [T, 1, N, 1], logs) in the
+        exact layout learners/gbt.py:_train_gbt produces, including
+        real per-iteration validation losses when a validation split is
+        configured (distributed early stopping)."""
+        cfg = self.cfg
+        L, B, N = cfg.frontier, cfg.num_bins, cfg.max_nodes
+        D = cfg.max_depth
+        S = self.rule.num_stats
+        labels = np.asarray(self.cache.labels)
+        w = self.cache.sample_weights
+        w_all = (
+            np.asarray(w, np.float32) if w is not None
+            else np.ones((self.n,), np.float32)
+        )
+        nv = int(self.va_idx.size)
+        y_tr = jnp.asarray(labels[self.tr_idx])
+        w_tr = jnp.asarray(w_all[self.tr_idx])
+        n_tr = int(self.tr_idx.size)
+
+        t0_ns = time.perf_counter_ns()
+        self.pool.ping_all(drop_unreachable=True)
+        self.owner = [
+            u % len(self.pool.addresses) for u in range(self.num_units)
+        ]
+        for widx, uids in self._groups(range(self.num_units)).items():
+            self._load_shards(widx, uids, with_state=False)
+
+        preds, init_pred = _j_init(
+            y_tr, w_tr, loss_obj=self.loss_obj, n=n_tr
+        )
+        vpreds = y_va = w_va = None
+        if nv > 0:
+            y_va = jnp.asarray(labels[self.va_idx])
+            w_va = jnp.asarray(w_all[self.va_idx])
+            # Mirrors _make_boost_fn._init's vpreds0 (exact broadcast).
+            vpreds = jnp.broadcast_to(
+                init_pred[None, :], (nv, 1)
+            ).astype(jnp.float32)
+        key = jax.random.PRNGKey(self.seed)
+        trees_acc: List[Dict[str, np.ndarray]] = []
+        lvs_acc: List[np.ndarray] = []
+        tls: List[float] = []
+        vls: List[float] = []
+
+        # In-loop early stopping mirrors the single-machine early-stop
+        # driver EXACTLY: same eligibility guard, same chunk length,
+        # same stop predicate at the same chunk boundaries — so the
+        # distributed run trains the same number of trees.
+        lookahead = self.early_stop_lookahead
+        use_stop = (
+            lookahead > 0 and nv > 0 and self.num_trees > lookahead
+        )
+        clen = max(1, min(lookahead or 25, 25))
+
+        it = 0
+        while it < self.num_trees:
+            with telemetry.span("dist.tree") as sp:
+                if telemetry.ENABLED:
+                    sp.set(iteration=it)
+                preds, vpreds, key, tree_np, lv, tl, vl = (
+                    self._train_tree_row(
+                        it, key, preds, vpreds, y_tr, w_tr, y_va, w_va,
+                        L, B, N, D, S,
+                    )
+                )
+            trees_acc.append(tree_np)
+            lvs_acc.append(np.asarray(lv))
+            tls.append(float(tl))
+            vls.append(float(vl) if vl is not None else 0.0)
+            if log.is_debug():
+                log.debug(
+                    f"dist row gbt: iter {it + 1}/{self.num_trees} "
+                    f"train_loss={tls[-1]:.6g}"
+                    + (f" valid_loss={vls[-1]:.6g}" if nv > 0 else "")
+                )
+            it += 1
+            if use_stop and it % clen == 0:
+                from ydf_tpu.learners.gbt import _early_stop_hit
+
+                if _early_stop_hit(
+                    [np.asarray(vls, np.float32)],
+                    min(it, self.num_trees), lookahead,
+                ):
+                    break
+
+        self._drain_worker_telemetry()
+        wall_ns = time.perf_counter_ns() - t0_ns
+        from ydf_tpu.ops.grower import TreeArrays
+
+        T = len(trees_acc)
+
+        def stack(field):
+            return jnp.asarray(
+                np.stack([t[field] for t in trees_acc])[:, None]
+            )  # [T, K=1, ...]
+
+        forest_stacked = TreeArrays(
+            feature=stack("feature"),
+            threshold_bin=stack("threshold_bin"),
+            is_cat=stack("is_cat"),
+            is_set=stack("is_set"),
+            cat_mask=stack("cat_mask"),
+            left=stack("left"),
+            right=stack("right"),
+            is_leaf=stack("is_leaf"),
+            leaf_stats=stack("leaf_stats"),
+            num_nodes=jnp.asarray(
+                np.asarray([t["num_nodes"] for t in trees_acc])[:, None]
+            ),
+        )
+        leaf_values = jnp.asarray(np.stack(lvs_acc)[:, None])
+        shard_rows = max(hi - lo for lo, hi in self.row_ranges)
+        logs = {
+            "train_loss": np.asarray(tls, np.float32),
+            "valid_loss": np.asarray(vls, np.float32),
+            "initial_predictions": np.asarray(init_pred),
+            "oblique_w": np.zeros((T, 0, 0), np.float32),
+            "oblique_b": np.zeros((T, 0, B - 1), np.float32),
+            "vs_a": np.zeros((T, 0, 0), np.float32),
+            "vs_b": np.zeros((T, 0, 0), np.float32),
+            "chunk_walls": [(0, T, t0_ns, wall_ns)],
+            "distributed": {
+                "workers": len(self.pool.addresses),
+                "mode": "hybrid" if self.C > 1 else "row",
+                "row_shards": self.R,
+                "col_shards": self.C,
+                "shard_rows": int(shard_rows),
+                "has_valid": nv > 0,
+                "valid_rows": nv,
+                "hist_quant": self.hist_quant,
+                **self.stats.summary(),
+            },
+        }
+        return forest_stacked, leaf_values, logs
+
+    def _train_tree_row(
+        self, it, key, preds, vpreds, y_tr, w_tr, y_va, w_va,
+        L, B, N, D, S,
+    ):
+        key, kk, hist_stats, qscale, total = _j_tree_prologue(
+            y_tr, w_tr, preds, key, it,
+            loss_obj=self.loss_obj, subsample=self.subsample,
+            hist_quant=self.hist_quant,
+        )
+        qscale_np = None if qscale is None else np.asarray(qscale)
+        # Scatter the train-order stats onto cache-row order (zeros at
+        # validation rows — structurally dropped by the trash slot, so
+        # they contribute nothing to any cell in any quant mode), then
+        # slice per row group: each worker receives ITS rows' grid, not
+        # the full-n broadcast the feature-parallel exchange pays.
+        hs_tr = np.asarray(hist_stats)
+        stats_cache = np.zeros((self.n,) + hs_tr.shape[1:], hs_tr.dtype)
+        stats_cache[self.tr_idx] = hs_tr
+        self._cur_tree = it
+        self._route_history = []
+        self._stats_by_unit = {}
+        for uid in range(self.num_units):
+            lo, hi = self.row_ranges[uid // self.C]
+            self._stats_by_unit[uid] = stats_cache[lo:hi]
+            self.stats.stats_bytes += self._stats_by_unit[uid].nbytes
+        if telemetry.ENABLED:
+            telemetry.counter("ydf_dist_stats_bytes_total").inc(
+                stats_cache.nbytes
+            )
+        total_np = np.asarray(total)
+
+        i32 = np.int32
+        W_words = (B + 31) // 32
+        tree = {
+            "feature": np.full((N + 1,), -1, i32),
+            "threshold_bin": np.zeros((N + 1,), i32),
+            "is_cat": np.zeros((N + 1,), bool),
+            "is_set": np.zeros((N + 1,), bool),
+            "cat_mask": np.zeros((N + 1, W_words), np.uint32),
+            "left": np.zeros((N + 1,), i32),
+            "right": np.zeros((N + 1,), i32),
+            "is_leaf": np.ones((N + 1,), bool),
+            "leaf_stats": np.zeros((N + 1, S), np.float32),
+        }
+        tree["leaf_stats"][0] = total_np
+        frontier_id = np.full((L + 1,), N, i32)
+        frontier_id[0] = 0
+        node_stats = np.zeros((L + 1, S), np.float32)
+        node_stats[0] = total_np
+        num_nodes = jnp.asarray(1, jnp.int32)
+        sub_state = None
+        pending_route = None
+        key_t = kk
+
+        for depth in range(D):
+            t_layer0 = time.perf_counter_ns()
+            hist_rpcs: Dict[int, Any] = {}
+            with telemetry.span("dist.layer") as lsp:
+                if telemetry.ENABLED:
+                    lsp.set(tree=it, layer=depth)
+                key_t, k_gain, k_feat = jax.random.split(
+                    jax.random.fold_in(key_t, depth), 3
+                )
+                children = depth + 1 < D
+                Ld = min(2 ** depth, L)
+                if sub_state is not None:
+                    _ph, _sil, Lh = sub_state
+                    num_slots = Lh
+                else:
+                    num_slots = Ld
+
+                # ---- 1. partial-histogram gather (all units) ------- #
+                base_req = {
+                    "verb": "row_histograms", "key": self.key_id,
+                    "tree": it, "layer": depth, "reset": depth == 0,
+                    "num_slots": num_slots, "num_bins": B,
+                    "quant": self.hist_quant,
+                }
+                if pending_route is not None:
+                    base_req["route"] = pending_route
+
+                partials: Dict[int, np.ndarray] = {}
+
+                def on_hist(widx, group, resp, _p=partials):
+                    for u, h in resp["hists"].items():
+                        _p[int(u)] = h
+                        self.stats.reduce_bytes += h.nbytes
+                    if telemetry.ENABLED:
+                        telemetry.counter(
+                            "ydf_dist_reduce_bytes_total"
+                        ).inc(
+                            sum(h.nbytes for h in resp["hists"].values())
+                        )
+
+                def make_req(uids, _r=base_req):
+                    req = {**_r, "shards": uids}
+                    if depth == 0:
+                        req["stats"] = {
+                            u: self._stats_by_unit[u] for u in uids
+                        }
+                    return req
+
+                self._exchange(
+                    list(range(self.num_units)), make_req,
+                    "dist.histogram_rpc", on_hist,
+                    rpc_record=hist_rpcs,
+                )
+                hist_np = self._merge_partials(partials, qscale_np)
+
+                if sub_state is not None:
+                    parent_hist, small_is_left, Lh = sub_state
+                    hist = _j_sibling_reconstruct(
+                        jnp.asarray(hist_np), parent_hist, small_is_left,
+                        Ld=Ld,
+                    )
+                else:
+                    hist = jnp.asarray(hist_np)
+
+                # ---- 2. split search (the grower's shared seam) ---- #
+                out = _j_layer_step(
+                    hist, jnp.asarray(node_stats[:Ld]),
+                    jnp.asarray(frontier_id[:Ld] < N),
+                    jnp.asarray(frontier_id[:Ld]), num_nodes,
+                    k_gain, k_feat,
+                    rule=self.rule, L=L, B=B, N=N, Fn=self.Fn,
+                    Fc=self.Fc,
+                    O=1, min_examples=self.cfg.min_examples,
+                    min_split_gain=self.min_split_gain,
+                    candidate_features=self.candidate_features,
+                    num_valid_features=None, children=children,
+                    subtract=self.hist_subtract,
+                )
+                dec = out["dec"]
+                num_nodes = dec.num_nodes
+                do_split = np.asarray(dec.do_split)
+                split_rank = np.asarray(dec.split_rank)
+                wid = np.asarray(dec.wid)
+                left_id = np.asarray(dec.left_id)
+                right_id = np.asarray(dec.right_id)
+                left_stats = np.asarray(dec.left_stats)
+                right_stats = np.asarray(dec.right_stats)
+                route_f = np.asarray(dec.route_f)
+                go_left_bins = np.asarray(dec.go_left_bins)
+
+                # ---- 3. node writes (manager-side tree arrays) ----- #
+                tree["feature"][wid] = np.asarray(dec.best_f_store)
+                tree["threshold_bin"][wid] = np.asarray(dec.best_t)
+                tree["is_cat"][wid] = np.asarray(dec.is_cat_split)
+                tree["is_set"][wid] = np.asarray(dec.is_set_split)
+                tree["cat_mask"][wid] = np.asarray(out["mask"])
+                tree["left"][wid] = left_id
+                tree["right"][wid] = right_id
+                tree["is_leaf"][wid] = False
+                tree["leaf_stats"][left_id] = left_stats
+                tree["leaf_stats"][right_id] = right_stats
+                tree["feature"][N] = -1
+                tree["is_leaf"][N] = True
+
+                # ---- 4. routing tables (NO bitmap broadcast in pure
+                # row mode — workers route their own rows from these
+                # tables; hybrid gathers owner bitmaps per row group) - #
+                hmap_np = (
+                    np.asarray(out["hmap"]) if "hmap" in out
+                    else np.arange(L + 1, dtype=i32)
+                )
+                tables = {
+                    "L": L, "children": children,
+                    "do_split": _pad_to(do_split, L + 1, False),
+                    "route_f": _pad_to(route_f, L + 1, 0),
+                    "go_left_bins": _pad_to(go_left_bins, L + 1, False),
+                    "left_id": _pad_to(left_id, L + 1, N),
+                    "right_id": _pad_to(right_id, L + 1, N),
+                    "split_rank": _pad_to(split_rank, L + 1, 0),
+                    "hmap": hmap_np,
+                }
+                bits_by_group = None
+                if self.C > 1 and bool(np.any(do_split)):
+                    bits_by_group = self._gather_hybrid_bits(
+                        it, depth, tables, do_split, route_f
+                    )
+                pending_route = {
+                    "tables": tables, "bits": bits_by_group
+                }
+                self._route_history.append(pending_route)
+
+                # ---- 5. frontier + sibling carry for the next layer  #
+                if children:
+                    tgt_l = np.where(do_split, 2 * split_rank, L)
+                    tgt_r = np.where(do_split, 2 * split_rank + 1, L)
+                    frontier_id = np.full((L + 1,), N, i32)
+                    frontier_id[tgt_l] = left_id
+                    frontier_id[tgt_r] = right_id
+                    frontier_id[L] = N
+                    node_stats = np.zeros((L + 1, S), np.float32)
+                    node_stats[tgt_l] = left_stats
+                    node_stats[tgt_r] = right_stats
+                    node_stats[L] = 0.0
+                    if "sub" in out:
+                        parent_next, small_next = out["sub"]
+                        sub_state = (
+                            parent_next, small_next, min(Ld, L // 2)
+                        )
+                    else:
+                        sub_state = None
+            self.stats.observe_layer(
+                time.perf_counter_ns() - t_layer0, hist_rpcs
+            )
+
+        # ---- tree end: leaf gather via the validation-routing verb - #
+        leaf_cache = self._gather_leaves(it, D)
+        nn = int(np.asarray(num_nodes))
+        leaf_tr = leaf_cache[self.tr_idx]
+        preds, lv, tl = _j_tree_epilogue(
+            jnp.asarray(tree["leaf_stats"][:N]),
+            jnp.asarray(leaf_tr), preds, y_tr, w_tr,
+            rule=self.rule, loss_obj=self.loss_obj,
+            shrinkage=self.shrinkage,
+        )
+        vl = None
+        if vpreds is not None:
+            vleaves = leaf_cache[self.va_idx]
+            vpreds, vl = _j_valid_update(
+                jnp.asarray(vleaves), lv, vpreds, y_va, w_va,
+                loss_obj=self.loss_obj,
+            )
+        tree_np = {k: v[:N] for k, v in tree.items()}
+        tree_np["num_nodes"] = np.asarray(nn, i32)
+        return preds, vpreds, key, tree_np, np.asarray(lv), tl, vl
+
+    def _gather_hybrid_bits(
+        self, it, depth, tables, do_split, route_f
+    ) -> Dict[int, bytes]:
+        """Hybrid (C > 1) routing: within each row group, only the
+        units owning a split feature compute go-left bits for the
+        group's rows (the feature-parallel 'one worker routes per
+        split' rule applied per group); the manager ORs owner bitmaps
+        and the merged per-group bitmap rides the next request."""
+        from ydf_tpu.parallel.dist_worker import pack_bits, unpack_bits
+
+        owner_uids = []
+        for uid in range(self.num_units):
+            clo, chi = self.col_ranges[uid % self.C]
+            if np.any(do_split & (route_f >= clo) & (route_f < chi)):
+                owner_uids.append(uid)
+        merged: Dict[int, np.ndarray] = {
+            r: np.zeros(hi - lo, bool)
+            for r, (lo, hi) in enumerate(self.row_ranges)
+        }
+        split_req = {
+            "verb": "row_apply_split", "key": self.key_id,
+            "tree": it, "layer": depth,
+            "tables": {
+                "do_split": tables["do_split"],
+                "route_f": tables["route_f"],
+                "go_left_bins": tables["go_left_bins"],
+            },
+        }
+
+        def on_bits(widx, group, resp, _m=merged):
+            for u, b in resp["bits"].items():
+                r = int(u) // self.C
+                lo, hi = self.row_ranges[r]
+                _m[r] |= unpack_bits(b, hi - lo)
+
+        if owner_uids:
+            self._exchange(
+                owner_uids,
+                lambda uids, _r=split_req: {**_r, "shards": uids},
+                "dist.split_broadcast",
+                on_bits,
+            )
+        return {r: pack_bits(m) for r, m in merged.items()}
+
+    def _gather_leaves(self, it, D) -> np.ndarray:
+        """Tree-end `route_validation` fan-out: applies the final
+        layer's routing on the workers and assembles the full
+        cache-order leaf assignment (train + row-sharded validation
+        rows) from the per-unit slices. With YDF_TPU_DIST_VERIFY=1 on a
+        hybrid layout, every column group answers and their per-group
+        leaf crcs are cross-checked — drifted duplicate routing state
+        raises instead of training on silently diverged workers."""
+        gather_uids = (
+            list(range(self.num_units))
+            if (self.verify and self.C > 1)
+            else [r * self.C for r in range(self.R)]
+        )
+        req = {
+            "verb": "route_validation", "key": self.key_id,
+            "tree": it, "layer": D,
+            "route": self._route_history[-1]
+            if self._route_history else None,
+        }
+        leaf_cache = np.zeros(self.n, np.int32)
+        crcs: Dict[int, int] = {}
+
+        def on_leaves(widx, group, resp):
+            for u, leaves in resp["leaves"].items():
+                u = int(u)
+                if u % self.C == 0:
+                    lo, hi = self.row_ranges[u // self.C]
+                    leaf_cache[lo:hi] = leaves
+                crcs[u] = resp["crcs"][u]
+
+        self._exchange(
+            gather_uids,
+            lambda uids, _r=req: {**_r, "shards": uids},
+            "dist.validation_rpc",
+            on_leaves,
+        )
+        if self.verify and self.C > 1:
+            for r in range(self.R):
+                group_crcs = {
+                    crcs[r * self.C + c] for c in range(self.C)
+                    if r * self.C + c in crcs
+                }
+                if len(group_crcs) > 1:
+                    raise DistributedTrainingError(
+                        f"hybrid routing state diverged across column "
+                        f"groups of row group {r} on tree {it} "
+                        f"(leaf crcs {sorted(group_crcs)})"
+                    )
+        return leaf_cache

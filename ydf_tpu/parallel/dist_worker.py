@@ -47,7 +47,17 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 VERBS = frozenset(
-    {"load_cache_shard", "build_histograms", "apply_split", "leaf_stats"}
+    {
+        "load_cache_shard", "build_histograms", "apply_split",
+        "leaf_stats",
+        # Row-parallel / hybrid verbs (parallel/dist_row.py manager;
+        # docs/distributed_training.md "Row-parallel mode"): a unit is
+        # one (row group, column group) cell of the sharding grid —
+        # pure row mode is C = 1 (every unit holds ALL features of its
+        # rows and routes them locally, no bitmap exchange).
+        "load_row_shard", "row_histograms", "row_apply_split",
+        "route_validation",
+    }
 )
 
 # Worker-side distributed state, keyed by (worker instance id, manager
@@ -351,11 +361,332 @@ def _leaf_stats(req: Dict[str, Any], worker_id: str) -> Dict[str, Any]:
         }
 
 
+# ------------------------------------------------------------------ #
+# Row-parallel / hybrid worker half (manager: parallel/dist_row.py).
+#
+# A unit is one (row group r, column group c) cell: resident uint8
+# bins[rlo:rhi, clo:chi] (streamed crc-verified from the cache's row
+# shard, never a full-slice double copy), the unit's per-row routing
+# state (slot / leaf / hist_slot over ITS rows only), and this tree's
+# gradient-stat slice on the manager's per-tree quantized grid.
+# Histogram answers are PARTIALS in the accumulation domain — f64 per
+# cell, integer-valued (hence exactly summable in any order) under
+# YDF_TPU_HIST_QUANT=int8 — which the manager folds in fixed row-group
+# order before one final conversion to the grower's f32 histogram
+# (docs/distributed_training.md "Sum-merge bit-stability").
+# ------------------------------------------------------------------ #
+
+
+class _RowUnit:
+    __slots__ = (
+        "r", "c", "row_lo", "row_hi", "col_lo", "col_hi", "bins",
+        "is_valid", "slot", "hist_slot", "leaf_id", "stats", "pos",
+    )
+
+    def __init__(self, r, c, row_lo, row_hi, col_lo, col_hi, bins,
+                 valid_local):
+        self.r, self.c = int(r), int(c)
+        self.row_lo, self.row_hi = int(row_lo), int(row_hi)
+        self.col_lo, self.col_hi = int(col_lo), int(col_hi)
+        self.bins = bins  # uint8 [n_r, chi-clo]
+        n_r = self.row_hi - self.row_lo
+        self.is_valid = np.zeros(n_r, bool)
+        if valid_local is not None and len(valid_local):
+            self.is_valid[np.asarray(valid_local, np.int64)] = True
+        self.slot = np.zeros(n_r, np.int32)
+        self.hist_slot = np.zeros(n_r, np.int32)
+        self.leaf_id = np.zeros(n_r, np.int32)
+        self.stats = None  # f64 [n_r, S'] — this tree's grid slice
+        self.pos = (-1, 0)
+
+    def reset(self, tree: int) -> None:
+        self.slot[:] = 0
+        self.hist_slot[:] = 0
+        self.leaf_id[:] = 0
+        self.pos = (int(tree), 0)
+
+    def nbytes(self) -> int:
+        total = (
+            self.bins.nbytes + self.is_valid.nbytes + self.slot.nbytes
+            + self.hist_slot.nbytes + self.leaf_id.nbytes
+        )
+        if self.stats is not None:
+            total += self.stats.nbytes
+        return int(total)
+
+
+class _RowState:
+    def __init__(self, n: int):
+        self.n = int(n)
+        self.lock = threading.Lock()
+        self.units: Dict[int, _RowUnit] = {}  # unit id -> state
+
+
+_ROW_STATE: Dict[tuple, _RowState] = {}
+
+
+def _unit_go_left(u: _RowUnit, tables: Dict[str, np.ndarray],
+                  owned_only: bool = False) -> np.ndarray:
+    """go-left bit of each of the unit's rows whose slot splits on a
+    feature this unit HOLDS (pure row mode holds all of them; a hybrid
+    unit computes bits only for its column range — `owned_only` is the
+    row_apply_split half, where other bits come from the merged
+    bitmap). Exact integer/bool bookkeeping, same expressions as the
+    feature-parallel _apply_split."""
+    do_split = np.asarray(tables["do_split"])
+    route_f = np.asarray(tables["route_f"])
+    glb = np.asarray(tables["go_left_bins"])
+    go = np.zeros(u.slot.shape[0], bool)
+    sel = do_split[u.slot]
+    if owned_only:
+        rf_all = route_f[u.slot]
+        sel &= (rf_all >= u.col_lo) & (rf_all < u.col_hi)
+    rows = np.flatnonzero(sel)
+    if rows.size:
+        s_rows = u.slot[rows]
+        bin_e = u.bins[rows, route_f[s_rows] - u.col_lo]
+        go[rows] = glb[s_rows, bin_e]
+    return go
+
+
+def _unit_apply_route(u: _RowUnit, route: Dict[str, Any]) -> None:
+    """Applies one layer's routing to the unit's rows: the merged
+    per-row-group bitmap when the manager shipped one (hybrid, C > 1),
+    else bits computed locally from the unit's own bins (pure row mode
+    — the no-bitmap-broadcast path)."""
+    tables = route["tables"]
+    bits = (route.get("bits") or {}).get(u.r)
+    if bits is not None:
+        go = unpack_bits(bits, u.slot.shape[0])
+    else:
+        go = _unit_go_left(u, tables)
+    u.slot, u.leaf_id, u.hist_slot = apply_route_tables(
+        u.slot, u.leaf_id, go, tables
+    )
+
+
+def _row_sync_to(u: _RowUnit, req: Dict[str, Any]) -> Optional[Dict]:
+    """Advances a unit to the request's (tree, layer): reset at tree
+    start, carried route when exactly one step behind, replayed
+    transition as a no-op — the same (tree, layer) stamp discipline as
+    the feature-parallel _sync_to, so recovery re-ships can never
+    double-apply a routing update."""
+    tree, layer = int(req["tree"]), int(req["layer"])
+    if req.get("reset"):
+        u.reset(tree)
+        return None
+    if u.pos == (tree, layer):
+        return None
+    route = req.get("route")
+    if u.pos == (tree, layer - 1) and route is not None:
+        _unit_apply_route(u, route)
+        u.pos = (tree, layer)
+        return None
+    return _need(
+        f"unit ({u.r},{u.c}) at position {u.pos} cannot serve "
+        f"(tree, layer) = {(tree, layer)}"
+    )
+
+
+def _adopt_row_state(u: _RowUnit, state: Dict[str, Any], uid: int) -> None:
+    """Recovery re-ship: reset to the tree start the manager names,
+    adopt the stats slice, and REPLAY the manager's route history —
+    deterministic integer routing, so the replacement unit lands in
+    exactly the lost unit's state."""
+    u.stats = None
+    st = (state.get("stats") or {}).get(uid)
+    if st is not None:
+        u.stats = np.ascontiguousarray(st)
+    u.reset(int(state.get("tree", -1)))
+    for route in state.get("replay") or []:
+        _unit_apply_route(u, route)
+        u.pos = (u.pos[0], u.pos[1] + 1)
+
+
+def _accum_partial(
+    bins_u8: np.ndarray, hist_slot: np.ndarray, stats: np.ndarray,
+    num_slots: int, num_bins: int,
+) -> np.ndarray:
+    """The unit's histogram partial over its rows, accumulated per cell
+    in f64 via np.bincount over FIXED 64k-row chunks folded in order —
+    deterministic regardless of worker placement, and EXACT (hence
+    merge-order-free) whenever the per-row stat values are integers,
+    which is precisely the int8 per-tree grid. `stats` stays resident
+    in its wire dtype (1 byte/stat under int8 — the memory contract);
+    each chunk widens to f64 exactly at accumulation time. Rows on the
+    trash slot (retired, larger-child under sibling subtraction,
+    validation rows) are compacted away before the scatter. Returns
+    f64 [num_slots, F_c, B, S']."""
+    n, Fc = bins_u8.shape
+    L, B = int(num_slots), int(num_bins)
+    Sw = stats.shape[1]
+    size = L * Fc * B
+    out = np.zeros((size, Sw), np.float64)
+    fidx = np.arange(Fc, dtype=np.int64)[None, :]
+    CH = 1 << 16
+    for s0 in range(0, max(n, 1), CH):
+        sl = hist_slot[s0: s0 + CH]
+        live = sl < L
+        if not live.any():
+            continue
+        rows = np.flatnonzero(live) + s0
+        b = bins_u8[rows]
+        s = sl[live].astype(np.int64)
+        st = stats[rows].astype(np.float64)  # exact widening cast
+        idx = ((s[:, None] * Fc + fidx) * B + b).ravel()
+        for j in range(Sw):
+            out[:, j] += np.bincount(
+                idx, weights=np.repeat(st[:, j], Fc), minlength=size
+            )
+    return out.reshape(L, Fc, B, Sw)
+
+
+def _get_row_state(worker_id: str, key: str) -> Optional[_RowState]:
+    with _STATE_LOCK:
+        return _ROW_STATE.get((worker_id, key))
+
+
+def _load_row_shard(req: Dict[str, Any], worker_id: str) -> Dict[str, Any]:
+    """Loads one or more (row group, column group) units: streams each
+    crc-verified row shard block-wise from the cache
+    (DatasetCache.load_row_shard_streamed — the resident footprint is
+    the slice, never the full matrix), records validation-row masks,
+    and on recovery adopts the manager's authoritative replay state."""
+    from ydf_tpu.dataset.cache import CacheCorruptionError, DatasetCache
+
+    key = req["key"]
+    layout = req["layout"]
+    n = int(layout["rows"])
+    try:
+        cache = DatasetCache(req["cache_dir"], verify="off")
+        units = {}
+        for spec in req["units"]:
+            uid = int(spec["uid"])
+            r, c = int(spec["r"]), int(spec["c"])
+            rlo, rhi = spec["row_range"]
+            clo, chi = spec["col_range"]
+            bins = cache.load_row_shard_streamed(
+                r, col_range=(int(clo), int(chi)), verify=True
+            )
+            units[uid] = _RowUnit(
+                r, c, rlo, rhi, clo, chi, bins,
+                (req.get("valid_rows") or {}).get(uid),
+            )
+    except CacheCorruptionError as e:
+        return {"ok": False, "corrupt": True, "error": str(e)}
+    with _STATE_LOCK:
+        st = _ROW_STATE.get((worker_id, key))
+        if st is None or st.n != n:
+            while len(_ROW_STATE) >= _STATE_CAP:
+                _ROW_STATE.pop(next(iter(_ROW_STATE)))
+            st = _ROW_STATE[(worker_id, key)] = _RowState(n)
+    with st.lock:
+        st.units.update(units)
+        state = req.get("state")
+        if state is not None:
+            for uid in units:
+                _adopt_row_state(st.units[uid], state, uid)
+        return {
+            "ok": True, "n": n, "units": sorted(st.units),
+            "shard_bytes": _row_state_bytes(st),
+            "config": _dist_config(),
+        }
+
+
+def _row_histograms(req: Dict[str, Any], worker_id: str) -> Dict[str, Any]:
+    st = _get_row_state(worker_id, req["key"])
+    if st is None:
+        return _need(f"unknown dist key {req['key']!r} (worker restarted?)")
+    with st.lock:
+        L = int(req["num_slots"])
+        B = int(req["num_bins"])
+        hists = {}
+        for uid in req["shards"]:
+            u = st.units.get(int(uid))
+            if u is None:
+                return _need(f"unit {uid} not loaded")
+            stats = (req.get("stats") or {}).get(int(uid))
+            if stats is not None:
+                # Tree-start grid slice, kept resident in the WIRE
+                # dtype (int8 grid points / bf16 halves / f32) —
+                # _accum_partial widens each chunk to f64 exactly at
+                # accumulation time, so the resident footprint stays
+                # on the quantized grid.
+                u.stats = np.ascontiguousarray(stats)
+            err = _row_sync_to(u, req)
+            if err is not None:
+                return err
+            if u.stats is None:
+                return _need("no gradient stats loaded for this tree")
+            # Validation rows ride the same routing state but never
+            # enter a histogram: force them onto the trash slot.
+            hs = np.where(u.is_valid, L, u.hist_slot).astype(np.int32)
+            hists[int(uid)] = _accum_partial(u.bins, hs, u.stats, L, B)
+        return {"ok": True, "hists": hists}
+
+
+def _row_apply_split(req: Dict[str, Any], worker_id: str) -> Dict[str, Any]:
+    """Hybrid (C > 1) owner routing: bits for the unit's rows whose
+    slot splits on a feature in ITS column range — train and validation
+    rows alike (positions are disjoint, the manager ORs owner bitmaps
+    per row group)."""
+    st = _get_row_state(worker_id, req["key"])
+    if st is None:
+        return _need(f"unknown dist key {req['key']!r} (worker restarted?)")
+    with st.lock:
+        pos = (int(req["tree"]), int(req["layer"]))
+        bits = {}
+        for uid in req["shards"]:
+            u = st.units.get(int(uid))
+            if u is None:
+                return _need(f"unit {uid} not loaded")
+            if u.pos != pos:
+                return _need(
+                    f"unit ({u.r},{u.c}) at position {u.pos} cannot "
+                    f"route layer {pos}"
+                )
+            bits[int(uid)] = pack_bits(
+                _unit_go_left(u, req["tables"], owned_only=True)
+            )
+        return {"ok": True, "bits": bits}
+
+
+def _route_validation(req: Dict[str, Any], worker_id: str) -> Dict[str, Any]:
+    """Tree-end routing/gather — the validation-routing verb: applies
+    the FINAL layer's tables to the unit's rows (train and row-sharded
+    validation rows alike; valid rows were routed through every prior
+    layer by the same tables) and returns the slice's leaf assignment
+    in cache-row order, plus a crc the hybrid cross-unit verify
+    compares across column groups."""
+    st = _get_row_state(worker_id, req["key"])
+    if st is None:
+        return _need(f"unknown dist key {req['key']!r} (worker restarted?)")
+    with st.lock:
+        leaves = {}
+        crcs = {}
+        for uid in req["shards"]:
+            u = st.units.get(int(uid))
+            if u is None:
+                return _need(f"unit {uid} not loaded")
+            err = _row_sync_to(u, req)
+            if err is not None:
+                return err
+            leaves[int(uid)] = u.leaf_id.copy()
+            crcs[int(uid)] = zlib.crc32(
+                np.ascontiguousarray(u.leaf_id).tobytes()
+            )
+        return {"ok": True, "leaves": leaves, "crcs": crcs}
+
+
 _HANDLERS = {
     "load_cache_shard": _load_cache_shard,
     "build_histograms": _build_histograms,
     "apply_split": _apply_split,
     "leaf_stats": _leaf_stats,
+    "load_row_shard": _load_row_shard,
+    "row_histograms": _row_histograms,
+    "row_apply_split": _row_apply_split,
+    "route_validation": _route_validation,
 }
 
 
@@ -387,16 +718,31 @@ def _state_bytes(st: "_DistState") -> int:
     return int(total)
 
 
+def _row_state_bytes(st: "_RowState") -> int:
+    """Resident bytes of one run's row-parallel state: streamed bin
+    slices + per-row routing arrays + the tree's stat slice — the
+    row-mode "dist_shard" memory-ledger contribution (per worker,
+    ~1/N of the single-machine bin matrix)."""
+    return int(sum(u.nbytes() for u in st.units.values()))
+
+
 def shard_bytes_total(worker_id: Optional[str] = None) -> int:
     """Bytes resident in this process's distributed worker state —
     all worker instances, or one `worker_id` (in-process fleets share
-    the process, so the ledger row is the process total)."""
+    the process, so the ledger row is the process total). Covers both
+    the feature-parallel and row-parallel state registries."""
     with _STATE_LOCK:
         items = [
             st for (wid, _), st in _STATE.items()
             if worker_id is None or wid == worker_id
         ]
-    return sum(_state_bytes(st) for st in items)
+        row_items = [
+            st for (wid, _), st in _ROW_STATE.items()
+            if worker_id is None or wid == worker_id
+        ]
+    return sum(_state_bytes(st) for st in items) + sum(
+        _row_state_bytes(st) for st in row_items
+    )
 
 
 # Pull-model memory accounting: sampled only at ledger snapshots
@@ -425,6 +771,22 @@ def status(worker_id: str = "local") -> Dict[str, Any]:
             "rows": st.n,
             "shard_bytes": _state_bytes(st),
         }
+    with _STATE_LOCK:
+        row_items = [
+            (key, st) for (wid, key), st in _ROW_STATE.items()
+            if wid == worker_id
+        ]
+    for key, st in row_items:
+        out[key] = {
+            "mode": "row",
+            "units": {
+                uid: {"pos": list(u.pos), "row_group": u.r,
+                      "col_group": u.c}
+                for uid, u in sorted(st.units.items())
+            },
+            "rows": st.n,
+            "shard_bytes": _row_state_bytes(st),
+        }
     return out
 
 
@@ -432,3 +794,4 @@ def reset_state() -> None:
     """Drops all per-key worker state (tests)."""
     with _STATE_LOCK:
         _STATE.clear()
+        _ROW_STATE.clear()

@@ -111,6 +111,11 @@ KNOWN_SITES = frozenset(
         "dist.shard_load",
         "dist.histogram_rpc",
         "dist.split_broadcast",
+        # parallel/dist_row.py — the row-parallel tree-end
+        # validation-routing/leaf-gather exchange (route_validation
+        # verb); shares the shard_load/histogram_rpc sites above for
+        # its other exchanges.
+        "dist.validation_rpc",
         # utils/telemetry.py — span/metrics exporter. flush() swallows
         # the injected fault (export is observation): the chaos test
         # asserts a crashing exporter leaves training bit-identical.
