@@ -717,6 +717,78 @@ def measure_serving_family(model, data, rows, record):
         record["serve_family_error"] = f"{type(e).__name__}: {e}"
 
 
+def measure_serving_load_family(model, data, rows, record):
+    """Serving-UNDER-LOAD bench family (serving/loadgen.py — ROADMAP
+    item 1's "multi-process closed+open-loop load generator"): the
+    per-call engine numbers above are unloaded microbenchmarks; these
+    fields say what the batcher front sustains and at what tail.
+
+      serve_sustained_qps     closed-loop capacity: 4 lanes, think-time
+                              0, through a bounded model_batcher
+      serve_load_p50_ns       open-loop Poisson run at 70% of that
+      serve_load_p99_ns       capacity; latency measured from the
+                              SCHEDULED arrival (coordinated-omission-
+                              safe — queueing delay is charged to the
+                              requests, never hidden)
+      serve_queue_age_p99_ns  dispatch lag p99 of the same run (actual
+                              fire − scheduled arrival)
+      serve_shed_rate         shed / (ok + shed) of the open-loop run
+                              (0.0 on a healthy 0.7x run)
+
+    The full run records (log2 latency buckets, shed-by-reason, ledger
+    peak) ride record["serve_load"] without the bucket arrays.
+    Failures recorded, never fatal."""
+    import numpy as np
+
+    from ydf_tpu.dataset.dataset import Dataset
+
+    try:
+        from ydf_tpu.serving import loadgen
+        from ydf_tpu.serving.registry import model_batcher
+
+        sample = {k: v[: min(rows, 2048)] for k, v in data.items()}
+        ds = Dataset.from_data(sample, dataspec=model.dataspec)
+        x_num, x_cat, _ = model._encode_inputs(ds)
+        x_num = np.ascontiguousarray(x_num)
+        x_cat = np.ascontiguousarray(x_cat)
+        n_av = x_num.shape[0]
+        workers = 4
+        n_req = 1200
+        with model_batcher(
+            model, max_batch=64, timeout_us=200.0,
+            max_queue=4096, deadline_us=100_000.0,
+        ) as bat:
+            def call(i):
+                j = i % n_av
+                bat.predict_one(x_num[j], x_cat[j])
+
+            closed = loadgen.run_closed_loop(
+                call, n_req, workers=workers, seed=0
+            )
+            capacity = max(closed["achieved_qps"], 1.0)
+            sched = loadgen.arrival_schedule_ns(
+                n_req, capacity * 0.7, arrival="poisson", seed=1
+            )
+            opened = loadgen.run_open_loop(
+                call, sched, workers=workers, seed=1,
+                arrival="poisson", offered_qps=capacity * 0.7,
+            )
+        record["serve_sustained_qps"] = closed["achieved_qps"]
+        record["serve_load_p50_ns"] = opened["latency_p50_ns"]
+        record["serve_load_p99_ns"] = opened["latency_p99_ns"]
+        record["serve_queue_age_p99_ns"] = opened["queue_age_p99_ns"]
+        accepted = opened["ok"] + opened["shed"]
+        record["serve_shed_rate"] = round(
+            opened["shed"] / max(accepted, 1), 4
+        )
+        record["serve_load"] = {
+            "closed": loadgen.record_summary(closed),
+            "open": loadgen.record_summary(opened),
+        }
+    except Exception as e:
+        record["serve_load_family_error"] = f"{type(e).__name__}: {e}"
+
+
 def measure_distributed_family(rows, trees, depth, features, record):
     """Distributed training measurement (ROADMAP item 2's bench half),
     gated on YDF_TPU_BENCH_DIST_WORKERS=N (N >= 2): spins N in-process
@@ -1048,6 +1120,10 @@ def run_bench(backend, rows, trees, depth, features, with_baseline, probe_log):
     # which engine actually serves (serve_engine) — rides every headline
     # record (ROADMAP item 1's "millions of users" measurement).
     measure_serving_family(model, data, rows, record)
+    _PARTIAL = dict(record)
+    # Serving-under-load family: sustained QPS + coordinated-omission-
+    # safe open-loop tail through the bounded request batcher.
+    measure_serving_load_family(model, data, rows, record)
     _PARTIAL = dict(record)
     # Distributed-training family (ROADMAP item 2's measurement half):
     # only runs when YDF_TPU_BENCH_DIST_WORKERS is set.

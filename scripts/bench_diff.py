@@ -13,9 +13,12 @@ exactly that failure mode. This tool:
     JSONL of records, or a single record object;
   * keeps only MEASURED headline records (projections and error records
     dropped) and pairs them **by record shape**
-    `(metric, backend, rows, trees, depth)` — records whose shape
-    appears in only one round are listed as unpaired, NEVER diffed
-    (the confound class is dead by construction);
+    `(metric, backend, rows, trees, depth, dist_mode, load_mode)` —
+    records whose shape appears in only one round are listed as
+    unpaired, NEVER diffed (the confound class is dead by
+    construction); `load_mode` keeps serving-load artifacts
+    (scripts/bench_serve_load.py) pairing closed-with-closed and
+    open-with-open only;
   * diffs every per-stage field two paired records share —
     `ingest_s`…`fused_s`, the serving latencies/QPS, the `dist_*`
     family, and the round-15 utilization/memory fields
@@ -51,10 +54,12 @@ from typing import Dict, List, Optional, Tuple
 #: dist_mode joins the key so a row-parallel round can never be diffed
 #: against a feature-parallel one (their dist_* fields measure
 #: different exchanges — protocol bytes, merge domains, shard
-#: residency); records without a distributed family carry no dist_mode
-#: and pair exactly as before.
+#: residency); load_mode joins it so a serving-load artifact's
+#: closed-loop capacity run never pairs with an open-loop latency run
+#: (scripts/bench_serve_load.py emits both per round). Records without
+#: those families carry neither key and pair exactly as before.
 SHAPE_FIELDS = ("metric", "backend", "rows", "trees", "depth",
-                "dist_mode")
+                "dist_mode", "load_mode")
 
 #: field (or dotted-prefix, trailing ".") -> (direction, rel_noise,
 #: abs_floor). direction "lower" = smaller is better. A change is a
@@ -90,6 +95,20 @@ FIELD_SPECS: Dict[str, Tuple[str, float, float]] = {
     "dist_wait_s": ("lower", 0.25, 0.1),
     "dist_layer_wall_s": ("lower", 0.15, 0.2),
     "dist_reduce_bytes": ("lower", 0.05, 1024.0),
+    # serving-under-load family (bench.py measure_serving_load_family /
+    # scripts/bench_serve_load.py): capacity up is good, tail latency /
+    # queue age / shed rate down is good.
+    "serve_sustained_qps": ("higher", 0.15, 0.0),
+    "serve_load_p50_ns": ("lower", 0.15, 100.0),
+    "serve_load_p99_ns": ("lower", 0.25, 500.0),
+    "serve_queue_age_p99_ns": ("lower", 0.25, 500.0),
+    "serve_shed_rate": ("lower", 0.10, 0.01),
+    # loadgen artifact records (load_mode in the pairing shape)
+    "achieved_qps": ("higher", 0.15, 0.0),
+    "latency_p50_ns": ("lower", 0.15, 100.0),
+    "latency_p99_ns": ("lower", 0.25, 500.0),
+    "queue_age_p99_ns": ("lower", 0.25, 500.0),
+    "serve_batcher_peak_bytes": ("lower", 0.25, float(1 << 16)),
     # dotted-prefix rules (nested numeric dicts flatten to parent.key)
     "pool_utilization.": ("higher", 0.10, 0.05),
     "infer_batch_p50_ns.": ("lower", 0.15, 100.0),

@@ -1,0 +1,230 @@
+"""Serving-load bench CLI: the multi-process closed+open-loop harness.
+
+Drives `serving/loadgen.py` against a freshly trained model's request
+batcher (`registry.model_batcher`) and writes one JSONL record per run
+— the artifact `scripts/bench_diff.py` pairs across rounds (records
+carry `load_mode` in the pairing shape, so a closed-loop capacity run
+never cross-compares with an open-loop latency run).
+
+Flow per process: train a small synthetic-Higgs GBT at (--rows,
+--trees, --depth), pre-encode --sample rows, open a bounded batcher
+(--max-queue / --deadline-us — the overload policy under test), then
+
+  1. closed loop (--requests, --workers lanes): sustained capacity;
+  2. open loop at --qps (default: 70% of the measured capacity;
+     --overload multiplies capacity instead, e.g. `--overload 4` for
+     a shedding run), seeded --arrival schedule, latency from
+     SCHEDULED arrival (coordinated-omission-safe).
+
+Multi-process: `--procs N` forks N child runs of this script (each
+with seed+i and its own model/batcher/engine — real process
+isolation), merges their records per mode (histograms sum exactly),
+and emits the merged fleet records beside the per-process ones.
+
+    python scripts/bench_serve_load.py --rows 20000 --trees 5 \
+        --requests 2000 --workers 4 --out serve_load.jsonl
+    python scripts/bench_serve_load.py --procs 4 --overload 4.0 \
+        --max-queue 256 --deadline-us 20000 --out overload.jsonl
+
+Exit 0 with a summary JSON line on stdout (last line), like bench.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def build_target(rows: int, trees: int, depth: int, features: int,
+                 sample: int, seed: int):
+    """Trains the bench-shaped synthetic GBT and returns
+    (batcher_factory, x_num, x_cat): pre-encoded rows plus a factory so
+    each run can open its own bounded batcher."""
+    import numpy as np
+
+    import ydf_tpu as ydf
+    from ydf_tpu.dataset.dataset import Dataset
+    from ydf_tpu.dataset.dataspec import ColumnType
+
+    rng = np.random.RandomState(0xD06 + seed)
+    x = rng.normal(size=(rows, features)).astype(np.float32)
+    y = (
+        x[:, 0] + 0.5 * x[:, 1] * x[:, 2] + rng.normal(size=rows) > 0
+    ).astype(np.int64)
+    data = {f"f{i}": x[:, i] for i in range(features)}
+    data["label"] = y
+    ds = Dataset.from_data(
+        data, label="label",
+        column_types={"label": ColumnType.CATEGORICAL},
+    )
+    model = ydf.GradientBoostedTreesLearner(
+        label="label", num_trees=trees, max_depth=depth,
+        validation_ratio=0.0, early_stopping="NONE",
+    ).train(ds)
+    n = min(sample, rows)
+    enc = Dataset.from_data(
+        {k: v[:n] for k, v in data.items()}, dataspec=model.dataspec
+    )
+    x_num, x_cat, _ = model._encode_inputs(enc)
+    return model, np.ascontiguousarray(x_num), np.ascontiguousarray(x_cat)
+
+
+def run_single(args) -> list:
+    """One process's closed+open pair; returns the run records with
+    the bench shape fields attached."""
+    from ydf_tpu.serving import loadgen
+    from ydf_tpu.serving.registry import model_batcher
+
+    model, x_num, x_cat = build_target(
+        args.rows, args.trees, args.depth, args.features,
+        args.sample, args.seed,
+    )
+    n_av = x_num.shape[0]
+
+    shape = {
+        "metric": "serve_load_qps",
+        "unit": "rows/s",
+        "backend": "cpu",
+        "rows": args.rows,
+        "trees": args.trees,
+        "depth": args.depth,
+    }
+    records = []
+    with model_batcher(
+        model,
+        max_batch=args.max_batch,
+        timeout_us=args.timeout_us,
+        max_queue=args.max_queue,
+        max_queue_bytes=args.max_queue_bytes,
+        deadline_us=args.deadline_us,
+    ) as bat:
+        def call(i):
+            j = i % n_av
+            bat.predict_one(x_num[j], x_cat[j])
+
+        closed = loadgen.run_closed_loop(
+            call, args.requests, workers=args.workers, seed=args.seed
+        )
+        records.append({**shape, "value": closed["achieved_qps"],
+                        **closed})
+        capacity = max(closed["achieved_qps"], 1.0)
+        if args.qps > 0:
+            offered = args.qps
+        else:
+            offered = capacity * (args.overload or 0.7)
+        sched = loadgen.arrival_schedule_ns(
+            args.requests, offered, arrival=args.arrival,
+            seed=args.seed + 1,
+        )
+        opened = loadgen.run_open_loop(
+            call, sched, workers=args.workers, seed=args.seed + 1,
+            arrival=args.arrival, offered_qps=offered,
+        )
+        records.append({**shape, "value": opened["achieved_qps"],
+                        **opened})
+    return records
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, default=20_000)
+    ap.add_argument("--trees", type=int, default=5)
+    ap.add_argument("--depth", type=int, default=6)
+    ap.add_argument("--features", type=int, default=28)
+    ap.add_argument("--sample", type=int, default=2048,
+                    help="pre-encoded request rows cycled by the load")
+    ap.add_argument("--requests", type=int, default=2000,
+                    help="requests per mode per process")
+    ap.add_argument("--workers", type=int, default=4,
+                    help="driver lanes (threads) per process")
+    ap.add_argument("--qps", type=float, default=0.0,
+                    help="open-loop offered QPS (0 = derive from the "
+                         "closed-loop capacity)")
+    ap.add_argument("--overload", type=float, default=0.0,
+                    help="open-loop offered QPS as a multiple of "
+                         "measured capacity (0 = the 0.7x latency run)")
+    ap.add_argument("--arrival", choices=("uniform", "poisson"),
+                    default="poisson")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--max-batch", type=int, default=64)
+    ap.add_argument("--timeout-us", type=float, default=200.0)
+    ap.add_argument("--max-queue", type=int, default=4096)
+    ap.add_argument("--max-queue-bytes", type=int, default=0)
+    ap.add_argument("--deadline-us", type=float, default=0.0)
+    ap.add_argument("--procs", type=int, default=1,
+                    help="fan out over N processes (each trains its "
+                         "own model and drives its own batcher)")
+    ap.add_argument("--out", default=None,
+                    help="append run records to this JSONL artifact")
+    args = ap.parse_args(argv)
+
+    from ydf_tpu.serving import loadgen
+
+    if args.procs > 1:
+        per_proc: list = []
+        children = []
+        # Rebuild the child command from the PARSED namespace (never by
+        # filtering argv: flags and their values are separate tokens).
+        base = []
+        for key in ("rows", "trees", "depth", "features", "sample",
+                    "requests", "workers", "qps", "overload", "arrival",
+                    "max_batch", "timeout_us", "max_queue",
+                    "max_queue_bytes", "deadline_us"):
+            base += [f"--{key.replace('_', '-')}",
+                     str(getattr(args, key))]
+        for p in range(args.procs):
+            children.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), *base,
+                 "--procs", "1", "--seed", str(args.seed + 1000 * p)],
+                stdout=subprocess.PIPE, text=True, cwd=REPO,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            ))
+        for c in children:
+            stdout, _ = c.communicate(timeout=1800)
+            if c.returncode != 0:
+                print(json.dumps({"error": f"child rc={c.returncode}"}))
+                return 1
+            recs = [
+                json.loads(ln) for ln in stdout.splitlines()
+                if ln.strip().startswith("{")
+                and "load_mode" in ln
+            ]
+            per_proc.append(recs)
+        records = []
+        for mode in ("closed", "open"):
+            same = [
+                r for recs in per_proc for r in recs
+                if r.get("load_mode") == mode
+            ]
+            if same:
+                merged = loadgen.merge_records(same)
+                merged["value"] = merged["achieved_qps"]
+                records.append(merged)
+    else:
+        records = run_single(args)
+
+    if args.out:
+        loadgen.write_jsonl(args.out, records)
+    for rec in records:
+        print(json.dumps(rec))
+    summary = {
+        "runs": len(records),
+        "modes": [r["load_mode"] for r in records],
+        "achieved_qps": [r["achieved_qps"] for r in records],
+        "shed": [r["shed"] for r in records],
+        "out": args.out,
+    }
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(main())

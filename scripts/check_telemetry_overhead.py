@@ -33,6 +33,12 @@ import sys
 import tempfile
 import time
 
+# Standalone invocation (`python scripts/check_telemetry_overhead.py`)
+# puts scripts/ on sys.path, not the repo root that holds ydf_tpu/.
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
 
 def measure_min_wall(train_once, reps: int) -> float:
     walls = []
@@ -54,6 +60,7 @@ def run_check(
     with_http: bool = False,
     with_ledger: bool = False,
     with_dist_row: bool = False,
+    with_serve_load: bool = False,
 ) -> dict:
     import numpy as np
 
@@ -77,6 +84,45 @@ def run_check(
         ).train(ds)
 
     train_once()  # compile + cold binning: excluded, like bench.py
+
+    load_once = None
+    if with_serve_load:
+        # Serving-load variant: a short closed-loop run through the
+        # request batcher (serving/loadgen.py). The enabled measurement
+        # runs with journey-trace sampling at rate 1.0 — EVERY request
+        # records its serve.request → batcher.* span chain — and the
+        # whole instrumented run must still fit the same budget against
+        # the telemetry-off, sampling-off baseline.
+        from ydf_tpu.dataset.dataset import Dataset as _DS
+
+        m = ydf.GradientBoostedTreesLearner(
+            label="label", num_trees=trees, max_depth=depth,
+            validation_ratio=0.0, early_stopping="NONE",
+        ).train(ds)
+        enc = _DS.from_data(
+            {k: v[:1024] for k, v in data.items()},
+            dataspec=m.dataspec,
+        )
+        lx_num, lx_cat, _ = m._encode_inputs(enc)
+        lx_num = np.ascontiguousarray(lx_num)
+        lx_cat = np.ascontiguousarray(lx_cat)
+        l_av = lx_num.shape[0]
+
+        def load_once(trace_sample=0.0):
+            from ydf_tpu.serving import loadgen
+            from ydf_tpu.serving.registry import model_batcher
+
+            with model_batcher(
+                m, max_batch=32, timeout_us=200.0,
+                trace_sample=trace_sample,
+            ) as bat:
+                def call(i):
+                    j = i % l_av
+                    bat.predict_one(lx_num[j], lx_cat[j])
+
+                loadgen.run_closed_loop(call, 1200, workers=4, seed=0)
+
+        load_once()  # warm the engine bank / code paths
 
     train_dist = None
     dist_cleanup = None
@@ -131,16 +177,24 @@ def run_check(
     disabled_dist = (
         measure_min_wall(train_dist, reps) if train_dist else None
     )
+    disabled_load = (
+        measure_min_wall(load_once, reps) if load_once else None
+    )
     td = tempfile.mkdtemp(prefix="ydf_tel_overhead_")
     enabled_http = None
     enabled_ledger = None
     ledger_snap = None
     enabled_dist = None
+    enabled_load = None
     try:
         with telemetry.active(td):
             enabled = measure_min_wall(train_once, reps)
             if train_dist is not None:
                 enabled_dist = measure_min_wall(train_dist, reps)
+            if load_once is not None:
+                enabled_load = measure_min_wall(
+                    lambda: load_once(trace_sample=1.0), reps
+                )
             if with_ledger:
                 # Ledger-accounting variant: RSS sampling at span
                 # boundaries FORCED on (it defaults on, but the check
@@ -239,6 +293,20 @@ def run_check(
         summary["dist_budget_s"] = round(dist_budget, 4)
         summary["ok_dist_row"] = dist_overhead <= dist_budget
         summary["ok"] = summary["ok"] and summary["ok_dist_row"]
+    if enabled_load is not None:
+        # The serving-load run is its own baseline: the telemetry-off
+        # closed loop pays the same batcher waits and kernel calls, so
+        # the delta is exactly the instrumentation — shed counters,
+        # queue gauges, the per-row latency histogram, AND the
+        # sampled-at-1.0 journey span chain.
+        load_overhead = enabled_load - disabled_load
+        load_budget = rel_budget * disabled_load + noise + abs_floor_s
+        summary["disabled_serve_load_min_s"] = round(disabled_load, 4)
+        summary["enabled_serve_load_min_s"] = round(enabled_load, 4)
+        summary["serve_load_overhead_s"] = round(load_overhead, 4)
+        summary["serve_load_budget_s"] = round(load_budget, 4)
+        summary["ok_serve_load"] = load_overhead <= load_budget
+        summary["ok"] = summary["ok"] and summary["ok_serve_load"]
     if dist_cleanup is not None:
         dist_cleanup()
     return summary
@@ -265,12 +333,20 @@ def main(argv=None) -> int:
                          "row-sharded cache) telemetry-off vs on — the "
                          "per-layer merge spans and RPC accounting "
                          "must fit the same 3%% budget")
+    ap.add_argument("--with-serve-load", action="store_true",
+                    help="additionally measure a short closed-loop "
+                         "serving-load run (serving/loadgen.py through "
+                         "the request batcher) telemetry+sampling off "
+                         "vs on with YDF_TPU_TRACE_SAMPLE-style "
+                         "journey tracing at rate 1.0 — must fit the "
+                         "same 3%% budget")
     args = ap.parse_args(argv)
     summary = run_check(
         rows=args.rows, trees=args.trees, depth=args.depth,
         features=args.features, reps=args.reps,
         with_http=args.with_http, with_ledger=args.with_ledger,
         with_dist_row=args.with_dist_row,
+        with_serve_load=args.with_serve_load,
     )
     print(json.dumps(summary))
     return 0 if summary["ok"] else 1

@@ -119,6 +119,11 @@ def _full_record():
         "serve_bank_bytes": 40 << 20,
         "infer_peak_rss_delta_bytes": 0,
         "infer_batch_p50_ns": {"1": 15000.0, "256": 200000.0},
+        "serve_sustained_qps": 18_000.0,
+        "serve_load_p50_ns": 400_000.0,
+        "serve_load_p99_ns": 1_500_000.0,
+        "serve_queue_age_p99_ns": 900_000.0,
+        "serve_shed_rate": 0.0,
     }
 
 
@@ -170,6 +175,72 @@ def test_resource_fields_diff_directionally(bd, tmp_path):
         doc2["pairs"][0]["fields"]["pool_utilization.hist"]["verdict"]
         == "improvement"
     )
+
+
+def test_serving_load_fields_diff_directionally(bd, tmp_path):
+    """The serving-under-load family carries direction: capacity DROP,
+    tail GROWTH and shed-rate GROWTH are the regressions."""
+    a, b = _full_record(), _full_record()
+    b["serve_sustained_qps"] = a["serve_sustained_qps"] * 0.5
+    b["serve_load_p99_ns"] = a["serve_load_p99_ns"] * 2.0
+    b["serve_shed_rate"] = 0.25
+    b["serve_load_p50_ns"] = a["serve_load_p50_ns"] * 1.05  # in-band
+    pa, pb = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    pa.write_text(json.dumps(a) + "\n")
+    pb.write_text(json.dumps(b) + "\n")
+    doc = bd.diff(str(pa), str(pb))
+    fields = doc["pairs"][0]["fields"]
+    assert fields["serve_sustained_qps"]["verdict"] == "regression"
+    assert fields["serve_load_p99_ns"]["verdict"] == "regression"
+    assert fields["serve_shed_rate"]["verdict"] == "regression"
+    assert fields["serve_load_p50_ns"]["verdict"] == "unchanged"
+    # ...and the improvement direction is symmetric.
+    doc2 = bd.diff(str(pb), str(pa))
+    f2 = doc2["pairs"][0]["fields"]
+    assert f2["serve_sustained_qps"]["verdict"] == "improvement"
+    assert f2["serve_shed_rate"]["verdict"] == "improvement"
+
+
+def _load_record(mode, qps, p99):
+    """A scripts/bench_serve_load.py artifact record (load_mode joins
+    the pairing shape)."""
+    return {
+        "metric": "serve_load_qps", "backend": "cpu", "rows": 20_000,
+        "trees": 5, "depth": 6, "load_mode": mode, "value": qps,
+        "achieved_qps": qps, "latency_p99_ns": p99, "shed": 0,
+    }
+
+
+def test_load_mode_joins_pairing_shape(bd, tmp_path):
+    """A closed-loop capacity record must NEVER pair with an open-loop
+    latency record (their latency fields measure different things —
+    service time vs scheduled-arrival tail): same rounds pair per
+    mode, and a round holding only one mode leaves the other unpaired."""
+    a = [_load_record("closed", 18_000.0, 600_000.0),
+         _load_record("open", 12_600.0, 1_500_000.0)]
+    b = [_load_record("closed", 19_000.0, 610_000.0),
+         _load_record("open", 12_800.0, 1_450_000.0)]
+    pa, pb = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    pa.write_text("\n".join(json.dumps(r) for r in a) + "\n")
+    pb.write_text("\n".join(json.dumps(r) for r in b) + "\n")
+    doc = bd.diff(str(pa), str(pb))
+    assert len(doc["pairs"]) == 2
+    modes = {p["shape"]["load_mode"] for p in doc["pairs"]}
+    assert modes == {"closed", "open"}
+    assert doc["ok"], doc["regressions"]
+    # Drop the open record from b: it must go unpaired, not pair with
+    # b's closed record.
+    pb.write_text(json.dumps(b[0]) + "\n")
+    doc2 = bd.diff(str(pa), str(pb))
+    assert len(doc2["pairs"]) == 1
+    assert doc2["pairs"][0]["shape"]["load_mode"] == "closed"
+    assert any("load_mode=open" in s for s in doc2["unpaired_a"])
+    # An injected open-loop tail regression is flagged on the pair.
+    b2 = [b[0], dict(b[1], latency_p99_ns=4_000_000.0)]
+    pb.write_text("\n".join(json.dumps(r) for r in b2) + "\n")
+    doc3 = bd.diff(str(pa), str(pb))
+    flagged = " ".join(doc3["regressions"])
+    assert "latency_p99_ns" in flagged and "load_mode=open" in flagged
 
 
 def test_different_shapes_never_compare(bd, tmp_path):

@@ -92,6 +92,21 @@ def test_small_cpu_run_emits_parseable_record():
     # On this CPU image the native engine must actually be the one
     # serving — anything else means the build silently degraded.
     assert rec["serve_engine"] == "NativeBatch"
+    # Serving-under-load family (this round): closed-loop sustained
+    # capacity through the bounded request batcher, then an open-loop
+    # Poisson run at 70% of it with latency measured from SCHEDULED
+    # arrival (coordinated-omission-safe) — queue age and shed rate
+    # ride the headline record (docs/serving.md "Serving under load").
+    assert rec.get("serve_load_family_error") is None, rec.get(
+        "serve_load_family_error"
+    )
+    assert rec["serve_sustained_qps"] > 0
+    assert rec["serve_load_p99_ns"] >= rec["serve_load_p50_ns"] > 0
+    assert rec["serve_queue_age_p99_ns"] >= 0
+    assert 0.0 <= rec["serve_shed_rate"] <= 1.0
+    assert rec["serve_load"]["closed"]["load_mode"] == "closed"
+    assert rec["serve_load"]["open"]["load_mode"] == "open"
+    assert rec["serve_load"]["open"]["schedule_fingerprint"]
     # Resource observability (round 15): pool utilization per stage —
     # busy / (lanes x pooled wall) from native/thread_pool.h's stats
     # block — and the memory headline fields. On this image the native

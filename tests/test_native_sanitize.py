@@ -166,6 +166,51 @@ engn = native_serve.build_native_engine(mn)
 assert engn is not None
 np.asarray(engn(xn_num, xn_cat))
 
+# Bounded-queue overload burst through the request batcher over the
+# SANITIZED native engine (serving round): reject-on-full sheds while
+# accepted rows keep serving through the native kernel, and a
+# deadline-armed batcher sheds its lone row at flush — both overload
+# paths (and their fan-out) run under asan/ubsan.
+import threading as _threading
+import time as _time
+from ydf_tpu.serving.registry import CoalescingBatcher, ServeOverloadError
+_shed_reasons = []
+_served = []
+_ov_lock = _threading.Lock()
+def _slow_native(xn, xc):
+    out = np.asarray(eng(xn, xc))
+    _time.sleep(0.001)  # make the queue actually fill
+    return out
+with CoalescingBatcher(_slow_native, max_batch=4, timeout_us=150.0,
+                       max_queue=3) as _bat:
+    def _hammer(k):
+        for _ in range(25):
+            try:
+                r = _bat.predict_one(x_num[k], x_cat[k])
+                with _ov_lock:
+                    _served.append((k, float(r)))
+            except ServeOverloadError as _e:
+                with _ov_lock:
+                    _shed_reasons.append(_e.reason)
+    _ts = [_threading.Thread(target=_hammer, args=(k,)) for k in range(8)]
+    for _t in _ts:
+        _t.start()
+    for _t in _ts:
+        _t.join()
+assert _shed_reasons, "overload burst shed nothing under the sanitizer"
+assert set(_shed_reasons) == {"queue_full"}, set(_shed_reasons)
+assert _served, "overload burst served nothing under the sanitizer"
+_oracle_rows = np.asarray(eng(x_num, x_cat))
+for _k, _r in _served:
+    assert _r == float(_oracle_rows[_k]), (_k, _r)
+with CoalescingBatcher(_slow_native, max_batch=8, timeout_us=400.0,
+                       deadline_us=5.0) as _bat2:
+    try:
+        _bat2.predict_one(x_num[0], x_cat[0])
+        raise AssertionError("deadline shed did not fire")
+    except ServeOverloadError as _e:
+        assert _e.reason == "deadline", _e.reason
+
 # Worker RPC paths under the sanitizer (distributed round): an
 # in-process worker serves the feature-parallel verbs — shard load,
 # per-layer histogram (the native kernel through the RPC path), split

@@ -31,11 +31,20 @@ def test_enabled_overhead_within_budget():
     nothing would pass the budget vacuously."""
     mod = _load()
     summary = mod.run_check(rows=8_000, trees=8, depth=4, reps=2,
-                            with_http=True, with_ledger=True)
+                            with_http=True, with_ledger=True,
+                            with_serve_load=True)
     assert summary["disabled_min_s"] > 0
     assert "ok_http" in summary and summary["enabled_http_min_s"] > 0
     assert "ok_ledger" in summary and summary["enabled_ledger_min_s"] > 0
     assert summary["ok_ledger_populated"], summary
+    # Serving-load variant (--with-serve-load): a closed-loop run
+    # through the request batcher with telemetry ON and journey-trace
+    # sampling at rate 1.0 (every request records its serve.request →
+    # batcher.* span chain) must fit the same budget as the train
+    # instrumentation.
+    assert "ok_serve_load" in summary
+    assert summary["enabled_serve_load_min_s"] > 0
+    assert summary["ok_serve_load"], summary
     assert summary["ok"], (
         "telemetry enabled-path overhead exceeded its budget: "
         f"{summary}"

@@ -210,6 +210,12 @@ def resolved_env_config() -> dict:
     put("YDF_TPU_SERVE_MAX_BATCH", lambda: _serving().SERVE_MAX_BATCH)
     put("YDF_TPU_SERVE_BATCH_TIMEOUT_US",
         lambda: _serving().SERVE_BATCH_TIMEOUT_US)
+    put("YDF_TPU_SERVE_MAX_QUEUE", lambda: _serving().SERVE_MAX_QUEUE)
+    put("YDF_TPU_SERVE_MAX_QUEUE_BYTES",
+        lambda: _serving().SERVE_MAX_QUEUE_BYTES)
+    put("YDF_TPU_SERVE_DEADLINE_US",
+        lambda: _serving().SERVE_DEADLINE_US)
+    put("YDF_TPU_TRACE_SAMPLE", lambda: _serving().TRACE_SAMPLE)
 
     def _cache_verify():
         from ydf_tpu.dataset import cache

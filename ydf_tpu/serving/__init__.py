@@ -16,8 +16,10 @@ from ydf_tpu.serving.quickscorer import (
 )
 from ydf_tpu.serving.registry import (
     CoalescingBatcher,
+    ServeOverloadError,
     model_batcher,
     resolve_serve_impl,
+    resolve_trace_sample,
 )
 
 __all__ = [
@@ -27,6 +29,7 @@ __all__ = [
     "NativeBinnedEngine",
     "PallasBankEngine",
     "QuickScorerEngine",
+    "ServeOverloadError",
     "build_binned_quickscorer",
     "build_native_binned_engine",
     "build_native_engine",
@@ -34,4 +37,5 @@ __all__ = [
     "build_quickscorer",
     "model_batcher",
     "resolve_serve_impl",
+    "resolve_trace_sample",
 ]

@@ -1,0 +1,446 @@
+"""Coordinated-omission-safe serving load harness.
+
+ROADMAP item 1's missing instrument: every serving number rounds 11-15
+produced is an UNLOADED per-call microbenchmark; a production tier is
+judged by behavior at sustained QPS. This module generates that load
+against any per-request target (a `registry.model_batcher`, a raw
+engine, a stub) in two modes:
+
+  * **closed loop** — `workers` lanes, think-time 0: each lane fires
+    its next request the instant the previous one answers. Measures
+    CAPACITY (the sustained-QPS ceiling) but structurally UNDERSTATES
+    latency: a slow response slows the offer down with it, so queueing
+    delay never shows (the "coordinated omission" failure mode of
+    naive load tests).
+  * **open loop** — a seeded, deterministic arrival schedule
+    (fixed-rate `uniform` or `poisson`) at an OFFERED qps. Each
+    request's latency is measured from its SCHEDULED arrival time, not
+    its actual dispatch time: when the service falls behind, the
+    backlog is charged to the requests (nothing is omitted), which is
+    the coordinated-omission correction. Dispatch concurrency is
+    bounded by `workers` lanes — a lane that is late simply fires
+    immediately, and the lateness (`queue_age`) is recorded per
+    request; the offered-vs-achieved QPS gap reports any deficit.
+
+Determinism: `arrival_schedule_ns(n, qps, arrival, seed)` is a pure
+function of its arguments — same seed ⇒ bit-identical schedule — and
+every run record carries a `schedule_fingerprint` plus the full input
+echo, so two runs are comparable field-by-field. The wall-derived
+fields a rerun may legitimately change are enumerated in
+MEASURED_FIELDS (tests strip exactly those when asserting
+reproducibility).
+
+Outcome accounting per request: `ok` (answered), `shed`
+(ServeOverloadError — the overload policy fired; reasons tallied in
+`shed_by_reason`), `timeouts` (TimeoutError), `errors` (anything
+else). Latency histograms (full log2-bucket form, mergeable across
+processes via LatencyHistogram.to_dict/merge) cover ACCEPTED requests
+only — "p99 of accepted requests stays bounded under overload" is the
+shedding acceptance criterion. Each record also samples the
+MemoryLedger's `serve_batcher` gauge for its peak and brackets
+`pool_utilization{serve}` when the native serving kernels run.
+
+`scripts/bench_serve_load.py` is the CLI (multi-process fan-out,
+JSONL artifacts); `bench.py:measure_serving_load_family` puts the
+headline fields on every bench record. docs/serving.md "Serving under
+load" has the full argument.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import json
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ydf_tpu.serving.registry import ServeOverloadError, note_load_run
+from ydf_tpu.utils.telemetry import LatencyHistogram
+
+__all__ = [
+    "MEASURED_FIELDS",
+    "arrival_schedule_ns",
+    "run_closed_loop",
+    "run_open_loop",
+    "merge_records",
+    "record_summary",
+    "write_jsonl",
+]
+
+#: Wall-derived record fields — everything a rerun of the same seed may
+#: legitimately change. The determinism contract is: two runs of the
+#: same (seed, schedule, target) produce identical records after
+#: removing exactly these keys.
+MEASURED_FIELDS = frozenset({
+    "duration_s",
+    "achieved_qps",
+    "latency",
+    "queue_age",
+    "latency_p50_ns",
+    "latency_p99_ns",
+    "queue_age_p99_ns",
+    "pool_utilization_serve",
+    "serve_batcher_peak_bytes",
+})
+
+_ARRIVALS = ("uniform", "poisson")
+
+
+def arrival_schedule_ns(
+    n: int, qps: float, arrival: str = "poisson", seed: int = 0
+) -> np.ndarray:
+    """Deterministic arrival offsets (int64 ns from run start) for `n`
+    requests at an offered `qps`. `uniform` spaces them exactly 1/qps
+    apart; `poisson` draws exponential inter-arrival gaps from a
+    seeded RNG (the memoryless arrival process real traffic
+    approximates). Pure function: same arguments ⇒ same array."""
+    if n < 1:
+        raise ValueError(f"n={n} must be >= 1")
+    if not qps > 0:
+        raise ValueError(f"qps={qps} must be > 0")
+    if arrival not in _ARRIVALS:
+        raise ValueError(
+            f"arrival={arrival!r} must be one of {list(_ARRIVALS)}"
+        )
+    if arrival == "uniform":
+        gaps = np.full(n, 1e9 / qps)
+    else:
+        rng = np.random.RandomState(seed & 0xFFFFFFFF)
+        gaps = rng.exponential(1e9 / qps, size=n)
+    return np.cumsum(gaps).astype(np.int64)
+
+
+def _schedule_fingerprint(schedule_ns: np.ndarray) -> str:
+    return hashlib.sha1(
+        np.ascontiguousarray(schedule_ns, dtype=np.int64).tobytes()
+    ).hexdigest()[:16]
+
+
+class _PeakSampler(threading.Thread):
+    """Samples the `serve_batcher` ledger gauge (~2 ms period) for its
+    peak over a run — the "did the bounded queue actually stay bounded"
+    evidence on every record."""
+
+    def __init__(self) -> None:
+        super().__init__(daemon=True, name="ydf-loadgen-peak")
+        # NOT "_stop": threading.Thread claims that name internally.
+        self._halt = threading.Event()
+        self.peak = 0
+
+    def run(self) -> None:
+        from ydf_tpu.utils import telemetry
+
+        ledger = telemetry.ledger()
+        while not self._halt.is_set():
+            try:
+                v = int(ledger.get_bytes("serve_batcher"))
+            except Exception:
+                v = 0
+            if v > self.peak:
+                self.peak = v
+            self._halt.wait(0.002)
+
+    def stop(self) -> int:
+        self._halt.set()
+        self.join(timeout=5)
+        return self.peak
+
+
+def _serve_utilization_reader() -> Callable[[], Optional[float]]:
+    """Brackets the native pool's serve-family utilization around a
+    run; returns a reader for the bracketed value (None when the
+    native kernels never ran — a stub or pure-XLA target)."""
+    try:
+        from ydf_tpu.utils.profiling import (
+            native_pool_stats,
+            reset_native_pool_stats,
+        )
+
+        reset_native_pool_stats()
+
+        def read() -> Optional[float]:
+            try:
+                ps = native_pool_stats()
+                fam = (ps or {}).get("families", {}).get("serve", {})
+                if fam.get("runs"):
+                    return fam.get("utilization")
+            except Exception:
+                pass
+            return None
+
+        return read
+    except Exception:
+        return lambda: None
+
+
+class _LaneResult:
+    __slots__ = ("latency", "queue_age", "counts", "shed_by")
+
+    def __init__(self) -> None:
+        self.latency = LatencyHistogram()
+        self.queue_age = LatencyHistogram()
+        self.counts = {"ok": 0, "shed": 0, "timeouts": 0, "errors": 0}
+        self.shed_by: Dict[str, int] = {}
+
+    def observe(self, call: Callable[[int], object], i: int,
+                ref_ns: int, queue_age_ns: Optional[int]) -> None:
+        """One request: outcome tallied; latency (from `ref_ns` — the
+        SCHEDULED arrival in open loop, the dispatch instant in closed
+        loop) observed for accepted requests only."""
+        if queue_age_ns is not None:
+            self.queue_age.observe_ns(queue_age_ns)
+        try:
+            call(i)
+        except ServeOverloadError as e:
+            self.counts["shed"] += 1
+            reason = getattr(e, "reason", "unknown")
+            self.shed_by[reason] = self.shed_by.get(reason, 0) + 1
+        except TimeoutError:
+            self.counts["timeouts"] += 1
+        except Exception:
+            self.counts["errors"] += 1
+        else:
+            self.counts["ok"] += 1
+            self.latency.observe_ns(time.perf_counter_ns() - ref_ns)
+
+
+def _drive(
+    workers: int,
+    lane_body: Callable[[_LaneResult, "itertools.count"], None],
+) -> tuple:
+    """Runs `workers` lanes over a shared request counter, merging
+    per-lane results (per-lane histograms keep the hot loop free of a
+    shared lock; LatencyHistogram.merge is exact)."""
+    if workers < 1:
+        raise ValueError(f"workers={workers} must be >= 1")
+    idx = itertools.count()
+    lanes = [_LaneResult() for _ in range(workers)]
+    sampler = _PeakSampler()
+    sampler.start()
+    read_util = _serve_utilization_reader()
+    threads = [
+        threading.Thread(
+            target=lane_body, args=(lanes[w], idx),
+            name=f"ydf-loadgen-{w}", daemon=True,
+        )
+        for w in range(workers)
+    ]
+    t0 = time.perf_counter_ns()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = (time.perf_counter_ns() - t0) / 1e9
+    peak = sampler.stop()
+    util = read_util()
+    lat = LatencyHistogram()
+    qage = LatencyHistogram()
+    counts = {"ok": 0, "shed": 0, "timeouts": 0, "errors": 0}
+    shed_by: Dict[str, int] = {}
+    for lane in lanes:
+        lat.merge(lane.latency)
+        qage.merge(lane.queue_age)
+        for k, v in lane.counts.items():
+            counts[k] += v
+        for k, v in lane.shed_by.items():
+            shed_by[k] = shed_by.get(k, 0) + v
+    return lat, qage, counts, shed_by, wall_s, peak, util
+
+
+def _record(
+    mode: str, n: int, workers: int, seed: int,
+    lat: LatencyHistogram, qage: LatencyHistogram,
+    counts: Dict[str, int], shed_by: Dict[str, int],
+    wall_s: float, peak: int, util: Optional[float],
+    offered_qps: Optional[float], arrival: Optional[str],
+    fingerprint: Optional[str],
+) -> dict:
+    p50 = lat.percentile_ns(50)
+    p99 = lat.percentile_ns(99)
+    qp99 = qage.percentile_ns(99)
+    rec = {
+        "load_mode": mode,
+        "requests": n,
+        "workers": workers,
+        "seed": seed,
+        "arrival": arrival,
+        "offered_qps": (
+            round(offered_qps, 1) if offered_qps is not None else None
+        ),
+        "schedule_fingerprint": fingerprint,
+        "ok": counts["ok"],
+        "shed": counts["shed"],
+        "timeouts": counts["timeouts"],
+        "errors": counts["errors"],
+        "shed_by_reason": dict(sorted(shed_by.items())),
+        "duration_s": round(wall_s, 4),
+        "achieved_qps": round(counts["ok"] / wall_s, 1) if wall_s else 0.0,
+        "latency": lat.to_dict(),
+        "queue_age": qage.to_dict(),
+        "latency_p50_ns": round(p50, 1) if p50 is not None else None,
+        "latency_p99_ns": round(p99, 1) if p99 is not None else None,
+        "queue_age_p99_ns": round(qp99, 1) if qp99 is not None else 0.0,
+        "serve_batcher_peak_bytes": int(peak),
+    }
+    if util is not None:
+        rec["pool_utilization_serve"] = util
+    note_load_run(record_summary(rec))
+    return rec
+
+
+def record_summary(rec: dict) -> dict:
+    """The /statusz- and bench-sized view of a run record (everything
+    but the bucket arrays)."""
+    return {
+        k: v for k, v in rec.items()
+        if k not in ("latency", "queue_age")
+    }
+
+
+def run_closed_loop(
+    call: Callable[[int], object],
+    num_requests: int,
+    workers: int = 4,
+    seed: int = 0,
+) -> dict:
+    """Closed-loop (capacity) run: `workers` lanes, think-time 0, each
+    request's latency measured from its own dispatch. `call(i)`
+    performs request i. Returns the run record (see module doc)."""
+    if num_requests < 1:
+        raise ValueError(f"num_requests={num_requests} must be >= 1")
+
+    def lane(res: _LaneResult, idx) -> None:
+        while True:
+            i = next(idx)
+            if i >= num_requests:
+                return
+            res.observe(call, i, time.perf_counter_ns(), None)
+
+    lat, qage, counts, shed_by, wall_s, peak, util = _drive(
+        workers, lane
+    )
+    return _record(
+        "closed", num_requests, workers, seed, lat, qage, counts,
+        shed_by, wall_s, peak, util, offered_qps=None, arrival=None,
+        fingerprint=None,
+    )
+
+
+def run_open_loop(
+    call: Callable[[int], object],
+    schedule_ns: np.ndarray,
+    workers: int = 4,
+    seed: int = 0,
+    arrival: Optional[str] = None,
+    offered_qps: Optional[float] = None,
+) -> dict:
+    """Open-loop run over a deterministic arrival schedule
+    (arrival_schedule_ns). Request i fires no earlier than its
+    scheduled offset; its latency is measured FROM THE SCHEDULED
+    ARRIVAL, so dispatch lag and service queueing are charged to it
+    (coordinated-omission-safe). `queue_age` records dispatch lag
+    alone (actual fire − scheduled arrival). `offered_qps` defaults to
+    n / schedule span."""
+    schedule_ns = np.asarray(schedule_ns, dtype=np.int64)
+    n = int(schedule_ns.shape[0])
+    if n < 1:
+        raise ValueError("schedule_ns must hold at least one arrival")
+    if offered_qps is None:
+        span_s = float(schedule_ns[-1]) / 1e9
+        offered_qps = n / span_s if span_s > 0 else float(n)
+    t_start = time.perf_counter_ns()
+
+    def lane(res: _LaneResult, idx) -> None:
+        while True:
+            i = next(idx)
+            if i >= n:
+                return
+            target = t_start + int(schedule_ns[i])
+            now = time.perf_counter_ns()
+            if now < target:
+                time.sleep((target - now) / 1e9)
+                now = time.perf_counter_ns()
+            res.observe(call, i, target, max(now - target, 0))
+
+    lat, qage, counts, shed_by, wall_s, peak, util = _drive(
+        workers, lane
+    )
+    return _record(
+        "open", n, workers, seed, lat, qage, counts, shed_by, wall_s,
+        peak, util, offered_qps=offered_qps, arrival=arrival,
+        fingerprint=_schedule_fingerprint(schedule_ns),
+    )
+
+
+def merge_records(records: List[dict]) -> dict:
+    """Merges same-mode run records from independent processes/lanes
+    into one fleet record: counts and QPS sum, latency/queue-age
+    histograms merge exactly (log2 buckets are value-independent), and
+    percentiles are recomputed over the union. The merged record keeps
+    the first record's shape fields and lists the per-process seeds."""
+    if not records:
+        raise ValueError("no records to merge")
+    modes = {r["load_mode"] for r in records}
+    if len(modes) != 1:
+        raise ValueError(
+            f"refusing to merge across load modes: {sorted(modes)} "
+            "(a closed-loop capacity run and an open-loop latency run "
+            "measure different things)"
+        )
+    lat = LatencyHistogram()
+    qage = LatencyHistogram()
+    out = dict(records[0])
+    counts = {"ok": 0, "shed": 0, "timeouts": 0, "errors": 0}
+    shed_by: Dict[str, int] = {}
+    offered = 0.0
+    achieved = 0.0
+    any_offered = False
+    for r in records:
+        lat.merge(LatencyHistogram.from_dict(r["latency"]))
+        qage.merge(LatencyHistogram.from_dict(r["queue_age"]))
+        for k in counts:
+            counts[k] += int(r.get(k, 0))
+        for k, v in r.get("shed_by_reason", {}).items():
+            shed_by[k] = shed_by.get(k, 0) + int(v)
+        if r.get("offered_qps") is not None:
+            offered += float(r["offered_qps"])
+            any_offered = True
+        achieved += float(r.get("achieved_qps", 0.0))
+    p50, p99 = lat.percentile_ns(50), lat.percentile_ns(99)
+    qp99 = qage.percentile_ns(99)
+    out.update(
+        procs=len(records),
+        seeds=[r.get("seed") for r in records],
+        requests=sum(int(r["requests"]) for r in records),
+        workers=sum(int(r["workers"]) for r in records),
+        offered_qps=round(offered, 1) if any_offered else None,
+        achieved_qps=round(achieved, 1),
+        duration_s=round(
+            max(float(r["duration_s"]) for r in records), 4
+        ),
+        latency=lat.to_dict(),
+        queue_age=qage.to_dict(),
+        latency_p50_ns=round(p50, 1) if p50 is not None else None,
+        latency_p99_ns=round(p99, 1) if p99 is not None else None,
+        queue_age_p99_ns=round(qp99, 1) if qp99 is not None else 0.0,
+        serve_batcher_peak_bytes=sum(
+            int(r.get("serve_batcher_peak_bytes", 0)) for r in records
+        ),
+        shed_by_reason=dict(sorted(shed_by.items())),
+        schedule_fingerprint=None,
+        **counts,
+    )
+    return out
+
+
+def write_jsonl(path: str, records: List[dict]) -> None:
+    """Appends one JSON line per record — the per-run artifact
+    scripts/bench_diff.py can pair (records carry `load_mode`, which
+    joins the pairing shape, so closed- and open-loop runs never
+    cross-compare)."""
+    with open(path, "a") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")

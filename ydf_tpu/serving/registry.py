@@ -28,16 +28,39 @@ boundary, never silently fall back to the generic engine):
   * YDF_TPU_SERVE_MAX_BATCH (int >= 1, default 256) and
     YDF_TPU_SERVE_BATCH_TIMEOUT_US (float > 0, default 2000) — the
     request-coalescing batcher's size/deadline bounds.
+  * YDF_TPU_SERVE_MAX_QUEUE (int >= 0, default 0 = unbounded) — the
+    batcher's pending-row bound: a submit beyond it is REJECTED with
+    ServeOverloadError(reason="queue_full") instead of growing the
+    queue without limit (overload degrades p99, never OOMs).
+  * YDF_TPU_SERVE_MAX_QUEUE_BYTES (int >= 0, default 0 = off) — the
+    admission signal: a submit whose row would push the MemoryLedger's
+    `serve_batcher` gauge past this bound is rejected with
+    reason="admission".
+  * YDF_TPU_SERVE_DEADLINE_US (float >= 0, default 0 = off) — per-row
+    deadline: rows older than this at flush time are shed with
+    reason="deadline" instead of being served late.
+  * YDF_TPU_TRACE_SAMPLE (float in [0, 1], default 0) — per-request
+    journey-tracing sample rate. 0 keeps the exact zero-overhead
+    singleton span path; a sampled request records the chain
+    serve.request → batcher.enqueue (caller thread) and
+    batcher.flush → serve.kernel → batcher.fanout (flusher thread),
+    linked by a shared `req` id and carrying queue-age/batch labels.
+
+Sheds are counted in ydf_serve_shed_total{reason} and mirrored into a
+telemetry-independent module total for /statusz (docs/serving.md
+"Serving under load").
 """
 
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import os
+import random
 import threading
 import time
 import weakref
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 
 # --------------------------------------------------------------------- #
@@ -103,13 +126,101 @@ def _parse_force_quickscorer() -> None:
         )
 
 
+def _parse_serve_max_queue() -> int:
+    env = os.environ.get("YDF_TPU_SERVE_MAX_QUEUE")
+    if env is None:
+        return 0
+    try:
+        v = int(env)
+    except ValueError:
+        v = -1
+    if v < 0:
+        raise ValueError(
+            f"YDF_TPU_SERVE_MAX_QUEUE={env!r} must be an integer >= 0 "
+            "(0 = unbounded)"
+        )
+    return v
+
+
+def _parse_serve_max_queue_bytes() -> int:
+    env = os.environ.get("YDF_TPU_SERVE_MAX_QUEUE_BYTES")
+    if env is None:
+        return 0
+    try:
+        v = int(env)
+    except ValueError:
+        v = -1
+    if v < 0:
+        raise ValueError(
+            f"YDF_TPU_SERVE_MAX_QUEUE_BYTES={env!r} must be an integer "
+            ">= 0 (0 = no admission bound)"
+        )
+    return v
+
+
+def _parse_serve_deadline_us() -> float:
+    env = os.environ.get("YDF_TPU_SERVE_DEADLINE_US")
+    if env is None:
+        return 0.0
+    try:
+        v = float(env)
+    except ValueError:
+        v = -1.0
+    if v < 0:
+        raise ValueError(
+            f"YDF_TPU_SERVE_DEADLINE_US={env!r} must be a number >= 0 "
+            "(0 = no deadline)"
+        )
+    return v
+
+
+def resolve_trace_sample(value: Optional[object] = None) -> float:
+    """Resolves the journey-tracing sample rate: a float in [0, 1].
+    An explicit value wins; YDF_TPU_TRACE_SAMPLE selects globally;
+    default 0 (no sampling — the exact zero-overhead span path).
+    Invalid values raise — here AND at registry import."""
+    if value is None:
+        value = os.environ.get("YDF_TPU_TRACE_SAMPLE")
+    if value is None:
+        return 0.0
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        v = -1.0
+    if not 0.0 <= v <= 1.0:
+        raise ValueError(
+            f"YDF_TPU_TRACE_SAMPLE={value!r} must be a sampling rate "
+            "in [0, 1]"
+        )
+    return v
+
+
 # Import-time eager parse: a malformed serving knob fails the first
 # `import ydf_tpu.serving.registry` of the process, not a predict call
 # hours into serving (the YDF_TPU_HIST_IMPL / failpoints contract).
 SERVE_IMPL = resolve_serve_impl()
 SERVE_MAX_BATCH = _parse_serve_max_batch()
 SERVE_BATCH_TIMEOUT_US = _parse_serve_batch_timeout_us()
+SERVE_MAX_QUEUE = _parse_serve_max_queue()
+SERVE_MAX_QUEUE_BYTES = _parse_serve_max_queue_bytes()
+SERVE_DEADLINE_US = _parse_serve_deadline_us()
+TRACE_SAMPLE = resolve_trace_sample()
 _parse_force_quickscorer()
+
+
+class ServeOverloadError(RuntimeError):
+    """A request shed by the serving overload policy. `reason` names
+    the shed cause — "queue_full" (the bounded queue rejected the
+    submit), "admission" (the MemoryLedger `serve_batcher` gauge is
+    past YDF_TPU_SERVE_MAX_QUEUE_BYTES), or "deadline" (the row aged
+    past YDF_TPU_SERVE_DEADLINE_US before its flush, or an injected
+    `serve.flush` failpoint simulated exactly that). Callers fail FAST:
+    a shed is the overload policy working, not a serving fault — retry
+    against another replica or surface the rejection."""
+
+    def __init__(self, message: str, reason: str):
+        super().__init__(message)
+        self.reason = reason
 
 
 @dataclasses.dataclass(frozen=True)
@@ -153,18 +264,70 @@ def compatible_engines(model) -> List[EngineFactory]:
 # add per selection or batcher construction, independent of telemetry.
 _LAST_ENGINE = {"engine": None, "forced": False}
 _BATCHERS: "weakref.WeakSet[CoalescingBatcher]" = weakref.WeakSet()
+#: Guards _BATCHERS iteration vs concurrent construction/GC: a bare
+#: WeakSet raises "Set changed size during iteration" when a batcher
+#: is added (or a dead one collected) while the ledger pull source or
+#: /statusz walks it.
+_BATCHERS_LOCK = threading.Lock()
+
+
+def _live_batchers() -> "List[CoalescingBatcher]":
+    with _BATCHERS_LOCK:
+        return list(_BATCHERS)
+
+#: Shed accounting independent of telemetry (the /statusz serving
+#: section must say how much was shed even on a telemetry-off host);
+#: ydf_serve_shed_total{reason} mirrors it into the registry when
+#: telemetry is on.
+_SHED_TOTALS: Dict[str, int] = {}
+_SHED_LOCK = threading.Lock()
+
+#: The most recent load-run summary (serving/loadgen.py posts it) —
+#: the /statusz serving section's "what did the last load test say".
+_LAST_LOAD_RUN: Dict[str, Optional[dict]] = {"record": None}
+
+#: Sampled-request id source for the journey-trace span chain (the
+#: `req` label linking caller-thread and flusher-thread spans).
+_REQ_IDS = itertools.count(1)
+#: Sampling decisions need no statistical independence from anything —
+#: a module PRNG keeps them cheap and reproducible enough.
+_TRACE_RNG = random.Random(0x5EED)
+
+
+def _note_shed(reason: str, n: int = 1) -> None:
+    from ydf_tpu.utils import telemetry
+
+    with _SHED_LOCK:
+        _SHED_TOTALS[reason] = _SHED_TOTALS.get(reason, 0) + n
+    if telemetry.ENABLED:
+        telemetry.counter("ydf_serve_shed_total", reason=reason).inc(n)
+
+
+def shed_totals() -> Dict[str, int]:
+    """Process-lifetime shed counts by reason (telemetry-independent)."""
+    with _SHED_LOCK:
+        return dict(_SHED_TOTALS)
+
+
+def note_load_run(record: dict) -> None:
+    """Stores the latest load-run summary (serving/loadgen.py calls it
+    at the end of every run) for the /statusz serving section."""
+    _LAST_LOAD_RUN["record"] = dict(record)
+    _register_serving_status()
 
 
 def batcher_queue_bytes() -> int:
     """Bytes of rows currently queued in live CoalescingBatchers — the
     "serve_batcher" row of the memory ledger (pull source: sampled at
-    snapshot time only, never on the predict_one hot path). Scalars
-    count their numpy itemsize, plain Python scalars a nominal 8."""
+    snapshot time only, never on the predict_one hot path) and the
+    admission signal YDF_TPU_SERVE_MAX_QUEUE_BYTES is checked against.
+    Reads each batcher's byte counter — maintained under the batcher's
+    lock at enqueue/dequeue — NEVER iterating `_queue` itself (a
+    concurrent flush mutates the list mid-iteration). Scalars count
+    their numpy itemsize, plain Python scalars a nominal 8."""
     total = 0
-    for b in list(_BATCHERS):
-        for slot in list(b._queue):
-            for x in slot.row:
-                total += int(getattr(x, "nbytes", 8))
+    for b in _live_batchers():
+        total += b.queue_bytes()
     return total
 
 
@@ -178,20 +341,28 @@ _register_mem_source()
 
 
 def serving_status() -> dict:
-    """The serving process's /statusz section: selected engine and per-
-    batcher queue depth/bounds. Row/flush counters (the QPS source)
-    ride /metrics as ydf_serve_batcher_rows_total etc."""
+    """The serving process's /statusz section: selected engine, per-
+    batcher queue depth/bytes/bounds, shed totals by reason, and the
+    last load-run summary (serving/loadgen.py). Row/flush counters
+    (the QPS source) ride /metrics as ydf_serve_batcher_rows_total
+    etc."""
     return {
         "engine": _LAST_ENGINE["engine"],
         "forced": _LAST_ENGINE["forced"],
+        "shed_total": shed_totals(),
+        "last_load_run": _LAST_LOAD_RUN["record"],
         "batchers": [
             {
                 "depth": len(b._queue),
+                "queue_bytes": b.queue_bytes(),
                 "max_batch": b.max_batch,
+                "max_queue": b.max_queue,
+                "max_queue_bytes": b.max_queue_bytes,
                 "timeout_us": b.timeout_s * 1e6,
+                "deadline_us": b.deadline_ns / 1e3,
                 "closed": b._closed,
             }
-            for b in list(_BATCHERS)
+            for b in _live_batchers()
         ],
     }
 
@@ -388,7 +559,8 @@ register_engine(EngineFactory(
 class _Slot:
     """One pending single-row request."""
 
-    __slots__ = ("row", "result", "error", "event", "t0_ns")
+    __slots__ = ("row", "result", "error", "event", "t0_ns", "nbytes",
+                 "sampled", "req")
 
     def __init__(self, row):
         self.row = row
@@ -396,6 +568,9 @@ class _Slot:
         self.error = None
         self.event = threading.Event()
         self.t0_ns = time.perf_counter_ns()
+        self.nbytes = 0    # row bytes, charged to the queue counter
+        self.sampled = False  # journey-trace sample (YDF_TPU_TRACE_SAMPLE)
+        self.req = 0       # sampled-request id linking the span chain
 
 
 class CoalescingBatcher:
@@ -412,16 +587,34 @@ class CoalescingBatcher:
     batch. Bounds default to YDF_TPU_SERVE_MAX_BATCH /
     YDF_TPU_SERVE_BATCH_TIMEOUT_US (validated at import).
 
+    Overload policy (docs/serving.md "Serving under load"): the queue
+    is bounded by `max_queue` rows (reject-on-full) and — through the
+    MemoryLedger's `serve_batcher` gauge — by `max_queue_bytes`
+    (admission); rows older than `deadline_us` at flush time are shed
+    instead of served late. Every shed fails the caller FAST with a
+    typed ServeOverloadError carrying the reason, is counted in
+    ydf_serve_shed_total{reason}, and preserves the exact-once
+    contract for survivors (each remaining row still gets its own
+    result). The `serve.flush` failpoint injects a whole-flush
+    deadline shed for the chaos tests.
+
     Instrumented with the per-engine serving telemetry: each answered
     row observes its whole queue+kernel latency into
     ydf_serve_latency_ns{engine="Batcher", batch_pow2} so p50/p99
-    under concurrent load is measurable (docs/observability.md)."""
+    under concurrent load is measurable; the flusher keeps the
+    ydf_serve_queue_depth / ydf_serve_queue_oldest_age_ns gauges
+    current, and `trace_sample` (YDF_TPU_TRACE_SAMPLE) records the
+    per-request journey span chain (docs/observability.md)."""
 
     def __init__(
         self,
         batch_fn: Callable,
         max_batch: Optional[int] = None,
         timeout_us: Optional[float] = None,
+        max_queue: Optional[int] = None,
+        max_queue_bytes: Optional[int] = None,
+        deadline_us: Optional[float] = None,
+        trace_sample: Optional[float] = None,
     ):
         self.batch_fn = batch_fn
         self.max_batch = int(max_batch or SERVE_MAX_BATCH)
@@ -433,10 +626,33 @@ class CoalescingBatcher:
         if timeout_us <= 0:
             raise ValueError("timeout_us must be > 0")
         self.timeout_s = float(timeout_us) / 1e6
+        self.max_queue = int(
+            SERVE_MAX_QUEUE if max_queue is None else max_queue
+        )
+        if self.max_queue < 0:
+            raise ValueError("max_queue must be >= 0 (0 = unbounded)")
+        self.max_queue_bytes = int(
+            SERVE_MAX_QUEUE_BYTES if max_queue_bytes is None
+            else max_queue_bytes
+        )
+        if self.max_queue_bytes < 0:
+            raise ValueError("max_queue_bytes must be >= 0 (0 = off)")
+        deadline_us = (
+            SERVE_DEADLINE_US if deadline_us is None else deadline_us
+        )
+        if deadline_us < 0:
+            raise ValueError("deadline_us must be >= 0 (0 = off)")
+        self.deadline_ns = int(float(deadline_us) * 1e3)
+        self.trace_sample = (
+            TRACE_SAMPLE if trace_sample is None
+            else resolve_trace_sample(trace_sample)
+        )
         self._cv = threading.Condition()
         self._queue: List[_Slot] = []
+        self._queue_bytes = 0  # maintained under _cv at enqueue/dequeue
         self._closed = False
-        _BATCHERS.add(self)  # /statusz queue-depth visibility
+        with _BATCHERS_LOCK:
+            _BATCHERS.add(self)  # /statusz queue-depth visibility
         _register_serving_status()
         self._thread = threading.Thread(
             target=self._flusher_loop, daemon=True,
@@ -446,19 +662,83 @@ class CoalescingBatcher:
 
     # -- caller side --------------------------------------------------- #
 
+    def queue_bytes(self) -> int:
+        """Bytes of rows currently pending, from the counter maintained
+        under the lock (the race-free ledger/admission read)."""
+        with self._cv:
+            return self._queue_bytes
+
     def predict_one(self, *row):
         """Submits one row (its per-position arrays/scalars) and blocks
-        until the coalesced batch containing it is served."""
+        until the coalesced batch containing it is served — or fails
+        fast with ServeOverloadError when the overload policy sheds
+        it (queue_full / admission here, deadline at flush)."""
+        nb = 0
+        for x in row:
+            nb += int(getattr(x, "nbytes", 8))
+        if self.max_queue_bytes:
+            from ydf_tpu.utils import telemetry
+
+            held = telemetry.ledger().get_bytes("serve_batcher")
+            if held + nb > self.max_queue_bytes:
+                _note_shed("admission")
+                raise ServeOverloadError(
+                    f"admission rejected: serve_batcher holds {held} "
+                    f"bytes (+{nb} for this row) against "
+                    f"max_queue_bytes={self.max_queue_bytes}",
+                    reason="admission",
+                )
         slot = _Slot(row)
-        with self._cv:
-            if self._closed:
-                raise RuntimeError("batcher is closed")
-            self._queue.append(slot)
-            self._cv.notify_all()
+        slot.nbytes = nb
+        if self.trace_sample:
+            from ydf_tpu.utils import telemetry
+
+            if telemetry.ENABLED and (
+                self.trace_sample >= 1.0
+                or _TRACE_RNG.random() < self.trace_sample
+            ):
+                slot.sampled = True
+                slot.req = next(_REQ_IDS)
+                # Journey trace, caller half: serve.request covers the
+                # whole queue+kernel residence; batcher.enqueue the
+                # submit. The flusher half (batcher.flush →
+                # serve.kernel → batcher.fanout) links back via `req`.
+                with telemetry.span("serve.request") as sp:
+                    sp.set(req=slot.req)
+                    with telemetry.span("batcher.enqueue") as se:
+                        se.set(req=slot.req)
+                        self._enqueue(slot)
+                    slot.event.wait()
+                    if slot.error is not None:
+                        sp.set(outcome=type(slot.error).__name__)
+                if slot.error is not None:
+                    raise slot.error
+                return slot.result
+        self._enqueue(slot)
         slot.event.wait()
         if slot.error is not None:
             raise slot.error
         return slot.result
+
+    def _enqueue(self, slot: _Slot) -> None:
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            depth = len(self._queue)
+            if self.max_queue and depth >= self.max_queue:
+                full = True
+            else:
+                full = False
+                self._queue.append(slot)
+                self._queue_bytes += slot.nbytes
+                self._cv.notify_all()
+        if full:
+            _note_shed("queue_full")
+            raise ServeOverloadError(
+                f"queue full: {depth} pending rows at "
+                f"max_queue={self.max_queue}",
+                reason="queue_full",
+            )
 
     # -- flusher side -------------------------------------------------- #
 
@@ -480,10 +760,74 @@ class CoalescingBatcher:
                     self._cv.wait(remaining)
                 batch = self._queue[: self.max_batch]
                 del self._queue[: len(batch)]
+                for s in batch:
+                    self._queue_bytes -= s.nbytes
+                depth_after = len(self._queue)
+                oldest_age = (
+                    time.perf_counter_ns() - self._queue[0].t0_ns
+                    if self._queue else 0
+                )
             if batch:
-                self._flush(batch)
+                self._flush(batch, depth_after, oldest_age)
 
-    def _flush(self, batch: List[_Slot]):
+    def _flush(self, batch: List[_Slot], queue_depth: int = 0,
+               oldest_age_ns: int = 0):
+        from ydf_tpu.utils import failpoints, telemetry
+
+        if telemetry.ENABLED:
+            telemetry.gauge("ydf_serve_queue_depth").set(queue_depth)
+            telemetry.gauge("ydf_serve_queue_oldest_age_ns").set(
+                oldest_age_ns
+            )
+        injected = False
+        if failpoints.ENABLED:
+            try:
+                failpoints.hit("serve.flush")
+            except (failpoints.FailpointError, ConnectionError):
+                # Injected overload: this flush behaves as if every row
+                # aged past its deadline — shed THE WHOLE BATCH, serve
+                # the next (the chaos handle for the shed-fanout
+                # exact-once contract).
+                injected = True
+        now = time.perf_counter_ns()
+        if injected or self.deadline_ns:
+            shed = []
+            kept = []
+            for s in batch:
+                if injected or now - s.t0_ns > self.deadline_ns:
+                    shed.append(s)
+                else:
+                    kept.append(s)
+            if shed:
+                _note_shed("deadline", len(shed))
+                dl_us = self.deadline_ns / 1e3
+                for s in shed:
+                    s.error = ServeOverloadError(
+                        f"shed at flush after "
+                        f"{(now - s.t0_ns) / 1e3:.0f} us "
+                        f"(deadline {dl_us:.0f} us"
+                        f"{', injected' if injected else ''})",
+                        reason="deadline",
+                    )
+                    s.event.set()
+            batch = kept
+        if not batch:
+            return
+        traced = False
+        if self.trace_sample and telemetry.ENABLED:
+            traced = any(s.sampled for s in batch)
+        if traced:
+            with telemetry.span("batcher.flush") as fs:
+                fs.set(
+                    batch=len(batch),
+                    req=next(s.req for s in batch if s.sampled),
+                    queue_age_ns=now - batch[0].t0_ns,
+                )
+                self._serve_batch(batch, traced=True)
+        else:
+            self._serve_batch(batch, traced=False)
+
+    def _serve_batch(self, batch: List[_Slot], traced: bool):
         import numpy as np
 
         from ydf_tpu.utils import telemetry
@@ -493,7 +837,12 @@ class CoalescingBatcher:
                 np.stack([s.row[k] for s in batch])
                 for k in range(len(batch[0].row))
             )
-            out = np.asarray(self.batch_fn(*stacked))
+            if traced:
+                with telemetry.span("serve.kernel") as ks:
+                    ks.set(batch=len(batch))
+                    out = np.asarray(self.batch_fn(*stacked))
+            else:
+                out = np.asarray(self.batch_fn(*stacked))
             for j, s in enumerate(batch):
                 s.result = out[j]
         except BaseException as e:  # noqa: BLE001 - fanned back to callers
@@ -514,8 +863,14 @@ class CoalescingBatcher:
                 telemetry.counter(
                     "ydf_serve_batcher_rows_total"
                 ).inc(len(batch))
-            for s in batch:
-                s.event.set()
+            if traced:
+                with telemetry.span("batcher.fanout") as fo:
+                    fo.set(batch=len(batch))
+                    for s in batch:
+                        s.event.set()
+            else:
+                for s in batch:
+                    s.event.set()
 
     # -- lifecycle ----------------------------------------------------- #
 
@@ -537,11 +892,17 @@ def model_batcher(
     model,
     max_batch: Optional[int] = None,
     timeout_us: Optional[float] = None,
+    max_queue: Optional[int] = None,
+    max_queue_bytes: Optional[int] = None,
+    deadline_us: Optional[float] = None,
+    trace_sample: Optional[float] = None,
 ) -> CoalescingBatcher:
     """A CoalescingBatcher over the model's fastest compatible engine:
     rows are pre-encoded (x_num_row [Fn], x_cat_row [Fc]) vectors (the
     engine input contract); results are raw scores. Falls back to the
-    generic routed scan when no fast engine is compatible."""
+    generic routed scan when no fast engine is compatible. Overload
+    bounds and the journey-trace sample rate pass through to the
+    batcher (defaults: the YDF_TPU_SERVE_* env knobs)."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -561,4 +922,8 @@ def model_batcher(
                 )
             )[:, 0]
 
-    return CoalescingBatcher(fn, max_batch=max_batch, timeout_us=timeout_us)
+    return CoalescingBatcher(
+        fn, max_batch=max_batch, timeout_us=timeout_us,
+        max_queue=max_queue, max_queue_bytes=max_queue_bytes,
+        deadline_us=deadline_us, trace_sample=trace_sample,
+    )

@@ -120,6 +120,12 @@ KNOWN_SITES = frozenset(
         # the injected fault (export is observation): the chaos test
         # asserts a crashing exporter leaves training bit-identical.
         "telemetry.flush",
+        # serving/registry.py — the request batcher's flush. The
+        # injected fault is converted to a whole-batch deadline shed
+        # (ServeOverloadError to exactly that flush's rows, survivors
+        # of later flushes untouched) — the chaos handle for the
+        # overload fan-out's exact-once contract.
+        "serve.flush",
     }
 )
 

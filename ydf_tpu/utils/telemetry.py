@@ -297,6 +297,52 @@ class LatencyHistogram:
             )
         return out
 
+    def to_dict(self) -> Dict[str, object]:
+        """Sparse JSON form — nonzero [index, count] pairs plus the
+        exact extrema. Enough to reconstruct (from_dict) and merge
+        across processes: the multi-process load harness
+        (scripts/bench_serve_load.py) sums per-process histograms this
+        way, exactly like a scraper sums the cumulative `_bucket`
+        exposition series."""
+        return {
+            "buckets": [
+                [i, c] for i, c in enumerate(self.buckets) if c
+            ],
+            "count": self.count,
+            "sum_ns": self.total,
+            "min_ns": self.min,
+            "max_ns": self.max,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, object]) -> "LatencyHistogram":
+        h = LatencyHistogram()
+        for i, c in d.get("buckets", []):
+            h.buckets[int(i)] += int(c)
+        h.count = int(d.get("count", 0))
+        h.total = int(d.get("sum_ns", 0))
+        h.min = d.get("min_ns")
+        h.max = d.get("max_ns")
+        return h
+
+    def merge(self, other: "LatencyHistogram") -> None:
+        """Adds another histogram's mass. Bucket boundaries are
+        value-independent, so the merge is exact at bucket resolution
+        and percentiles of the union stay derivable."""
+        for i, c in enumerate(other.buckets):
+            if c:
+                self.buckets[i] += c
+        self.count += other.count
+        self.total += other.total
+        if other.min is not None and (
+            self.min is None or other.min < self.min
+        ):
+            self.min = other.min
+        if other.max is not None and (
+            self.max is None or other.max > self.max
+        ):
+            self.max = other.max
+
 
 _MetricKey = Tuple[str, Tuple[Tuple[str, str], ...]]
 

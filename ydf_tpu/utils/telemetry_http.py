@@ -13,8 +13,9 @@ be *scrapeable*: a tiny stdlib `http.server` thread serving
   /statusz   JSON snapshot of registered status providers — a worker
              reports its id, per-run (tree, layer) position stamp and
              shard ownership (`parallel/dist_worker.status`); a serving
-             process reports the selected engine and batcher depth
-             (`serving/registry.serving_status`).
+             process reports the selected engine, live batcher
+             depth/bytes/bounds, shed totals by reason and the last
+             load-run summary (`serving/registry.serving_status`).
 
 Enablement follows the failpoints/telemetry zero-overhead contract:
 
