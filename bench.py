@@ -789,6 +789,135 @@ def measure_serving_load_family(model, data, rows, record):
         record["serve_load_family_error"] = f"{type(e).__name__}: {e}"
 
 
+def measure_fleet_family(model, data, rows, record):
+    """Serving-FLEET bench family (serving/fleet.py — ROADMAP item 1's
+    tier half): a replica pool over the RPC worker substrate, driven by
+    the round-16 load generator at sustained QPS across a versioned
+    hot-swap. Headline fields:
+
+      fleet_replicas          replica count (YDF_TPU_BENCH_FLEET_REPLICAS,
+                              default 2, 0 disables the family; part of
+                              the bench-diff pairing shape so 2-replica
+                              and 4-replica rounds never cross-compare)
+      fleet_sustained_qps     closed-loop capacity through the router: 4
+                              lanes, think-time 0, single-row predicts
+                              spread round-robin over the replicas
+      fleet_swap_p99_ns       accepted-request p99 of the SAME run —
+                              which spans a mid-run hot-swap to a
+                              second model version, so the tail carries
+                              whatever the flip cost (zero-downtime
+                              means it stays bounded)
+      fleet_failover_count    failovers the run needed (0 on a healthy
+                              in-process fleet)
+
+    The run detail (swap result, shed/error counts, router status)
+    rides record["fleet"]. Replicas are in-process localhost workers —
+    like the distributed family, this measures PROTOCOL cost, not
+    scaling; a multi-host fleet is where replica-count speedup appears.
+    Failures recorded, never fatal."""
+    env = os.environ.get("YDF_TPU_BENCH_FLEET_REPLICAS")
+    try:
+        nrep = int(env) if env else 2
+        if nrep < 0 or nrep == 1:
+            raise ValueError
+    except ValueError:
+        record["fleet_family_error"] = (
+            f"YDF_TPU_BENCH_FLEET_REPLICAS={env!r} must be an integer "
+            ">= 2 (or 0 to disable the fleet family)"
+        )
+        return
+    if nrep == 0:
+        return
+    import socket as _socket
+    import threading
+
+    import numpy as np
+
+    from ydf_tpu.dataset.dataset import Dataset
+
+    try:
+        from ydf_tpu.parallel.worker_service import (
+            WorkerPool,
+            start_worker,
+        )
+        from ydf_tpu.serving import loadgen
+        from ydf_tpu.serving.fleet import FleetRouter
+
+        sample = {k: v[: min(rows, 2048)] for k, v in data.items()}
+        ds = Dataset.from_data(sample, dataspec=model.dataspec)
+        x_num, x_cat, _ = model._encode_inputs(ds)
+        x_num = np.ascontiguousarray(x_num)
+        x_cat = np.ascontiguousarray(x_cat)
+        n_av = x_num.shape[0]
+        ports = []
+        for _ in range(nrep):
+            s = _socket.socket()
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+            s.close()
+        for p in ports:
+            start_worker(p, host="127.0.0.1", blocking=False)
+        addrs = [f"127.0.0.1:{p}" for p in ports]
+        router = FleetRouter(addrs)
+        try:
+            router.deploy(model, "bench_v1")
+            # The swap target: the same forest under a new version id —
+            # the swap mechanics (ship, verify, flip, drain, free) are
+            # identical, and bit-identity across the flip is trivially
+            # checkable.
+            router.deploy(model, "bench_v2", activate=False)
+            n_req = 600
+            swap_at = n_req // 3
+            swap_result = {}
+            swap_thread = []
+            swap_lock = threading.Lock()
+
+            def do_swap():
+                swap_result.update(router.swap_to("bench_v2"))
+
+            def call(i):
+                if i == swap_at:
+                    with swap_lock:
+                        if not swap_thread:
+                            t = threading.Thread(
+                                target=do_swap, daemon=True
+                            )
+                            t.start()
+                            swap_thread.append(t)
+                j = i % n_av
+                router.predict(
+                    x_num[j: j + 1], x_cat[j: j + 1], req_id=i
+                )
+
+            closed = loadgen.run_closed_loop(
+                call, n_req, workers=4, seed=0
+            )
+            for t in swap_thread:
+                t.join(timeout=30)
+            status = router.status()
+            record["fleet_replicas"] = nrep
+            record["fleet_sustained_qps"] = closed["achieved_qps"]
+            record["fleet_swap_p99_ns"] = closed["latency_p99_ns"]
+            record["fleet_failover_count"] = status["failovers"]
+            record["fleet"] = {
+                "swap": swap_result,
+                "errors": closed["errors"],
+                "shed": closed["shed"],
+                "ok": closed["ok"],
+                "active_version": status["active_version"],
+                "swaps": status["swaps"],
+                "latency_ns": status["latency_ns"],
+            }
+        finally:
+            router.close()
+            try:
+                WorkerPool(addrs, timeout_s=10.0).shutdown_all()
+            except Exception:
+                pass
+    except Exception as e:
+        record["fleet_family_error"] = f"{type(e).__name__}: {e}"
+
+
 def measure_distributed_family(rows, trees, depth, features, record):
     """Distributed training measurement (ROADMAP item 2's bench half),
     gated on YDF_TPU_BENCH_DIST_WORKERS=N (N >= 2): spins N in-process
@@ -1124,6 +1253,10 @@ def run_bench(backend, rows, trees, depth, features, with_baseline, probe_log):
     # Serving-under-load family: sustained QPS + coordinated-omission-
     # safe open-loop tail through the bounded request batcher.
     measure_serving_load_family(model, data, rows, record)
+    _PARTIAL = dict(record)
+    # Serving-fleet family: replica pool over the worker substrate,
+    # sustained QPS across a mid-run versioned hot-swap.
+    measure_fleet_family(model, data, rows, record)
     _PARTIAL = dict(record)
     # Distributed-training family (ROADMAP item 2's measurement half):
     # only runs when YDF_TPU_BENCH_DIST_WORKERS is set.

@@ -13,12 +13,13 @@ exactly that failure mode. This tool:
     JSONL of records, or a single record object;
   * keeps only MEASURED headline records (projections and error records
     dropped) and pairs them **by record shape**
-    `(metric, backend, rows, trees, depth, dist_mode, load_mode)` —
-    records whose shape appears in only one round are listed as
-    unpaired, NEVER diffed (the confound class is dead by
+    `(metric, backend, rows, trees, depth, dist_mode, load_mode,
+    fleet_replicas)` — records whose shape appears in only one round
+    are listed as unpaired, NEVER diffed (the confound class is dead by
     construction); `load_mode` keeps serving-load artifacts
     (scripts/bench_serve_load.py) pairing closed-with-closed and
-    open-with-open only;
+    open-with-open only, and `fleet_replicas` keeps fleet rounds
+    pairing at identical replica count;
   * diffs every per-stage field two paired records share —
     `ingest_s`…`fused_s`, the serving latencies/QPS, the `dist_*`
     family, and the round-15 utilization/memory fields
@@ -56,10 +57,13 @@ from typing import Dict, List, Optional, Tuple
 #: different exchanges — protocol bytes, merge domains, shard
 #: residency); load_mode joins it so a serving-load artifact's
 #: closed-loop capacity run never pairs with an open-loop latency run
-#: (scripts/bench_serve_load.py emits both per round). Records without
-#: those families carry neither key and pair exactly as before.
+#: (scripts/bench_serve_load.py emits both per round); fleet_replicas
+#: joins it so a 2-replica fleet round never pairs with a 4-replica one
+#: (per-replica QPS scales with the pool — comparing across counts is
+#: the same confound class). Records without those families carry
+#: neither key and pair exactly as before.
 SHAPE_FIELDS = ("metric", "backend", "rows", "trees", "depth",
-                "dist_mode", "load_mode")
+                "dist_mode", "load_mode", "fleet_replicas")
 
 #: field (or dotted-prefix, trailing ".") -> (direction, rel_noise,
 #: abs_floor). direction "lower" = smaller is better. A change is a
@@ -103,6 +107,13 @@ FIELD_SPECS: Dict[str, Tuple[str, float, float]] = {
     "serve_load_p99_ns": ("lower", 0.25, 500.0),
     "serve_queue_age_p99_ns": ("lower", 0.25, 500.0),
     "serve_shed_rate": ("lower", 0.10, 0.01),
+    # serving-fleet family (bench.py measure_fleet_family): sustained
+    # capacity through the replica router up is good; the p99 of the
+    # run spanning the hot-swap and the failover count down is good
+    # (fleet_replicas itself is a SHAPE field, never diffed).
+    "fleet_sustained_qps": ("higher", 0.15, 0.0),
+    "fleet_swap_p99_ns": ("lower", 0.25, 500.0),
+    "fleet_failover_count": ("lower", 0.50, 0.5),
     # loadgen artifact records (load_mode in the pairing shape)
     "achieved_qps": ("higher", 0.15, 0.0),
     "latency_p50_ns": ("lower", 0.15, 100.0),

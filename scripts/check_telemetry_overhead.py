@@ -61,6 +61,7 @@ def run_check(
     with_ledger: bool = False,
     with_dist_row: bool = False,
     with_serve_load: bool = False,
+    with_fleet: bool = False,
 ) -> dict:
     import numpy as np
 
@@ -124,6 +125,68 @@ def run_check(
 
         load_once()  # warm the engine bank / code paths
 
+    fleet_once = None
+    fleet_cleanup = None
+    if with_fleet:
+        # Serving-fleet variant: a 2-replica in-process fleet (real RPC
+        # over localhost sockets) serving single-row predicts through
+        # the FleetRouter's round-robin/failover path. The enabled
+        # measurement must fit the same budget against the
+        # telemetry-off fleet — the delta is exactly the router's
+        # per-request instrumentation (per-version latency histograms,
+        # predict counters) plus the worker-side request spans.
+        import socket as _socket
+
+        from ydf_tpu.dataset.dataset import Dataset as _FDS
+        from ydf_tpu.parallel.worker_service import (
+            WorkerPool as _FWP,
+            start_worker as _f_start_worker,
+        )
+        from ydf_tpu.serving.fleet import FleetRouter
+
+        fm = ydf.GradientBoostedTreesLearner(
+            label="label", num_trees=trees, max_depth=depth,
+            validation_ratio=0.0, early_stopping="NONE",
+        ).train(ds)
+        fenc = _FDS.from_data(
+            {k: v[:512] for k, v in data.items()}, dataspec=fm.dataspec,
+        )
+        fx_num, fx_cat, _ = fm._encode_inputs(fenc)
+        fx_num = np.ascontiguousarray(fx_num)
+        fx_cat = np.ascontiguousarray(fx_cat)
+        f_av = fx_num.shape[0]
+        f_ports = []
+        for _ in range(2):
+            s = _socket.socket()
+            s.bind(("127.0.0.1", 0))
+            f_ports.append(s.getsockname()[1])
+            s.close()
+        for p in f_ports:
+            _f_start_worker(p, host="127.0.0.1", blocking=False)
+        f_addrs = [f"127.0.0.1:{p}" for p in f_ports]
+        f_router = FleetRouter(f_addrs)
+        f_router.deploy(fm, "overhead_v1")
+
+        def fleet_once():
+            from ydf_tpu.serving import loadgen
+
+            def call(i):
+                j = i % f_av
+                f_router.predict(
+                    fx_num[j: j + 1], fx_cat[j: j + 1], req_id=i
+                )
+
+            loadgen.run_closed_loop(call, 400, workers=4, seed=0)
+
+        def fleet_cleanup():
+            f_router.close()
+            try:
+                _FWP(f_addrs, timeout_s=10.0).shutdown_all()
+            except Exception:
+                pass
+
+        fleet_once()  # warm the replica banks / code paths
+
     train_dist = None
     dist_cleanup = None
     if with_dist_row:
@@ -180,12 +243,16 @@ def run_check(
     disabled_load = (
         measure_min_wall(load_once, reps) if load_once else None
     )
+    disabled_fleet = (
+        measure_min_wall(fleet_once, reps) if fleet_once else None
+    )
     td = tempfile.mkdtemp(prefix="ydf_tel_overhead_")
     enabled_http = None
     enabled_ledger = None
     ledger_snap = None
     enabled_dist = None
     enabled_load = None
+    enabled_fleet = None
     try:
         with telemetry.active(td):
             enabled = measure_min_wall(train_once, reps)
@@ -195,6 +262,8 @@ def run_check(
                 enabled_load = measure_min_wall(
                     lambda: load_once(trace_sample=1.0), reps
                 )
+            if fleet_once is not None:
+                enabled_fleet = measure_min_wall(fleet_once, reps)
             if with_ledger:
                 # Ledger-accounting variant: RSS sampling at span
                 # boundaries FORCED on (it defaults on, but the check
@@ -307,6 +376,20 @@ def run_check(
         summary["serve_load_budget_s"] = round(load_budget, 4)
         summary["ok_serve_load"] = load_overhead <= load_budget
         summary["ok"] = summary["ok"] and summary["ok_serve_load"]
+    if enabled_fleet is not None:
+        # The fleet run is its own baseline: the telemetry-off router
+        # pays the same RPC round-trips and rotation, so the delta is
+        # exactly the per-request fleet instrumentation.
+        fleet_overhead = enabled_fleet - disabled_fleet
+        fleet_budget = rel_budget * disabled_fleet + noise + abs_floor_s
+        summary["disabled_fleet_min_s"] = round(disabled_fleet, 4)
+        summary["enabled_fleet_min_s"] = round(enabled_fleet, 4)
+        summary["fleet_overhead_s"] = round(fleet_overhead, 4)
+        summary["fleet_budget_s"] = round(fleet_budget, 4)
+        summary["ok_fleet"] = fleet_overhead <= fleet_budget
+        summary["ok"] = summary["ok"] and summary["ok_fleet"]
+    if fleet_cleanup is not None:
+        fleet_cleanup()
     if dist_cleanup is not None:
         dist_cleanup()
     return summary
@@ -340,6 +423,12 @@ def main(argv=None) -> int:
                          "vs on with YDF_TPU_TRACE_SAMPLE-style "
                          "journey tracing at rate 1.0 — must fit the "
                          "same 3%% budget")
+    ap.add_argument("--with-fleet", action="store_true",
+                    help="additionally measure a 2-replica serving "
+                         "fleet predict path (serving/fleet.py over "
+                         "in-process localhost workers) telemetry-off "
+                         "vs on — the router/replica instrumentation "
+                         "must fit the same 3%% budget (ok_fleet)")
     args = ap.parse_args(argv)
     summary = run_check(
         rows=args.rows, trees=args.trees, depth=args.depth,
@@ -347,6 +436,7 @@ def main(argv=None) -> int:
         with_http=args.with_http, with_ledger=args.with_ledger,
         with_dist_row=args.with_dist_row,
         with_serve_load=args.with_serve_load,
+        with_fleet=args.with_fleet,
     )
     print(json.dumps(summary))
     return 0 if summary["ok"] else 1

@@ -296,3 +296,50 @@ def test_markdown_mentions_unpaired_confound_warning(bd):
     md = bd.to_markdown(doc)
     assert "NOT compared" in md
     assert "640" in md  # the lesson is named in the report itself
+
+
+def _fleet_record(replicas, qps, swap_p99, failovers):
+    """A headline record carrying the serving-fleet family
+    (fleet_replicas joins the pairing shape)."""
+    return {
+        "metric": "gbt_train_rows_x_trees_per_sec_per_chip",
+        "backend": "cpu", "rows": 20_000, "trees": 5, "depth": 6,
+        "fleet_replicas": replicas, "value": 1.0,
+        "fleet_sustained_qps": qps, "fleet_swap_p99_ns": swap_p99,
+        "fleet_failover_count": failovers,
+    }
+
+
+def test_fleet_replicas_joins_pairing_shape_and_fields_directional(
+    bd, tmp_path
+):
+    """fleet_replicas is a SHAPE field: a 2-replica round never pairs
+    with a 4-replica one (per-replica QPS scales with the pool — the
+    same confound class load_mode guards against). The fleet fields
+    are direction-aware: capacity down and swap-spanning p99 /
+    failover count up are regressions."""
+    a = [_fleet_record(2, 50_000.0, 2_000_000.0, 0)]
+    b = [_fleet_record(4, 90_000.0, 2_100_000.0, 0)]
+    pa, pb = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    pa.write_text(json.dumps(a[0]) + "\n")
+    pb.write_text(json.dumps(b[0]) + "\n")
+    doc = bd.diff(str(pa), str(pb))
+    assert doc["pairs"] == []
+    assert any("fleet_replicas=2" in s for s in doc["unpaired_a"])
+    assert any("fleet_replicas=4" in s for s in doc["unpaired_b"])
+    # Same replica count pairs; regression directions honored.
+    worse = _fleet_record(2, 30_000.0, 9_000_000.0, 3)
+    pb.write_text(json.dumps(worse) + "\n")
+    doc2 = bd.diff(str(pa), str(pb))
+    assert len(doc2["pairs"]) == 1
+    flagged = " ".join(doc2["regressions"])
+    assert "fleet_sustained_qps" in flagged
+    assert "fleet_swap_p99_ns" in flagged
+    assert "fleet_failover_count" in flagged
+    # Improvements flow the other way and stay ok.
+    better = _fleet_record(2, 70_000.0, 1_200_000.0, 0)
+    pb.write_text(json.dumps(better) + "\n")
+    doc3 = bd.diff(str(pa), str(pb))
+    assert doc3["ok"], doc3["regressions"]
+    imp = " ".join(doc3["improvements"])
+    assert "fleet_sustained_qps" in imp and "fleet_swap_p99_ns" in imp

@@ -107,6 +107,22 @@ def test_small_cpu_run_emits_parseable_record():
     assert rec["serve_load"]["closed"]["load_mode"] == "closed"
     assert rec["serve_load"]["open"]["load_mode"] == "open"
     assert rec["serve_load"]["open"]["schedule_fingerprint"]
+    # Serving-fleet family (this round): a 2-replica pool over the
+    # worker substrate, closed-loop capacity through the router with a
+    # mid-run versioned hot-swap — replica count (a bench-diff pairing
+    # shape field), sustained QPS, the p99 of the run spanning the
+    # swap, and the failover count (0 on a healthy in-process fleet).
+    # Zero errors/sheds attributable to the flip.
+    assert rec.get("fleet_family_error") is None, rec.get(
+        "fleet_family_error"
+    )
+    assert rec["fleet_replicas"] == 2
+    assert rec["fleet_sustained_qps"] > 0
+    assert rec["fleet_swap_p99_ns"] > 0
+    assert rec["fleet_failover_count"] == 0
+    assert rec["fleet"]["errors"] == 0 and rec["fleet"]["shed"] == 0
+    assert rec["fleet"]["swap"]["to"] == "bench_v2"
+    assert rec["fleet"]["active_version"] == "bench_v2"
     # Resource observability (round 15): pool utilization per stage —
     # busy / (lanes x pooled wall) from native/thread_pool.h's stats
     # block — and the memory headline fields. On this image the native

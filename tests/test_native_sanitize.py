@@ -279,6 +279,39 @@ with _tempfile.TemporaryDirectory() as _td:
                                   np.asarray(_fh[_k])), _k
     assert _m_hyb.training_logs["distributed"]["mode"] == "hybrid"
     WorkerPool([f"127.0.0.1:{_port}"]).shutdown_all()
+
+# Serving-fleet swap + failover cycle under the sanitizer (fleet
+# round): two in-process replicas hold sanitized native banks; a
+# versioned hot-swap (load alongside -> flip -> drain -> free, the
+# bank free path under asan) and a replica kill mid-traffic (failover
+# through the rotation) both run with responses bit-checked against
+# the engine oracle of whichever version served them.
+from ydf_tpu.serving.fleet import FleetRouter
+_f_ports = []
+for _ in range(2):
+    _fs = _socket.socket(); _fs.bind(("127.0.0.1", 0))
+    _f_ports.append(_fs.getsockname()[1]); _fs.close()
+for _fp in _f_ports:
+    start_worker(_fp, host="127.0.0.1", blocking=False)
+_f_addrs = [f"127.0.0.1:{p}" for p in _f_ports]
+_router = FleetRouter(_f_addrs)
+_router.deploy(mn, "san_v1")
+_router.deploy(m, "san_v2", activate=False)
+_o1 = np.asarray(engn(xn_num, xn_cat), np.float32)
+_o2 = np.asarray(eng(x_num, x_cat), np.float32)
+_r1, _v1 = _router.predict_versioned(xn_num, xn_cat)
+assert _v1 == "san_v1" and np.array_equal(_r1, _o1)
+_swap = _router.swap_to("san_v2")
+assert _swap["to"] == "san_v2" and _swap["freed_bytes"] > 0, _swap
+_r2, _v2 = _router.predict_versioned(x_num, x_cat)
+assert _v2 == "san_v2" and np.array_equal(_r2, _o2)
+WorkerPool([_f_addrs[0]]).shutdown_all()
+_time.sleep(0.1)
+for _k in range(6):  # failover: dead replica quarantined, traffic moves
+    _rk, _vk = _router.predict_versioned(x_num, x_cat)
+    assert _vk == "san_v2" and np.array_equal(_rk, _o2)
+_router.close()
+WorkerPool([_f_addrs[1]]).shutdown_all()
 print("SANITIZE_RUN_OK", mode)
 """
 

@@ -51,6 +51,24 @@ def test_enabled_overhead_within_budget():
     )
 
 
+def test_fleet_overhead_within_budget():
+    """Serving-fleet variant (`--with-fleet`): the router/replica
+    instrumentation of a 2-replica fleet predict path (per-version
+    latency histograms, predict/failover counters, worker request
+    spans) must fit the same 3% + noise budget against the
+    telemetry-off fleet baseline — the same RPC round-trips either
+    way, so the delta is exactly the instrumentation."""
+    mod = _load()
+    summary = mod.run_check(rows=4_000, trees=4, depth=4, reps=2,
+                            with_fleet=True)
+    assert summary["disabled_fleet_min_s"] > 0
+    assert summary["enabled_fleet_min_s"] > 0
+    assert summary["ok_fleet"], (
+        "serving-fleet telemetry overhead exceeded its budget: "
+        f"{summary}"
+    )
+
+
 def test_dist_row_overhead_within_budget():
     """Row-parallel distributed variant (`--with-dist-row`): the
     per-layer dist.layer spans, merge accounting and RPC latency

@@ -447,3 +447,29 @@ def test_load_data_all_serializes_once(monkeypatch):
     pool.load_data_all("k1", d, d)
     assert calls["n"] == 1
     pool.shutdown_all()
+
+
+def test_next_worker_round_robin_spreads_after_quarantine():
+    """The pick_worker fix (fleet round): a caller that always scanned
+    from a fixed start dumped every rerouted request on the FIRST
+    healthy worker after a quarantine. next_worker's rotating cursor
+    spreads consecutive picks across the whole healthy rotation — the
+    FleetRouter's load-spreading pick. Pure health-map exercise, no
+    sockets (clean workers are picked without probing)."""
+    pool = WorkerPool(["h:1", "h:2", "h:3"])
+    # Healthy fleet: strict rotation.
+    assert [pool.next_worker() for _ in range(6)] == [0, 1, 2, 0, 1, 2]
+    # Quarantine worker 1: picks STILL spread across both healthy
+    # workers instead of funneling onto one.
+    pool.mark_failed(1)
+    picks = [pool.next_worker() for _ in range(12)]
+    assert 1 not in picks
+    from collections import Counter
+
+    counts = Counter(picks)
+    assert counts[0] >= 4 and counts[2] >= 4, counts
+    # Healing restores the full rotation.
+    pool.mark_ok(1)
+    assert sorted(set(pool.next_worker() for _ in range(6))) == [0, 1, 2]
+    # And the fixed-start scan is unchanged for callers that pin.
+    assert pool.pick_worker(2) == 2

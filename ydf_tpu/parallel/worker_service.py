@@ -353,6 +353,16 @@ def _handle_request(
         return {"ok": True, "score": float(sign * value), "metric": metric}
     if verb == "shutdown":
         return {"ok": True, "shutdown": True}
+    from ydf_tpu.serving import replica as serve_replica
+
+    if verb in serve_replica.VERBS:
+        # Serving-fleet verbs (serve_load_bank / serve_predict /
+        # serve_swap / serve_unload / serve_status) — the replica half
+        # of serving/fleet.py, kept in its own module so this service
+        # stays a transport. State is namespaced per worker instance
+        # like the distributed verbs' (several in-process replicas must
+        # hold separate banks and active-version pointers).
+        return serve_replica.handle(verb, req, worker_id=wid)
     from ydf_tpu.parallel import dist_worker
 
     if verb in dist_worker.VERBS:
@@ -400,11 +410,16 @@ def start_worker(
     def _worker_status(wid=ctx["worker_id"]):
         from ydf_tpu.config import resolved_env_config
         from ydf_tpu.parallel import dist_worker
+        from ydf_tpu.serving import replica as serve_replica
 
         return {
             "worker_id": wid,
             "listening": not stop_evt.is_set(),
             "dist": dist_worker.status(wid),
+            # Model-version section: which serving-bank versions this
+            # replica holds and which one it is actively serving — the
+            # hot-swap verification read (serving/replica.py).
+            "serving_fleet": serve_replica.status(wid),
             # Resolved env knobs: the manager compares its own against
             # each worker's at shard-load time (config drift used to be
             # invisible until it surfaced as a perf/bit report).
@@ -581,6 +596,14 @@ class WorkerPool:
         # Jitter only — never part of any result, so an unseeded RNG
         # keeps trial outcomes deterministic.
         self._jitter = random.Random(0xFA17)
+        # Round-robin rotation cursor for next_worker(): pick_worker
+        # scans from whatever start the CALLER chose, so a caller that
+        # always passes the same start (the pre-fleet pattern) dumps
+        # every rerouted request on the first healthy worker after a
+        # quarantine. next_worker advances this cursor per call, so
+        # consecutive picks spread across the healthy rotation.
+        self._rr = 0
+        self._rr_lock = threading.Lock()
 
     def request(
         self, i: int, req: Dict[str, Any],
@@ -640,12 +663,38 @@ class WorkerPool:
         with self._health_lock:
             self._health.pop(addr, None)
 
+    def is_quarantined(self, i: int) -> bool:
+        """True while worker i's quarantine hold is still running (it
+        will not be picked and has not yet earned a re-probe). The
+        fleet's swap rollout reads this to skip dead replicas instead
+        of blocking a deploy on them."""
+        addr = self.addresses[i % len(self.addresses)]
+        with self._health_lock:
+            st = self._health.get(addr)
+            return bool(st is not None and st["until"] > time.monotonic())
+
+    def next_worker(self) -> Optional[int]:
+        """Next usable worker under ROUND-ROBIN rotation: an internal
+        cursor advances one slot per call, so consecutive picks spread
+        across every healthy worker instead of re-scanning from a
+        caller-fixed start (which, after a quarantine, funneled all
+        rerouted traffic onto the same first-healthy worker). The
+        load-spreading pick of the serving fleet's router
+        (serving/fleet.py); same health/re-probe semantics as
+        pick_worker, None when everything is quarantined."""
+        with self._rr_lock:
+            start = self._rr
+            self._rr = (self._rr + 1) % len(self.addresses)
+        return self.pick_worker(start)
+
     def pick_worker(self, start: int) -> Optional[int]:
-        """Next usable worker index at/after `start` (round-robin).
-        Skips quarantined workers; one whose quarantine has EXPIRED is
-        re-probed with a short ping first — success heals it, failure
-        re-quarantines with a doubled backoff. None when every worker
-        is currently quarantined (caller backs off and retries)."""
+        """First usable worker index at/after `start` (scan order is
+        fixed by `start` — callers wanting load SPREADING across calls
+        use next_worker()'s rotating cursor instead). Skips quarantined
+        workers; one whose quarantine has EXPIRED is re-probed with a
+        short ping first — success heals it, failure re-quarantines
+        with a doubled backoff. None when every worker is currently
+        quarantined (caller backs off and retries)."""
         n = len(self.addresses)
         for off in range(n):
             i = (start + off) % n

@@ -19,9 +19,32 @@ cpp_target_lowering.cc):
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 from typing import Callable, List, Optional, Tuple
 
 import numpy as np
+
+
+def forest_fingerprint(forest) -> str:
+    """Content fingerprint of a forest's node arrays (16 hex chars) —
+    the model identity a serving replica reports and a fleet deploy
+    verifies (docs/serving.md "Serving fleet"). Computed over the
+    field-name-sorted numpy form (dtype + shape + bytes per array), so
+    it is stable across processes, wire round-trips (model.serialize /
+    deserialize_model) and jax-vs-numpy residency — two banks with the
+    same fingerprint route identically by construction. Accepts a
+    Forest or its to_numpy() dict."""
+    d = forest.to_numpy() if hasattr(forest, "to_numpy") else dict(forest)
+    h = hashlib.sha1()
+    for k in sorted(d):
+        if d[k] is None:
+            continue
+        a = np.ascontiguousarray(np.asarray(d[k]))
+        h.update(k.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()[:16]
 
 
 @dataclasses.dataclass

@@ -1,0 +1,670 @@
+"""Serving fleet: a replica pool with versioned zero-downtime hot-swap
+and shadow/canary routing.
+
+ROADMAP item 1's composition round: every primitive already existed —
+the RPC worker substrate with retry/backoff/quarantine
+(`parallel/worker_service.py`), the flatten-once serving banks
+(`serving/native_serve.py`), the load harness (`serving/loadgen.py`)
+— and this module composes them into a serving *tier*:
+
+  * **FleetRouter** spreads predict traffic across healthy replicas
+    (worker processes holding `serving/replica.py` banks) through the
+    pool's round-robin rotation (`WorkerPool.next_worker`); a dead
+    replica's requests fail over to the next healthy one with
+    exactly-once RESULTS — predict is a pure function of (model
+    version, rows), so a retried request returns the identical bits
+    and the caller observes exactly one answer per request.
+  * **Versioned hot-swap** (`swap_to`): ship version B to every
+    replica alongside A (`deploy`), verify each replica holds B at the
+    expected forest fingerprint, flip every replica's atomic
+    active-version pointer (`serve_swap` — flip ONLY), then drain and
+    free A (`serve_unload` releases the bank's `serve_bank` ledger
+    bytes). A mid-rollout failure (chaos site `fleet.swap`) rolls the
+    flipped replicas back to A — A was never unloaded before the last
+    flip succeeded, so the old version keeps serving and no request
+    ever fails because of the flip (docs/serving.md "Serving fleet",
+    hot-swap state machine; proven under load by tests/test_fleet.py).
+  * **Shadow/canary splits** (`set_split`): a deterministic seeded
+    per-request hash routes `fraction` of traffic to version B
+    (canary — B's answers are returned) or duplicates it to B and
+    discards the result (shadow — A still answers), with per-version
+    latency histograms and a prediction-divergence counter
+    (`ydf_fleet_divergence_total`) for canary validation.
+
+Telemetry: `ydf_fleet_predict_total{version,route}`,
+`ydf_fleet_predict_latency_ns{version}`, `ydf_fleet_failover_total`,
+`ydf_fleet_swap_total`, `ydf_fleet_swap_latency_ns`,
+`ydf_fleet_divergence_total`; swap rollouts and failovers record
+`fleet.swap` / `fleet.failover` spans into the merged trace, and the
+router registers a `fleet` /statusz section (docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ydf_tpu.parallel.worker_service import WorkerPool, _encode_frame
+from ydf_tpu.utils import failpoints, telemetry, telemetry_http
+from ydf_tpu.utils.telemetry import LatencyHistogram
+
+__all__ = [
+    "FleetError",
+    "FleetSwapError",
+    "FleetRouter",
+    "fleet_batcher",
+]
+
+_SPLIT_MODES = ("canary", "shadow")
+
+
+class FleetError(RuntimeError):
+    """A fleet request that could not be served (every replica failed,
+    or a replica answered a protocol-level refusal)."""
+
+
+class FleetSwapError(FleetError):
+    """A hot-swap rollout that aborted. The router rolled every flipped
+    replica back to the previous version before raising, so the old
+    version keeps serving — the swap either completes everywhere or
+    changes nothing."""
+
+
+def _req_hash(seed: int, req_id: int) -> float:
+    """Deterministic per-request split coordinate in [0, 1): a pure
+    function of (seed, request id), stable across processes and runs —
+    the same request id lands on the same side of a canary fraction
+    everywhere (the reproducible-experiment contract)."""
+    h = hashlib.sha1(f"{seed}:{req_id}".encode()).digest()
+    return int.from_bytes(h[:8], "big") / float(1 << 64)
+
+
+class FleetRouter:
+    """Front-end over a pool of serving replicas ("host:port" worker
+    addresses running `worker_service.start_worker`). Reuses
+    WorkerPool's retry/backoff/quarantine so replica death is handled
+    by the SAME policy as distributed training — a quarantined replica
+    that restarts is re-probed and healed back into rotation. One
+    router serves one model lineage; versions are immutable ids."""
+
+    def __init__(
+        self,
+        addresses: List[str],
+        secret: Optional[bytes] = None,
+        timeout_s: float = 60.0,
+        retry_attempts: int = 8,
+        seed: int = 0,
+        register_statusz: bool = True,
+    ):
+        self.pool = WorkerPool(
+            addresses, timeout_s=timeout_s, secret=secret,
+            retry_attempts=retry_attempts,
+        )
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self.active_version: Optional[str] = None
+        #: version -> forest fingerprint, for every deployed version.
+        self._versions: Dict[str, str] = {}
+        self._split: Optional[Dict[str, Any]] = None
+        self._req_ids = itertools.count(1)
+        self._failovers = 0
+        self._swaps = 0
+        #: True while a swap rollout is in flight: replicas flip one at
+        #: a time, so mixed active versions are EXPECTED and the
+        #: stale-replica resync guard must stand down (it would fight
+        #: the rollout). Every response is still single-version and
+        #: bit-identical to its own version's oracle.
+        self._swapping = False
+        self._divergence = 0
+        self._shadow_compared = 0
+        #: Telemetry-independent per-version latency (the /statusz
+        #: read); ydf_fleet_predict_latency_ns mirrors it when on.
+        self._lat: Dict[str, LatencyHistogram] = {}
+        self._statusz_key: Optional[str] = None
+        if register_statusz:
+            self._statusz_key = f"fleet:{id(self):x}"
+            telemetry_http.register_status(self._statusz_key, self.status)
+
+    # ---- deploy / swap ---------------------------------------------- #
+
+    def deploy(self, model, version: str,
+               activate: Optional[bool] = None) -> Dict[str, Any]:
+        """Ships `model` to EVERY replica under `version` (serialized
+        once, same frame bytes per replica — the load_data_all
+        broadcast contract) and verifies each replica built it at the
+        expected forest fingerprint. `activate=True` flips each
+        replica as it loads (first deploy of a fresh fleet defaults to
+        active); later versions default to loading ALONGSIDE the
+        active one, to be promoted by `swap_to` or routed explicitly
+        by a shadow/canary split."""
+        from ydf_tpu.serving.flatten import forest_fingerprint
+
+        with self._lock:
+            if version in self._versions:
+                raise FleetError(
+                    f"version {version!r} already deployed (ids are "
+                    "immutable; pick a new one)"
+                )
+            first = self.active_version is None
+        if activate is None:
+            activate = first
+        fingerprint = forest_fingerprint(model.forest)
+        frame = _encode_frame(
+            {
+                "verb": "serve_load_bank", "version": version,
+                "model_blob": model.serialize(),
+                "fingerprint": fingerprint, "activate": bool(activate),
+            },
+            self.pool.secret,
+        )
+        results = self._broadcast_frame(frame, f"deploy:{version}")
+        for i, resp in enumerate(results):
+            if resp.get("fingerprint") != fingerprint:
+                raise FleetError(
+                    f"replica {self.pool.addr_str(i)} loaded "
+                    f"{version!r} at fingerprint "
+                    f"{resp.get('fingerprint')!r}, expected "
+                    f"{fingerprint!r} — the shipped model did not "
+                    "round-trip"
+                )
+        with self._lock:
+            self._versions[version] = fingerprint
+            if activate or self.active_version is None:
+                self.active_version = version
+        return {
+            "version": version, "fingerprint": fingerprint,
+            "replicas": len(results), "active": bool(activate),
+            "engines": sorted({r.get("engine") for r in results}),
+        }
+
+    def swap_to(self, version: str, retire: bool = True) -> Dict[str, Any]:
+        """Zero-downtime promotion of an already-deployed `version`:
+
+          1. VERIFY — every replica reports `version` loaded at the
+             deploy fingerprint (serve_status); any mismatch aborts
+             before anything flips.
+          2. FLIP — every replica's active pointer is swapped
+             (serve_swap, flip only). A failure mid-rollout (chaos
+             site `fleet.swap`) rolls the already-flipped replicas
+             back — the old bank is still loaded everywhere, so the
+             rollback is a pointer flip too, and FleetSwapError is
+             raised with the old version serving.
+          3. RETIRE (retire=True) — the previous version is drained
+             and freed on every replica (serve_unload; the native
+             bank's `serve_bank` ledger bytes drop). Retire failures
+             are reported, never raised: the flip already happened and
+             a lingering old bank is memory, not correctness.
+
+        In-flight predicts are never failed by the flip: a request
+        resolves its version once, under the replica's state lock, and
+        keeps its bank through the compute (drain waits for it)."""
+        t0 = time.perf_counter_ns()
+        with self._lock:
+            old = self.active_version
+            expected = self._versions.get(version)
+        if expected is None:
+            raise FleetSwapError(
+                f"swap target {version!r} was never deployed"
+            )
+        if version == old:
+            return {"from": old, "to": version, "flipped": 0,
+                    "freed_bytes": 0, "retire_errors": [], "skipped": []}
+        with self._lock:
+            self._swapping = True
+        try:
+            return self._swap_rollout(version, old, expected, retire, t0)
+        finally:
+            with self._lock:
+                self._swapping = False
+
+    def _swap_rollout(self, version: str, old: Optional[str],
+                      expected: str, retire: bool,
+                      t0: int) -> Dict[str, Any]:
+        with telemetry.span("fleet.swap") as sp:
+            if telemetry.ENABLED:
+                sp.set(to=version, previous=old)
+            n = len(self.pool.addresses)
+            # 1. verify — doubles as the liveness probe: a replica that
+            # is quarantined or unreachable RIGHT NOW is skipped (and
+            # quarantined), not flipped — it missed the swap and will
+            # be resynced (or redeployed) when it heals; the fleet's
+            # healthy majority must not be blocked by a dead box.
+            live: List[int] = []
+            skipped: List[str] = []
+            for i in range(n):
+                if self.pool.is_quarantined(i):
+                    skipped.append(self.pool.addr_str(i))
+                    continue
+                try:
+                    st = self._replica_request(
+                        i, {"verb": "serve_status"}, "swap verify",
+                        attempts=1,
+                    )
+                except FleetError as e:
+                    if "unreachable" not in str(e):
+                        raise
+                    skipped.append(self.pool.addr_str(i))
+                    continue
+                info = st.get("versions", {}).get(version)
+                if info is None or info.get("fingerprint") != expected:
+                    raise FleetSwapError(
+                        f"replica {self.pool.addr_str(i)} does not hold "
+                        f"{version!r} at fingerprint {expected!r} "
+                        f"(has: {sorted(st.get('versions', {}))}); "
+                        "redeploy before swapping"
+                    )
+                live.append(i)
+            if not live:
+                raise FleetSwapError(
+                    f"no live replica to swap (skipped: {skipped})"
+                )
+            # 2. flip
+            flipped: List[int] = []
+            try:
+                for i in live:
+                    failpoints.hit("fleet.swap")
+                    self._replica_request(
+                        i, {"verb": "serve_swap", "version": version},
+                        "swap flip",
+                    )
+                    flipped.append(i)
+            except BaseException as e:
+                rollback_errors = []
+                if old is not None:
+                    for i in flipped:
+                        try:
+                            self._replica_request(
+                                i,
+                                {"verb": "serve_swap", "version": old},
+                                "swap rollback",
+                            )
+                        except Exception as re:
+                            rollback_errors.append(
+                                f"{self.pool.addr_str(i)}: {re}"
+                            )
+                raise FleetSwapError(
+                    f"swap to {version!r} aborted after "
+                    f"{len(flipped)}/{n} flips; rolled back to {old!r}"
+                    + (
+                        f" (rollback errors: {rollback_errors})"
+                        if rollback_errors else ""
+                    )
+                    + f": {type(e).__name__}: {e}"
+                ) from e
+            with self._lock:
+                self.active_version = version
+                self._swaps += 1
+            # 3. retire
+            freed = 0
+            retire_errors: List[str] = []
+            if retire and old is not None:
+                for i in live:
+                    try:
+                        r = self._replica_request(
+                            i, {"verb": "serve_unload", "version": old},
+                            "swap retire",
+                        )
+                        freed += int(r.get("freed_bytes", 0))
+                    except Exception as e:
+                        retire_errors.append(
+                            f"{self.pool.addr_str(i)}: {e}"
+                        )
+                with self._lock:
+                    self._versions.pop(old, None)
+                    self._split_drop_version(old)
+        if telemetry.ENABLED:
+            telemetry.counter("ydf_fleet_swap_total").inc()
+            telemetry.histogram("ydf_fleet_swap_latency_ns").observe_ns(
+                time.perf_counter_ns() - t0
+            )
+        return {
+            "from": old, "to": version, "flipped": len(flipped),
+            "freed_bytes": freed, "retire_errors": retire_errors,
+            "skipped": skipped,
+        }
+
+    # ---- shadow / canary -------------------------------------------- #
+
+    def set_split(self, version: str, fraction: float,
+                  mode: str = "canary", seed: Optional[int] = None) -> None:
+        """Routes a deterministic `fraction` of requests at `version`:
+        `canary` serves them FROM it (its answers are returned),
+        `shadow` duplicates them TO it and discards the result after
+        comparing against the primary answer (the divergence counter).
+        The per-request hash is a pure function of (seed, request id) —
+        the same id lands the same way on every run."""
+        if mode not in _SPLIT_MODES:
+            raise ValueError(
+                f"split mode {mode!r} must be one of {list(_SPLIT_MODES)}"
+            )
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError(
+                f"split fraction {fraction!r} must be in [0, 1]"
+            )
+        with self._lock:
+            if version not in self._versions:
+                raise FleetError(
+                    f"split target {version!r} was never deployed"
+                )
+            if version == self.active_version:
+                raise FleetError(
+                    f"split target {version!r} IS the active version — "
+                    "a split routes against a non-active candidate"
+                )
+            self._split = {
+                "version": version, "fraction": float(fraction),
+                "mode": mode,
+                "seed": self.seed if seed is None else int(seed),
+            }
+
+    def clear_split(self) -> None:
+        with self._lock:
+            self._split = None
+
+    def _split_drop_version(self, version: str) -> None:
+        # caller holds self._lock
+        if self._split and self._split["version"] == version:
+            self._split = None
+
+    # ---- predict ---------------------------------------------------- #
+
+    def predict(self, x_num, x_cat=None,
+                req_id: Optional[int] = None) -> np.ndarray:
+        """Raw scores f32 [n] for one pre-encoded batch, served by the
+        fleet (active version, or the canary for canary-routed request
+        ids). See predict_versioned for the (scores, version) form the
+        swap proofs use."""
+        return self.predict_versioned(x_num, x_cat, req_id=req_id)[0]
+
+    def predict_versioned(self, x_num, x_cat=None,
+                          req_id: Optional[int] = None):
+        """(scores, served_version): the response names which model
+        version answered — the bit-identity oracle key under a
+        mid-load hot-swap (acceptance: every response is bit-identical
+        to the oracle of WHICHEVER version served it)."""
+        rid = next(self._req_ids) if req_id is None else int(req_id)
+        with self._lock:
+            split = dict(self._split) if self._split else None
+        route = "primary"
+        version = None  # replica's active version
+        shadow_version = None
+        if split and split["fraction"] > 0.0 and _req_hash(
+            split["seed"], rid
+        ) < split["fraction"]:
+            if split["mode"] == "canary":
+                route = "canary"
+                version = split["version"]
+            else:
+                shadow_version = split["version"]
+        t0 = time.perf_counter_ns()
+        resp = self._predict_with_failover(x_num, x_cat, version)
+        scores = np.asarray(resp["scores"], np.float32)
+        served = resp["version"]
+        self._observe_predict(served, route, time.perf_counter_ns() - t0)
+        if shadow_version is not None:
+            self._shadow_once(x_num, x_cat, shadow_version, scores)
+        return scores, served
+
+    def _observe_predict(self, version: str, route: str,
+                         dur_ns: int) -> None:
+        with self._lock:
+            hist = self._lat.get(version)
+            if hist is None:
+                hist = self._lat[version] = LatencyHistogram()
+        hist.observe_ns(dur_ns)
+        if telemetry.ENABLED:
+            telemetry.counter(
+                "ydf_fleet_predict_total", version=version, route=route
+            ).inc()
+            telemetry.histogram(
+                "ydf_fleet_predict_latency_ns", version=version
+            ).observe_ns(dur_ns)
+
+    def _shadow_once(self, x_num, x_cat, version: str,
+                     primary: np.ndarray) -> None:
+        """Shadow duplicate: best-effort (shadow is observation — a
+        failing candidate must never fail live traffic), compared
+        bit-for-bit against the primary answer."""
+        t0 = time.perf_counter_ns()
+        try:
+            resp = self._predict_with_failover(x_num, x_cat, version)
+        except Exception:
+            return
+        dur = time.perf_counter_ns() - t0
+        shadow = np.asarray(resp["scores"], np.float32)
+        diverged = not np.array_equal(primary, shadow)
+        with self._lock:
+            self._shadow_compared += 1
+            if diverged:
+                self._divergence += 1
+        self._observe_predict(resp["version"], "shadow", dur)
+        if diverged and telemetry.ENABLED:
+            telemetry.counter("ydf_fleet_divergence_total").inc()
+
+    def _predict_with_failover(self, x_num, x_cat,
+                               version: Optional[str]) -> Dict[str, Any]:
+        """One predict under the pool's retry policy: replicas are
+        picked round-robin (next_worker — load spreading survives a
+        quarantine), a transport failure quarantines the replica and
+        FAILS OVER to the next healthy one. Results are exactly-once
+        to the caller: predict is pure, so a request retried after a
+        lost response returns identical bits, and the caller observes
+        one answer. Protocol refusals (need_load after a replica
+        restart) raise — the fleet needs a redeploy, not a retry."""
+        req = {
+            "verb": "serve_predict",
+            "x_num": np.ascontiguousarray(x_num, np.float32),
+            "x_cat": (
+                None if x_cat is None
+                else np.ascontiguousarray(x_cat, np.int32)
+            ),
+        }
+        if version is not None:
+            req["version"] = version
+        frame = _encode_frame(req, self.pool.secret)
+        last_err: Optional[BaseException] = None
+        for attempt in range(self.pool.retry_attempts):
+            if attempt:
+                time.sleep(self.pool.backoff_delay(attempt - 1))
+            idx = self.pool.next_worker()
+            if idx is None:
+                last_err = last_err or ConnectionError(
+                    "all replicas quarantined"
+                )
+                continue
+            try:
+                failpoints.hit("fleet.replica_predict")
+                resp = self.pool.request_frame(idx, frame)
+            except (OSError, ConnectionError) as e:
+                self.pool.mark_failed(idx)
+                self._note_failover(idx, e)
+                last_err = e
+                continue
+            if not resp.get("ok"):
+                raise FleetError(
+                    f"replica {self.pool.addr_str(idx)} refused "
+                    f"predict: {resp.get('error')}"
+                )
+            if version is None:
+                # Stale-replica guard: a replica that healed after
+                # missing a swap still serves ITS active version. The
+                # stale answer is discarded, the replica's pointer is
+                # resynced (its new bank was deployed while it was
+                # healthy; if even that is missing it needs a redeploy
+                # and is quarantined), and the request retries on the
+                # rotation.
+                with self._lock:
+                    want = self.active_version
+                    swapping = self._swapping
+                served = resp.get("version")
+                if want is not None and served != want and not swapping:
+                    try:
+                        self._replica_request(
+                            idx, {"verb": "serve_swap", "version": want},
+                            "stale resync", attempts=1,
+                        )
+                    except Exception as e:
+                        self.pool.mark_failed(idx)
+                        self._note_failover(idx, e)
+                    last_err = FleetError(
+                        f"replica {self.pool.addr_str(idx)} served "
+                        f"stale version {served!r} (want {want!r}); "
+                        "resynced"
+                    )
+                    continue
+            self.pool.mark_ok(idx)
+            return resp
+        raise FleetError(
+            f"predict failed on every replica "
+            f"({self.pool.retry_attempts} attempts); last error: "
+            f"{last_err}"
+        )
+
+    def _note_failover(self, idx: int, err: BaseException) -> None:
+        with self._lock:
+            self._failovers += 1
+        if telemetry.ENABLED:
+            telemetry.counter("ydf_fleet_failover_total").inc()
+            with telemetry.span("fleet.failover") as sp:
+                sp.set(
+                    replica=self.pool.addr_str(idx),
+                    error=type(err).__name__,
+                )
+
+    # ---- plumbing --------------------------------------------------- #
+
+    def _replica_request(self, i: int, req: Dict[str, Any],
+                         what: str, attempts: int = 3) -> Dict[str, Any]:
+        """One control-plane request PINNED to replica i (status, flip,
+        unload must land on THAT replica — no failover), with a short
+        transport retry. Raises on refusal or unreachability (the
+        replica is quarantined first, so the rotation stops picking
+        it)."""
+        last_err: Optional[BaseException] = None
+        for attempt in range(attempts):
+            if attempt:
+                time.sleep(self.pool.backoff_delay(attempt - 1))
+            try:
+                resp = self.pool.request(i, req)
+            except (OSError, ConnectionError) as e:
+                last_err = e
+                continue
+            if not resp.get("ok"):
+                raise FleetError(
+                    f"replica {self.pool.addr_str(i)} failed {what}: "
+                    f"{resp.get('error')}"
+                )
+            return resp
+        self.pool.mark_failed(i)
+        raise FleetError(
+            f"replica {self.pool.addr_str(i)} unreachable during "
+            f"{what}: {last_err}"
+        )
+
+    def _broadcast_frame(self, frame: bytes,
+                         what: str) -> List[Dict[str, Any]]:
+        """Delivers one pre-encoded frame to EVERY replica (pinned, no
+        failover — a deploy must land everywhere), raising if any
+        replica stays unreachable or refuses."""
+        results = []
+        for i in range(len(self.pool.addresses)):
+            last_err: Optional[BaseException] = None
+            resp = None
+            for attempt in range(3):
+                if attempt:
+                    time.sleep(self.pool.backoff_delay(attempt - 1))
+                try:
+                    resp = self.pool.request_frame(i, frame)
+                    last_err = None
+                    break
+                except (OSError, ConnectionError) as e:
+                    last_err = e
+            if last_err is not None:
+                self.pool.mark_failed(i)
+                raise FleetError(
+                    f"replica {self.pool.addr_str(i)} unreachable "
+                    f"during {what}: {last_err}"
+                )
+            if not resp.get("ok"):
+                raise FleetError(
+                    f"replica {self.pool.addr_str(i)} failed {what}: "
+                    f"{resp.get('error')}"
+                )
+            results.append(resp)
+        return results
+
+    def replica_statuses(self) -> List[Dict[str, Any]]:
+        """serve_status of every reachable replica (unreachable ones
+        reported as {"error": ...} — this is the observability read,
+        it must not raise mid-incident)."""
+        out = []
+        for i in range(len(self.pool.addresses)):
+            try:
+                out.append(
+                    self._replica_request(
+                        i, {"verb": "serve_status"}, "status"
+                    )
+                )
+            except Exception as e:
+                out.append({
+                    "replica": self.pool.addr_str(i),
+                    "error": f"{type(e).__name__}: {e}",
+                })
+        return out
+
+    def status(self) -> Dict[str, Any]:
+        """The router's /statusz section: replica addresses, versions
+        and the active pointer, the split config, failover/swap/
+        divergence totals, and per-version latency percentiles."""
+        with self._lock:
+            lat = {
+                v: {
+                    "p50_ns": h.percentile_ns(50),
+                    "p99_ns": h.percentile_ns(99),
+                }
+                for v, h in self._lat.items()
+            }
+            return {
+                "replicas": [
+                    self.pool.addr_str(i)
+                    for i in range(len(self.pool.addresses))
+                ],
+                "active_version": self.active_version,
+                "versions": dict(self._versions),
+                "split": dict(self._split) if self._split else None,
+                "failovers": self._failovers,
+                "swaps": self._swaps,
+                "shadow_compared": self._shadow_compared,
+                "divergence": self._divergence,
+                "latency_ns": lat,
+            }
+
+    def close(self) -> None:
+        if self._statusz_key is not None:
+            telemetry_http.unregister_status(self._statusz_key)
+            self._statusz_key = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def fleet_batcher(router: FleetRouter, **kwargs):
+    """A CoalescingBatcher front over the fleet: concurrent single-row
+    predict_one calls coalesce into one fleet RPC per flush (the
+    round-12 batcher semantics — exact-once, order-preserving,
+    overload-shedding — composed with fleet routing/failover). Rows
+    are the engine input contract (x_num_row [Fn], x_cat_row [Fc])."""
+    from ydf_tpu.serving.registry import CoalescingBatcher
+
+    def batch_fn(x_num, x_cat):
+        return router.predict(x_num, x_cat)
+
+    return CoalescingBatcher(batch_fn, **kwargs)

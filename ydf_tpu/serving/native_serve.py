@@ -102,9 +102,13 @@ def _require_registered() -> None:
 # Running total of live ServeBank table bytes — the "serve_bank" row of
 # the memory ledger (pull source, sampled at snapshot only) and the
 # bench headline's serve_bank_bytes. Plain int under a lock: bank
-# create/close is model-load-rate, never the predict hot path.
+# create/close is model-load-rate, never the predict hot path. The
+# per-bank identity registry beside it feeds the /statusz serving
+# section's model-identity rows (registry.serving_status — which model
+# is this process actually serving, the hot-swap verification signal).
 _BANK_BYTES_LOCK = threading.Lock()
 _BANK_BYTES_TOTAL = 0
+_LIVE_BANKS: dict = {}
 
 
 def _note_bank_bytes(delta: int) -> None:
@@ -117,6 +121,14 @@ def bank_bytes_total() -> int:
     """Bytes held by live serving data banks in this process (host-side
     tables; the native handle mirrors them once more)."""
     return _BANK_BYTES_TOTAL
+
+
+def live_banks() -> list:
+    """Identity of every live serving bank in this process:
+    {fingerprint, num_trees, total_nodes, nbytes} per bank, in creation
+    order — the model-identity half of `/statusz`'s serving section."""
+    with _BANK_BYTES_LOCK:
+        return [dict(v) for v in _LIVE_BANKS.values()]
 
 
 from ydf_tpu.utils import telemetry as _telemetry  # noqa: E402
@@ -142,10 +154,17 @@ class ServeBank:
         V = int(f["leaf_value"].shape[-1])
         leaf_values = np.asarray(f["leaf_value"], np.float32)
 
-        from ydf_tpu.serving.flatten import flatten_forest_data_bank
+        from ydf_tpu.serving.flatten import (
+            flatten_forest_data_bank,
+            forest_fingerprint,
+        )
 
         bank = flatten_forest_data_bank(f, leaf_values, nfeat, ow, V)
         W = int(np.shape(f["cat_mask"])[-1])
+        # Model identity: stable across processes and wire round-trips
+        # (same forest ⇒ same fingerprint), reported on /statusz and
+        # verified by a fleet deploy against the router's own value.
+        self.fingerprint = forest_fingerprint(f)
 
         self.num_numerical = int(binner.num_numerical)
         self.num_categorical = nfeat - self.num_numerical
@@ -197,6 +216,13 @@ class ServeBank:
         )
         _note_bank_bytes(self.nbytes)
         self._counted = True
+        with _BANK_BYTES_LOCK:
+            _LIVE_BANKS[id(self)] = {
+                "fingerprint": self.fingerprint,
+                "num_trees": self.num_trees,
+                "total_nodes": self.total,
+                "nbytes": self.nbytes,
+            }
 
         self._h = None
         lib = _lib()
@@ -225,6 +251,8 @@ class ServeBank:
         if getattr(self, "_counted", False):
             _note_bank_bytes(-self.nbytes)
             self._counted = False
+            with _BANK_BYTES_LOCK:
+                _LIVE_BANKS.pop(id(self), None)
 
     def __del__(self):  # pragma: no cover - interpreter shutdown order
         try:

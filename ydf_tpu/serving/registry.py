@@ -341,14 +341,24 @@ _register_mem_source()
 
 
 def serving_status() -> dict:
-    """The serving process's /statusz section: selected engine, per-
+    """The serving process's /statusz section: selected engine, MODEL
+    IDENTITY (the forest fingerprint + tree/node/byte counts of every
+    live serving bank — which model this process is actually serving;
+    the hot-swap verification signal a fleet deploy checks), per-
     batcher queue depth/bytes/bounds, shed totals by reason, and the
     last load-run summary (serving/loadgen.py). Row/flush counters
     (the QPS source) ride /metrics as ydf_serve_batcher_rows_total
     etc."""
+    try:
+        from ydf_tpu.serving.native_serve import live_banks
+
+        banks = live_banks()
+    except Exception:
+        banks = []
     return {
         "engine": _LAST_ENGINE["engine"],
         "forced": _LAST_ENGINE["forced"],
+        "banks": banks,
         "shed_total": shed_totals(),
         "last_load_run": _LAST_LOAD_RUN["record"],
         "batchers": [

@@ -1,0 +1,381 @@
+"""Serving replica: the worker half of the serving fleet.
+
+Counterpart of the reference's model-registry + `BuildFastEngine` seam
+(a loaded model is replaceable behind a stable predict interface,
+PAPER.md L2/L5), lifted onto the RPC worker substrate
+(`parallel/worker_service.py`): a *replica* is a worker process that
+holds loaded serving banks keyed by a **model version id** and answers
+the fleet verbs this module handles. The router half — load spreading,
+failover, hot-swap orchestration, shadow/canary splits — lives in
+`serving/fleet.py`; this module only holds per-worker-instance version
+state and the verb handlers:
+
+  serve_load_bank   deserialize a shipped model (model.serialize()
+                    bytes — the saved-directory tar, never a pickle of
+                    live engine objects), build its serving engine
+                    (per-replica ServeBank through native_serve when
+                    the native kernel is available and allowed, the
+                    XLA routed oracle otherwise — both bit-identical
+                    by the round-12 parity contract) and store it
+                    under `version`, ALONGSIDE whatever else is
+                    loaded. Idempotent for a same-fingerprint re-ship
+                    (a restarted replica is re-deployed, not wedged).
+  serve_predict     one batched predict against the ACTIVE version (or
+                    an explicit `version` — the shadow/canary path).
+                    The version pointer is read ONCE per request under
+                    the state lock, so a response batch is never
+                    mixed-version by construction; the response names
+                    the version that served it.
+  serve_swap        atomically flip the active-version pointer to an
+                    already-loaded version. Flip only — the previous
+                    bank STAYS loaded (the router retires it with
+                    serve_unload once every replica has flipped, which
+                    is what makes a mid-rollout abort rollback-safe).
+  serve_unload      drain (wait for in-flight predicts on that
+                    version) and free one non-active version's bank —
+                    the native ServeBank close releases its
+                    `serve_bank` memory-ledger bytes.
+  serve_status      versions held (fingerprint, engine, bytes,
+                    predict/in-flight counts), the active version and
+                    swap count — the per-replica `/statusz`
+                    model-version section and the router's pre-swap
+                    verification read.
+
+State is keyed by WORKER INSTANCE id exactly like
+`parallel/dist_worker._STATE`: several in-process replicas (tests,
+bench) must hold separate banks and active pointers, like separate
+replica processes would. docs/serving.md "Serving fleet" has the full
+protocol and the hot-swap state machine.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+VERBS = frozenset(
+    {
+        "serve_load_bank", "serve_predict", "serve_swap",
+        "serve_unload", "serve_status",
+    }
+)
+
+#: Bounded drain: serve_unload waits this long for in-flight predicts
+#: on the retiring version before refusing (the request threads hold
+#: their own connections; a wedged one must not wedge the unload verb).
+_DRAIN_TIMEOUT_S = 10.0
+
+
+class _LoadedBank:
+    """One model version resident on this replica."""
+
+    __slots__ = (
+        "version", "fn", "engine", "bank", "fingerprint", "num_trees",
+        "nbytes", "predicts", "rows", "inflight",
+    )
+
+    def __init__(self, version: str, fn: Callable, engine: str,
+                 bank, fingerprint: str, num_trees: int, nbytes: int):
+        self.version = version
+        self.fn = fn
+        self.engine = engine
+        self.bank = bank  # native ServeBank or None (routed fallback)
+        self.fingerprint = fingerprint
+        self.num_trees = num_trees
+        self.nbytes = nbytes
+        self.predicts = 0   # requests served
+        self.rows = 0       # rows served
+        self.inflight = 0   # requests currently inside fn
+
+
+class _ReplicaState:
+    def __init__(self) -> None:
+        # Guards the version map and the active pointer. Predicts hold
+        # it only to resolve the version and bump inflight — the kernel
+        # call runs outside it, so concurrent predicts overlap and a
+        # flip between two requests is exactly a pointer swap.
+        self.lock = threading.Lock()
+        self.banks: Dict[str, _LoadedBank] = {}
+        self.active: Optional[str] = None
+        self.swaps = 0
+
+
+_STATE: Dict[str, _ReplicaState] = {}
+_STATE_LOCK = threading.Lock()
+
+
+def _state(worker_id: str) -> _ReplicaState:
+    with _STATE_LOCK:
+        st = _STATE.get(worker_id)
+        if st is None:
+            st = _STATE[worker_id] = _ReplicaState()
+        return st
+
+
+def _reset_for_tests() -> None:
+    with _STATE_LOCK:
+        _STATE.clear()
+
+
+def _build_fn(model):
+    """(fn, bank, engine_name) for a deserialized model: the native
+    data-bank walk when built and allowed (YDF_TPU_SERVE_IMPL honors
+    the registry's impl switch — `xla` pins the oracle, `native`
+    registers-or-raises), the XLA routed oracle otherwise. Both are
+    bit-identical for the engine envelope (round-12 parity suite), so
+    a fleet mixing native and fallback replicas still answers
+    bit-identically. The bank is owned by THIS replica (not the
+    model-level cache) so unload can free exactly its ledger bytes."""
+    from ydf_tpu.serving import native_serve
+    from ydf_tpu.serving.registry import resolve_serve_impl
+
+    impl = resolve_serve_impl()
+    bank = None
+    eng = None
+    if impl != "xla" and native_serve.in_envelope(model):
+        if impl == "native":
+            native_serve._require_registered()
+        if native_serve.available():
+            bank = native_serve.ServeBank(model)
+            if bank._h is not None:
+                eng = native_serve.NativeBatchEngine(bank)
+            else:
+                bank.close()
+                bank = None
+    if eng is not None:
+        def fn(x_num, x_cat, _eng=eng):
+            return np.asarray(_eng(x_num, x_cat), np.float32)
+
+        return fn, bank, "NativeBatch"
+
+    import jax.numpy as jnp
+
+    from ydf_tpu.ops.routing import forest_predict_values
+
+    def fn(x_num, x_cat, _m=model):
+        if x_cat is None:
+            x_cat = np.zeros(
+                (np.shape(x_num)[0],
+                 _m.binner.num_scalar - _m.binner.num_numerical),
+                np.int32,
+            )
+        return np.asarray(
+            forest_predict_values(
+                _m.forest, jnp.asarray(x_num), jnp.asarray(x_cat),
+                num_numerical=_m.binner.num_numerical,
+                max_depth=_m.max_depth, combine="sum",
+            ),
+            np.float32,
+        )[:, 0]
+
+    return fn, None, "Routed"
+
+
+def _version_info(lb: _LoadedBank) -> Dict[str, Any]:
+    return {
+        "fingerprint": lb.fingerprint,
+        "engine": lb.engine,
+        "num_trees": lb.num_trees,
+        "bank_bytes": lb.nbytes,
+        "predicts": lb.predicts,
+        "rows": lb.rows,
+        "inflight": lb.inflight,
+    }
+
+
+def status(worker_id: str) -> Dict[str, Any]:
+    """The per-replica `/statusz` model-version section (rides the
+    worker status provider, worker_service.start_worker): which model
+    versions this replica holds, WHICH ONE IT IS SERVING, and the
+    per-version traffic counts — the swap-verification read."""
+    with _STATE_LOCK:
+        st = _STATE.get(worker_id)
+    if st is None:
+        return {"active_version": None, "versions": {}, "swaps": 0}
+    with st.lock:
+        return {
+            "active_version": st.active,
+            "versions": {
+                v: _version_info(lb) for v, lb in st.banks.items()
+            },
+            "swaps": st.swaps,
+        }
+
+
+# --------------------------------------------------------------------- #
+# Verb handlers
+# --------------------------------------------------------------------- #
+
+
+def _handle_load_bank(req: Dict[str, Any], st: _ReplicaState,
+                      worker_id: str) -> Dict[str, Any]:
+    from ydf_tpu.models.io import deserialize_model
+    from ydf_tpu.serving.flatten import forest_fingerprint
+
+    version = req.get("version")
+    if not isinstance(version, str) or not version:
+        return {"ok": False, "error": "serve_load_bank needs a version id"}
+    blob = req.get("model_blob")
+    with st.lock:
+        held = st.banks.get(version)
+    if held is not None:
+        fp = req.get("fingerprint")
+        if fp is None or fp == held.fingerprint:
+            # Idempotent re-ship (router retry / replica re-deploy).
+            with st.lock:
+                active = st.active
+            return {
+                "ok": True, "version": version, "reloaded": False,
+                "fingerprint": held.fingerprint, "engine": held.engine,
+                "bank_bytes": held.nbytes, "active_version": active,
+            }
+        return {
+            "ok": False,
+            "error": (
+                f"version {version!r} already loaded with fingerprint "
+                f"{held.fingerprint} (deploy ships {fp}); unload it or "
+                "pick a new version id — version ids are immutable"
+            ),
+        }
+    if not isinstance(blob, (bytes, bytearray)):
+        return {
+            "ok": False,
+            "error": f"serve_load_bank for new version {version!r} "
+            "needs model_blob bytes (model.serialize())",
+            "need_model": True,
+        }
+    model = deserialize_model(bytes(blob))
+    fingerprint = forest_fingerprint(model.forest)
+    fn, bank, engine = _build_fn(model)
+    nbytes = int(bank.nbytes) if bank is not None else 0
+    lb = _LoadedBank(
+        version, fn, engine, bank, fingerprint,
+        int(model.forest.num_trees), nbytes,
+    )
+    with st.lock:
+        st.banks[version] = lb
+        if st.active is None or req.get("activate"):
+            st.active = version
+        active = st.active
+    return {
+        "ok": True, "version": version, "reloaded": True,
+        "fingerprint": fingerprint, "engine": engine,
+        "bank_bytes": nbytes, "active_version": active,
+    }
+
+
+def _handle_predict(req: Dict[str, Any], st: _ReplicaState,
+                    worker_id: str) -> Dict[str, Any]:
+    x_num = np.ascontiguousarray(req.get("x_num"), np.float32)
+    x_cat = req.get("x_cat")
+    # Version resolution + inflight bump are ONE lock hold: the served
+    # version is decided exactly once per request, so a response batch
+    # can never mix versions across a concurrent swap.
+    with st.lock:
+        version = req.get("version") or st.active
+        lb = st.banks.get(version) if version else None
+        if lb is None:
+            return {
+                "ok": False,
+                "error": f"no serving bank for version {version!r} on "
+                f"replica {worker_id} (restarted? redeploy)",
+                "need_load": True,
+            }
+        lb.inflight += 1
+    try:
+        scores = lb.fn(x_num, x_cat)
+    finally:
+        with st.lock:
+            lb.inflight -= 1
+            lb.predicts += 1
+            lb.rows += int(x_num.shape[0])
+    return {
+        "ok": True,
+        "scores": np.asarray(scores, np.float32),
+        "version": lb.version,
+        "replica": worker_id,
+    }
+
+
+def _handle_swap(req: Dict[str, Any], st: _ReplicaState,
+                 worker_id: str) -> Dict[str, Any]:
+    version = req.get("version")
+    with st.lock:
+        if version not in st.banks:
+            return {
+                "ok": False,
+                "error": f"serve_swap target {version!r} is not loaded "
+                f"on replica {worker_id} (ship it with serve_load_bank "
+                "first — the swap verb only flips the pointer)",
+                "need_load": True,
+            }
+        previous = st.active
+        st.active = version
+        if previous != version:
+            st.swaps += 1
+    return {
+        "ok": True, "active_version": version, "previous": previous,
+        "replica": worker_id,
+    }
+
+
+def _handle_unload(req: Dict[str, Any], st: _ReplicaState,
+                   worker_id: str) -> Dict[str, Any]:
+    version = req.get("version")
+    with st.lock:
+        if version == st.active:
+            return {
+                "ok": False,
+                "error": f"refusing to unload ACTIVE version "
+                f"{version!r} on replica {worker_id} (swap first)",
+            }
+        lb = st.banks.pop(version, None)
+    if lb is None:
+        # Idempotent: a retried retire finds the work already done.
+        return {"ok": True, "version": version, "freed_bytes": 0,
+                "was_loaded": False}
+    # Drain: the version is no longer reachable (popped under the
+    # lock), so inflight only decreases; wait it out, then free.
+    deadline = time.perf_counter() + _DRAIN_TIMEOUT_S
+    while True:
+        with st.lock:
+            inflight = lb.inflight
+        if inflight == 0:
+            break
+        if time.perf_counter() > deadline:
+            return {
+                "ok": False,
+                "error": f"unload of {version!r} timed out draining "
+                f"{inflight} in-flight predicts",
+            }
+        time.sleep(0.001)
+    freed = lb.nbytes
+    if lb.bank is not None:
+        lb.bank.close()  # releases the serve_bank ledger bytes
+    lb.fn = None  # type: ignore[assignment]
+    return {"ok": True, "version": version, "freed_bytes": freed,
+            "was_loaded": True}
+
+
+def handle(verb: str, req: Dict[str, Any],
+           worker_id: str = "local") -> Dict[str, Any]:
+    """Dispatch for the fleet verbs (called by worker_service). Task
+    errors are caught by the service's handler wrapper; this returns
+    protocol-level {ok: ...} responses."""
+    st = _state(worker_id)
+    if verb == "serve_load_bank":
+        return _handle_load_bank(req, st, worker_id)
+    if verb == "serve_predict":
+        return _handle_predict(req, st, worker_id)
+    if verb == "serve_swap":
+        return _handle_swap(req, st, worker_id)
+    if verb == "serve_unload":
+        return _handle_unload(req, st, worker_id)
+    if verb == "serve_status":
+        out = status(worker_id)
+        out.update(ok=True, replica=worker_id)
+        return out
+    return {"ok": False, "error": f"unknown fleet verb {verb!r}"}

@@ -126,6 +126,15 @@ KNOWN_SITES = frozenset(
         # of later flushes untouched) — the chaos handle for the
         # overload fan-out's exact-once contract.
         "serve.flush",
+        # serving/fleet.py — router-side fleet sites (the manager-side
+        # placement dist.* uses). fleet.replica_predict fires on the
+        # predict RPC path: drop_conn surfaces as a dead replica and
+        # drives the failover/quarantine rotation. fleet.swap fires
+        # before each per-replica flip of a versioned hot-swap: an
+        # injected error aborts the rollout mid-flip and drives the
+        # rollback path (old version keeps serving everywhere).
+        "fleet.replica_predict",
+        "fleet.swap",
     }
 )
 
