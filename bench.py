@@ -809,6 +809,15 @@ def measure_fleet_family(model, data, rows, record):
                               means it stays bounded)
       fleet_failover_count    failovers the run needed (0 on a healthy
                               in-process fleet)
+      rpc_connects            TCP connects the whole run paid (<= 1
+                              per replica under the persistent pool),
+      rpc_conn_reuse_rate     the fraction of requests that reused a
+                              pooled connection,
+      rpc_header_bytes        wire bytes: pickled headers vs zero-copy
+      rpc_payload_bytes       array segments, and
+      fleet_predict_rtt_p50_ns  the per-RPC predict round-trip p50 on
+                              the pooled connection (no routing/
+                              failover retries in it)
 
     The run detail (swap result, shed/error counts, router status)
     rides record["fleet"]. Replicas are in-process localhost workers —
@@ -899,6 +908,25 @@ def measure_fleet_family(model, data, rows, record):
             record["fleet_sustained_qps"] = closed["achieved_qps"]
             record["fleet_swap_p99_ns"] = closed["latency_p99_ns"]
             record["fleet_failover_count"] = status["failovers"]
+            # Transport-overhaul headline fields: the whole run's TCP
+            # connects (<= 1 per replica under the persistent pool),
+            # the connection-reuse rate, wire bytes split into pickled
+            # header vs zero-copy array payload, and the per-RPC
+            # predict round-trip p50 (one replica request on the
+            # pooled connection — the protocol-overhead instrument the
+            # localhost bench actually measures).
+            tsnap = router.pool.transport_snapshot()
+            record["rpc_connects"] = int(tsnap["rpc_connects"])
+            record["rpc_conn_reuse_rate"] = float(
+                tsnap["rpc_conn_reuse_rate"]
+            )
+            record["rpc_header_bytes"] = int(tsnap["rpc_header_bytes"])
+            record["rpc_payload_bytes"] = int(
+                tsnap["rpc_payload_bytes"]
+            )
+            record["fleet_predict_rtt_p50_ns"] = round(
+                status["predict_rtt_p50_ns"], 1
+            )
             record["fleet"] = {
                 "swap": swap_result,
                 "errors": closed["errors"],
@@ -1066,6 +1094,21 @@ def measure_distributed_family(rows, trees, depth, features, record):
             record["dist_net_s"] = round(d["net_s"], 3)
             record["dist_wait_s"] = round(d["wait_s"], 3)
             record["dist_layer_wall_s"] = round(d["layer_wall_s"], 3)
+            # Transport-overhaul fields (mirrors the fleet family's
+            # rpc_* under the dist_ prefix): per-run TCP connects and
+            # reuse over the manager's pooled worker connections, and
+            # the wire split between pickled headers and zero-copy
+            # array segments.
+            record["dist_rpc_connects"] = int(d.get("rpc_connects", 0))
+            record["dist_rpc_conn_reuse_rate"] = float(
+                d.get("rpc_conn_reuse_rate", 0.0)
+            )
+            record["dist_rpc_header_bytes"] = int(
+                d.get("rpc_header_bytes", 0)
+            )
+            record["dist_rpc_payload_bytes"] = int(
+                d.get("rpc_payload_bytes", 0)
+            )
         try:
             WorkerPool(addrs).shutdown_all()
         except Exception:

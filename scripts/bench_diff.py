@@ -114,6 +114,21 @@ FIELD_SPECS: Dict[str, Tuple[str, float, float]] = {
     "fleet_sustained_qps": ("higher", 0.15, 0.0),
     "fleet_swap_p99_ns": ("lower", 0.25, 500.0),
     "fleet_failover_count": ("lower", 0.50, 0.5),
+    # transport-overhaul family (persistent pool + pipelining +
+    # zero-copy framing): fewer connects and less wire traffic are
+    # better, a higher connection-reuse rate is better, and the
+    # per-RPC predict round-trip p50 is the protocol-overhead
+    # instrument itself. The fleet family carries them bare; the
+    # distributed family mirrors them under the dist_ prefix.
+    "rpc_connects": ("lower", 0.25, 0.5),
+    "rpc_conn_reuse_rate": ("higher", 0.05, 0.02),
+    "rpc_header_bytes": ("lower", 0.15, 4096.0),
+    "rpc_payload_bytes": ("lower", 0.10, 4096.0),
+    "fleet_predict_rtt_p50_ns": ("lower", 0.20, 300.0),
+    "dist_rpc_connects": ("lower", 0.25, 0.5),
+    "dist_rpc_conn_reuse_rate": ("higher", 0.05, 0.02),
+    "dist_rpc_header_bytes": ("lower", 0.15, 4096.0),
+    "dist_rpc_payload_bytes": ("lower", 0.10, 4096.0),
     # loadgen artifact records (load_mode in the pairing shape)
     "achieved_qps": ("higher", 0.15, 0.0),
     "latency_p50_ns": ("lower", 0.15, 100.0),

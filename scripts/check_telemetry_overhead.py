@@ -62,6 +62,7 @@ def run_check(
     with_dist_row: bool = False,
     with_serve_load: bool = False,
     with_fleet: bool = False,
+    with_transport: bool = False,
 ) -> dict:
     import numpy as np
 
@@ -187,6 +188,50 @@ def run_check(
 
         fleet_once()  # warm the replica banks / code paths
 
+    transport_once = None
+    transport_cleanup = None
+    if with_transport:
+        # Transport-counter variant: a tight loop of small RPCs over
+        # ONE pooled pipelined connection (parallel/worker_service.py).
+        # The enabled measurement pays the per-request transport
+        # instrumentation — ydf_rpc_connects/reuse counters, the
+        # inflight gauge, per-verb header/payload wire-byte counters,
+        # plus the worker-side request spans — and must fit the same
+        # budget against the telemetry-off loop over the identical
+        # socket.
+        import socket as _t_socket
+
+        import numpy as _t_np
+
+        from ydf_tpu.parallel.worker_service import (
+            WorkerPool as _TWP,
+            start_worker as _t_start_worker,
+        )
+
+        _ts = _t_socket.socket()
+        _ts.bind(("127.0.0.1", 0))
+        _t_port = _ts.getsockname()[1]
+        _ts.close()
+        _t_start_worker(_t_port, host="127.0.0.1", blocking=False)
+        _t_pool = _TWP([f"127.0.0.1:{_t_port}"], timeout_s=30.0)
+        _t_arr = _t_np.arange(4096, dtype=_t_np.float32)
+
+        def transport_once():
+            for _ in range(400):
+                _t_pool.request(0, {"verb": "ping"})
+            for _ in range(100):
+                _t_pool.request(
+                    0, {"verb": "echo", "payload": _t_arr}
+                )
+
+        def transport_cleanup():
+            try:
+                _t_pool.shutdown_all()
+            except Exception:
+                pass
+
+        transport_once()  # warm the pooled connection / code paths
+
     train_dist = None
     dist_cleanup = None
     if with_dist_row:
@@ -246,6 +291,10 @@ def run_check(
     disabled_fleet = (
         measure_min_wall(fleet_once, reps) if fleet_once else None
     )
+    disabled_transport = (
+        measure_min_wall(transport_once, reps) if transport_once
+        else None
+    )
     td = tempfile.mkdtemp(prefix="ydf_tel_overhead_")
     enabled_http = None
     enabled_ledger = None
@@ -253,9 +302,14 @@ def run_check(
     enabled_dist = None
     enabled_load = None
     enabled_fleet = None
+    enabled_transport = None
     try:
         with telemetry.active(td):
             enabled = measure_min_wall(train_once, reps)
+            if transport_once is not None:
+                enabled_transport = measure_min_wall(
+                    transport_once, reps
+                )
             if train_dist is not None:
                 enabled_dist = measure_min_wall(train_dist, reps)
             if load_once is not None:
@@ -388,6 +442,28 @@ def run_check(
         summary["fleet_budget_s"] = round(fleet_budget, 4)
         summary["ok_fleet"] = fleet_overhead <= fleet_budget
         summary["ok"] = summary["ok"] and summary["ok_fleet"]
+    if enabled_transport is not None:
+        # The pooled-transport loop is its own baseline: the
+        # telemetry-off loop pays the same sockets, framing and
+        # pipelined waits, so the delta is exactly the new per-RPC
+        # transport counters (connects/reuse/inflight/wire-bytes)
+        # plus the worker request spans.
+        transport_overhead = enabled_transport - disabled_transport
+        transport_budget = (
+            rel_budget * disabled_transport + noise + abs_floor_s
+        )
+        summary["disabled_transport_min_s"] = round(
+            disabled_transport, 4
+        )
+        summary["enabled_transport_min_s"] = round(
+            enabled_transport, 4
+        )
+        summary["transport_overhead_s"] = round(transport_overhead, 4)
+        summary["transport_budget_s"] = round(transport_budget, 4)
+        summary["ok_transport"] = transport_overhead <= transport_budget
+        summary["ok"] = summary["ok"] and summary["ok_transport"]
+    if transport_cleanup is not None:
+        transport_cleanup()
     if fleet_cleanup is not None:
         fleet_cleanup()
     if dist_cleanup is not None:
@@ -429,6 +505,13 @@ def main(argv=None) -> int:
                          "in-process localhost workers) telemetry-off "
                          "vs on — the router/replica instrumentation "
                          "must fit the same 3%% budget (ok_fleet)")
+    ap.add_argument("--with-transport", action="store_true",
+                    help="additionally measure a tight pooled-RPC loop "
+                         "(pings + zero-copy echos over one persistent "
+                         "pipelined connection) telemetry-off vs on — "
+                         "the new ydf_rpc_* connect/reuse/inflight/"
+                         "wire-byte counters must fit the same 3%% "
+                         "budget (ok_transport)")
     args = ap.parse_args(argv)
     summary = run_check(
         rows=args.rows, trees=args.trees, depth=args.depth,
@@ -437,6 +520,7 @@ def main(argv=None) -> int:
         with_dist_row=args.with_dist_row,
         with_serve_load=args.with_serve_load,
         with_fleet=args.with_fleet,
+        with_transport=args.with_transport,
     )
     print(json.dumps(summary))
     return 0 if summary["ok"] else 1

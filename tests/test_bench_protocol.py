@@ -123,6 +123,17 @@ def test_small_cpu_run_emits_parseable_record():
     assert rec["fleet"]["errors"] == 0 and rec["fleet"]["shed"] == 0
     assert rec["fleet"]["swap"]["to"] == "bench_v2"
     assert rec["fleet"]["active_version"] == "bench_v2"
+    # Transport overhaul (this round): the whole fleet run — deploys
+    # included — pays at most one TCP connect per replica on the
+    # persistent pool, nearly every request reuses a pooled
+    # connection, the wire splits into pickled header vs zero-copy
+    # array payload bytes, and the per-RPC predict round-trip p50
+    # rides the record.
+    assert 1 <= rec["rpc_connects"] <= rec["fleet_replicas"]
+    assert rec["rpc_conn_reuse_rate"] > 0.9
+    assert rec["rpc_header_bytes"] > 0
+    assert rec["rpc_payload_bytes"] > 0
+    assert rec["fleet_predict_rtt_p50_ns"] > 0
     # Resource observability (round 15): pool utilization per stage —
     # busy / (lanes x pooled wall) from native/thread_pool.h's stats
     # block — and the memory headline fields. On this image the native
@@ -192,6 +203,14 @@ def test_small_cpu_run_with_distributed_family():
     assert abs(total - rec["dist_layer_wall_s"]) <= 0.02 + 0.01 * rec[
         "dist_layer_wall_s"
     ]
+    # Transport overhaul (this round): the steady-state distributed
+    # run connects once per worker (persistent pool), reuses for every
+    # per-layer RPC, and accounts its wire bytes split into pickled
+    # header vs zero-copy array segments.
+    assert 1 <= rec["dist_rpc_connects"] <= rec["dist_workers"]
+    assert rec["dist_rpc_conn_reuse_rate"] > 0.8
+    assert rec["dist_rpc_header_bytes"] > 0
+    assert rec["dist_rpc_payload_bytes"] > 0
 
 
 def test_bench_dist_workers_env_validation(tmp_path):

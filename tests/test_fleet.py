@@ -14,6 +14,7 @@ localhost replicas, tiny banks (the tier-1 gate is timeout-bound)."""
 
 import socket
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -448,6 +449,129 @@ def test_canary_split_deterministic_and_bit_identical(models):
                 r.set_split("ghost", 0.5)
             with pytest.raises(FleetError, match="active"):
                 r.set_split("v1", 0.5)
+    finally:
+        WorkerPool(addrs, timeout_s=10.0).shutdown_all()
+
+
+# --------------------------------------------------------------------- #
+# Transport: connection reuse on the predict hot path, auto-redeploy
+# --------------------------------------------------------------------- #
+
+
+def test_fleet_predict_connects_once_per_replica(models):
+    """The transport acceptance criterion: an entire closed-loop load
+    run — deploy included — performs at most ONE TCP connect per
+    (router, replica) pair (`ydf_rpc_connects_total`); every predict
+    rides the persistent pipelined connection."""
+    from ydf_tpu.serving import loadgen
+    from ydf_tpu.utils import telemetry
+
+    addrs = _spin_replicas(2)
+    try:
+        with telemetry.active():
+            with FleetRouter(addrs) as r:
+                r.deploy(models["m1"], "v1")
+
+                def call(i):
+                    j = i % 64
+                    s, v = r.predict_versioned(
+                        models["x_num"][j: j + 1],
+                        models["x_cat"][j: j + 1],
+                        req_id=i,
+                    )
+                    assert float(s[0]) == float(models["oracle1"][j])
+
+                rec = loadgen.run_closed_loop(
+                    call, 120, workers=4, seed=0
+                )
+                assert rec["errors"] == 0 and rec["ok"] == 120, rec
+                counters = telemetry.snapshot()["counters"]
+                for a in addrs:
+                    key = f'ydf_rpc_connects_total{{worker="{a}"}}'
+                    assert counters.get(key, 0) == 1, (key, counters)
+                snap = r.pool.transport_snapshot()
+                assert snap["rpc_connects"] == len(addrs), snap
+                assert snap["rpc_conn_reuse_rate"] > 0.9, snap
+                st = r.status()
+                assert st["predict_rtt_p50_ns"] > 0
+                assert st["transport"]["rpc_connects"] == len(addrs)
+    finally:
+        WorkerPool(addrs, timeout_s=10.0).shutdown_all()
+
+
+def test_replica_redeploy_on_heal(models):
+    """Replica auto-redeploy (ROADMAP item 1 remainder): a replica dies
+    mid-load, a new version is deployed + swapped while it is down
+    (both skip it), it heals WITHOUT the bank (restart) — and the
+    router re-ships the cached deploy frame automatically before
+    routing traffic back, serving the new version bit-identically,
+    with `ydf_fleet_redeploy_total` incremented."""
+    from ydf_tpu.utils import telemetry
+
+    addrs = _spin_replicas(2)
+    host, _, port = addrs[0].rpartition(":")
+    try:
+        with telemetry.active():
+            with FleetRouter(addrs) as r:
+                # Short quarantine holds so the heal probe fires fast.
+                r.pool.backoff_base_s = 0.05
+                r.pool.backoff_max_s = 0.2
+                r.deploy(models["m1"], "v1")
+                # Kill replica 0; drive traffic until the router has
+                # noticed (failover + quarantine).
+                WorkerPool([addrs[0]], timeout_s=10.0).shutdown_all()
+                for i in range(6):
+                    r.predict(
+                        models["x_num"][:1], models["x_cat"][:1],
+                        req_id=i,
+                    )
+                assert r.status()["failovers"] >= 1
+                # Deploy + swap while the replica is down: both skip it.
+                dep = r.deploy(models["m2"], "v2", activate=False)
+                swap = r.swap_to("v2")
+                assert addrs[0] in set(
+                    dep["skipped"] + swap["skipped"]
+                )
+                # Heal: a fresh replica process on the same port (the
+                # in-process state registry is cleared like a real
+                # restart would lose it).
+                serve_replica.reset_worker(addrs[0])
+                start_worker(
+                    int(port), host=host, blocking=False
+                )
+                deadline = time.time() + 15.0
+                while r.status()["redeploys"] == 0:
+                    assert time.time() < deadline, r.status()
+                    r.predict(
+                        models["x_num"][:1], models["x_cat"][:1]
+                    )
+                    time.sleep(0.05)
+                # The healed replica holds and SERVES v2 at the deploy
+                # fingerprint; fleet answers stay bit-identical.
+                sts = {
+                    st.get("replica"): st
+                    for st in r.replica_statuses()
+                    if "error" not in st
+                }
+                healed = sts[addrs[0]]
+                assert healed["active_version"] == "v2"
+                assert (
+                    healed["versions"]["v2"]["fingerprint"]
+                    == dep["fingerprint"]
+                )
+                for i in range(200, 212):
+                    s, v = r.predict_versioned(
+                        models["x_num"][:4], models["x_cat"][:4],
+                        req_id=i,
+                    )
+                    assert v == "v2"
+                    assert np.array_equal(s, models["oracle2"][:4])
+                assert sum(
+                    st["versions"]["v2"]["predicts"]
+                    for st in sts.values()
+                ) > 0
+                counters = telemetry.snapshot()["counters"]
+                assert counters.get("ydf_fleet_redeploy_total", 0) >= 1
     finally:
         WorkerPool(addrs, timeout_s=10.0).shutdown_all()
 

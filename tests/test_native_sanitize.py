@@ -278,6 +278,37 @@ with _tempfile.TemporaryDirectory() as _td:
             assert np.array_equal(np.asarray(_flr[_k]),
                                   np.asarray(_fh[_k])), _k
     assert _m_hyb.training_logs["distributed"]["mode"] == "hybrid"
+    # Pipelined fan-out on ONE pooled connection under the sanitizer
+    # (transport round): concurrent zero-copy echo frames — segmented
+    # send, recv_into preallocated buffers, incremental HMAC-free
+    # decode — interleave on a single persistent socket; every
+    # response must match its request exactly once.
+    _pp = WorkerPool([f"127.0.0.1:{_port}"], timeout_s=60.0)
+    _pl_arr = np.arange(50000, dtype=np.float32)
+    _pl_out = {}
+    _pl_errs = []
+    _pl_lock = _threading.Lock()
+    def _pl_echo(k):
+        try:
+            r = _pp.request(0, {"verb": "echo", "payload": _pl_arr * k})
+            with _pl_lock:
+                _pl_out[k] = r["payload"]
+        except Exception as e:
+            with _pl_lock:
+                _pl_errs.append(e)
+    _pl_ts = [
+        _threading.Thread(target=_pl_echo, args=(k,)) for k in range(4)
+    ]
+    for _t in _pl_ts:
+        _t.start()
+    for _t in _pl_ts:
+        _t.join()
+    assert not _pl_errs, _pl_errs
+    for k in range(4):
+        assert np.array_equal(_pl_out[k], _pl_arr * k), k
+    assert _pp.transport_snapshot()["rpc_connects"] == 1, (
+        _pp.transport_snapshot()
+    )
     WorkerPool([f"127.0.0.1:{_port}"]).shutdown_all()
 
 # Serving-fleet swap + failover cycle under the sanitizer (fleet
@@ -305,6 +336,28 @@ _swap = _router.swap_to("san_v2")
 assert _swap["to"] == "san_v2" and _swap["freed_bytes"] > 0, _swap
 _r2, _v2 = _router.predict_versioned(x_num, x_cat)
 assert _v2 == "san_v2" and np.array_equal(_r2, _o2)
+# Pooled-connection fleet predicts under the sanitizer (transport
+# round): a concurrent burst shares the two persistent replica
+# connections — pipelined segmented frames through the sanitized
+# native banks, one connect per replica for the whole session.
+_fb_errs = []
+_fb_lock = _threading.Lock()
+def _fb_pred(k):
+    try:
+        _rk, _vk = _router.predict_versioned(x_num, x_cat)
+        assert _vk == "san_v2" and np.array_equal(_rk, _o2)
+    except Exception as e:
+        with _fb_lock:
+            _fb_errs.append(e)
+_fb_ts = [_threading.Thread(target=_fb_pred, args=(k,)) for k in range(6)]
+for _t in _fb_ts:
+    _t.start()
+for _t in _fb_ts:
+    _t.join()
+assert not _fb_errs, _fb_errs
+_fb_snap = _router.pool.transport_snapshot()
+assert _fb_snap["rpc_connects"] <= 2, _fb_snap
+assert _fb_snap["rpc_conn_reuse_rate"] > 0.5, _fb_snap
 WorkerPool([_f_addrs[0]]).shutdown_all()
 _time.sleep(0.1)
 for _k in range(6):  # failover: dead replica quarantined, traffic moves

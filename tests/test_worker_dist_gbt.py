@@ -324,8 +324,15 @@ def test_chaos_straggler_timeout_recovers_bit_identical(
     """A straggler — a worker that answers pings but hangs on real
     work (hung host) — must be timed out by YDF_TPU_DIST_RPC_TIMEOUT_S,
     quarantined, and its shards re-placed on the healthy workers."""
+    import time as _time
+
     from ydf_tpu.parallel import dist_gbt
-    from ydf_tpu.parallel.worker_service import _recv_msg, _send_msg
+    from ydf_tpu.parallel.worker_service import (
+        _encode_frame,
+        _recv_msg,
+        _recv_seq_or_idle,
+        _send_seq_frame,
+    )
 
     hung = socket.socket()
     hung.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -334,12 +341,24 @@ def test_chaos_straggler_timeout_recovers_bit_identical(
     stop = threading.Event()
 
     def serve_conn(conn):
+        # Speaks the pipelined persistent-connection protocol: pings
+        # answered (the straggler looks healthy), real work swallowed
+        # without a response (the per-request deadline must fire).
         try:
-            req = _recv_msg(conn)
-            if req.get("verb") == "ping":
-                _send_msg(conn, {"ok": True})
-            else:
-                stop.wait(60.0)  # hang: never answer real work
+            conn.settimeout(5.0)
+            while not stop.is_set():
+                seq = _recv_seq_or_idle(conn)
+                if seq is None:
+                    continue
+                req = _recv_msg(conn)
+                if req.get("verb") == "ping":
+                    _send_seq_frame(
+                        conn, seq, _encode_frame(
+                            {"ok": True,
+                             "clock_ns": _time.perf_counter_ns()}
+                        ),
+                    )
+                # anything else: hang — never answer real work
         except Exception:
             pass
         finally:

@@ -2288,7 +2288,13 @@ def _train_gbt_distributed(
     else:
         mgr = DistGBTManager(pool, cache, **common)
     with _flight_guard():
-        return mgr.train()
+        try:
+            return mgr.train()
+        finally:
+            # The pool (and its persistent pipelined connections) is
+            # per-train: release the sockets so the workers' idle reap
+            # never has to.
+            pool.close()
 
 
 def _oom_failpoint():

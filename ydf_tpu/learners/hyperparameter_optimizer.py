@@ -306,11 +306,19 @@ class HyperParameterOptimizerLearner(HyperparameterValidationMixin):
                     score = float(np.mean(scores))
             return TrialLog(params=params, score=score)
 
-        if workers == 1:
-            self.logs = [run_trial(t) for t in enumerate(trials)]
-        else:
-            with ThreadPoolExecutor(max_workers=workers) as pool:
-                self.logs = list(pool.map(run_trial, enumerate(trials)))
+        try:
+            if workers == 1:
+                self.logs = [run_trial(t) for t in enumerate(trials)]
+            else:
+                with ThreadPoolExecutor(max_workers=workers) as pool:
+                    self.logs = list(
+                        pool.map(run_trial, enumerate(trials))
+                    )
+        finally:
+            if wpool is not None:
+                # Release the persistent pooled connections — the
+                # tuning run is the pool's lifetime.
+                wpool.close()
 
         best_i = int(np.argmax([t.score for t in self.logs]))
         best = self.logs[best_i]

@@ -244,6 +244,17 @@ def _pad_to(a: np.ndarray, length: int, fill) -> np.ndarray:
     return out
 
 
+def _transport_fields(pool) -> Dict[str, Any]:
+    """The pool's always-on transport counters for the run's
+    `training_logs["distributed"]` record (bench.py's dist_rpc_*
+    headline fields): TCP connects, connection-reuse rate, and wire
+    bytes split into pickled header vs zero-copy array payload. The
+    pool is created per train, so the counts are per-run. Tolerates
+    bare test doubles without the transport attribute."""
+    snap = getattr(pool, "transport_snapshot", None)
+    return snap() if callable(snap) else {}
+
+
 class _DistStats:
     """Always-on manager-side exchange accounting (the bench family's
     source; mirrored into telemetry when it is armed)."""
@@ -684,6 +695,16 @@ class DistGBTManager:
                 # midpoint within ~rtt/2 (get_telemetry's own handling
                 # is drain + snapshot — tens of ms on first call, which
                 # would bias a midpoint estimate; measured +31 ms).
+                # One throwaway warm ping first: with pooled
+                # connections the sampled pings must ride an ALREADY
+                # ESTABLISHED socket, so the RTT midpoint reflects
+                # network round-trip only — a ping that pays a TCP
+                # connect (fresh pool, or a reconnect after a drop)
+                # would bias the offset by ~connect/2.
+                self.pool.request(
+                    widx, {"verb": "ping"},
+                    timeout_s=min(10.0, t_out),
+                )
                 offset_ns = None
                 best_rtt = None
                 for _ in range(3):
@@ -879,6 +900,7 @@ class DistGBTManager:
                 "feature_shards": self.num_shards,
                 "hist_quant": self.hist_quant,
                 **self.stats.summary(),
+                **_transport_fields(self.pool),
             },
         }
         return forest_stacked, leaf_values, logs

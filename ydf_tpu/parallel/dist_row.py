@@ -78,6 +78,7 @@ from ydf_tpu.parallel.dist_gbt import (
     _DistStats,
     _RPC_TIMEOUT_S,
     _VERIFY,
+    _transport_fields,
     _j_init,
     _j_layer_step,
     _j_sibling_reconstruct,
@@ -462,6 +463,7 @@ class RowDistGBTManager(DistGBTManager):
                 "valid_rows": nv,
                 "hist_quant": self.hist_quant,
                 **self.stats.summary(),
+                **_transport_fields(self.pool),
             },
         }
         return forest_stacked, leaf_values, logs

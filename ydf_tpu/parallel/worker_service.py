@@ -7,25 +7,59 @@ distributed hyperparameter tuning) and the PYDF `ydf.start_worker(port)`
 entry point (`port/python/ydf/learner/worker.py:22-51`).
 
 Design. Where the reference runs a gRPC server speaking the distribute
-protocol, the TPU build needs exactly one remote verb — "train this
-candidate on this data and return its validation score" — so the service
-is a length-prefixed-pickle request/response loop over a TCP socket: a
-dozen lines of protocol instead of a protocol stack. Like the
-reference's distribute layer, the transport assumes a TRUSTED network
-(the reference workers execute arbitrary training requests from their
-manager too); do not expose the port beyond the job's hosts.
+protocol, this service is a length-prefixed-pickle request/response
+protocol over TCP — a dozen lines of framing instead of a protocol
+stack. The transport (this round's overhaul) is a **persistent,
+pipelined** connection per (client, worker) pair:
+
+  * **Connection pool** — `WorkerPool` keeps ONE long-lived
+    authenticated socket per worker address, lazily (re)connected on
+    demand. Reconnect-and-retry replaces connect-per-request: a
+    transport failure kills the pooled connection, the existing
+    retry/backoff/quarantine machinery fires exactly as before, and the
+    next attempt dials fresh. The worker reaps connections idle past
+    `YDF_TPU_WORKER_IDLE_TIMEOUT_S` (no request in flight), so a dead
+    client cannot pin sockets forever.
+  * **Request pipelining** — every frame on a persistent connection is
+    prefixed with an 8-byte sequence id; multiple requests may be in
+    flight per connection and responses complete OUT OF ORDER (the
+    worker answers each request on its own handler the moment it
+    finishes). Completion is exactly-once: the client matches responses
+    to waiters by sequence id, a deadline-expired waiter is
+    deregistered and its late response discarded, and a connection
+    death fails every in-flight waiter with ConnectionError (the
+    head-of-line-safe error fan-out). Per-request deadlines are
+    event waits detached from the socket lifetime — one slow RPC
+    neither extends nor shortens any other request's deadline.
+  * **Zero-copy array framing** — large `np.ndarray` payloads
+    (histogram slices, gradient-stat grids, prediction batches) travel
+    as out-of-band raw buffer segments (pickle protocol 5's
+    out-of-band buffers) described by a small pickled header, instead
+    of being copied through `pickle.dumps`: the sender writes the
+    arrays' own memory to the socket, the receiver reads each segment
+    into a preallocated buffer that BACKS the deserialized array.
+    HMAC is computed incrementally over header + segments. See
+    docs/distributed_training.md "Transport" for the frame grammar.
+
+Like the reference's distribute layer, the transport assumes a TRUSTED
+network (the reference workers execute arbitrary training requests from
+their manager too); do not expose the port beyond the job's hosts.
 
 Authentication. The reference's gRPC backend can enable TLS
 (`utils/distribute/implementations/grpc/grpc.proto:26`); the counterpart
 here is a shared-secret HMAC: when `YDF_TPU_WORKER_SECRET` is set (or a
 `secret=` is passed), every frame carries an HMAC-SHA256 of its payload
-and the worker drops connections whose MAC does not verify
-(constant-time compare). This keeps the trusted-network model but makes
-an accidental `--host 0.0.0.0` non-exploitable for code execution;
-resource use by unauthenticated peers is bounded by a per-connection
-idle timeout and a frame-size cap (YDF_TPU_WORKER_MAX_FRAME bytes,
-default 4 GiB), not eliminated. Requests execute pickled learner
-objects — NEVER expose an unsecured worker beyond loopback.
+(header plus out-of-band segments, computed incrementally) and the
+worker drops connections whose MAC does not verify (constant-time
+compare). The sequence prefix is transport plumbing OUTSIDE the MAC —
+it has to be, so a broadcast frame can be encoded and MAC'd once — so
+the HMAC authenticates frame CONTENT, not stream order; the
+trusted-network model is unchanged. This keeps an accidental
+`--host 0.0.0.0` non-exploitable for code execution; resource use by
+unauthenticated peers is bounded by the idle timeout and the frame-size
+cap (YDF_TPU_WORKER_MAX_FRAME bytes, default 4 GiB), not eliminated.
+Requests execute pickled learner objects — NEVER expose an unsecured
+worker beyond loopback.
 
     # on each worker host / process
     YDF_TPU_WORKER_SECRET=s3cret python -m ydf_tpu.cli worker --port 9900
@@ -45,12 +79,13 @@ import hmac
 import hashlib
 import os
 import pickle
+import queue as queue_mod
 import random
 import socket
 import struct
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 from ydf_tpu.utils import failpoints, telemetry, telemetry_http
 
@@ -70,7 +105,9 @@ def _parse_max_frame() -> int:
     exceed any fixed bound, so payloads above the cap are CHUNKED
     (sender splits, receiver reassembles — `_send_payload` /
     `_recv_payload`) and the cap's remaining job is the pre-auth
-    allocation bound per frame."""
+    allocation bound per frame. Segmented (zero-copy) frames bound the
+    pickled HEADER by the cap and the whole frame by the same
+    cap x _CHUNK_FACTOR assembly bound as chunked frames."""
     raw = os.environ.get("YDF_TPU_WORKER_MAX_FRAME")
     if raw is None:
         return 4 << 30
@@ -90,30 +127,142 @@ def _parse_max_frame() -> int:
     return v
 
 
+def _parse_idle_timeout() -> float:
+    """YDF_TPU_WORKER_IDLE_TIMEOUT_S — how long the worker keeps an
+    idle persistent connection (no request in flight, nothing arriving)
+    before reaping it. Also the per-operation socket progress bound, so
+    a peer that stalls mid-frame is dropped within it. Eagerly
+    validated at import like the other env knobs."""
+    raw = os.environ.get("YDF_TPU_WORKER_IDLE_TIMEOUT_S")
+    if raw is None:
+        return 120.0
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"YDF_TPU_WORKER_IDLE_TIMEOUT_S={raw!r} is not a number of "
+            "seconds"
+        ) from None
+    if not v > 0:
+        raise ValueError(
+            f"YDF_TPU_WORKER_IDLE_TIMEOUT_S={raw} must be > 0"
+        )
+    return v
+
+
 _MAX_FRAME: int = _parse_max_frame()
+_IDLE_TIMEOUT_S: float = _parse_idle_timeout()
 #: A chunked transfer may assemble up to this many caps' worth of bytes
 #: — bounded so a bogus chunk header still cannot demand unbounded
 #: memory, while any realistic histogram payload fits.
 _CHUNK_FACTOR = 1024
 #: Length-prefix sentinel announcing a chunked frame.
 _CHUNK_SENTINEL = (1 << 64) - 1
+#: Length-prefix sentinel announcing a segmented (zero-copy) frame.
+_SEG_SENTINEL = (1 << 64) - 2
+#: Arrays below this size pickle in-band (a tiny out-of-band segment
+#: would cost a syscall + descriptor for no copy saved).
+_SEG_MIN_BYTES = 8 << 10
 
 
 def _max_frame() -> int:
     return _MAX_FRAME
 
 
-def _encode_frame(obj: Any, secret: Optional[bytes] = None) -> bytes:
-    """Request/response payload bytes (pickle + optional HMAC trailer).
-    Split from the socket write so a caller broadcasting one payload to
-    N workers serializes it ONCE (WorkerPool.load_data_all)."""
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+def _hard_close(sock: socket.socket) -> None:
+    """shutdown(SHUT_RDWR) then close. The shutdown matters: close()
+    alone does NOT tear a connection down while another thread is
+    blocked in recv() on it — the in-flight syscall pins the socket,
+    no FIN goes out, and the peer waits its full timeout for a death
+    it was never told about. shutdown() wakes blocked readers and
+    sends the FIN immediately, whoever is mid-recv."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+# --------------------------------------------------------------------- #
+# Frame encoding — one encode (and one MAC) per logical message, shared
+# by every socket it is broadcast to.
+# --------------------------------------------------------------------- #
+
+
+class EncodedFrame:
+    """One encoded RPC message: a pickled header plus zero or more
+    out-of-band raw buffer SEGMENTS (pickle protocol 5 buffers — the
+    memory of large contiguous ndarrays, referenced, not copied). The
+    MAC covers header||segments in order, so a frame can be encoded —
+    and MAC'd — once and delivered to N workers (the load_data_all
+    broadcast contract). For frames without segments, `header` is the
+    exact legacy payload (pickle + MAC trailer) and rides the plain /
+    chunked path byte-identically."""
+
+    __slots__ = ("header", "segments", "seg_lens", "mac", "verb")
+
+    def __init__(self, header: bytes, segments: List[memoryview],
+                 mac: Optional[bytes], verb: Optional[str]):
+        self.header = header
+        self.segments = segments
+        self.seg_lens = [s.nbytes for s in segments]
+        self.mac = mac
+        self.verb = verb
+
+    @property
+    def header_bytes(self) -> int:
+        return len(self.header)
+
+    @property
+    def payload_bytes(self) -> int:
+        return sum(self.seg_lens)
+
+
+def _encode_frame(obj: Any, secret: Optional[bytes] = None) -> EncodedFrame:
+    """Encodes one message. Large contiguous ndarray buffers leave the
+    pickle stream as zero-copy segments (pickle protocol 5 out-of-band
+    buffers); everything else — including non-contiguous arrays, which
+    numpy pickles in-band by value — stays in the header. Split from
+    the socket write so a caller broadcasting one payload to N workers
+    serializes (and MACs) it ONCE (WorkerPool.load_data_all)."""
+    segments: List[memoryview] = []
+
+    def _cb(buf) -> Optional[bool]:
+        raw = buf.raw()
+        if raw.nbytes < _SEG_MIN_BYTES:
+            return True  # keep small buffers in-band
+        segments.append(raw)
+        return None  # out-of-band
+
+    header = pickle.dumps(
+        obj, protocol=pickle.HIGHEST_PROTOCOL, buffer_callback=_cb
+    )
+    if segments and len(header) > _max_frame():
+        # Degenerate: a huge NON-array header next to segments. The
+        # segmented wire format bounds the header by the cap, so fall
+        # back to one fully in-band payload (the chunked path handles
+        # any size).
+        segments = []
+        header = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    verb = obj.get("verb") if isinstance(obj, dict) else None
+    if not segments:
+        if secret:
+            header += hmac.new(secret, header, hashlib.sha256).digest()
+        return EncodedFrame(header, [], None, verb)
+    mac = None
     if secret:
-        payload += hmac.new(secret, payload, hashlib.sha256).digest()
-    return payload
+        h = hmac.new(secret, header, hashlib.sha256)
+        for s in segments:
+            h.update(s)
+        mac = h.digest()
+    return EncodedFrame(header, segments, mac, verb)
 
 
-def _send_payload(sock: socket.socket, payload: bytes) -> None:
+def _send_payload(sock: socket.socket, payload) -> None:
+    """Plain or chunked delivery of one in-band payload (bytes)."""
     cap = _max_frame()
     if len(payload) <= cap:
         sock.sendall(struct.pack("<Q", len(payload)) + payload)
@@ -133,9 +282,54 @@ def _send_payload(sock: socket.socket, payload: bytes) -> None:
         sock.sendall(part)
 
 
+def _send_frame(sock: socket.socket,
+                frame: Union[EncodedFrame, bytes]) -> None:
+    """Writes one encoded frame (segments as raw out-of-band writes
+    straight from the source arrays' memory)."""
+    if isinstance(frame, (bytes, bytearray, memoryview)):
+        _send_payload(sock, frame)
+        return
+    if not frame.segments:
+        _send_payload(sock, frame.header)
+        return
+    lens = frame.seg_lens
+    prefix = struct.pack(
+        "<QQQ", _SEG_SENTINEL, len(frame.header), len(lens)
+    ) + struct.pack(f"<{len(lens)}Q", *lens)
+    # Coalesce prefix + header into one write when small (one TCP
+    # segment for the metadata, then the raw array writes).
+    if len(frame.header) <= (1 << 20):
+        sock.sendall(prefix + frame.header)
+    else:
+        sock.sendall(prefix)
+        sock.sendall(frame.header)
+    for s in frame.segments:
+        sock.sendall(s)
+    if frame.mac:
+        sock.sendall(frame.mac)
+
+
+def _send_seq_frame(sock: socket.socket, seq: int,
+                    frame: Union[EncodedFrame, bytes]) -> None:
+    """One pipelined message: 8-byte sequence prefix, then the frame.
+    Small plain frames coalesce prefix + length + payload into a single
+    write (one TCP segment per RPC on the hot path)."""
+    if isinstance(frame, EncodedFrame) and not frame.segments:
+        frame = frame.header
+    if isinstance(frame, (bytes, bytearray, memoryview)) and len(
+        frame
+    ) <= min(_max_frame(), 1 << 20):
+        sock.sendall(
+            struct.pack("<QQ", seq, len(frame)) + bytes(frame)
+        )
+        return
+    sock.sendall(struct.pack("<Q", seq))
+    _send_frame(sock, frame)
+
+
 def _send_msg(sock: socket.socket, obj: Any,
               secret: Optional[bytes] = None) -> None:
-    _send_payload(sock, _encode_frame(obj, secret))
+    _send_frame(sock, _encode_frame(obj, secret))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -148,9 +342,43 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def _recv_payload(sock: socket.socket) -> bytes:
-    cap = _max_frame()
-    (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
+def _recv_into(sock: socket.socket, buf: bytearray) -> None:
+    """Fills `buf` straight from the socket (recv_into — the segment
+    bytes land in the preallocated buffer that will back the array;
+    no intermediate copies)."""
+    view = memoryview(buf)
+    got = 0
+    while got < len(buf):
+        r = sock.recv_into(view[got:])
+        if not r:
+            raise ConnectionError("peer closed")
+        got += r
+
+
+def _recv_seq_or_idle(sock: socket.socket) -> Optional[int]:
+    """Reads the 8-byte sequence prefix of the next pipelined message.
+    Returns None on a CLEAN idle timeout (no bytes of the prefix had
+    arrived — the caller decides whether to keep waiting or reap);
+    raises ConnectionError on EOF or a stall mid-prefix."""
+    buf = b""
+    while len(buf) < 8:
+        try:
+            chunk = sock.recv(8 - len(buf))
+        except socket.timeout:
+            if not buf:
+                return None
+            raise ConnectionError(
+                "peer stalled mid-frame (sequence prefix)"
+            ) from None
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return struct.unpack("<Q", buf)[0]
+
+
+def _recv_payload_rest(sock: socket.socket, n: int, cap: int) -> bytes:
+    """Body of a plain or chunked payload whose first length word `n`
+    has already been read."""
     if n == _CHUNK_SENTINEL:
         total, nchunks = struct.unpack("<QQ", _recv_exact(sock, 16))
         if total > cap * _CHUNK_FACTOR:
@@ -206,9 +434,88 @@ def _recv_payload(sock: socket.socket) -> bytes:
     return _recv_exact(sock, n)
 
 
-# Bytes currently pinned by in-flight chunked-frame assemblies — the
-# "dist_frames" memory-ledger row (pull source; the per-frame update is
-# two int ops per multi-MB frame, not per chunk).
+def _recv_payload(sock: socket.socket) -> bytes:
+    cap = _max_frame()
+    (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
+    if n == _SEG_SENTINEL:
+        raise ConnectionError(
+            "segmented frame in a payload-only context (peer speaks a "
+            "newer protocol)"
+        )
+    return _recv_payload_rest(sock, n, cap)
+
+
+def _recv_segmented(sock: socket.socket, secret: Optional[bytes],
+                    cap: int) -> Any:
+    """Receives one segmented frame: validates the declared sizes
+    BEFORE any allocation (same pre-auth bound discipline as the
+    chunked path), reads each segment into a preallocated buffer that
+    then BACKS the deserialized array (zero further copies), verifies
+    the incremental HMAC over header + segments, and unpickles with
+    the segments as out-of-band buffers."""
+    hdr_len, nseg = struct.unpack("<QQ", _recv_exact(sock, 16))
+    if hdr_len > cap:
+        raise ConnectionError(
+            f"segmented frame header of {hdr_len} bytes exceeds the "
+            f"{cap}-byte cap; raise the YDF_TPU_WORKER_MAX_FRAME "
+            "environment variable on the receiving side"
+        )
+    if nseg > _CHUNK_FACTOR or nseg < 1:
+        raise ConnectionError(
+            f"segmented frame declares {nseg} segments (bound "
+            f"{_CHUNK_FACTOR}); peer speaks a different protocol"
+        )
+    seg_lens = struct.unpack(f"<{nseg}Q", _recv_exact(sock, 8 * nseg))
+    total = hdr_len + sum(seg_lens)
+    if total > cap * _CHUNK_FACTOR:
+        raise ConnectionError(
+            f"segmented frame of {total} bytes exceeds the "
+            f"{cap * _CHUNK_FACTOR}-byte assembly bound "
+            f"(YDF_TPU_WORKER_MAX_FRAME={cap} x {_CHUNK_FACTOR}); "
+            "raise YDF_TPU_WORKER_MAX_FRAME on the receiving side"
+        )
+    _note_frame_bytes(total)
+    try:
+        header = _recv_exact(sock, hdr_len)
+        bufs: List[bytearray] = []
+        for m in seg_lens:
+            buf = bytearray(m)
+            _recv_into(sock, buf)
+            bufs.append(buf)
+        if secret:
+            mac = _recv_exact(sock, _MAC_LEN)
+            h = hmac.new(secret, header, hashlib.sha256)
+            for b in bufs:
+                h.update(b)
+            if not hmac.compare_digest(mac, h.digest()):
+                raise ConnectionError("authentication failed (bad HMAC)")
+        return pickle.loads(
+            header, buffers=[memoryview(b) for b in bufs]
+        )
+    finally:
+        _note_frame_bytes(-total)
+
+
+def _recv_msg(sock: socket.socket, secret: Optional[bytes] = None) -> Any:
+    cap = _max_frame()
+    (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
+    if n == _SEG_SENTINEL:
+        return _recv_segmented(sock, secret, cap)
+    data = _recv_payload_rest(sock, n, cap)
+    if secret:
+        if len(data) < _MAC_LEN:
+            raise ConnectionError("authentication failed (frame too short)")
+        body, mac = data[:-_MAC_LEN], data[-_MAC_LEN:]
+        want = hmac.new(secret, body, hashlib.sha256).digest()
+        if not hmac.compare_digest(mac, want):
+            raise ConnectionError("authentication failed (bad HMAC)")
+        data = body
+    return pickle.loads(data)
+
+
+# Bytes currently pinned by in-flight chunked/segmented frame
+# assemblies — the "dist_frames" memory-ledger row (pull source; the
+# per-frame update is two int ops per multi-MB frame, not per chunk).
 _FRAME_BYTES_LOCK = threading.Lock()
 _FRAME_BYTES = 0
 
@@ -226,19 +533,6 @@ def frame_assembly_bytes() -> int:
 telemetry.register_mem_source("dist_frames", frame_assembly_bytes)
 
 
-def _recv_msg(sock: socket.socket, secret: Optional[bytes] = None) -> Any:
-    data = _recv_payload(sock)
-    if secret:
-        if len(data) < _MAC_LEN:
-            raise ConnectionError("authentication failed (frame too short)")
-        body, mac = data[:-_MAC_LEN], data[-_MAC_LEN:]
-        want = hmac.new(secret, body, hashlib.sha256).digest()
-        if not hmac.compare_digest(mac, want):
-            raise ConnectionError("authentication failed (bad HMAC)")
-        data = body
-    return pickle.loads(data)
-
-
 # Worker-side dataset cache: load_data ships the (train, holdout) pair
 # ONCE per tuning run; every trial request then carries only the learner
 # config + the data key — the reference workers keep their dataset cache
@@ -254,28 +548,27 @@ _DATA_CACHE_LOCK = threading.Lock()
 
 
 def _send_timeout() -> float:
-    """Deadline for sending one response frame. The accept loop used to
-    run the response send with NO timeout (settimeout(None) for
-    training), so a manager that died mid-request — or stopped reading
-    with a full TCP window — wedged the single-threaded worker forever.
-    Connections are now handled on their own threads AND every send is
-    bounded."""
+    """Deadline for one response send's progress. A manager that died
+    mid-request — or stopped reading with a full TCP window — wedges at
+    most one handler for this long before its connection is dropped
+    (the per-operation socket bound is max of this and the idle
+    timeout)."""
     return float(os.environ.get("YDF_TPU_WORKER_SEND_TIMEOUT", 120.0))
 
 
 def _handle_request(
     req: Dict[str, Any], ctx: Optional[Dict[str, Any]] = None
 ) -> Dict[str, Any]:
-    """Executes one request. Verbs: ping; load_data (cache a
-    train/holdout pair under a key); train_score (train a learner,
-    evaluate on the holdout, return the signed primary-metric score —
-    the reference GenericWorker's TrainModel+EvaluateModel fused; data
-    comes from the cache via data_key, or inline); shutdown; plus the
-    distributed-GBT verbs (dist_worker.VERBS). `ctx` carries this
-    worker INSTANCE's identity: several workers of one test/bench
-    process must not share distributed state (their slot/leaf arrays
-    are per-worker, and concurrent routing updates on shared state
-    would race)."""
+    """Executes one request. Verbs: ping; echo (transport diagnostic);
+    load_data (cache a train/holdout pair under a key); train_score
+    (train a learner, evaluate on the holdout, return the signed
+    primary-metric score — the reference GenericWorker's
+    TrainModel+EvaluateModel fused; data comes from the cache via
+    data_key, or inline); shutdown; plus the distributed-GBT verbs
+    (dist_worker.VERBS). `ctx` carries this worker INSTANCE's identity:
+    several workers of one test/bench process must not share
+    distributed state (their slot/leaf arrays are per-worker, and
+    concurrent routing updates on shared state would race)."""
     verb = req.get("verb")
     wid = (ctx or {}).get("worker_id", "local")
     if verb == "ping":
@@ -287,6 +580,17 @@ def _handle_request(
         # collector imports on first call — is tens of ms and would
         # bias a midpoint estimate.)
         return {"ok": True, "clock_ns": time.perf_counter_ns()}
+    if verb == "echo":
+        # Transport diagnostic: returns the payload (arrays round-trip
+        # the zero-copy framing bit-for-bit) after an optional bounded
+        # delay — the pipelining/out-of-order test handle.
+        d = float(req.get("delay_s") or 0.0)
+        if d > 0:
+            time.sleep(min(d, 10.0))
+        return {
+            "ok": True, "payload": req.get("payload"),
+            "clock_ns": time.perf_counter_ns(),
+        }
     if verb == "get_telemetry":
         # Observability drain: the manager pulls this worker's span
         # buffer and metrics snapshot at end-of-train (and on
@@ -376,15 +680,70 @@ def _handle_request(
     return {"ok": False, "error": f"unknown verb {verb!r}"}
 
 
+class _ConnState:
+    """Per-connection worker-side dispatch state: one RESIDENT handler
+    thread drains a queue (the sequential hot path pays a queue handoff,
+    never a thread spawn), and requests arriving while another is in
+    flight get their own overflow thread — so pipelined requests
+    complete out of order and a slow RPC never blocks the ones behind
+    it (head-of-line safety)."""
+
+    def __init__(self, conn: socket.socket, run_one: Callable):
+        self.conn = conn
+        self.run_one = run_one
+        self.send_lock = threading.Lock()
+        self.lock = threading.Lock()
+        self.inflight = 0
+        self.queue: "queue_mod.SimpleQueue" = queue_mod.SimpleQueue()
+        self._resident_started = False
+
+    def dispatch(self, seq: int, req: Any) -> None:
+        with self.lock:
+            self.inflight += 1
+            overflow = self.inflight > 1
+            if not overflow and not self._resident_started:
+                self._resident_started = True
+                threading.Thread(
+                    target=self._resident, daemon=True
+                ).start()
+        if overflow:
+            threading.Thread(
+                target=self.run_one, args=(self, seq, req), daemon=True
+            ).start()
+        else:
+            self.queue.put((seq, req))
+
+    def _resident(self) -> None:
+        while True:
+            item = self.queue.get()
+            if item is None:
+                return
+            self.run_one(self, *item)
+
+    def done(self) -> None:
+        with self.lock:
+            self.inflight -= 1
+
+    def stop_resident(self) -> None:
+        self.queue.put(None)
+
+
 def start_worker(
     port: int, host: str = "127.0.0.1", blocking: bool = True,
     secret: Optional[bytes] = None, metrics_port: Optional[int] = None,
 ) -> Optional[threading.Thread]:
-    """Serves train/evaluate requests until a shutdown request arrives
-    (reference ydf.start_worker). blocking=False runs the accept loop in
-    a daemon thread and returns it (for tests). When a secret is set
-    (param or YDF_TPU_WORKER_SECRET), unauthenticated or wrong-MAC
-    connections are dropped without executing anything.
+    """Serves requests until a shutdown request arrives (reference
+    ydf.start_worker). blocking=False runs the accept loop in a daemon
+    thread and returns it (for tests). When a secret is set (param or
+    YDF_TPU_WORKER_SECRET), unauthenticated or wrong-MAC connections
+    are dropped without executing anything.
+
+    Connections are PERSISTENT and PIPELINED: each carries a stream of
+    sequence-prefixed request frames; responses are sent (under a
+    per-connection send lock) the moment each handler finishes, in
+    completion order. A connection with nothing in flight is reaped
+    after YDF_TPU_WORKER_IDLE_TIMEOUT_S of silence; shutdown closes
+    every live connection so pooled clients observe the death.
 
     Observability: with `metrics_port` set (or YDF_TPU_METRICS_PORT in
     the env), the process exposition server is started and a /statusz
@@ -397,6 +756,11 @@ def start_worker(
     srv.bind((host, port))
     srv.listen(16)
     stop_evt = threading.Event()
+    # Live connections, so shutdown can close them all: a pooled client
+    # holding a persistent socket must SEE the worker die instead of
+    # talking to a zombie reader thread.
+    conns: set = set()
+    conns_lock = threading.Lock()
     # Per-INSTANCE identity: distributed-GBT state is namespaced by it,
     # so several in-process workers (tests, bench) hold separate
     # slot/leaf arrays exactly like separate worker processes would.
@@ -430,20 +794,36 @@ def start_worker(
         f"worker:{ctx['worker_id']}", _worker_status
     )
 
-    def serve_conn(conn: socket.socket) -> None:
-        """One connection, on its own thread: a stalled or dead manager
-        wedges only this thread, never the accept loop (the old
-        single-threaded loop ran the response send with settimeout(None)
-        — one bad peer blocked every other manager forever)."""
+    def _close_all_conns() -> None:
+        with conns_lock:
+            live = list(conns)
+            conns.clear()
+        for c in live:
+            _hard_close(c)
+
+    def _begin_shutdown() -> None:
+        stop_evt.set()
+        _close_all_conns()
+        # Wake the accept loop: closing a listening socket another
+        # thread is blocked in accept() on is not guaranteed to
+        # unblock it — poke it with a no-op connection instead.
+        whost, wport = srv.getsockname()[:2]
+        if whost == "0.0.0.0":
+            whost = "127.0.0.1"
         try:
-            # Idle timeout per recv chunk: a peer that connects and
-            # sends nothing must not pin a handler thread forever.
-            # Legit large frames stream continuously, so this does not
-            # bound request size.
-            conn.settimeout(120.0)
-            failpoints.hit("worker.recv")
-            req = _recv_msg(conn, secret)
-            conn.settimeout(None)  # training can take hours
+            with socket.create_connection((whost, wport), timeout=5):
+                pass
+        except OSError:
+            pass
+
+    def run_one(state: _ConnState, seq: int, req: Any) -> None:
+        """One request, start to response — on the resident handler or
+        an overflow thread. Any transport-level failure (including the
+        worker.send/worker.handle failpoints) tears the CONNECTION
+        down, so pipelined peers see a dead socket, never a silent
+        hole in the response stream."""
+        conn = state.conn
+        try:
             failpoints.hit("worker.handle")
             # Per-request span + counters — the telemetry the
             # distributed round's manager-side debugging stands on
@@ -495,32 +875,64 @@ def start_worker(
                         telemetry.counter(
                             "ydf_worker_request_errors_total", verb=verb
                         ).inc()
-            # Send deadline: a manager that vanished after sending its
-            # request (full TCP window, half-open connection) must not
-            # pin this thread past the timeout.
-            conn.settimeout(_send_timeout())
             failpoints.hit("worker.send")
-            _send_msg(conn, resp, secret)
+            frame = _encode_frame(resp, secret)
+            with state.send_lock:
+                _send_seq_frame(conn, seq, frame)
             if resp.get("shutdown"):
-                stop_evt.set()
-                # Wake the accept loop: closing a listening socket
-                # another thread is blocked in accept() on is not
-                # guaranteed to unblock it — poke it with a no-op
-                # connection instead.
-                whost, wport = srv.getsockname()[:2]
-                if whost == "0.0.0.0":
-                    whost = "127.0.0.1"
-                try:
-                    with socket.create_connection(
-                        (whost, wport), timeout=5
-                    ):
-                        pass
-                except OSError:
-                    pass
+                _begin_shutdown()
+        except Exception:
+            # Broken/stalled peer or an injected transport fault: the
+            # response stream is unrecoverable — drop the connection
+            # (every in-flight peer request fails over, reconnects,
+            # and retries; all verbs are idempotent/pure by contract).
+            # Hard close: the connection's reader thread is blocked in
+            # recv, so a bare close() would neither wake it nor send
+            # the FIN the client's failover latency depends on.
+            _hard_close(conn)
+        finally:
+            state.done()
+
+    def serve_conn(conn: socket.socket) -> None:
+        """One PERSISTENT connection, on its own reader thread: a
+        stream of sequence-prefixed requests, each dispatched to the
+        resident handler (or an overflow thread when one is already in
+        flight). A stalled or dead peer wedges only this connection's
+        threads, never the accept loop."""
+        with conns_lock:
+            if stop_evt.is_set():
+                conn.close()
+                return
+            conns.add(conn)
+        state = _ConnState(conn, run_one)
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # One fixed per-operation progress bound, set ONCE (socket
+            # timeouts are per-op and shared by the reader and the
+            # handler threads' sends — changing them per phase would
+            # race): a peer that connects and sends nothing is reaped
+            # after it, a peer that stalls mid-frame or stops reading
+            # responses is dropped within it. Legit large frames
+            # stream continuously, so this does not bound request size.
+            conn.settimeout(max(_IDLE_TIMEOUT_S, _send_timeout()))
+            while not stop_evt.is_set():
+                seq = _recv_seq_or_idle(conn)
+                if seq is None:
+                    with state.lock:
+                        idle = state.inflight == 0
+                    if idle:
+                        break  # idle past the reap bound
+                    continue  # a long handler is running; keep serving
+                failpoints.hit("worker.recv")
+                req = _recv_msg(conn, secret)
+                state.dispatch(seq, req)
         except Exception:
             pass  # malformed/broken/unauthenticated/stalled: drop conn
         finally:
-            conn.close()
+            state.stop_resident()
+            with conns_lock:
+                conns.discard(conn)
+            _hard_close(conn)
 
     def loop():
         while not stop_evt.is_set():
@@ -538,6 +950,7 @@ def start_worker(
             srv.close()
         except OSError:
             pass
+        _close_all_conns()
         # Worker shutdown: export whatever telemetry is still buffered
         # and write the flight-recorder black box — a worker that dies
         # between manager drains must not take its last spans with it.
@@ -555,22 +968,191 @@ def start_worker(
     return t
 
 
+# --------------------------------------------------------------------- #
+# Client side: the pooled, pipelined connection.
+# --------------------------------------------------------------------- #
+
+# Process-wide in-flight RPC count (all pools), mirrored into the
+# ydf_rpc_inflight gauge when telemetry is armed.
+_INFLIGHT_LOCK = threading.Lock()
+_INFLIGHT = 0
+
+
+def _note_inflight(delta: int) -> None:
+    global _INFLIGHT
+    with _INFLIGHT_LOCK:
+        _INFLIGHT += delta
+        v = _INFLIGHT
+    if telemetry.ENABLED:
+        telemetry.gauge("ydf_rpc_inflight").set(v)
+
+
+class _PoolConn:
+    """One persistent client connection: a sender (any caller thread,
+    under the send lock) and ONE reader thread matching responses to
+    waiters by sequence id. Death — EOF, reset, a stall mid-frame —
+    fails every in-flight waiter with ConnectionError and evicts the
+    connection from its pool, so the next request redials (lazy
+    reconnect)."""
+
+    def __init__(self, addr: Tuple[str, int], timeout_s: float,
+                 secret: Optional[bytes],
+                 on_close: Optional[Callable[["_PoolConn"], None]] = None):
+        self.addr = addr
+        self.secret = secret
+        self.on_close = on_close
+        self.sock = socket.create_connection(addr, timeout=timeout_s)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Transport keepalive: a silently dead peer (rack power, NAT
+        # reap) is detected by the kernel instead of pinning the
+        # connection until the next request times out.
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+        self.sock.settimeout(timeout_s)
+        self._lock = threading.Lock()
+        self._send_lock = threading.Lock()
+        self._pending: Dict[int, Dict[str, Any]] = {}
+        self._seq = 0
+        self.closed = False
+        self._err: Optional[BaseException] = None
+        threading.Thread(target=self._read_loop, daemon=True).start()
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                seq = _recv_seq_or_idle(self.sock)
+                if seq is None:
+                    if self.closed:
+                        return
+                    continue  # idle wake (socket timeout); keep waiting
+                resp = _recv_msg(self.sock, self.secret)
+                with self._lock:
+                    slot = self._pending.pop(seq, None)
+                if slot is not None:
+                    slot["resp"] = resp
+                    slot["ev"].set()
+                # An unmatched seq is a response whose waiter already
+                # timed out and deregistered: discarded — the waiter
+                # observed its one outcome (the deadline) already.
+        except Exception as e:
+            self._kill(e)
+
+    def _kill(self, err: BaseException) -> None:
+        with self._lock:
+            if self.closed:
+                return
+            self.closed = True
+            self._err = err
+            slots = list(self._pending.values())
+            self._pending.clear()
+        for slot in slots:
+            slot["err"] = ConnectionError(
+                f"connection to {self.addr[0]}:{self.addr[1]} died "
+                f"mid-request: {type(err).__name__}: {err}"
+            )
+            slot["ev"].set()
+        # Hard close (shutdown first): the reader thread may be blocked
+        # in recv on this socket — close() alone would leave it pinned
+        # (and the FIN unsent) until its timeout.
+        _hard_close(self.sock)
+        if self.on_close is not None:
+            self.on_close(self)
+
+    def close(self) -> None:
+        self._kill(ConnectionError("connection closed by pool"))
+
+    def request(self, frame: Union[EncodedFrame, bytes],
+                timeout_s: float) -> Dict[str, Any]:
+        with self._lock:
+            if self.closed:
+                raise ConnectionError(
+                    f"pooled connection to {self.addr} is closed: "
+                    f"{self._err}"
+                )
+            self._seq += 1
+            seq = self._seq
+            slot = {"ev": threading.Event(), "resp": None, "err": None}
+            self._pending[seq] = slot
+        try:
+            with self._send_lock:
+                _send_seq_frame(self.sock, seq, frame)
+        except BaseException as e:
+            # A partial send leaves the stream unframed — the
+            # connection is unusable for every request behind it.
+            with self._lock:
+                self._pending.pop(seq, None)
+            self._kill(e)
+            raise
+        if not slot["ev"].wait(timeout_s):
+            # Per-request deadline, detached from the connection: the
+            # waiter is deregistered (its late response, if any, will
+            # be discarded by the reader) and OTHER in-flight requests
+            # on this connection are untouched.
+            with self._lock:
+                self._pending.pop(seq, None)
+            raise socket.timeout(
+                f"no response from {self.addr[0]}:{self.addr[1]} "
+                f"within {timeout_s}s"
+            )
+        if slot["err"] is not None:
+            raise slot["err"]
+        return slot["resp"]
+
+
+class _TransportStats:
+    """Always-on per-pool transport accounting (the bench families'
+    source; mirrored into telemetry counters when it is armed)."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.connects = 0
+        self.reuses = 0
+        self.header_bytes = 0
+        self.payload_bytes = 0
+
+    def note_connect(self) -> None:
+        with self.lock:
+            self.connects += 1
+
+    def note_request(self, reused: bool, header_bytes: int,
+                     payload_bytes: int) -> None:
+        with self.lock:
+            if reused:
+                self.reuses += 1
+            self.header_bytes += header_bytes
+            self.payload_bytes += payload_bytes
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self.lock:
+            total = self.connects + self.reuses
+            return {
+                "rpc_connects": self.connects,
+                "rpc_conn_reuse_rate": round(
+                    self.reuses / total, 4
+                ) if total else 0.0,
+                "rpc_header_bytes": self.header_bytes,
+                "rpc_payload_bytes": self.payload_bytes,
+            }
+
+
 class WorkerPool:
     """Round-robin client over worker addresses ("host:port"). One
-    request per connection — the simplest protocol that is also robust
-    to worker restarts between trials (the reference re-instantiates
-    workers across manager restarts the same way, distribute.h:52-66).
+    PERSISTENT pipelined connection per worker (lazily dialed, reused
+    across requests, redialed on death) — the connect + handshake +
+    teardown that the old one-request-per-connection protocol paid on
+    every RPC is paid once per (pool, worker) pair.
 
     Fault tolerance (reference distribute semantics, made explicit):
-    transport failures quarantine the worker with exponential backoff —
-    doubling per consecutive failure, capped, jittered so a fleet of
-    managers never retries in lockstep — and a quarantined worker is
-    re-PROBED with a short ping once its backoff expires, returning to
-    rotation on success (a restarted worker is healed, not permanently
-    dropped). `request_retry` wraps one logical request in that policy;
-    `pick_worker`/`mark_failed`/`mark_ok`/`backoff_delay` expose the
-    pieces for callers with their own retry structure (the tuner's
-    need_data re-ship)."""
+    transport failures — now including a pooled connection dying mid-
+    request — quarantine the worker with exponential backoff — doubling
+    per consecutive failure, capped, jittered so a fleet of managers
+    never retries in lockstep — and a quarantined worker is re-PROBED
+    with a short ping once its backoff expires, returning to rotation
+    on success (a restarted worker is healed, not permanently dropped;
+    its stale pooled connection was evicted when it died, so the probe
+    dials fresh). `request_retry` wraps one logical request in that
+    policy; `pick_worker`/`mark_failed`/`mark_ok`/`backoff_delay`
+    expose the pieces for callers with their own retry structure (the
+    tuner's need_data re-ship)."""
 
     def __init__(self, addresses: List[str], timeout_s: float = 3600.0,
                  secret: Optional[bytes] = None,
@@ -604,6 +1186,69 @@ class WorkerPool:
         # consecutive picks spread across the healthy rotation.
         self._rr = 0
         self._rr_lock = threading.Lock()
+        # The connection pool: one live _PoolConn per address, plus a
+        # per-address dial lock so racing first requests never open
+        # duplicate sockets (the <=1-connect-per-pair contract the
+        # fleet asserts).
+        self._conns: Dict[Tuple[str, int], _PoolConn] = {}
+        self._conn_lock = threading.Lock()
+        self._dial_locks: Dict[Tuple[str, int], threading.Lock] = {}
+        self.transport = _TransportStats()
+
+    # ---- the pooled transport --------------------------------------- #
+
+    def _conn_for(
+        self, i: int, timeout_s: float
+    ) -> Tuple[_PoolConn, bool]:
+        """(connection, reused): the live pooled connection for worker
+        i, dialing one — under the per-address dial lock — when none is
+        alive. A dead connection was already evicted by its reader, so
+        this IS the lazy-reconnect path."""
+        addr = self.addresses[i % len(self.addresses)]
+        with self._conn_lock:
+            c = self._conns.get(addr)
+            if c is not None and not c.closed:
+                return c, True
+            dial = self._dial_locks.setdefault(addr, threading.Lock())
+        with dial:
+            with self._conn_lock:
+                c = self._conns.get(addr)
+                if c is not None and not c.closed:
+                    return c, True
+            c = _PoolConn(
+                addr, timeout_s, self.secret,
+                on_close=lambda conn, _a=addr: self._evict(_a, conn),
+            )
+            with self._conn_lock:
+                self._conns[addr] = c
+            self.transport.note_connect()
+            if telemetry.ENABLED:
+                telemetry.counter(
+                    "ydf_rpc_connects_total",
+                    worker=f"{addr[0]}:{addr[1]}",
+                ).inc()
+            return c, False
+
+    def _evict(self, addr: Tuple[str, int], conn: _PoolConn) -> None:
+        with self._conn_lock:
+            if self._conns.get(addr) is conn:
+                del self._conns[addr]
+
+    def close(self) -> None:
+        """Releases every pooled connection (their in-flight waiters
+        fail with ConnectionError). The pool stays usable — the next
+        request redials."""
+        with self._conn_lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            c.close()
+
+    def transport_snapshot(self) -> Dict[str, Any]:
+        """The always-on transport counters: connects, connection-reuse
+        rate, and per-run wire bytes split into pickled header vs raw
+        array payload — the bench families' rpc_* fields."""
+        return self.transport.snapshot()
 
     def request(
         self, i: int, req: Dict[str, Any],
@@ -614,17 +1259,39 @@ class WorkerPool:
         )
 
     def request_frame(
-        self, i: int, frame: bytes, timeout_s: Optional[float] = None,
+        self, i: int, frame: Union[EncodedFrame, bytes],
+        timeout_s: Optional[float] = None,
     ) -> Dict[str, Any]:
-        """`request` over a pre-encoded payload (``_encode_frame``):
+        """`request` over a pre-encoded frame (``_encode_frame``):
         callers broadcasting one request to many workers serialize —
-        and MAC — it once instead of per worker."""
-        host, port = self.addresses[i % len(self.addresses)]
-        with socket.create_connection(
-            (host, port), timeout=timeout_s or self.timeout_s
-        ) as sock:
-            _send_payload(sock, frame)
-            return _recv_msg(sock, self.secret)
+        and MAC — it once instead of per worker. Rides the pooled
+        pipelined connection; transport failures raise
+        OSError/ConnectionError for the callers' retry policies."""
+        t = timeout_s or self.timeout_s
+        conn, reused = self._conn_for(i, t)
+        if isinstance(frame, EncodedFrame):
+            hdr_b, pay_b, verb = (
+                frame.header_bytes, frame.payload_bytes, frame.verb
+            )
+        else:
+            hdr_b, pay_b, verb = len(frame), 0, None
+        self.transport.note_request(reused, hdr_b, pay_b)
+        if telemetry.ENABLED:
+            if reused:
+                telemetry.counter("ydf_rpc_reuse_total").inc()
+            v = str(verb) if verb else "?"
+            telemetry.counter(
+                "ydf_rpc_header_bytes_total", verb=v
+            ).inc(hdr_b)
+            if pay_b:
+                telemetry.counter(
+                    "ydf_rpc_payload_bytes_total", verb=v
+                ).inc(pay_b)
+        _note_inflight(1)
+        try:
+            return conn.request(frame, t)
+        finally:
+            _note_inflight(-1)
 
     # ---- retry / backoff / quarantine ------------------------------- #
 
@@ -693,8 +1360,10 @@ class WorkerPool:
         use next_worker()'s rotating cursor instead). Skips quarantined
         workers; one whose quarantine has EXPIRED is re-probed with a
         short ping first — success heals it, failure re-quarantines
-        with a doubled backoff. None when every worker is currently
-        quarantined (caller backs off and retries)."""
+        with a doubled backoff. The probe rides the pooled connection
+        when one is alive, and dials fresh when the failure that
+        quarantined the worker killed it. None when every worker is
+        currently quarantined (caller backs off and retries)."""
         n = len(self.addresses)
         for off in range(n):
             i = (start + off) % n
@@ -800,7 +1469,7 @@ class WorkerPool:
             )
         self.addresses = alive
 
-    def _ship_frames(self, frames: List[bytes], what: str) -> None:
+    def _ship_frames(self, frames: List[EncodedFrame], what: str) -> None:
         """Delivers frames[i] to worker i with the pinned-retry /
         quarantine-and-tolerate policy shared by load_data_all and
         load_data_each: the payload must land on THAT host, a worker
@@ -839,8 +1508,9 @@ class WorkerPool:
         """Ships the dataset pair to every worker ONCE; trial requests
         then reference it by key instead of re-pickling gigabytes per
         trial. The request is serialized (and MAC'd) a single time and
-        the same frame bytes go to each worker — broadcasting N copies
-        used to pay N full pickles of the dataset."""
+        the same frame — header plus zero-copy array segments — goes to
+        each worker (broadcasting N copies used to pay N full pickles
+        of the dataset)."""
         frame = _encode_frame(
             {
                 "verb": "load_data", "key": key,
@@ -873,3 +1543,4 @@ class WorkerPool:
                 self.request(i, {"verb": "shutdown"})
             except Exception:
                 pass
+        self.close()

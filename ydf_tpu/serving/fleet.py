@@ -122,9 +122,23 @@ class FleetRouter:
         self._swapping = False
         self._divergence = 0
         self._shadow_compared = 0
+        #: Replicas healed back from quarantine that were missing the
+        #: active version's bank and had it re-shipped automatically
+        #: (ydf_fleet_redeploy_total mirrors it when telemetry is on).
+        self._redeploys = 0
+        #: The serialized deploy frame of every live version — encoded
+        #: (and MAC'd) once at deploy; the heal-time auto-redeploy
+        #: re-ships exactly these bytes.
+        self._deploy_frames: Dict[str, Any] = {}
         #: Telemetry-independent per-version latency (the /statusz
         #: read); ydf_fleet_predict_latency_ns mirrors it when on.
         self._lat: Dict[str, LatencyHistogram] = {}
+        #: Per-RPC predict round-trip (ONE replica request on the
+        #: pooled connection — no routing or failover retries), the
+        #: transport-overhead instrument the bench family reads
+        #: (fleet_predict_rtt_p50_ns): with connection reuse this is
+        #: frame + handle + frame, never connect + handshake.
+        self._rtt = LatencyHistogram()
         self._statusz_key: Optional[str] = None
         if register_statusz:
             self._statusz_key = f"fleet:{id(self):x}"
@@ -134,14 +148,18 @@ class FleetRouter:
 
     def deploy(self, model, version: str,
                activate: Optional[bool] = None) -> Dict[str, Any]:
-        """Ships `model` to EVERY replica under `version` (serialized
-        once, same frame bytes per replica — the load_data_all
-        broadcast contract) and verifies each replica built it at the
-        expected forest fingerprint. `activate=True` flips each
-        replica as it loads (first deploy of a fresh fleet defaults to
-        active); later versions default to loading ALONGSIDE the
-        active one, to be promoted by `swap_to` or routed explicitly
-        by a shadow/canary split."""
+        """Ships `model` to every LIVE replica under `version`
+        (serialized once, same frame bytes per replica — the
+        load_data_all broadcast contract) and verifies each replica
+        built it at the expected forest fingerprint. A replica that is
+        quarantined or stays unreachable is SKIPPED (and quarantined)
+        rather than blocking the rollout — it receives the cached
+        deploy frame automatically when it heals (the auto-redeploy
+        path); a fleet where NO replica takes the deploy raises.
+        `activate=True` flips each replica as it loads (first deploy
+        of a fresh fleet defaults to active); later versions default
+        to loading ALONGSIDE the active one, to be promoted by
+        `swap_to` or routed explicitly by a shadow/canary split."""
         from ydf_tpu.serving.flatten import forest_fingerprint
 
         with self._lock:
@@ -162,8 +180,10 @@ class FleetRouter:
             },
             self.pool.secret,
         )
-        results = self._broadcast_frame(frame, f"deploy:{version}")
-        for i, resp in enumerate(results):
+        results, skipped = self._broadcast_frame(
+            frame, f"deploy:{version}"
+        )
+        for i, resp in results:
             if resp.get("fingerprint") != fingerprint:
                 raise FleetError(
                     f"replica {self.pool.addr_str(i)} loaded "
@@ -174,12 +194,14 @@ class FleetRouter:
                 )
         with self._lock:
             self._versions[version] = fingerprint
+            self._deploy_frames[version] = frame
             if activate or self.active_version is None:
                 self.active_version = version
         return {
             "version": version, "fingerprint": fingerprint,
             "replicas": len(results), "active": bool(activate),
-            "engines": sorted({r.get("engine") for r in results}),
+            "skipped": skipped,
+            "engines": sorted({r.get("engine") for _, r in results}),
         }
 
     def swap_to(self, version: str, retire: bool = True) -> Dict[str, Any]:
@@ -316,6 +338,7 @@ class FleetRouter:
                         )
                 with self._lock:
                     self._versions.pop(old, None)
+                    self._deploy_frames.pop(old, None)
                     self._split_drop_version(old)
         if telemetry.ENABLED:
             telemetry.counter("ydf_fleet_swap_total").inc()
@@ -479,13 +502,27 @@ class FleetRouter:
                 continue
             try:
                 failpoints.hit("fleet.replica_predict")
+                t_rpc0 = time.perf_counter_ns()
                 resp = self.pool.request_frame(idx, frame)
+                self._rtt.observe_ns(time.perf_counter_ns() - t_rpc0)
             except (OSError, ConnectionError) as e:
                 self.pool.mark_failed(idx)
                 self._note_failover(idx, e)
                 last_err = e
                 continue
             if not resp.get("ok"):
+                if resp.get("need_load") and self._try_redeploy(idx):
+                    # A replica healed from quarantine without the
+                    # active version's bank (it restarted, or missed
+                    # the deploy while down): the cached deploy frame
+                    # was re-shipped and its pointer flipped — retry
+                    # the request on the rotation (it may land right
+                    # back here, now serving).
+                    last_err = FleetError(
+                        f"replica {self.pool.addr_str(idx)} was "
+                        "missing the active bank; redeployed"
+                    )
+                    continue
                 raise FleetError(
                     f"replica {self.pool.addr_str(idx)} refused "
                     f"predict: {resp.get('error')}"
@@ -504,11 +541,21 @@ class FleetRouter:
                 served = resp.get("version")
                 if want is not None and served != want and not swapping:
                     try:
-                        self._replica_request(
-                            idx, {"verb": "serve_swap", "version": want},
-                            "stale resync", attempts=1,
+                        sw = self.pool.request(
+                            idx,
+                            {"verb": "serve_swap", "version": want},
                         )
-                    except Exception as e:
+                        if not sw.get("ok"):
+                            # The healed replica does not even HOLD the
+                            # active bank (it missed the deploy, or
+                            # restarted): re-ship it; anything else is
+                            # a worker problem — quarantine.
+                            if not (
+                                sw.get("need_load")
+                                and self._try_redeploy(idx)
+                            ):
+                                self.pool.mark_failed(idx)
+                    except (OSError, ConnectionError) as e:
                         self.pool.mark_failed(idx)
                         self._note_failover(idx, e)
                     last_err = FleetError(
@@ -524,6 +571,45 @@ class FleetRouter:
             f"({self.pool.retry_attempts} attempts); last error: "
             f"{last_err}"
         )
+
+    def _try_redeploy(self, idx: int) -> bool:
+        """Replica auto-redeploy on heal: re-ships the ACTIVE version's
+        cached deploy frame (the exact bytes `deploy` broadcast —
+        encoded and MAC'd once) to replica idx and flips its pointer,
+        so a replica that healed from quarantine without the bank — it
+        restarted, or the version shipped while it was down — returns
+        to rotation serving bit-identically instead of being
+        quarantined forever. False (and quarantined) when the re-ship
+        itself fails; True after the replica verifiably holds and
+        serves the active version."""
+        with self._lock:
+            want = self.active_version
+            frame = self._deploy_frames.get(want) if want else None
+            expected = self._versions.get(want) if want else None
+        if frame is None:
+            return False
+        try:
+            resp = self.pool.request_frame(idx, frame)
+            if not resp.get("ok") or (
+                resp.get("fingerprint") not in (None, expected)
+            ):
+                self.pool.mark_failed(idx)
+                return False
+            sw = self.pool.request(
+                idx, {"verb": "serve_swap", "version": want}
+            )
+            if not sw.get("ok"):
+                self.pool.mark_failed(idx)
+                return False
+        except (OSError, ConnectionError) as e:
+            self.pool.mark_failed(idx)
+            self._note_failover(idx, e)
+            return False
+        with self._lock:
+            self._redeploys += 1
+        if telemetry.ENABLED:
+            telemetry.counter("ydf_fleet_redeploy_total").inc()
+        return True
 
     def _note_failover(self, idx: int, err: BaseException) -> None:
         with self._lock:
@@ -566,13 +652,23 @@ class FleetRouter:
             f"{what}: {last_err}"
         )
 
-    def _broadcast_frame(self, frame: bytes,
-                         what: str) -> List[Dict[str, Any]]:
-        """Delivers one pre-encoded frame to EVERY replica (pinned, no
-        failover — a deploy must land everywhere), raising if any
-        replica stays unreachable or refuses."""
-        results = []
+    def _broadcast_frame(self, frame, what: str):
+        """Delivers one pre-encoded frame to every LIVE replica
+        (pinned, no failover). A replica that is quarantined right now
+        — or stays unreachable through the short retry — is skipped
+        and quarantined, exactly like the swap rollout's liveness
+        probe: a dead box must not block the healthy majority, and the
+        auto-redeploy path resyncs it when it heals. A protocol-level
+        refusal still raises. Returns ([(index, response)], [skipped
+        addr strings]); raises when NO replica took the frame."""
+        import warnings
+
+        results: List = []
+        skipped: List[str] = []
         for i in range(len(self.pool.addresses)):
+            if self.pool.is_quarantined(i):
+                skipped.append(self.pool.addr_str(i))
+                continue
             last_err: Optional[BaseException] = None
             resp = None
             for attempt in range(3):
@@ -586,17 +682,27 @@ class FleetRouter:
                     last_err = e
             if last_err is not None:
                 self.pool.mark_failed(i)
-                raise FleetError(
+                skipped.append(self.pool.addr_str(i))
+                warnings.warn(
                     f"replica {self.pool.addr_str(i)} unreachable "
-                    f"during {what}: {last_err}"
+                    f"during {what} ({last_err}); it is quarantined "
+                    "and will be redeployed automatically when it "
+                    "heals",
+                    RuntimeWarning, stacklevel=3,
                 )
+                continue
             if not resp.get("ok"):
                 raise FleetError(
                     f"replica {self.pool.addr_str(i)} failed {what}: "
                     f"{resp.get('error')}"
                 )
-            results.append(resp)
-        return results
+            results.append((i, resp))
+        if not results:
+            raise FleetError(
+                f"no reachable replica during {what} "
+                f"(skipped: {skipped})"
+            )
+        return results, skipped
 
     def replica_statuses(self) -> List[Dict[str, Any]]:
         """serve_status of every reachable replica (unreachable ones
@@ -620,7 +726,9 @@ class FleetRouter:
     def status(self) -> Dict[str, Any]:
         """The router's /statusz section: replica addresses, versions
         and the active pointer, the split config, failover/swap/
-        divergence totals, and per-version latency percentiles."""
+        redeploy/divergence totals, per-version latency percentiles,
+        the per-RPC predict round-trip p50, and the pooled transport's
+        connect/reuse/wire-byte counters."""
         with self._lock:
             lat = {
                 v: {
@@ -639,15 +747,21 @@ class FleetRouter:
                 "split": dict(self._split) if self._split else None,
                 "failovers": self._failovers,
                 "swaps": self._swaps,
+                "redeploys": self._redeploys,
                 "shadow_compared": self._shadow_compared,
                 "divergence": self._divergence,
                 "latency_ns": lat,
+                "predict_rtt_p50_ns": self._rtt.percentile_ns(50),
+                "transport": self.pool.transport_snapshot(),
             }
 
     def close(self) -> None:
         if self._statusz_key is not None:
             telemetry_http.unregister_status(self._statusz_key)
             self._statusz_key = None
+        # Release the persistent replica connections (the router owns
+        # its pool, unlike the shared distributed-training workers).
+        self.pool.close()
 
     def __enter__(self):
         return self

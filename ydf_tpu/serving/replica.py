@@ -120,6 +120,16 @@ def _reset_for_tests() -> None:
         _STATE.clear()
 
 
+def reset_worker(worker_id: str) -> None:
+    """Drops ONE worker instance's replica state — the test handle for
+    a replica process restart: an IN-PROCESS replica restarted on the
+    same port would otherwise still find its banks in this module's
+    process-global registry, which a real process restart would have
+    lost (tests/test_fleet.py, the heal-redeploy proof)."""
+    with _STATE_LOCK:
+        _STATE.pop(worker_id, None)
+
+
 def _build_fn(model):
     """(fn, bank, engine_name) for a deserialized model: the native
     data-bank walk when built and allowed (YDF_TPU_SERVE_IMPL honors
