@@ -976,6 +976,12 @@ def measure_distributed_family(rows, trees, depth, features, record):
       dist_rpc_p50_ns         per-verb RPC p50 from the run's latency
                               histograms (telemetry-keyed by verb)
       dist_recoveries         reassignments the run needed (0 healthy)
+      dist_snapshot_s         manager tree-boundary snapshot wall (the
+                              preemption-safe round: the bench train
+                              runs with a working_dir so the durable
+                              forest-so-far snapshot the resume
+                              contract depends on is part of the
+                              measured protocol cost)
       dist_compute_s          per-layer wall attribution, summed over
       dist_net_s              the run: compute (worker kernels +
       dist_wait_s             manager search), network (median RPC −
@@ -1054,18 +1060,22 @@ def measure_distributed_family(rows, trees, depth, features, record):
                 task=Task.CLASSIFICATION, **shard_kw,
             )
 
-            def train_dist():
+            def train_dist(run):
+                # A working_dir per run arms the tree-boundary
+                # snapshot machinery (at least the final boundary's
+                # durable snapshot) — dist_snapshot_s measures it.
                 learner = ydf.GradientBoostedTreesLearner(
                     label="label", num_trees=trees, max_depth=depth,
                     validation_ratio=0.0, early_stopping="NONE",
                     distributed_workers=addrs,
+                    working_dir=os.path.join(td, f"wd_{run}"),
                 )
                 t0 = time.time()
                 model = learner.train(cache)
                 return model, time.time() - t0
 
-            train_dist()                  # compile + shard placement
-            model, wall = train_dist()    # steady state
+            train_dist(0)                  # compile + shard placement
+            model, wall = train_dist(1)    # steady state
             d = model.training_logs["distributed"]
             record["dist_mode"] = d.get("mode", "feature")
             record["dist_workers"] = nw
@@ -1078,6 +1088,9 @@ def measure_distributed_family(rows, trees, depth, features, record):
             record["dist_shard_rows"] = int(d.get("shard_rows", rows))
             record["dist_rpc_p50_ns"] = d["rpc_p50_ns"]
             record["dist_recoveries"] = int(d["recoveries"])
+            record["dist_snapshot_s"] = round(
+                d.get("snapshot_s", 0.0), 4
+            )
             # Fleet-total resident shard/state bytes the workers
             # reported at shard load — the distributed row of the
             # memory headline (docs/observability.md) — plus the

@@ -93,6 +93,9 @@ FIELD_SPECS: Dict[str, Tuple[str, float, float]] = {
     "dist_shard_bytes_per_worker": ("lower", 0.10, float(1 << 20)),
     "dist_shard_rows": ("lower", 0.05, 1024.0),
     "dist_merge_s": ("lower", 0.25, 0.05),
+    # Manager tree-boundary snapshot wall (preemption-safe round):
+    # fsync-dominated, so a generous rel band with a small abs floor.
+    "dist_snapshot_s": ("lower", 0.30, 0.02),
     "dist_train_s": ("lower", 0.15, 0.2),
     "dist_compute_s": ("lower", 0.20, 0.1),
     "dist_net_s": ("lower", 0.25, 0.1),

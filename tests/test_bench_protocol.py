@@ -187,6 +187,10 @@ def test_small_cpu_run_with_distributed_family():
     assert p50.get("build_histograms", 0) > 0
     assert p50.get("load_cache_shard", 0) > 0
     assert rec["dist_recoveries"] == 0
+    # Preemption-safe round: the bench train runs with a working_dir,
+    # so the manager's tree-boundary snapshot wall (at least the final
+    # boundary's durable write) rides the headline record.
+    assert rec["dist_snapshot_s"] > 0
     # Fleet-total resident shard/state bytes the workers reported at
     # shard load (round 15's distributed memory headline).
     assert rec["dist_shard_bytes"] > 0

@@ -278,6 +278,34 @@ with _tempfile.TemporaryDirectory() as _td:
             assert np.array_equal(np.asarray(_flr[_k]),
                                   np.asarray(_fh[_k])), _k
     assert _m_hyb.training_logs["distributed"]["mode"] == "hybrid"
+    # Preemption-safe distributed training under the sanitizer: a
+    # manager preempted at a tree boundary (forced durable snapshot,
+    # TrainingPreempted) is resumed by a NEW manager — reattach loads
+    # shards through the sanitized crc/stream paths, the epoch-fenced
+    # RPCs drive the same native histogram kernels, and the resumed
+    # model must equal the uninterrupted one bit for bit.
+    _wd = _td + "/wd_resume"
+    _lp = _mk(
+        distributed_workers=[f"127.0.0.1:{_port}"], working_dir=_wd,
+        resume_training_snapshot_interval_trees=1,
+    )
+    _lp._preempt_after_chunks = 1
+    try:
+        _lp.train(_cache)
+        raise AssertionError("distributed preemption did not fire")
+    except ydf.TrainingPreempted:
+        pass
+    _m_res = _mk(
+        distributed_workers=[f"127.0.0.1:{_port}"], working_dir=_wd,
+        resume_training=True,
+    ).train(_cache)
+    _fres = _m_res.forest.to_numpy()
+    for _k in _fl:
+        if _fl[_k] is not None:
+            assert np.array_equal(np.asarray(_fl[_k]),
+                                  np.asarray(_fres[_k])), _k
+    assert _m_res.training_logs["distributed"]["resumed_from"] == 1
+    assert _m_res.training_logs["distributed"]["epoch"] == 2
     # Pipelined fan-out on ONE pooled connection under the sanitizer
     # (transport round): concurrent zero-copy echo frames — segmented
     # send, recv_into preallocated buffers, incremental HMAC-free

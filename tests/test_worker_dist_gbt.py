@@ -488,6 +488,349 @@ def test_unsharded_cache_shard_accessors_raise(tmp_path):
         cache.shard_bins(0)
 
 
+# --------------------------------------------------------------------- #
+# Preemption-safe training: manager checkpoint/resume + epoch fencing
+# --------------------------------------------------------------------- #
+
+
+def _cache_for_mode(tmp_path, mode, name=None):
+    kw = {
+        "feature": {"feature_shards": 2},
+        "row": {"row_shards": 2},
+        "hybrid": {"row_shards": 2, "feature_shards": 2},
+    }[mode]
+    return create_dataset_cache(
+        _frame(), str(tmp_path / (name or f"cache_{mode}")),
+        label="y", task=Task.REGRESSION, **kw,
+    )
+
+
+def _preempt_then_resume(cache, addrs, wd, resume_addrs=None,
+                         interval=2, **kw):
+    """Trains until the first snapshot (2 trees at interval=2), takes
+    the simulated SIGTERM at the boundary (forced-final-snapshot →
+    TrainingPreempted), then resumes with a NEW manager."""
+    l1 = _learner(
+        distributed_workers=addrs, working_dir=str(wd),
+        resume_training_snapshot_interval_trees=interval, **kw,
+    )
+    l1._preempt_after_chunks = 1
+    with pytest.raises(ydf.TrainingPreempted):
+        l1.train(cache)
+    l2 = _learner(
+        distributed_workers=list(resume_addrs or addrs),
+        working_dir=str(wd), resume_training=True,
+        resume_training_snapshot_interval_trees=interval, **kw,
+    )
+    return l2.train(cache)
+
+
+@pytest.mark.parametrize(
+    "mode,quant",
+    [
+        ("feature", "f32"), ("feature", "int8"),
+        ("row", "f32"), ("row", "int8"),
+        ("hybrid", "f32"), ("hybrid", "int8"),
+    ],
+)
+def test_dist_resume_bit_identity(tmp_path, workers, monkeypatch, mode,
+                                  quant):
+    """The acceptance criterion: a manager preempted at a tree boundary
+    resumes via a NEW manager to a model bit-identical to the
+    uninterrupted run — in all three dist modes and both ends of the
+    YDF_TPU_HIST_QUANT spectrum (int8's wire format exercises the
+    per-tree quant-grid re-derivation after restore)."""
+    from ydf_tpu.learners.gbt import _make_boost_fn
+
+    if quant != "f32":
+        monkeypatch.setenv("YDF_TPU_HIST_QUANT", quant)
+        _make_boost_fn.cache_clear()
+    try:
+        cache = _cache_for_mode(tmp_path, mode)
+        addrs = workers(2)
+        m_ref = _learner(distributed_workers=addrs).train(cache)
+        m_res = _preempt_then_resume(cache, addrs, tmp_path / "wd")
+        _assert_bit_identical(m_res, m_ref)
+        d = m_res.training_logs["distributed"]
+        assert d["resumed_from"] == 2
+        assert d["epoch"] == 2
+        assert d["snapshots"] >= 1
+        assert d["snapshot_s"] > 0
+        assert d["hist_quant"] == quant
+    finally:
+        if quant != "f32":
+            _make_boost_fn.cache_clear()
+
+
+def test_dist_resume_across_worker_counts(tmp_path, workers):
+    """Resume is bit-identical across FLEET SIZES: preempted on 2
+    workers, resumed on 3 — worker count is deliberately outside the
+    snapshot fingerprint, and row-mode partial sums are bit-stable
+    under any placement."""
+    cache = _cache_for_mode(tmp_path, "row")
+    addrs = workers(3)
+    m_ref = _learner(distributed_workers=addrs[:2]).train(cache)
+    m_res = _preempt_then_resume(
+        cache, addrs[:2], tmp_path / "wd", resume_addrs=addrs
+    )
+    _assert_bit_identical(m_res, m_ref)
+
+
+def test_dist_resume_fingerprint_mismatch_raises(tmp_path, workers):
+    """Satellite contract: resuming against different flags fails fast
+    with a clear error instead of silently mixing trees."""
+    cache = _cache_for_mode(tmp_path, "feature")
+    addrs = workers(2)
+    l1 = _learner(
+        distributed_workers=addrs, working_dir=str(tmp_path / "wd"),
+        resume_training_snapshot_interval_trees=2,
+    )
+    l1._preempt_after_chunks = 1
+    with pytest.raises(ydf.TrainingPreempted):
+        l1.train(cache)
+    with pytest.raises(ValueError, match="refusing to resume"):
+        _learner(
+            distributed_workers=addrs,
+            working_dir=str(tmp_path / "wd"), resume_training=True,
+            shrinkage=0.05,  # differs from the snapshot's config
+        ).train(cache)
+
+
+def test_dist_resume_reattach_after_corrupt_shard(tmp_path, workers):
+    """Reattach verifies every shard: one corrupted while the manager
+    was dead is caught by the worker's crc at load, re-sliced from the
+    verified bins.npy, and the resumed model is still bit-identical."""
+    cache = _cache_for_mode(tmp_path, "feature")
+    addrs = workers(2)
+    m_ref = _learner(distributed_workers=addrs).train(cache)
+    l1 = _learner(
+        distributed_workers=addrs, working_dir=str(tmp_path / "wd"),
+        resume_training_snapshot_interval_trees=2,
+    )
+    l1._preempt_after_chunks = 1
+    with pytest.raises(ydf.TrainingPreempted):
+        l1.train(cache)
+    shard_path = os.path.join(cache.path, "bins_shard_0.npy")
+    before = open(shard_path, "rb").read()
+    with open(shard_path, "r+b") as f:
+        f.seek(len(before) // 2)
+        b = f.read(1)
+        f.seek(-1, 1)
+        f.write(bytes([b[0] ^ 0xFF]))
+    m_res = _learner(
+        distributed_workers=addrs, working_dir=str(tmp_path / "wd"),
+        resume_training=True,
+        resume_training_snapshot_interval_trees=2,
+    ).train(cache)
+    _assert_bit_identical(m_res, m_ref)
+    assert m_res.training_logs["distributed"]["shard_rebuilds"] >= 1
+    assert open(shard_path, "rb").read() == before
+
+
+def test_epoch_fence_rejects_stale_rpc(tmp_path):
+    """Worker-side fencing contract at the handle level: a stale-epoch
+    RPC gets the TYPED rejection (never need_shard — a zombie must not
+    be invited to re-ship), mutates nothing, and a zombie's re-attach
+    is refused too; a work verb from an epoch the state has not been
+    attached by answers need_shard."""
+    cache = _make_cache(tmp_path, shards=2)
+    wid = "fence-worker"
+    r = dist_worker.handle(
+        "load_cache_shard",
+        {"key": "k", "shards": [0, 1], "cache_dir": cache.path,
+         "epoch": 2},
+        wid,
+    )
+    assert r["ok"]
+    st = dist_worker._get_state(wid, "k")
+    assert st.epoch == 2
+    stale = dist_worker.handle(
+        "build_histograms",
+        {"key": "k", "epoch": 1, "tree": 0, "layer": 0, "reset": True,
+         "shards": [0], "num_slots": 1,
+         "num_bins": cache.binner.num_bins},
+        wid,
+    )
+    assert stale["ok"] is False
+    assert stale["stale_epoch"] is True
+    assert stale["have_epoch"] == 2
+    assert "stale manager epoch" in stale["error"]
+    assert st.pos == (-1, 0), "rejected request mutated worker state"
+    # Zombie re-attach: the load verb is fenced the same way.
+    stale2 = dist_worker.handle(
+        "load_cache_shard",
+        {"key": "k", "shards": [0], "cache_dir": cache.path,
+         "epoch": 1},
+        wid,
+    )
+    assert stale2.get("stale_epoch") is True
+    assert st.epoch == 2
+    # A NEWER manager that never attached (no load at its epoch yet):
+    # work verbs demand the re-ship instead of trusting old state.
+    ahead = dist_worker.handle(
+        "apply_split",
+        {"key": "k", "epoch": 3, "tree": 0, "layer": 0,
+         "tables": None, "shards": [0]},
+        wid,
+    )
+    assert ahead.get("need_shard") is True
+    assert st.epoch == 2  # only a load may advance it
+    dist_worker.reset_state()
+
+
+@pytest.mark.chaos
+def test_chaos_epoch_fence_fences_manager_without_corruption(
+    tmp_path, workers
+):
+    """dist.epoch_fence converts one mid-train RPC into the stale
+    rejection (as if a newer manager had attached): the fenced manager
+    stops LOUDLY, and because the rejection mutated nothing, a clean
+    rerun over the same workers is bit-identical to the reference."""
+    from ydf_tpu.parallel.dist_gbt import DistributedTrainingError
+
+    cache = _make_cache(tmp_path, shards=2)
+    addrs = workers(2)
+    m_ref = _learner().train(cache)
+    # @3: the first two hits are the shard-load fences (one per
+    # worker); the third fences a mid-train histogram RPC.
+    with failpoints.active("dist.epoch_fence=error@3"):
+        with pytest.raises(DistributedTrainingError, match="fenced out"):
+            _learner(distributed_workers=addrs).train(cache)
+        assert "dist.epoch_fence" in failpoints.fired_sites()
+    m2 = _learner(distributed_workers=addrs).train(cache)
+    _assert_bit_identical(m2, m_ref)
+
+
+@pytest.mark.chaos
+def test_chaos_snapshot_crash_resumes_from_previous_boundary(
+    tmp_path, workers
+):
+    """dist.snapshot=error@2: the manager dies writing the second
+    snapshot; resume recovers from the first (durable) one and the
+    model is bit-identical to the uninterrupted run."""
+    cache = _make_cache(tmp_path, shards=2)
+    addrs = workers(2)
+    m_ref = _learner(distributed_workers=addrs).train(cache)
+    wd = str(tmp_path / "wd")
+    with failpoints.active("dist.snapshot=error@2"):
+        with pytest.raises(failpoints.FailpointError):
+            _learner(
+                distributed_workers=addrs, working_dir=wd,
+                resume_training_snapshot_interval_trees=1,
+            ).train(cache)
+        assert "dist.snapshot" in failpoints.fired_sites()
+    m2 = _learner(
+        distributed_workers=addrs, working_dir=wd,
+        resume_training=True,
+        resume_training_snapshot_interval_trees=1,
+    ).train(cache)
+    _assert_bit_identical(m2, m_ref)
+    assert m2.training_logs["distributed"]["resumed_from"] == 1
+
+
+@pytest.mark.chaos
+def test_chaos_resume_attach_drop_fails_over(tmp_path, workers):
+    """dist.resume_attach=drop_conn: the resumed manager's reattach
+    shard-load drops its connection; the shard fails over to the next
+    healthy worker and the resumed model is bit-identical."""
+    cache = _make_cache(tmp_path, shards=2)
+    addrs = workers(2)
+    m_ref = _learner(distributed_workers=addrs).train(cache)
+    wd = str(tmp_path / "wd")
+    l1 = _learner(
+        distributed_workers=addrs, working_dir=wd,
+        resume_training_snapshot_interval_trees=2,
+    )
+    l1._preempt_after_chunks = 1
+    with pytest.raises(ydf.TrainingPreempted):
+        l1.train(cache)
+    with failpoints.active("dist.resume_attach=drop_conn"):
+        m2 = _learner(
+            distributed_workers=addrs, working_dir=wd,
+            resume_training=True,
+            resume_training_snapshot_interval_trees=2,
+        ).train(cache)
+        assert "dist.resume_attach" in failpoints.fired_sites()
+    _assert_bit_identical(m2, m_ref)
+    assert m2.training_logs["distributed"]["recoveries"] >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.parametrize("signame,expect_rc", [
+    ("SIGKILL", None),  # hard kill: no goodbye (rc = -SIGKILL)
+    ("SIGTERM", 75),    # preemption: forced final snapshot, exit 75
+])
+def test_real_kill_of_manager_subprocess_then_cli_resume(
+    tmp_path, workers, signame, expect_rc
+):
+    """The real thing, mirroring round 10's single-machine version: a
+    `cli train --workers --working_dir` MANAGER process is killed
+    after its first tree-boundary snapshot lands (SIGKILL: no goodbye;
+    SIGTERM: the guard's forced final snapshot and the resumable exit
+    code 75); `--resume` in a fresh process completes the run with
+    exit 0, and the saved model predicts bit-identically to an
+    uninterrupted in-process train."""
+    import json
+    import signal
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cache = _cache_for_mode(tmp_path, "feature")
+    addrs = workers(2)
+    hp = {
+        "num_trees": 10, "max_depth": 3, "validation_ratio": 0.0,
+        "early_stopping": "NONE",
+        "resume_training_snapshot_interval_trees": 1,
+    }
+    m_ref = ydf.GradientBoostedTreesLearner(
+        label="y", task=Task.REGRESSION, **hp
+    ).train(cache)
+    wd = str(tmp_path / "wd")
+    out_dir = str(tmp_path / "model")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": repo}
+    env.pop("YDF_TPU_FAILPOINTS", None)
+    cmd = [
+        sys.executable, "-m", "ydf_tpu.cli", "train",
+        "--dataset", cache.path, "--label", "y",
+        "--task", "REGRESSION", "--output", out_dir,
+        "--workers", ",".join(addrs), "--working_dir", wd,
+        "--hyperparameters", json.dumps(hp), "--cpu",
+    ]
+    proc = subprocess.Popen(
+        cmd, cwd=repo, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+    )
+    index = os.path.join(wd, "snapshot")
+    deadline = time.time() + 420
+    while not os.path.exists(index) and time.time() < deadline:
+        if proc.poll() is not None:
+            pytest.fail(
+                "manager exited before first snapshot: "
+                f"{proc.stderr.read()[-3000:]}"
+            )
+        time.sleep(0.01)
+    assert os.path.exists(index), "no snapshot within 420s"
+    sig = getattr(signal, signame)
+    proc.send_signal(sig)
+    rc = proc.wait(timeout=300)
+    assert rc == (expect_rc if expect_rc is not None else -sig), (
+        rc, proc.stderr.read()[-3000:]
+    )
+    done = subprocess.run(
+        cmd + ["--resume"], cwd=repo, env=env, capture_output=True,
+        text=True, timeout=600,
+    )
+    assert done.returncode == 0, done.stderr[-3000:]
+    m_res = ydf.load_model(out_dir)
+    probe = _frame(n=256, seed=11)
+    np.testing.assert_array_equal(
+        np.asarray(m_ref.predict(probe)),
+        np.asarray(m_res.predict(probe)),
+    )
+
+
 def test_shard_col_ranges_cover_and_validate():
     from ydf_tpu.dataset.cache import shard_col_ranges
 

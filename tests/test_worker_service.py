@@ -473,3 +473,120 @@ def test_next_worker_round_robin_spreads_after_quarantine():
     assert sorted(set(pool.next_worker() for _ in range(6))) == [0, 1, 2]
     # And the fixed-start scan is unchanged for callers that pin.
     assert pool.pick_worker(2) == 2
+
+
+# --------------------------------------------------------------------- #
+# Orphan-state reaping (YDF_TPU_WORKER_STATE_TTL_S)
+# --------------------------------------------------------------------- #
+
+
+def test_worker_state_ttl_env_validation(monkeypatch):
+    """Eager validation at the env boundary, same policy as the other
+    worker knobs: typos raise, 0/off/unset disable."""
+    from ydf_tpu.parallel.worker_service import _parse_state_ttl
+
+    for bad in ("banana", "-3", "0.0"):
+        monkeypatch.setenv("YDF_TPU_WORKER_STATE_TTL_S", bad)
+        with pytest.raises(ValueError, match="YDF_TPU_WORKER_STATE_TTL_S"):
+            _parse_state_ttl()
+    for off in ("0", "off", ""):
+        monkeypatch.setenv("YDF_TPU_WORKER_STATE_TTL_S", off)
+        assert _parse_state_ttl() is None
+    monkeypatch.delenv("YDF_TPU_WORKER_STATE_TTL_S")
+    assert _parse_state_ttl() is None
+    monkeypatch.setenv("YDF_TPU_WORKER_STATE_TTL_S", "2.5")
+    assert _parse_state_ttl() == 2.5
+
+
+def test_worker_state_reaped_after_ttl(tmp_path):
+    """A dead manager's resident dist state (shards, routing arrays)
+    and replica serving state are reaped once idle past the TTL: the
+    ledger bytes are released, and a manager that returns is healed by
+    the ordinary need_shard path instead of finding stale state."""
+    from ydf_tpu.config import Task
+    from ydf_tpu.dataset.cache import create_dataset_cache
+    from ydf_tpu.parallel import dist_worker
+    from ydf_tpu.serving import replica
+
+    rng = np.random.RandomState(0)
+    frame = {
+        "a": rng.normal(size=400), "b": rng.normal(size=400),
+        "y": rng.normal(size=400).astype(np.float32),
+    }
+    cache = create_dataset_cache(
+        frame, str(tmp_path / "c"), label="y", task=Task.REGRESSION,
+        feature_shards=2,
+    )
+    r = dist_worker.handle(
+        "load_cache_shard",
+        {"key": "ttl-k", "shards": [0, 1], "cache_dir": cache.path,
+         "epoch": 1},
+        "ttl-w",
+    )
+    assert r["ok"]
+    assert dist_worker.shard_bytes_total("ttl-w") > 0
+    # Not idle long enough: nothing reaped.
+    n, freed = dist_worker.reap_idle_state(3600.0)
+    assert n == 0 and freed == 0
+    assert dist_worker.shard_bytes_total("ttl-w") > 0
+    time.sleep(0.05)
+    n, freed = dist_worker.reap_idle_state(0.02)
+    assert n >= 1 and freed > 0
+    assert dist_worker.shard_bytes_total("ttl-w") == 0
+    # The returning manager is healed, not broken: need_shard → re-ship.
+    r2 = dist_worker.handle(
+        "build_histograms",
+        {"key": "ttl-k", "epoch": 1, "tree": 0, "layer": 0,
+         "reset": True, "shards": [0], "num_slots": 1,
+         "num_bins": cache.binner.num_bins},
+        "ttl-w",
+    )
+    assert r2.get("need_shard") is True
+    # Replica serving state rides the same TTL (banks closed on reap).
+    replica._state("ttl-replica")
+    time.sleep(0.05)
+    n2, _ = replica.reap_idle(0.02)
+    assert n2 >= 1
+    assert replica.status("ttl-replica") == {
+        "active_version": None, "versions": {}, "swaps": 0,
+    }
+    dist_worker.reset_state()
+
+
+def test_worker_reaper_thread_runs_with_ttl(tmp_path, monkeypatch):
+    """start_worker spawns the sweep thread when the TTL is armed: an
+    idle worker's dist state disappears WITHOUT any request arriving —
+    the dead-manager scenario the on-request check could never cover."""
+    from ydf_tpu.config import Task
+    from ydf_tpu.dataset.cache import create_dataset_cache
+    from ydf_tpu.parallel import dist_worker, worker_service
+
+    rng = np.random.RandomState(1)
+    frame = {
+        "a": rng.normal(size=300), "b": rng.normal(size=300),
+        "y": rng.normal(size=300).astype(np.float32),
+    }
+    cache = create_dataset_cache(
+        frame, str(tmp_path / "c2"), label="y", task=Task.REGRESSION,
+        feature_shards=2,
+    )
+    monkeypatch.setattr(worker_service, "_STATE_TTL_S", 0.2)
+    port = _free_port()
+    start_worker(port, host="127.0.0.1", blocking=False)
+    addr = f"127.0.0.1:{port}"
+    pool = WorkerPool([addr])
+    resp = pool.request(
+        0,
+        {"verb": "load_cache_shard", "key": "reap-k",
+         "shards": [0, 1], "cache_dir": cache.path, "epoch": 1},
+    )
+    assert resp["ok"]
+    wid = addr
+    assert dist_worker.shard_bytes_total(wid) > 0
+    deadline = time.time() + 10
+    while dist_worker.shard_bytes_total(wid) > 0 and time.time() < deadline:
+        time.sleep(0.05)
+    assert dist_worker.shard_bytes_total(wid) == 0, (
+        "reaper thread did not release idle dist state"
+    )
+    pool.shutdown_all()

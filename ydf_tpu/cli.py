@@ -447,10 +447,17 @@ def main(argv=None):
     p.add_argument("--working_dir",
                    help="snapshot directory for checkpointed training "
                         "(enables preemption-safe SIGTERM handling; "
-                        "exit code 75 = resumable)")
+                        "exit code 75 = resumable). Works with "
+                        "--workers too: the distributed manager "
+                        "snapshots at tree boundaries and a new "
+                        "manager can --resume after the old one died "
+                        "(docs/distributed_training.md \"Resume\")")
     p.add_argument("--resume", action="store_true",
                    help="resume from the latest snapshot in "
-                        "--working_dir")
+                        "--working_dir (single-machine or "
+                        "distributed; a snapshot whose worker/shard "
+                        "config fingerprint mismatches the flags is "
+                        "refused with a clear error)")
     p.add_argument("--telemetry_dir",
                    help="write chrome-tracing spans + a Prometheus "
                         "metrics dump here (same as "

@@ -234,6 +234,8 @@ def resolved_env_config() -> dict:
         lambda: _worker()._send_timeout())
     put("YDF_TPU_WORKER_SECRET",
         lambda: _worker()._env_secret() is not None)
+    put("YDF_TPU_WORKER_STATE_TTL_S",
+        lambda: _worker()._STATE_TTL_S)
 
     def _dist():
         from ydf_tpu.parallel import dist_gbt

@@ -287,7 +287,11 @@ class GradientBoostedTreesLearner(GenericLearner):
         # histograms, and row-sharded validation / distributed early
         # stopping (parallel/dist_row.py; both together = hybrid).
         # Either way the model is bit-identical to the single-machine
-        # build (docs/distributed_training.md).
+        # build (docs/distributed_training.md). Combined with
+        # working_dir/resume_training, the manager snapshots at tree
+        # boundaries and survives its own preemption/death — a new
+        # manager resumes bit-identically via the epoch-fenced worker
+        # reattach (docs/distributed_training.md "Resume").
         self.distributed_workers = (
             list(distributed_workers) if distributed_workers else None
         )
@@ -2238,8 +2242,6 @@ def _train_gbt_distributed(
         unsupported.append("monotonic constraints")
     if learner.mesh is not None:
         unsupported.append("mesh= (GSPMD) combined with RPC workers")
-    if learner.working_dir is not None:
-        unsupported.append("working_dir= checkpointing")
     if (
         learner.maximum_training_duration
         and learner.maximum_training_duration > 0
@@ -2262,6 +2264,18 @@ def _train_gbt_distributed(
         hist_impl=resolve_hist_impl("auto"),
         hist_subtract=resolve_hist_subtract(None),
         hist_quant=resolve_hist_quant(None),
+        # Preemption-safe distributed training: with a working_dir the
+        # manager snapshots at tree boundaries through the round-10
+        # Snapshots contract, installs the SIGTERM/SIGINT guard
+        # (forced final snapshot → TrainingPreempted → exit 75), and
+        # resume_training reattaches a NEW manager bit-identically
+        # (docs/distributed_training.md "Resume").
+        working_dir=learner.working_dir,
+        resume=learner.resume_training,
+        snapshot_interval=(
+            learner.resume_training_snapshot_interval_trees
+        ),
+        preempt_after_snapshots=learner._preempt_after_chunks,
     )
     if row_mode:
         # Deterministic train/validation split — the EXACT expressions

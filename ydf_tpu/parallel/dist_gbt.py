@@ -63,8 +63,10 @@ values); tests/test_worker_dist_gbt.py asserts it across quant modes.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import os
+import signal as _signal
 import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor
@@ -288,6 +290,25 @@ class _DistStats:
         self.shard_bytes: Dict[str, int] = {}
         self.worker_rss_bytes: Dict[str, int] = {}
         self.config_mismatches = 0
+        # Tree-boundary snapshot accounting (preemption-safe round):
+        # count, summed write wall (bench.py's dist_snapshot_s) and
+        # payload bytes.
+        self.snapshots = 0
+        self.snapshot_ns = 0
+        self.snapshot_bytes = 0
+
+    def observe_snapshot(self, dur_ns: int, nbytes: int) -> None:
+        self.snapshots += 1
+        self.snapshot_ns += int(dur_ns)
+        self.snapshot_bytes += int(nbytes)
+        if telemetry.ENABLED:
+            telemetry.counter("ydf_dist_snapshots_total").inc()
+            telemetry.counter("ydf_dist_snapshot_ns_total").inc(
+                int(dur_ns)
+            )
+            telemetry.counter("ydf_dist_snapshot_bytes_total").inc(
+                int(nbytes)
+            )
 
     def observe_rpc(self, verb: str, dur_ns: int) -> None:
         self.rpc_ns.setdefault(verb, LatencyHistogram()).observe_ns(dur_ns)
@@ -376,6 +397,9 @@ class _DistStats:
                 v: int(h.count) for v, h in sorted(self.rpc_ns.items())
             },
         }
+        out["snapshots"] = int(self.snapshots)
+        out["snapshot_s"] = round(self.snapshot_ns / 1e9, 6)
+        out["snapshot_bytes"] = int(self.snapshot_bytes)
         out["shard_bytes"] = int(sum(self.shard_bytes.values()))
         if self.shard_bytes:
             out["worker_shard_bytes"] = dict(self.shard_bytes)
@@ -400,6 +424,10 @@ class DistGBTManager:
         min_split_gain: float = 1e-9,
         rpc_timeout_s: Optional[float] = None,
         verify: Optional[bool] = None,
+        working_dir: Optional[str] = None,
+        resume: bool = False,
+        snapshot_interval: int = 50,
+        preempt_after_snapshots: Optional[int] = None,
     ):
         self.pool = pool
         self.cache = cache
@@ -443,6 +471,285 @@ class DistGBTManager:
         self.pos = (-1, 0)
         self.cur_hist_stats: Optional[np.ndarray] = None
         self.cur_qscale: Optional[np.ndarray] = None
+        self._init_ckpt(
+            working_dir, resume, snapshot_interval,
+            preempt_after_snapshots,
+        )
+
+    # ---- checkpoint / resume / epoch fencing ------------------------- #
+    #
+    # Preemption-safe distributed training (docs/distributed_training.md
+    # "Resume"): with a working_dir, the manager writes a durable
+    # snapshot through the round-10 Snapshots contract at tree
+    # boundaries — forest-so-far, train (and row-mode validation)
+    # predictions and losses, the carried PRNG key (the per-tree quant
+    # grid is derived from it, so no mid-tree state is persisted) and
+    # the shard ownership map — guards the loop with the SIGTERM/SIGINT
+    # handler (forced final snapshot → TrainingPreempted → exit 75),
+    # and on resume a NEW manager reattaches: same deterministic run
+    # key (worker-state namespace), snapshot epoch + 1 as its fencing
+    # token, shards verified-or-re-shipped idempotently, training
+    # resumed bit-identical from the boundary.
+
+    #: Per-tree array fields a snapshot stacks (tree dict layout of
+    #: _train_tree's tree_np).
+    _TREE_FIELDS = (
+        "feature", "threshold_bin", "is_cat", "is_set", "cat_mask",
+        "left", "right", "is_leaf", "leaf_stats", "num_nodes",
+    )
+
+    def _init_ckpt(self, working_dir, resume, snapshot_interval,
+                   preempt_after_snapshots) -> None:
+        """Shared by both managers (RowDistGBTManager skips
+        super().__init__): arms the Snapshots handle, derives the
+        deterministic run key, and loads the latest snapshot — epoch
+        continuity is unconditional, training-state restore happens
+        only under resume=True."""
+        self.working_dir = working_dir
+        self.resume = bool(resume)
+        self.snapshot_interval = max(int(snapshot_interval or 50), 1)
+        self.preempt_after_snapshots = preempt_after_snapshots
+        self._snapshots_taken = 0
+        #: The manager epoch token stamped on every RPC (_stamp) and
+        #: persisted in each snapshot. Workers fence lower epochs with
+        #: a typed rejection (dist_worker._check_epoch) — the
+        #: split-brain close the per-instance namespacing of the
+        #: feature-parallel round left open.
+        self.epoch = 1
+        self._snaps = None
+        self._resume_state: Optional[Dict[str, Any]] = None
+        if not working_dir:
+            return
+        from ydf_tpu.utils.snapshot import Snapshots
+
+        self._snaps = Snapshots(working_dir, max_kept=2)
+        # Deterministic run key: a resumed manager reattaches to the
+        # SAME worker-state namespace its dead predecessor used — which
+        # is exactly why the epoch fence (not namespacing) must protect
+        # the workers from the predecessor's zombie frames.
+        self.key_id = f"dist-{self._ckpt_fingerprint()[:16]}"
+        self._prepare_resume()
+
+    def _ckpt_mode_fields(self) -> tuple:
+        """The shard-layout half of the snapshot fingerprint (the row
+        manager overrides with its R×C grid and validation split).
+        Worker COUNT is deliberately absent: resume is bit-identical
+        across fleet sizes, so it must not invalidate a snapshot."""
+        return ("feature", self.num_shards)
+
+    def _ckpt_fingerprint(self) -> str:
+        """sha1 identity of (dataset cache, shard layout, training
+        config) — what a resume must match exactly. Mirrors the
+        single-machine checkpointed driver's fingerprint discipline:
+        resuming against different data or hyperparameters fails fast
+        instead of silently mixing trees."""
+        import hashlib
+
+        fp = hashlib.sha1()
+        fp.update(repr(self._ckpt_mode_fields()).encode())
+        fp.update(
+            repr(
+                (
+                    getattr(self.cache, "_meta", {}).get(
+                        "request_fingerprint"
+                    ),
+                    self.n, self.F,
+                    type(self.loss_obj).__name__, self.rule, self.cfg,
+                    self.num_trees, self.shrinkage, self.subsample,
+                    self.candidate_features, self.seed,
+                    self.hist_impl, self.hist_subtract,
+                    self.hist_quant, self.min_split_gain,
+                )
+            ).encode()
+        )
+        return fp.hexdigest()
+
+    def _prepare_resume(self) -> None:
+        state = self._snaps.latest()
+        if state is None:
+            if self.resume:
+                log.info(
+                    "dist: resume requested but no snapshot in "
+                    f"{self.working_dir!r}; starting fresh"
+                )
+            return
+        _idx, arrays, meta = state
+        # Epoch continuity is UNCONDITIONAL: any new manager on this
+        # working_dir attaches with a strictly higher epoch, so a
+        # zombie predecessor's delayed frames are fenced even when the
+        # operator starts fresh instead of resuming.
+        self.epoch = int(meta.get("epoch", 0)) + 1
+        if not self.resume:
+            return
+        if meta.get("fingerprint") != self._ckpt_fingerprint():
+            raise ValueError(
+                f"Distributed snapshot in {self.working_dir!r} was "
+                "created with a different worker/shard configuration "
+                "or dataset (cache layout, hyperparameters, "
+                "YDF_TPU_HIST_* mode or seed differ from the current "
+                "flags); refusing to resume. Delete the working "
+                "directory or restore the original configuration."
+            )
+        self._resume_state = {"arrays": arrays, "meta": meta}
+
+    def _restore_progress(self) -> Optional[Dict[str, Any]]:
+        """Unpacks the resume snapshot into the training loop's
+        accumulators (per-tree dicts, leaf values, losses, predictions,
+        carried PRNG key). None when starting fresh."""
+        if self._resume_state is None:
+            return None
+        arrays = self._resume_state["arrays"]
+        meta = self._resume_state["meta"]
+        done = int(meta["completed_trees"])
+        trees_acc = [
+            {
+                f: np.asarray(arrays[f"tree_{f}"][t])
+                for f in self._TREE_FIELDS
+            }
+            for t in range(done)
+        ]
+        return {
+            "done": done,
+            "trees_acc": trees_acc,
+            "lvs_acc": [np.asarray(arrays["lvs"][t]) for t in range(done)],
+            "tls": [float(v) for v in arrays["tls"]],
+            "preds": jnp.asarray(arrays["preds"]),
+            "key": jnp.asarray(arrays["key"]),
+            "arrays": arrays,
+        }
+
+    def _restore_owner_map(self) -> None:
+        """Re-applies the snapshot's shard→address ownership for
+        addresses still in the (pruned) rotation, so a resumed manager
+        reattaches each shard to the worker that most likely still
+        holds it — the verify-or-re-ship load is idempotent either
+        way."""
+        if self._resume_state is None:
+            return
+        addrs = {
+            self.pool.addr_str(i): i
+            for i in range(len(self.pool.addresses))
+        }
+        saved = self._resume_state["meta"].get("owner_addrs") or []
+        for sid, addr in enumerate(saved[: len(self.owner)]):
+            if addr in addrs:
+                self.owner[sid] = addrs[addr]
+
+    def _attach_site(self) -> str:
+        """Failpoint site of the initial shard placement: the resume
+        reattach has its own (`dist.resume_attach`), so chaos schedules
+        can target exactly the new-manager attach path."""
+        return (
+            "dist.resume_attach" if self._resume_state is not None
+            else "dist.shard_load"
+        )
+
+    def _maybe_snapshot(self, done: int, trees_acc, lvs_acc, tls, preds,
+                        key, extra_arrays: Optional[Dict[str, Any]] = None,
+                        force: bool = False) -> bool:
+        """Writes the tree-boundary snapshot when `done` sits on the
+        snapshot cadence (or the final boundary, or forced by the
+        preemption guard). Returns whether a snapshot was written."""
+        if self._snaps is None or done == 0:
+            return False
+        if not (
+            force
+            or done % self.snapshot_interval == 0
+            or done == self.num_trees
+        ):
+            return False
+        failpoints.hit("dist.snapshot")
+        t0 = time.perf_counter_ns()
+        arrays: Dict[str, Any] = {
+            f"tree_{f}": np.stack(
+                [np.asarray(t[f]) for t in trees_acc]
+            )
+            for f in self._TREE_FIELDS
+        }
+        arrays["lvs"] = np.stack([np.asarray(v) for v in lvs_acc])
+        # float(np.float32) losses are exact in f64 — the restored list
+        # round-trips bit-identically.
+        arrays["tls"] = np.asarray(tls, np.float64)
+        arrays["preds"] = np.asarray(preds)
+        arrays["key"] = np.asarray(key)
+        if extra_arrays:
+            arrays.update(extra_arrays)
+        meta = {
+            "completed_trees": int(done),
+            "fingerprint": self._ckpt_fingerprint(),
+            "epoch": int(self.epoch),
+            "num_trees": int(self.num_trees),
+            "mode": self._ckpt_mode_fields()[0],
+            "owner_addrs": [
+                self.pool.addr_str(w) for w in self.owner
+            ],
+        }
+        self._snaps.save(done, arrays, meta)
+        try:
+            nbytes = os.path.getsize(self._snaps._payload_path(done))
+        except OSError:
+            nbytes = 0
+        self.stats.observe_snapshot(time.perf_counter_ns() - t0, nbytes)
+        return True
+
+    def _guard_cm(self):
+        """The SIGTERM/SIGINT preemption guard, armed only when
+        snapshots exist to make the preemption resumable (without a
+        working_dir a signal keeps its default disposition, as
+        before)."""
+        if self._snaps is None:
+            return contextlib.nullcontext(None)
+        from ydf_tpu.learners.gbt import _PreemptionGuard
+
+        return _PreemptionGuard()
+
+    def _tree_boundary(self, guard, done: int, trees_acc, lvs_acc, tls,
+                       preds, key,
+                       extra_arrays: Optional[Dict[str, Any]] = None
+                       ) -> None:
+        """Tree-boundary bookkeeping of a checkpointed run: the
+        scheduled snapshot, the `_preempt_after_chunks` test hook
+        (trigger after N snapshots — the same semantics as the
+        single-machine checkpointed driver), and the forced-final-
+        snapshot → TrainingPreempted exit when the guard tripped."""
+        if self._snaps is None:
+            return
+        saved = self._maybe_snapshot(
+            done, trees_acc, lvs_acc, tls, preds, key, extra_arrays
+        )
+        if saved:
+            self._snapshots_taken += 1
+            if (
+                self.preempt_after_snapshots is not None
+                and self._snapshots_taken >= self.preempt_after_snapshots
+                and guard is not None
+                and not guard.triggered
+            ):
+                guard.trigger(_signal.SIGTERM)
+        if guard is None or not guard.triggered:
+            return
+        if not saved:
+            # Forced final snapshot: the preemption exit is only
+            # resumable if the boundary just crossed is durable.
+            self._maybe_snapshot(
+                done, trees_acc, lvs_acc, tls, preds, key, extra_arrays,
+                force=True,
+            )
+        from ydf_tpu.learners.gbt import TrainingPreempted
+
+        if telemetry.ENABLED:
+            telemetry.flight_record(
+                "preempt", signal=guard.signal_name,
+                completed_trees=done, num_trees=self.num_trees,
+            )
+            telemetry.flush()
+            telemetry.flight_dump("preempt")
+        raise TrainingPreempted(
+            f"distributed training preempted by {guard.signal_name}: "
+            f"snapshot at {done}/{self.num_trees} trees in "
+            f"{self.working_dir!r} is resumable "
+            "(resume_training=True / --resume)"
+        )
 
     # ---- RPC plumbing ------------------------------------------------ #
 
@@ -453,7 +760,14 @@ class DistGBTManager:
         worker's per-request span records it, which is what makes the
         merged cross-process trace attributable. Must be called on the
         thread holding the open span (the training loop's), not the
-        fan-out executor's."""
+        fan-out executor's.
+
+        Every request additionally carries the manager's EPOCH token —
+        the worker-side fence (dist_worker._check_epoch) rejects lower
+        epochs with a typed response, so a zombie manager (or a delayed
+        in-flight frame of a dead run) can never double-apply routing
+        or histogram state."""
+        req["epoch"] = self.epoch
         if telemetry.ENABLED:
             ctx = telemetry.current_context()
             if ctx is not None:
@@ -504,12 +818,15 @@ class DistGBTManager:
         )
 
     def _load_shards(self, widx: int, sids: List[int],
-                     with_state: bool) -> int:
+                     with_state: bool,
+                     site: str = "dist.shard_load") -> int:
         """Delivers shards (plus, on recovery, the authoritative state)
         to a worker; on transport failure moves on to the next healthy
         worker; on a corruption report re-slices the shard from the
         verified bins.npy (byte-identical) and retries. Returns the
-        worker index that ended up owning the shards."""
+        worker index that ended up owning the shards. `site` is the
+        failpoint of this exchange (`dist.resume_attach` during a
+        resumed manager's initial reattach)."""
         rebuilt = False
         for attempt in range(self.pool.retry_attempts):
             req = {
@@ -520,7 +837,7 @@ class DistGBTManager:
                 req["state"] = self._state_payload()
             try:
                 resp = self._request(
-                    widx, self._stamp(req, widx), "dist.shard_load"
+                    widx, self._stamp(req, widx), site
                 )
             except (OSError, ConnectionError) as e:
                 log.debug(
@@ -538,6 +855,13 @@ class DistGBTManager:
                     self.owner[sid] = widx
                 self._note_shard_load(widx, resp)
                 return widx
+            if resp.get("stale_epoch"):
+                raise DistributedTrainingError(
+                    f"fenced out: worker {self.pool.addr_str(widx)} "
+                    f"holds manager epoch {resp.get('have_epoch')} > "
+                    f"ours ({self.epoch}) — a newer manager has "
+                    "attached to this run; this manager must stop"
+                )
             if resp.get("corrupt") and not rebuilt:
                 # Worker-side crc caught a corrupt slice: re-slice it
                 # from the (fully verified) bins.npy and try again —
@@ -784,6 +1108,18 @@ class DistGBTManager:
                         raise resp
                     self._handle_failure(widx, group)
                     continue
+                if resp.get("stale_epoch"):
+                    # The fencing contract's manager half: a rejection
+                    # means a NEWER manager attached to this run's
+                    # worker state — continuing would race two
+                    # managers, so this one stops loudly.
+                    raise DistributedTrainingError(
+                        "fenced out: worker "
+                        f"{self.pool.addr_str(widx)} holds manager "
+                        f"epoch {resp.get('have_epoch')} > ours "
+                        f"({self.epoch}) — a newer manager has "
+                        "attached to this run; this manager must stop"
+                    )
                 if resp.get("need_shard"):
                     # Worker restarted in place: re-ship shard + state
                     # to the SAME address and retry.
@@ -828,9 +1164,15 @@ class DistGBTManager:
         self.owner = [
             k % len(self.pool.addresses) for k in range(self.num_shards)
         ]
-        # Initial shard placement: shard k → worker k % W.
+        self._restore_owner_map()
+        # Initial shard placement: shard k → worker k % W (snapshot
+        # ownership preferred on resume). The load verb is the reattach
+        # handshake too: crc-verified shard load + epoch adoption,
+        # idempotent for a worker that already holds the shard.
+        attach_site = self._attach_site()
         for widx, sids in self._groups(range(self.num_shards)).items():
-            self._load_shards(widx, sids, with_state=False)
+            self._load_shards(widx, sids, with_state=False,
+                              site=attach_site)
 
         preds, init_pred = _j_init(
             y_j, w_j, loss_obj=self.loss_obj, n=self.n
@@ -839,21 +1181,41 @@ class DistGBTManager:
         trees_acc: List[Dict[str, np.ndarray]] = []
         lvs_acc: List[np.ndarray] = []
         tls: List[float] = []
+        start_it = 0
+        rs = self._restore_progress()
+        if rs is not None:
+            # Resume from the tree boundary: forest-so-far, losses,
+            # predictions and the CARRIED key restore exactly; tree
+            # start re-derives gradients/quant grid from them, so the
+            # continuation is bit-identical to an uninterrupted run.
+            start_it = rs["done"]
+            trees_acc, lvs_acc, tls = (
+                rs["trees_acc"], rs["lvs_acc"], rs["tls"]
+            )
+            preds, key = rs["preds"], rs["key"]
+            log.info(
+                f"dist: resuming at tree {start_it}/{self.num_trees} "
+                f"from {self.working_dir!r} (manager epoch {self.epoch})"
+            )
 
-        for it in range(self.num_trees):
-            with telemetry.span("dist.tree") as sp:
-                if telemetry.ENABLED:
-                    sp.set(iteration=it)
-                preds, key, tree_np, lv, tl = self._train_tree(
-                    it, key, preds, y_j, w_j, L, B, N, D, S
-                )
-            trees_acc.append(tree_np)
-            lvs_acc.append(np.asarray(lv))
-            tls.append(float(tl))
-            if log.is_debug():
-                log.debug(
-                    f"dist gbt: iter {it + 1}/{self.num_trees} "
-                    f"train_loss={tls[-1]:.6g}"
+        with self._guard_cm() as guard:
+            for it in range(start_it, self.num_trees):
+                with telemetry.span("dist.tree") as sp:
+                    if telemetry.ENABLED:
+                        sp.set(iteration=it)
+                    preds, key, tree_np, lv, tl = self._train_tree(
+                        it, key, preds, y_j, w_j, L, B, N, D, S
+                    )
+                trees_acc.append(tree_np)
+                lvs_acc.append(np.asarray(lv))
+                tls.append(float(tl))
+                if log.is_debug():
+                    log.debug(
+                        f"dist gbt: iter {it + 1}/{self.num_trees} "
+                        f"train_loss={tls[-1]:.6g}"
+                    )
+                self._tree_boundary(
+                    guard, it + 1, trees_acc, lvs_acc, tls, preds, key
                 )
 
         # Cross-process observability: drain every worker's span buffer
@@ -894,11 +1256,16 @@ class DistGBTManager:
             "oblique_b": np.zeros((T, 0, B - 1), np.float32),
             "vs_a": np.zeros((T, 0, 0), np.float32),
             "vs_b": np.zeros((T, 0, 0), np.float32),
-            "chunk_walls": [(0, T, t0_ns, wall_ns)],
+            # Pre-resume trees carry no wall (they ran in a dead
+            # manager); their iteration records report 0 seconds, like
+            # the single-machine checkpointed driver's.
+            "chunk_walls": [(start_it, T - start_it, t0_ns, wall_ns)],
             "distributed": {
                 "workers": len(self.pool.addresses),
                 "feature_shards": self.num_shards,
                 "hist_quant": self.hist_quant,
+                "epoch": int(self.epoch),
+                "resumed_from": int(start_it),
                 **self.stats.summary(),
                 **_transport_fields(self.pool),
             },

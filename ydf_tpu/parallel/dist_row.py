@@ -129,6 +129,10 @@ class RowDistGBTManager(DistGBTManager):
         tr_idx: Optional[np.ndarray] = None,
         va_idx: Optional[np.ndarray] = None,
         early_stop_lookahead: int = 0,
+        working_dir: Optional[str] = None,
+        resume: bool = False,
+        snapshot_interval: int = 50,
+        preempt_after_snapshots: Optional[int] = None,
     ):
         from ydf_tpu.dataset.cache import (
             row_shard_ranges,
@@ -190,6 +194,21 @@ class RowDistGBTManager(DistGBTManager):
         self._stats_by_unit: Dict[int, np.ndarray] = {}
         self._route_history: List[Dict[str, Any]] = []
         self._cur_tree = -1
+        self._init_ckpt(
+            working_dir, resume, snapshot_interval,
+            preempt_after_snapshots,
+        )
+
+    def _ckpt_mode_fields(self) -> tuple:
+        # The R×C grid plus the deterministic train/validation split
+        # sizes and the early-stop window: resuming with a different
+        # validation configuration could not be bit-identical.
+        return (
+            "hybrid" if self.C > 1 else "row",
+            self.R, self.C,
+            int(self.tr_idx.size), int(self.va_idx.size),
+            self.early_stop_lookahead,
+        )
 
     # ---- unit geometry ------------------------------------------------ #
 
@@ -218,11 +237,14 @@ class RowDistGBTManager(DistGBTManager):
         }
 
     def _load_shards(self, widx: int, uids: List[int],
-                     with_state: bool) -> int:
+                     with_state: bool,
+                     site: str = "dist.shard_load") -> int:
         """Places units on a worker: the worker streams each row shard
         crc-block-wise (corrupt slices surface as `corrupt` and are
         re-sliced from bins.npy byte-identically); recovery re-ships the
-        current tree's stats + route history for replay."""
+        current tree's stats + route history for replay. `site` is the
+        failpoint of this exchange (`dist.resume_attach` during a
+        resumed manager's initial reattach)."""
         rebuilt = False
         for _attempt in range(self.pool.retry_attempts):
             req = {
@@ -247,7 +269,7 @@ class RowDistGBTManager(DistGBTManager):
                 }
             try:
                 resp = self._request(
-                    widx, self._stamp(req, widx), "dist.shard_load"
+                    widx, self._stamp(req, widx), site
                 )
             except (OSError, ConnectionError) as e:
                 log.debug(
@@ -265,6 +287,13 @@ class RowDistGBTManager(DistGBTManager):
                     self.owner[u] = widx
                 self._note_shard_load(widx, resp)
                 return widx
+            if resp.get("stale_epoch"):
+                raise DistributedTrainingError(
+                    f"fenced out: worker {self.pool.addr_str(widx)} "
+                    f"holds manager epoch {resp.get('have_epoch')} > "
+                    f"ours ({self.epoch}) — a newer manager has "
+                    "attached to this run; this manager must stop"
+                )
             if resp.get("corrupt") and not rebuilt:
                 log.info(
                     f"dist row: row shard(s) for units {uids} corrupt on "
@@ -356,8 +385,11 @@ class RowDistGBTManager(DistGBTManager):
         self.owner = [
             u % len(self.pool.addresses) for u in range(self.num_units)
         ]
+        self._restore_owner_map()
+        attach_site = self._attach_site()
         for widx, uids in self._groups(range(self.num_units)).items():
-            self._load_shards(widx, uids, with_state=False)
+            self._load_shards(widx, uids, with_state=False,
+                              site=attach_site)
 
         preds, init_pred = _j_init(
             y_tr, w_tr, loss_obj=self.loss_obj, n=n_tr
@@ -375,6 +407,28 @@ class RowDistGBTManager(DistGBTManager):
         lvs_acc: List[np.ndarray] = []
         tls: List[float] = []
         vls: List[float] = []
+        start_it = 0
+        rs = self._restore_progress()
+        if rs is not None:
+            start_it = rs["done"]
+            trees_acc, lvs_acc, tls = (
+                rs["trees_acc"], rs["lvs_acc"], rs["tls"]
+            )
+            preds, key = rs["preds"], rs["key"]
+            # Row-mode extras: the validation predictions and the
+            # per-iteration valid losses (the early-stop driver state —
+            # restoring them keeps the stop decision's argmin history
+            # absolute, like the single-machine re-seed).
+            vls = [float(v) for v in rs["arrays"].get(
+                "vls", np.zeros((start_it,), np.float64)
+            )]
+            if nv > 0 and "vpreds" in rs["arrays"]:
+                vpreds = jnp.asarray(rs["arrays"]["vpreds"])
+            log.info(
+                f"dist row: resuming at tree {start_it}/"
+                f"{self.num_trees} from {self.working_dir!r} "
+                f"(manager epoch {self.epoch})"
+            )
 
         # In-loop early stopping mirrors the single-machine early-stop
         # driver EXACTLY: same eligibility guard, same chunk length,
@@ -386,36 +440,50 @@ class RowDistGBTManager(DistGBTManager):
         )
         clen = max(1, min(lookahead or 25, 25))
 
-        it = 0
-        while it < self.num_trees:
-            with telemetry.span("dist.tree") as sp:
-                if telemetry.ENABLED:
-                    sp.set(iteration=it)
-                preds, vpreds, key, tree_np, lv, tl, vl = (
-                    self._train_tree_row(
-                        it, key, preds, vpreds, y_tr, w_tr, y_va, w_va,
-                        L, B, N, D, S,
-                    )
-                )
-            trees_acc.append(tree_np)
-            lvs_acc.append(np.asarray(lv))
-            tls.append(float(tl))
-            vls.append(float(vl) if vl is not None else 0.0)
-            if log.is_debug():
-                log.debug(
-                    f"dist row gbt: iter {it + 1}/{self.num_trees} "
-                    f"train_loss={tls[-1]:.6g}"
-                    + (f" valid_loss={vls[-1]:.6g}" if nv > 0 else "")
-                )
-            it += 1
-            if use_stop and it % clen == 0:
-                from ydf_tpu.learners.gbt import _early_stop_hit
+        def _row_extra(vp):
+            if vp is None:
+                return {"vls": np.asarray(vls, np.float64)}
+            return {
+                "vls": np.asarray(vls, np.float64),
+                "vpreds": np.asarray(vp),
+            }
 
-                if _early_stop_hit(
-                    [np.asarray(vls, np.float32)],
-                    min(it, self.num_trees), lookahead,
-                ):
-                    break
+        it = start_it
+        with self._guard_cm() as guard:
+            while it < self.num_trees:
+                with telemetry.span("dist.tree") as sp:
+                    if telemetry.ENABLED:
+                        sp.set(iteration=it)
+                    preds, vpreds, key, tree_np, lv, tl, vl = (
+                        self._train_tree_row(
+                            it, key, preds, vpreds, y_tr, w_tr, y_va,
+                            w_va, L, B, N, D, S,
+                        )
+                    )
+                trees_acc.append(tree_np)
+                lvs_acc.append(np.asarray(lv))
+                tls.append(float(tl))
+                vls.append(float(vl) if vl is not None else 0.0)
+                if log.is_debug():
+                    log.debug(
+                        f"dist row gbt: iter {it + 1}/{self.num_trees} "
+                        f"train_loss={tls[-1]:.6g}"
+                        + (f" valid_loss={vls[-1]:.6g}" if nv > 0
+                           else "")
+                    )
+                it += 1
+                self._tree_boundary(
+                    guard, it, trees_acc, lvs_acc, tls, preds, key,
+                    extra_arrays=_row_extra(vpreds),
+                )
+                if use_stop and it % clen == 0:
+                    from ydf_tpu.learners.gbt import _early_stop_hit
+
+                    if _early_stop_hit(
+                        [np.asarray(vls, np.float32)],
+                        min(it, self.num_trees), lookahead,
+                    ):
+                        break
 
         self._drain_worker_telemetry()
         wall_ns = time.perf_counter_ns() - t0_ns
@@ -452,10 +520,14 @@ class RowDistGBTManager(DistGBTManager):
             "oblique_b": np.zeros((T, 0, B - 1), np.float32),
             "vs_a": np.zeros((T, 0, 0), np.float32),
             "vs_b": np.zeros((T, 0, 0), np.float32),
-            "chunk_walls": [(0, T, t0_ns, wall_ns)],
+            # Pre-resume trees carry no wall (they ran in a dead
+            # manager); their iteration records report 0 seconds.
+            "chunk_walls": [(start_it, T - start_it, t0_ns, wall_ns)],
             "distributed": {
                 "workers": len(self.pool.addresses),
                 "mode": "hybrid" if self.C > 1 else "row",
+                "epoch": int(self.epoch),
+                "resumed_from": int(start_it),
                 "row_shards": self.R,
                 "col_shards": self.C,
                 "shard_rows": int(shard_rows),

@@ -36,15 +36,32 @@ Everything here is exact integer/bool bookkeeping plus calls into the
 shared histogram kernels; the float split search happens only on the
 manager, which is what makes the distributed build bit-identical to the
 single-machine grower (docs/distributed_training.md).
+
+Two cross-cutting contracts ride every verb (preemption-safe round):
+
+  * **Manager-epoch fence** (`_check_epoch`): every distributed RPC is
+    stamped with the manager's monotonically-increasing epoch token
+    (persisted in its tree-boundary snapshot); a request from a LOWER
+    epoch — a zombie manager, or a delayed in-flight frame of a dead
+    run — gets the typed `stale_epoch` rejection before any state
+    mutation, and only the shard-load verbs may advance the epoch (the
+    reattach handshake of `--resume`).
+  * **Orphan-state TTL** (`reap_idle_state`): with
+    YDF_TPU_WORKER_STATE_TTL_S set, state idle past the TTL — a dead
+    manager's shards, routing arrays and stat slices — is reaped and
+    its `dist_shard` ledger bytes released.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 import zlib
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
+
+from ydf_tpu.utils import failpoints
 
 VERBS = frozenset(
     {
@@ -88,6 +105,16 @@ class _DistState:
         # one request per worker at a time, but recovery replays can
         # overlap a straggling original — mutations must not interleave.
         self.lock = threading.Lock()
+        # The highest manager epoch that attached this state (0 =
+        # pre-fencing). Requests from a LOWER epoch — a zombie manager,
+        # or a delayed in-flight frame of a dead run — are rejected
+        # with the typed stale_epoch response before any state mutation
+        # (_check_epoch); only the shard-load verbs may advance it.
+        self.epoch = 0
+        # Idle stamp for the orphan-state reaper
+        # (YDF_TPU_WORKER_STATE_TTL_S): a dead manager must not pin
+        # resident shards forever.
+        self.last_used = time.monotonic()
         self.shards: Dict[int, _ShardSlice] = {}
         self.slot = np.zeros(n, np.int32)
         self.hist_slot = np.zeros(n, np.int32)
@@ -165,13 +192,74 @@ def _dequantized_stats(st: _DistState) -> np.ndarray:
 
 def _get_state(worker_id: str, key: str) -> Optional[_DistState]:
     with _STATE_LOCK:
-        return _STATE.get((worker_id, key))
+        st = _STATE.get((worker_id, key))
+        if st is not None:
+            st.last_used = time.monotonic()
+        return st
 
 
 def _need(msg: str) -> Dict[str, Any]:
     # need_shard mirrors the tuner protocol's need_data: the manager
     # re-ships the shard (plus its authoritative state) and retries.
     return {"ok": False, "need_shard": True, "error": msg}
+
+
+def _stale_reject(req_epoch: int, have: int) -> Dict[str, Any]:
+    """The typed stale-epoch rejection: the fencing half of
+    preemption-safe distributed training (docs/distributed_training.md
+    "Resume"). Deliberately NOT need_shard — a zombie manager must not
+    be invited to re-ship state over a newer manager's."""
+    from ydf_tpu.utils import telemetry
+
+    if telemetry.ENABLED:
+        telemetry.counter("ydf_dist_epoch_rejects_total").inc()
+    return {
+        "ok": False, "stale_epoch": True, "have_epoch": int(have),
+        "error": (
+            f"request from stale manager epoch {req_epoch} fenced: this "
+            f"worker state was attached by manager epoch {have}"
+        ),
+    }
+
+
+def _check_epoch(st, req: Dict[str, Any],
+                 load: bool = False) -> Optional[Dict[str, Any]]:
+    """Manager-epoch fence, run BEFORE any state mutation of every
+    distributed verb. Requests carry the manager's monotonically-
+    increasing epoch token (persisted in its snapshot; a resumed
+    manager attaches with snapshot epoch + 1):
+
+      * epoch < state epoch  → typed stale_epoch rejection — a zombie
+        manager (or a delayed in-flight frame from the dead run) can
+        never double-apply routing or histogram state;
+      * epoch > state epoch  → the shard-load verbs ADOPT it (the
+        reattach handshake); work verbs answer need_shard, because a
+        state the new manager has not attached may be a dead run's;
+      * equal (or the request is unfenced — direct handle() callers) →
+        proceed.
+
+    The `dist.epoch_fence` failpoint converts one request into the
+    stale rejection, as if a newer manager had attached — the chaos
+    handle proving the manager-side contract without a real zombie."""
+    e = req.get("epoch")
+    if e is None:
+        return None
+    e = int(e)
+    try:
+        failpoints.hit("dist.epoch_fence")
+    except failpoints.FailpointError:
+        return _stale_reject(e, max(st.epoch, e + 1))
+    if e < st.epoch:
+        return _stale_reject(e, st.epoch)
+    if e > st.epoch:
+        if load:
+            st.epoch = e
+            return None
+        return _need(
+            f"worker state at epoch {st.epoch} has not been attached "
+            f"by manager epoch {e}; re-ship shards"
+        )
+    return None
 
 
 def _load_cache_shard(req: Dict[str, Any], worker_id: str) -> Dict[str, Any]:
@@ -206,7 +294,11 @@ def _load_cache_shard(req: Dict[str, Any], worker_id: str) -> Dict[str, Any]:
             while len(_STATE) >= _STATE_CAP:
                 _STATE.pop(next(iter(_STATE)))
             st = _STATE[(worker_id, key)] = _DistState(n)
+        st.last_used = time.monotonic()
     with st.lock:
+        err = _check_epoch(st, req, load=True)
+        if err is not None:
+            return err
         st.shards.update(slices)
         state = req.get("state")
         if state is not None:
@@ -271,6 +363,9 @@ def _build_histograms(req: Dict[str, Any], worker_id: str) -> Dict[str, Any]:
     if st is None:
         return _need(f"unknown dist key {req['key']!r} (worker restarted?)")
     with st.lock:
+        err = _check_epoch(st, req)
+        if err is not None:
+            return err
         stats = req.get("stats")
         if stats is not None:
             st.hist_stats = np.asarray(stats["hist_stats"])
@@ -307,6 +402,9 @@ def _apply_split(req: Dict[str, Any], worker_id: str) -> Dict[str, Any]:
     if st is None:
         return _need(f"unknown dist key {req['key']!r} (worker restarted?)")
     with st.lock:
+        err = _check_epoch(st, req)
+        if err is not None:
+            return err
         pos = (int(req["tree"]), int(req["layer"]))
         if st.pos != pos:
             # apply_split routes with the CURRENT layer's slot state; a
@@ -339,6 +437,9 @@ def _leaf_stats(req: Dict[str, Any], worker_id: str) -> Dict[str, Any]:
     if st is None:
         return _need(f"unknown dist key {req['key']!r} (worker restarted?)")
     with st.lock:
+        err = _check_epoch(st, req)
+        if err is not None:
+            return err
         err = _sync_to(st, req)
         if err is not None:
             return err
@@ -419,6 +520,8 @@ class _RowState:
     def __init__(self, n: int):
         self.n = int(n)
         self.lock = threading.Lock()
+        self.epoch = 0  # same fencing contract as _DistState.epoch
+        self.last_used = time.monotonic()
         self.units: Dict[int, _RowUnit] = {}  # unit id -> state
 
 
@@ -543,7 +646,10 @@ def _accum_partial(
 
 def _get_row_state(worker_id: str, key: str) -> Optional[_RowState]:
     with _STATE_LOCK:
-        return _ROW_STATE.get((worker_id, key))
+        st = _ROW_STATE.get((worker_id, key))
+        if st is not None:
+            st.last_used = time.monotonic()
+        return st
 
 
 def _load_row_shard(req: Dict[str, Any], worker_id: str) -> Dict[str, Any]:
@@ -580,7 +686,11 @@ def _load_row_shard(req: Dict[str, Any], worker_id: str) -> Dict[str, Any]:
             while len(_ROW_STATE) >= _STATE_CAP:
                 _ROW_STATE.pop(next(iter(_ROW_STATE)))
             st = _ROW_STATE[(worker_id, key)] = _RowState(n)
+        st.last_used = time.monotonic()
     with st.lock:
+        err = _check_epoch(st, req, load=True)
+        if err is not None:
+            return err
         st.units.update(units)
         state = req.get("state")
         if state is not None:
@@ -598,6 +708,9 @@ def _row_histograms(req: Dict[str, Any], worker_id: str) -> Dict[str, Any]:
     if st is None:
         return _need(f"unknown dist key {req['key']!r} (worker restarted?)")
     with st.lock:
+        err = _check_epoch(st, req)
+        if err is not None:
+            return err
         L = int(req["num_slots"])
         B = int(req["num_bins"])
         hists = {}
@@ -634,6 +747,9 @@ def _row_apply_split(req: Dict[str, Any], worker_id: str) -> Dict[str, Any]:
     if st is None:
         return _need(f"unknown dist key {req['key']!r} (worker restarted?)")
     with st.lock:
+        err = _check_epoch(st, req)
+        if err is not None:
+            return err
         pos = (int(req["tree"]), int(req["layer"]))
         bits = {}
         for uid in req["shards"]:
@@ -662,6 +778,9 @@ def _route_validation(req: Dict[str, Any], worker_id: str) -> Dict[str, Any]:
     if st is None:
         return _need(f"unknown dist key {req['key']!r} (worker restarted?)")
     with st.lock:
+        err = _check_epoch(st, req)
+        if err is not None:
+            return err
         leaves = {}
         crcs = {}
         for uid in req["shards"]:
@@ -753,6 +872,35 @@ from ydf_tpu.utils import telemetry as _telemetry  # noqa: E402
 _telemetry.register_mem_source("dist_shard", shard_bytes_total)
 
 
+def reap_idle_state(ttl_s: float) -> Tuple[int, int]:
+    """Drops per-run distributed state (feature AND row registries)
+    idle past `ttl_s` — the orphan-state reaper behind
+    YDF_TPU_WORKER_STATE_TTL_S (worker_service starts the sweep
+    thread): a dead manager's resident shards, routing arrays and stat
+    slices are released instead of pinned forever. Returns
+    (entries reaped, resident bytes released); the `dist_shard` ledger
+    row shrinks by exactly those bytes (pull source). A manager that
+    comes back after a reap is not broken — its next request answers
+    need_shard and the normal re-ship path rebuilds the state."""
+    now = time.monotonic()
+    reaped = 0
+    freed = 0
+    with _STATE_LOCK:
+        for key, st in list(_STATE.items()):
+            if now - st.last_used >= ttl_s:
+                freed += _state_bytes(st)
+                del _STATE[key]
+                reaped += 1
+        for key, st in list(_ROW_STATE.items()):
+            if now - st.last_used >= ttl_s:
+                freed += _row_state_bytes(st)
+                del _ROW_STATE[key]
+                reaped += 1
+    if reaped and _telemetry.ENABLED:
+        _telemetry.counter("ydf_worker_state_reaped_total").inc(reaped)
+    return reaped, freed
+
+
 def status(worker_id: str = "local") -> Dict[str, Any]:
     """This worker instance's distributed state for /statusz: one entry
     per resident run key with the (tree, layer) position stamp, owned
@@ -767,6 +915,7 @@ def status(worker_id: str = "local") -> Dict[str, Any]:
     for key, st in items:
         out[key] = {
             "pos": list(st.pos),
+            "epoch": st.epoch,
             "shards": sorted(st.shards),
             "rows": st.n,
             "shard_bytes": _state_bytes(st),
@@ -779,6 +928,7 @@ def status(worker_id: str = "local") -> Dict[str, Any]:
     for key, st in row_items:
         out[key] = {
             "mode": "row",
+            "epoch": st.epoch,
             "units": {
                 uid: {"pos": list(u.pos), "row_group": u.r,
                       "col_group": u.c}

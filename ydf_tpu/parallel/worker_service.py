@@ -150,8 +150,38 @@ def _parse_idle_timeout() -> float:
     return v
 
 
+def _parse_state_ttl() -> Optional[float]:
+    """YDF_TPU_WORKER_STATE_TTL_S — orphan-state reaping (eagerly
+    validated at import, DEFAULT OFF): with a TTL set, a worker reaps
+    per-run distributed state (resident shards, routing arrays, stat
+    slices — `dist_worker.reap_idle_state`) and replica serving banks
+    (`serving/replica.reap_idle`) that no request has touched for that
+    long, releasing their ledger bytes and counting
+    `ydf_worker_state_reaped_total`. A dead manager/router otherwise
+    pins that state forever; a manager that returns after a reap is
+    healed by the ordinary need_shard / need_load re-ship paths.
+    "0"/"off"/unset disable the reaper entirely."""
+    raw = os.environ.get("YDF_TPU_WORKER_STATE_TTL_S")
+    if raw is None or raw.strip().lower() in ("", "0", "off"):
+        return None
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"YDF_TPU_WORKER_STATE_TTL_S={raw!r} is not a number of "
+            "seconds (or 0/off to disable)"
+        ) from None
+    if not v > 0:
+        raise ValueError(
+            f"YDF_TPU_WORKER_STATE_TTL_S={raw} must be > 0 (or 0/off "
+            "to disable)"
+        )
+    return v
+
+
 _MAX_FRAME: int = _parse_max_frame()
 _IDLE_TIMEOUT_S: float = _parse_idle_timeout()
+_STATE_TTL_S: Optional[float] = _parse_state_ttl()
 #: A chunked transfer may assemble up to this many caps' worth of bytes
 #: — bounded so a bogus chunk header still cannot demand unbounded
 #: memory, while any realistic histogram payload fits.
@@ -770,6 +800,26 @@ def start_worker(
         telemetry_http.start_metrics_server(metrics_port)
     else:
         telemetry_http.maybe_start_from_env()
+
+    if _STATE_TTL_S is not None:
+        # Orphan-state reaper (YDF_TPU_WORKER_STATE_TTL_S): a dead
+        # manager pins resident shards / serve banks with no request
+        # ever arriving to notice, so the sweep must be a thread, not
+        # an on-request check. Sweep period ≤ TTL/4 keeps the reap
+        # latency bounded by ~1.25 × TTL.
+        def _reap_loop():
+            period = min(max(_STATE_TTL_S / 4.0, 0.05), 30.0)
+            while not stop_evt.wait(period):
+                try:
+                    from ydf_tpu.parallel import dist_worker
+                    from ydf_tpu.serving import replica as serve_replica
+
+                    dist_worker.reap_idle_state(_STATE_TTL_S)
+                    serve_replica.reap_idle(_STATE_TTL_S)
+                except Exception:
+                    pass  # reaping is hygiene; never kills the worker
+
+        threading.Thread(target=_reap_loop, daemon=True).start()
 
     def _worker_status(wid=ctx["worker_id"]):
         from ydf_tpu.config import resolved_env_config

@@ -101,6 +101,11 @@ class _ReplicaState:
         self.banks: Dict[str, _LoadedBank] = {}
         self.active: Optional[str] = None
         self.swaps = 0
+        # Idle stamp for the orphan-state reaper
+        # (YDF_TPU_WORKER_STATE_TTL_S): a router that died without
+        # retiring its banks must not pin serve_bank ledger bytes
+        # forever.
+        self.last_used = time.monotonic()
 
 
 _STATE: Dict[str, _ReplicaState] = {}
@@ -112,7 +117,45 @@ def _state(worker_id: str) -> _ReplicaState:
         st = _STATE.get(worker_id)
         if st is None:
             st = _STATE[worker_id] = _ReplicaState()
+        st.last_used = time.monotonic()
         return st
+
+
+def reap_idle(ttl_s: float) -> tuple:
+    """Drops replica serving state idle past `ttl_s` (no fleet verb —
+    predict, swap, status, anything — touched it): every held bank is
+    closed, releasing its `serve_bank` ledger bytes. The serving half
+    of the YDF_TPU_WORKER_STATE_TTL_S orphan reaper
+    (worker_service.start_worker runs the sweep thread); a router that
+    comes back is not broken — its next predict answers `need_load`
+    and the fleet's auto-redeploy re-ships the cached deploy frame.
+    Returns (replica states reaped, bank bytes released)."""
+    from ydf_tpu.utils import telemetry
+
+    now = time.monotonic()
+    dead = []
+    with _STATE_LOCK:
+        for wid, st in list(_STATE.items()):
+            if now - st.last_used >= ttl_s:
+                dead.append(_STATE.pop(wid))
+    reaped = 0
+    freed = 0
+    for st in dead:
+        with st.lock:
+            banks = list(st.banks.values())
+            st.banks.clear()
+            st.active = None
+        reaped += 1
+        for lb in banks:
+            freed += lb.nbytes
+            if lb.bank is not None:
+                try:
+                    lb.bank.close()
+                except Exception:
+                    pass
+    if reaped and telemetry.ENABLED:
+        telemetry.counter("ydf_worker_state_reaped_total").inc(reaped)
+    return reaped, freed
 
 
 def _reset_for_tests() -> None:

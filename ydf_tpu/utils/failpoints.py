@@ -116,6 +116,22 @@ KNOWN_SITES = frozenset(
         # verb); shares the shard_load/histogram_rpc sites above for
         # its other exchanges.
         "dist.validation_rpc",
+        # parallel/dist_gbt.py — the manager's tree-boundary snapshot
+        # write (preemption-safe distributed training): an injected
+        # error crashes the manager between boundaries and the chaos
+        # suite proves `--resume` from the previous snapshot is
+        # bit-identical.
+        "dist.snapshot",
+        # parallel/dist_gbt.py — the resume-time worker reattach
+        # (shard verify/re-ship by a NEW manager): drop_conn drives
+        # the reattach's failover to the next healthy worker.
+        "dist.resume_attach",
+        # parallel/dist_worker.py — the worker-side manager-epoch
+        # fence. An injected error makes the worker answer ONE request
+        # with the typed stale-epoch rejection, as if a newer manager
+        # had attached — the chaos handle for the zombie-manager
+        # split-brain path (the worker's state is never mutated).
+        "dist.epoch_fence",
         # utils/telemetry.py — span/metrics exporter. flush() swallows
         # the injected fault (export is observation): the chaos test
         # asserts a crashing exporter leaves training bit-identical.
