@@ -1130,6 +1130,203 @@ def measure_distributed_family(rows, trees, depth, features, record):
         record["dist_family_error"] = f"{type(e).__name__}: {e}"
 
 
+def measure_cache_build_family(rows, features, record):
+    """Dataset-cache build measurement (the distributed-ingest round's
+    bench half), gated on YDF_TPU_BENCH_CACHE_WORKERS=N (N >= 2): streams
+    the bench table to CSV once, then records
+
+      cache_build_s               single-machine create_dataset_cache
+                                  wall (CSV -> binned shards + meta)
+      cache_build_peak_rss_bytes  process peak RSS right after the
+                                  single-machine build — the streaming
+                                  ingest's memory headline
+      sketch_bytes                total pass-1 state of a sketch-mode
+                                  ingest over the same stream (the bytes
+                                  a worker ships the manager per
+                                  partial; exact mode ships the raw
+                                  distinct-or-spill summaries instead)
+      sketch_rank_error           max measured rank error of the
+                                  sketch across features (vs the raw
+                                  sorted columns), with the max
+                                  certified per-instance bound beside
+                                  it (sketch_rank_error_bound) and the
+                                  within-bound verdict
+                                  (sketch_rank_error_within_bound) —
+                                  the acceptance read that the bound
+                                  documented in docs/binning_pipeline
+                                  holds on real bench data
+      sketch_split_max_drift      max quantile-space drift of
+                                  sketch-derived bin boundaries vs the
+                                  exact build's boundaries (split
+                                  parity, docs/distributed_training.md
+                                  "Distributed cache build")
+      dist_cache_build_s          distributed build wall through N
+                                  in-process localhost workers (ingest
+                                  exchange + bin/shard-write exchange +
+                                  commit) — protocol cost, not a
+                                  scaling figure, same caveat as
+                                  dist_train_s
+      dist_cache_build_workers    worker count
+      dist_cache_peak_worker_build_bytes
+                                  fleet-max per-worker transient from
+                                  the build's commit record — the
+                                  ~1/N-of-the-bin-matrix memory
+                                  contract, MemoryLedger-asserted by
+                                  tests/test_dist_cache.py
+
+    on the headline record. Failures recorded, never fatal."""
+    env = os.environ.get("YDF_TPU_BENCH_CACHE_WORKERS")
+    if not env:
+        return
+    try:
+        nw = int(env)
+        if nw < 2:
+            raise ValueError
+    except ValueError:
+        record["cache_build_family_error"] = (
+            f"YDF_TPU_BENCH_CACHE_WORKERS={env!r} must be an integer >= 2"
+        )
+        return
+    try:
+        import socket as _socket
+        import tempfile
+
+        import numpy as np
+
+        from ydf_tpu.config import Task
+        from ydf_tpu.dataset.cache import (
+            _always_categorical,
+            _iter_chunks,
+            create_dataset_cache,
+        )
+        from ydf_tpu.dataset.sketch import IngestPartial
+        from ydf_tpu.parallel.dist_cache import (
+            create_dataset_cache_distributed,
+        )
+        from ydf_tpu.parallel.worker_service import (
+            WorkerPool,
+            start_worker,
+        )
+        from ydf_tpu.utils import telemetry
+
+        rng = np.random.RandomState(0xCACE)
+        x, y = synth_higgs_chunk(rng, rows, features)
+        chunk_rows = max(rows // 8, 1)
+        with tempfile.TemporaryDirectory() as td:
+            csv_path = os.path.join(td, "bench.csv")
+            cols = [f"f{i}" for i in range(features)] + ["label"]
+            with open(csv_path, "w") as f:
+                f.write(",".join(cols) + "\n")
+                for r in range(rows):
+                    f.write(
+                        ",".join(repr(float(v)) for v in x[r])
+                        + f",{int(y[r])}\n"
+                    )
+
+            t0 = time.time()
+            single = create_dataset_cache(
+                csv_path, os.path.join(td, "single"), label="label",
+                task=Task.CLASSIFICATION, chunk_rows=chunk_rows,
+            )
+            record["cache_build_s"] = round(time.time() - t0, 3)
+            record["cache_build_peak_rss_bytes"] = int(
+                telemetry.peak_rss_bytes()
+            )
+
+            # Sketch-mode pass-1 footprint over the same stream: what a
+            # worker's per-chunk partial costs on the wire when
+            # boundaries="sketch" (bounded by O(k log n) per feature,
+            # vs. the unbounded distinct-value spill of exact mode).
+            always_cat = _always_categorical(
+                "label", Task.CLASSIFICATION, None
+            )
+            partial = IngestPartial(mode="sketch", sketch_k=4096)
+            raw_cols = {}
+            for chunk in _iter_chunks([csv_path], chunk_rows):
+                partial.observe_chunk(chunk, always_cat)
+                for cname, cvals in chunk.items():
+                    if cname != "label":
+                        raw_cols.setdefault(cname, []).append(
+                            np.asarray(cvals, np.float64)
+                        )
+            record["sketch_bytes"] = int(partial.nbytes())
+
+            # Measured sketch quality vs the raw columns: max rank
+            # error across features against each summary's certified
+            # per-instance bound, and the quantile-space drift of
+            # sketch-derived boundaries vs the exact build's — the
+            # split-parity evidence the sketch mode documents.
+            from ydf_tpu.dataset.binning import boundaries_from_sketch
+
+            max_err = max_bound = max_drift = 0.0
+            for i, name in enumerate(single.binner.feature_names):
+                s = partial.num.get(name)
+                if s is None or name not in raw_cols:
+                    continue
+                # Ranks measured against the PARSED column (the stream
+                # the sketch actually saw — the CSV parse can differ
+                # from the pre-write array in the last ulp).
+                col = np.sort(np.concatenate(raw_cols[name]))
+                col = col[np.isfinite(col)]
+                v, w = s.weighted_items()
+                est = np.cumsum(w) - w / 2.0
+                lo = np.searchsorted(col, v, side="left")
+                hi = np.searchsorted(col, v, side="right")
+                err = np.maximum(np.maximum(lo - est, est - hi), 0)
+                max_err = max(
+                    max_err, float(err.max() / max(col.size, 1))
+                )
+                max_bound = max(max_bound, s.rank_error_bound())
+                nb = int(single.binner.feature_num_bins[i])
+                sk_b = boundaries_from_sketch(
+                    v, w, nb, s.distinct_exact()
+                )
+                ex_b = single.binner.boundaries[i, : nb - 1]
+                m = min(sk_b.size, ex_b.size)
+                if m:
+                    qe = np.searchsorted(col, ex_b[:m]) / col.size
+                    qs = np.searchsorted(col, sk_b[:m]) / col.size
+                    max_drift = max(
+                        max_drift, float(np.abs(qe - qs).max())
+                    )
+            record["sketch_rank_error"] = round(max_err, 6)
+            record["sketch_rank_error_bound"] = round(max_bound, 6)
+            record["sketch_rank_error_within_bound"] = bool(
+                max_err <= max_bound
+            )
+            record["sketch_split_max_drift"] = round(max_drift, 6)
+
+            ports = []
+            for _ in range(nw):
+                s = _socket.socket()
+                s.bind(("127.0.0.1", 0))
+                ports.append(s.getsockname()[1])
+                s.close()
+            for p in ports:
+                start_worker(p, host="127.0.0.1", blocking=False)
+            addrs = [f"127.0.0.1:{p}" for p in ports]
+            try:
+                t0 = time.time()
+                dist = create_dataset_cache_distributed(
+                    csv_path, os.path.join(td, "dist"), label="label",
+                    workers=addrs, task=Task.CLASSIFICATION,
+                    chunk_rows=chunk_rows,
+                )
+                record["dist_cache_build_s"] = round(time.time() - t0, 3)
+                record["dist_cache_build_workers"] = nw
+                build = dist._meta.get("build") or {}
+                record["dist_cache_peak_worker_build_bytes"] = int(
+                    build.get("peak_worker_build_bytes", 0)
+                )
+            finally:
+                try:
+                    WorkerPool(addrs).shutdown_all()
+                except Exception:
+                    pass
+    except Exception as e:
+        record["cache_build_family_error"] = f"{type(e).__name__}: {e}"
+
+
 def synth_higgs_chunk(rng, rows, features):
     """One chunk of the synthetic Higgs-shaped table — the ONE label
     model shared by the bench rows and the north-star flow, so their AUC
@@ -1317,6 +1514,10 @@ def run_bench(backend, rows, trees, depth, features, with_baseline, probe_log):
     # Distributed-training family (ROADMAP item 2's measurement half):
     # only runs when YDF_TPU_BENCH_DIST_WORKERS is set.
     measure_distributed_family(rows, trees, depth, features, record)
+    _PARTIAL = dict(record)
+    # Cache-build family (distributed-ingest round's measurement half):
+    # only runs when YDF_TPU_BENCH_CACHE_WORKERS is set.
+    measure_cache_build_family(rows, features, record)
     _PARTIAL = dict(record)
     if backend not in ("cpu",):
         hardware_extras(model, data, record)

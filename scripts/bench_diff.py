@@ -138,6 +138,17 @@ FIELD_SPECS: Dict[str, Tuple[str, float, float]] = {
     "latency_p99_ns": ("lower", 0.25, 500.0),
     "queue_age_p99_ns": ("lower", 0.25, 500.0),
     "serve_batcher_peak_bytes": ("lower", 0.25, float(1 << 16)),
+    # cache-build family (bench.py measure_cache_build_family, env
+    # YDF_TPU_BENCH_CACHE_WORKERS): build walls and the streaming
+    # ingest's peak RSS down is good; sketch_bytes is the per-partial
+    # wire cost of sketch-mode boundary inference, also lower-better.
+    "cache_build_s": ("lower", 0.20, 0.1),
+    "dist_cache_build_s": ("lower", 0.20, 0.1),
+    "cache_build_peak_rss_bytes": ("lower", 0.15, float(64 << 20)),
+    "sketch_bytes": ("lower", 0.10, 4096.0),
+    "dist_cache_peak_worker_build_bytes": ("lower", 0.15, float(1 << 20)),
+    "sketch_rank_error": ("lower", 0.50, 0.002),
+    "sketch_split_max_drift": ("lower", 0.50, 0.002),
     # dotted-prefix rules (nested numeric dicts flatten to parent.key)
     "pool_utilization.": ("higher", 0.10, 0.05),
     "infer_batch_p50_ns.": ("lower", 0.15, 100.0),

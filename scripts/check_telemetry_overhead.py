@@ -63,6 +63,7 @@ def run_check(
     with_serve_load: bool = False,
     with_fleet: bool = False,
     with_transport: bool = False,
+    with_cache_build: bool = False,
 ) -> dict:
     import numpy as np
 
@@ -232,6 +233,65 @@ def run_check(
 
         transport_once()  # warm the pooled connection / code paths
 
+    cache_build_once = None
+    cache_build_cleanup = None
+    if with_cache_build:
+        # Distributed cache-build variant: the 2-worker ingest +
+        # bin/shard-write exchange (parallel/dist_cache.py) over the
+        # SAME table streamed to CSV once. The build is its own
+        # baseline — the telemetry-off fleet pays the identical
+        # planning, merge and write exchange, so the delta is exactly
+        # the instrumentation (build counters, memory-ledger peak
+        # report, RPC latency histograms, failpoint site checks).
+        import socket as _c_socket
+
+        from ydf_tpu.config import Task as _CTask
+        from ydf_tpu.parallel.dist_cache import (
+            create_dataset_cache_distributed,
+        )
+        from ydf_tpu.parallel.worker_service import (
+            WorkerPool as _CWP,
+            start_worker as _c_start_worker,
+        )
+
+        c_ports = []
+        for _ in range(2):
+            s = _c_socket.socket()
+            s.bind(("127.0.0.1", 0))
+            c_ports.append(s.getsockname()[1])
+            s.close()
+        for p in c_ports:
+            _c_start_worker(p, host="127.0.0.1", blocking=False)
+        c_addrs = [f"127.0.0.1:{p}" for p in c_ports]
+        c_dir = tempfile.mkdtemp(prefix="ydf_tel_cache_")
+        c_csv = os.path.join(c_dir, "data.csv")
+        c_cols = list(data.keys())
+        with open(c_csv, "w") as f:
+            f.write(",".join(c_cols) + "\n")
+            for r in range(rows):
+                f.write(",".join(
+                    str(int(data[c][r])) if c == "label"
+                    else repr(float(data[c][r]))
+                    for c in c_cols
+                ) + "\n")
+        c_pool = _CWP(c_addrs)
+
+        def cache_build_once():
+            create_dataset_cache_distributed(
+                c_csv, os.path.join(c_dir, "cache"), label="label",
+                workers=c_pool, task=_CTask.CLASSIFICATION,
+                chunk_rows=max(rows // 8, 1),
+            )
+
+        def cache_build_cleanup():
+            try:
+                c_pool.shutdown_all()
+            except Exception:
+                pass
+            shutil.rmtree(c_dir, ignore_errors=True)
+
+        cache_build_once()  # warm pooled connections / code paths
+
     train_dist = None
     dist_cleanup = None
     if with_dist_row:
@@ -295,6 +355,10 @@ def run_check(
         measure_min_wall(transport_once, reps) if transport_once
         else None
     )
+    disabled_cache_build = (
+        measure_min_wall(cache_build_once, reps) if cache_build_once
+        else None
+    )
     td = tempfile.mkdtemp(prefix="ydf_tel_overhead_")
     enabled_http = None
     enabled_ledger = None
@@ -303,12 +367,17 @@ def run_check(
     enabled_load = None
     enabled_fleet = None
     enabled_transport = None
+    enabled_cache_build = None
     try:
         with telemetry.active(td):
             enabled = measure_min_wall(train_once, reps)
             if transport_once is not None:
                 enabled_transport = measure_min_wall(
                     transport_once, reps
+                )
+            if cache_build_once is not None:
+                enabled_cache_build = measure_min_wall(
+                    cache_build_once, reps
                 )
             if train_dist is not None:
                 enabled_dist = measure_min_wall(train_dist, reps)
@@ -462,6 +531,28 @@ def run_check(
         summary["transport_budget_s"] = round(transport_budget, 4)
         summary["ok_transport"] = transport_overhead <= transport_budget
         summary["ok"] = summary["ok"] and summary["ok_transport"]
+    if enabled_cache_build is not None:
+        # The distributed cache build is its own baseline: the
+        # telemetry-off fleet pays the same ingest/bin exchange and
+        # shard writes, so the delta is exactly the build's
+        # instrumentation (counters, ledger peak report, RPC latency
+        # histograms, failpoint site checks on the chunk path).
+        cache_overhead = enabled_cache_build - disabled_cache_build
+        cache_budget = (
+            rel_budget * disabled_cache_build + noise + abs_floor_s
+        )
+        summary["disabled_cache_build_min_s"] = round(
+            disabled_cache_build, 4
+        )
+        summary["enabled_cache_build_min_s"] = round(
+            enabled_cache_build, 4
+        )
+        summary["cache_build_overhead_s"] = round(cache_overhead, 4)
+        summary["cache_build_budget_s"] = round(cache_budget, 4)
+        summary["ok_cache_build"] = cache_overhead <= cache_budget
+        summary["ok"] = summary["ok"] and summary["ok_cache_build"]
+    if cache_build_cleanup is not None:
+        cache_build_cleanup()
     if transport_cleanup is not None:
         transport_cleanup()
     if fleet_cleanup is not None:
@@ -512,6 +603,13 @@ def main(argv=None) -> int:
                          "the new ydf_rpc_* connect/reuse/inflight/"
                          "wire-byte counters must fit the same 3%% "
                          "budget (ok_transport)")
+    ap.add_argument("--with-cache-build", action="store_true",
+                    help="additionally measure a 2-worker distributed "
+                         "dataset-cache build (parallel/dist_cache.py "
+                         "over in-process localhost workers) "
+                         "telemetry-off vs on — the build counters, "
+                         "ledger peak report and RPC accounting must "
+                         "fit the same 3%% budget (ok_cache_build)")
     args = ap.parse_args(argv)
     summary = run_check(
         rows=args.rows, trees=args.trees, depth=args.depth,
@@ -521,6 +619,7 @@ def main(argv=None) -> int:
         with_serve_load=args.with_serve_load,
         with_fleet=args.with_fleet,
         with_transport=args.with_transport,
+        with_cache_build=args.with_cache_build,
     )
     print(json.dumps(summary))
     return 0 if summary["ok"] else 1

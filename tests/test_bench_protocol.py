@@ -233,6 +233,58 @@ def test_bench_dist_workers_env_validation(tmp_path):
     assert rec2 == {}
 
 
+@pytest.mark.slow
+def test_small_cpu_run_with_cache_build_family():
+    """YDF_TPU_BENCH_CACHE_WORKERS=2 adds the cache-build family to
+    the headline record: single-machine build wall + peak RSS, the
+    sketch-mode pass-1 wire footprint, and the 2-worker distributed
+    build wall with the fleet-max per-worker transient from the
+    build's commit record."""
+    env = dict(os.environ, YDF_TPU_BENCH_CACHE_WORKERS="2")
+    out = subprocess.run(
+        [sys.executable, BENCH, "--cpu", "--small", "--no-baseline"],
+        capture_output=True, text=True, timeout=900, cwd=REPO, env=env,
+    )
+    assert out.returncode == 0
+    rec = _last_json(out.stdout)
+    assert rec.get("cache_build_family_error") is None, rec.get(
+        "cache_build_family_error"
+    )
+    assert rec["cache_build_s"] > 0
+    assert rec["cache_build_peak_rss_bytes"] > 0
+    assert rec["sketch_bytes"] > 0
+    # Sketch-quality acceptance reads: measured rank error within the
+    # certified per-instance bound, split drift vs exact boundaries
+    # reported (both 0.0 when the stream fits the sketch exactly).
+    assert rec["sketch_rank_error"] >= 0
+    assert rec["sketch_rank_error_bound"] >= 0
+    assert rec["sketch_rank_error_within_bound"] is True
+    assert 0 <= rec["sketch_split_max_drift"] < 0.05
+    assert rec["dist_cache_build_s"] > 0
+    assert rec["dist_cache_build_workers"] == 2
+    assert rec["dist_cache_peak_worker_build_bytes"] > 0
+    # The sketch partial must be dramatically smaller than the peak
+    # the build itself needs — that asymmetry is the point of
+    # sketch-mode boundary inference.
+    assert rec["sketch_bytes"] < rec["cache_build_peak_rss_bytes"]
+
+
+def test_bench_cache_workers_env_validation(tmp_path):
+    """A malformed YDF_TPU_BENCH_CACHE_WORKERS lands as a recorded
+    family error, never a crashed bench (artifact protocol)."""
+    mod = _load_bench(tmp_path)
+    rec = {}
+    os.environ["YDF_TPU_BENCH_CACHE_WORKERS"] = "one"
+    try:
+        mod.measure_cache_build_family(1000, 4, rec)
+    finally:
+        del os.environ["YDF_TPU_BENCH_CACHE_WORKERS"]
+    assert "must be an integer >= 2" in rec["cache_build_family_error"]
+    rec2 = {}
+    mod.measure_cache_build_family(1000, 4, rec2)  # unset: no-op
+    assert rec2 == {}
+
+
 def _load_bench(tmp_path):
     """Imports bench.py as a module (its top level only defines) with
     the probe cache redirected into the test's tmp dir."""

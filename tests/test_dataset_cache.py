@@ -237,3 +237,48 @@ def test_cache_uplift_mesh_composition(tmp_path):
     p1 = np.asarray(m_plain.predict(df))
     p2 = np.asarray(m_mesh.predict(df))
     np.testing.assert_allclose(p1, p2, atol=1e-6)
+
+
+def test_cache_reuse_shard_layout_change_rebuilds(tmp_path):
+    """reuse=True must treat a shard-layout change (feature_shards /
+    row_shards) as a request mismatch and REBUILD — never hand back a
+    cache missing the requested shard files (the layout is an
+    unconditional part of the request fingerprint)."""
+    import pandas as pd
+
+    rng = np.random.default_rng(0)
+    n = 1200
+    df = pd.DataFrame({
+        "f1": rng.normal(size=n),
+        "f2": rng.integers(0, 4, size=n),
+        "y": rng.choice(["a", "b"], size=n),
+    })
+    csv = tmp_path / "d.csv"
+    df.to_csv(csv, index=False)
+    c0 = create_dataset_cache(
+        str(csv), str(tmp_path / "c"), label="y", chunk_rows=400,
+    )
+    assert not os.path.exists(tmp_path / "c" / "bins_shard_0.npy")
+    # same request → reuse hit (meta untouched)
+    meta_before = open(tmp_path / "c" / "cache_meta.json", "rb").read()
+    create_dataset_cache(
+        str(csv), str(tmp_path / "c"), label="y", chunk_rows=400,
+        reuse=True,
+    )
+    assert open(tmp_path / "c" / "cache_meta.json", "rb").read() == \
+        meta_before
+    # feature-shard request against the unsharded cache → rebuild
+    c2 = create_dataset_cache(
+        str(csv), str(tmp_path / "c"), label="y", chunk_rows=400,
+        feature_shards=2, reuse=True,
+    )
+    assert os.path.exists(tmp_path / "c" / "bins_shard_0.npy")
+    assert c2.feature_shards == 2
+    np.testing.assert_array_equal(np.asarray(c2.bins), np.asarray(c0.bins))
+    # row-shard layout change on top → rebuild again
+    c3 = create_dataset_cache(
+        str(csv), str(tmp_path / "c"), label="y", chunk_rows=400,
+        feature_shards=2, row_shards=3, reuse=True,
+    )
+    assert os.path.exists(tmp_path / "c" / "bins_rows_2.npy")
+    assert c3.row_shards == 3

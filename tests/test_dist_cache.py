@@ -1,0 +1,367 @@
+"""Distributed dataset-cache creation (parallel/dist_cache.py +
+dist_worker cache verbs). The headline guarantees under test:
+
+  * exact-boundaries distributed builds are BYTE-IDENTICAL to the
+    single-machine `create_dataset_cache` output (meta modulo the
+    "build" provenance key) across worker counts and uneven unit
+    splits, and a model trained from the distributed cache is
+    bit-identical to one trained from the single-machine cache;
+  * sketch-mode builds are invariant to worker count (the manager's
+    ascending-uid merge fold) and publish their certified rank-error
+    bound in the commit record;
+  * chaos: a worker lost mid-ingest is quarantined and its units move
+    (recovered cache byte-identical); a corrupt shard write is caught
+    by the manager's crc receipt verification and re-binned; a manager
+    dying between phases leaves NO commit record and `reuse=True`
+    rebuilds;
+  * memory contract: every worker's reported peak transient build
+    bytes stays within (bin-matrix bytes / N) + the documented
+    per-chunk constant (docs/distributed_training.md "Distributed
+    cache build") — distributed build never holds the full matrix.
+"""
+
+import json
+import os
+import socket
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.dataset.cache import (
+    CacheCorruptionError,
+    DatasetCache,
+    create_dataset_cache,
+)
+from ydf_tpu.parallel import dist_worker
+from ydf_tpu.parallel.dist_cache import create_dataset_cache_distributed
+from ydf_tpu.parallel.worker_service import WorkerPool, start_worker
+from ydf_tpu.utils import failpoints, telemetry
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def workers():
+    started = []
+
+    def start(n):
+        ports = [_free_port() for _ in range(n)]
+        for p in ports:
+            start_worker(p, host="127.0.0.1", blocking=False)
+        addrs = [f"127.0.0.1:{p}" for p in ports]
+        WorkerPool(addrs).ping_all()
+        started.extend(addrs)
+        return addrs
+
+    yield start
+    try:
+        WorkerPool(started).shutdown_all() if started else None
+    except Exception:
+        pass
+    dist_worker.reset_state()
+
+
+def _write_csv(path, n=4000, seed=0):
+    """NaN numericals + an empty-string-laced categorical — the
+    ingest-typing edge cases — written as one CSV source."""
+    rng = np.random.default_rng(seed)
+    df = pd.DataFrame({
+        "f1": rng.normal(size=n),
+        "f2": rng.integers(0, 5, size=n),
+        "f3": rng.exponential(size=n),
+        "cat": rng.choice(["aa", "bb", "cc", ""], size=n),
+        "income": rng.choice(["<=50K", ">50K"], size=n),
+    })
+    df.loc[rng.choice(n, max(n // 50, 1), replace=False), "f1"] = np.nan
+    df.to_csv(path, index=False)
+    return str(path)
+
+
+def _assert_caches_byte_identical(a, b, allow_build=True):
+    fa, fb = sorted(os.listdir(a)), sorted(os.listdir(b))
+    assert fa == fb
+    for f in fa:
+        ba = open(os.path.join(a, f), "rb").read()
+        bb = open(os.path.join(b, f), "rb").read()
+        if f == "cache_meta.json":
+            ja, jb = json.loads(ba), json.loads(bb)
+            if allow_build:
+                ja.pop("build", None)
+                jb.pop("build", None)
+            assert ja == jb, "cache_meta.json differs beyond 'build'"
+        else:
+            assert ba == bb, f"byte mismatch in {f}"
+
+
+# ---------------------------------------------------------------------- #
+# exact-mode byte-identity
+# ---------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("nworkers", [2, 3])
+def test_exact_mode_byte_identity(tmp_path, workers, nworkers):
+    """chunk_rows=700 over 4000 rows → 6 uneven units; with 3 workers
+    the unit runs are uneven too (2/2/2 over a 5.71-chunk stream)."""
+    csv = _write_csv(tmp_path / "d.csv")
+    single = create_dataset_cache(
+        csv, str(tmp_path / "single"), label="income", chunk_rows=700,
+        feature_shards=2, row_shards=2,
+    )
+    dist = create_dataset_cache_distributed(
+        csv, str(tmp_path / "dist"), label="income",
+        workers=workers(nworkers), chunk_rows=700,
+        feature_shards=2, row_shards=2,
+    )
+    assert dist.num_rows == single.num_rows == 4000
+    _assert_caches_byte_identical(tmp_path / "single", tmp_path / "dist")
+    meta = json.load(open(tmp_path / "dist" / "cache_meta.json"))
+    assert meta["build"]["workers"] == nworkers
+    assert meta["build"]["units"] == 6
+
+
+def test_exact_mode_train_bit_identity(tmp_path, workers):
+    csv = _write_csv(tmp_path / "d.csv", n=2500, seed=3)
+    single = create_dataset_cache(
+        csv, str(tmp_path / "single"), label="income", chunk_rows=600,
+    )
+    dist = create_dataset_cache_distributed(
+        csv, str(tmp_path / "dist"), label="income",
+        workers=workers(2), chunk_rows=600,
+    )
+    kw = dict(label="income", num_trees=8, max_depth=4)
+    m1 = ydf.GradientBoostedTreesLearner(**kw).train(single)
+    m2 = ydf.GradientBoostedTreesLearner(**kw).train(dist)
+    df = pd.read_csv(csv)
+    frame = {c: df[c].to_numpy() for c in df.columns}
+    np.testing.assert_array_equal(m1.predict(frame), m2.predict(frame))
+
+
+def test_distributed_reuses_single_machine_cache(tmp_path, workers):
+    """The shared request fingerprint: a distributed build with
+    reuse=True over an existing single-machine cache of the SAME
+    request returns it without touching a worker."""
+    csv = _write_csv(tmp_path / "d.csv", n=1500, seed=5)
+    create_dataset_cache(
+        csv, str(tmp_path / "c"), label="income", chunk_rows=400,
+    )
+    meta_before = open(tmp_path / "c" / "cache_meta.json", "rb").read()
+    got = create_dataset_cache_distributed(
+        csv, str(tmp_path / "c"), label="income",
+        workers=["127.0.0.1:1"],  # unreachable: must never be dialed
+        chunk_rows=400, reuse=True,
+    )
+    assert got.num_rows == 1500
+    assert open(tmp_path / "c" / "cache_meta.json", "rb").read() == \
+        meta_before
+
+
+# ---------------------------------------------------------------------- #
+# sketch mode
+# ---------------------------------------------------------------------- #
+
+
+def test_sketch_mode_worker_count_invariant(tmp_path, workers):
+    """The ascending-uid merge fold makes sketch results a function of
+    the chunk plan only — 2- and 3-worker builds are byte-identical to
+    each other (split-parity with exact mode is documented, not
+    asserted: the sketch is a different estimator)."""
+    csv = _write_csv(tmp_path / "d.csv", n=3000, seed=7)
+    addrs = workers(3)
+    a = create_dataset_cache_distributed(
+        csv, str(tmp_path / "w2"), label="income", workers=addrs[:2],
+        chunk_rows=500, boundaries="sketch", sketch_k=128,
+    )
+    b = create_dataset_cache_distributed(
+        csv, str(tmp_path / "w3"), label="income", workers=addrs,
+        chunk_rows=500, boundaries="sketch", sketch_k=128,
+    )
+    assert a.num_rows == b.num_rows == 3000
+    _assert_caches_byte_identical(tmp_path / "w2", tmp_path / "w3")
+    meta = json.load(open(tmp_path / "w3" / "cache_meta.json"))
+    assert meta["boundaries"] == "sketch"
+    bound = meta["build"]["max_rank_error_bound"]
+    assert 0.0 <= bound < 0.5
+
+
+def test_sketch_mode_splits_close_to_exact(tmp_path, workers):
+    """Split parity evidence: sketch-mode bin boundaries deviate from
+    exact boundaries by at most the certified rank error (in quantile
+    space) — here checked as boundary-count equality and bounded value
+    drift on a smooth column."""
+    csv = _write_csv(tmp_path / "d.csv", n=4000, seed=11)
+    exact = create_dataset_cache(
+        csv, str(tmp_path / "exact"), label="income", chunk_rows=800,
+        num_bins=32,
+    )
+    sk = create_dataset_cache_distributed(
+        csv, str(tmp_path / "sk"), label="income", workers=workers(2),
+        chunk_rows=800, num_bins=32, boundaries="sketch", sketch_k=1024,
+    )
+    be = exact.binner.boundaries
+    bs = sk.binner.boundaries
+    assert be.shape == bs.shape
+    # value drift bounded: compare quantile positions of each boundary
+    df = pd.read_csv(csv)
+    for i, name in enumerate(exact.binner.feature_names[:3]):
+        col = np.sort(df[name].to_numpy(np.float64))
+        col = col[np.isfinite(col)]
+        nb = int(exact.binner.feature_num_bins[i]) - 1
+        qe = np.searchsorted(col, be[i, :nb]) / col.size
+        qs = np.searchsorted(col, bs[i, :nb]) / col.size
+        assert np.abs(qe - qs).max() <= 0.05, name
+
+
+# ---------------------------------------------------------------------- #
+# chaos
+# ---------------------------------------------------------------------- #
+
+
+def test_worker_loss_mid_ingest_recovers_byte_identical(
+    tmp_path, workers
+):
+    csv = _write_csv(tmp_path / "d.csv", n=2000, seed=13)
+    single = create_dataset_cache(
+        csv, str(tmp_path / "single"), label="income", chunk_rows=300,
+        feature_shards=2,
+    )
+    with failpoints.active("dist.cache_ingest=drop_conn@2"):
+        dist = create_dataset_cache_distributed(
+            csv, str(tmp_path / "dist"), label="income",
+            workers=workers(2), chunk_rows=300, feature_shards=2,
+        )
+        assert failpoints.fired_sites() == ["dist.cache_ingest"]
+    assert dist.num_rows == single.num_rows
+    _assert_caches_byte_identical(tmp_path / "single", tmp_path / "dist")
+    meta = json.load(open(tmp_path / "dist" / "cache_meta.json"))
+    assert meta["build"]["recoveries"] >= 1
+
+
+def test_corrupt_shard_write_is_rebinned(tmp_path, workers, monkeypatch):
+    """A worker whose written bytes don't match its crc receipt (torn
+    write / disk fault between write and commit) is caught by the
+    manager's receipt verification and its units re-binned; the
+    committed cache is byte-identical to a clean build."""
+    csv = _write_csv(tmp_path / "d.csv", n=1600, seed=17)
+    single = create_dataset_cache(
+        csv, str(tmp_path / "single"), label="income", chunk_rows=400,
+        feature_shards=2,
+    )
+    real = dist_worker._HANDLERS["cache_bin_rows"]
+    state = {"corrupted": False}
+
+    def corrupting(req, worker_id):
+        from ydf_tpu.dataset.cache import _npy_data_offset
+
+        resp = real(req, worker_id)
+        if not state["corrupted"] and resp.get("ok"):
+            state["corrupted"] = True
+            # Corrupt bytes ON DISK inside THIS request's own written
+            # row range (no other worker rewrites them): the receipt
+            # is now a lie and the manager's verify must catch it.
+            path = os.path.join(req["cache_dir"], "labels.npy")
+            grow = int(req["units"][0][4])
+            off = _npy_data_offset(path)
+            with open(path, "r+b") as f:
+                f.seek(off + grow * 4)
+                f.write(b"\xff" * 4)
+        return resp
+
+    monkeypatch.setitem(
+        dist_worker._HANDLERS, "cache_bin_rows", corrupting
+    )
+    with telemetry.active():
+        dist = create_dataset_cache_distributed(
+            csv, str(tmp_path / "dist"), label="income",
+            workers=workers(2), chunk_rows=400, feature_shards=2,
+        )
+        rebins = telemetry.counter(
+            "ydf_dist_cache_rebins_total"
+        ).value
+    assert state["corrupted"]
+    assert rebins >= 1
+    _assert_caches_byte_identical(tmp_path / "single", tmp_path / "dist")
+    dist.verify(full=True)
+    DatasetCache(str(tmp_path / "dist"), verify="full")
+
+
+def test_manager_death_between_phases_then_reuse_rebuilds(
+    tmp_path, workers
+):
+    """dist.cache_bin=error@1 models the manager crashing after ingest
+    but before any commit record exists: the partial cache FAILS TO
+    OPEN, and a reuse=True retry rebuilds from scratch."""
+    csv = _write_csv(tmp_path / "d.csv", n=1200, seed=19)
+    addrs = workers(2)
+    with failpoints.active("dist.cache_bin=error@1"):
+        with pytest.raises(failpoints.FailpointError):
+            create_dataset_cache_distributed(
+                csv, str(tmp_path / "c"), label="income",
+                workers=addrs, chunk_rows=300,
+            )
+    # no commit record → the half-built cache is unopenable
+    assert not os.path.exists(tmp_path / "c" / "cache_meta.json")
+    with pytest.raises(Exception):
+        DatasetCache(str(tmp_path / "c"))
+    rebuilt = create_dataset_cache_distributed(
+        csv, str(tmp_path / "c"), label="income", workers=addrs,
+        chunk_rows=300, reuse=True,
+    )
+    assert rebuilt.num_rows == 1200
+    single = create_dataset_cache(
+        csv, str(tmp_path / "single"), label="income", chunk_rows=300,
+    )
+    _assert_caches_byte_identical(tmp_path / "single", tmp_path / "c")
+
+
+def test_epoch_fence_rejects_build(tmp_path, workers):
+    """A fenced-out cache-build manager stops loudly, exactly like a
+    fenced training manager."""
+    from ydf_tpu.parallel.dist_gbt import DistributedTrainingError
+
+    csv = _write_csv(tmp_path / "d.csv", n=600, seed=23)
+    with failpoints.active("dist.epoch_fence=error@1"):
+        with pytest.raises(DistributedTrainingError, match="fenced"):
+            create_dataset_cache_distributed(
+                csv, str(tmp_path / "c"), label="income",
+                workers=workers(1), chunk_rows=200,
+            )
+
+
+# ---------------------------------------------------------------------- #
+# memory contract
+# ---------------------------------------------------------------------- #
+
+
+def test_memory_contract(tmp_path, workers):
+    """Per-worker peak transient build bytes ≤ (bin-matrix bytes / N)
+    + the documented per-chunk constant — and, with these sizes, below
+    the bin matrix outright: no process ever holds the full matrix.
+    The fleet max lands on the dist_cache_build MemoryLedger row."""
+    n, chunk_rows, W = 50_000, 500, 2
+    csv = _write_csv(tmp_path / "d.csv", n=n)
+    with telemetry.active():
+        dist = create_dataset_cache_distributed(
+            csv, str(tmp_path / "c"), label="income",
+            workers=workers(W), chunk_rows=chunk_rows,
+        )
+        ledger_bytes = telemetry.ledger().get_bytes("dist_cache_build")
+    meta = json.load(open(tmp_path / "c" / "cache_meta.json"))
+    peak = meta["build"]["peak_worker_build_bytes"]
+    assert peak == ledger_bytes > 0
+    bins_bytes = dist.num_rows * dist.binner.num_scalar
+    ncols = 5
+    # documented constant (docs/distributed_training.md): one resident
+    # chunk — its f64 columns, its uint8 bin block, and the per-unit
+    # partial (exact mode: ≤ one value+count pair per chunk row).
+    const = chunk_rows * (8 * ncols + dist.binner.num_scalar + 24) \
+        + (64 << 10)
+    assert peak <= bins_bytes / W + const
+    assert peak < bins_bytes  # never the full matrix in one process

@@ -337,6 +337,58 @@ with _tempfile.TemporaryDirectory() as _td:
     assert _pp.transport_snapshot()["rpc_connects"] == 1, (
         _pp.transport_snapshot()
     )
+    # Distributed dataset-cache build under the sanitizer (ingest
+    # round): two workers stream the CSV through the ingest-stats +
+    # bin-rows exchange and write crc-block shards through the
+    # sanitized native binning kernel; the result must equal the
+    # single-machine build byte for byte (meta modulo the build
+    # provenance record).
+    import json as _json
+    import os as _os
+    from ydf_tpu.parallel.dist_cache import (
+        create_dataset_cache_distributed,
+    )
+    _csv = _td + "/san.csv"
+    _ccols = list(_frame.keys())
+    with open(_csv, "w") as _f:
+        _f.write(",".join(_ccols) + "\n")
+        for _r in range(len(_frame["y"])):
+            _f.write(",".join(
+                repr(float(_frame[_c][_r])) for _c in _ccols
+            ) + "\n")
+    _san_single = create_dataset_cache(
+        _csv, _td + "/san_single", label="y", task=Task.REGRESSION,
+        chunk_rows=400, feature_shards=2,
+    )
+    _s2 = _socket.socket(); _s2.bind(("127.0.0.1", 0))
+    _port2 = _s2.getsockname()[1]; _s2.close()
+    start_worker(_port2, host="127.0.0.1", blocking=False)
+    _san_dist = create_dataset_cache_distributed(
+        _csv, _td + "/san_dist", label="y",
+        workers=[f"127.0.0.1:{_port}", f"127.0.0.1:{_port2}"],
+        task=Task.REGRESSION, chunk_rows=400, feature_shards=2,
+    )
+    _npys = sorted(
+        _n for _n in _os.listdir(_td + "/san_single")
+        if _n.endswith(".npy")
+    )
+    assert _npys == sorted(
+        _n for _n in _os.listdir(_td + "/san_dist")
+        if _n.endswith(".npy")
+    ), _npys
+    for _name in _npys:
+        with open(_td + "/san_single/" + _name, "rb") as _fa:
+            _ba = _fa.read()
+        with open(_td + "/san_dist/" + _name, "rb") as _fb:
+            _bb = _fb.read()
+        assert _ba == _bb, f"shard {_name} differs under the sanitizer"
+    with open(_td + "/san_single/cache_meta.json") as _fa:
+        _ma = _json.load(_fa)
+    with open(_td + "/san_dist/cache_meta.json") as _fb:
+        _mb = _json.load(_fb)
+    _ma.pop("build", None); _mb.pop("build", None)
+    assert _ma == _mb, "cache meta differs under the sanitizer"
+    WorkerPool([f"127.0.0.1:{_port2}"]).shutdown_all()
     WorkerPool([f"127.0.0.1:{_port}"]).shutdown_all()
 
 # Serving-fleet swap + failover cycle under the sanitizer (fleet

@@ -1,0 +1,337 @@
+"""Mergeable quantile summaries (dataset/sketch.py): the pass-1
+statistics of every cache build. Under test:
+
+  * exact mode is an order-independent multiset — any partition of a
+    stream into chunks, merged in any grouping, reproduces the
+    single-stream summary bit-for-bit (the distributed exact-mode
+    byte-identity contract rests on this);
+  * the KLL sketch's measured rank error stays within its CERTIFIED
+    per-instance bound (rank_error_bound) on adversarial
+    distributions — heavy duplicates, constants, NaN-laced, sorted
+    adversarial streams;
+  * sketch merges are deterministic for a fixed unit sequence: the
+    manager's ascending-uid fold gives one result regardless of how
+    units were grouped onto workers;
+  * the dyadic exact sum is order-independent and correctly rounded.
+"""
+
+import numpy as np
+import pytest
+
+from ydf_tpu.dataset.sketch import (
+    IngestPartial,
+    NumericSummary,
+    dyadic_add,
+    dyadic_sum,
+    dyadic_to_float,
+)
+
+# ---------------------------------------------------------------------- #
+# dyadic exact sums
+# ---------------------------------------------------------------------- #
+
+
+def test_dyadic_sum_order_independent():
+    rng = np.random.RandomState(0)
+    vals = np.concatenate([
+        rng.normal(size=1000) * 1e12,
+        rng.normal(size=1000) * 1e-12,
+        rng.normal(size=1000),
+    ])
+    d1 = dyadic_sum(vals)
+    for seed in range(3):
+        p = np.random.RandomState(seed).permutation(vals.size)
+        assert dyadic_sum(vals[p]) == d1
+    # splitting + dyadic_add == whole-array sum
+    d2 = dyadic_add(dyadic_sum(vals[:700]), dyadic_sum(vals[700:]))
+    assert d2 == d1
+
+
+def test_dyadic_to_float_correctly_rounded():
+    # 0.1 summed 10 times: the dyadic sum is the exact rational sum of
+    # the f64 representations; its rounding differs from naive
+    # accumulation's drift but equals math.fsum.
+    import math
+
+    vals = np.full(10, 0.1)
+    assert dyadic_to_float(dyadic_sum(vals)) == math.fsum([0.1] * 10)
+    assert dyadic_to_float(dyadic_sum(vals), div=10) == pytest.approx(
+        0.1, abs=0
+    )
+
+
+# ---------------------------------------------------------------------- #
+# exact mode
+# ---------------------------------------------------------------------- #
+
+
+def _summary_of(vals, mode="exact", k=4096, chunks=1):
+    s = NumericSummary(mode=mode, k=k)
+    for part in np.array_split(np.asarray(vals, np.float64), chunks):
+        if part.size:
+            s.update(part)
+    return s
+
+
+def _wire_equal(a: NumericSummary, b: NumericSummary) -> bool:
+    wa, wb = a.to_wire(), b.to_wire()
+    if set(wa) != set(wb):
+        return False
+    for key in wa:
+        va, vb = wa[key], wb[key]
+        if isinstance(va, np.ndarray):
+            if not np.array_equal(va, vb, equal_nan=True):
+                return False
+        elif isinstance(va, list):
+            if len(va) != len(vb) or any(
+                not np.array_equal(x, y) for x, y in zip(va, vb)
+            ):
+                return False
+        elif isinstance(va, float) and isinstance(vb, float):
+            if va != vb and not (np.isnan(va) and np.isnan(vb)):
+                return False
+        elif va != vb:
+            return False
+    return True
+
+
+def test_exact_partition_invariance():
+    """Any chunking AND any merge grouping of an exact summary equals
+    the single-stream summary exactly — the property that makes
+    distributed exact mode byte-identical."""
+    rng = np.random.RandomState(3)
+    vals = np.concatenate([
+        rng.normal(size=4000),
+        np.repeat([1.5, -2.25, 0.0], 500),
+        [np.nan] * 37, [np.inf, -np.inf] * 3, [-0.0] * 11,
+    ])
+    rng.shuffle(vals)
+    ref = _summary_of(vals)
+    for nchunks, group in [(7, 2), (13, 3), (4, 4), (29, 6)]:
+        parts = [
+            _summary_of(c)
+            for c in np.array_split(vals, nchunks)
+        ]
+        # merge in fixed order but arbitrary grouping (associativity)
+        while len(parts) > 1:
+            merged = []
+            for i in range(0, len(parts), group):
+                head = parts[i]
+                for p in parts[i + 1: i + group]:
+                    head.merge(p)
+                merged.append(head)
+            parts = merged
+        got = parts[0]
+        assert _wire_equal(got, ref), (nchunks, group)
+        # +inf and -inf both present → the mean is NaN in every grouping
+        np.testing.assert_equal(got.mean(), ref.mean())
+
+
+def test_exact_handles_nan_inf_negzero():
+    s = _summary_of([1.0, np.nan, -0.0, 0.0, np.inf, 2.0])
+    assert s.missing == 1          # NaN → missing, not a value
+    assert s.count == 5            # ±inf and -0.0 are values
+    v, w = s.weighted_items()
+    # -0.0 canonicalized: one zero entry with weight 2
+    assert 0.0 in v.tolist()
+    assert w[np.flatnonzero(v == 0.0)[0]] == 2
+    assert not np.signbit(v[v == 0.0])[0]
+    assert s.mean() == np.inf      # inf dominates the mean
+
+
+def test_exact_mean_matches_fsum():
+    import math
+
+    rng = np.random.RandomState(11)
+    vals = rng.normal(size=10_000) * np.logspace(-9, 9, 10_000)
+    s = _summary_of(vals, chunks=17)
+    assert s.mean() == pytest.approx(
+        math.fsum(vals.tolist()) / vals.size, rel=1e-15
+    )
+
+
+def test_exact_distinct_fast_path():
+    """≤ small-cardinality streams stay exact (distinct_exact) — the
+    midpoint-boundaries fast path the Binner mirrors."""
+    s = _summary_of(np.tile([3.0, 1.0, 2.0], 400))
+    assert s.distinct_exact()
+    v, w = s.weighted_items()
+    np.testing.assert_array_equal(v, [1.0, 2.0, 3.0])
+    np.testing.assert_array_equal(w, [400, 400, 400])
+    assert s.rank_error_bound() == 0.0
+
+
+# ---------------------------------------------------------------------- #
+# sketch mode
+# ---------------------------------------------------------------------- #
+
+
+def _measured_rank_error(s: NumericSummary, vals: np.ndarray) -> float:
+    """Max |estimated rank − true rank| / n over the sketch's items."""
+    finite = np.sort(vals[np.isfinite(vals)])
+    n = finite.size
+    v, w = s.weighted_items()
+    est = np.cumsum(w) - w / 2.0
+    true_lo = np.searchsorted(finite, v, side="left")
+    true_hi = np.searchsorted(finite, v, side="right")
+    err = np.maximum(true_lo - est, est - true_hi)
+    return float(np.maximum(err, 0).max() / max(n, 1))
+
+
+@pytest.mark.parametrize(
+    "name,vals",
+    [
+        ("normal", np.random.RandomState(0).normal(size=200_000)),
+        ("sorted_adversarial", np.arange(150_000, dtype=np.float64)),
+        (
+            "heavy_dup",
+            np.random.RandomState(1).choice(
+                [0.0, 1.0, 2.0, 1e9], size=200_000, p=[0.7, 0.2, 0.09, 0.01]
+            ),
+        ),
+        (
+            "nan_laced",
+            np.where(
+                np.random.RandomState(2).rand(120_000) < 0.3,
+                np.nan,
+                np.random.RandomState(3).lognormal(size=120_000),
+            ),
+        ),
+    ],
+)
+def test_sketch_rank_error_within_certified_bound(name, vals):
+    for k, chunks in [(256, 23), (1024, 7)]:
+        s = _summary_of(vals, mode="sketch", k=k, chunks=chunks)
+        bound = s.rank_error_bound()
+        measured = _measured_rank_error(s, np.asarray(vals))
+        assert measured <= bound + 1e-12, (name, k, measured, bound)
+        # the bound must also be non-vacuous for a real spill
+        if s.spilled:
+            assert bound < 0.5
+
+
+def test_sketch_constant_column_stays_exact():
+    s = _summary_of(np.full(500_000, 7.25), mode="sketch", k=64)
+    assert s.distinct_exact()
+    v, w = s.weighted_items()
+    np.testing.assert_array_equal(v, [7.25])
+    np.testing.assert_array_equal(w, [500_000])
+
+
+def test_sketch_fixed_fold_is_worker_count_invariant():
+    """The manager merges PER-UNIT summaries in ascending uid order —
+    the fold over units is identical no matter how units were grouped
+    onto 1, 2, or 5 workers, so sketch-mode builds don't depend on
+    worker count."""
+    rng = np.random.RandomState(5)
+    vals = rng.gamma(2.0, size=90_000)
+    units = np.array_split(vals, 18)  # 18 chunk units
+    unit_summaries = [
+        _summary_of(u, mode="sketch", k=128) for u in units
+    ]
+    wires = [s.to_wire() for s in unit_summaries]
+
+    def fold():
+        out = NumericSummary(mode="sketch", k=128)
+        for w in wires:
+            out.merge(NumericSummary.from_wire(w))
+        return out
+
+    ref = fold()
+    for _ in range(3):  # regrouping workers never changes the fold
+        again = fold()
+        assert _wire_equal(again, ref)
+    assert _measured_rank_error(ref, vals) <= ref.rank_error_bound()
+
+
+def test_sketch_memory_bounded():
+    """nbytes stays O(k log n) while exact mode grows with distincts."""
+    rng = np.random.RandomState(9)
+    vals = rng.normal(size=300_000)
+    sk = _summary_of(vals, mode="sketch", k=256, chunks=10)
+    ex = _summary_of(vals, mode="exact", chunks=10)
+    assert sk.nbytes() < ex.nbytes() / 20
+    assert sk.nbytes() < 256 * 8 * 64  # k floats × generous level slack
+
+
+# ---------------------------------------------------------------------- #
+# IngestPartial
+# ---------------------------------------------------------------------- #
+
+
+def _chunked(df_cols, nchunks):
+    n = len(next(iter(df_cols.values())))
+    idx = np.array_split(np.arange(n), nchunks)
+    return [
+        {k: np.asarray(v)[i] for k, v in df_cols.items()} for i in idx
+    ]
+
+
+def test_ingest_partial_merge_equals_stream():
+    rng = np.random.RandomState(21)
+    n = 3000
+    cols = {
+        "x": rng.normal(size=n),
+        "c": rng.choice(["u", "v", "w", ""], size=n),
+        "y": rng.choice(["a", "b"], size=n),
+    }
+    ref = IngestPartial()
+    for ch in _chunked(cols, 6):
+        ref.observe_chunk(ch, frozenset({"y"}))
+    merged = IngestPartial()
+    for ch in _chunked(cols, 6):
+        p = IngestPartial()
+        p.observe_chunk(ch, frozenset({"y"}))
+        merged.merge(p)
+    assert merged.num_rows == ref.num_rows == n
+    assert merged.cat == ref.cat
+    assert merged.cat_missing == ref.cat_missing
+    assert _wire_equal(merged.num["x"], ref.num["x"])
+
+
+def test_ingest_partial_mixed_column_recount():
+    """A column numeric in one chunk and object in another demotes to
+    categorical via the recount protocol — merged partials reach the
+    same counts as the single-machine begin/observe recount."""
+    chunks = [
+        {"m": np.array([1.0, 2.0]), "y": np.array(["a", "b"])},
+        {"m": np.array(["x", "y"], object), "y": np.array(["a", "a"])},
+    ]
+    p = IngestPartial()
+    for ch in chunks:
+        p.observe_chunk(ch, frozenset({"y"}))
+    mixed = p.mixed_columns()
+    assert mixed == ["m"]
+    p.begin_recount(mixed)
+    rc = IngestPartial()
+    for ch in chunks:
+        q = IngestPartial()
+        q.observe_recount(ch, mixed)
+        rc.merge(q)
+    p.apply_recount(rc, mixed)
+    assert p.cat["m"] == {"1.0": 1, "2.0": 1, "x": 1, "y": 1}
+    assert "m" not in p.num
+
+
+def test_ingest_partial_wire_roundtrip():
+    rng = np.random.RandomState(2)
+    p = IngestPartial(mode="sketch", sketch_k=64)
+    p.observe_chunk(
+        {"x": rng.normal(size=5000), "c": rng.choice(["p", "q"], 5000)},
+        frozenset(),
+    )
+    q = IngestPartial.from_wire(p.to_wire())
+    assert q.num_rows == p.num_rows
+    assert q.cat == p.cat
+    assert _wire_equal(q.num["x"], p.num["x"])
+    # merged roundtrips keep merging
+    q.merge(IngestPartial.from_wire(p.to_wire()))
+    assert q.num_rows == 2 * p.num_rows
+
+
+def test_ingest_partial_column_order_mismatch_raises():
+    a, b = IngestPartial(), IngestPartial()
+    a.observe_chunk({"x": np.arange(3.0)}, frozenset())
+    b.observe_chunk({"z": np.arange(3.0)}, frozenset())
+    with pytest.raises(ValueError, match="column order"):
+        a.merge(b)

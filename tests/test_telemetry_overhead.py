@@ -84,3 +84,21 @@ def test_dist_row_overhead_within_budget():
         "row-parallel distributed telemetry overhead exceeded its "
         f"budget: {summary}"
     )
+
+
+def test_cache_build_overhead_within_budget():
+    """Distributed cache-build variant (`--with-cache-build`): the
+    build counters, memory-ledger peak report, RPC latency histograms
+    and per-chunk failpoint site checks of a 2-worker ingest +
+    bin/shard-write exchange must fit the same 3% + noise budget
+    against the telemetry-off build baseline — the observability of
+    the build may not eat the parallelism it measures."""
+    mod = _load()
+    summary = mod.run_check(rows=4_000, trees=4, depth=4, reps=2,
+                            with_cache_build=True)
+    assert summary["disabled_cache_build_min_s"] > 0
+    assert summary["enabled_cache_build_min_s"] > 0
+    assert summary["ok_cache_build"], (
+        "distributed cache-build telemetry overhead exceeded its "
+        f"budget: {summary}"
+    )

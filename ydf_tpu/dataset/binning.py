@@ -30,7 +30,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import os
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -44,6 +44,63 @@ _NUMERICAL_LIKE = (
 )
 
 _BIN_IMPLS = ("native", "numpy")
+
+#: np.repeat-expansion ceiling of boundaries_from_sketch: a weighted
+#: item set whose total weight fits under this is quantiled through
+#: np.quantile on the expanded multiset (bit-identical to the legacy
+#: sample path, which never exceeds the 200k row sample); above it the
+#: weighted replica of the same "linear" method runs in O(items).
+_QUANTILE_EXPAND_CAP = 1 << 21
+
+
+def boundaries_from_sketch(
+    values: np.ndarray,
+    weights: np.ndarray,
+    num_bins: int,
+    distinct_is_exact: bool,
+) -> np.ndarray:
+    """Bin boundaries from a weighted item set (ascending unique
+    `values`, positive integer `weights`) — the shared boundary → bin
+    seam of `Binner.fit` and the sketch-fed distributed cache build
+    (dataset/sketch.py): both paths call THIS function, so single-
+    machine and distributed builds agree on boundary semantics by
+    construction.
+
+      * `distinct_is_exact` and ≤ num_bins-1 items: midpoints between
+        consecutive distinct values, computed in `values`' own dtype —
+        binned training is exactly equivalent to exhaustive split
+        search, and the legacy fit path (f32 unique values) keeps its
+        bit-identical boundaries.
+      * otherwise: deduplicated weighted quantiles of the multiset,
+        replicating numpy's "linear" method (virtual index q·(n-1),
+        same-lerp `a+(b-a)·t` / `b-(b-a)·(1-t)` branch at t ≥ 0.5) so a
+        weight-1 item set reproduces np.quantile of the raw sample
+        bit-for-bit.
+    """
+    max_boundaries = num_bins - 1
+    values = np.asarray(values)
+    weights = np.asarray(weights, np.int64)
+    if values.size == 0:
+        return np.zeros((0,), np.float32)
+    if distinct_is_exact and len(values) <= max_boundaries:
+        return ((values[:-1] + values[1:]) / 2).astype(np.float32)
+    total = int(weights.sum())
+    qs_pos = np.linspace(0, 1, num_bins + 1)[1:-1]
+    v64 = values.astype(np.float64)
+    if total <= _QUANTILE_EXPAND_CAP:
+        qs = np.quantile(
+            np.repeat(v64, weights), qs_pos, method="linear"
+        )
+    else:
+        cw = np.cumsum(weights)
+        h = qs_pos * (total - 1)
+        lo = np.floor(h).astype(np.int64)
+        g = h - lo
+        hi = np.minimum(lo + 1, total - 1)
+        a = v64[np.searchsorted(cw, lo, side="right")]
+        b = v64[np.searchsorted(cw, hi, side="right")]
+        qs = np.where(g < 0.5, a + (b - a) * g, b - (b - a) * (1 - g))
+    return np.unique(qs).astype(np.float32)
 
 
 def resolve_bin_impl(impl: str = "auto") -> str:
@@ -153,6 +210,93 @@ class Binner:
         num_bins: int = 256,
         max_unique_for_exact: Optional[int] = None,
     ) -> "Binner":
+        spec = dataset.dataspec
+        max_boundaries = num_bins - 1
+
+        # One shared fixed-seed row sample for every dense column: each
+        # column used to draw its own sample with the SAME seed, so the
+        # indices were identical anyway — hoisting the choice() out of
+        # the loop is bit-identical and saves its O(n) cost per column.
+        state: Dict[str, Optional[np.ndarray]] = {"sample_idx": None}
+
+        def column_boundaries(name: str) -> np.ndarray:
+            vals = dataset.encoded_numerical(name)
+            # Boundary fitting is O(n log n) (unique/quantile sorts);
+            # past ~200k rows a fixed-seed row sample estimates the
+            # 255 quantiles with negligible split-quality impact —
+            # the reference's distributed dataset cache discretizes
+            # from samples the same way (dataset_cache.proto:42-58),
+            # and sklearn's histogram GBT subsamples binning at the
+            # same scale. A small pre-sample screens cardinality so
+            # the full-column unique sort only runs when the column
+            # really is low-cardinality.
+            if len(vals) > 200_000:
+                if state["sample_idx"] is None:
+                    state["sample_idx"] = np.random.default_rng(
+                        0xB1A5
+                    ).choice(len(vals), 200_000, replace=False)
+                sample = vals[state["sample_idx"]]
+            else:
+                sample = vals
+            presample = sample[: 4 * max_boundaries + 4]
+            if len(np.unique(presample)) <= max_boundaries:
+                # Possibly low cardinality — confirm exactly (the
+                # midpoint boundaries need the true unique set).
+                uniq = np.unique(vals)
+            else:
+                uniq = None  # dense column: quantile path
+            if uniq is not None and len(uniq) <= max_boundaries:
+                return boundaries_from_sketch(
+                    uniq, np.ones(len(uniq), np.int64), num_bins,
+                    distinct_is_exact=True,
+                )
+            su, sc = np.unique(sample, return_counts=True)
+            return boundaries_from_sketch(
+                su, sc, num_bins, distinct_is_exact=False
+            )
+
+        return Binner._fit_common(
+            spec, features, num_bins, column_boundaries
+        )
+
+    @staticmethod
+    def fit_from_summaries(
+        spec: DataSpecification,
+        features: Sequence[str],
+        num_bins: int,
+        summaries: Dict,
+    ) -> "Binner":
+        """Binner.fit fed by mergeable pass-1 summaries instead of raw
+        columns: `summaries` maps each numerical feature name to a
+        dataset.sketch.NumericSummary. This is the boundary source of
+        BOTH the single-machine streaming cache build and the
+        distributed one (the former is the 1-partial instance of the
+        latter), so caches agree byte-for-byte whenever the merged
+        summaries do — exactly in exact mode, per the documented rank
+        error in sketch mode."""
+
+        def column_boundaries(name: str) -> np.ndarray:
+            s = summaries[name]
+            v, w = s.weighted_items()
+            return boundaries_from_sketch(
+                v, w, num_bins, distinct_is_exact=s.distinct_exact()
+            )
+
+        return Binner._fit_common(
+            spec, features, num_bins, column_boundaries
+        )
+
+    @staticmethod
+    def _fit_common(
+        spec: DataSpecification,
+        features: Sequence[str],
+        num_bins: int,
+        column_boundaries: Callable[[str], np.ndarray],
+    ) -> "Binner":
+        """Shared fit body: feature partition/ordering, the
+        DISCRETIZED_NUMERICAL stored-boundary branch, imputation and
+        per-feature bin counts — with the numerical boundary source
+        abstracted as `column_boundaries(name)`."""
         if not (2 <= num_bins <= 256):
             raise ValueError(
                 f"num_bins must be in [2, 256] (uint8 bin matrix), got {num_bins}"
@@ -162,7 +306,6 @@ class Binner:
                 f"num_bins must be a multiple of 32 (packed category masks), "
                 f"got {num_bins}"
             )
-        spec = dataset.dataspec
         numericals = [
             f for f in features
             if spec.column_by_name(f).type in _NUMERICAL_LIKE
@@ -195,12 +338,6 @@ class Binner:
         impute = np.zeros((F,), dtype=np.float32)
         fnb = np.ones((F,), dtype=np.int32)
 
-        # One shared fixed-seed row sample for every dense column: each
-        # column used to draw its own sample with the SAME seed, so the
-        # indices were identical anyway — hoisting the choice() out of
-        # the loop is bit-identical and saves its O(n) cost per column.
-        sample_idx: Optional[np.ndarray] = None
-
         for i, name in enumerate(numericals):
             col = spec.column_by_name(name)
             if (
@@ -217,40 +354,7 @@ class Binner:
                     idx = np.linspace(0, len(b) - 1, max_boundaries)
                     b = b[np.round(idx).astype(int)]
             else:
-                vals = dataset.encoded_numerical(name)
-                # Boundary fitting is O(n log n) (unique/quantile sorts);
-                # past ~200k rows a fixed-seed row sample estimates the
-                # 255 quantiles with negligible split-quality impact —
-                # the reference's distributed dataset cache discretizes
-                # from samples the same way (dataset_cache.proto:42-58),
-                # and sklearn's histogram GBT subsamples binning at the
-                # same scale. A small pre-sample screens cardinality so
-                # the full-column unique sort only runs when the column
-                # really is low-cardinality.
-                if len(vals) > 200_000:
-                    if sample_idx is None:
-                        sample_idx = np.random.default_rng(0xB1A5).choice(
-                            len(vals), 200_000, replace=False
-                        )
-                    sample = vals[sample_idx]
-                else:
-                    sample = vals
-                presample = sample[: 4 * max_boundaries + 4]
-                if len(np.unique(presample)) <= max_boundaries:
-                    # Possibly low cardinality — confirm exactly (the
-                    # midpoint boundaries need the true unique set).
-                    uniq = np.unique(vals)
-                else:
-                    uniq = None  # dense column: quantile path
-                if uniq is not None and len(uniq) <= max_boundaries:
-                    b = ((uniq[:-1] + uniq[1:]) / 2).astype(np.float32)
-                else:
-                    qs = np.quantile(
-                        sample.astype(np.float64),
-                        np.linspace(0, 1, num_bins + 1)[1:-1],
-                        method="linear",
-                    )
-                    b = np.unique(qs).astype(np.float32)
+                b = column_boundaries(name)
             boundaries[i, : len(b)] = b
             impute[i] = np.float32(col.mean)
             fnb[i] = len(b) + 1

@@ -5,13 +5,20 @@ Counterpart of the reference's distributed dataset cache
 16-59`): a two-pass, chunked ingestion that never materializes the raw
 dataset in host RAM.
 
-  Pass 1  stream the input shards chunk-by-chunk, accumulating dataspec
-          statistics (numerical mean/min/max + a bounded reservoir sample
-          for quantile boundaries; categorical value counts — the same
-          sample-based discretization the reference cache uses,
-          dataset_cache.proto:42-58).
-  Pass 2  bin every chunk with the fitted Binner and append the uint8
-          rows to a memmapped `bins.npy` (+ float32 labels/weights).
+  Pass 1  stream the input shards chunk-by-chunk, accumulating MERGEABLE
+          dataspec statistics (dataset/sketch.py: exact dyadic sums,
+          exact or KLL-sketched weighted quantile summaries, categorical
+          value counts). Mergeability is the load-bearing property: the
+          distributed build (parallel/dist_cache.py) runs the SAME pass
+          on per-worker row ranges and merges the partials in fixed
+          order, so the single-machine build is just its 1-worker
+          instance — in exact-boundaries mode the two are byte-identical
+          by construction.
+  Pass 2  bin every chunk with the fitted Binner straight into the
+          memmapped `bins.npy` (+ labels/weights/extra/raw and every
+          feature-/row-shard file, all filled chunk-wise in this one
+          pass — _CacheWriters is the shared write surface of the
+          single-machine builder and the distributed bin workers).
 
 Training then memmaps the cache: host RSS stays O(chunk), and the single
 device transfer of the uint8 bin matrix is the only full-size copy —
@@ -50,6 +57,22 @@ from ydf_tpu.dataset.dataspec import (
     OOV_ITEM,
     infer_column,
 )
+from ydf_tpu.dataset.sketch import IngestPartial, NumericSummary
+
+#: Cache format version, part of every request fingerprint: bumping it
+#: invalidates reuse=True against caches whose build semantics differ
+#: (v2: sketch-based pass 1 — exact/KLL boundary inference replacing
+#: the seeded reservoir sample, shard files written chunk-wise).
+_CACHE_FORMAT = 2
+
+#: Boundary-inference modes of pass 1 (the `boundaries=` argument).
+#: "exact": per-column exact weighted multisets — order-independent,
+#: the mode under which distributed and single-machine builds are
+#: byte-identical; memory is O(distinct values). "sketch": the KLL
+#: compactor with its certified rank-error bound — bounded memory
+#: (O(k·log n) per column), the mode for columns too wide to hold
+#: exactly (docs/binning_pipeline.md "Boundary inference").
+_BOUNDARY_MODES = ("exact", "sketch")
 
 
 def _iter_chunks(
@@ -73,64 +96,193 @@ def _iter_chunks(
                 yield {k: v[s: s + chunk_rows] for k, v in cols.items()}
 
 
-class _NumSketch:
-    """Streaming numerical stats + bounded reservoir for quantiles."""
+def count_csv_rows(path: str) -> int:
+    """Data-row count of one CSV file — the distributed manager's
+    planning pass (a single one-column parse; cheap next to the full
+    ingest the workers then parallelize)."""
+    try:
+        import pandas as pd
+    except ImportError:
+        pd = None
+    if pd is not None:
+        n = 0
+        for df in pd.read_csv(path, usecols=[0], chunksize=1 << 20):
+            n += len(df)
+        return n
+    cols = _read_csv(path)
+    return len(next(iter(cols.values())))
 
-    def __init__(self, cap: int = 200_000, seed: int = 0xB1A5):
-        self.count = 0
-        self.missing = 0
-        self.total = 0.0
-        self.min = np.inf
-        self.max = -np.inf
-        self.cap = cap
-        self.rng = np.random.default_rng(seed)
-        self.sample: List[np.ndarray] = []
-        self.sampled = 0
 
-    def update(self, vals: np.ndarray):
-        vals = np.asarray(vals, np.float64)
-        miss = np.isnan(vals)
-        ok = vals[~miss]
-        self.missing += int(miss.sum())
-        self.count += len(ok)
-        if len(ok) == 0:
-            return
-        self.total += float(ok.sum())
-        self.min = min(self.min, float(ok.min()))
-        self.max = max(self.max, float(ok.max()))
-        # Chunked reservoir: keep each value with prob cap/seen.
-        self.sampled += len(ok)
-        if self.sampled <= self.cap:
-            self.sample.append(ok)
+def plan_chunk_assignments(
+    files: List[str], chunk_rows: int
+) -> List[tuple]:
+    """The full chunk-aligned work list of one cache build, in stream
+    order: [(file_idx, start_row, nrows, global_row), ...] — one entry
+    per chunk that `_iter_chunks` would yield. Distributed worker
+    ranges are split over WHOLE chunks (parallel/dist_cache.py assigns
+    contiguous runs of this list), never mid-chunk: pandas infers
+    dtypes per chunk, so a mid-chunk split could type a worker's
+    sub-chunk differently from the single-machine stream and break the
+    byte-identity contract."""
+    out: List[tuple] = []
+    grow = 0
+    for fi, f in enumerate(files):
+        n = count_csv_rows(f)
+        for start in range(0, n, chunk_rows):
+            k = min(chunk_rows, n - start)
+            out.append((fi, start, k, grow))
+            grow += k
+    return out
+
+
+def _iter_chunk_assignments(
+    files: List[str], assignments: List[tuple]
+) -> Iterator[tuple]:
+    """Streams (global_row, chunk) for an explicit assignment list from
+    plan_chunk_assignments — the distributed workers' chunk reader.
+    Each chunk covers exactly the rows the single-machine stream's
+    corresponding chunk covers, so per-chunk dtype inference (and with
+    it every downstream typing decision) is identical."""
+    try:
+        import pandas as pd
+    except ImportError:
+        pd = None
+    for fi, start, nrows, grow in assignments:
+        f = files[int(fi)]
+        if pd is not None:
+            df = pd.read_csv(
+                f, skiprows=range(1, int(start) + 1), nrows=int(nrows)
+            )
+            yield int(grow), {c: df[c].to_numpy() for c in df.columns}
         else:
-            keep = self.rng.random(len(ok)) < self.cap / self.sampled
-            if keep.any():
-                self.sample.append(ok[keep])
-            # Bound memory: resample down when overfull.
-            tot = sum(len(s) for s in self.sample)
-            if tot > 2 * self.cap:
-                allv = np.concatenate(self.sample)
-                self.sample = [
-                    self.rng.choice(allv, self.cap, replace=False)
-                ]
+            cols = _read_csv(f)
+            yield int(grow), {
+                k: v[int(start): int(start) + int(nrows)]
+                for k, v in cols.items()
+            }
 
-    def column(self, name: str) -> Column:
-        return Column(
-            name=name,
-            type=ColumnType.NUMERICAL,
-            mean=self.total / max(self.count, 1),
-            min_value=float(self.min) if self.count else 0.0,
-            max_value=float(self.max) if self.count else 0.0,
-            num_values=self.count,
-            num_missing=self.missing,
-        )
 
-    def values_sample(self) -> np.ndarray:
-        return (
-            np.concatenate(self.sample)
-            if self.sample
-            else np.zeros((0,), np.float64)
+def _always_categorical(
+    label: str, task: Task, uplift_treatment: Optional[str]
+) -> frozenset:
+    """Columns dictionary-encoded regardless of inferred dtype: the
+    classification label, and treatment groups (index 1 = control, 2 =
+    treated — learners/generic.py convention)."""
+    names = set()
+    if task == Task.CLASSIFICATION:
+        names.add(label)
+    if uplift_treatment is not None:
+        names.add(uplift_treatment)
+    return frozenset(names)
+
+
+def _column_from_summary(name: str, s: NumericSummary) -> Column:
+    return Column(
+        name=name,
+        type=ColumnType.NUMERICAL,
+        mean=s.mean(),
+        min_value=float(s.min) if s.count else 0.0,
+        max_value=float(s.max) if s.count else 0.0,
+        num_values=s.count,
+        num_missing=s.missing,
+    )
+
+
+def _spec_from_partial(
+    partial: IngestPartial,
+    label: str,
+    ranking_group: Optional[str],
+    uplift_treatment: Optional[str],
+    max_vocab_count: int,
+    min_vocab_frequency: int,
+) -> DataSpecification:
+    """Finalizes the merged pass-1 partial into the cache's dataspec —
+    numeric columns from their summaries, categorical vocabularies
+    frequency-sorted and pruned (never for the label / ranking-group /
+    treatment dictionaries, whose merged-into-OOV groups would silently
+    corrupt the task)."""
+    no_prune = {label, ranking_group, uplift_treatment} - {None}
+    cols: List[Column] = []
+    for name in partial.col_order:
+        if name in partial.num:
+            cols.append(_column_from_summary(name, partial.num[name]))
+        else:
+            cnt = partial.cat[name]
+            minf = 1 if name in no_prune else min_vocab_frequency
+            items = sorted(
+                cnt.items(), key=lambda kv: (-kv[1], kv[0])
+            )
+            kept = [
+                (k, v) for k, v in items if v >= max(minf, 1)
+            ]
+            if name not in no_prune and max_vocab_count > 0:
+                kept = kept[:max_vocab_count]
+            oov = sum(cnt.values()) - sum(v for _, v in kept)
+            cols.append(
+                Column(
+                    name=name,
+                    type=ColumnType.CATEGORICAL,
+                    vocabulary=[OOV_ITEM] + [k for k, _ in kept],
+                    vocab_counts=[oov] + [v for _, v in kept],
+                    num_values=sum(cnt.values()),
+                    num_missing=partial.cat_missing.get(name, 0),
+                )
+            )
+    return DataSpecification(
+        columns=cols, created_num_rows=partial.num_rows
+    )
+
+
+def _default_feature_names(
+    spec: DataSpecification,
+    label: str,
+    weights: Optional[str],
+    extra_cols: List[str],
+) -> List[str]:
+    return [
+        c.name
+        for c in spec.columns
+        if c.name not in ({label, weights} | set(extra_cols))
+        and c.type
+        in (
+            ColumnType.NUMERICAL,
+            ColumnType.BOOLEAN,
+            ColumnType.CATEGORICAL,
         )
+    ]
+
+
+def _fit_binner_from_partial(
+    spec: DataSpecification,
+    feature_names: List[str],
+    num_bins,
+    partial: IngestPartial,
+) -> Binner:
+    """Binner from the merged pass-1 partial. "auto" resolves against
+    the TRUE row count (not a sample size) with the same rule as
+    in-memory training — including the categorical-vocab floor — so a
+    model trained from this cache equals one trained from the
+    equivalent in-memory dataset (tests/test_dataset_cache.py
+    composition assertions)."""
+    from ydf_tpu.config import resolve_num_bins
+
+    max_vocab = max(
+        (
+            spec.column_by_name(f).vocab_size
+            for f in feature_names
+            if spec.column_by_name(f).type == ColumnType.CATEGORICAL
+        ),
+        default=0,
+    )
+    nb = resolve_num_bins(
+        num_bins, partial.num_rows, min_cat_vocab=max_vocab
+    )
+    summaries = {
+        f: partial.num.get(f)
+        or NumericSummary(mode=partial.mode, k=partial.sketch_k)
+        for f in feature_names
+    }
+    return Binner.fit_from_summaries(spec, feature_names, nb, summaries)
 
 
 class CacheCorruptionError(RuntimeError):
@@ -653,6 +805,350 @@ class DatasetCache:
         return list(col.vocabulary[1:])  # drop OOV, like Dataset
 
 
+def _npy_data_offset(path: str) -> int:
+    """Byte offset of the data region of an .npy file (header skip) —
+    the distributed manager needs it to map a worker's reported
+    row-range crc onto an absolute byte range of the file."""
+    with open(path, "rb") as f:
+        head = f.read(12)
+        if len(head) < 10 or head[:6] != b"\x93NUMPY":
+            raise CacheCorruptionError(
+                f"{os.path.basename(path)!r} is not an npy file"
+            )
+        if head[6] >= 2:
+            return 12 + int.from_bytes(head[8:12], "little")
+        return 10 + int.from_bytes(head[8:10], "little")
+
+
+class _CacheWriters:
+    """The pass-2 write surface of a cache build: the full bins /
+    labels / weights / extra / raw memmaps plus every feature- and
+    row-shard file, created up front (mode "w+" — the single-machine
+    builder and the distributed manager's pre-create) or attached
+    (mode "r+" — the distributed bin workers filling their row ranges
+    of the SAME files). Every shard file is filled chunk-wise in the
+    same pass as bins.npy, so the builder never re-reads the bin
+    matrix, and the single-machine and distributed paths produce
+    identical bytes by running identical writes against identical
+    (manager-created) npy headers.
+
+    With `track_crc=True` every write accumulates per-file rolling
+    crc32 segments over the bytes written, in write order — the
+    worker's receipt: the manager re-reads each reported byte range
+    from disk and verifies it before committing the cache, so a torn
+    or corrupted shard write is re-binned, never published
+    (docs/distributed_training.md "Distributed cache build")."""
+
+    def __init__(
+        self,
+        cache_dir: str,
+        spec: DataSpecification,
+        binner: Binner,
+        num_rows: int,
+        label: str,
+        weights: Optional[str],
+        extra_cols: List[str],
+        store_raw: bool,
+        feature_shards: int,
+        row_shards: int,
+        mode: str = "w+",
+        track_crc: bool = False,
+    ):
+        self.cache_dir = cache_dir
+        self.spec = spec
+        self.binner = binner
+        self.num_rows = int(num_rows)
+        self.label = label
+        self.weights = weights
+        self.extra_cols = list(extra_cols)
+        self.F = binner.num_scalar
+
+        def _mm(name, dtype, shape):
+            p = os.path.join(cache_dir, name)
+            if mode == "w+":
+                return np.lib.format.open_memmap(
+                    p, mode="w+", dtype=dtype, shape=shape
+                )
+            return np.lib.format.open_memmap(p, mode="r+")
+
+        self.bins = _mm("bins.npy", np.uint8, (self.num_rows, self.F))
+        label_col = spec.column_by_name(label)
+        self.label_task = (
+            Task.CLASSIFICATION
+            if label_col.type == ColumnType.CATEGORICAL
+            else Task.REGRESSION
+        )
+        label_dtype = (
+            np.int32
+            if label_col.type == ColumnType.CATEGORICAL
+            else np.float32
+        )
+        self.labels = _mm("labels.npy", label_dtype, (self.num_rows,))
+        self.weights_mm = (
+            _mm("weights.npy", np.float32, (self.num_rows,))
+            if weights is not None
+            else None
+        )
+        self.extra: Dict[str, np.ndarray] = {}
+        for name in self.extra_cols:
+            col = spec.column_by_name(name)
+            dt = (
+                np.int32
+                if col.type == ColumnType.CATEGORICAL
+                else np.float64
+            )
+            self.extra[name] = _mm(
+                f"col_{name}.npy", dt, (self.num_rows,)
+            )
+        self.raw = None
+        if store_raw and binner.num_numerical > 0:
+            self.raw = _mm(
+                "raw_numerical.npy", np.float32,
+                (self.num_rows, binner.num_numerical),
+            )
+        self.col_ranges = (
+            shard_col_ranges(self.F, int(feature_shards))
+            if feature_shards
+            else []
+        )
+        self.row_ranges = (
+            row_shard_ranges(self.num_rows, int(row_shards))
+            if row_shards
+            else []
+        )
+        self.shard_mms = [
+            _mm(_shard_file(k), np.uint8, (self.num_rows, hi - lo))
+            for k, (lo, hi) in enumerate(self.col_ranges)
+        ]
+        self.row_mms = [
+            _mm(_row_shard_file(k), np.uint8, (hi - lo, self.F))
+            for k, (lo, hi) in enumerate(self.row_ranges)
+        ]
+        #: name → [{"start", "nbytes", "crc"}] byte segments relative
+        #: to the file's DATA region, in write order.
+        self._crc: Optional[Dict[str, List[Dict[str, int]]]] = (
+            {} if track_crc else None
+        )
+
+    def data_files(self) -> List[str]:
+        out = ["bins.npy", "labels.npy"]
+        if self.weights_mm is not None:
+            out.append("weights.npy")
+        out += [f"col_{name}.npy" for name in self.extra_cols]
+        if self.raw is not None:
+            out.append("raw_numerical.npy")
+        out += [_shard_file(k) for k in range(len(self.col_ranges))]
+        out += [_row_shard_file(k) for k in range(len(self.row_ranges))]
+        return out
+
+    def _crc_add(self, name: str, start: int, arr: np.ndarray) -> None:
+        if self._crc is None:
+            return
+        b = np.ascontiguousarray(arr).tobytes()
+        segs = self._crc.setdefault(name, [])
+        if segs and segs[-1]["start"] + segs[-1]["nbytes"] == start:
+            segs[-1]["crc"] = zlib.crc32(b, segs[-1]["crc"])
+            segs[-1]["nbytes"] += len(b)
+        else:
+            segs.append(
+                {"start": int(start), "nbytes": len(b),
+                 "crc": zlib.crc32(b)}
+            )
+
+    def crc_report(self) -> Dict[str, List[Dict[str, int]]]:
+        return self._crc or {}
+
+    def write_chunk(self, row: int, chunk: Dict[str, np.ndarray]) -> int:
+        """Bins one chunk into rows [row, row+k) of every target file.
+        Returns the transient bytes this chunk cost (the per-process
+        build-memory accounting: chunk columns + the uint8 chunk bin
+        block — RSS stays O(chunk) regardless of cache size)."""
+        ds = Dataset(chunk, self.spec)
+        k = ds.num_rows
+        cb = np.empty((k, self.F), np.uint8)
+        self.binner.transform(ds, out=cb)
+        self.bins[row: row + k] = cb
+        self._crc_add("bins.npy", row * self.F, cb)
+        lv = np.asarray(
+            ds.encoded_label(self.label, self.label_task),
+            self.labels.dtype,
+        )
+        self.labels[row: row + k] = lv
+        self._crc_add("labels.npy", row * lv.itemsize, lv)
+        transient = cb.nbytes + sum(
+            np.asarray(v).nbytes for v in chunk.values()
+        )
+        if self.weights_mm is not None:
+            wv = np.asarray(chunk[self.weights], np.float32)
+            self.weights_mm[row: row + k] = wv
+            self._crc_add("weights.npy", row * 4, wv)
+        for name, mm in self.extra.items():
+            if mm.dtype == np.int32:
+                ev = np.asarray(ds.encoded_categorical(name), np.int32)
+            else:
+                ev = np.asarray(chunk[name], np.float64)
+            mm[row: row + k] = ev
+            self._crc_add(f"col_{name}.npy", row * ev.itemsize, ev)
+        if self.raw is not None:
+            Fn = self.binner.num_numerical
+            rb = np.empty((k, Fn), np.float32)
+            for i, fname in enumerate(self.binner.feature_names[:Fn]):
+                rb[:, i] = (
+                    ds.encoded_numerical(fname)
+                    if fname in ds.data
+                    else self.binner.impute_values[i]
+                )
+            self.raw[row: row + k] = rb
+            self._crc_add("raw_numerical.npy", row * Fn * 4, rb)
+            transient += rb.nbytes
+        for s, (lo, hi) in enumerate(self.col_ranges):
+            seg = np.ascontiguousarray(cb[:, lo:hi])
+            self.shard_mms[s][row: row + k] = seg
+            self._crc_add(_shard_file(s), row * (hi - lo), seg)
+        for s, (lo, hi) in enumerate(self.row_ranges):
+            olo, ohi = max(lo, row), min(hi, row + k)
+            if olo < ohi:
+                seg = cb[olo - row: ohi - row]
+                self.row_mms[s][olo - lo: ohi - lo] = seg
+                self._crc_add(
+                    _row_shard_file(s), (olo - lo) * self.F, seg
+                )
+        return transient
+
+    def flush(self) -> None:
+        for mm in (
+            [self.bins, self.labels]
+            + ([self.weights_mm] if self.weights_mm is not None else [])
+            + list(self.extra.values())
+            + ([self.raw] if self.raw is not None else [])
+            + self.shard_mms
+            + self.row_mms
+        ):
+            mm.flush()
+
+    def close(self) -> None:
+        self.flush()
+        self.bins = self.labels = self.weights_mm = self.raw = None
+        self.extra = {}
+        self.shard_mms = []
+        self.row_mms = []
+
+
+def _request_fingerprint(
+    files: List[str],
+    label: str,
+    task: Task,
+    weights,
+    features,
+    num_bins,
+    chunk_rows: int,
+    max_vocab_count: int,
+    min_vocab_frequency: int,
+    ranking_group,
+    uplift_treatment,
+    label_event_observed,
+    label_entry_age,
+    store_raw_numerical: bool,
+    feature_shards: int,
+    row_shards: int,
+    boundaries: str,
+    sketch_k: int,
+) -> str:
+    """The reuse=True identity of a cache build: (source content proxy,
+    requested config, format version). Shared verbatim by the single-
+    machine and distributed builders so a distributed build can reuse a
+    single-machine cache and vice versa. The shard layout is an
+    UNCONDITIONAL part of the tuple: a reused cache missing requested
+    shard files (or carrying a different sharding) is a mismatch, never
+    a hit (tests/test_dataset_cache.py shard-layout regression). File
+    identity is (basename, size, mtime_ns) — the usual cheap content
+    proxy."""
+    src = sorted(
+        (os.path.basename(p), os.path.getsize(p),
+         os.stat(p).st_mtime_ns)
+        for p in files
+    )
+    return hashlib.sha1(
+        repr((
+            _CACHE_FORMAT, src, label, task.value, weights, features,
+            num_bins, chunk_rows, max_vocab_count, min_vocab_frequency,
+            ranking_group, uplift_treatment, label_event_observed,
+            label_entry_age, store_raw_numerical,
+            ("shards", int(feature_shards), int(row_shards)),
+            boundaries,
+            sketch_k if boundaries == "sketch" else None,
+        )).encode()
+    ).hexdigest()
+
+
+def _publish_meta(
+    cache_dir: str,
+    spec: DataSpecification,
+    binner: Binner,
+    num_rows: int,
+    label: str,
+    weights: Optional[str],
+    extra_cols: List[str],
+    store_raw: bool,
+    feature_shards: int,
+    row_shards: int,
+    source: str,
+    request_fp: Optional[str],
+    boundaries: str,
+    data_files: List[str],
+    build: Optional[Dict] = None,
+) -> DatasetCache:
+    """Finalize: integrity metadata + atomic publish. The metadata is
+    the cache's COMMIT RECORD: it is written LAST, fsync-before-rename
+    (same durability recipe as utils/snapshot.py), so a crash anywhere
+    earlier — including a distributed manager dying between the ingest
+    and bin phases — leaves a cache that *fails to open* instead of one
+    that trains on half-written memmaps; reuse=True then rebuilds.
+    `build` carries optional build provenance (distributed worker
+    count, measured sketch error) — the ONLY meta key on which a
+    distributed exact-mode build may differ from the single-machine
+    one."""
+    integrity = {
+        "algo": "crc32",
+        "block_bytes": _CRC_BLOCK,
+        "files": {
+            name: _file_integrity(os.path.join(cache_dir, name))
+            for name in data_files
+        },
+    }
+    if telemetry.ENABLED:
+        telemetry.counter("ydf_cache_builds_total").inc()
+        telemetry.counter("ydf_cache_bytes_written_total").inc(
+            sum(rec["size"] for rec in integrity["files"].values())
+        )
+    failpoints.hit("cache.finalize")
+    from ydf_tpu.utils.snapshot import _durable_replace
+
+    meta = {
+        "dataspec": spec.to_json(),
+        "binner": binner.to_json(),
+        "num_rows": num_rows,
+        "label": label,
+        "weights": weights,
+        "extra_columns": extra_cols,
+        "store_raw_numerical": bool(store_raw),
+        "feature_shards": int(feature_shards),
+        "row_shards": int(row_shards),
+        "source": source,
+        "integrity": integrity,
+        "request_fingerprint": request_fp,
+        "boundaries": boundaries,
+    }
+    if build is not None:
+        meta["build"] = build
+    meta_path = os.path.join(cache_dir, "cache_meta.json")
+    tmp = meta_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    _durable_replace(tmp, meta_path)
+    return DatasetCache(cache_dir)
+
+
 def create_dataset_cache(
     data_path,
     cache_dir: str,
@@ -672,6 +1168,8 @@ def create_dataset_cache(
     reuse: bool = False,
     feature_shards: int = 0,
     row_shards: int = 0,
+    boundaries: str = "exact",
+    sketch_k: int = 4096,
 ) -> DatasetCache:
     """Builds an on-disk binned cache from (sharded) CSV input, or from
     an in-memory columnar frame (pandas / polars DataFrame or dict of
@@ -713,7 +1211,18 @@ def create_dataset_cache(
     footprint is its slice, ~1/N of the bin matrix. Both shardings may
     coexist on one cache: `row_shards=R, feature_shards=C` is the
     hybrid row×feature layout (R row groups × C column groups; hybrid
-    workers stream a row slice and keep only their column range)."""
+    workers stream a row slice and keep only their column range).
+
+    `boundaries=` selects pass 1's boundary-inference mode (module
+    constant _BOUNDARY_MODES): "exact" (default) keeps per-column exact
+    weighted value multisets — fully order-independent, the mode under
+    which a distributed build (parallel/dist_cache.py
+    create_dataset_cache_distributed) is byte-identical to this
+    single-machine one; "sketch" bounds pass-1 memory to O(sketch_k ·
+    log n) per column via the KLL compactor (dataset/sketch.py) with a
+    certified rank-error bound. Both feed the same
+    Binner.boundaries_from_sketch seam, so boundary → bin semantics
+    never fork."""
     if isinstance(data_path, str):
         fmt, _ = _split_typed_path(data_path)
         if fmt != "csv":
@@ -739,28 +1248,22 @@ def create_dataset_cache(
     row_shards = int(row_shards)
     if row_shards < 0:
         raise ValueError(f"row_shards must be >= 0, got {row_shards}")
+    if boundaries not in _BOUNDARY_MODES:
+        raise ValueError(
+            f"boundaries mode {boundaries!r} is not one of "
+            f"{list(_BOUNDARY_MODES)}"
+        )
     os.makedirs(cache_dir, exist_ok=True)
 
-    # Request fingerprint: identifies (source content proxy, requested
-    # config) so a reuse can never hand back a cache built from other
-    # data or another binning/vocab policy. File identity is
-    # (basename, size, mtime_ns) — the usual cheap content proxy.
     request_fp = None
     if files is not None:
-        src = sorted(
-            (os.path.basename(p), os.path.getsize(p),
-             os.stat(p).st_mtime_ns)
-            for p in files
+        request_fp = _request_fingerprint(
+            files, label, task, weights, features, num_bins,
+            chunk_rows, max_vocab_count, min_vocab_frequency,
+            ranking_group, uplift_treatment, label_event_observed,
+            label_entry_age, store_raw_numerical, feature_shards,
+            row_shards, boundaries, sketch_k,
         )
-        request_fp = hashlib.sha1(
-            repr((
-                src, label, task.value, weights, features, num_bins,
-                chunk_rows, max_vocab_count, min_vocab_frequency,
-                ranking_group, uplift_treatment, label_event_observed,
-                label_entry_age, store_raw_numerical,
-            ) + ((feature_shards,) if feature_shards else ())
-              + (("rows", row_shards) if row_shards else ())).encode()
-        ).hexdigest()
     if reuse and request_fp is not None:
         existing = _try_reuse_cache(cache_dir, request_fp)
         if existing is not None:
@@ -771,22 +1274,6 @@ def create_dataset_cache(
             return _iter_frame(None, chunk_rows)
         return _iter_chunks(files, chunk_rows)
 
-    # ---- pass 1: streaming dataspec -------------------------------- #
-    num_sketch: Dict[str, _NumSketch] = {}
-    cat_counts: Dict[str, Dict[str, int]] = {}
-    cat_missing: Dict[str, int] = {}
-    col_order: List[str] = []
-    num_rows = 0
-
-    def _count_categorical(name: str, vals: np.ndarray) -> None:
-        cnt = cat_counts.setdefault(name, {})
-        sv = vals.astype(str)
-        miss = (sv == "") | (sv == "nan")
-        cat_missing[name] = cat_missing.get(name, 0) + int(miss.sum())
-        uniq, c = np.unique(sv[~miss], return_counts=True)
-        for u, k in zip(uniq.tolist(), c.tolist()):
-            cnt[u] = cnt.get(u, 0) + k
-
     extra_cols = [
         c
         for c in (
@@ -795,30 +1282,15 @@ def create_dataset_cache(
         )
         if c is not None
     ]
-    # Dictionary-encoded special columns keep their full vocabulary: a
-    # pruned ranking-group or treatment dictionary would silently merge
-    # groups/arms into OOV.
-    no_prune = {label, ranking_group, uplift_treatment} - {None}
 
+    # ---- pass 1: streaming mergeable dataspec stats ----------------- #
+    # The 1-partial instance of the distributed ingest: the same
+    # IngestPartial the cache_ingest_stats workers build over their row
+    # ranges, fed the whole stream.
+    partial = IngestPartial(mode=boundaries, sketch_k=sketch_k)
+    always_cat = _always_categorical(label, task, uplift_treatment)
     for chunk in _chunks():
-        if not col_order:
-            col_order = list(chunk.keys())
-        num_rows += len(next(iter(chunk.values())))
-        for name, vals in chunk.items():
-            vals = np.asarray(vals)
-            numeric_chunk = (
-                vals.dtype.kind in "fiub"
-                and (name != label or task != Task.CLASSIFICATION)
-                # Treatment groups are always dictionary-encoded (index 1 =
-                # control, 2 = treated — learners/generic.py convention).
-                and name != uplift_treatment
-            )
-            if numeric_chunk and name not in cat_counts:
-                num_sketch.setdefault(name, _NumSketch()).update(
-                    vals.astype(np.float64)
-                )
-            else:
-                _count_categorical(name, vals)
+        partial.observe_chunk(chunk, always_cat)
 
     # A column can be inferred numeric on one chunk and object on another
     # (pandas types each chunk independently). One type per column is
@@ -827,272 +1299,46 @@ def create_dataset_cache(
     # favor of a targeted string recount over the affected columns only —
     # otherwise the numeric chunks' values would be silently coerced to
     # NaN in pass 2.
-    mixed = [n for n in col_order if n in num_sketch and n in cat_counts]
+    mixed = partial.mixed_columns()
     if mixed:
-        for name in mixed:
-            del num_sketch[name]
-            cat_counts[name] = {}
-            cat_missing[name] = 0
+        partial.begin_recount(mixed)
         for chunk in _chunks():
-            for name in mixed:
-                if name in chunk:
-                    _count_categorical(name, np.asarray(chunk[name]))
+            partial.observe_recount(chunk, mixed)
 
-    cols: List[Column] = []
-    for name in col_order:
-        if name in num_sketch:
-            cols.append(num_sketch[name].column(name))
-        else:
-            cnt = cat_counts[name]
-            minf = 1 if name in no_prune else min_vocab_frequency
-            items = sorted(
-                cnt.items(), key=lambda kv: (-kv[1], kv[0])
-            )
-            kept = [
-                (k, v) for k, v in items if v >= max(minf, 1)
-            ]
-            if name not in no_prune and max_vocab_count > 0:
-                kept = kept[:max_vocab_count]
-            oov = sum(cnt.values()) - sum(v for _, v in kept)
-            cols.append(
-                Column(
-                    name=name,
-                    type=ColumnType.CATEGORICAL,
-                    vocabulary=[OOV_ITEM] + [k for k, _ in kept],
-                    vocab_counts=[oov] + [v for _, v in kept],
-                    num_values=sum(cnt.values()),
-                    num_missing=cat_missing.get(name, 0),
-                )
-            )
-    spec = DataSpecification(columns=cols, created_num_rows=num_rows)
-
-    # ---- fit the binner on the quantile sketch ---------------------- #
-    feature_names = features or [
-        c.name
-        for c in cols
-        if c.name not in ({label, weights} | set(extra_cols))
-        and c.type
-        in (
-            ColumnType.NUMERICAL,
-            ColumnType.BOOLEAN,
-            ColumnType.CATEGORICAL,
-        )
-    ]
-    sample_data: Dict[str, np.ndarray] = {}
-    for name in feature_names:
-        if name in num_sketch:
-            s = num_sketch[name].values_sample().astype(np.float32)
-            sample_data[name] = s
-    # Build a small surrogate dataset carrying the samples (padded to one
-    # length) purely to reuse Binner.fit's quantile logic.
-    slen = max((len(v) for v in sample_data.values()), default=1)
-    surrogate = {}
-    for name in feature_names:
-        col = spec.column_by_name(name)
-        if name in sample_data and len(sample_data[name]):
-            v = sample_data[name]
-            surrogate[name] = np.resize(v, slen)
-        elif col.type == ColumnType.CATEGORICAL:
-            surrogate[name] = np.full((slen,), OOV_ITEM, object)
-        else:
-            surrogate[name] = np.zeros((slen,), np.float32)
-    # "auto" resolves against the TRUE row count (not the sketch-sample
-    # size) with the same rule as in-memory training — including the
-    # categorical-vocab floor — so a model trained from this cache
-    # equals one trained from the equivalent in-memory dataset
-    # (tests/test_dataset_cache.py composition assertions).
-    from ydf_tpu.config import resolve_num_bins
-
-    max_vocab = max(
-        (
-            spec.column_by_name(f).vocab_size
-            for f in feature_names
-            if spec.column_by_name(f).type == ColumnType.CATEGORICAL
-        ),
-        default=0,
-    )
-    binner = Binner.fit(
-        Dataset(surrogate, spec), feature_names,
-        num_bins=resolve_num_bins(
-            num_bins, num_rows, min_cat_vocab=max_vocab
-        ),
+    num_rows = partial.num_rows
+    spec = _spec_from_partial(
+        partial, label, ranking_group, uplift_treatment,
+        max_vocab_count, min_vocab_frequency,
     )
 
-    # ---- pass 2: bin chunks into the memmap ------------------------- #
-    F = binner.num_scalar
-    bins_mm = np.lib.format.open_memmap(
-        os.path.join(cache_dir, "bins.npy"),
-        mode="w+",
-        dtype=np.uint8,
-        shape=(num_rows, F),
+    # ---- fit the binner on the merged summaries --------------------- #
+    feature_names = features or _default_feature_names(
+        spec, label, weights, extra_cols
     )
-    label_col = spec.column_by_name(label)
-    label_dtype = (
-        np.int32 if label_col.type == ColumnType.CATEGORICAL else np.float32
+    binner = _fit_binner_from_partial(
+        spec, feature_names, num_bins, partial
     )
-    labels_mm = np.lib.format.open_memmap(
-        os.path.join(cache_dir, "labels.npy"),
-        mode="w+",
-        dtype=label_dtype,
-        shape=(num_rows,),
+
+    # ---- pass 2: bin chunks into the memmaps ------------------------ #
+    # One streaming pass fills bins.npy AND every shard file chunk-wise
+    # (_CacheWriters — the write surface shared with the distributed
+    # bin workers); RSS stays O(chunk).
+    writers = _CacheWriters(
+        cache_dir, spec, binner, num_rows, label, weights, extra_cols,
+        store_raw_numerical, feature_shards, row_shards, mode="w+",
     )
-    weights_mm = None
-    if weights is not None:
-        weights_mm = np.lib.format.open_memmap(
-            os.path.join(cache_dir, "weights.npy"),
-            mode="w+",
-            dtype=np.float32,
-            shape=(num_rows,),
-        )
-    extra_mm: Dict[str, np.ndarray] = {}
-    for name in extra_cols:
-        col = spec.column_by_name(name)
-        extra_mm[name] = np.lib.format.open_memmap(
-            os.path.join(cache_dir, f"col_{name}.npy"),
-            mode="w+",
-            dtype=(
-                np.int32
-                if col.type == ColumnType.CATEGORICAL
-                else np.float64
-            ),
-            shape=(num_rows,),
-        )
-    raw_mm = None
-    if store_raw_numerical and binner.num_numerical > 0:
-        raw_mm = np.lib.format.open_memmap(
-            os.path.join(cache_dir, "raw_numerical.npy"),
-            mode="w+",
-            dtype=np.float32,
-            shape=(num_rows, binner.num_numerical),
-        )
     row = 0
-    label_task = (
-        Task.CLASSIFICATION
-        if label_col.type == ColumnType.CATEGORICAL
-        else Task.REGRESSION
-    )
     for chunk in _chunks():
         failpoints.hit("cache.write_chunk")
-        ds = Dataset(chunk, spec)
-        k = ds.num_rows
-        # Fused ingest: each chunk is binned (native kernel when built)
-        # straight into its memmap slice — no intermediate [k, F] copy,
-        # and no full-f32 materialization of the chunk's columns.
-        binner.transform(ds, out=bins_mm[row: row + k])
-        labels_mm[row: row + k] = ds.encoded_label(label, label_task)
-        if weights_mm is not None:
-            weights_mm[row: row + k] = np.asarray(
-                chunk[weights], np.float32
-            )
-        for name, mm in extra_mm.items():
-            if mm.dtype == np.int32:
-                mm[row: row + k] = ds.encoded_categorical(name)
-            else:
-                mm[row: row + k] = np.asarray(chunk[name], np.float64)
-        if raw_mm is not None:
-            for i, fname in enumerate(
-                binner.feature_names[: binner.num_numerical]
-            ):
-                raw_mm[row: row + k, i] = (
-                    ds.encoded_numerical(fname)
-                    if fname in ds.data
-                    else binner.impute_values[i]
-                )
-        row += k
-    bins_mm.flush()
-    labels_mm.flush()
-    if weights_mm is not None:
-        weights_mm.flush()
-    for mm in extra_mm.values():
-        mm.flush()
-    if raw_mm is not None:
-        raw_mm.flush()
+        writers.write_chunk(row, chunk)
+        row += len(next(iter(chunk.values())))
+    data_files = writers.data_files()
+    writers.close()
 
-    # ---- feature shards: the distributed-GBT column slices ---------- #
-    shard_files: List[str] = []
-    if feature_shards:
-        for k, (lo, hi) in enumerate(
-            shard_col_ranges(F, int(feature_shards))
-        ):
-            sm = np.lib.format.open_memmap(
-                os.path.join(cache_dir, _shard_file(k)), mode="w+",
-                dtype=np.uint8, shape=(num_rows, hi - lo),
-            )
-            # Row-block streaming keeps RSS at O(block) — the slice
-            # never materializes in host RAM.
-            step = max(1, (64 << 20) // max(hi - lo, 1))
-            for r in range(0, num_rows, step):
-                sm[r: r + step] = bins_mm[r: r + step, lo:hi]
-            sm.flush()
-            del sm
-            shard_files.append(_shard_file(k))
-    if row_shards:
-        # Row-parallel slices: bins[lo:hi, :] per row_shard_ranges —
-        # written by row-block streaming like the column shards.
-        for k, (lo, hi) in enumerate(
-            row_shard_ranges(num_rows, int(row_shards))
-        ):
-            rm = np.lib.format.open_memmap(
-                os.path.join(cache_dir, _row_shard_file(k)), mode="w+",
-                dtype=np.uint8, shape=(hi - lo, F),
-            )
-            step = max(1, (64 << 20) // max(F, 1))
-            for r in range(lo, hi, step):
-                rm[r - lo: min(r + step, hi) - lo] = bins_mm[
-                    r: min(r + step, hi)
-                ]
-            rm.flush()
-            del rm
-            shard_files.append(_row_shard_file(k))
-
-    # ---- finalize: integrity metadata + atomic publish -------------- #
-    # The metadata is the cache's commit record: it is written LAST,
-    # fsync-before-rename (same durability recipe as utils/snapshot.py),
-    # so a crash anywhere in pass 1/2 leaves a cache that *fails to
-    # open* instead of one that trains on half-written memmaps.
-    data_files = ["bins.npy", "labels.npy"]
-    if weights_mm is not None:
-        data_files.append("weights.npy")
-    data_files += [f"col_{name}.npy" for name in extra_mm]
-    if raw_mm is not None:
-        data_files.append("raw_numerical.npy")
-    data_files += shard_files
-    integrity = {
-        "algo": "crc32",
-        "block_bytes": _CRC_BLOCK,
-        "files": {
-            name: _file_integrity(os.path.join(cache_dir, name))
-            for name in data_files
-        },
-    }
-    if telemetry.ENABLED:
-        telemetry.counter("ydf_cache_builds_total").inc()
-        telemetry.counter("ydf_cache_bytes_written_total").inc(
-            sum(rec["size"] for rec in integrity["files"].values())
-        )
-    failpoints.hit("cache.finalize")
-    from ydf_tpu.utils.snapshot import _durable_replace
-
-    meta_path = os.path.join(cache_dir, "cache_meta.json")
-    tmp = meta_path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(
-            {
-                "dataspec": spec.to_json(),
-                "binner": binner.to_json(),
-                "num_rows": num_rows,
-                "label": label,
-                "weights": weights,
-                "extra_columns": extra_cols,
-                "store_raw_numerical": bool(raw_mm is not None),
-                "feature_shards": int(feature_shards),
-                "row_shards": int(row_shards),
-                "source": data_path if isinstance(data_path, str) else
-                "<in-memory frame>",
-                "integrity": integrity,
-                "request_fingerprint": request_fp,
-            },
-            f,
-        )
-    _durable_replace(tmp, meta_path)
-    return DatasetCache(cache_dir)
+    return _publish_meta(
+        cache_dir, spec, binner, num_rows, label, weights, extra_cols,
+        store_raw_numerical and binner.num_numerical > 0,
+        feature_shards, row_shards,
+        data_path if isinstance(data_path, str) else "<in-memory frame>",
+        request_fp, boundaries, data_files,
+    )

@@ -1,0 +1,452 @@
+"""Distributed dataset-cache creation (manager half).
+
+Counterpart of the reference's dedicated cache-creation workers
+(`ydf/learner/distributed_decision_tree/dataset_cache/` — PAPER.md L4:
+the dataset cache is built BY a worker fleet before training ever
+starts), layered on this repo's hardened worker substrate: the pooled
+pipelined transport, retry/quarantine, manager-epoch fencing and
+failpoints machinery of parallel/dist_gbt.py are reused unchanged.
+
+Protocol — two phases over one chunk-aligned plan:
+
+  plan        The manager prices the work ONCE: `plan_chunk_assignments`
+              lists every chunk the single-machine stream would read,
+              in stream order. Each chunk is one merge/work UNIT;
+              workers own contiguous runs of units. Chunk alignment is
+              load-bearing: pandas infers dtypes per chunk, so a
+              mid-chunk split could type a worker's rows differently
+              from the single-machine stream and break byte-identity.
+
+  ingest      `cache_ingest_stats`: each worker streams its units and
+              returns one mergeable IngestPartial PER UNIT
+              (dataset/sketch.py — exact value multisets or the KLL
+              compactor, per `boundaries=`). The manager merges ALL
+              units in ascending uid order — a fixed order over units,
+              not workers, so the finalized dataspec/vocabularies/
+              boundaries are invariant to worker count AND to failover
+              regrouping. Mixed-type columns trigger the same targeted
+              categorical recount as the single-machine pass, as a
+              second ingest round.
+
+  bin         The manager finalizes the dataspec + Binner (the exact
+              helpers the single-machine builder uses), pre-creates
+              every output file's npy header (the workers' write
+              surface), and fans out `cache_bin_rows`: workers bin
+              their units through the native kernel and write their
+              rows of bins.npy and every feature-/row-shard file in
+              place (shared filesystem), returning per-file crc32
+              receipts over exactly the byte ranges written. The
+              manager re-reads and verifies every receipt from disk;
+              a mismatching range is re-binned once
+              (ydf_dist_cache_rebins_total) before the build fails.
+
+  commit      `cache_meta.json` is written LAST, fsync-before-rename
+              (_publish_meta — the same commit record as the
+              single-machine build, plus a "build" provenance key). A
+              manager that dies between any phases leaves a cache that
+              FAILS TO OPEN; `reuse=True` detects it and rebuilds.
+
+Contracts (docs/distributed_training.md "Distributed cache build"):
+
+  * boundaries="exact": the distributed cache is BYTE-IDENTICAL to the
+    single-machine `create_dataset_cache` output (meta modulo the
+    "build" key) — identical chunk reads, identical order-independent
+    statistics, identical Binner, identical writes against identical
+    manager-created headers. All downstream bit-identity proofs
+    compose through it.
+  * boundaries="sketch": pass-1 memory is O(sketch_k · log n) per
+    column; the published "build" key records the certified
+    max_rank_error_bound actually reached.
+  * Memory: every worker reports its peak transient build bytes
+    (chunk columns + chunk bin block); the manager publishes the fleet
+    max as the `dist_cache_build` MemoryLedger row — per-process build
+    memory stays ~1/N of the bin matrix instead of all of it.
+
+Failure model: a worker lost mid-phase is quarantined and its units
+move to the next healthy worker (`_handle_failure` — no state to
+re-ship, the verbs are self-contained); unit writes are deterministic,
+so a straggler's duplicate write is byte-identical, never corrupting.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+import zlib
+from typing import Any, Dict, List, Optional
+
+from ydf_tpu.config import Task
+from ydf_tpu.dataset.cache import (
+    CacheCorruptionError,
+    DatasetCache,
+    _always_categorical,
+    _BOUNDARY_MODES,
+    _CacheWriters,
+    _default_feature_names,
+    _fit_binner_from_partial,
+    _npy_data_offset,
+    _publish_meta,
+    _request_fingerprint,
+    _spec_from_partial,
+    _try_reuse_cache,
+    plan_chunk_assignments,
+)
+from ydf_tpu.dataset.dataset import _resolve_typed_path, _split_typed_path
+from ydf_tpu.dataset.sketch import IngestPartial
+from ydf_tpu.parallel.dist_gbt import (
+    DistGBTManager,
+    DistributedTrainingError,
+    _DistStats,
+    _RPC_TIMEOUT_S,
+)
+from ydf_tpu.utils import telemetry
+
+__all__ = ["create_dataset_cache_distributed"]
+
+
+class _DistCacheManager(DistGBTManager):
+    """Drives one distributed cache build over a WorkerPool. Reuses the
+    training manager's RPC plumbing (_stamp/_request/_fan_out/_exchange,
+    retry/quarantine, epoch fencing) wholesale; "shard ids" are the
+    plan's chunk-unit ids."""
+
+    def __init__(self, pool, rpc_timeout_s: Optional[float] = None):
+        # Deliberately NOT calling super().__init__ (the
+        # RowDistGBTManager idiom): it requires a trained-cache shard
+        # layout. The reused RPC plumbing only needs the fields here.
+        self.pool = pool
+        self.stats = _DistStats()
+        self.rpc_timeout_s = (
+            _RPC_TIMEOUT_S if rpc_timeout_s is None else rpc_timeout_s
+        )
+        #: Fresh builds always run at epoch 1 under a unique run key —
+        #: the fence exists to reject a ZOMBIE manager's delayed
+        #: frames, not to sequence resumed builds (a cache build is
+        #: rebuilt, never resumed: the commit record is all-or-nothing).
+        self.epoch = 1
+        self.key_id = f"distcache-{uuid.uuid4().hex[:12]}"
+        self.owner: List[int] = []
+
+    def _handle_failure(self, widx: int, sids: List[int]) -> None:
+        """Transport failure / straggler timeout on `widx`: quarantine
+        it and move its units to the next healthy worker. Unlike the
+        training managers there is no state to re-ship — the build
+        verbs re-read their chunks from the source files."""
+        self.pool.mark_failed(widx)
+        self.stats.recoveries += 1
+        if telemetry.ENABLED:
+            telemetry.counter("ydf_dist_recoveries_total").inc()
+            self._drain_worker_telemetry([widx], timeout_s=5.0)
+        new_w = self._pick_replacement(widx + 1)
+        for sid in sids:
+            self.owner[sid] = new_w
+
+    def _note_build_bytes(self, bytes_by_worker: Dict[str, int],
+                          widx: int, resp: Dict[str, Any]) -> None:
+        addr = self.pool.addr_str(widx)
+        bb = resp.get("build_bytes")
+        if isinstance(bb, int):
+            bytes_by_worker[addr] = max(
+                bytes_by_worker.get(addr, 0), bb
+            )
+
+    def _verify_receipts(self, cache_dir: str,
+                         reports: List[tuple]) -> List[List[int]]:
+        """Re-reads every (file, byte-range) a bin response claims to
+        have written and compares crc32 — the commit gate. Returns the
+        unit-id lists of the responses whose receipts do NOT match the
+        bytes on disk (torn write, concurrent corruption)."""
+        offsets: Dict[str, int] = {}
+        bad: List[List[int]] = []
+        for uids, rep in reports:
+            ok = True
+            for name, segs in rep.items():
+                path = os.path.join(cache_dir, name)
+                if name not in offsets:
+                    offsets[name] = _npy_data_offset(path)
+                with open(path, "rb") as f:
+                    for seg in segs:
+                        f.seek(offsets[name] + int(seg["start"]))
+                        data = f.read(int(seg["nbytes"]))
+                        if (
+                            len(data) != int(seg["nbytes"])
+                            or zlib.crc32(data) != int(seg["crc"])
+                        ):
+                            ok = False
+                            break
+                if not ok:
+                    break
+            if not ok:
+                bad.append(list(uids))
+        return bad
+
+    def build(
+        self, *, files: List[str], cache_dir: str, label: str,
+        task: Task, weights, features, num_bins, chunk_rows: int,
+        max_vocab_count: int, min_vocab_frequency: int,
+        ranking_group, uplift_treatment, label_event_observed,
+        label_entry_age, store_raw_numerical: bool,
+        feature_shards: int, row_shards: int, boundaries: str,
+        sketch_k: int, request_fp: Optional[str], source: str,
+    ) -> DatasetCache:
+        t0 = time.perf_counter()
+        plan = plan_chunk_assignments(files, chunk_rows)
+        U = len(plan)
+        if U == 0:
+            raise DistributedTrainingError(
+                f"no data rows found in {files!r}"
+            )
+        W = len(self.pool.addresses)
+        # Contiguous balanced unit runs — worker w starts with units
+        # [w*U/W, (w+1)*U/W); failures move runs via self.owner.
+        self.owner = [(uid * min(W, U)) // U for uid in range(U)]
+        files = list(files)
+        always_cat = sorted(
+            _always_categorical(label, task, uplift_treatment)
+        )
+        extra_cols = [
+            c
+            for c in (
+                ranking_group, uplift_treatment, label_event_observed,
+                label_entry_age,
+            )
+            if c is not None
+        ]
+        all_uids = list(range(U))
+        bytes_by_worker: Dict[str, int] = {}
+
+        # ---- phase 1: ingest ---------------------------------------- #
+        def _ingest_req(uids, recount_cols=None):
+            req = {
+                "verb": "cache_ingest_stats", "key": self.key_id,
+                "files": files, "mode": boundaries,
+                "sketch_k": int(sketch_k), "always_cat": always_cat,
+                "units": [(u,) + tuple(plan[u]) for u in uids],
+            }
+            if recount_cols:
+                req["recount_cols"] = list(recount_cols)
+            return req
+
+        def _merge_units(wires: Dict[int, Dict]) -> IngestPartial:
+            # THE determinism anchor: ascending uid order, independent
+            # of which worker answered which unit.
+            merged = IngestPartial(mode=boundaries, sketch_k=sketch_k)
+            for uid in sorted(wires):
+                merged.merge(IngestPartial.from_wire(wires[uid]))
+            return merged
+
+        wires: Dict[int, Dict] = {}
+
+        def _on_ingest(widx, group, resp):
+            for uid, w in resp["partials"].items():
+                wires[int(uid)] = w
+            self._note_build_bytes(bytes_by_worker, widx, resp)
+
+        self._exchange(
+            all_uids, _ingest_req, "dist.cache_ingest", _on_ingest
+        )
+        partial = _merge_units(wires)
+
+        mixed = partial.mixed_columns()
+        if mixed:
+            partial.begin_recount(mixed)
+            wires = {}
+            self._exchange(
+                all_uids,
+                lambda uids: _ingest_req(uids, recount_cols=mixed),
+                "dist.cache_ingest", _on_ingest,
+            )
+            partial.apply_recount(_merge_units(wires), mixed)
+
+        num_rows = partial.num_rows
+        spec = _spec_from_partial(
+            partial, label, ranking_group, uplift_treatment,
+            max_vocab_count, min_vocab_frequency,
+        )
+        feature_names = features or _default_feature_names(
+            spec, label, weights, extra_cols
+        )
+        binner = _fit_binner_from_partial(
+            spec, feature_names, num_bins, partial
+        )
+
+        # ---- phase 2: bin ------------------------------------------- #
+        # Pre-create every output file (npy headers + sized data
+        # regions): the workers attach r+ over THESE headers, so the
+        # final bytes equal a single-machine build's by construction.
+        writers = _CacheWriters(
+            cache_dir, spec, binner, num_rows, label, weights,
+            extra_cols, store_raw_numerical, feature_shards,
+            row_shards, mode="w+",
+        )
+        data_files = writers.data_files()
+        writers.close()
+
+        spec_json = spec.to_json()
+        binner_json = binner.to_json()
+
+        def _bin_req(uids):
+            return {
+                "verb": "cache_bin_rows", "key": self.key_id,
+                "files": files, "cache_dir": cache_dir,
+                "dataspec": spec_json, "binner": binner_json,
+                "num_rows": num_rows, "label": label,
+                "weights": weights, "extra_cols": extra_cols,
+                "store_raw": bool(store_raw_numerical),
+                "feature_shards": int(feature_shards),
+                "row_shards": int(row_shards),
+                "units": [(u,) + tuple(plan[u]) for u in uids],
+            }
+
+        reports: List[tuple] = []
+
+        def _on_bin(widx, group, resp):
+            reports.append((list(group), resp["crc"]))
+            self._note_build_bytes(bytes_by_worker, widx, resp)
+
+        self._exchange(all_uids, _bin_req, "dist.cache_bin", _on_bin)
+
+        # ---- commit gate: verify write receipts --------------------- #
+        bad = self._verify_receipts(cache_dir, reports)
+        if bad:
+            retry = sorted({u for uids in bad for u in uids})
+            if telemetry.ENABLED:
+                telemetry.counter(
+                    "ydf_dist_cache_rebins_total"
+                ).inc(len(retry))
+            reports = []
+            self._exchange(
+                retry, _bin_req, "dist.cache_bin", _on_bin
+            )
+            bad = self._verify_receipts(cache_dir, reports)
+            if bad:
+                raise CacheCorruptionError(
+                    f"distributed cache build: units "
+                    f"{sorted(u for g in bad for u in g)} failed crc "
+                    "verification twice; refusing to commit"
+                )
+
+        # ---- commit ------------------------------------------------- #
+        peak = max(bytes_by_worker.values(), default=0)
+        if telemetry.ENABLED:
+            telemetry.mem_set("dist_cache_build", peak)
+            telemetry.counter("ydf_dist_cache_builds_total").inc()
+        build: Dict[str, Any] = {
+            "distributed": True,
+            "workers": W,
+            "units": U,
+            "build_s": time.perf_counter() - t0,
+            "recoveries": self.stats.recoveries,
+            "peak_worker_build_bytes": peak,
+        }
+        if boundaries == "sketch":
+            build["max_rank_error_bound"] = max(
+                (s.rank_error_bound() for s in partial.num.values()),
+                default=0.0,
+            )
+        return _publish_meta(
+            cache_dir, spec, binner, num_rows, label, weights,
+            extra_cols,
+            store_raw_numerical and binner.num_numerical > 0,
+            feature_shards, row_shards, source, request_fp,
+            boundaries, data_files, build=build,
+        )
+
+
+def create_dataset_cache_distributed(
+    data_path: str,
+    cache_dir: str,
+    label: str,
+    workers,
+    task: Task = Task.CLASSIFICATION,
+    weights: Optional[str] = None,
+    features: Optional[List[str]] = None,
+    num_bins="auto",
+    chunk_rows: int = 500_000,
+    max_vocab_count: int = 2000,
+    min_vocab_frequency: int = 5,
+    ranking_group: Optional[str] = None,
+    uplift_treatment: Optional[str] = None,
+    label_event_observed: Optional[str] = None,
+    label_entry_age: Optional[str] = None,
+    store_raw_numerical: bool = False,
+    reuse: bool = False,
+    feature_shards: int = 0,
+    row_shards: int = 0,
+    boundaries: str = "exact",
+    sketch_k: int = 4096,
+    secret: Optional[bytes] = None,
+    rpc_timeout_s: Optional[float] = None,
+) -> DatasetCache:
+    """Builds an on-disk binned cache from (sharded) CSV input with a
+    worker fleet — the distributed twin of
+    `dataset.cache.create_dataset_cache` (same arguments, same output,
+    same `reuse=True` fingerprint, so the two builders' caches reuse
+    each other interchangeably). `workers` is a list of
+    "host:port" addresses or an already-connected WorkerPool (the pool
+    is left open when caller-owned; an internally-created one has its
+    connections released on exit). Requires a filesystem shared by the
+    manager and all workers: workers read the source CSVs and write
+    their rows of the output files in place.
+
+    With `boundaries="exact"` (default) the result is byte-identical
+    to the single-machine build; `boundaries="sketch"` bounds worker
+    ingest memory via the KLL compactor and records the certified
+    rank-error bound under meta["build"]. See the module docstring for
+    the protocol and failure model."""
+    fmt, _ = _split_typed_path(data_path)
+    if fmt != "csv":
+        raise NotImplementedError(
+            "create_dataset_cache_distributed streams CSV input only "
+            f"(got {fmt!r}); convert other formats to CSV first"
+        )
+    files = _resolve_typed_path(data_path)
+    feature_shards = int(feature_shards)
+    row_shards = int(row_shards)
+    if feature_shards < 0 or row_shards < 0:
+        raise ValueError("shard counts must be >= 0")
+    if boundaries not in _BOUNDARY_MODES:
+        raise ValueError(
+            f"boundaries mode {boundaries!r} is not one of "
+            f"{list(_BOUNDARY_MODES)}"
+        )
+    os.makedirs(cache_dir, exist_ok=True)
+    request_fp = _request_fingerprint(
+        files, label, task, weights, features, num_bins, chunk_rows,
+        max_vocab_count, min_vocab_frequency, ranking_group,
+        uplift_treatment, label_event_observed, label_entry_age,
+        store_raw_numerical, feature_shards, row_shards, boundaries,
+        sketch_k,
+    )
+    if reuse:
+        existing = _try_reuse_cache(cache_dir, request_fp)
+        if existing is not None:
+            return existing
+
+    own_pool = not hasattr(workers, "request")
+    if own_pool:
+        from ydf_tpu.parallel.worker_service import WorkerPool
+
+        pool = WorkerPool(list(workers), secret=secret)
+    else:
+        pool = workers
+    try:
+        mgr = _DistCacheManager(pool, rpc_timeout_s=rpc_timeout_s)
+        return mgr.build(
+            files=files, cache_dir=cache_dir, label=label, task=task,
+            weights=weights, features=features, num_bins=num_bins,
+            chunk_rows=chunk_rows, max_vocab_count=max_vocab_count,
+            min_vocab_frequency=min_vocab_frequency,
+            ranking_group=ranking_group,
+            uplift_treatment=uplift_treatment,
+            label_event_observed=label_event_observed,
+            label_entry_age=label_entry_age,
+            store_raw_numerical=store_raw_numerical,
+            feature_shards=feature_shards, row_shards=row_shards,
+            boundaries=boundaries, sketch_k=sketch_k,
+            request_fp=request_fp, source=data_path,
+        )
+    finally:
+        if own_pool:
+            pool.close()

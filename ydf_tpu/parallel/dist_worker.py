@@ -74,6 +74,15 @@ VERBS = frozenset(
         # rows and routes them locally, no bitmap exchange).
         "load_row_shard", "row_histograms", "row_apply_split",
         "route_validation",
+        # Distributed cache-build verbs (parallel/dist_cache.py
+        # manager; docs/distributed_training.md "Distributed cache
+        # build"): pass-1 streaming ingest of a run of chunk units
+        # (per-UNIT mergeable partials — the manager's fixed merge
+        # order is over units, so results are invariant to worker
+        # count and failover regrouping) and pass-2 native binning of
+        # the same units straight into the manager-created shard
+        # files, with per-file crc32 write receipts.
+        "cache_ingest_stats", "cache_bin_rows",
     }
 )
 
@@ -797,6 +806,142 @@ def _route_validation(req: Dict[str, Any], worker_id: str) -> Dict[str, Any]:
         return {"ok": True, "leaves": leaves, "crcs": crcs}
 
 
+class _CacheBuildState:
+    """Epoch-fence anchor of one distributed cache build. The build
+    verbs are self-contained (each request re-reads its chunks and
+    releases everything before replying — no resident shards), so the
+    only per-run state a worker keeps is the manager-epoch token plus
+    the reaper's idle stamp; a zombie cache-build manager is fenced
+    exactly like a zombie training manager."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.epoch = 0
+        self.last_used = time.monotonic()
+
+
+_CACHE_STATE: Dict[tuple, "_CacheBuildState"] = {}
+
+
+def _get_cache_state(worker_id: str, key: str) -> "_CacheBuildState":
+    with _STATE_LOCK:
+        st = _CACHE_STATE.get((worker_id, key))
+        if st is None:
+            while len(_CACHE_STATE) >= _STATE_CAP:
+                _CACHE_STATE.pop(next(iter(_CACHE_STATE)))
+            st = _CACHE_STATE[(worker_id, key)] = _CacheBuildState()
+        st.last_used = time.monotonic()
+        return st
+
+
+def _cache_units(req: Dict[str, Any]) -> list:
+    """[(uid, file_idx, start_row, nrows, global_row), ...] of this
+    request — contiguous runs of the manager's chunk-aligned plan
+    (dataset/cache.py plan_chunk_assignments)."""
+    return [tuple(int(x) for x in u) for u in req["units"]]
+
+
+def _cache_ingest_stats(req: Dict[str, Any],
+                        worker_id: str) -> Dict[str, Any]:
+    """Pass 1 of a distributed cache build: streams the request's chunk
+    units from the (shared-filesystem) source files and returns one
+    mergeable IngestPartial PER UNIT — the manager merges all units of
+    the whole plan in ascending uid order, so the finalized dataspec
+    and boundaries are invariant to worker count and failover
+    regrouping. With `recount_cols`, runs the mixed-type categorical
+    recount pass over the same units instead. `build_bytes` is the
+    request's peak transient footprint (chunk columns + the partial) —
+    the manager's MemoryLedger evidence that per-process build memory
+    never approaches the full matrix."""
+    from ydf_tpu.dataset.cache import _iter_chunk_assignments
+    from ydf_tpu.dataset.sketch import IngestPartial
+
+    st = _get_cache_state(worker_id, req["key"])
+    with st.lock:
+        err = _check_epoch(st, req, load=True)
+        if err is not None:
+            return err
+    files = list(req["files"])
+    always_cat = frozenset(req.get("always_cat") or ())
+    recount = req.get("recount_cols")
+    partials: Dict[int, Dict[str, Any]] = {}
+    peak = 0
+    for uid, fi, start, nrows, grow in _cache_units(req):
+        p = IngestPartial(
+            mode=req.get("mode", "exact"),
+            sketch_k=int(req.get("sketch_k", 4096)),
+        )
+        for _row, chunk in _iter_chunk_assignments(
+            files, [(fi, start, nrows, grow)]
+        ):
+            if recount:
+                p.observe_recount(chunk, list(recount))
+            else:
+                p.observe_chunk(chunk, always_cat)
+            peak = max(
+                peak,
+                p.nbytes()
+                + sum(np.asarray(v).nbytes for v in chunk.values()),
+            )
+        partials[uid] = p.to_wire()
+    return {
+        "ok": True, "partials": partials, "build_bytes": int(peak),
+        "config": _dist_config(),
+    }
+
+
+def _cache_bin_rows(req: Dict[str, Any],
+                    worker_id: str) -> Dict[str, Any]:
+    """Pass 2 of a distributed cache build: re-streams the request's
+    chunk units, bins each through the native kernel and writes its
+    rows of bins.npy / labels / weights / extra / raw AND every
+    feature-/row-shard file in place (_CacheWriters mode "r+", over the
+    npy headers the manager pre-created — identical writes to the
+    single-machine pass, which is the byte-identity contract). Returns
+    per-file crc32 write receipts over exactly the byte ranges written;
+    the manager re-reads and verifies every range before committing the
+    cache, so a torn or corrupted shard write is re-binned, never
+    published."""
+    from ydf_tpu.dataset.binning import Binner
+    from ydf_tpu.dataset.cache import (
+        _CacheWriters,
+        _iter_chunk_assignments,
+    )
+    from ydf_tpu.dataset.dataspec import DataSpecification
+
+    st = _get_cache_state(worker_id, req["key"])
+    with st.lock:
+        err = _check_epoch(st, req, load=True)
+        if err is not None:
+            return err
+    files = list(req["files"])
+    units = _cache_units(req)
+    spec = DataSpecification.from_json(req["dataspec"])
+    binner = Binner.from_json(req["binner"])
+    writers = _CacheWriters(
+        req["cache_dir"], spec, binner, int(req["num_rows"]),
+        req["label"], req.get("weights"),
+        list(req.get("extra_cols") or ()),
+        bool(req.get("store_raw")),
+        int(req.get("feature_shards") or 0),
+        int(req.get("row_shards") or 0),
+        mode="r+", track_crc=True,
+    )
+    peak = 0
+    try:
+        for row, chunk in _iter_chunk_assignments(
+            files, [u[1:] for u in units]
+        ):
+            peak = max(peak, writers.write_chunk(row, chunk))
+        report = writers.crc_report()
+    finally:
+        writers.close()
+    return {
+        "ok": True, "crc": report, "build_bytes": int(peak),
+        "config": _dist_config(),
+    }
+
+
 _HANDLERS = {
     "load_cache_shard": _load_cache_shard,
     "build_histograms": _build_histograms,
@@ -806,6 +951,8 @@ _HANDLERS = {
     "row_histograms": _row_histograms,
     "row_apply_split": _row_apply_split,
     "route_validation": _route_validation,
+    "cache_ingest_stats": _cache_ingest_stats,
+    "cache_bin_rows": _cache_bin_rows,
 }
 
 
@@ -896,6 +1043,10 @@ def reap_idle_state(ttl_s: float) -> Tuple[int, int]:
                 freed += _row_state_bytes(st)
                 del _ROW_STATE[key]
                 reaped += 1
+        for key, st in list(_CACHE_STATE.items()):
+            if now - st.last_used >= ttl_s:
+                del _CACHE_STATE[key]
+                reaped += 1
     if reaped and _telemetry.ENABLED:
         _telemetry.counter("ydf_worker_state_reaped_total").inc(reaped)
     return reaped, freed
@@ -945,3 +1096,4 @@ def reset_state() -> None:
     with _STATE_LOCK:
         _STATE.clear()
         _ROW_STATE.clear()
+        _CACHE_STATE.clear()

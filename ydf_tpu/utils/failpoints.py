@@ -126,6 +126,15 @@ KNOWN_SITES = frozenset(
         # (shard verify/re-ship by a NEW manager): drop_conn drives
         # the reattach's failover to the next healthy worker.
         "dist.resume_attach",
+        # parallel/dist_cache.py — manager-side distributed cache-build
+        # RPCs: the pass-1 ingest-stats exchange and the pass-2
+        # bin-rows exchange. drop_conn surfaces as a transport failure
+        # and drives the unit-reassignment recovery path (the chaos
+        # tests assert the recovered cache is byte-identical); error
+        # between the phases models a manager crash before the commit
+        # record — reuse=True must rebuild.
+        "dist.cache_ingest",
+        "dist.cache_bin",
         # parallel/dist_worker.py — the worker-side manager-epoch
         # fence. An injected error makes the worker answer ONE request
         # with the typed stale-epoch rejection, as if a newer manager
