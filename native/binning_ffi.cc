@@ -132,12 +132,10 @@ void BinRows(const float* values, const float* boundaries,
 
 int ResolveThreads(int num_threads, int64_t n) {
   if (num_threads <= 0) {
-    if (const char* env = std::getenv("YDF_TPU_BIN_THREADS")) {
-      num_threads = std::atoi(env);
-    }
-  }
-  if (num_threads <= 0) {
-    num_threads = static_cast<int>(std::thread::hardware_concurrency());
+    // Per-call env read over the pool's CACHED hardware_concurrency
+    // (no per-call sysfs re-read).
+    num_threads =
+        ydf_native::ThreadPool::FamilyThreadCap(ydf_native::kPoolBin);
   }
   if (num_threads < 1) num_threads = 1;
   // Don't spawn threads that would each see under ~64k rows: thread

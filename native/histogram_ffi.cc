@@ -78,6 +78,7 @@
 #include <cstring>
 #include <vector>
 
+#include "route_simd.h"
 #include "thread_pool.h"
 #include "xla/ffi/api/ffi.h"
 
@@ -176,6 +177,41 @@ extern "C" int32_t ydf_pool_stats_enabled() {
 extern "C" void ydf_pool_stats_reset() {
   ydf_native::ThreadPool::Stats().Reset();
 }
+// Work-stealing counters (many-core round): blocks claimed across
+// lanes, the submitting lane's out-of-work tail wait, and the
+// engaged-lanes wall accumulator (the engaged_utilization denominator —
+// a run that engages fewer lanes than the pool has must not
+// under-report).
+extern "C" int64_t ydf_pool_steals_total(int family) {
+  if (family < 0 || family >= ydf_native::kPoolFamilies) return 0;
+  return ydf_native::ThreadPool::Stats().steals[family].load();
+}
+extern "C" int64_t ydf_pool_straggler_wait_ns_total(int family) {
+  if (family < 0 || family >= ydf_native::kPoolFamilies) return 0;
+  return ydf_native::ThreadPool::Stats().straggler_wait_ns[family].load();
+}
+extern "C" int64_t ydf_pool_engaged_wall_ns_total(int family) {
+  if (family < 0 || family >= ydf_native::kPoolFamilies) return 0;
+  return ydf_native::ThreadPool::Stats().engaged_wall_ns[family].load();
+}
+// NUMA nodes the pool places against (1 = all placement logic is a
+// no-op: single-node box or YDF_TPU_POOL_NUMA=off).
+extern "C" int32_t ydf_pool_numa_nodes() {
+  return ydf_native::ThreadPool::NumaNodes();
+}
+// Failpoint hook (pool.block_stall): every block index that is a
+// multiple of `stride` sleeps `stall_ns` inside its task body —
+// a pure delay that forces maximal stealing without touching data.
+// Armed/disarmed through ctypes by ops/pool_stats.py:block_stall.
+extern "C" void ydf_pool_set_block_stall(int64_t stall_ns, int64_t stride) {
+  ydf_native::ThreadPool::SetBlockStall(stall_ns, stride);
+}
+// Whether the AVX2 routing-gather path is live in this process
+// (compiled in + CPUID + YDF_TPU_ROUTE_SIMD). Per-call shape gates can
+// still fall back to scalar.
+extern "C" int32_t ydf_route_simd_active() {
+  return ydf_native::RouteSimdActive() ? 1 : 0;
+}
 
 namespace {
 
@@ -255,6 +291,8 @@ struct RouteSlot {
   int32_t hist_trash;  // hmp[trash]
   int32_t* nsp;        // out: new_slot [n]
   int32_t* nlp;        // out: new_leaf [n]
+  int64_t bins_elems;  // n * F (the AVX2 gather clamp bound)
+  bool simd;           // AVX2 materialize path usable for this call
   inline int32_t operator()(int64_t i, const uint8_t* br) const {
     int32_t s = sp[i];
     if (s < 0 || s > trash) s = trash;
@@ -274,6 +312,22 @@ struct RouteSlot {
     const int32_t cs = 2 * srp[s] + (gl ? 0 : 1);
     nsp[i] = cs;
     return hmp[std::min(std::max(cs, 0), trash)];
+  }
+  inline ydf_native::RouteSimdTables Tables() const {
+    return {sp,  lp,  dsp, rfp,
+            glp, lip, rip, srp,
+            hmp, static_cast<int64_t>(trash) + 1, B, F,
+            trash, hist_trash};
+  }
+};
+
+// Slot provider over a pre-materialized hist-slot chunk (the AVX2
+// routing walk fills `buf` for rows [base, base + len)).
+struct BufSlot {
+  const int32_t* buf;
+  int64_t base;
+  inline int32_t operator()(int64_t i, const uint8_t*) const {
+    return buf[i - base];
   }
 };
 
@@ -476,21 +530,108 @@ void AccumulateRowsQ8(const uint8_t* bp, const SlotFn& slot_of,
   }
 }
 
+// Rows the fused AVX2 path materializes hist slots for at a time: the
+// int32 chunk buffer stays L1-resident (16 KiB) on the worker's stack.
+constexpr int64_t kSimdChunk = 4096;
+
+// Range-accumulation seam between the histogram cores and the slot
+// providers. The generic form forwards straight to the row loop; the
+// RouteSlot overloads vectorize the fused routing walk when the AVX2
+// gather path is usable — materialize the chunk's hist slots (plus the
+// new_slot/new_leaf side outputs) with route_simd.h's walk, then run
+// the plain accumulator through a BufSlot provider. Chunking never
+// reorders rows (they ascend either way) and the vector walk is
+// bit-identical to RouteSlot::operator(), so results are unchanged.
+template <class SlotFn>
+inline void AccumulateRangeF32(const uint8_t* bp, const SlotFn& slot_of,
+                               const float* stp, double* acc, int64_t F,
+                               int64_t L, int64_t B, int64_t S, int64_t r0,
+                               int64_t r1) {
+  AccumulateRows(bp, slot_of, stp, acc, F, L, B, S, r0, r1);
+}
+
+inline void AccumulateRangeF32(const uint8_t* bp, const RouteSlot& rs,
+                               const float* stp, double* acc, int64_t F,
+                               int64_t L, int64_t B, int64_t S, int64_t r0,
+                               int64_t r1) {
+  if (!rs.simd) {
+    AccumulateRows(bp, rs, stp, acc, F, L, B, S, r0, r1);
+    return;
+  }
+  int32_t buf[kSimdChunk];
+  for (int64_t c0 = r0; c0 < r1; c0 += kSimdChunk) {
+    const int64_t c1 = std::min(c0 + kSimdChunk, r1);
+    // Fused kernels see row-major bins [n, F]: (f, i) at bp[i*F + f].
+    ydf_native::RouteRowsSimd(rs.Tables(), bp, rs.bins_elems,
+                              /*row_stride=*/F, /*col_stride=*/1, c0, c1,
+                              rs.nsp, rs.nlp, buf, /*hsp_base=*/c0,
+                              /*cnt=*/nullptr);
+    AccumulateRows(bp, BufSlot{buf, c0}, stp, acc, F, L, B, S, c0, c1);
+  }
+}
+
+template <class SlotFn>
+inline void AccumulateRangeQ8(const uint8_t* bp, const SlotFn& slot_of,
+                              const int8_t* qp, int32_t* part,
+                              uint64_t* packed, int64_t F, int64_t L,
+                              int64_t B, int64_t S, int64_t r0, int64_t r1,
+                              bool flush_packed = true) {
+  AccumulateRowsQ8(bp, slot_of, qp, part, packed, F, L, B, S, r0, r1,
+                   flush_packed);
+}
+
+inline void AccumulateRangeQ8(const uint8_t* bp, const RouteSlot& rs,
+                              const int8_t* qp, int32_t* part,
+                              uint64_t* packed, int64_t F, int64_t L,
+                              int64_t B, int64_t S, int64_t r0, int64_t r1,
+                              bool flush_packed = true) {
+  if (!rs.simd) {
+    AccumulateRowsQ8(bp, rs, qp, part, packed, F, L, B, S, r0, r1,
+                     flush_packed);
+    return;
+  }
+  int32_t buf[kSimdChunk];
+  for (int64_t c0 = r0; c0 < r1; c0 += kSimdChunk) {
+    const int64_t c1 = std::min(c0 + kSimdChunk, r1);
+    ydf_native::RouteRowsSimd(rs.Tables(), bp, rs.bins_elems,
+                              /*row_stride=*/F, /*col_stride=*/1, c0, c1,
+                              rs.nsp, rs.nlp, buf, /*hsp_base=*/c0,
+                              /*cnt=*/nullptr);
+    // Defer the packed flush across chunks — one final sweep; integer
+    // associativity keeps totals bit-identical.
+    AccumulateRowsQ8(bp, BufSlot{buf, c0}, qp, part, packed, F, L, B, S, c0,
+                     c1, /*flush_packed=*/false);
+  }
+  if (flush_packed && packed != nullptr) {
+    FlushPacked(packed, part, L * F * B);
+  }
+}
+
 int ResolveThreads(int64_t nblocks, int64_t bytes_per_partial) {
-  int num_threads = 0;
-  if (const char* env = std::getenv("YDF_TPU_HIST_THREADS")) {
-    num_threads = std::atoi(env);
-  }
-  if (num_threads <= 0) {
-    num_threads = static_cast<int>(std::thread::hardware_concurrency());
-  }
-  if (num_threads < 1) num_threads = 1;
+  // Per-call env read (tests flip YDF_TPU_HIST_THREADS mid-process)
+  // over the pool's CACHED hardware_concurrency.
+  const int cap =
+      ydf_native::ThreadPool::FamilyThreadCap(ydf_native::kPoolHist);
   // One partial histogram lives per in-flight block: bound the arena.
   const int64_t mem_cap =
       std::max<int64_t>(1, kArenaBudgetBytes / bytes_per_partial);
-  num_threads = static_cast<int>(std::min<int64_t>(
-      {static_cast<int64_t>(num_threads), nblocks, mem_cap}));
-  return num_threads;
+  return static_cast<int>(std::min<int64_t>(
+      {static_cast<int64_t>(cap), nblocks, mem_cap}));
+}
+
+// In-flight partials per pool submission. WIDER than the lane count
+// (4x) so the work-stealing deques hold real backlog — a lane that
+// finishes its deal early steals the tail of a straggler's instead of
+// idling at the wave barrier. The reduction adds partials in ascending
+// block order per wave whatever the wave width, so widening is pure
+// scheduling: not one bit of the result moves. Bounded by the arena
+// budget (partial scratch scales with the wave, not the lane count).
+int ResolveWave(int threads, int64_t nblocks, int64_t bytes_per_partial) {
+  if (threads <= 1) return 1;
+  const int64_t mem_cap =
+      std::max<int64_t>(1, kArenaBudgetBytes / bytes_per_partial);
+  return static_cast<int>(std::min<int64_t>(
+      {int64_t{threads} * 4, nblocks, mem_cap}));
 }
 
 // Ascending-block-order partial reduction shared by both kernels:
@@ -534,10 +675,11 @@ ffi::Error RunHistogramF32(const uint8_t* bp, const SlotFn& slot_of,
   const int64_t nblocks = (n + kRowBlock - 1) / kRowBlock;
   const int threads =
       ResolveThreads(std::max<int64_t>(nblocks, 1), need * int64_t{8});
-  // In-flight partials per wave. 1 block ≡ 1 partial ≡ the accumulator
-  // itself, so the arena is skipped entirely.
-  const int wave = static_cast<int>(
-      std::min<int64_t>(std::max(threads, 1), std::max<int64_t>(nblocks, 1)));
+  // In-flight partials per wave (threads*4 — steal backlog; see
+  // ResolveWave). 1 block ≡ 1 partial ≡ the accumulator itself, so the
+  // arena is skipped entirely.
+  const int wave = ResolveWave(threads, std::max<int64_t>(nblocks, 1),
+                               need * int64_t{8});
   try {
     if (acc.size() < static_cast<size_t>(need)) acc.resize(need);
     if (nblocks > 1 &&
@@ -563,7 +705,7 @@ ffi::Error RunHistogramF32(const uint8_t* bp, const SlotFn& slot_of,
     // (which executes inline on this thread) so the pool utilization
     // accounting covers small inputs too.
     ydf_native::ThreadPool::Get().Run(ydf_native::kPoolHist, 1, [&](int) {
-      AccumulateRows(bp, slot_of, stp, acc_p, F, L, B, S, 0, n);
+      AccumulateRangeF32(bp, slot_of, stp, acc_p, F, L, B, S, 0, n);
     });
   } else {
     for (int64_t wave0 = 0; wave0 < nblocks; wave0 += wave) {
@@ -575,8 +717,8 @@ ffi::Error RunHistogramF32(const uint8_t* bp, const SlotFn& slot_of,
         std::memset(part, 0, sizeof(double) * need);
         const int64_t r0 = (wave0 + j) * kRowBlock;
         const int64_t r1 = std::min(r0 + kRowBlock, n);
-        AccumulateRows(bp, slot_of, stp, part, F, L, B, S, r0, r1);
-      });
+        AccumulateRangeF32(bp, slot_of, stp, part, F, L, B, S, r0, r1);
+      }, /*max_lanes=*/threads);
       // Reduce this wave's partials into acc in ASCENDING BLOCK ORDER
       // per cell (the fixed-order reduction that makes the result
       // independent of the thread count).
@@ -628,8 +770,8 @@ ffi::Error RunHistogramQ8(const uint8_t* bp, const SlotFn& slot_of,
       need * int64_t{4} + (use_packed ? ncells * int64_t{8} : int64_t{0});
   const int threads =
       ResolveThreads(std::max<int64_t>(nblocks, 1), bytes_per_partial);
-  const int wave = static_cast<int>(
-      std::min<int64_t>(std::max(threads, 1), std::max<int64_t>(nblocks, 1)));
+  const int wave = ResolveWave(threads, std::max<int64_t>(nblocks, 1),
+                               bytes_per_partial);
 
   static thread_local std::vector<int64_t> acc_q8;
   static thread_local std::vector<int32_t> arena_q8;
@@ -671,8 +813,8 @@ ffi::Error RunHistogramQ8(const uint8_t* bp, const SlotFn& slot_of,
     }
     // Run(m=1) executes inline; it only adds the utilization accounting.
     ydf_native::ThreadPool::Get().Run(ydf_native::kPoolHist, 1, [&](int) {
-      AccumulateRowsQ8(bp, slot_of, qp, arena_p, packed_p, F, L, B, S, 0, n,
-                       /*flush_packed=*/false);
+      AccumulateRangeQ8(bp, slot_of, qp, arena_p, packed_p, F, L, B, S, 0, n,
+                        /*flush_packed=*/false);
     });
     if (packed_p != nullptr) FlushPacked(packed_p, arena_p, ncells);
     for (int64_t i = 0; i < need; ++i) {
@@ -697,8 +839,8 @@ ffi::Error RunHistogramQ8(const uint8_t* bp, const SlotFn& slot_of,
       }
       const int64_t r0 = (wave0 + j) * kRowBlock;
       const int64_t r1 = std::min(r0 + kRowBlock, n);
-      AccumulateRowsQ8(bp, slot_of, qp, part, packed, F, L, B, S, r0, r1);
-    });
+      AccumulateRangeQ8(bp, slot_of, qp, part, packed, F, L, B, S, r0, r1);
+    }, /*max_lanes=*/threads);
     ReduceWave(arena_p, acc_p, need, m, threads);
   }
   // The single dequantize: int64 totals × per-stat scale, one f32
@@ -752,7 +894,9 @@ static RouteSlot MakeRouteSlot(
   const int64_t L1 = do_split.dimensions()[0];
   const int64_t Bt = go_left.dimensions()[1];
   const int32_t trash = static_cast<int32_t>(L1 - 1);
-  return RouteSlot{
+  const bool have_set =
+      set_go_left.dimensions()[0] == static_cast<uint64_t>(n);
+  RouteSlot rs{
       slot.typed_data(),
       leaf.typed_data(),
       do_split.typed_data(),
@@ -764,13 +908,17 @@ static RouteSlot MakeRouteSlot(
       hmap.typed_data(),
       is_set.typed_data(),
       set_go_left.typed_data(),
-      /*have_set=*/set_go_left.dimensions()[0] == static_cast<uint64_t>(n),
+      have_set,
       /*B=*/Bt,
       /*F=*/F,
       trash,
       /*hist_trash=*/hmap.typed_data()[trash],
       new_slot->typed_data(),
-      new_leaf->typed_data()};
+      new_leaf->typed_data(),
+      /*bins_elems=*/n * F,
+      /*simd=*/false};
+  rs.simd = ydf_native::RouteSimdUsable(rs.Tables(), rs.bins_elems, have_set);
+  return rs;
 }
 
 // Fused histogram + routing (f32): applies the PREVIOUS layer's chosen

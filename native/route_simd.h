@@ -1,0 +1,274 @@
+// AVX2 gather path for the per-layer routing row walk, shared by the
+// standalone kernel (routing_ffi.cc:RouteUpdateImpl) and the fused
+// histogram+routing slot provider (histogram_ffi.cc:RouteSlot).
+//
+// Why: the routing walk is gather-bound, not FLOP-bound (the Booster
+// argument, PAPERS.md 2011.02022) — per row it chases five small
+// routing LUTs (do_split/route_f/left/right/split_rank/hmap) plus one
+// byte of the bins matrix, all data-dependent loads the scalar loop
+// serializes. AVX2 `vpgatherdd` issues 8 of those loads per
+// instruction and hides their latency against each other; on the
+// trash-heavy sibling-subtraction layers (most rows take the early-out)
+// the vector path also replaces the per-row branch with a blend.
+//
+// Bit-identity contract: the walk is ALL-INTEGER, and this path
+// replicates the scalar decision logic operation-for-operation (same
+// out-of-range->trash blend — NOT a clamp —, same route_f clamp, same
+// left/right select order, same hmap-index clamp), so its outputs are
+// byte-identical to the scalar loop on every input that honors the
+// kernel contracts. The scalar loop stays the reference; the dispatch
+// is runtime (CPUID) + env (YDF_TPU_ROUTE_SIMD=auto|off) and tests
+// assert equality of both paths on the same inputs.
+//
+// Memory-safety (the part that makes u8 gathers non-trivial): a 32-bit
+// gather always reads FOUR bytes, so a byte-table gather at index
+// size-1 would read 3 bytes past the end. Every u8 gather here is
+// CLAMPED — load 4 bytes at min(idx, size-4), then shift the wanted
+// byte out per lane (vpsrlvd) — so no gather ever touches a byte
+// outside the table, and the sanitizer builds (ASAN) stay clean.
+// Tables smaller than 4 bytes, categorical-set layers (per-row set
+// decisions don't vectorize into the same gather shape) and >2^31-byte
+// tables (32-bit gather indices) fall back to the scalar loop; the
+// dispatcher (RouteSimdUsable) checks all of it per call.
+//
+// Compile-time dispatch: the AVX2 body is compiled with
+// __attribute__((target("avx2"))) so the shared library still builds
+// and runs on baseline x86-64 (and non-x86 hosts compile the scalar
+// fallback only); the CPUID check gates execution at runtime. The
+// function is noinline so the compiler never hoists AVX2 code into a
+// baseline caller.
+
+#ifndef YDF_TPU_NATIVE_ROUTE_SIMD_H_
+#define YDF_TPU_NATIVE_ROUTE_SIMD_H_
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+#if defined(__x86_64__) && (defined(__GNUC__) || defined(__clang__))
+#define YDF_TPU_ROUTE_SIMD_COMPILED 1
+#include <immintrin.h>
+#else
+#define YDF_TPU_ROUTE_SIMD_COMPILED 0
+#endif
+
+namespace ydf_native {
+
+// The per-slot routing tables of one layer (all borrowed pointers).
+// Field names follow routing_ffi.cc:RouteUpdateImpl; `trash` == L1-1,
+// `hist_trash` == hmp[trash].
+struct RouteSimdTables {
+  const int32_t* sp;   // prev slot [n]
+  const int32_t* lp;   // prev leaf id [n]
+  const uint8_t* dsp;  // do_split [L1]
+  const int32_t* rfp;  // route_f [L1], pre-clipped to [0, F)
+  const uint8_t* glp;  // go_left [L1, B]
+  const int32_t* lip;  // left_id [L1]
+  const int32_t* rip;  // right_id [L1]
+  const int32_t* srp;  // split_rank [L1]
+  const int32_t* hmp;  // hmap [L1]
+  int64_t L1, B, F;
+  int32_t trash, hist_trash;
+};
+
+// YDF_TPU_ROUTE_SIMD=auto|off (default auto). Validated eagerly at the
+// Python env boundary (ops/pool_stats.py:resolve_route_simd); the C++
+// side treats anything that isn't an explicit off as auto so a bad env
+// can only disable.
+inline bool RouteSimdEnvEnabled() {
+  static const bool on = [] {
+    const char* env = std::getenv("YDF_TPU_ROUTE_SIMD");
+    if (env == nullptr) return true;
+    return !(std::strcmp(env, "off") == 0 || std::strcmp(env, "OFF") == 0 ||
+             std::strcmp(env, "0") == 0);
+  }();
+  return on;
+}
+
+// Env on + compiled in + CPU supports AVX2 — the process-wide gate
+// (exported to Python as ydf_route_simd_active()).
+inline bool RouteSimdActive() {
+#if YDF_TPU_ROUTE_SIMD_COMPILED
+  static const bool cpu_ok = __builtin_cpu_supports("avx2") != 0;
+  return cpu_ok && RouteSimdEnvEnabled();
+#else
+  return false;
+#endif
+}
+
+// Per-call shape gate on top of RouteSimdActive(): `bins_elems` is the
+// total byte count of the bins matrix (n*F — same bound whichever
+// layout), `have_set` whether this layer carries per-row
+// categorical-set decisions (scalar-only).
+inline bool RouteSimdUsable(const RouteSimdTables& t, int64_t bins_elems,
+                            bool have_set) {
+  if (!RouteSimdActive()) return false;
+  if (have_set) return false;
+  // Clamped byte gathers need >= 4 readable bytes per table; 32-bit
+  // gather offsets need every byte index < 2^31 (with clamp headroom).
+  constexpr int64_t kIdxLimit = (int64_t{1} << 31) - 16;
+  if (t.L1 < 4 || t.F < 1 || t.B < 1) return false;
+  if (bins_elems < 8 || bins_elems > kIdxLimit) return false;
+  const int64_t glp_bytes = t.L1 * t.B;
+  if (glp_bytes < 4 || glp_bytes > kIdxLimit) return false;
+  return true;
+}
+
+// One row of the routing walk — the scalar reference, also the vector
+// path's tail loop. MUST stay in lockstep with
+// routing_ffi.cc:RouteUpdateImpl and histogram_ffi.cc:RouteSlot (the
+// bit-parity tests pin all three against each other). bins element
+// (f, i) lives at bins[f*col_stride + i*row_stride]: the standalone
+// kernel's feature-major [F, n] layout is (col=n, row=1), the fused
+// kernels' row-major [n, F] is (col=1, row=F). `hsp` (next-layer hist
+// slot, written at hsp[i - hsp_base]) and `cnt` (per-(slot, side) row
+// counts) are optional.
+inline void RouteOneScalar(const RouteSimdTables& t, const uint8_t* bins,
+                           int64_t row_stride, int64_t col_stride, int64_t i,
+                           int32_t* nsp, int32_t* nlp, int32_t* hsp,
+                           int64_t hsp_base, int64_t* cnt) {
+  int32_t s = t.sp[i];
+  if (s < 0 || s > t.trash) s = t.trash;
+  if (!t.dsp[s]) {
+    nsp[i] = t.trash;
+    nlp[i] = t.lp[i];
+    if (hsp != nullptr) hsp[i - hsp_base] = t.hist_trash;
+    return;
+  }
+  const int64_t f = std::min<int64_t>(std::max(t.rfp[s], 0), t.F - 1);
+  const int64_t b = bins[f * col_stride + i * row_stride];
+  const bool gl = t.glp[s * t.B + b] != 0;
+  nlp[i] = gl ? t.lip[s] : t.rip[s];
+  const int32_t cs = 2 * t.srp[s] + (gl ? 0 : 1);
+  nsp[i] = cs;
+  if (hsp != nullptr) {
+    hsp[i - hsp_base] = t.hmp[std::min(std::max(cs, 0), t.trash)];
+  }
+  if (cnt != nullptr) ++cnt[s * 2 + (gl ? 0 : 1)];
+}
+
+#if YDF_TPU_ROUTE_SIMD_COMPILED
+
+// AVX2 body: 8 rows per iteration, scalar tail. noinline keeps the
+// avx2-targeted code out of baseline callers (GCC refuses to inline
+// across target mismatches only when it notices; don't let it try).
+__attribute__((target("avx2"), noinline)) inline void RouteRowsSimd(
+    const RouteSimdTables& t, const uint8_t* bins, int64_t bins_elems,
+    int64_t row_stride, int64_t col_stride, int64_t r0, int64_t r1,
+    int32_t* nsp, int32_t* nlp, int32_t* hsp, int64_t hsp_base,
+    int64_t* cnt) {
+  const __m256i vzero = _mm256_setzero_si256();
+  const __m256i vone = _mm256_set1_epi32(1);
+  const __m256i vff = _mm256_set1_epi32(0xFF);
+  const __m256i vtrash = _mm256_set1_epi32(t.trash);
+  const __m256i vht = _mm256_set1_epi32(t.hist_trash);
+  const __m256i vFm1 = _mm256_set1_epi32(static_cast<int32_t>(t.F - 1));
+  const __m256i vB = _mm256_set1_epi32(static_cast<int32_t>(t.B));
+  const __m256i vcol = _mm256_set1_epi32(static_cast<int32_t>(col_stride));
+  const __m256i vrow = _mm256_set1_epi32(static_cast<int32_t>(row_stride));
+  // Clamp bases for the byte-table gathers (see header comment).
+  const __m256i vdcl = _mm256_set1_epi32(static_cast<int32_t>(t.L1 - 4));
+  const __m256i vbcl =
+      _mm256_set1_epi32(static_cast<int32_t>(bins_elems - 4));
+  const __m256i vgcl =
+      _mm256_set1_epi32(static_cast<int32_t>(t.L1 * t.B - 4));
+  const __m256i viota = _mm256_setr_epi32(0, 1, 2, 3, 4, 5, 6, 7);
+
+  int64_t i = r0;
+  for (; i + 8 <= r1; i += 8) {
+    // s = sp[i]; if (s < 0 || s > trash) s = trash  — blend, NOT clamp
+    // (an in-range but > trash value cannot exist; a negative one maps
+    // to trash exactly like the scalar branch).
+    __m256i vs = _mm256_loadu_si256(
+        reinterpret_cast<const __m256i*>(t.sp + i));
+    const __m256i voob = _mm256_or_si256(_mm256_cmpgt_epi32(vzero, vs),
+                                         _mm256_cmpgt_epi32(vs, vtrash));
+    vs = _mm256_blendv_epi8(vs, vtrash, voob);
+    // split = dsp[s] != 0 (clamped byte gather + per-lane byte shift)
+    __m256i vad = _mm256_min_epi32(vs, vdcl);
+    __m256i vwd = _mm256_i32gather_epi32(
+        reinterpret_cast<const int*>(t.dsp), vad, 1);
+    __m256i vsh = _mm256_slli_epi32(_mm256_sub_epi32(vs, vad), 3);
+    const __m256i vds = _mm256_and_si256(_mm256_srlv_epi32(vwd, vsh), vff);
+    const __m256i vsplit = _mm256_cmpgt_epi32(vds, vzero);
+    // f = clamp(rfp[s], 0, F-1); b = bins[f*col + i*row]
+    __m256i vf = _mm256_i32gather_epi32(t.rfp, vs, 4);
+    vf = _mm256_min_epi32(_mm256_max_epi32(vf, vzero), vFm1);
+    const __m256i vi =
+        _mm256_add_epi32(_mm256_set1_epi32(static_cast<int32_t>(i)), viota);
+    const __m256i vidx = _mm256_add_epi32(_mm256_mullo_epi32(vf, vcol),
+                                          _mm256_mullo_epi32(vi, vrow));
+    vad = _mm256_min_epi32(vidx, vbcl);
+    vwd = _mm256_i32gather_epi32(reinterpret_cast<const int*>(bins), vad, 1);
+    vsh = _mm256_slli_epi32(_mm256_sub_epi32(vidx, vad), 3);
+    const __m256i vb = _mm256_and_si256(_mm256_srlv_epi32(vwd, vsh), vff);
+    // gl = go_left[s*B + b] != 0
+    const __m256i vgidx = _mm256_add_epi32(_mm256_mullo_epi32(vs, vB), vb);
+    vad = _mm256_min_epi32(vgidx, vgcl);
+    vwd = _mm256_i32gather_epi32(
+        reinterpret_cast<const int*>(t.glp), vad, 1);
+    vsh = _mm256_slli_epi32(_mm256_sub_epi32(vgidx, vad), 3);
+    const __m256i vglb = _mm256_and_si256(_mm256_srlv_epi32(vwd, vsh), vff);
+    const __m256i vgl = _mm256_cmpgt_epi32(vglb, vzero);
+    // new_leaf = gl ? left_id[s] : right_id[s]
+    const __m256i vlip = _mm256_i32gather_epi32(t.lip, vs, 4);
+    const __m256i vrip = _mm256_i32gather_epi32(t.rip, vs, 4);
+    const __m256i vnl = _mm256_blendv_epi8(vrip, vlip, vgl);
+    // cs = 2*split_rank[s] + (gl ? 0 : 1)
+    const __m256i vsr = _mm256_i32gather_epi32(t.srp, vs, 4);
+    const __m256i vcs = _mm256_add_epi32(_mm256_add_epi32(vsr, vsr),
+                                         _mm256_andnot_si256(vgl, vone));
+    // hist = hmap[clamp(cs, 0, trash)]
+    const __m256i vh = _mm256_min_epi32(_mm256_max_epi32(vcs, vzero), vtrash);
+    const __m256i vhm = _mm256_i32gather_epi32(t.hmp, vh, 4);
+    // Non-split lanes keep (trash, lp[i], hist_trash).
+    const __m256i vlp = _mm256_loadu_si256(
+        reinterpret_cast<const __m256i*>(t.lp + i));
+    _mm256_storeu_si256(reinterpret_cast<__m256i*>(nsp + i),
+                        _mm256_blendv_epi8(vtrash, vcs, vsplit));
+    _mm256_storeu_si256(reinterpret_cast<__m256i*>(nlp + i),
+                        _mm256_blendv_epi8(vlp, vnl, vsplit));
+    if (hsp != nullptr) {
+      _mm256_storeu_si256(reinterpret_cast<__m256i*>(hsp + (i - hsp_base)),
+                          _mm256_blendv_epi8(vht, vhm, vsplit));
+    }
+    if (cnt != nullptr) {
+      // Count increments are per-(slot, side) scatters — not worth a
+      // conflict-detect dance at 8 lanes; extract and bump.
+      alignas(32) int32_t ls[8], lg[8], lm[8];
+      _mm256_store_si256(reinterpret_cast<__m256i*>(ls), vs);
+      _mm256_store_si256(reinterpret_cast<__m256i*>(lg), vgl);
+      _mm256_store_si256(reinterpret_cast<__m256i*>(lm), vsplit);
+      for (int k = 0; k < 8; ++k) {
+        if (lm[k]) ++cnt[ls[k] * 2 + (lg[k] ? 0 : 1)];
+      }
+    }
+  }
+  for (; i < r1; ++i) {
+    RouteOneScalar(t, bins, row_stride, col_stride, i, nsp, nlp, hsp,
+                   hsp_base, cnt);
+  }
+}
+
+#else  // !YDF_TPU_ROUTE_SIMD_COMPILED
+
+// Non-x86 fallback so call sites compile; RouteSimdUsable() is
+// constant-false on these hosts, so this only runs if a caller skips
+// the gate — in which case it is still correct, just scalar.
+inline void RouteRowsSimd(const RouteSimdTables& t, const uint8_t* bins,
+                          int64_t /*bins_elems*/, int64_t row_stride,
+                          int64_t col_stride, int64_t r0, int64_t r1,
+                          int32_t* nsp, int32_t* nlp, int32_t* hsp,
+                          int64_t hsp_base, int64_t* cnt) {
+  for (int64_t i = r0; i < r1; ++i) {
+    RouteOneScalar(t, bins, row_stride, col_stride, i, nsp, nlp, hsp,
+                   hsp_base, cnt);
+  }
+}
+
+#endif  // YDF_TPU_ROUTE_SIMD_COMPILED
+
+}  // namespace ydf_native
+
+#endif  // YDF_TPU_NATIVE_ROUTE_SIMD_H_

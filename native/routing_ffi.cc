@@ -92,6 +92,7 @@
 #include <cstring>
 #include <vector>
 
+#include "route_simd.h"
 #include "thread_pool.h"
 #include "xla/ffi/api/ffi.h"
 
@@ -142,22 +143,20 @@ class ScopedTimer {
 constexpr int64_t kRowBlock = 32768;
 
 int ResolveRouteThreads(int64_t nblocks) {
-  int num_threads = 0;
-  if (const char* env = std::getenv("YDF_TPU_ROUTE_THREADS")) {
-    num_threads = std::atoi(env);
-  }
-  if (num_threads <= 0) {
-    num_threads = static_cast<int>(std::thread::hardware_concurrency());
-  }
-  if (num_threads < 1) num_threads = 1;
+  // Per-call env read (tests flip it mid-process) over the pool's
+  // CACHED hardware_concurrency — never the per-call sysfs re-read.
+  const int cap =
+      ydf_native::ThreadPool::FamilyThreadCap(ydf_native::kPoolRoute);
   return static_cast<int>(
-      std::min<int64_t>(num_threads, std::max<int64_t>(nblocks, 1)));
+      std::min<int64_t>(cap, std::max<int64_t>(nblocks, 1)));
 }
 
-// Runs fn(0..nblocks-1) in waves of at most `threads` pool tasks. The
-// block partitioning is fixed (kRowBlock) and every block writes
-// disjoint output ranges, so the thread cap only changes scheduling,
-// never a bit of the result.
+// Runs fn(0..nblocks-1) as ONE pool submission with a per-call lane
+// cap: all blocks land in the work-stealing deques at once, so lanes
+// that finish early steal from stragglers instead of idling at a wave
+// barrier. The block partitioning is fixed (kRowBlock) and every block
+// writes disjoint output ranges, so the thread cap and the steal
+// schedule only change WHO computes a block, never a bit of the result.
 template <typename Fn>
 void RunBlocks(int64_t nblocks, int threads, const Fn& fn) {
   if (nblocks <= 1 || threads <= 1) {
@@ -167,11 +166,9 @@ void RunBlocks(int64_t nblocks, int threads, const Fn& fn) {
     });
     return;
   }
-  for (int64_t w0 = 0; w0 < nblocks; w0 += threads) {
-    const int m = static_cast<int>(std::min<int64_t>(threads, nblocks - w0));
-    ydf_native::ThreadPool::Get().Run(
-        ydf_native::kPoolRoute, m, [&, w0](int j) { fn(w0 + j); });
-  }
+  ydf_native::ThreadPool::Get().Run(
+      ydf_native::kPoolRoute, static_cast<int>(nblocks),
+      [&](int j) { fn(j); }, /*max_lanes=*/threads);
 }
 
 }  // namespace
@@ -274,11 +271,28 @@ static ffi::Error RouteUpdateImpl(
   // would resolve its OWN empty instance) — hoist the raw pointer.
   int64_t* const arena_p = count_arena.data();
 
+  // AVX2 gather path (native/route_simd.h): bit-identical to the
+  // scalar walk below by construction (all-integer, op-for-op), gated
+  // per call on CPUID + YDF_TPU_ROUTE_SIMD + table shapes. The
+  // standalone kernel's bins are feature-major [F, n]: element (f, i)
+  // at bp[f*n + i] -> col_stride=n, row_stride=1.
+  const ydf_native::RouteSimdTables simd_tables{
+      sp, lp, dsp, rfp, glp, lip, rip, srp, hmp,
+      L1, B, F, trash, hist_trash};
+  const bool use_simd =
+      ydf_native::RouteSimdUsable(simd_tables, F * n, have_set);
+
   auto run_block = [&, arena_p](int64_t blk) {
     int64_t* cnt = arena_p + blk * ncount;
     std::memset(cnt, 0, sizeof(int64_t) * ncount);
     const int64_t r0 = blk * kRowBlock;
     const int64_t r1 = std::min(r0 + kRowBlock, n);
+    if (use_simd) {
+      ydf_native::RouteRowsSimd(simd_tables, bp, F * n, /*row_stride=*/1,
+                                /*col_stride=*/n, r0, r1, nsp, nlp, hsp,
+                                /*hsp_base=*/0, cnt);
+      return;
+    }
     for (int64_t i = r0; i < r1; ++i) {
       int32_t s = sp[i];
       if (s < 0 || s >= static_cast<int32_t>(L1)) s = trash;

@@ -249,20 +249,13 @@ void ServeRows(const BankView& b, const Input& in, int64_t r0, int64_t r1,
 constexpr int64_t kServeRowBlock = 512;
 
 int ResolveServeThreads(int64_t nblocks) {
-  int num_threads = 0;
-  if (const char* env = std::getenv("YDF_TPU_SERVE_THREADS")) {
-    num_threads = std::atoi(env);
-  }
-  if (num_threads <= 0) {
-    // hardware_concurrency() re-reads sysfs on glibc (~tens of µs) —
-    // never on the per-request path; cache it for the process.
-    static const int hw =
-        static_cast<int>(std::thread::hardware_concurrency());
-    num_threads = hw;
-  }
-  if (num_threads < 1) num_threads = 1;
+  // Per-call env read over the pool's CACHED hardware_concurrency (the
+  // sysfs re-read fix that started here now lives at the pool layer
+  // for all families).
+  const int cap =
+      ydf_native::ThreadPool::FamilyThreadCap(ydf_native::kPoolServe);
   return static_cast<int>(
-      std::min<int64_t>(num_threads, std::max<int64_t>(nblocks, 1)));
+      std::min<int64_t>(cap, std::max<int64_t>(nblocks, 1)));
 }
 
 template <typename Input>
@@ -289,13 +282,13 @@ void ServeBatch(const BankView& b, const Input& in, int64_t n, float* out) {
     });
     return;
   }
-  for (int64_t w0 = 0; w0 < nblocks; w0 += threads) {
-    const int m =
-        static_cast<int>(std::min<int64_t>(threads, nblocks - w0));
-    ydf_native::ThreadPool::Get().Run(
-        ydf_native::kPoolServe, m,
-        [&, w0](int j) { run_block(w0 + j); });
-  }
+  // One submission for the whole batch: all blocks land in the
+  // work-stealing deques at once (lane cap = threads), so a lane that
+  // drains its deal steals a straggler's tail instead of idling at a
+  // wave barrier. Blocks write disjoint output rows — scheduling only.
+  ydf_native::ThreadPool::Get().Run(
+      ydf_native::kPoolServe, static_cast<int>(nblocks),
+      [&](int j) { run_block(j); }, /*max_lanes=*/threads);
 }
 
 // Owned bank: the ctypes handle. Arrays are copied once at model load

@@ -1,6 +1,7 @@
-// Persistent worker pool shared by the native kernels (histogram_ffi.cc
-// and binning_ffi.cc, compiled together into ONE shared library by
-// ydf_tpu/ops/native_ffi.py — the pool is owned by that loaded module).
+// Persistent worker pool shared by the native kernels (histogram_ffi.cc,
+// binning_ffi.cc, routing_ffi.cc and serving_ffi.cc, compiled together
+// into ONE shared library by ydf_tpu/ops/native_ffi.py — the pool is
+// owned by that loaded module).
 //
 // Why: the kernels used to spawn std::thread per call. At 32k-row block
 // granularity that is fine for one cold call, but the boosting loop
@@ -10,31 +11,61 @@
 // on the first parallel call) and parks workers on a condition variable
 // between calls.
 //
+// Scheduling: WORK-STEALING dynamic chunking (many-core round). A Run()
+// call's m tasks (fixed-size row blocks) are dealt into per-lane deques
+// as contiguous ranges — lane l owns blocks [l*m/E, (l+1)*m/E) of the E
+// engaged lanes. A lane pops its own deque from the FRONT; a lane whose
+// deque is empty steals ONE block from the TAIL of the most-loaded
+// victim (same-NUMA-node victims first, see below). The front/tail
+// split keeps the owner streaming forward through its contiguous range
+// (prefetcher-friendly, and the range it first-touched) while thieves
+// peel from the far end where the owner will arrive last.
+//
 // Bit-stability contract: the pool only changes WHO runs a task, never
 // the task partitioning or the reduction order. Callers still cut work
 // into fixed blocks and reduce in ascending block order, so results
-// remain bit-stable across pool sizes and caller-side thread caps —
-// parallelism is controlled by how many TASKS a call submits (the
-// per-call YDF_TPU_HIST_THREADS / YDF_TPU_BIN_THREADS resolution),
-// which the pool merely bounds from above.
+// remain bit-stable across pool sizes, caller-side lane caps AND STEAL
+// SCHEDULES — stealing migrates a block to another lane but the block
+// computes the same pure function into the same disjoint output range
+// either way (tests pin this with an adversarial stall schedule that
+// forces maximal stealing; docs/thread_pool.md has the full argument).
 //
-// Sizing: YDF_TPU_HIST_THREADS at first use, else hardware_concurrency.
-// Task claims are mutex-protected: tasks are 32k-row blocks (~ms), so
-// claim contention is noise, and the mutex closes the stale-worker race
-// (a worker waking from a PREVIOUS run can never claim a task of the
-// current one — claims are generation-checked under the lock).
+// NUMA placement (YDF_TPU_POOL_NUMA=auto|off, default auto): on a
+// multi-node box, worker lanes are pinned round-robin-contiguously to
+// nodes (lane l -> node l*nnodes/size) and each lane's steal order
+// visits same-node victims before remote ones. Because the block->lane
+// deal is a fixed function of (m, E), the lane that FIRST touches a
+// block's scratch pages is the same lane on every run — first-touch
+// page placement makes block scratch node-local, and steal-within-node
+// keeps migrated blocks on the same memory node unless the whole node
+// has drained. On single-node boxes (and with =off) all of this
+// degrades to a no-op: one node, plain ascending steal order, no
+// pinning. Node topology is read once from sysfs; no libnuma
+// dependency.
+//
+// Sizing: resolved ONCE per process (the ~40µs/call sysfs re-read trap
+// fixed at the pool layer): the pool takes the max of the per-family
+// caps YDF_TPU_{HIST,BIN,ROUTE,SERVE}_THREADS (any that are set), else
+// hardware_concurrency(). Per-call lane caps (the `max_lanes` argument,
+// fed by the same per-family envs) bound how many lanes ENGAGE in one
+// Run without touching pool size. FamilyThreadCap() is the shared
+// resolver for the kernel .cc files: it still reads the env per call
+// (cheap, and tests monkeypatch it) but falls back to the CACHED
+// hardware_concurrency — never the sysfs re-read.
 //
 // Utilization stats: every Run() is tagged with a kernel FAMILY
 // (PoolFamily below) and the pool accumulates per-(family, lane)
-// busy-ns and task counts plus per-family queue-wait-ns and run-wall-ns
-// into a shared atomic stats block (PoolStats). That block is the
-// measurement ROADMAP item 3 ("saturate a many-core box") is judged by:
-// busy / (lanes x run-wall) is the per-stage pool_utilization the bench
-// headline records carry. Exported via extern "C" accessors defined in
-// histogram_ffi.cc (one TU), read by ydf_tpu/ops/pool_stats.py;
-// YDF_TPU_POOL_STATS=0 removes the per-task clock reads entirely.
-// Recording never changes partitioning or reduction order, so results
-// are bit-identical with stats on or off.
+// busy-ns and task counts plus per-family queue-wait-ns, run-wall-ns,
+// ENGAGED-lane wall-ns, steal counts and straggler-wait-ns into a
+// shared atomic stats block (PoolStats). That block is the measurement
+// ROADMAP item 3 ("saturate a many-core box") is judged by:
+//   busy / (size    x run-wall)  = pool_utilization  (whole-pool view)
+//   busy / engaged_wall          = engaged_utilization (per-run lanes)
+// Exported via extern "C" accessors defined in histogram_ffi.cc (one
+// TU), read by ydf_tpu/ops/pool_stats.py; YDF_TPU_POOL_STATS=0 removes
+// the per-task clock reads entirely. Recording never changes
+// partitioning or reduction order, so results are bit-identical with
+// stats on or off.
 
 #ifndef YDF_TPU_NATIVE_THREAD_POOL_H_
 #define YDF_TPU_NATIVE_THREAD_POOL_H_
@@ -43,12 +74,19 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <functional>
 #include <mutex>
 #include <thread>
 #include <vector>
+
+#if defined(__linux__)
+#include <pthread.h>
+#include <sched.h>
+#include <sys/stat.h>
+#endif
 
 namespace ydf_native {
 
@@ -70,16 +108,22 @@ enum PoolFamily : int {
 // the export stays bounded on very wide boxes.
 //
 // Semantics (docs/observability.md has the full contract):
-//   busy_ns[f][l]     wall time lane l spent INSIDE task bodies of
-//                     family f (what "utilization" divides by
-//                     lanes x run-wall);
-//   tasks[f][l]       task bodies lane l executed for family f;
-//   queue_wait_ns[f]  sum over tasks of (claim time - submit time):
-//                     total time family-f tasks sat queued before a
-//                     lane picked them up (backlog + wakeup latency);
-//   run_wall_ns[f]    wall time of whole Run() calls (submit to
-//                     all-done) — the utilization denominator;
-//   runs[f]           Run() calls.
+//   busy_ns[f][l]        wall time lane l spent INSIDE task bodies of
+//                        family f;
+//   tasks[f][l]          task bodies lane l executed for family f;
+//   queue_wait_ns[f]     sum over tasks of (claim time - submit time);
+//   run_wall_ns[f]       wall time of whole Run() calls (submit to
+//                        all-done) — the pool_utilization denominator;
+//   engaged_wall_ns[f]   sum over Run() calls of engaged_lanes x
+//                        run-wall — the engaged_utilization denominator
+//                        (a run that engages fewer lanes than the pool
+//                        has must not be under-reported);
+//   runs[f]              Run() calls;
+//   steals[f]            blocks a lane claimed from ANOTHER lane's
+//                        deque (work-stealing migrations);
+//   straggler_wait_ns[f] wall time the submitting lane spent waiting,
+//                        out of work, for the last block to finish —
+//                        the tail the slowest lane imposes on the run.
 //
 // The block is plain atomics: recording never takes a lock beyond what
 // Run already holds, and reading is tear-free per counter. Counters
@@ -92,7 +136,10 @@ struct PoolStats {
   std::atomic<int64_t> tasks[kPoolFamilies][kMaxLanes];
   std::atomic<int64_t> queue_wait_ns[kPoolFamilies];
   std::atomic<int64_t> run_wall_ns[kPoolFamilies];
+  std::atomic<int64_t> engaged_wall_ns[kPoolFamilies];
   std::atomic<int64_t> runs[kPoolFamilies];
+  std::atomic<int64_t> steals[kPoolFamilies];
+  std::atomic<int64_t> straggler_wait_ns[kPoolFamilies];
 
   void Reset() {
     for (int f = 0; f < kPoolFamilies; ++f) {
@@ -102,7 +149,10 @@ struct PoolStats {
       }
       queue_wait_ns[f].store(0, std::memory_order_relaxed);
       run_wall_ns[f].store(0, std::memory_order_relaxed);
+      engaged_wall_ns[f].store(0, std::memory_order_relaxed);
       runs[f].store(0, std::memory_order_relaxed);
+      steals[f].store(0, std::memory_order_relaxed);
+      straggler_wait_ns[f].store(0, std::memory_order_relaxed);
     }
   }
 };
@@ -121,6 +171,34 @@ class ThreadPool {
   static int ResolvedSize() {
     static const int n = ResolveSize();
     return n;
+  }
+
+  // hardware_concurrency() re-reads sysfs on glibc (~tens of µs):
+  // resolved ONCE for the process. Every per-call thread resolver in
+  // the kernel .cc files goes through this (the serving_ffi.cc fix,
+  // promoted to the pool layer for all families).
+  static int HardwareThreads() {
+    static const int hw = [] {
+      int n = static_cast<int>(std::thread::hardware_concurrency());
+      return n < 1 ? 1 : n;
+    }();
+    return hw;
+  }
+
+  // Per-family lane cap: YDF_TPU_{HIST,BIN,ROUTE,SERVE}_THREADS, else
+  // the cached hardware_concurrency. The env read stays per-call
+  // (getenv is a library lookup, not a syscall, and tests monkeypatch
+  // the vars mid-process); only the sysfs probe is cached.
+  static int FamilyThreadCap(int family) {
+    static const char* const kEnv[kPoolFamilies] = {
+        "YDF_TPU_HIST_THREADS", "YDF_TPU_BIN_THREADS",
+        "YDF_TPU_ROUTE_THREADS", "YDF_TPU_SERVE_THREADS"};
+    int n = 0;
+    if (family >= 0 && family < kPoolFamilies) {
+      if (const char* env = std::getenv(kEnv[family])) n = std::atoi(env);
+    }
+    if (n <= 0) n = HardwareThreads();
+    return n < 1 ? 1 : n;
   }
 
   // Shared stats block (zero-initialized static storage; one instance
@@ -146,35 +224,85 @@ class ThreadPool {
     return on;
   }
 
+  // YDF_TPU_POOL_NUMA=auto|off (default auto; validated eagerly at the
+  // Python env boundary in ops/pool_stats.py — the C++ side treats any
+  // unrecognized value as "off" so a bad env can disable, never crash).
+  static bool NumaEnabled() {
+    static const bool on = [] {
+      const char* env = std::getenv("YDF_TPU_POOL_NUMA");
+      if (env == nullptr || std::strcmp(env, "auto") == 0) return true;
+      return false;  // "off" and anything unrecognized
+    }();
+    return on;
+  }
+
+  // Number of populated NUMA nodes the pool sees: sysfs node count when
+  // NUMA placement is enabled and the box is multi-node, else 1. 1
+  // means every NUMA branch below is a no-op (the graceful single-node
+  // degradation the bench container exercises).
+  static int NumaNodes() {
+    static const int nodes = [] {
+      if (!NumaEnabled()) return 1;
+      return DetectNodes();
+    }();
+    return nodes;
+  }
+
   static int64_t NowNs() {
     return std::chrono::duration_cast<std::chrono::nanoseconds>(
                std::chrono::steady_clock::now().time_since_epoch())
         .count();
   }
 
+  // Failpoint hook (pool.block_stall, armed through ctypes by
+  // ydf_tpu/ops/pool_stats.py:block_stall): every block whose index is
+  // a multiple of `stride` sleeps `stall_ns` inside its task body. A
+  // pure delay — never touches data or scheduling state — so it forces
+  // maximal stealing and straggler migration while the results stay
+  // bit-identical (the adversarial-steal suites assert exactly that).
+  static void SetBlockStall(int64_t stall_ns, int64_t stride) {
+    StallNs().store(stall_ns < 0 ? 0 : stall_ns, std::memory_order_relaxed);
+    StallStride().store(stride < 1 ? 0 : stride, std::memory_order_relaxed);
+  }
+
   // Runs fn(0) .. fn(m-1) across the pool and the calling thread;
-  // returns when all m tasks finished. At most min(m, size+1) tasks run
-  // concurrently. Whole Run() calls are serialized (two concurrent XLA
+  // returns when all m tasks finished. At most min(m, size, max_lanes)
+  // lanes engage. Whole Run() calls are serialized (two concurrent XLA
   // custom calls queue rather than interleave task sets). `family`
-  // attributes the call's utilization (PoolFamily above).
-  void Run(int family, int m, const std::function<void(int)>& fn) {
+  // attributes the call's utilization (PoolFamily above); `max_lanes`
+  // is the caller's per-call cap (the per-family THREADS env), which
+  // bounds PARALLELISM only — the block set and the caller-side
+  // reduction order never depend on it.
+  void Run(int family, int m, const std::function<void(int)>& fn,
+           int max_lanes = 1 << 30) {
     if (m <= 0) return;
     const bool stats = StatsEnabled();
-    if (m == 1 || workers_.empty()) {
-      // Inline path (single task, or a 1-lane pool): the caller IS the
-      // pool. Timed as lane-0 busy so single-core boxes still report
-      // utilization (~1.0 by construction).
+    if (max_lanes < 1) max_lanes = 1;
+    int engaged = size();
+    if (m < engaged) engaged = m;
+    if (max_lanes < engaged) engaged = max_lanes;
+    if (engaged <= 1 || workers_.empty()) {
+      // Inline path (single lane): the caller IS the pool. Timed as
+      // lane-0 busy so single-core boxes still report utilization
+      // (~1.0 by construction).
       if (!stats) {
-        for (int i = 0; i < m; ++i) fn(i);
+        for (int i = 0; i < m; ++i) {
+          MaybeStall(i);
+          fn(i);
+        }
         return;
       }
       const int64_t t0 = NowNs();
-      for (int i = 0; i < m; ++i) fn(i);
+      for (int i = 0; i < m; ++i) {
+        MaybeStall(i);
+        fn(i);
+      }
       const int64_t dt = NowNs() - t0;
       PoolStats& s = Stats();
       s.busy_ns[family][0].fetch_add(dt, std::memory_order_relaxed);
       s.tasks[family][0].fetch_add(m, std::memory_order_relaxed);
       s.run_wall_ns[family].fetch_add(dt, std::memory_order_relaxed);
+      s.engaged_wall_ns[family].fetch_add(dt, std::memory_order_relaxed);
       s.runs[family].fetch_add(1, std::memory_order_relaxed);
       return;
     }
@@ -185,15 +313,24 @@ class ThreadPool {
       std::lock_guard<std::mutex> lk(mutex_);
       task_fn_ = fn;
       total_ = m;
-      next_ = 0;
       completed_ = 0;
       family_ = family;
+      engaged_ = engaged;
       submit_ns_ = t_submit;
       stats_on_ = stats;
+      // Deal blocks into per-lane deques: lane l owns the contiguous
+      // range [l*m/E, (l+1)*m/E). The deal is a pure function of
+      // (m, E) — the same on every run — which is what makes
+      // first-touch page affinity stick across calls.
+      for (int l = 0; l < engaged; ++l) {
+        deque_lo_[l] = static_cast<int64_t>(l) * m / engaged;
+        deque_hi_[l] = static_cast<int64_t>(l + 1) * m / engaged;
+      }
       gen = ++generation_;
     }
     wake_.notify_all();
     Work(fn, gen, family, /*lane=*/0, stats, t_submit);  // caller joins
+    const int64_t t_idle = stats ? NowNs() : 0;
     {
       std::unique_lock<std::mutex> lk(mutex_);
       done_.wait(lk, [&] { return completed_ == total_; });
@@ -201,33 +338,168 @@ class ThreadPool {
     }
     if (stats) {
       PoolStats& s = Stats();
-      s.run_wall_ns[family].fetch_add(NowNs() - t_submit,
+      const int64_t t_end = NowNs();
+      s.run_wall_ns[family].fetch_add(t_end - t_submit,
                                       std::memory_order_relaxed);
+      s.engaged_wall_ns[family].fetch_add(
+          static_cast<int64_t>(engaged) * (t_end - t_submit),
+          std::memory_order_relaxed);
       s.runs[family].fetch_add(1, std::memory_order_relaxed);
+      // Tail overhang: how long the submitting lane sat out of work
+      // while stragglers finished. High values with idle-lane steals
+      // exhausted = a genuinely serial tail; high values with stalled
+      // deques = imbalance stealing could not fix (block too big).
+      s.straggler_wait_ns[family].fetch_add(t_end - t_idle,
+                                            std::memory_order_relaxed);
     }
   }
 
   int size() const { return static_cast<int>(workers_.size()) + 1; }
 
  private:
+  static constexpr int kMaxPoolLanes = 1024;
+
+  static std::atomic<int64_t>& StallNs() {
+    static std::atomic<int64_t> ns{0};
+    return ns;
+  }
+  static std::atomic<int64_t>& StallStride() {
+    static std::atomic<int64_t> stride{0};
+    return stride;
+  }
+
+  static void MaybeStall(int block) {
+    const int64_t stride = StallStride().load(std::memory_order_relaxed);
+    if (stride <= 0) return;
+    if (block % stride != 0) return;
+    const int64_t ns = StallNs().load(std::memory_order_relaxed);
+    if (ns > 0) std::this_thread::sleep_for(std::chrono::nanoseconds(ns));
+  }
+
+  // Pool sizing: the max of every per-family cap that is explicitly
+  // set (a pool sized for the widest family serves the narrower ones
+  // via per-call lane caps), else hardware_concurrency. Resolved once.
   static int ResolveSize() {
+    static const char* const kEnv[] = {
+        "YDF_TPU_HIST_THREADS", "YDF_TPU_BIN_THREADS",
+        "YDF_TPU_ROUTE_THREADS", "YDF_TPU_SERVE_THREADS"};
     int n = 0;
-    if (const char* env = std::getenv("YDF_TPU_HIST_THREADS")) {
-      n = std::atoi(env);
+    for (const char* name : kEnv) {
+      if (const char* env = std::getenv(name)) {
+        const int v = std::atoi(env);
+        if (v > n) n = v;
+      }
     }
-    if (n <= 0) n = static_cast<int>(std::thread::hardware_concurrency());
+    if (n <= 0) n = HardwareThreads();
     if (n < 1) n = 1;
+    if (n > kMaxPoolLanes) n = kMaxPoolLanes;
     // The caller thread participates in every Run, so n-1 workers give
     // an n-lane pool.
     return n;
   }
 
+  static int DetectNodes() {
+#if defined(__linux__)
+    int n = 0;
+    char path[64];
+    for (int i = 0; i < 256; ++i) {
+      std::snprintf(path, sizeof(path), "/sys/devices/system/node/node%d",
+                    i);
+      struct stat st;
+      if (stat(path, &st) != 0) break;
+      ++n;
+    }
+    return n > 1 ? n : 1;
+#else
+    return 1;
+#endif
+  }
+
+  // Lane -> node map: contiguous stripes (lane l -> node l*nodes/size),
+  // so a node's lanes are adjacent and a steal scan "own node first,
+  // then ascending remote" is a simple reorder of lane indices.
+  int NodeOfLane(int lane) const {
+    const int nodes = NumaNodes();
+    if (nodes <= 1) return 0;
+    return static_cast<int>(static_cast<int64_t>(lane) * nodes / size());
+  }
+
+#if defined(__linux__)
+  // Pin a worker thread to its node's CPU set (parsed once from sysfs
+  // cpulist, e.g. "0-15,32-47"). Pinning is what turns the fixed
+  // block->lane deal into real first-touch locality; failure is
+  // silently ignored (a cpuset-restricted container still works, just
+  // without placement).
+  static void PinToNode(int node) {
+    char path[64];
+    std::snprintf(path, sizeof(path),
+                  "/sys/devices/system/node/node%d/cpulist", node);
+    FILE* f = std::fopen(path, "r");
+    if (f == nullptr) return;
+    char buf[4096];
+    const size_t len = std::fread(buf, 1, sizeof(buf) - 1, f);
+    std::fclose(f);
+    buf[len] = '\0';
+    cpu_set_t set;
+    CPU_ZERO(&set);
+    int ncpu = 0;
+    for (char* p = buf; *p != '\0';) {
+      char* end;
+      long a = std::strtol(p, &end, 10);
+      if (end == p) break;
+      long b = a;
+      p = end;
+      if (*p == '-') {
+        b = std::strtol(p + 1, &end, 10);
+        if (end == p + 1) break;
+        p = end;
+      }
+      for (long c = a; c <= b && c >= 0 && c < CPU_SETSIZE; ++c) {
+        CPU_SET(static_cast<int>(c), &set);
+        ++ncpu;
+      }
+      if (*p == ',') ++p;
+    }
+    if (ncpu > 0) pthread_setaffinity_np(pthread_self(), sizeof(set), &set);
+  }
+#endif
+
   explicit ThreadPool(int workers) {
+    const int lanes = workers + 1;
+    deque_lo_.resize(lanes, 0);
+    deque_hi_.resize(lanes, 0);
+    // Per-lane steal order, built once: own node's lanes ascending,
+    // then remote lanes ascending. On one node this is just "all lanes
+    // ascending" — the NUMA machinery degrades to zero extra work.
+    steal_order_.resize(lanes);
+    for (int l = 0; l < lanes; ++l) {
+      steal_order_[l].reserve(lanes - 1);
+      const int my_node = NodeOfLaneSized(l, lanes);
+      for (int pass = 0; pass < 2; ++pass) {
+        for (int v = 0; v < lanes; ++v) {
+          if (v == l) continue;
+          const bool same = NodeOfLaneSized(v, lanes) == my_node;
+          if ((pass == 0) == same) steal_order_[l].push_back(v);
+        }
+      }
+    }
     workers_.reserve(workers > 0 ? workers : 0);
     for (int i = 0; i < workers; ++i) {
       // Lane i+1: lane 0 is reserved for whichever thread calls Run.
-      workers_.emplace_back([this, i] { WorkerLoop(i + 1); });
+      workers_.emplace_back([this, i, lanes] {
+#if defined(__linux__)
+        if (NumaNodes() > 1) PinToNode(NodeOfLaneSized(i + 1, lanes));
+#endif
+        WorkerLoop(i + 1);
+      });
     }
+  }
+
+  // NodeOfLane before size() is valid (constructor context).
+  static int NodeOfLaneSized(int lane, int lanes) {
+    const int nodes = NumaNodes();
+    if (nodes <= 1 || lanes <= 0) return 0;
+    return static_cast<int>(static_cast<int64_t>(lane) * nodes / lanes);
   }
 
   ~ThreadPool() {
@@ -252,6 +524,7 @@ class ThreadPool {
         wake_.wait(lk, [&] { return stop_ || generation_ != seen; });
         if (stop_) return;
         seen = gen = generation_;
+        if (lane >= engaged_) continue;  // not engaged this run
         task = task_fn_;  // copy: outlives the caller's reference
         family = family_;
         submit_ns = submit_ns_;
@@ -261,12 +534,48 @@ class ThreadPool {
     }
   }
 
-  // Claims the next task index of generation `gen`, or -1 when that
-  // generation is exhausted or superseded.
-  int Claim(uint64_t gen) {
+  // Claims the next block for `lane` of generation `gen`: own deque
+  // front first, else steal from the TAIL of the most-loaded victim in
+  // this lane's steal order (same-node first), or -1 when the
+  // generation is exhausted or superseded. `stole` reports whether the
+  // claim crossed lanes (the steals counter).
+  int Claim(uint64_t gen, int lane, bool* stole) {
     std::lock_guard<std::mutex> lk(mutex_);
-    if (gen != generation_ || next_ >= total_) return -1;
-    return next_++;
+    *stole = false;
+    if (gen != generation_) return -1;
+    if (lane < engaged_ && deque_lo_[lane] < deque_hi_[lane]) {
+      return static_cast<int>(deque_lo_[lane]++);
+    }
+    // Steal: scan this lane's victim order, take from the victim with
+    // the most remaining work among same-node candidates before moving
+    // to remote nodes (the order list is node-partitioned, so a plain
+    // "best in the same-node prefix, else best in the remote suffix"
+    // falls out of one scan with a node boundary check).
+    const std::vector<int>& order =
+        steal_order_[lane < static_cast<int>(steal_order_.size())
+                         ? lane
+                         : static_cast<int>(steal_order_.size()) - 1];
+    const int my_node = NodeOfLane(lane);
+    int best = -1;
+    int64_t best_load = 0;
+    bool best_same_node = false;
+    for (int v : order) {
+      if (v >= engaged_) continue;
+      const int64_t load = deque_hi_[v] - deque_lo_[v];
+      if (load <= 0) continue;
+      const bool same = NodeOfLane(v) == my_node;
+      // Same-node victims categorically beat remote ones; within a
+      // category, prefer the most loaded (halving the worst backlog).
+      if (best < 0 || (same && !best_same_node) ||
+          (same == best_same_node && load > best_load)) {
+        best = v;
+        best_load = load;
+        best_same_node = same;
+      }
+    }
+    if (best < 0) return -1;
+    *stole = true;
+    return static_cast<int>(--deque_hi_[best]);
   }
 
   void Work(const std::function<void(int)>& fn, uint64_t gen, int family,
@@ -274,18 +583,25 @@ class ThreadPool {
     const int slot =
         lane < PoolStats::kMaxLanes ? lane : PoolStats::kMaxLanes - 1;
     while (true) {
-      const int i = Claim(gen);
+      bool stole = false;
+      const int i = Claim(gen, lane, &stole);
       if (i < 0) return;
       if (stats) {
         PoolStats& s = Stats();
+        if (stole) s.steals[family].fetch_add(1, std::memory_order_relaxed);
         const int64_t t0 = NowNs();
         s.queue_wait_ns[family].fetch_add(t0 - submit_ns,
                                           std::memory_order_relaxed);
+        MaybeStall(i);
         fn(i);
         s.busy_ns[family][slot].fetch_add(NowNs() - t0,
                                           std::memory_order_relaxed);
         s.tasks[family][slot].fetch_add(1, std::memory_order_relaxed);
       } else {
+        if (stole) {
+          Stats().steals[family].fetch_add(1, std::memory_order_relaxed);
+        }
+        MaybeStall(i);
         fn(i);
       }
       std::lock_guard<std::mutex> lk(mutex_);
@@ -302,13 +618,20 @@ class ThreadPool {
   std::condition_variable done_;
   std::function<void(int)> task_fn_;
   int total_ = 0;
-  int next_ = 0;
   int completed_ = 0;
   int family_ = 0;
+  int engaged_ = 0;
   int64_t submit_ns_ = 0;
   bool stats_on_ = false;
   uint64_t generation_ = 0;
   bool stop_ = false;
+  // Per-lane block deques as [lo, hi) ranges over the current run's
+  // task indices: owner pops lo++, thieves pop --hi. Guarded by mutex_
+  // (blocks are ~ms; claim contention is noise, and the lock closes
+  // the stale-worker race exactly like the old central counter).
+  std::vector<int64_t> deque_lo_;
+  std::vector<int64_t> deque_hi_;
+  std::vector<std::vector<int>> steal_order_;
 };
 
 }  // namespace ydf_native

@@ -14,12 +14,15 @@ exactly that failure mode. This tool:
   * keeps only MEASURED headline records (projections and error records
     dropped) and pairs them **by record shape**
     `(metric, backend, rows, trees, depth, dist_mode, load_mode,
-    fleet_replicas)` — records whose shape appears in only one round
-    are listed as unpaired, NEVER diffed (the confound class is dead by
-    construction); `load_mode` keeps serving-load artifacts
-    (scripts/bench_serve_load.py) pairing closed-with-closed and
-    open-with-open only, and `fleet_replicas` keeps fleet rounds
-    pairing at identical replica count;
+    fleet_replicas, hist/bin/route/serve_threads)` — records whose
+    shape appears in only one round are listed as unpaired, NEVER
+    diffed (the confound class is dead by construction); `load_mode`
+    keeps serving-load artifacts (scripts/bench_serve_load.py) pairing
+    closed-with-closed and open-with-open only, `fleet_replicas` keeps
+    fleet rounds pairing at identical replica count, and the thread
+    caps (defaulting to 1 when absent, matching the 1-core historical
+    rounds) keep an N-core round from ever diffing against a 1-core
+    one;
   * diffs every per-stage field two paired records share —
     `ingest_s`…`fused_s`, the serving latencies/QPS, the `dist_*`
     family, and the round-15 utilization/memory fields
@@ -61,9 +64,18 @@ from typing import Dict, List, Optional, Tuple
 #: joins it so a 2-replica fleet round never pairs with a 4-replica one
 #: (per-replica QPS scales with the pool — comparing across counts is
 #: the same confound class). Records without those families carry
-#: neither key and pair exactly as before.
+#: neither key and pair exactly as before. The four kernel thread caps
+#: join the key in the many-core round: a 1-core r01–r05 record must
+#: never cross-compare with an N-core r06 one (every per-stage wall
+#: scales with the pool — the exact confound class again). They DEFAULT
+#: TO 1 when absent so the historical records, all measured on the
+#: 1-core box before the fields existed, keep pairing with each other
+#: and with explicit single-threaded rounds.
+THREAD_SHAPE_FIELDS = ("hist_threads", "bin_threads", "route_threads",
+                       "serve_threads")
 SHAPE_FIELDS = ("metric", "backend", "rows", "trees", "depth",
-                "dist_mode", "load_mode", "fleet_replicas")
+                "dist_mode", "load_mode",
+                "fleet_replicas") + THREAD_SHAPE_FIELDS
 
 #: field (or dotted-prefix, trailing ".") -> (direction, rel_noise,
 #: abs_floor). direction "lower" = smaller is better. A change is a
@@ -151,6 +163,13 @@ FIELD_SPECS: Dict[str, Tuple[str, float, float]] = {
     "sketch_split_max_drift": ("lower", 0.50, 0.002),
     # dotted-prefix rules (nested numeric dicts flatten to parent.key)
     "pool_utilization.": ("higher", 0.10, 0.05),
+    # core-scaling family (bench.py measure_core_scaling, many-core
+    # round): speedup and efficiency at the top core count up is good;
+    # engaged_utilization (busy over the lanes a run actually engaged)
+    # dropping means the steal schedule stopped covering stragglers.
+    "scaling_speedup.": ("higher", 0.10, 0.05),
+    "parallel_efficiency.": ("higher", 0.10, 0.05),
+    "engaged_utilization.": ("higher", 0.10, 0.05),
     "infer_batch_p50_ns.": ("lower", 0.15, 100.0),
     "infer_batch_p99_ns.": ("lower", 0.20, 200.0),
     "dist_rpc_p50_ns.": ("lower", 0.25, 1000.0),
@@ -214,13 +233,19 @@ def load_records(path: str) -> List[dict]:
 
 
 def shape_key(rec: dict) -> Tuple:
-    return tuple(rec.get(k) for k in SHAPE_FIELDS)
+    return tuple(
+        rec.get(k, 1) if k in THREAD_SHAPE_FIELDS else rec.get(k)
+        for k in SHAPE_FIELDS
+    )
 
 
 def shape_str(key: Tuple) -> str:
+    # Thread caps at their default (1) stay out of the label: every
+    # historical record would otherwise carry four noise terms.
     return ", ".join(
         f"{name}={val}" for name, val in zip(SHAPE_FIELDS, key)
         if val is not None
+        and not (name in THREAD_SHAPE_FIELDS and val == 1)
     )
 
 

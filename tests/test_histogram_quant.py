@@ -321,6 +321,40 @@ def test_native_bit_stable_across_thread_counts_quant(quant, monkeypatch):
     assert all(np.array_equal(outs[0], o) for o in outs[1:])
 
 
+@needs_native
+@pytest.mark.parametrize("quant", ["f32", "bf16x2", "int8"])
+def test_native_bit_stable_adversarial_steal_quant(quant, monkeypatch):
+    """Steal-SCHEDULE invariance, per quant grid: the work-stealing pool
+    moves whole fixed blocks between lanes but never re-partitions or
+    reorders the reduction, so an armed per-block stall
+    (pool.block_stall failpoint — every other block sleeps, idle lanes
+    must raid the straggler's deque) cannot change a bit of any
+    quantization mode's output."""
+    from ydf_tpu.ops import pool_stats
+    from ydf_tpu.utils import failpoints
+
+    n, F, L, B = 150_000, 6, 8, 64
+    bins, slot, stats = _case(n, F, L, B, seed=11, scale=100.0)
+
+    def run():
+        return np.asarray(histogram(
+            jnp.asarray(bins), jnp.asarray(slot), jnp.asarray(stats),
+            num_slots=L, num_bins=B, impl="native", quant=quant,
+        ))
+
+    monkeypatch.setenv("YDF_TPU_HIST_THREADS", "1")
+    ref = run()
+    for t in ("5", "16"):
+        monkeypatch.setenv("YDF_TPU_HIST_THREADS", t)
+        with failpoints.active("pool.block_stall=stall"):
+            with pool_stats.block_stall(stall_ns=300_000, stride=2) as armed:
+                out = run()
+        assert armed, "stall failpoint did not engage"
+        assert np.array_equal(ref, out), (
+            f"threads={t} under adversarial stall changed bits ({quant})"
+        )
+
+
 # --------------------------------------------------------------------- #
 # Env resolution
 # --------------------------------------------------------------------- #

@@ -449,6 +449,90 @@ print("SANITIZE_RUN_OK", mode)
 """
 
 
+# ThreadSanitizer driver — deliberately FOCUSED on the work-stealing
+# pool's concurrency protocol (claim/steal/completion under mutex_,
+# generation handoff, the stall hook) rather than the whole stack: only
+# the kernel .so is instrumented, so the ctypes surfaces exercise every
+# cross-thread edge tsan can see, and a steal-heavy stall schedule
+# forces the raciest interleaving (thieves draining a stalled lane's
+# deque while it still runs).
+_TSAN_DRIVER = r"""
+import ctypes
+import numpy as np
+from ydf_tpu.ops.native_ffi import KERNELS_LIB
+from ydf_tpu.ops import pool_stats
+from ydf_tpu.utils import failpoints
+
+mode = KERNELS_LIB.sanitize
+assert mode == "tsan", mode
+assert mode in KERNELS_LIB.lib_path, KERNELS_LIB.lib_path
+lib = KERNELS_LIB.load()
+assert lib is not None, "tsan build failed to load"
+
+# 9 row-range tasks over the 4-lane pool (YDF_TPU_HIST_THREADS=4 sizes
+# it; the explicit 16 only caps partitioning) — owners pop heads while
+# thieves raid tails, under a stall that guarantees steals happen.
+n, F, mb = 600_000, 4, 16
+rng = np.random.default_rng(0)
+vals = rng.standard_normal((F, n)).astype(np.float32)
+bounds = np.sort(rng.standard_normal((F, mb)).astype(np.float32), axis=1)
+nb = np.full(F, mb, np.int32)
+imp = np.zeros(F, np.float32)
+out = np.empty((n, F), np.uint8)
+
+def run_bin(threads):
+    lib.ydf_bin_columns(
+        vals.ctypes.data_as(ctypes.c_void_p),
+        bounds.ctypes.data_as(ctypes.c_void_p),
+        nb.ctypes.data_as(ctypes.c_void_p),
+        imp.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(n), ctypes.c_int64(F), ctypes.c_int64(mb),
+        ctypes.c_int64(F), ctypes.c_int32(threads))
+    return out.copy()
+
+ref = run_bin(1)
+for trial in range(5):  # several generations: reuse + re-deal races
+    with failpoints.active("pool.block_stall=stall"):
+        with pool_stats.block_stall(stall_ns=2_000_000, stride=3) as armed:
+            assert armed
+            got = run_bin(16)
+    assert np.array_equal(ref, got), f"trial {trial} changed bits"
+
+# The serving family through its ctypes handle engine: many 512-row
+# blocks per Run, stats accounting from caller AND worker lanes.
+import pandas as pd
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+from ydf_tpu.serving import native_serve
+from ydf_tpu.dataset.dataset import Dataset
+
+rs = np.random.RandomState(3)
+df = pd.DataFrame({f"g{i}": rs.normal(size=6000) for i in range(5)})
+df["y"] = (df["g0"] + df["g1"] * df["g2"]).astype(np.float32)
+m = ydf.GradientBoostedTreesLearner(
+    label="y", task=Task.REGRESSION, num_trees=3, max_depth=4,
+    validation_ratio=0.0, early_stopping="NONE",
+).train(df)
+ds = Dataset.from_data(df, dataspec=m.dataspec)
+x_num, x_cat, _ = m._encode_inputs(ds)
+eng = native_serve.build_native_engine(m)
+assert eng is not None
+import os
+os.environ["YDF_TPU_SERVE_THREADS"] = "1"
+sref = np.asarray(eng(x_num, x_cat))
+os.environ["YDF_TPU_SERVE_THREADS"] = "4"
+with failpoints.active("pool.block_stall=stall"):
+    with pool_stats.block_stall(stall_ns=500_000, stride=3) as armed:
+        assert armed
+        sgot = np.asarray(eng(x_num, x_cat))
+assert np.array_equal(sref, sgot), "stalled serve changed bits"
+s = pool_stats.pool_stats()
+assert s["families"]["bin"]["steals"] >= 1, s["families"]["bin"]
+print("SANITIZE_RUN_OK", mode)
+"""
+
+
 def _gcc_lib(name):
     out = subprocess.run(
         ["g++", f"-print-file-name={name}"], capture_output=True, text=True
@@ -457,14 +541,14 @@ def _gcc_lib(name):
     return path if os.path.sep in path else None
 
 
-def _run(mode, extra_env):
+def _run(mode, extra_env, driver=None):
     env = dict(
         os.environ, JAX_PLATFORMS="cpu", YDF_TPU_NATIVE_SANITIZE=mode,
         **extra_env,
     )
     return subprocess.run(
-        [sys.executable, "-c", _DRIVER], capture_output=True, text=True,
-        timeout=900, cwd=REPO, env=env,
+        [sys.executable, "-c", driver or _DRIVER], capture_output=True,
+        text=True, timeout=900, cwd=REPO, env=env,
     )
 
 
@@ -503,6 +587,43 @@ def test_kernels_clean_under_ubsan():
     assert "runtime error" not in out.stderr, out.stderr[-4000:]
 
 
+@pytest.mark.slow
+def test_pool_clean_under_tsan(tmp_path):
+    """The work-stealing protocol under ThreadSanitizer: forced 4-lane
+    pool, steal-heavy stall schedules across several pool generations
+    (binning ctypes + serving handle engine), bit-compared against the
+    1-thread runs. Any unsynchronized deque/stat/handoff access in
+    native/thread_pool.h fails HERE with a race report.
+
+    Only the kernel .so is instrumented, so stacks entirely inside
+    xla_extension.so (XLA synchronizes through atomics tsan cannot see
+    in uninstrumented code) and the numpy-dealloc-vs-XLA-worker pair
+    during the model train are unavoidable FALSE positives — suppressed
+    by module. The pool's own stacks live in libydfkernels.so and its
+    callers (ctypes), which no suppression names: a real race in
+    claim/steal/completion still fails the test."""
+    libtsan = _gcc_lib("libtsan.so")
+    libstdcpp = _gcc_lib("libstdc++.so.6") or _gcc_lib("libstdc++.so")
+    if libtsan is None:
+        pytest.skip("no libtsan runtime in this toolchain")
+    supp = tmp_path / "tsan_suppressions.txt"
+    supp.write_text("race:xla_extension.so\nrace:_multiarray_umath\n")
+    out = _run(
+        "tsan",
+        {
+            "LD_PRELOAD": f"{libtsan} {libstdcpp}" if libstdcpp else libtsan,
+            "TSAN_OPTIONS": f"halt_on_error=0,suppressions={supp}",
+            "YDF_TPU_HIST_THREADS": "4",
+        },
+        driver=_TSAN_DRIVER,
+    )
+    assert "SANITIZE_RUN_OK tsan" in out.stdout, (
+        f"tsan run failed\nstdout: {out.stdout[-2000:]}\n"
+        f"stderr: {out.stderr[-4000:]}"
+    )
+    assert "WARNING: ThreadSanitizer" not in out.stderr, out.stderr[-4000:]
+
+
 def test_sanitize_mode_env_validation(monkeypatch):
     """Typos fail eagerly at the env boundary (tier-1: fast, no build)."""
     from ydf_tpu.ops import native_ffi
@@ -510,6 +631,8 @@ def test_sanitize_mode_env_validation(monkeypatch):
     monkeypatch.setenv("YDF_TPU_NATIVE_SANITIZE", "asna")
     with pytest.raises(ValueError, match="not a sanitizer mode"):
         native_ffi.sanitize_mode()
+    monkeypatch.setenv("YDF_TPU_NATIVE_SANITIZE", "tsan")
+    assert native_ffi.sanitize_mode() == "tsan"
     monkeypatch.setenv("YDF_TPU_NATIVE_SANITIZE", "asan")
     assert native_ffi.sanitize_mode() == "asan"
     monkeypatch.setenv("YDF_TPU_NATIVE_SANITIZE", "")

@@ -1,0 +1,253 @@
+"""Work-stealing pool scaling contract (many-core round).
+
+The bit-stability suites prove steal schedules cannot change results;
+this file proves the MACHINERY itself: steals actually happen and are
+counted, the straggler/engaged accounting is sane, the NUMA and SIMD
+env knobs validate eagerly and degrade gracefully, and the SIMD routing
+gather is byte-identical to the scalar walk.
+
+Everything pool-structural runs in a SUBPROCESS: the pool's lane count
+is resolved once at singleton creation (first native call of the
+process), so a forced multi-lane pool on this possibly-1-core box needs
+the YDF_TPU_*_THREADS env set before the first ydf_tpu import — exactly
+the boundary bench.py's measure_core_scaling sweep uses.
+"""
+
+import hashlib
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_py(code, **env_over):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **env_over)
+    return subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=600, cwd=REPO, env=env,
+    )
+
+
+_STEAL_DRIVER = r"""
+import ctypes
+import numpy as np
+from ydf_tpu.ops.native_ffi import KERNELS_LIB
+from ydf_tpu.ops import pool_stats
+from ydf_tpu.utils import failpoints
+
+lib = KERNELS_LIB.load()
+assert lib is not None, "native build unavailable"
+assert pool_stats.pool_size() == 4, pool_stats.pool_size()
+
+# 9 fixed row-range tasks (600k rows / 64k-row floor) over a 4-lane
+# pool: lanes own 2-3 blocks each. Block 0 stalls 5 ms while the other
+# blocks run in ~1 ms, so lane 0's remaining backlog MUST be stolen by
+# the drained lanes.
+n, F, mb = 600_000, 4, 16
+rng = np.random.default_rng(0)
+vals = rng.standard_normal((F, n)).astype(np.float32)
+bounds = np.sort(rng.standard_normal((F, mb)).astype(np.float32), axis=1)
+nb = np.full(F, mb, np.int32)
+imp = np.zeros(F, np.float32)
+out = np.empty((n, F), np.uint8)
+
+def run_bin(threads):
+    lib.ydf_bin_columns(
+        vals.ctypes.data_as(ctypes.c_void_p),
+        bounds.ctypes.data_as(ctypes.c_void_p),
+        nb.ctypes.data_as(ctypes.c_void_p),
+        imp.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(n), ctypes.c_int64(F), ctypes.c_int64(mb),
+        ctypes.c_int64(F), ctypes.c_int32(threads))
+    return out.copy()
+
+ref = run_bin(1)
+pool_stats.reset_pool_stats()
+with failpoints.active("pool.block_stall=stall"):
+    with pool_stats.block_stall(stall_ns=5_000_000, stride=100) as armed:
+        assert armed, "stall did not engage"
+        got = run_bin(16)
+assert np.array_equal(ref, got), "stolen blocks changed bits"
+s = pool_stats.pool_stats()
+fam = s["families"]["bin"]
+assert fam["tasks"] == 9, fam["tasks"]
+assert fam["steals"] >= 1, f"no steals counted: {fam}"
+assert fam["straggler_wait_ns"] >= 0
+assert fam["engaged_wall_ns"] > 0
+assert 0.0 < fam["engaged_utilization"] <= 1.0, fam
+# whole-pool vs engaged denominators: engaged never reports LOWER than
+# the whole-pool view (engaged_wall <= size * run_wall).
+assert fam["engaged_utilization"] >= fam["utilization"] - 1e-9, fam
+m = pool_stats.pool_metrics()
+for name in ("ydf_pool_steals_total", "ydf_pool_straggler_wait_ns_total",
+             "ydf_pool_engaged_wall_ns_total"):
+    assert any(k.startswith(name + "{") for k in m), (name, sorted(m))
+print("STEALS_OK", fam["steals"])
+"""
+
+
+def test_steals_counted_and_bit_stable_under_stall():
+    """A forced 4-lane pool with a stalled straggler block must record
+    real steals, keep the output bit-identical, and expose the new
+    counters through pool_stats()/pool_metrics()."""
+    out = _run_py(_STEAL_DRIVER, YDF_TPU_HIST_THREADS="4")
+    assert "STEALS_OK" in out.stdout, (
+        f"stdout: {out.stdout[-2000:]}\nstderr: {out.stderr[-4000:]}"
+    )
+
+
+_NUMA_OFF_DRIVER = r"""
+from ydf_tpu.ops import pool_stats
+assert not pool_stats.POOL_NUMA_ENABLED
+lib_nodes = pool_stats.numa_nodes()
+assert lib_nodes in (0, 1), lib_nodes  # off => placement is a no-op
+print("NUMA_OFF_OK", lib_nodes)
+"""
+
+
+def test_numa_env_off_and_validation():
+    """YDF_TPU_POOL_NUMA=off reports a single placement node (graceful
+    no-op everywhere); a typo fails EAGERLY at import, in-process and in
+    a subprocess."""
+    from ydf_tpu.ops import pool_stats
+
+    assert pool_stats.resolve_pool_numa("auto") is True
+    assert pool_stats.resolve_pool_numa("off") is False
+    with pytest.raises(ValueError, match="YDF_TPU_POOL_NUMA"):
+        pool_stats.resolve_pool_numa("numa-all-the-things")
+    out = _run_py(_NUMA_OFF_DRIVER, YDF_TPU_POOL_NUMA="off")
+    assert "NUMA_OFF_OK" in out.stdout, out.stderr[-2000:]
+    bad = _run_py(
+        "import ydf_tpu.ops.pool_stats", YDF_TPU_POOL_NUMA="interleave"
+    )
+    assert bad.returncode != 0
+    assert "YDF_TPU_POOL_NUMA" in bad.stderr
+
+
+def test_numa_auto_reports_nodes():
+    """auto (default) detects >= 1 node from sysfs; on a single-node box
+    the pool runs exactly as before (the graceful-degradation half of
+    the acceptance bar)."""
+    from ydf_tpu.ops import pool_stats
+
+    if not pool_stats.available():
+        pytest.skip("native library unavailable")
+    assert pool_stats.numa_nodes() >= 1
+
+
+_SIMD_HASH_DRIVER = r"""
+import hashlib
+import numpy as np
+import jax
+import jax.numpy as jnp
+from ydf_tpu.ops import grower, pool_stats
+from ydf_tpu.ops.split_rules import HessianGainRule
+
+import os
+if os.environ.get("YDF_TPU_ROUTE_SIMD") == "off":
+    assert not pool_stats.route_simd_active()
+
+rng = np.random.default_rng(31)
+n, F, B = 70001, 4, 32
+bins = jnp.asarray(rng.integers(0, B, (n, F), dtype=np.int64).astype(np.uint8))
+g = rng.standard_normal(n).astype(np.float32)
+stats = jnp.asarray(
+    np.stack([g, np.ones(n, np.float32), np.ones(n, np.float32)], 1)
+)
+h = hashlib.sha256()
+for fuse in (True, False):
+    res = grower.grow_tree(
+        bins, stats, jax.random.PRNGKey(1), route_impl="native",
+        route_fuse=fuse, rule=HessianGainRule(l2=1.0), max_depth=5,
+        frontier=32, max_nodes=63, num_bins=B, min_examples=2,
+        min_split_gain=0.0,
+    )
+    h.update(np.asarray(res.leaf_id).tobytes())
+    h.update(np.asarray(res.tree.feature).tobytes())
+    h.update(np.asarray(res.tree.threshold_bin).tobytes())
+print("ROUTE_HASH", h.hexdigest(), int(pool_stats.route_simd_active()))
+"""
+
+
+def test_route_simd_scalar_parity_and_env():
+    """The AVX2 gather path and the scalar walk must be byte-identical:
+    two subprocesses grow the same tree (fused AND standalone routing)
+    with YDF_TPU_ROUTE_SIMD=auto vs off and their output hashes must
+    match. Also validates the env knob eagerly."""
+    from ydf_tpu.ops import pool_stats
+
+    assert pool_stats.resolve_route_simd("auto") is True
+    assert pool_stats.resolve_route_simd("off") is False
+    with pytest.raises(ValueError, match="YDF_TPU_ROUTE_SIMD"):
+        pool_stats.resolve_route_simd("sse2")
+    hashes = {}
+    for mode in ("auto", "off"):
+        out = _run_py(_SIMD_HASH_DRIVER, YDF_TPU_ROUTE_SIMD=mode)
+        assert "ROUTE_HASH" in out.stdout, (
+            f"mode={mode}\nstdout: {out.stdout[-2000:]}\n"
+            f"stderr: {out.stderr[-4000:]}"
+        )
+        _, digest, active = out.stdout.strip().split()[-3:]
+        hashes[mode] = digest
+        if mode == "off":
+            assert active == "0", "SIMD stayed active under =off"
+    assert hashes["auto"] == hashes["off"], (
+        "SIMD route diverged from the scalar walk"
+    )
+
+
+@pytest.mark.slow
+def test_measure_core_scaling_record_shape():
+    """bench.measure_core_scaling sweeps {1,2,4,...,nproc} subprocesses
+    and emits per-family wall/speedup/efficiency/utilization/steal
+    curves; on a 1-core box the sweep degrades to one point with the
+    counters still real (the acceptance bar's graceful half)."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+
+    rec = {}
+    bench.measure_core_scaling(150_000, 4, rec)
+    assert "core_scaling_error" not in rec, rec
+    cs = rec["core_scaling"]
+    ncpu = os.cpu_count() or 1
+    assert cs["thread_counts"][0] == 1
+    assert cs["thread_counts"][-1] == ncpu
+    for fam in ("hist", "bin", "route", "serve"):
+        curves = cs["families"][fam]
+        for field in ("wall_s", "scaling_speedup", "parallel_efficiency",
+                      "pool_utilization", "engaged_utilization", "steals"):
+            assert set(curves[field]) == {
+                str(t) for t in cs["thread_counts"]
+            }, (fam, field, curves)
+        assert curves["scaling_speedup"]["1"] == 1.0
+        assert curves["parallel_efficiency"]["1"] == 1.0
+        assert all(0.0 <= u <= 1.0
+                   for u in curves["engaged_utilization"].values())
+    # Flat top-count copies for bench_diff's one-level flatten.
+    assert "hist" in rec["parallel_efficiency"]
+    assert "serve" in rec["scaling_speedup"]
+    # The off switch is a clean no-op.
+    rec_off = {}
+    os.environ["YDF_TPU_BENCH_CORE_SCALING"] = "off"
+    try:
+        bench.measure_core_scaling(150_000, 4, rec_off)
+    finally:
+        del os.environ["YDF_TPU_BENCH_CORE_SCALING"]
+    assert rec_off == {}
+
+
+def test_block_stall_noop_when_unarmed():
+    """Without the failpoint, block_stall() must be a strict no-op (the
+    production path never pays for the chaos hook)."""
+    from ydf_tpu.ops import pool_stats
+
+    with pool_stats.block_stall() as armed:
+        assert armed is False

@@ -317,6 +317,56 @@ def test_thread_count_bit_stability(monkeypatch):
             assert np.array_equal(a, b), t
 
 
+@pytest.mark.parametrize("quant", ["f32", "bf16x2", "int8"])
+def test_steal_schedule_bit_stability(quant, monkeypatch):
+    """Work-stealing only changes WHICH lane runs a block, never the
+    block partition or the ascending-block reduction — so even a
+    pathological steal schedule must reproduce every bit. The
+    pool.block_stall failpoint stalls every other block inside the
+    native pool, forcing idle lanes to steal the straggler's backlog;
+    layer routing, the fused histogram+routing kernels (under every
+    quant grid) and the prediction updates must all match the unstalled
+    1-thread run exactly."""
+    from ydf_tpu.ops import pool_stats
+    from ydf_tpu.utils import failpoints
+
+    monkeypatch.setenv("YDF_TPU_HIST_QUANT", quant)
+    rng = np.random.default_rng(29)
+    n, F, B = 70001, 4, 32
+    bins = jnp.asarray(rng.integers(0, B, (n, F), dtype=np.int64).astype(np.uint8))
+    g = rng.standard_normal(n).astype(np.float32)
+    stats = jnp.asarray(
+        np.stack([g, np.ones(n, np.float32), np.ones(n, np.float32)], 1)
+    )
+    kw = dict(
+        rule=HessianGainRule(l2=1.0), max_depth=4, frontier=16,
+        max_nodes=31, num_bins=B, min_examples=2, min_split_gain=0.0,
+    )
+    leaf = jnp.asarray(rng.integers(0, 31, n).astype(np.int32))
+    raw = jnp.asarray(rng.standard_normal(31).astype(np.float32))
+    preds = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+
+    def run():
+        res = grower.grow_tree(
+            bins, stats, jax.random.PRNGKey(1), route_impl="native", **kw
+        )
+        up = routing_native.leaf_update(leaf, raw, 0.1, preds)
+        return np.asarray(res.leaf_id), np.asarray(up)
+
+    monkeypatch.setenv("YDF_TPU_ROUTE_THREADS", "1")
+    monkeypatch.setenv("YDF_TPU_HIST_THREADS", "1")
+    ref = run()
+    for t in ("3", "16"):
+        monkeypatch.setenv("YDF_TPU_ROUTE_THREADS", t)
+        monkeypatch.setenv("YDF_TPU_HIST_THREADS", t)
+        with failpoints.active("pool.block_stall=stall"):
+            with pool_stats.block_stall(stall_ns=300_000, stride=2) as armed:
+                got = run()
+        assert armed, "stall failpoint did not engage"
+        for a, b in zip(ref, got):
+            assert np.array_equal(a, b), f"threads={t} under stall diverged"
+
+
 def test_leaf_update_matches_xla_rounding():
     """The rounding contract: the kernel must reproduce whatever this
     host's XLA emits for `preds + (raw·η)[leaf]` — fma(raw, η, preds)
@@ -486,8 +536,16 @@ def test_route_impl_env_validation(monkeypatch):
         routing_native.resolve_route_impl(None)
     monkeypatch.setenv("YDF_TPU_ROUTE_IMPL", "native")
     assert routing_native.resolve_route_impl(None) == "native"
-    monkeypatch.delenv("YDF_TPU_ROUTE_IMPL")
+    monkeypatch.setenv("YDF_TPU_ROUTE_IMPL", "xla")
     assert routing_native.resolve_route_impl(None) == "xla"
+    # Default (and explicit auto) flipped to native-when-buildable in
+    # the many-core round — the paired A/B decision recorded in
+    # docs/row_routing.md "Measured".
+    default = "native" if routing_native.available() else "xla"
+    monkeypatch.setenv("YDF_TPU_ROUTE_IMPL", "auto")
+    assert routing_native.resolve_route_impl(None) == default
+    monkeypatch.delenv("YDF_TPU_ROUTE_IMPL")
+    assert routing_native.resolve_route_impl(None) == default
     with pytest.raises(ValueError, match="not a routing impl"):
         routing_native.resolve_route_impl("nativ")
     monkeypatch.setenv("YDF_TPU_UPDATE_FMA", "maybe")

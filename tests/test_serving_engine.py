@@ -261,6 +261,31 @@ def test_serve_batch_thread_bit_stability(monkeypatch):
             assert np.array_equal(out, ref), f"threads={t} changed bits"
 
 
+def test_serve_batch_steal_schedule_bit_stability(monkeypatch):
+    """Per-row purity makes this trivially true — unless stealing were
+    to re-partition the 512-row blocks. The pool.block_stall failpoint
+    stalls every third block so idle lanes must steal the stragglers'
+    backlog; outputs must still match the 1-thread run bit for bit."""
+    from ydf_tpu.ops import pool_stats
+    from ydf_tpu.serving.native_serve import build_native_engine
+    from ydf_tpu.utils import failpoints
+
+    df = _mixed_df(n=5000, seed=7)
+    m = _gbt(df)
+    _, x_num, x_cat = _encoded(m, df)
+    eng = build_native_engine(m)
+    assert eng is not None
+    monkeypatch.setenv("YDF_TPU_SERVE_THREADS", "1")
+    ref = eng(x_num, x_cat)
+    for t in ("2", "16"):
+        monkeypatch.setenv("YDF_TPU_SERVE_THREADS", t)
+        with failpoints.active("pool.block_stall=stall"):
+            with pool_stats.block_stall(stall_ns=100_000, stride=3) as armed:
+                out = eng(x_num, x_cat)
+        assert armed, "stall failpoint did not engage"
+        assert np.array_equal(out, ref), f"threads={t} under stall diverged"
+
+
 # --------------------------------------------------------------------- #
 # Registry / env contract
 # --------------------------------------------------------------------- #

@@ -41,11 +41,17 @@ BUILD_DIR = os.path.join(NATIVE_DIR, "build")
 # fails at the env boundary, not as a silent normal build.
 _SANITIZE_MODES = {"asan": ("-fsanitize=address",),
                    "ubsan": ("-fsanitize=undefined",
-                             "-fno-sanitize-recover=undefined")}
+                             "-fno-sanitize-recover=undefined"),
+                   # ThreadSanitizer: the work-stealing pool's claim /
+                   # steal / completion protocol runs under it in
+                   # tests/test_native_sanitize.py (steal-heavy stall
+                   # schedule included).
+                   "tsan": ("-fsanitize=thread",)}
 
 
 def sanitize_mode():
-    """YDF_TPU_NATIVE_SANITIZE ∈ {asan, ubsan} selects a sanitizer build
+    """YDF_TPU_NATIVE_SANITIZE ∈ {asan, ubsan, tsan} selects a sanitizer
+    build
     (separate .so name, so it never clobbers — or staleness-races — the
     normal build); empty/unset means the plain -O3 build."""
     env = os.environ.get("YDF_TPU_NATIVE_SANITIZE", "").strip().lower()
@@ -271,5 +277,5 @@ KERNELS_LIB = NativeLibrary(
         "ydf_serve_batch": "YdfServeBatch",
     },
     extra_cflags=("-pthread",),
-    extra_deps=("thread_pool.h",),
+    extra_deps=("thread_pool.h", "route_simd.h"),
 )

@@ -19,22 +19,48 @@ module is the ctypes read side:
   * `reset_pool_stats()` — bench/test bracketing, like the kernel wall
     counters.
 
+The work-stealing round (docs/thread_pool.md) added per-family
+`steals` (blocks a lane claimed from another lane's deque),
+`straggler_wait_ns` (the submitting lane's out-of-work tail wait) and
+`engaged_wall_ns` (sum over runs of engaged-lanes × run-wall).
+`pool_stats()` reports BOTH utilization views:
+
+  * `utilization`          = busy / (size × run-wall) — the whole-pool
+    view (a small batch that engages 2 of 16 lanes scores ~2/16);
+  * `engaged_utilization`  = busy / engaged_wall_ns — how busy the
+    lanes a run actually engaged were (the small batch scores ~1.0
+    when those 2 lanes never idled).
+
 Env boundary: YDF_TPU_POOL_STATS ∈ {1, on, 0, off, unset} is validated
 EAGERLY at import (the YDF_TPU_HIST_IMPL policy); default ON — the cost
 is two steady_clock reads per ~ms pool task, noise next to the task
 bodies, and 0 when disabled. The counters never influence task
 partitioning or reduction order, so models and kernel outputs are
 bit-identical with stats on or off
-(tests/test_resource_observability.py).
+(tests/test_resource_observability.py). Same eager policy for the
+many-core knobs consumed by the native side:
+YDF_TPU_POOL_NUMA ∈ {auto, off, unset} (NUMA-aware lane pinning +
+steal-within-node-first ordering; no-op on single-node boxes) and
+YDF_TPU_ROUTE_SIMD ∈ {auto, off, unset} (the AVX2 routing-gather path,
+native/route_simd.h; scalar fallback is byte-identical).
+
+`block_stall()` is the failpoint bridge for the pool's adversarial
+steal schedule: when the `pool.block_stall` site is armed with the
+cooperative `stall` action, the context manager arms a per-block delay
+in the native pool (every stride-th block sleeps), forcing maximal
+stealing and straggler migration — a pure delay, so the bit-stability
+suites can assert steal-schedule invariance against it.
 """
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import os
 from typing import Dict, List, Optional
 
 from ydf_tpu.ops.native_ffi import KERNELS_LIB
+from ydf_tpu.utils import failpoints
 
 #: PoolFamily enum order of native/thread_pool.h — keep in lockstep.
 FAMILIES = ("hist", "bin", "route", "serve")
@@ -66,6 +92,42 @@ def resolve_pool_stats(value: Optional[str]) -> bool:
 #: native side; this constant is the Python-visible resolution).
 POOL_STATS_ENABLED: bool = resolve_pool_stats(None)
 
+_AUTO_OFF = ("auto", "off")
+
+
+def _resolve_auto_off(env_name: str, value: Optional[str]) -> bool:
+    raw = os.environ.get(env_name, "auto") if value is None else value
+    v = raw.strip().lower()
+    if v in ("", "auto"):
+        return True
+    if v == "off":
+        return False
+    raise ValueError(
+        f"{env_name}={raw!r} is not one of {list(_AUTO_OFF)} (or unset)"
+    )
+
+
+def resolve_pool_numa(value: Optional[str] = None) -> bool:
+    """Validates a YDF_TPU_POOL_NUMA value (None reads the env).
+    auto/unset = detect nodes from sysfs, pin worker lanes per node and
+    steal within the node first (a strict no-op on single-node boxes);
+    off = no detection, no pinning, plain ascending steal order."""
+    return _resolve_auto_off("YDF_TPU_POOL_NUMA", value)
+
+
+def resolve_route_simd(value: Optional[str] = None) -> bool:
+    """Validates a YDF_TPU_ROUTE_SIMD value (None reads the env).
+    auto/unset = use the AVX2 routing-gather path when the CPU supports
+    it (native/route_simd.h; per-call shape gates can still fall back);
+    off = always the scalar walk. Both paths are byte-identical — the
+    switch exists for A/B measurement and incident bisection."""
+    return _resolve_auto_off("YDF_TPU_ROUTE_SIMD", value)
+
+
+#: Eager env validation at import, like POOL_STATS_ENABLED.
+POOL_NUMA_ENABLED: bool = resolve_pool_numa(None)
+ROUTE_SIMD_ENABLED: bool = resolve_route_simd(None)
+
 _setup_done = False
 
 
@@ -86,9 +148,19 @@ def _lib():
         lib.ydf_pool_run_wall_ns_total.argtypes = [i32]
         lib.ydf_pool_runs_total.restype = i64
         lib.ydf_pool_runs_total.argtypes = [i32]
+        lib.ydf_pool_steals_total.restype = i64
+        lib.ydf_pool_steals_total.argtypes = [i32]
+        lib.ydf_pool_straggler_wait_ns_total.restype = i64
+        lib.ydf_pool_straggler_wait_ns_total.argtypes = [i32]
+        lib.ydf_pool_engaged_wall_ns_total.restype = i64
+        lib.ydf_pool_engaged_wall_ns_total.argtypes = [i32]
         lib.ydf_pool_size.restype = i32
         lib.ydf_pool_max_lanes.restype = i32
         lib.ydf_pool_stats_enabled.restype = i32
+        lib.ydf_pool_numa_nodes.restype = i32
+        lib.ydf_route_simd_active.restype = i32
+        lib.ydf_pool_set_block_stall.restype = None
+        lib.ydf_pool_set_block_stall.argtypes = [i64, i64]
         _setup_done = True
     return lib
 
@@ -104,6 +176,49 @@ def pool_size() -> int:
     return int(lib.ydf_pool_size()) if lib is not None else 0
 
 
+def numa_nodes() -> int:
+    """NUMA nodes the pool places against (1 = placement is a no-op:
+    single-node box or YDF_TPU_POOL_NUMA=off); 0 when unavailable."""
+    lib = _lib()
+    return int(lib.ydf_pool_numa_nodes()) if lib is not None else 0
+
+
+def route_simd_active() -> bool:
+    """Whether the AVX2 routing-gather path is live in this process
+    (compiled in + CPUID + YDF_TPU_ROUTE_SIMD); per-call shape gates
+    can still fall back to the (byte-identical) scalar walk."""
+    lib = _lib()
+    return bool(lib.ydf_route_simd_active()) if lib is not None else False
+
+
+@contextlib.contextmanager
+def block_stall(stall_ns: int = 2_000_000, stride: int = 2):
+    """Failpoint-driven adversarial steal schedule: if the
+    `pool.block_stall` site is armed with the cooperative `stall`
+    action (failpoints grammar: "pool.block_stall=stall"), every pool
+    block whose index is a multiple of `stride` sleeps `stall_ns`
+    inside its task body for the duration of the with-block. The delay
+    is pure — no data, partitioning or reduction-order effect — so it
+    forces maximal cross-lane stealing while results stay bit-identical
+    (the thread bit-stability suites assert exactly that). A no-op when
+    the site is not armed or the native library is unavailable; yields
+    whether the stall actually engaged."""
+    lib = _lib()
+    armed = (
+        lib is not None
+        and failpoints.hit("pool.block_stall") == "stall"
+        and stride > 0
+        and stall_ns > 0
+    )
+    if armed:
+        lib.ydf_pool_set_block_stall(int(stall_ns), int(stride))
+    try:
+        yield armed
+    finally:
+        if armed:
+            lib.ydf_pool_set_block_stall(0, 0)
+
+
 def reset_pool_stats() -> None:
     """Zeroes the shared stats block (bench/test bracketing)."""
     lib = _lib()
@@ -112,13 +227,19 @@ def reset_pool_stats() -> None:
 
 
 def pool_stats() -> Dict[str, object]:
-    """Structured snapshot: {"size", "enabled", "families": {name:
-    {"busy_ns", "tasks", "queue_wait_ns", "run_wall_ns", "runs",
-    "utilization", "per_lane_busy_ns"}}}. Empty dict when the native
-    library is unavailable. `utilization` = busy / (size × run_wall) —
-    1.0 means every lane was inside a task body for the family's whole
-    pooled wall; low values mean lanes idled (queue starvation, serial
-    reduction tails, or a task count below the lane count)."""
+    """Structured snapshot: {"size", "enabled", "numa_nodes",
+    "families": {name: {"busy_ns", "tasks", "queue_wait_ns",
+    "run_wall_ns", "engaged_wall_ns", "runs", "steals",
+    "straggler_wait_ns", "utilization", "engaged_utilization",
+    "per_lane_busy_ns"}}}. Empty dict when the native library is
+    unavailable. `utilization` = busy / (size × run_wall) — 1.0 means
+    every lane was inside a task body for the family's whole pooled
+    wall; `engaged_utilization` = busy / engaged_wall_ns judges only
+    the lanes each run actually engaged, so small batches are not
+    under-reported by idle-by-design lanes. Low engaged utilization
+    with high `steals` means imbalance stealing could not absorb
+    (blocks too coarse); high `straggler_wait_ns` with few steals means
+    a genuinely serial tail."""
     lib = _lib()
     if lib is None:
         return {}
@@ -134,20 +255,30 @@ def pool_stats() -> Dict[str, object]:
             int(lib.ydf_pool_tasks_total(fi, l)) for l in range(lanes)
         )
         wall = int(lib.ydf_pool_run_wall_ns_total(fi))
+        engaged_wall = int(lib.ydf_pool_engaged_wall_ns_total(fi))
         fams[name] = {
             "busy_ns": busy,
             "tasks": tasks,
             "queue_wait_ns": int(lib.ydf_pool_queue_wait_ns_total(fi)),
             "run_wall_ns": wall,
+            "engaged_wall_ns": engaged_wall,
             "runs": int(lib.ydf_pool_runs_total(fi)),
+            "steals": int(lib.ydf_pool_steals_total(fi)),
+            "straggler_wait_ns": int(
+                lib.ydf_pool_straggler_wait_ns_total(fi)
+            ),
             "utilization": (
                 round(busy / (size * wall), 4) if wall > 0 and size else 0.0
+            ),
+            "engaged_utilization": (
+                round(busy / engaged_wall, 4) if engaged_wall > 0 else 0.0
             ),
             "per_lane_busy_ns": per_lane,
         }
     return {
         "size": size,
         "enabled": bool(lib.ydf_pool_stats_enabled()),
+        "numa_nodes": int(lib.ydf_pool_numa_nodes()),
         "families": fams,
     }
 
@@ -158,10 +289,12 @@ def pool_metrics() -> Dict[str, float]:
     `ydf_pool_busy_ns_total{pool=...,worker=...}` and
     `ydf_pool_tasks_total{...}`, per-family
     `ydf_pool_queue_wait_ns_total{pool=...}` /
-    `ydf_pool_run_wall_ns_total{pool=...}` / `ydf_pool_runs_total{...}`,
-    plus the unlabeled `ydf_pool_size` gauge. Lanes that never ran a
-    task are omitted so a 128-core box does not dump 128 zero series
-    per family."""
+    `ydf_pool_run_wall_ns_total{pool=...}` / `ydf_pool_runs_total{...}`
+    / `ydf_pool_steals_total{...}` /
+    `ydf_pool_straggler_wait_ns_total{...}` /
+    `ydf_pool_engaged_wall_ns_total{...}`, plus the unlabeled
+    `ydf_pool_size` gauge. Lanes that never ran a task are omitted so a
+    128-core box does not dump 128 zero series per family."""
     lib = _lib()
     if lib is None:
         return {}
@@ -188,4 +321,13 @@ def pool_metrics() -> Dict[str, float]:
             lib.ydf_pool_run_wall_ns_total(fi)
         )
         out[f"ydf_pool_runs_total{lab}"] = float(runs)
+        out[f"ydf_pool_steals_total{lab}"] = float(
+            lib.ydf_pool_steals_total(fi)
+        )
+        out[f"ydf_pool_straggler_wait_ns_total{lab}"] = float(
+            lib.ydf_pool_straggler_wait_ns_total(fi)
+        )
+        out[f"ydf_pool_engaged_wall_ns_total{lab}"] = float(
+            lib.ydf_pool_engaged_wall_ns_total(fi)
+        )
     return out

@@ -44,8 +44,16 @@ def resolve_route_impl(value=None) -> str:
     caveats as ops/histogram.py:resolve_hist_impl — the boosting loop's
     closure cache IS keyed on the resolved impl, so set the env before
     train()). An explicit value wins; YDF_TPU_ROUTE_IMPL selects
-    globally; default/"auto" is "xla" — the exact pipeline stays the
-    default and the native path is an opt-in pure speed switch.
+    globally; default/"auto" is "native" when the kernel library is
+    buildable, else "xla". The default FLIPPED in the many-core round:
+    with the AVX2 routing gather, the paired A/B at the bench shape
+    measured native-fused 0.34 s FASTER than the XLA chain (it was
+    +0.26 s slower before the SIMD path — docs/row_routing.md
+    "Measured" records both sides of the decision). Both impls remain
+    bit-identical, so the flip is pure speed; YDF_TPU_ROUTE_IMPL=xla
+    restores the old pipeline wholesale. The learner still demotes
+    native to xla for mesh/TPU backends, DART and K > 1 losses
+    (learners/gbt.py — compiler-whim FMA contraction, same doc).
     Validation is EAGER: a typo fails here, at the env boundary."""
     if value is not None and value != "auto":
         if value not in _ROUTE_IMPLS:
@@ -55,17 +63,16 @@ def resolve_route_impl(value=None) -> str:
             )
         return value
     env = os.environ.get("YDF_TPU_ROUTE_IMPL")
-    if env is None:
-        return "xla"
-    low = env.strip().lower()
-    if low == "auto":
-        return "xla"
-    if low not in _ROUTE_IMPLS:
-        raise ValueError(
-            f"YDF_TPU_ROUTE_IMPL={env!r} is not a routing impl; expected "
-            f"one of {sorted(_ROUTE_IMPLS)} (or 'auto')"
-        )
-    return low
+    if env is not None:
+        low = env.strip().lower()
+        if low != "auto":
+            if low not in _ROUTE_IMPLS:
+                raise ValueError(
+                    f"YDF_TPU_ROUTE_IMPL={env!r} is not a routing impl; "
+                    f"expected one of {sorted(_ROUTE_IMPLS)} (or 'auto')"
+                )
+            return low
+    return "native" if available() else "xla"
 
 
 def resolve_route_fuse() -> bool:

@@ -41,6 +41,11 @@ Actions:
               responsible for simulating a crash mid-write (truncate the
               payload, then raise FailpointError). Only sites that
               document torn-write support accept it.
+  stall       cooperative: hit() RETURNS "stall" and the site arms a
+              per-block delay in the native work-stealing pool
+              (pool_stats.block_stall() — adversarial steal schedules
+              for the bit-stability suites). Only `pool.block_stall`
+              accepts it.
 
 Overhead contract: with YDF_TPU_FAILPOINTS unset, every instrumented
 site costs one module-global boolean check (`ENABLED`, computed once at
@@ -160,13 +165,26 @@ KNOWN_SITES = frozenset(
         # rollback path (old version keeps serving everywhere).
         "fleet.replica_predict",
         "fleet.swap",
+        # ops/pool_stats.py — adversarial-steal schedule for the native
+        # work-stealing pool. The cooperative `stall` action makes
+        # pool_stats.block_stall() arm a per-block busy-delay inside the
+        # native workers (every stride-th block sleeps before running),
+        # turning uniform block costs into a pathological straggler
+        # pattern so idle lanes MUST steal. The bit-stability suites use
+        # it to prove results are invariant under steal schedule, not
+        # just thread count.
+        "pool.block_stall",
     }
 )
 
 #: Sites that implement the cooperative torn_write action.
 TORN_WRITE_SITES = frozenset({"snapshot.save"})
 
-_ACTIONS = ("error", "fail_once", "drop_conn", "torn_write")
+#: Sites that implement the cooperative stall action (native-pool
+#: per-block delay; see pool_stats.block_stall()).
+STALL_SITES = frozenset({"pool.block_stall"})
+
+_ACTIONS = ("error", "fail_once", "drop_conn", "torn_write", "stall")
 
 
 @dataclasses.dataclass
@@ -224,6 +242,11 @@ def parse(spec: str) -> Dict[str, _Spec]:
                 f"site {site!r} does not support torn_write (supported: "
                 f"{sorted(TORN_WRITE_SITES)}); use 'error' instead"
             )
+        if action == "stall" and site not in STALL_SITES:
+            raise ValueError(
+                f"site {site!r} does not support stall (supported: "
+                f"{sorted(STALL_SITES)}); use 'error' instead"
+            )
         if site in out:
             raise ValueError(
                 f"YDF_TPU_FAILPOINTS lists site {site!r} twice"
@@ -278,7 +301,7 @@ def hit(site: str) -> Optional[str]:
         raise ConnectionError(
             f"injected connection drop at {site!r} (hit {at})"
         )
-    return action  # "torn_write"
+    return action  # cooperative: "torn_write" / "stall"
 
 
 def fired_sites() -> List[str]:

@@ -410,6 +410,9 @@ COLLECTOR_METRICS: Dict[str, str] = {
     "ydf_pool_queue_wait_ns_total": "counter",
     "ydf_pool_run_wall_ns_total": "counter",
     "ydf_pool_runs_total": "counter",
+    "ydf_pool_steals_total": "counter",
+    "ydf_pool_straggler_wait_ns_total": "counter",
+    "ydf_pool_engaged_wall_ns_total": "counter",
     "ydf_pool_size": "gauge",
     # memory ledger (MemoryLedger below)
     "ydf_mem_bytes": "gauge",

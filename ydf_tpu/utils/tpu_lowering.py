@@ -165,11 +165,14 @@ def export_grow_tree(
     rule = HessianGainRule(l2=0.0)
 
     def one_tree(bins, stats, key):
+        # route_impl pinned to the XLA chain: the native fused route is a
+        # CPU custom call (the ambient default since the many-core round),
+        # which cannot serialize into a TPU export.
         return grow_tree(
             bins, stats, key,
             rule=rule, max_depth=max_depth, frontier=cfg.frontier,
             max_nodes=cfg.max_nodes, num_bins=num_bins, num_numerical=F,
-            hist_impl=hist_impl,
+            hist_impl=hist_impl, route_impl="xla",
         )
 
     args = (
@@ -351,11 +354,13 @@ def grow_tree_cost(
     rule = HessianGainRule(l2=0.0)
 
     def one_tree(bins, stats, key):
+        # route_impl="xla" for the same reason as export_grow_tree: the
+        # cost model must count the HLO the TPU runs, not host callbacks.
         return grow_tree(
             bins, stats, key,
             rule=rule, max_depth=max_depth, frontier=cfg.frontier,
             max_nodes=cfg.max_nodes, num_bins=num_bins, num_numerical=F,
-            hist_impl=hist_impl,
+            hist_impl=hist_impl, route_impl="xla",
         )
 
     lowered = jax.jit(one_tree).lower(
