@@ -73,9 +73,19 @@ from typing import Dict, List, Optional, Tuple
 #: and with explicit single-threaded rounds.
 THREAD_SHAPE_FIELDS = ("hist_threads", "bin_threads", "route_threads",
                        "serve_threads")
+#: `device_loop` is the active YDF_TPU_TREES_PER_DISPATCH override on
+#: the record (bench.py headline; 0 = knob unset, the driver's own
+#: chunking). It is a SHAPE field because a knob-forced chunking
+#: changes what dispatches_per_tree / train_wall_s mean — a tpd=1
+#: per-tree-baseline record must never pair against a default or
+#: tpd=25 one. DEFAULTS TO 0 when absent so every historical record
+#: (all measured before the knob existed, i.e. knob unset) keeps
+#: pairing with new default-driver records.
+LOOP_SHAPE_FIELDS = ("device_loop",)
 SHAPE_FIELDS = ("metric", "backend", "rows", "trees", "depth",
                 "dist_mode", "load_mode",
-                "fleet_replicas") + THREAD_SHAPE_FIELDS
+                "fleet_replicas") + THREAD_SHAPE_FIELDS \
+    + LOOP_SHAPE_FIELDS
 
 #: field (or dotted-prefix, trailing ".") -> (direction, rel_noise,
 #: abs_floor). direction "lower" = smaller is better. A change is a
@@ -94,6 +104,14 @@ FIELD_SPECS: Dict[str, Tuple[str, float, float]] = {
     "route_s": ("lower", 0.20, 0.05),
     "update_s": ("lower", 0.20, 0.05),
     "fused_s": ("lower", 0.15, 0.1),
+    # Device-resident boosting loop (ops/device_loop.py accounting
+    # around the steady train): fewer XLA dispatches and fewer
+    # host-materialized bytes per tree are better. dispatches_per_tree
+    # is a deterministic count (noise band only absorbs chunk-tail
+    # rounding); host_sync is byte-exact per shape, the floor absorbs
+    # dtype-width churn.
+    "dispatches_per_tree": ("lower", 0.10, 0.01),
+    "host_sync_bytes_per_tree": ("lower", 0.10, 1024.0),
     "infer_ns_per_example": ("lower", 0.10, 30.0),
     "infer_p50_ns": ("lower", 0.10, 30.0),
     "infer_p99_ns": ("lower", 0.15, 60.0),
@@ -234,18 +252,22 @@ def load_records(path: str) -> List[dict]:
 
 def shape_key(rec: dict) -> Tuple:
     return tuple(
-        rec.get(k, 1) if k in THREAD_SHAPE_FIELDS else rec.get(k)
+        rec.get(k, 1) if k in THREAD_SHAPE_FIELDS
+        else rec.get(k, 0) if k in LOOP_SHAPE_FIELDS
+        else rec.get(k)
         for k in SHAPE_FIELDS
     )
 
 
 def shape_str(key: Tuple) -> str:
-    # Thread caps at their default (1) stay out of the label: every
-    # historical record would otherwise carry four noise terms.
+    # Thread caps at their default (1) and the dispatch-chunk knob at
+    # its default (0 = unset) stay out of the label: every historical
+    # record would otherwise carry the noise terms.
     return ", ".join(
         f"{name}={val}" for name, val in zip(SHAPE_FIELDS, key)
         if val is not None
         and not (name in THREAD_SHAPE_FIELDS and val == 1)
+        and not (name in LOOP_SHAPE_FIELDS and val == 0)
     )
 
 

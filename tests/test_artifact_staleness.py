@@ -1,0 +1,70 @@
+"""Lowering-artifact staleness check (tier-1): the committed
+artifacts/tpu_lowering/ exports carry sha256 digests of every kernel
+source file they were generated from
+(utils/tpu_lowering.py:kernel_source_digests). If a kernel source
+changes without `JAX_PLATFORMS=cpu python -m ydf_tpu.utils.tpu_lowering`
+being re-run, the digests diverge and this fails — the committed
+Mosaic-lowering evidence must never silently describe code that no
+longer exists."""
+
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SUMMARY = os.path.join(REPO, "artifacts", "tpu_lowering", "summary.json")
+
+
+@pytest.fixture(scope="module")
+def summary():
+    if not os.path.isfile(SUMMARY):
+        pytest.skip("no committed lowering artifacts")
+    with open(SUMMARY) as f:
+        return json.load(f)
+
+
+def test_artifacts_match_kernel_sources(summary):
+    from ydf_tpu.utils.tpu_lowering import kernel_source_digests
+
+    committed = summary.get("source_digests")
+    assert committed, (
+        "summary.json has no source_digests — regenerate with "
+        "`JAX_PLATFORMS=cpu python -m ydf_tpu.utils.tpu_lowering`"
+    )
+    current = kernel_source_digests()
+    stale = {
+        path: (committed.get(path), h)
+        for path, h in current.items()
+        if committed.get(path) != h
+    }
+    assert not stale, (
+        "kernel sources changed since artifacts/tpu_lowering/ was "
+        f"generated — re-run the export. Stale: {sorted(stale)}"
+    )
+    # And no tracked source vanished without a regenerate either.
+    assert set(committed) == set(current)
+
+
+def test_digest_inventory_covers_fused_kernel(summary):
+    """The staleness net must include the fused route+histogram kernel
+    source and the export script itself."""
+    digests = summary.get("source_digests", {})
+    assert "ydf_tpu/ops/histogram_pallas.py" in digests
+    assert "ydf_tpu/utils/tpu_lowering.py" in digests
+
+
+def test_fused_route_accounting_present(summary):
+    """The MXU projection must state its routing basis — routing is no
+    longer projected as free (ISSUE 18 satellite 1)."""
+    acc = summary.get("fused_route_accounting")
+    assert acc and acc["route_flops_per_tree"] > 0
+    assert acc["route_mxu_passes_per_mac"] == 3.0  # routing dots are f32
+    assert acc["hist_slot_hbm_bytes_avoided_per_tree"] > 0
+    proj = summary.get("projection_by_quant")
+    assert proj and set(proj) == {"f32", "bf16x2", "int8"}
+    for p in proj.values():
+        assert "no longer projected as free" in p["basis"]
+        for row in p["rows"]:
+            assert row["route_flops_per_tree"] > 0
+            assert row["route_mxu_passes_per_mac"] == 3.0

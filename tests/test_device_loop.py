@@ -1,0 +1,202 @@
+"""Device-resident boosting loop (ops/device_loop.py): multi-tree
+donated-carry dispatch must be INVISIBLE in the results — any
+YDF_TPU_TREES_PER_DISPATCH chunking produces the same model arrays and
+per-iteration losses as the single fused scan, early stopping fires at
+the same iteration, snapshot/resume at a chunk boundary is
+bit-identical — while the host-sync accounting counts what the driver
+actually dispatched (docs/device_loop.md)."""
+
+import numpy as np
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.learners.gbt import _TrainingAborted
+from ydf_tpu.ops import device_loop
+
+
+def _data(n=900, seed=3, nan_cat=False):
+    rng = np.random.RandomState(seed)
+    d = {"x1": rng.normal(size=n), "x2": rng.normal(size=n)}
+    y = (
+        d["x1"] + 0.5 * d["x2"] + rng.normal(scale=0.5, size=n) > 0
+    ).astype(np.int64)
+    if nan_cat:
+        x3 = rng.normal(size=n)
+        x3[rng.rand(n) < 0.15] = np.nan  # missing-value routing
+        d["x3"] = x3
+        d["c1"] = rng.choice(["a", "b", "c", "d"], size=n)
+    d["y"] = y
+    return d
+
+
+def _train(data, tpd, monkeypatch, **kw):
+    if tpd is None:
+        monkeypatch.delenv("YDF_TPU_TREES_PER_DISPATCH", raising=False)
+    else:
+        monkeypatch.setenv("YDF_TPU_TREES_PER_DISPATCH", str(tpd))
+    try:
+        return ydf.GradientBoostedTreesLearner(label="y", **kw).train(
+            data
+        )
+    finally:
+        monkeypatch.delenv("YDF_TPU_TREES_PER_DISPATCH", raising=False)
+
+
+def _assert_identical(a, b, data):
+    import jax
+
+    for la, lb in zip(jax.tree.leaves(a.forest), jax.tree.leaves(b.forest)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    assert a.training_logs["train_loss"] == b.training_logs["train_loss"]
+    assert a.training_logs["valid_loss"] == b.training_logs["valid_loss"]
+    np.testing.assert_array_equal(a.predict(data), b.predict(data))
+
+
+_KW = dict(num_trees=11, max_depth=3, random_seed=7,
+           validation_ratio=0.0, early_stopping="NONE")
+
+
+@pytest.mark.parametrize("quant", ["f32", "bf16x2", "int8"])
+def test_chunked_equals_single_scan_per_quant(quant, monkeypatch):
+    """Single fused scan (knob unset) vs per-tree dispatch (tpd=1) vs
+    a chunk length that does not divide num_trees (tpd=4 on 11 trees):
+    model arrays AND per-iteration losses bit-identical in every
+    gradient-quantization mode."""
+    monkeypatch.setenv("YDF_TPU_HIST_QUANT", quant)
+    data = _data()
+    base = _train(data, None, monkeypatch, **_KW)
+    per_tree = _train(data, 1, monkeypatch, **_KW)
+    chunked = _train(data, 4, monkeypatch, **_KW)
+    _assert_identical(base, per_tree, data)
+    _assert_identical(base, chunked, data)
+
+
+def test_chunked_equals_single_scan_sampling(monkeypatch):
+    """Row subsampling + feature sampling draw from the carried PRNG
+    key; per-iteration randomness folds the ABSOLUTE iteration index,
+    so chunk boundaries must not move any draw."""
+    data = _data(seed=5)
+    kw = dict(_KW, subsample=0.7, num_candidate_attributes=1)
+    base = _train(data, None, monkeypatch, **kw)
+    chunked = _train(data, 3, monkeypatch, **kw)
+    _assert_identical(base, chunked, data)
+
+
+def test_chunked_equals_single_scan_nan_categorical(monkeypatch):
+    data = _data(seed=6, nan_cat=True)
+    base = _train(data, None, monkeypatch, **_KW)
+    chunked = _train(data, 5, monkeypatch, **_KW)
+    _assert_identical(base, chunked, data)
+
+
+def test_early_stop_same_iteration(monkeypatch):
+    """In-loop early stopping is decided from the per-iteration
+    validation losses — identical across chunkings — so every chunk
+    length keeps the SAME trees, whatever boundary the driver noticed
+    the stall at."""
+    rng = np.random.RandomState(3)
+    n = 800
+    x = rng.normal(size=n)
+    y = (x + rng.normal(scale=2.0, size=n) > 0).astype(np.int64)
+    data = {"x": x, "y": y}
+    kw = dict(num_trees=80, max_depth=3, random_seed=7,
+              early_stopping="LOSS_INCREASE",
+              early_stopping_num_trees_look_ahead=10)
+    a = _train(data, 1, monkeypatch, **kw)
+    b = _train(data, 7, monkeypatch, **kw)
+    assert a.training_logs["num_trees"] < 80  # it actually stopped
+    assert a.training_logs["num_trees"] == b.training_logs["num_trees"]
+    assert a.num_trees() == b.num_trees()
+    kept = a.training_logs["num_trees"]
+    assert (
+        a.training_logs["train_loss"][:kept]
+        == b.training_logs["train_loss"][:kept]
+    )
+    np.testing.assert_array_equal(a.predict(data), b.predict(data))
+
+
+def test_snapshot_resume_at_chunk_boundary(monkeypatch, tmp_path):
+    """Preemption at a fused-chunk boundary: kill after one 5-tree
+    dispatch, resume, and the final model is bit-identical to the
+    uninterrupted single-scan train (donated carries never leak into
+    the snapshot — it serializes the NEW carry)."""
+    data = _data()
+    kw = dict(label="y", num_trees=12, max_depth=3, random_seed=7)
+    base = ydf.GradientBoostedTreesLearner(**kw).train(data)
+
+    monkeypatch.setenv("YDF_TPU_TREES_PER_DISPATCH", "5")
+    learner = ydf.GradientBoostedTreesLearner(
+        working_dir=str(tmp_path),
+        resume_training_snapshot_interval_trees=5, **kw,
+    )
+    learner._abort_after_chunks = 1
+    with pytest.raises(_TrainingAborted):
+        learner.train(data)
+    resumed = ydf.GradientBoostedTreesLearner(
+        working_dir=str(tmp_path), resume_training=True,
+        resume_training_snapshot_interval_trees=5, **kw,
+    ).train(data)
+    np.testing.assert_array_equal(base.predict(data), resumed.predict(data))
+
+
+def test_chunk_fn_cached_across_chunk_lengths():
+    """The donated-carry jit wrapper is built ONCE per run object;
+    changing chunk_len mid-run (5,2,5-style tails) must reuse the same
+    callable and compile one executable per distinct length — the
+    retrace regression this round fixes."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    class _Run:
+        pass
+
+    @functools.partial(jax.jit, static_argnames=("chunk_len",))
+    def run_chunk(carry, start, chunk_len, xs):
+        def step(c, i):
+            return c + xs * (start + i), c
+
+        return jax.lax.scan(step, carry, jnp.arange(chunk_len))
+
+    run = _Run()
+    run.run_chunk = run_chunk
+    fn = device_loop.chunk_fn(run)
+    assert device_loop.chunk_fn(run) is fn  # cached per run
+    carry = jnp.zeros(4)
+    xs = jnp.ones(4)
+    for clen in (3, 2, 3, 2, 3):
+        carry, _ = device_loop.run_chunk(run, carry, 0, clen, xs)
+    # Two distinct static chunk lengths -> exactly two executables;
+    # start is a device scalar, so offsets never fork compilations.
+    assert fn._cache_size() == 2
+
+
+def test_stats_accounting(monkeypatch):
+    """12 trees at 5 trees/dispatch = dispatches at starts 0/5/10 (the
+    tail overshoots by design — one executable serves every chunk);
+    host-sync bytes count the per-chunk output fetches."""
+    data = _data()
+    device_loop.reset_stats()
+    _train(data, 5, monkeypatch, num_trees=12, max_depth=3,
+           random_seed=7, validation_ratio=0.0, early_stopping="NONE")
+    snap = device_loop.stats_snapshot()
+    assert snap["dispatches"] == 3
+    assert snap["device_loop"] == 5  # the chunk length dispatched
+    assert snap["host_sync_bytes"] > 0
+    assert snap["host_sync_bytes_per_tree"] > 0
+    assert 0 < snap["dispatches_per_tree"] < 1
+    device_loop.reset_stats()
+    assert device_loop.stats_snapshot()["dispatches"] == 0
+
+
+def test_env_validation(monkeypatch):
+    monkeypatch.setenv("YDF_TPU_TREES_PER_DISPATCH", "zero")
+    with pytest.raises(ValueError, match="YDF_TPU_TREES_PER_DISPATCH"):
+        device_loop.trees_per_dispatch(None)
+    monkeypatch.setenv("YDF_TPU_TREES_PER_DISPATCH", "0")
+    with pytest.raises(ValueError, match="YDF_TPU_TREES_PER_DISPATCH"):
+        device_loop.trees_per_dispatch(None)
+    monkeypatch.delenv("YDF_TPU_TREES_PER_DISPATCH", raising=False)
+    assert device_loop.trees_per_dispatch(None) is None
+    assert device_loop.trees_per_dispatch(25) == 25

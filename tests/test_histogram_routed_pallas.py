@@ -1,0 +1,203 @@
+"""Fused Mosaic route+histogram kernel
+(ops/histogram_pallas.py:histogram_routed_pallas, dispatched via
+ops/routing.py:route_histogram_fused): interpret mode must be
+bit-identical to the grower's XLA routing chain + routed histogram
+across every YDF_TPU_HIST_QUANT mode, and the kernel must
+Mosaic-lower for platform 'tpu' (docs/row_routing.md "The TPU fusion
+seam")."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from ydf_tpu.ops.histogram_pallas import histogram_routed_pallas
+from ydf_tpu.ops.routing import route_histogram_fused
+
+
+def _case(seed=0, n=700, F=5, B=16, L=8, Lh=4, S=3, identity_hmap=False):
+    """One routed-histogram layer: padded [L+1] decision tables (trash
+    slot = L), rows spread over live + trash slots, a forced-set split,
+    and INTEGER-VALUED f32 stats so every accumulation order — and the
+    bf16x2/int8 decompositions — is exact (the test_histogram_pallas
+    bit-exactness idiom). Returns inputs + the XLA-chain reference
+    (grower.py's split_e/bin_e/go_left_e/new_leaf/new_slot/hist_slot
+    math, executed in numpy)."""
+    rng = np.random.default_rng(seed)
+    do_split = np.zeros(L + 1, bool)
+    do_split[[0, 2, 5]] = True
+    split_rank = np.zeros(L + 1, np.int32)
+    split_rank[[0, 2, 5]] = [0, 1, 2]
+    route_f = rng.integers(0, F, L + 1).astype(np.int32)
+    go_left = rng.integers(0, 2, (L + 1, B)).astype(bool)
+    left_id = rng.integers(0, 30, L + 1).astype(np.int32)
+    right_id = rng.integers(0, 30, L + 1).astype(np.int32)
+    if identity_hmap:
+        # Subtraction off: hmap[l] = l, trash L maps to itself — with
+        # num_slots = L it lands exactly on the sliced-off boundary.
+        hmap = np.arange(L + 1, dtype=np.int32)
+    else:
+        hmap = rng.integers(0, Lh, L + 1).astype(np.int32)
+        hmap[L] = Lh  # trash rows land past the sliced-off boundary
+    is_set = np.zeros(L + 1, bool)
+    is_set[2] = True  # a categorical-set split: bin lookup overridden
+    set_go_left = rng.integers(0, 2, n).astype(np.uint8)
+    slot = rng.integers(0, L + 1, n).astype(np.int32)  # incl. trash L
+    leaf = rng.integers(0, 30, n).astype(np.int32)
+    bins = rng.integers(0, B, (n, F)).astype(np.int32)
+    stats = rng.integers(-8, 9, (n, S)).astype(np.float32)
+
+    split_e = do_split[slot]
+    bin_e = bins[np.arange(n), route_f[slot]]
+    gl = go_left[slot, bin_e]
+    gl = np.where(is_set[slot], set_go_left.astype(bool), gl)
+    child = np.where(gl, left_id[slot], right_id[slot])
+    new_leaf = np.where(split_e, child, leaf)
+    child_slot = 2 * split_rank[slot] + np.where(gl, 0, 1)
+    new_slot = np.where(split_e, child_slot, L)
+    hist_slot = hmap[new_slot]
+    hist = np.zeros((Lh, F, B, S), np.float32)
+    for e in range(n):
+        hs = hist_slot[e]
+        if hs < Lh:
+            for f in range(F):
+                hist[hs, f, bins[e, f]] += stats[e]
+    tables = (do_split, route_f, go_left, left_id, right_id, split_rank,
+              hmap, is_set, set_go_left)
+    return bins, slot, leaf, tables, stats, (hist, new_slot, new_leaf)
+
+
+def _run(bins, slot, leaf, tables, stats, Lh, B, quant_scale=None,
+         **kw):
+    (do_split, route_f, go_left, left_id, right_id, split_rank, hmap,
+     is_set, set_go_left) = tables
+    return histogram_routed_pallas(
+        jnp.asarray(bins), jnp.asarray(slot), jnp.asarray(leaf),
+        jnp.asarray(do_split), jnp.asarray(route_f),
+        jnp.asarray(go_left), jnp.asarray(left_id),
+        jnp.asarray(right_id), jnp.asarray(split_rank),
+        jnp.asarray(hmap), jnp.asarray(is_set),
+        jnp.asarray(set_go_left), jnp.asarray(stats),
+        num_slots=Lh, num_bins=B, chunk=256,
+        quant_scale=quant_scale, interpret=True, **kw,
+    )
+
+
+def test_interpret_parity_f32():
+    bins, slot, leaf, tables, stats, ref = _case()
+    h, ns, nl = _run(bins, slot, leaf, tables, stats, Lh=4, B=16)
+    np.testing.assert_array_equal(np.asarray(ns), ref[1])
+    np.testing.assert_array_equal(np.asarray(nl), ref[2])
+    np.testing.assert_array_equal(np.asarray(h), ref[0])
+
+
+def test_interpret_parity_int8():
+    bins, slot, leaf, tables, stats, ref = _case(seed=1)
+    scale = 0.25  # pow2 scale: dequantized sums stay exact
+    stats_q = np.clip(np.round(stats / scale), -127, 127).astype(np.int8)
+    qs = jnp.asarray(np.full(stats.shape[1], scale, np.float32))
+    h, ns, nl = _run(bins, slot, leaf, tables, stats_q, Lh=4, B=16,
+                     quant_scale=qs)
+    np.testing.assert_array_equal(np.asarray(ns), ref[1])
+    np.testing.assert_array_equal(np.asarray(nl), ref[2])
+    # Reference in the kernel's own domain: int32 accumulate, ONE
+    # final dequantize (ops/histogram.py dispatch contract).
+    hist_q = np.zeros(ref[0].shape, np.int64)
+    hist_slot_ref = tables[6][ref[1]]  # hmap[new_slot]
+    for e in range(len(slot)):
+        hs = hist_slot_ref[e]
+        if hs < 4:
+            for f in range(bins.shape[1]):
+                hist_q[hs, f, bins[e, f]] += stats_q[e]
+    np.testing.assert_array_equal(
+        np.asarray(h), hist_q.astype(np.float32) * scale
+    )
+
+
+def test_interpret_parity_bf16x2():
+    bins, slot, leaf, tables, stats, ref = _case(seed=2)
+    hi = stats.astype(jnp.bfloat16)
+    lo = (stats - np.asarray(hi, np.float32)).astype(jnp.bfloat16)
+    stats_b = jnp.concatenate([jnp.asarray(hi), jnp.asarray(lo)], axis=1)
+    h, ns, nl = _run(bins, slot, leaf, tables, stats_b, Lh=4, B=16)
+    np.testing.assert_array_equal(np.asarray(ns), ref[1])
+    np.testing.assert_array_equal(np.asarray(nl), ref[2])
+    # Integer-valued stats: the hi half carries everything, folding the
+    # halves is exact.
+    np.testing.assert_array_equal(np.asarray(h), ref[0])
+
+
+def test_identity_hmap_no_subtraction():
+    """Subtraction off: hmap is the identity over [0, L], trash maps to
+    L == num_slots (sliced-off padding) and the full-frontier layout
+    must come out exact."""
+    bins, slot, leaf, tables, stats, ref = _case(
+        seed=3, L=8, Lh=8, identity_hmap=True
+    )
+    h, ns, nl = _run(bins, slot, leaf, tables, stats, Lh=8, B=16)
+    np.testing.assert_array_equal(np.asarray(ns), ref[1])
+    np.testing.assert_array_equal(np.asarray(nl), ref[2])
+    np.testing.assert_array_equal(np.asarray(h), ref[0])
+
+
+def test_all_trash_rows_accumulate_nothing():
+    """Rows whose slot is already the trash slot L stay there (no split
+    applies) and contribute to NO live histogram slot."""
+    bins, slot, leaf, tables, stats, _ = _case(seed=4)
+    slot = np.full_like(slot, 8)  # every row on trash
+    h, ns, nl = _run(bins, slot, leaf, tables, stats, Lh=4, B=16)
+    np.testing.assert_array_equal(np.asarray(ns), np.full(len(slot), 8))
+    np.testing.assert_array_equal(np.asarray(nl), leaf)
+    np.testing.assert_array_equal(np.asarray(h), np.zeros_like(h))
+
+
+def test_dispatcher_matches_native():
+    """route_histogram_fused: the Mosaic interpret backend and the
+    native CPU SlotFn kernel answer the same contract bit-identically
+    (f32; the native kernel is the grower's CPU fuse_route path)."""
+    from ydf_tpu.ops import routing_native
+
+    if not routing_native.available():
+        pytest.skip("native kernel library unavailable")
+    bins, slot, leaf, tables, stats, ref = _case(seed=5)
+    (do_split, route_f, go_left, left_id, right_id, split_rank, hmap,
+     is_set, set_go_left) = tables
+    args = (
+        jnp.asarray(bins.astype(np.uint8)), jnp.asarray(slot),
+        jnp.asarray(leaf), jnp.asarray(do_split),
+        jnp.asarray(route_f), jnp.asarray(go_left),
+        jnp.asarray(left_id), jnp.asarray(right_id),
+        jnp.asarray(split_rank), jnp.asarray(hmap),
+        jnp.asarray(is_set), jnp.asarray(set_go_left),
+        jnp.asarray(stats),
+    )
+    out_n = route_histogram_fused(
+        *args, num_slots=4, num_bins=16, impl="native"
+    )
+    out_p = route_histogram_fused(
+        *args, num_slots=4, num_bins=16, impl="pallas_interpret"
+    )
+    for a, b, r in zip(out_n, out_p, (ref[0], ref[1], ref[2])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(np.asarray(a), r)
+
+
+def test_dispatcher_rejects_unknown_impl():
+    bins, slot, leaf, tables, stats, _ = _case(seed=6, n=32)
+    with pytest.raises(ValueError, match="route_histogram_fused"):
+        route_histogram_fused(
+            jnp.asarray(bins), jnp.asarray(slot), jnp.asarray(leaf),
+            *[jnp.asarray(t) for t in tables], jnp.asarray(stats),
+            num_slots=4, num_bins=16, impl="cuda",
+        )
+
+
+@pytest.mark.parametrize("quant", ["f32", "bf16x2", "int8"])
+def test_kernel_lowers_to_mosaic(quant):
+    from ydf_tpu.utils import tpu_lowering as tl
+
+    exp = tl.export_histogram_routed_pallas(
+        n=4096, F=8, L=16, Lh=8, B=64, quant=quant
+    )
+    assert exp.platforms == ("tpu",)
+    assert "tpu_custom_call" in exp.mlir_module()

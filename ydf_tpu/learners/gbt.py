@@ -43,7 +43,7 @@ from ydf_tpu.learners.generic import GenericLearner
 from ydf_tpu.learners.losses import make_loss
 from ydf_tpu.models.forest import forest_from_stacked_trees
 from ydf_tpu.models.gbt_model import GradientBoostedTreesModel
-from ydf_tpu.ops import grower
+from ydf_tpu.ops import device_loop, grower
 from ydf_tpu.ops.routing import apply_leaf_values, route_tree_bins
 from ydf_tpu.ops.split_rules import HessianGainRule
 
@@ -1784,6 +1784,9 @@ def _chunk_arrays_from_ys(ys) -> dict:
     d["ob"] = np.asarray(ob_c)
     d["vsa"] = np.asarray(va_c)
     d["vsb"] = np.asarray(vb_c)
+    # This materialization is THE host-sync point of the chunked drivers:
+    # everything else (carry, bin matrix, labels) stays device-resident.
+    device_loop.count_host_sync(sum(a.nbytes for a in d.values()))
     return d
 
 
@@ -1885,6 +1888,7 @@ def _train_gbt(
     if vs_tr is not None:
         data_kwargs["vs_tr"] = vs_tr
         data_kwargs["vs_va"] = vs_va
+    trees_per_dispatch = device_loop.trees_per_dispatch(None)
     if cache_dir is None:
         if (
             early_stop_lookahead > 0
@@ -1892,7 +1896,7 @@ def _train_gbt(
             # Stopping can only ever fire when the loop outlives the
             # look-ahead window; otherwise the fused single scan is cheaper.
             and num_trees > early_stop_lookahead
-        ) or deadline is not None:
+        ) or deadline is not None or trees_per_dispatch is not None:
             # In-loop early STOPPING without a working_dir: drive the same
             # run_chunk executable in memory and break once the validation
             # loss has not improved for `early_stop_lookahead` trees — the
@@ -1902,7 +1906,12 @@ def _train_gbt(
             # driver too (the fused single scan cannot stop mid-flight).
             use_dart = getattr(run, "use_dart", False)
             carry, init_pred = run.init_state(y_tr, w_tr)
-            clen = max(1, min(early_stop_lookahead or 25, 25))
+            # Trees grown per XLA dispatch: the env knob when set
+            # (YDF_TPU_TREES_PER_DISPATCH — the paired A/B in bench.py
+            # pins it), else the early-stop look-ahead window.
+            clen = trees_per_dispatch or max(
+                1, min(early_stop_lookahead or 25, 25)
+            )
             parts = []
             vls_seen = []
             chunk_walls = []
@@ -1910,8 +1919,11 @@ def _train_gbt(
             while start < num_trees:
                 c = _chunk_len(clen, start, num_trees, use_dart)
                 t0_ns = time.perf_counter_ns()
-                carry, ys = run.run_chunk(
-                    carry, jnp.asarray(start), c, *data_args, **data_kwargs
+                # Donated-carry dispatch: `carry` is dead after this call
+                # (its buffers were reused in place on device); everything
+                # below reads only the NEW carry / the fetched ys.
+                carry, ys = device_loop.run_chunk(
+                    run, carry, start, c, *data_args, **data_kwargs
                 )
                 parts.append(_chunk_arrays_from_ys(ys))
                 _note_chunk(
@@ -1949,6 +1961,15 @@ def _train_gbt(
         # and every output is materialized a few lines later anyway —
         # this just keeps the single "chunk" wall honest.
         jax.block_until_ready(tls)
+        device_loop.count_dispatch(num_trees)
+        device_loop.count_host_sync(
+            sum(
+                leaf.nbytes
+                for leaf in jax.tree.leaves(
+                    (trees, lvs, tls, vls, obl_w, obl_b, vs_a, vs_b)
+                )
+            )
+        )
         _oom_failpoint()
         single_wall = [(0, num_trees, t0_ns, time.perf_counter_ns() - t0_ns)]
         logs = {
@@ -2050,12 +2071,20 @@ def _train_gbt(
     chunk_walls = []
     with _PreemptionGuard() as guard, _flight_guard():
         while start < num_trees:
+            # The env knob can move the dispatch boundary off the
+            # snapshot cadence (e.g. resume with a different chunk
+            # size); the compile cache in device_loop keys on the
+            # static loop shape, so alternating sizes never rebuild
+            # previously compiled executables.
             clen = _chunk_len(
-                snapshot_interval, start, num_trees, use_dart
+                device_loop.trees_per_dispatch(snapshot_interval),
+                start, num_trees, use_dart,
             )
             t0_ns = time.perf_counter_ns()
-            carry, ys = run.run_chunk(
-                carry, jnp.asarray(start), clen, *data_args, **data_kwargs
+            # Donated-carry dispatch: the old carry dies here; the
+            # snapshot below serializes the NEW carry.
+            carry, ys = device_loop.run_chunk(
+                run, carry, start, clen, *data_args, **data_kwargs
             )
             chunk_arrays = _chunk_arrays_from_ys(ys)
             _note_chunk(
@@ -2074,6 +2103,11 @@ def _train_gbt(
             arrays = {"init_pred": np.asarray(init_pred)}
             for i, leaf in enumerate(jax.tree.leaves(carry)):
                 arrays[f"carry_{i}"] = np.asarray(leaf)
+            # Snapshot durability is the checkpointed driver's extra
+            # host-sync point on top of the chunk payload fetch.
+            device_loop.count_host_sync(
+                sum(a.nbytes for a in arrays.values())
+            )
             if chunks_done == 0:
                 # Chunk list carried across interrupted runs via the
                 # snapshot.

@@ -169,6 +169,286 @@ def _hist_kernel_packed(
             out_ref[f, g, :, :] += h
 
 
+def _hist_routed_kernel(
+    binsb_ref, binsf_ref, slot_ref, leaf_ref, setgl_ref, tabs_ref,
+    glbT_ref, stats_ref, out_ref, nslot_ref, nleaf_ref, *,
+    Fb, Fp, S, B, Lhp, L1p, L, op_dtype, acc_dtype,
+):
+    """Fused previous-layer routing + this-layer histogram — the Pallas
+    mirror of the native `SlotFn` fusion seam (routing_native
+    histogram_routed / docs/row_routing.md): each example's histogram
+    slot is computed IN-REGISTER from the previous layer's decision
+    tables and consumed by the accumulation dots in the same grid step,
+    so the per-layer hist_slot array never touches HBM and the bin
+    matrix — loaded once for the contraction — is the only per-example
+    traffic. Everything a row gather would need becomes a one-hot MXU
+    contraction (gathers don't vectorize on the VPU; one-hot dots are
+    what the MXU is for):
+
+      slot_oh [L1p, C]   one-hot of the PREVIOUS frontier slot
+      T = tabs @ slot_oh  [Kp, C]  every per-slot table row gathered at
+                          once (do_split, route_f, left/right ids,
+                          split_rank, is_set, and the PRE-COMPOSED next
+                          hist slots hmap[2r] / hmap[2r+1] / hmap[L] —
+                          composing hmap into the table is what removes
+                          any gather by NEW slot)
+      b_sel  [1, C]      the routed feature's bin via a feature one-hot
+                         row-select over the full bin block
+      M = glbT @ slot_oh [B, C]    each example's slot's go-left row;
+                          the bin one-hot then selects M[bin_e]
+
+    All table values (ids <= N, bins < B, slots <= L) are exact in f32
+    and every contraction has exactly one non-zero term per output
+    (one-hot factor), so the routing is EXACT — bit-identical to the
+    XLA gather chain in ops/grower.py — independent of op_dtype; only
+    the histogram dots follow stats.dtype (module docstring).
+
+    binsb_ref [Fb, C]  this feature block's bins (histogram operand)
+    binsf_ref [Fp, C]  ALL features' bins (routing needs any column)
+    slot_ref  [1, C]   previous-layer slot; L = trash
+    leaf_ref  [1, C]   current leaf ids
+    setgl_ref [1, C]   per-example set-split go-left (u8-as-i32)
+    tabs_ref  [Kp, L1p] packed f32 decision tables (rows above)
+    glbT_ref  [B, L1p] go_left_bins transposed
+    stats_ref [S, C]
+    out_ref   [Fb, S, B, Lhp]; nslot/nleaf [1, C] i32 — written
+    identically at every feature-block step (the grid revisits these
+    blocks once per block; full idempotent rewrites keep every visit's
+    store correct).
+    """
+    c_step = pl.program_id(1)
+
+    @pl.when(c_step == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    f32 = jnp.float32
+    C = binsb_ref.shape[1]
+    slot_oh = (
+        slot_ref[...] == jax.lax.broadcasted_iota(jnp.int32, (L1p, C), 0)
+    ).astype(f32)  # [L1p, C]
+    T = jax.lax.dot_general(
+        tabs_ref[...], slot_oh, (((1,), (0,)), ((), ())),
+        preferred_element_type=f32,
+    )  # [Kp, C]: every table row gathered by previous slot at once
+    split_e = T[0:1, :] > 0.0
+    rf_e = T[1:2, :]
+    left_e, right_e = T[2:3, :], T[3:4, :]
+    sr_e = T[4:5, :]
+    isset_e = T[5:6, :] > 0.0
+    hl_e, hr_e, trash_e = T[6:7, :], T[7:8, :], T[8:9, :]
+
+    # The routed feature's bin: one-hot row select over the FULL block
+    # (route_f may name any feature, not just this histogram block's).
+    fio = jax.lax.broadcasted_iota(jnp.int32, (Fp, C), 0).astype(f32)
+    feat_oh = (rf_e == fio).astype(f32)  # [Fp, C]
+    b_sel = jnp.sum(
+        feat_oh * binsf_ref[...].astype(f32), axis=0, keepdims=True
+    )  # [1, C] — exact: one non-zero term, bins < B <= 256
+
+    # Go-left: gather each slot's per-bin row, then select the bin.
+    M = jax.lax.dot_general(
+        glbT_ref[...], slot_oh, (((1,), (0,)), ((), ())),
+        preferred_element_type=f32,
+    )  # [B, C]
+    bio_f = jax.lax.broadcasted_iota(jnp.int32, (B, C), 0).astype(f32)
+    b_oh = (b_sel == bio_f).astype(f32)
+    gl = jnp.sum(b_oh * M, axis=0, keepdims=True) > 0.0  # [1, C]
+    gl = jnp.where(isset_e, setgl_ref[...] > 0, gl)
+
+    new_slot = jnp.where(
+        split_e, 2.0 * sr_e + jnp.where(gl, 0.0, 1.0), float(L)
+    )
+    new_leaf = jnp.where(
+        split_e, jnp.where(gl, left_e, right_e),
+        leaf_ref[...].astype(f32),
+    )
+    hist_slot = jnp.where(split_e, jnp.where(gl, hl_e, hr_e), trash_e)
+    nslot_ref[...] = new_slot.astype(jnp.int32)
+    nleaf_ref[...] = new_leaf.astype(jnp.int32)
+
+    # This layer's histogram from the in-register hist slot — identical
+    # accumulation to _hist_kernel.
+    hs = hist_slot.astype(jnp.int32)  # [1, C]
+    hslot_ohT = (
+        hs == jax.lax.broadcasted_iota(jnp.int32, (Lhp, C), 0)
+    ).astype(op_dtype)  # [Lhp, C]; trash lanes sliced off by the wrapper
+    biotaT = jax.lax.broadcasted_iota(jnp.int32, (B, C), 0)
+    for f in range(Fb):
+        ohT = (binsb_ref[f : f + 1, :] == biotaT).astype(op_dtype)
+        for s in range(S):
+            aT = hslot_ohT * stats_ref[s : s + 1, :]
+            h = jax.lax.dot_general(
+                ohT, aT, (((1,), (1,)), ((), ())),
+                preferred_element_type=acc_dtype,
+            )  # [B, Lhp]
+            out_ref[f, s, :, :] += h
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_slots", "num_bins", "chunk", "feature_block", "interpret"
+    ),
+)
+def histogram_routed_pallas(
+    bins: jax.Array,         # int-like [n, F]
+    slot: jax.Array,         # int32 [n], previous-layer slot; L = trash
+    leaf_id: jax.Array,      # int32 [n]
+    do_split: jax.Array,     # bool/u8 [L+1]
+    route_f: jax.Array,      # int32 [L+1]
+    go_left: jax.Array,      # bool/u8 [L+1, B]
+    left_id: jax.Array,      # int32 [L+1]
+    right_id: jax.Array,     # int32 [L+1]
+    split_rank: jax.Array,   # int32 [L+1]
+    hmap: jax.Array,         # int32 [L+1] (identity when subtraction off)
+    is_set: jax.Array,       # bool/u8 [L+1]
+    set_go_left: jax.Array,  # u8 [n] (or [1] when no set features)
+    stats: jax.Array,        # f32 [n, S] / bf16 [n, 2S] / int8 [n, S]
+    *,
+    num_slots: int,
+    num_bins: int = 256,
+    quant_scale: jax.Array | None = None,
+    chunk: int = 1024,
+    feature_block: int | None = None,
+    interpret: bool = False,
+):
+    """Fused route+histogram, Pallas/Mosaic backend — same contract as
+    routing_native.histogram_routed: applies the PREVIOUS layer's splits
+    per example and accumulates THIS layer's [num_slots, F, num_bins, S]
+    histogram from the resulting hist slot in one pass. Returns
+    (hist f32 — dequantized/refolded like ops/histogram.py —, new_slot
+    [n] i32, new_leaf [n] i32). Table arrays follow route_update's
+    padded [L+1] contract. stats.dtype selects the histogram precision
+    (f32 exact / bf16x2 halves / int8+quant_scale); routing is exact in
+    every mode."""
+    n, F = bins.shape
+    Sq = stats.shape[1]
+    L1 = do_split.shape[0]
+    L = L1 - 1
+    Lh, B = num_slots, num_bins
+    f32, i32 = jnp.float32, jnp.int32
+    Lhp = _round_up(max(Lh, 1), 128)
+    L1p = _round_up(L1, 128)
+
+    if stats.dtype == jnp.bfloat16:
+        op_dtype, acc_dtype = jnp.bfloat16, jnp.float32
+    elif jnp.issubdtype(stats.dtype, jnp.integer):
+        if quant_scale is None:
+            raise ValueError("int8 fused histogram requires quant_scale")
+        op_dtype, acc_dtype = jnp.int8, jnp.int32
+    else:
+        op_dtype, acc_dtype = jnp.float32, jnp.float32
+
+    # Packed decision tables, one f32 row per table (kernel docstring).
+    # hmap is composed HERE — rows 6..8 carry the next hist slot for
+    # go-left / go-right / no-split, so the kernel never gathers by new
+    # slot. Every value (ids <= N <= 2^24, slots, bins) is f32-exact.
+    sr_i = split_rank.astype(i32)
+    hl = hmap[jnp.clip(2 * sr_i, 0, L)]
+    hr = hmap[jnp.clip(2 * sr_i + 1, 0, L)]
+    tabs = jnp.stack(
+        [
+            do_split.astype(f32),
+            route_f.astype(f32),
+            left_id.astype(f32),
+            right_id.astype(f32),
+            split_rank.astype(f32),
+            is_set.astype(f32),
+            hl.astype(f32),
+            hr.astype(f32),
+            jnp.broadcast_to(hmap[L].astype(f32), (L1,)),
+        ]
+    )  # [9, L1]
+    Kp = 16  # sublane-pad the 9 table rows (f32 tiles want 8k rows)
+    tabs = jnp.pad(tabs, ((0, Kp - tabs.shape[0]), (0, L1p - L1)))
+    glbT = jnp.pad(
+        go_left.astype(f32).T, ((0, 0), (0, L1p - L1))
+    )  # [B, L1p]
+
+    set_gl = (
+        set_go_left.astype(i32)
+        if set_go_left.shape[0] == n
+        else jnp.zeros((n,), i32)
+    )
+
+    if feature_block is None:
+        # Keep the resident output block around ~6 MB of VMEM.
+        per_f = Sq * B * Lhp * 4
+        feature_block = max(1, min(F, (6 << 20) // max(per_f, 1)))
+    Fb = feature_block
+    Fp = _round_up(F, Fb)
+    n_pad = _round_up(max(n, 1), chunk)
+
+    bins_i = bins.astype(i32)
+    leaf_i = leaf_id.astype(i32)
+    slot_i = slot.astype(i32)
+    if Fp != F:
+        bins_i = jnp.pad(bins_i, ((0, 0), (0, Fp - F)))
+    if n_pad != n:
+        bins_i = jnp.pad(bins_i, ((0, n_pad - n), (0, 0)))
+        # Padded examples ride the trash path: slot L never splits
+        # (do_split pads False), their hist slot is hmap[L] (>= Lh, in
+        # the sliced lanes), and their zero stats contribute nothing.
+        slot_i = jnp.pad(slot_i, (0, n_pad - n), constant_values=L)
+        leaf_i = jnp.pad(leaf_i, (0, n_pad - n))
+        set_gl = jnp.pad(set_gl, (0, n_pad - n))
+        stats = jnp.pad(stats, ((0, n_pad - n), (0, 0)))
+
+    kernel = functools.partial(
+        _hist_routed_kernel, Fb=Fb, Fp=Fp, S=Sq, B=B, Lhp=Lhp, L1p=L1p,
+        L=L, op_dtype=op_dtype, acc_dtype=acc_dtype,
+    )
+    grid = (Fp // Fb, n_pad // chunk)
+    hist, new_slot, new_leaf = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((Fb, chunk), lambda fb, c: (fb, c)),
+            pl.BlockSpec((Fp, chunk), lambda fb, c: (0, c)),
+            pl.BlockSpec((1, chunk), lambda fb, c: (0, c)),
+            pl.BlockSpec((1, chunk), lambda fb, c: (0, c)),
+            pl.BlockSpec((1, chunk), lambda fb, c: (0, c)),
+            pl.BlockSpec((Kp, L1p), lambda fb, c: (0, 0)),
+            pl.BlockSpec((B, L1p), lambda fb, c: (0, 0)),
+            pl.BlockSpec((Sq, chunk), lambda fb, c: (0, c)),
+        ],
+        out_specs=[
+            pl.BlockSpec((Fb, Sq, B, Lhp), lambda fb, c: (fb, 0, 0, 0)),
+            pl.BlockSpec((1, chunk), lambda fb, c: (0, c)),
+            pl.BlockSpec((1, chunk), lambda fb, c: (0, c)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Fp, Sq, B, Lhp), acc_dtype),
+            jax.ShapeDtypeStruct((1, n_pad), i32),
+            jax.ShapeDtypeStruct((1, n_pad), i32),
+        ],
+        interpret=interpret,
+    )(
+        bins_i.T,
+        bins_i.T,
+        slot_i[None, :],
+        leaf_i[None, :],
+        set_gl[None, :],
+        tabs,
+        glbT,
+        stats.astype(op_dtype).T,
+    )
+
+    # [Fp, S, B, Lhp] -> [Lh, F, B, S], then the same dequantize/refold
+    # as ops/histogram.py so every backend returns f32 histograms.
+    out = jnp.transpose(hist[:F, :, :, :Lh], (3, 0, 2, 1))
+    if stats.dtype == jnp.bfloat16:
+        S = Sq // 2
+        out = out.astype(f32)
+        out = out[..., :S] + out[..., S:]
+    elif jnp.issubdtype(stats.dtype, jnp.integer):
+        out = out.astype(f32) * quant_scale[None, None, None, :]
+    else:
+        out = out.astype(f32)
+    return out, new_slot[0, :n], new_leaf[0, :n]
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(

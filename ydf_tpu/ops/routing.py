@@ -406,3 +406,52 @@ def _leaf_proximity_jit(
 
     _, prox = jax.lax.scan(lambda c, b: (c, one(b)), 0, l1c)
     return prox.reshape(n1p, -1)[:n1]
+
+
+def route_histogram_fused(
+    bins, slot, leaf_id, do_split, route_f, go_left, left_id, right_id,
+    split_rank, hmap, is_set, set_go_left, stats, *, num_slots, num_bins,
+    quant_scale=None, impl: str = "native",
+):
+    """The fused previous-layer-routing + this-layer-histogram seam
+    (docs/row_routing.md): ONE pass over rows applies the previous
+    layer's decision tables per example and accumulates this layer's
+    [num_slots, F, num_bins, S] histogram from the in-register hist
+    slot. Two backends, one contract — returns (hist f32, new_slot [n]
+    i32, new_leaf [n] i32), bit-identical to each other and to the
+    unfused route-then-histogram chain:
+
+      * "native" — the multithreaded CPU SlotFn kernel
+        (ops/routing_native.py:histogram_routed; f32/int8 stats).
+      * "pallas" / "pallas_interpret" — the Mosaic kernel
+        (ops/histogram_pallas.py:histogram_routed_pallas; f32/bf16x2/
+        int8 stats), the TPU-native form: routing gathers become
+        one-hot MXU contractions and the bin matrix is the only
+        per-example traffic.
+
+    Table arrays follow the padded [L+1] contract of
+    routing_native.route_update; `hmap` must be the identity when
+    sibling subtraction is off."""
+    if impl == "native":
+        from ydf_tpu.ops import routing_native
+
+        return routing_native.histogram_routed(
+            bins, slot, leaf_id, do_split, route_f, go_left, left_id,
+            right_id, split_rank, hmap, is_set, set_go_left, stats,
+            num_slots=num_slots, num_bins=num_bins,
+            quant_scale=quant_scale,
+        )
+    if impl in ("pallas", "pallas_interpret"):
+        from ydf_tpu.ops.histogram_pallas import histogram_routed_pallas
+
+        return histogram_routed_pallas(
+            bins, slot, leaf_id, do_split, route_f, go_left, left_id,
+            right_id, split_rank, hmap, is_set, set_go_left, stats,
+            num_slots=num_slots, num_bins=num_bins,
+            quant_scale=quant_scale,
+            interpret=(impl == "pallas_interpret"),
+        )
+    raise ValueError(
+        f"route_histogram_fused impl {impl!r} must be 'native', "
+        "'pallas' or 'pallas_interpret'"
+    )

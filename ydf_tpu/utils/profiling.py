@@ -125,6 +125,77 @@ def trace_event_seconds(
     return out
 
 
+def trace_event_counts(
+    trace_dir: str, substrings: Optional[tuple] = None
+) -> Dict[str, int]:
+    """Aggregates per-op EVENT COUNTS from a jax.profiler trace
+    directory — the same schema-less xplane walk as
+    trace_event_seconds, counting XEvent occurrences per metadata name
+    instead of summing durations. This is the trace-level cross-check
+    for the device loop's dispatch accounting: every XLA dispatch of
+    the boosting chunk shows up as one `jit_run_chunk`-family event on
+    the host runtime line, so
+    `trace_event_counts(dir, ("jit_",))` recovers dispatches-per-train
+    from the profiler's own record (ops/device_loop.py counts the same
+    quantity host-side without needing a trace armed)."""
+    import pathlib
+
+    from ydf_tpu.utils import protowire as pw
+
+    out: Dict[str, int] = {}
+    for path in sorted(pathlib.Path(trace_dir).rglob("*.xplane.pb")):
+        try:
+            space = pw.decode(path.read_bytes())
+        except Exception:
+            continue  # partial/foreign file: skip, never fail the bench
+        for plane_b in space.get(1, []):
+            plane = pw.decode(bytes(plane_b))
+            names: Dict[int, str] = {}
+            for entry_b in plane.get(4, []):
+                entry = pw.decode(bytes(entry_b))
+                md_b = entry.get(2)
+                if not md_b:
+                    continue
+                md = pw.decode(bytes(md_b[-1]))
+                names[pw.get_int(entry, 1)] = pw.get_str(md, 2)
+            if not names:
+                continue
+            for line_b in plane.get(3, []):
+                line = pw.decode(bytes(line_b))
+                for ev_b in line.get(4, []):
+                    ev = pw.decode(bytes(ev_b))
+                    name = names.get(pw.get_int(ev, 1))
+                    if not name:
+                        continue
+                    if substrings is not None and not any(
+                        s in name for s in substrings
+                    ):
+                        continue
+                    out[name] = out.get(name, 0) + 1
+    return out
+
+
+def device_loop_metrics() -> Dict[str, float]:
+    """The device-resident boosting loop's host-side accounting
+    (ops/device_loop.py stats window) in metric form: XLA dispatches,
+    host-sync bytes, and the derived per-tree rates bench.py emits on
+    headline records (docs/device_loop.md has the boundary
+    inventory)."""
+    from ydf_tpu.ops import device_loop
+
+    snap = device_loop.stats_snapshot()
+    return {
+        "ydf_train_dispatches": float(snap["dispatches"]),
+        "ydf_train_host_sync_bytes": float(snap["host_sync_bytes"]),
+        "ydf_train_dispatches_per_tree": float(
+            snap["dispatches_per_tree"]
+        ),
+        "ydf_train_host_sync_bytes_per_tree": float(
+            snap["host_sync_bytes_per_tree"]
+        ),
+    }
+
+
 def native_hist_kernel_seconds() -> float:
     """Cumulative wall seconds spent INSIDE the native histogram custom
     call (both precisions) — the exact in-loop attribution for the CPU

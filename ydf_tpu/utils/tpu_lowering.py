@@ -40,11 +40,13 @@ __all__ = [
     "export_train_step",
     "export_grow_tree",
     "export_binning_pallas",
+    "export_histogram_routed_pallas",
     "export_quickscorer",
     "export_serve_bank",
     "export_vector_sequence",
     "grow_tree_cost",
     "tpu_projection",
+    "kernel_source_digests",
     "write_artifacts",
     "CHIP_SPECS",
 ]
@@ -215,6 +217,58 @@ def export_histogram_pallas(
         ),
         platforms=tuple(platforms),
     )(*args)
+
+
+def export_histogram_routed_pallas(
+    n: int = 262_144, F: int = 28, L: int = 32, Lh: int = 16,
+    B: int = 256, quant: str = "f32", platforms=("tpu",),
+):
+    """jax.export of the FUSED route+histogram Mosaic kernel
+    (ops/histogram_pallas.py:histogram_routed_pallas) at a bench-layer
+    shape: the previous layer's decision tables applied in-register and
+    this layer's histogram accumulated in the same grid step — the
+    TPU-native mirror of the native SlotFn fusion seam that makes the
+    device-resident boosting loop's per-layer routing free of HBM
+    round trips (docs/device_loop.md). `quant` selects the stats
+    operand like export_histogram_pallas; the routing contractions are
+    f32 one-hot dots in every mode."""
+    from ydf_tpu.ops.histogram_pallas import histogram_routed_pallas
+
+    dtype, S = {
+        "f32": (jnp.float32, 3),
+        "bf16x2": (jnp.bfloat16, 6),
+        "int8": (jnp.int8, 3),
+    }[quant]
+    L1 = L + 1
+    args = (
+        jax.ShapeDtypeStruct((n, F), jnp.uint8),    # bins
+        jax.ShapeDtypeStruct((n,), jnp.int32),      # slot
+        jax.ShapeDtypeStruct((n,), jnp.int32),      # leaf_id
+        jax.ShapeDtypeStruct((L1,), jnp.uint8),     # do_split
+        jax.ShapeDtypeStruct((L1,), jnp.int32),     # route_f
+        jax.ShapeDtypeStruct((L1, B), jnp.uint8),   # go_left
+        jax.ShapeDtypeStruct((L1,), jnp.int32),     # left_id
+        jax.ShapeDtypeStruct((L1,), jnp.int32),     # right_id
+        jax.ShapeDtypeStruct((L1,), jnp.int32),     # split_rank
+        jax.ShapeDtypeStruct((L1,), jnp.int32),     # hmap
+        jax.ShapeDtypeStruct((L1,), jnp.uint8),     # is_set
+        jax.ShapeDtypeStruct((n,), jnp.uint8),      # set_go_left
+        jax.ShapeDtypeStruct((n, S), dtype),        # stats
+        jax.ShapeDtypeStruct((S if quant != "bf16x2" else S // 2,),
+                             jnp.float32),          # quant_scale
+    )
+
+    def fused(bins, slot, leaf, ds, rf, gl, li, ri, sr, hm, iss, sgl,
+              st, qs):
+        return histogram_routed_pallas(
+            bins, slot, leaf, ds, rf, gl, li, ri, sr, hm, iss, sgl, st,
+            num_slots=Lh, num_bins=B,
+            quant_scale=qs if quant == "int8" else None,
+        )
+
+    return jax.export.export(jax.jit(fused), platforms=tuple(platforms))(
+        *args
+    )
 
 
 def export_binning_pallas(
@@ -443,6 +497,29 @@ def pallas_lane_packing_summary(
     }
 
 
+def _analytic_route_flops(n, max_depth, num_bins, L=1024, table_rows=16):
+    """Closed-form FLOP count of the fused route+histogram kernel's
+    ROUTING contractions per tree (ops/histogram_pallas.py
+    _hist_routed_kernel). Every per-example table gather is a one-hot
+    MXU dot against the previous frontier's padded slot axis
+    (L1p = L+1 rounded up to 128 lanes):
+
+      tabs gather   [Kp, L1p] @ [L1p, n]  — Kp = 16 packed table rows
+      go-left       [B,  L1p] @ [L1p, n]  — each slot's per-bin row
+
+    so layer d costs 2·n·(Kp + B)·L1p FLOPs, issued once per layer past
+    the root (the root has no previous splits to route). These dots run
+    f32 (exactness of the id arithmetic), i.e. 3 MXU passes per MAC,
+    REGARDLESS of the histogram's quant mode. Earlier projections
+    treated routing as free — defensible for the XLA gather chain
+    (VPU-bound, hidden under the histogram), wrong for the fused kernel
+    whose routing occupies the same MXU the histogram needs."""
+    frontier = min(2 ** max(max_depth - 1, 0), L)
+    L1p = -(-(frontier + 1) // 128) * 128
+    per_layer = 2.0 * n * (table_rows + num_bins) * L1p
+    return per_layer * max(max_depth - 1, 0)
+
+
 # MXU issue cost per histogram MAC, in native-bf16-pass units, by stats
 # operand precision (docs/histogram_quantization.md has the derivation):
 #   f32     Mosaic decomposes an f32×f32 dot into bf16 passes (hi·hi +
@@ -488,6 +565,12 @@ def tpu_projection(
     # and dominates everything else. Project on whichever is larger.
     flops = max(cost["flops"], analytic)
     passes = MXU_PASSES_PER_MAC[hist_quant]
+    # Fused route+histogram kernel: the routing one-hot dots share the
+    # MXU with the histogram and are NOT free (they used to be counted
+    # as zero). f32 passes in every quant mode — id arithmetic must
+    # stay exact.
+    route_flops = _analytic_route_flops(n, max_depth, num_bins)
+    route_passes = MXU_PASSES_PER_MAC["f32"]
     # HBM traffic floor per tree: re-read bins + stats once per layer
     # (the Pallas/fused formulation; XLA's unfused "bytes accessed"
     # wildly overcounts by materializing one-hots). The stats re-read
@@ -498,7 +581,9 @@ def tpu_projection(
     rows = []
     for chip in chips:
         spec = CHIP_SPECS[chip]
-        t_compute = flops * passes / (spec["peak_flops"] * mfu)
+        t_compute = (flops * passes + route_flops * route_passes) / (
+            spec["peak_flops"] * mfu
+        )
         t_mem = bytes_floor / spec["hbm_gbps"]
         t_tree = max(t_compute, t_mem)
         rows.append({
@@ -508,6 +593,8 @@ def tpu_projection(
             "flops_per_tree_projected": flops,
             "flops_per_tree_xla": cost["flops"],
             "flops_per_tree_matmul_floor": analytic,
+            "route_flops_per_tree": route_flops,
+            "route_mxu_passes_per_mac": route_passes,
             "hbm_bytes_floor_per_tree": bytes_floor,
             "assumed_mfu": mfu,
             "projected_s_per_tree": t_tree,
@@ -516,12 +603,48 @@ def tpu_projection(
         })
     return {"config": {"n": n, "F": F, "max_depth": max_depth,
                        "num_bins": num_bins, "hist_quant": hist_quant},
+            "basis": (
+                "compute = hist MACs x quant-mode MXU passes + fused "
+                "route+histogram routing dots (f32 passes, "
+                "_analytic_route_flops) — routing is no longer "
+                "projected as free"
+            ),
             "rows": rows}
 
 
 # --------------------------------------------------------------------------
 # Artifact generation
 # --------------------------------------------------------------------------
+
+
+# The source files whose content determines the exported Mosaic
+# artifacts. Paths are repo-relative; the digests ship in summary.json so
+# CI can detect stale committed artifacts WITHOUT re-running the (slow)
+# full export: if a kernel source changed and the artifacts were not
+# regenerated, the recomputed digest diverges
+# (tests/test_artifact_staleness.py).
+KERNEL_SOURCES = (
+    "ydf_tpu/ops/histogram_pallas.py",
+    "ydf_tpu/ops/binning_pallas.py",
+    "ydf_tpu/ops/vector_sequence.py",
+    "ydf_tpu/serving/quickscorer.py",
+    "ydf_tpu/serving/pallas_scorer.py",
+    "ydf_tpu/utils/tpu_lowering.py",
+)
+
+
+def kernel_source_digests() -> dict:
+    """sha256 of each Pallas-kernel source file (KERNEL_SOURCES),
+    keyed by repo-relative path. Computed from the installed package
+    location so the test and the export agree on the same bytes."""
+    import hashlib
+
+    root = Path(__file__).resolve().parent.parent.parent
+    out = {}
+    for rel in KERNEL_SOURCES:
+        p = root / rel
+        out[rel] = hashlib.sha256(p.read_bytes()).hexdigest()
+    return out
 
 
 def write_artifacts(outdir: str | Path, full_scale: bool = True) -> dict:
@@ -566,6 +689,17 @@ def write_artifacts(outdir: str | Path, full_scale: bool = True) -> dict:
         "histogram_pallas_kernel_int8": lambda: export_histogram_pallas(
             quant="int8"
         ),
+        # The device-resident loop's fused route+histogram kernel
+        # (ops/histogram_pallas.py:histogram_routed_pallas): previous-
+        # layer routing in-register + this-layer histogram in one
+        # Mosaic pass, across the quantized-gradient operand modes.
+        "histogram_routed_pallas_kernel": export_histogram_routed_pallas,
+        "histogram_routed_pallas_kernel_bf16x2": (
+            lambda: export_histogram_routed_pallas(quant="bf16x2")
+        ),
+        "histogram_routed_pallas_kernel_int8": (
+            lambda: export_histogram_routed_pallas(quant="int8")
+        ),
         # Ingestion: the fused binning pipeline's Mosaic kernel
         # (ops/binning_pallas.py) — bins compile on-device next to the
         # loop that consumes them.
@@ -606,6 +740,27 @@ def write_artifacts(outdir: str | Path, full_scale: bool = True) -> dict:
     # MAC-based projection can't see — the per-layer dot-count collapse
     # on sibling-subtraction layers.
     summary["pallas_slot_packing"] = pallas_lane_packing_summary()
+    # Fused route+histogram transfer accounting: what fusion removes
+    # from HBM per tree at the projection shape (the per-layer hist_slot
+    # and new_slot/new_leaf intermediates the unfused chain writes and
+    # re-reads), next to the routing MXU passes it adds (counted in
+    # projection_by_quant's compute term — see its "basis").
+    pn, pd = 500_000, 6
+    summary["fused_route_accounting"] = {
+        "config": {"n": pn, "max_depth": pd},
+        "route_flops_per_tree": _analytic_route_flops(pn, pd, 256),
+        "route_mxu_passes_per_mac": MXU_PASSES_PER_MAC["f32"],
+        # hist_slot [n] i32 written+read per routed layer by the
+        # unfused chain; fused, it lives in registers.
+        "hist_slot_hbm_bytes_avoided_per_tree": 2 * (pd - 1) * pn * 4,
+        "basis": (
+            "fusion removes the per-layer hist_slot round trip "
+            "(2 x (depth-1) x n x 4 B) and computes it in-register; "
+            "the routing one-hot dots it adds are charged to the "
+            "compute roofline via route_flops_per_tree"
+        ),
+    }
+    summary["source_digests"] = kernel_source_digests()
     (outdir / "summary.json").write_text(json.dumps(summary, indent=2))
     return summary
 
