@@ -895,6 +895,20 @@ def measure_fleet_family(model, data, rows, record):
                               the pooled connection (no routing/
                               failover retries in it)
 
+    YDF_TPU_BENCH_FLEET_ELASTIC=1 adds the elastic mode: the SAME
+    closed-loop run additionally spans a live `add_replica` of a
+    freshly spawned replica and a `remove_replica` drain of it,
+    emitting
+
+      fleet_join_to_serving_ns  spawn -> admitted wall (the time to
+                              serving: port bind, worker start, frame
+                              ship, verify, rotation admit)
+      fleet_drain_ns          whole drain+teardown wall
+      fleet_scale_events      join+drain count the run performed
+      fleet_elastic           1 — part of the bench-diff pairing shape
+                              so elastic records never cross-compare
+                              with static ones
+
     The run detail (swap result, shed/error counts, router status)
     rides record["fleet"]. Replicas are in-process localhost workers —
     like the distributed family, this measures PROTOCOL cost, not
@@ -913,6 +927,14 @@ def measure_fleet_family(model, data, rows, record):
         return
     if nrep == 0:
         return
+    elastic_env = os.environ.get("YDF_TPU_BENCH_FLEET_ELASTIC", "")
+    if elastic_env not in ("", "0", "1"):
+        record["fleet_family_error"] = (
+            f"YDF_TPU_BENCH_FLEET_ELASTIC={elastic_env!r} must be "
+            "0 or 1"
+        )
+        return
+    elastic = elastic_env == "1"
     import socket as _socket
     import threading
 
@@ -944,6 +966,7 @@ def measure_fleet_family(model, data, rows, record):
             start_worker(p, host="127.0.0.1", blocking=False)
         addrs = [f"127.0.0.1:{p}" for p in ports]
         router = FleetRouter(addrs)
+        elastic_state = {}
         try:
             router.deploy(model, "bench_v1")
             # The swap target: the same forest under a new version id —
@@ -960,6 +983,44 @@ def measure_fleet_family(model, data, rows, record):
             def do_swap():
                 swap_result.update(router.swap_to("bench_v2"))
 
+            # Elastic mode: a live join and a live drain mid-run —
+            # spawn->admitted wall is the headline "time to serving",
+            # and the drain wall covers rotation removal + in-flight
+            # drain + bank teardown. The joiner is the one drained
+            # (the autoscaler's LIFO discipline).
+            join_at = n_req // 2
+            drain_at = (5 * n_req) // 6
+            join_thread = []
+            drain_thread = []
+
+            def do_join():
+                t0 = time.perf_counter_ns()
+                s2 = _socket.socket()
+                s2.bind(("127.0.0.1", 0))
+                p2 = s2.getsockname()[1]
+                s2.close()
+                start_worker(p2, host="127.0.0.1", blocking=False)
+                addr = f"127.0.0.1:{p2}"
+                res = router.add_replica(addr)
+                elastic_state["join_ns"] = (
+                    time.perf_counter_ns() - t0
+                )
+                elastic_state["joiner"] = addr
+                elastic_state["join"] = res
+
+            def do_drain():
+                for t in join_thread:
+                    t.join(timeout=30)
+                addr = elastic_state.get("joiner")
+                if addr is None:
+                    return
+                t0 = time.perf_counter_ns()
+                res = router.remove_replica(addr)
+                elastic_state["drain_ns"] = (
+                    time.perf_counter_ns() - t0
+                )
+                elastic_state["drain"] = res
+
             def call(i):
                 if i == swap_at:
                     with swap_lock:
@@ -969,6 +1030,22 @@ def measure_fleet_family(model, data, rows, record):
                             )
                             t.start()
                             swap_thread.append(t)
+                if elastic and i == join_at:
+                    with swap_lock:
+                        if not join_thread:
+                            t = threading.Thread(
+                                target=do_join, daemon=True
+                            )
+                            t.start()
+                            join_thread.append(t)
+                if elastic and i == drain_at:
+                    with swap_lock:
+                        if not drain_thread:
+                            t = threading.Thread(
+                                target=do_drain, daemon=True
+                            )
+                            t.start()
+                            drain_thread.append(t)
                 j = i % n_av
                 router.predict(
                     x_num[j: j + 1], x_cat[j: j + 1], req_id=i
@@ -977,7 +1054,7 @@ def measure_fleet_family(model, data, rows, record):
             closed = loadgen.run_closed_loop(
                 call, n_req, workers=4, seed=0
             )
-            for t in swap_thread:
+            for t in swap_thread + join_thread + drain_thread:
                 t.join(timeout=30)
             status = router.status()
             record["fleet_replicas"] = nrep
@@ -1012,10 +1089,34 @@ def measure_fleet_family(model, data, rows, record):
                 "swaps": status["swaps"],
                 "latency_ns": status["latency_ns"],
             }
+            record["fleet_elastic"] = int(elastic)
+            if elastic:
+                record["fleet_join_to_serving_ns"] = int(
+                    elastic_state.get("join_ns", 0)
+                )
+                record["fleet_drain_ns"] = int(
+                    elastic_state.get("drain_ns", 0)
+                )
+                record["fleet_scale_events"] = int(
+                    status["joins"] + status["drains"]
+                )
+                record["fleet"]["elastic"] = {
+                    "join": elastic_state.get("join"),
+                    "drain": elastic_state.get("drain"),
+                    "joins": status["joins"],
+                    "drains": status["drains"],
+                }
         finally:
             router.close()
             try:
-                WorkerPool(addrs, timeout_s=10.0).shutdown_all()
+                extra = (
+                    [elastic_state["joiner"]]
+                    if elastic and elastic_state.get("joiner")
+                    else []
+                )
+                WorkerPool(
+                    addrs + extra, timeout_s=10.0
+                ).shutdown_all()
             except Exception:
                 pass
     except Exception as e:

@@ -80,8 +80,13 @@ THREAD_SHAPE_FIELDS = ("hist_threads", "bin_threads", "route_threads",
 #: per-tree-baseline record must never pair against a default or
 #: tpd=25 one. DEFAULTS TO 0 when absent so every historical record
 #: (all measured before the knob existed, i.e. knob unset) keeps
-#: pairing with new default-driver records.
-LOOP_SHAPE_FIELDS = ("device_loop",)
+#: pairing with new default-driver records. `fleet_elastic` rides the
+#: same default-0 discipline: an elastic fleet record (the closed loop
+#: spans a live add_replica/remove_replica — YDF_TPU_BENCH_FLEET_ELASTIC)
+#: must never pair with a static one (the scale ops perturb the run's
+#: tail and capacity), and every historical fleet record predates the
+#: mode, i.e. was static.
+LOOP_SHAPE_FIELDS = ("device_loop", "fleet_elastic")
 SHAPE_FIELDS = ("metric", "backend", "rows", "trees", "depth",
                 "dist_mode", "load_mode",
                 "fleet_replicas") + THREAD_SHAPE_FIELDS \
@@ -147,6 +152,13 @@ FIELD_SPECS: Dict[str, Tuple[str, float, float]] = {
     "fleet_sustained_qps": ("higher", 0.15, 0.0),
     "fleet_swap_p99_ns": ("lower", 0.25, 500.0),
     "fleet_failover_count": ("lower", 0.50, 0.5),
+    # elastic-membership additions (YDF_TPU_BENCH_FLEET_ELASTIC=1;
+    # fleet_elastic itself is a SHAPE field, never diffed): faster
+    # joins/drains are better, fewer scale events for the same run are
+    # better (an autoscaler that flaps is a regression).
+    "fleet_join_to_serving_ns": ("lower", 0.25, 500.0),
+    "fleet_drain_ns": ("lower", 0.25, 500.0),
+    "fleet_scale_events": ("lower", 0.50, 0.5),
     # transport-overhaul family (persistent pool + pipelining +
     # zero-copy framing): fewer connects and less wire traffic are
     # better, a higher connection-reuse rate is better, and the

@@ -64,6 +64,7 @@ def run_check(
     with_fleet: bool = False,
     with_transport: bool = False,
     with_cache_build: bool = False,
+    with_autoscaler: bool = False,
 ) -> dict:
     import numpy as np
 
@@ -188,6 +189,82 @@ def run_check(
                 pass
 
         fleet_once()  # warm the replica banks / code paths
+
+    autoscaler_once = None
+    autoscaler_cleanup = None
+    if with_autoscaler:
+        # Autoscaler variant: the control loop rides ALONGSIDE a
+        # 2-replica fleet predict load — closed-loop predicts plus a
+        # burst of `tick()` evaluations per rep, with min==max so the
+        # decision is deterministically "steady"/"hold" and no scale
+        # operation perturbs the timing. The autoscaler is ACTIVE in
+        # both the disabled and enabled measurements; the delta is
+        # exactly the tick's instrumentation (signal sampling, the
+        # decision log, the ydf_fleet_replicas gauge refresh).
+        import socket as _a_socket
+
+        from ydf_tpu.dataset.dataset import Dataset as _ADS
+        from ydf_tpu.parallel.worker_service import (
+            WorkerPool as _AWP,
+            start_worker as _a_start_worker,
+        )
+        from ydf_tpu.serving.autoscaler import (
+            FleetAutoscaler,
+            InProcessReplicaProvider,
+        )
+        from ydf_tpu.serving.fleet import FleetRouter as _AFleetRouter
+
+        am = ydf.GradientBoostedTreesLearner(
+            label="label", num_trees=trees, max_depth=depth,
+            validation_ratio=0.0, early_stopping="NONE",
+        ).train(ds)
+        aenc = _ADS.from_data(
+            {k: v[:512] for k, v in data.items()}, dataspec=am.dataspec,
+        )
+        ax_num, ax_cat, _ = am._encode_inputs(aenc)
+        ax_num = np.ascontiguousarray(ax_num)
+        ax_cat = np.ascontiguousarray(ax_cat)
+        a_av = ax_num.shape[0]
+        a_ports = []
+        for _ in range(2):
+            s = _a_socket.socket()
+            s.bind(("127.0.0.1", 0))
+            a_ports.append(s.getsockname()[1])
+            s.close()
+        for p in a_ports:
+            _a_start_worker(p, host="127.0.0.1", blocking=False)
+        a_addrs = [f"127.0.0.1:{p}" for p in a_ports]
+        a_router = _AFleetRouter(a_addrs)
+        a_router.deploy(am, "overhead_v1")
+        a_provider = InProcessReplicaProvider()
+        a_scaler = FleetAutoscaler(
+            a_router, a_provider, min_replicas=2, max_replicas=2,
+            cooldown_s=0.0, shed_high=1, idle_ticks=1_000_000,
+        )
+
+        def autoscaler_once():
+            from ydf_tpu.serving import loadgen
+
+            def call(i):
+                j = i % a_av
+                a_router.predict(
+                    ax_num[j: j + 1], ax_cat[j: j + 1], req_id=i
+                )
+
+            loadgen.run_closed_loop(call, 400, workers=4, seed=0)
+            for _ in range(20):
+                a_scaler.tick()
+
+        def autoscaler_cleanup():
+            a_scaler.close()
+            a_provider.close()
+            a_router.close()
+            try:
+                _AWP(a_addrs, timeout_s=10.0).shutdown_all()
+            except Exception:
+                pass
+
+        autoscaler_once()  # warm the replica banks / code paths
 
     transport_once = None
     transport_cleanup = None
@@ -351,6 +428,10 @@ def run_check(
     disabled_fleet = (
         measure_min_wall(fleet_once, reps) if fleet_once else None
     )
+    disabled_autoscaler = (
+        measure_min_wall(autoscaler_once, reps) if autoscaler_once
+        else None
+    )
     disabled_transport = (
         measure_min_wall(transport_once, reps) if transport_once
         else None
@@ -368,6 +449,7 @@ def run_check(
     enabled_fleet = None
     enabled_transport = None
     enabled_cache_build = None
+    enabled_autoscaler = None
     try:
         with telemetry.active(td):
             enabled = measure_min_wall(train_once, reps)
@@ -387,6 +469,10 @@ def run_check(
                 )
             if fleet_once is not None:
                 enabled_fleet = measure_min_wall(fleet_once, reps)
+            if autoscaler_once is not None:
+                enabled_autoscaler = measure_min_wall(
+                    autoscaler_once, reps
+                )
             if with_ledger:
                 # Ledger-accounting variant: RSS sampling at span
                 # boundaries FORCED on (it defaults on, but the check
@@ -511,6 +597,29 @@ def run_check(
         summary["fleet_budget_s"] = round(fleet_budget, 4)
         summary["ok_fleet"] = fleet_overhead <= fleet_budget
         summary["ok"] = summary["ok"] and summary["ok_fleet"]
+    if enabled_autoscaler is not None:
+        # The autoscaled fleet is its own baseline: the telemetry-off
+        # run pays the same predicts AND the same tick() evaluations,
+        # so the delta is exactly the control loop's instrumentation
+        # (the scale-event counters, the ydf_fleet_replicas gauge
+        # refresh, decision-log bookkeeping under telemetry).
+        autoscaler_overhead = enabled_autoscaler - disabled_autoscaler
+        autoscaler_budget = (
+            rel_budget * disabled_autoscaler + noise + abs_floor_s
+        )
+        summary["disabled_autoscaler_min_s"] = round(
+            disabled_autoscaler, 4
+        )
+        summary["enabled_autoscaler_min_s"] = round(
+            enabled_autoscaler, 4
+        )
+        summary["autoscaler_overhead_s"] = round(autoscaler_overhead, 4)
+        summary["autoscaler_budget_s"] = round(autoscaler_budget, 4)
+        summary["autoscaler_ticks"] = int(a_scaler.status()["ticks"])
+        summary["ok_autoscaler"] = (
+            autoscaler_overhead <= autoscaler_budget
+        )
+        summary["ok"] = summary["ok"] and summary["ok_autoscaler"]
     if enabled_transport is not None:
         # The pooled-transport loop is its own baseline: the
         # telemetry-off loop pays the same sockets, framing and
@@ -551,6 +660,8 @@ def run_check(
         summary["cache_build_budget_s"] = round(cache_budget, 4)
         summary["ok_cache_build"] = cache_overhead <= cache_budget
         summary["ok"] = summary["ok"] and summary["ok_cache_build"]
+    if autoscaler_cleanup is not None:
+        autoscaler_cleanup()
     if cache_build_cleanup is not None:
         cache_build_cleanup()
     if transport_cleanup is not None:
@@ -603,6 +714,14 @@ def main(argv=None) -> int:
                          "the new ydf_rpc_* connect/reuse/inflight/"
                          "wire-byte counters must fit the same 3%% "
                          "budget (ok_transport)")
+    ap.add_argument("--with-autoscaler", action="store_true",
+                    help="additionally measure a 2-replica fleet "
+                         "predict load with the FleetAutoscaler "
+                         "(serving/autoscaler.py) ticking alongside — "
+                         "the control loop is active in BOTH the "
+                         "telemetry-off and telemetry-on measurements "
+                         "and its instrumentation must fit the same "
+                         "3%% budget (ok_autoscaler)")
     ap.add_argument("--with-cache-build", action="store_true",
                     help="additionally measure a 2-worker distributed "
                          "dataset-cache build (parallel/dist_cache.py "
@@ -620,6 +739,7 @@ def main(argv=None) -> int:
         with_fleet=args.with_fleet,
         with_transport=args.with_transport,
         with_cache_build=args.with_cache_build,
+        with_autoscaler=args.with_autoscaler,
     )
     print(json.dumps(summary))
     return 0 if summary["ok"] else 1

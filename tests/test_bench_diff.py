@@ -343,3 +343,63 @@ def test_fleet_replicas_joins_pairing_shape_and_fields_directional(
     assert doc3["ok"], doc3["regressions"]
     imp = " ".join(doc3["improvements"])
     assert "fleet_sustained_qps" in imp and "fleet_swap_p99_ns" in imp
+
+
+def test_fleet_elastic_joins_pairing_shape_and_fields_directional(
+    bd, tmp_path
+):
+    """fleet_elastic is a DEFAULT-0 SHAPE field: an elastic fleet
+    record (the run spans live add_replica/remove_replica) never pairs
+    with a static one — and a historical record WITHOUT the field is
+    static (0), so pre-elastic artifacts keep pairing with new static
+    rounds. The elastic fields are direction-aware: slower joins/
+    drains and more scale events are regressions."""
+    static = _fleet_record(2, 50_000.0, 2_000_000.0, 0)
+    elastic = dict(
+        _fleet_record(2, 48_000.0, 2_200_000.0, 0),
+        fleet_elastic=1,
+        fleet_join_to_serving_ns=30_000_000.0,
+        fleet_drain_ns=3_000_000.0,
+        fleet_scale_events=2,
+    )
+    pa, pb = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    pa.write_text(json.dumps(static) + "\n")
+    pb.write_text(json.dumps(elastic) + "\n")
+    doc = bd.diff(str(pa), str(pb))
+    assert doc["pairs"] == []
+    assert any("fleet_elastic=1" in s for s in doc["unpaired_b"])
+    # Static records suppress the default from the label (historical
+    # artifacts never carried the field).
+    assert not any("fleet_elastic" in s for s in doc["unpaired_a"])
+    # A record with the explicit 0 pairs with a field-less one.
+    explicit0 = dict(static, fleet_elastic=0)
+    pb.write_text(json.dumps(explicit0) + "\n")
+    doc2 = bd.diff(str(pa), str(pb))
+    assert len(doc2["pairs"]) == 1
+    # Elastic-with-elastic pairs; regression directions honored.
+    worse = dict(
+        elastic,
+        fleet_join_to_serving_ns=90_000_000.0,
+        fleet_drain_ns=9_000_000.0,
+        fleet_scale_events=6,
+    )
+    pa.write_text(json.dumps(elastic) + "\n")
+    pb.write_text(json.dumps(worse) + "\n")
+    doc3 = bd.diff(str(pa), str(pb))
+    assert len(doc3["pairs"]) == 1
+    flagged = " ".join(doc3["regressions"])
+    assert "fleet_join_to_serving_ns" in flagged
+    assert "fleet_drain_ns" in flagged
+    assert "fleet_scale_events" in flagged
+    # Improvements flow the other way and stay ok.
+    faster = dict(
+        elastic,
+        fleet_join_to_serving_ns=10_000_000.0,
+        fleet_drain_ns=1_000_000.0,
+    )
+    pb.write_text(json.dumps(faster) + "\n")
+    doc4 = bd.diff(str(pa), str(pb))
+    assert doc4["ok"], doc4["regressions"]
+    imp = " ".join(doc4["improvements"])
+    assert "fleet_join_to_serving_ns" in imp
+    assert "fleet_drain_ns" in imp

@@ -134,6 +134,14 @@ def test_small_cpu_run_emits_parseable_record():
     assert rec["rpc_header_bytes"] > 0
     assert rec["rpc_payload_bytes"] > 0
     assert rec["fleet_predict_rtt_p50_ns"] > 0
+    # Elastic membership (this round): without the env the fleet run is
+    # STATIC and says so — fleet_elastic is a bench-diff pairing shape
+    # field, so the default record must carry the 0 explicitly and none
+    # of the elastic headline fields.
+    assert rec["fleet_elastic"] == 0
+    assert "fleet_join_to_serving_ns" not in rec
+    assert "fleet_drain_ns" not in rec
+    assert "fleet_scale_events" not in rec
     # Resource observability (round 15): pool utilization per stage —
     # busy / (lanes x pooled wall) from native/thread_pool.h's stats
     # block — and the memory headline fields. On this image the native
@@ -283,6 +291,69 @@ def test_bench_cache_workers_env_validation(tmp_path):
     rec2 = {}
     mod.measure_cache_build_family(1000, 4, rec2)  # unset: no-op
     assert rec2 == {}
+
+
+def test_bench_fleet_elastic_env_validation(tmp_path):
+    """A malformed YDF_TPU_BENCH_FLEET_ELASTIC lands as a recorded
+    family error, never a crashed bench (artifact protocol)."""
+    mod = _load_bench(tmp_path)
+    rec = {}
+    os.environ["YDF_TPU_BENCH_FLEET_ELASTIC"] = "yes"
+    try:
+        mod.measure_fleet_family(None, None, 1000, rec)
+    finally:
+        del os.environ["YDF_TPU_BENCH_FLEET_ELASTIC"]
+    assert "must be 0 or 1" in rec["fleet_family_error"]
+
+
+def test_bench_fleet_family_elastic_mode(tmp_path):
+    """YDF_TPU_BENCH_FLEET_ELASTIC=1 (in-process, tier-1): the fleet
+    closed loop spans a live add_replica of a freshly spawned replica
+    and a remove_replica drain of it, and the record carries the
+    elastic headline fields — spawn->admitted wall, drain wall, the
+    scale-event count — with fleet_elastic=1 joining the bench-diff
+    pairing shape. Zero errors: the scale ops are invisible to
+    callers."""
+    import numpy as np
+
+    import ydf_tpu as ydf
+    from ydf_tpu.config import Task
+
+    mod = _load_bench(tmp_path)
+    rng = np.random.RandomState(0)
+    rows = 1500
+    data = {
+        f"f{i}": rng.normal(size=rows).astype(np.float32)
+        for i in range(5)
+    }
+    data["label"] = (data["f0"] + data["f1"] > 0).astype(np.int64)
+    model = ydf.GradientBoostedTreesLearner(
+        label="label", task=Task.CLASSIFICATION, num_trees=3,
+        max_depth=3, validation_ratio=0.0, early_stopping="NONE",
+    ).train(data)
+    rec = {}
+    os.environ["YDF_TPU_BENCH_FLEET_ELASTIC"] = "1"
+    try:
+        mod.measure_fleet_family(model, data, rows, rec)
+    finally:
+        del os.environ["YDF_TPU_BENCH_FLEET_ELASTIC"]
+    assert rec.get("fleet_family_error") is None, rec.get(
+        "fleet_family_error"
+    )
+    assert rec["fleet_elastic"] == 1
+    assert rec["fleet_join_to_serving_ns"] > 0
+    assert rec["fleet_drain_ns"] > 0
+    # Exactly one join and one drain — an autoscaler-shaped run that
+    # flapped would inflate this.
+    assert rec["fleet_scale_events"] == 2
+    el = rec["fleet"]["elastic"]
+    assert el["join"]["joined"] is True
+    assert el["drain"]["removed"] is True
+    assert el["joins"] == 1 and el["drains"] == 1
+    # The scale ops were invisible to the load: zero errors, and the
+    # fleet ends on its founding replicas (the joiner drained away).
+    assert rec["fleet"]["errors"] == 0
+    assert rec["fleet_replicas"] == 2
 
 
 def _load_bench(tmp_path):

@@ -1,0 +1,828 @@
+"""Elastic membership (this round's tentpole — docs/serving.md
+"Elastic fleet", docs/distributed_training.md "Elastic membership"):
+live replica join/leave in a serving fleet, worker churn at tree
+boundaries in a running distributed train, and the router-driven
+autoscaler — chaos-proven under sustained load.
+
+Proof bar, per the acceptance criteria: a replica JOIN under sustained
+closed-loop load is invisible (zero errors, zero join-attributable
+sheds, every response bit-identical); a LEAVE drains in-flight
+predicts without dropping one; a distributed train whose membership
+changes at a tree boundary — join AND leave — produces a model
+bit-identical to the fixed-membership run, and a joining worker killed
+for real recovers via quarantine + remap; the autoscaler, driven only
+by exported signals, grows under overload until the shed rate reaches
+zero and shrinks after cooldown, with every decision visible in
+telemetry and the /statusz decision log."""
+
+import collections
+import queue
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+import ydf_tpu as ydf
+from ydf_tpu.config import Task
+from ydf_tpu.dataset.cache import create_dataset_cache
+from ydf_tpu.dataset.dataset import Dataset
+from ydf_tpu.parallel import dist_worker
+from ydf_tpu.parallel.dist_gbt import MembershipChannel
+from ydf_tpu.parallel.worker_service import WorkerPool, start_worker
+from ydf_tpu.serving import loadgen
+from ydf_tpu.serving.autoscaler import (
+    FleetAutoscaler,
+    InProcessReplicaProvider,
+)
+from ydf_tpu.serving.fleet import FleetError, FleetRouter
+from ydf_tpu.serving.flatten import forest_fingerprint
+from ydf_tpu.serving.registry import _note_shed
+from ydf_tpu.utils import failpoints, telemetry
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spin_replicas(n):
+    ports = [_free_port() for _ in range(n)]
+    for p in ports:
+        start_worker(p, host="127.0.0.1", blocking=False)
+    return [f"127.0.0.1:{p}" for p in ports]
+
+
+@pytest.fixture(scope="module")
+def models():
+    """Two deliberately DIFFERENT tiny models over one dataspec, plus
+    pre-encoded rows and per-model oracles (the test_fleet recipe)."""
+    rng = np.random.RandomState(7)
+    n = 1200
+    x = rng.normal(size=(n, 5)).astype(np.float32)
+    y = (x[:, 0] - 0.5 * x[:, 1] + 0.1 * rng.normal(size=n)).astype(
+        np.float32
+    )
+    data = {f"f{i}": x[:, i] for i in range(5)}
+    data["y"] = y
+    ds = Dataset.from_data(data, label="y")
+
+    def mk(trees, depth):
+        return ydf.GradientBoostedTreesLearner(
+            label="y", task=Task.REGRESSION, num_trees=trees,
+            max_depth=depth, validation_ratio=0.0,
+            early_stopping="NONE",
+        ).train(ds)
+
+    m1, m2 = mk(3, 3), mk(5, 4)
+    enc = Dataset.from_data(
+        {k: v[:64] for k, v in data.items()}, dataspec=m1.dataspec
+    )
+    x_num, x_cat, _ = m1._encode_inputs(enc)
+    x_num = np.ascontiguousarray(x_num)
+    x_cat = np.ascontiguousarray(x_cat)
+
+    def oracle(m):
+        eng = m._fast_engine()
+        if eng is not None:
+            return np.asarray(eng(x_num, x_cat), np.float32)
+        import jax.numpy as jnp
+
+        from ydf_tpu.ops.routing import forest_predict_values
+
+        return np.asarray(
+            forest_predict_values(
+                m.forest, jnp.asarray(x_num), jnp.asarray(x_cat),
+                num_numerical=m.binner.num_numerical,
+                max_depth=m.max_depth, combine="sum",
+            ),
+            np.float32,
+        )[:, 0]
+
+    return {
+        "m1": m1, "m2": m2, "x_num": x_num, "x_cat": x_cat,
+        "oracle1": oracle(m1), "oracle2": oracle(m2),
+    }
+
+
+# --------------------------------------------------------------------- #
+# WorkerPool membership primitive: fair rotation across add/remove
+# --------------------------------------------------------------------- #
+
+
+def test_pool_rotation_no_skip_no_double_under_churn():
+    """The satellite distribution proof: the round-robin cursor stays
+    fair across removals on EITHER side of it and across adds — no
+    live worker is skipped, none is visited twice per cycle. Fake
+    addresses: next_worker never dials when health state is empty."""
+    a = [f"10.9.9.{i}:700{i}" for i in range(4)]
+    pool = WorkerPool(a)
+
+    def take(n):
+        out = []
+        for _ in range(n):
+            i = pool.next_worker()
+            assert i is not None
+            out.append(pool.addr_str(i))
+        return out
+
+    # Fair baseline: two full cycles visit everyone exactly twice.
+    assert collections.Counter(take(8)) == {x: 2 for x in a}
+    # Remove BEHIND the cursor: a0 was just visited, cursor points at
+    # a1 — a1 must still be next (no skip), a0 gone.
+    assert take(1) == [a[0]]
+    assert pool.remove_worker(a[0]) is True
+    assert take(3) == [a[1], a[2], a[3]]
+    # Remove AHEAD of the cursor (a3, not yet visited this cycle):
+    # the rest of the cycle continues without a double-visit.
+    assert take(1) == [a[1]]
+    assert pool.remove_worker(a[3]) is True
+    assert take(2) == [a[2], a[1]]
+    # Add: the newcomer slots into the NEXT cycle exactly once.
+    b = "10.9.9.9:7009"
+    idx = pool.add_worker(b)
+    assert pool.addr_str(idx) == b
+    assert collections.Counter(take(3)) == {a[1]: 1, a[2]: 1, b: 1}
+    # Idempotent add; unknown remove is a no-op.
+    assert pool.addr_str(pool.add_worker(a[1])) == a[1]
+    assert len(pool.addresses) == 3
+    assert pool.remove_worker("10.0.0.1:1") is False
+    # Never empty the rotation.
+    assert pool.remove_worker(a[2]) is True
+    assert pool.remove_worker(b) is True
+    with pytest.raises(ValueError, match="last worker"):
+        pool.remove_worker(a[1])
+    assert take(2) == [a[1], a[1]]
+    pool.close()
+
+
+# --------------------------------------------------------------------- #
+# Serving tier: live join / leave
+# --------------------------------------------------------------------- #
+
+
+def test_add_replica_ships_verifies_and_serves(models):
+    """A joining replica receives EVERY deployed version's cached
+    deploy frame (active last), is fingerprint-verified, and serves
+    bit-identically the moment it is admitted."""
+    addrs = _spin_replicas(2)
+    extra = _spin_replicas(1)[0]
+    try:
+        with FleetRouter(addrs) as r:
+            r.deploy(models["m1"], "v1")
+            dep2 = r.deploy(models["m2"], "v2", activate=False)
+            res = r.add_replica(extra)
+            assert res["joined"] is True
+            # Non-active versions ship first, the active version LAST.
+            assert res["versions"] == ["v2", "v1"]
+            assert res["active"] == "v1" and res["replicas"] == 3
+            assert res["join_ns"] > 0
+            # Idempotent: a second join of a member is a no-op.
+            assert r.add_replica(extra)["joined"] is False
+            # The joiner is IN the rotation and serving v1.
+            for i in range(12):
+                r.predict(
+                    models["x_num"][:1], models["x_cat"][:1], req_id=i
+                )
+            sts = {
+                st["replica"]: st for st in r.replica_statuses()
+            }
+            assert extra in sts
+            assert sts[extra]["active_version"] == "v1"
+            assert sts[extra]["versions"]["v1"]["predicts"] >= 1
+            assert (
+                sts[extra]["versions"]["v2"]["fingerprint"]
+                == dep2["fingerprint"]
+            )
+            # Fleet answers stay bit-identical with the joiner serving.
+            s, v = r.predict_versioned(models["x_num"], models["x_cat"])
+            assert v == "v1" and np.array_equal(s, models["oracle1"])
+            st = r.status()
+            assert st["joins"] == 1 and st["join_p50_ns"] > 0
+    finally:
+        WorkerPool(addrs + [extra], timeout_s=10.0).shutdown_all()
+
+
+def test_churn_under_sustained_load_zero_errors_bit_identical(models):
+    """The tentpole acceptance run: seeded random join/leave churn
+    under sustained closed-loop load. Zero errors, zero sheds (so zero
+    join-attributable sheds), every response bit-identical, every
+    request answered exactly once, bounded p99."""
+    addrs = _spin_replicas(2)
+    spares = _spin_replicas(2)
+    try:
+        with FleetRouter(addrs) as r:
+            r.deploy(models["m1"], "v1")
+            n_req = 320
+            # Seeded random churn schedule, ops strictly ordered (the
+            # queue serializes them; marks are spaced beyond the lane
+            # count so FIFO matches the id order).
+            rng = np.random.RandomState(0)
+            marks, nxt = [], 40
+            for _ in range(4):
+                nxt += int(rng.randint(30, 60))
+                marks.append(nxt)
+            plan = [
+                ("join", spares[0]),
+                ("leave", addrs[0]),
+                ("join", spares[1]),
+                ("leave", spares[0]),
+            ]
+            triggers = set(marks)
+            q = queue.Queue()
+            churn_errors, done_ops = [], []
+
+            def churn():
+                try:
+                    for op, target in plan:
+                        q.get()
+                        if op == "join":
+                            res = r.add_replica(target)
+                            assert res["joined"], res
+                        else:
+                            res = r.remove_replica(target)
+                            assert res["removed"], res
+                        done_ops.append((op, target))
+                except Exception as e:  # surfaced after the run
+                    churn_errors.append(e)
+
+            th = threading.Thread(target=churn, daemon=True)
+            th.start()
+            results = {}
+            lock = threading.Lock()
+
+            def call(i):
+                if i in triggers:
+                    q.put(i)
+                j = i % 64
+                s, v = r.predict_versioned(
+                    models["x_num"][j: j + 1],
+                    models["x_cat"][j: j + 1],
+                    req_id=i,
+                )
+                with lock:
+                    assert i not in results  # exactly one answer per id
+                    results[i] = (j, float(s[0]))
+
+            rec = loadgen.run_closed_loop(call, n_req, workers=4, seed=0)
+            th.join(timeout=60)
+            assert not th.is_alive() and not churn_errors, churn_errors
+            assert len(done_ops) == 4
+            # Invisible churn: zero errors and zero sheds of ANY kind.
+            assert rec["errors"] == 0 and rec["shed"] == 0, rec
+            assert rec["ok"] == n_req and len(results) == n_req
+            assert rec["latency_p99_ns"] < 5e9, rec["latency_p99_ns"]
+            for i, (j, val) in results.items():
+                assert val == float(models["oracle1"][j]), (i, j)
+            st = r.status()
+            assert st["joins"] == 2 and st["drains"] == 2
+            assert sorted(st["replicas"]) == sorted(
+                [addrs[1], spares[1]]
+            )
+            # The surviving joiner really carries traffic.
+            for i in range(1000, 1012):
+                r.predict(
+                    models["x_num"][:1], models["x_cat"][:1], req_id=i
+                )
+            sts = {
+                s0.get("replica"): s0
+                for s0 in r.replica_statuses()
+                if "error" not in s0
+            }
+            assert sts[spares[1]]["versions"]["v1"]["predicts"] >= 1
+    finally:
+        WorkerPool(addrs + spares, timeout_s=10.0).shutdown_all()
+
+
+def test_remove_replica_drains_frees_and_refuses_empty(models):
+    from ydf_tpu.serving.native_serve import bank_bytes_total
+
+    addrs = _spin_replicas(2)
+    try:
+        with FleetRouter(addrs) as r:
+            r.deploy(models["m1"], "v1")
+            bytes_before = bank_bytes_total()
+            res = r.remove_replica(addrs[0])
+            assert res["removed"] is True and res["reachable"] is True
+            assert res["replicas"] == 1 and res["drain_ns"] > 0
+            # In-process replicas share this process's serve_bank
+            # ledger: the drained bank's bytes really were released.
+            if res["freed_bytes"]:
+                assert (
+                    bank_bytes_total()
+                    == bytes_before - res["freed_bytes"]
+                )
+            st = r.status()
+            assert st["replicas"] == [addrs[1]] and st["drains"] == 1
+            # Traffic is untouched by the departure.
+            s, v = r.predict_versioned(models["x_num"], models["x_cat"])
+            assert v == "v1" and np.array_equal(s, models["oracle1"])
+            # Idempotent; and the rotation can never be emptied.
+            assert r.remove_replica(addrs[0])["removed"] is False
+            with pytest.raises(ValueError, match="last worker"):
+                r.remove_replica(addrs[1])
+    finally:
+        WorkerPool(addrs, timeout_s=10.0).shutdown_all()
+
+
+def test_join_chaos_never_enters_rotation(models):
+    """The fleet.join chaos site AND a candidate killed mid-join: both
+    abort the join with the fleet EXACTLY as it was — the candidate
+    never entered the rotation, traffic never saw it."""
+    addrs = _spin_replicas(2)
+    spare = _spin_replicas(1)[0]
+    try:
+        with FleetRouter(addrs) as r:
+            r.deploy(models["m1"], "v1")
+            with failpoints.active("fleet.join=error"):
+                with pytest.raises(
+                    FleetError, match="never entered the rotation"
+                ):
+                    r.add_replica(spare)
+                assert "fleet.join" in failpoints.fired_sites()
+            assert r.status()["replicas"] == addrs
+            # Kill the candidate for real, then try to admit it.
+            WorkerPool([spare], timeout_s=10.0).shutdown_all()
+            with pytest.raises(
+                FleetError, match="never entered the rotation"
+            ):
+                r.add_replica(spare)
+            st = r.status()
+            assert st["replicas"] == addrs and st["joins"] == 0
+            s, v = r.predict_versioned(models["x_num"], models["x_cat"])
+            assert v == "v1" and np.array_equal(s, models["oracle1"])
+    finally:
+        WorkerPool(addrs, timeout_s=10.0).shutdown_all()
+
+
+def test_drain_chaos_leaves_replica_serving(models):
+    addrs = _spin_replicas(2)
+    try:
+        with FleetRouter(addrs) as r:
+            r.deploy(models["m1"], "v1")
+            with failpoints.active("fleet.drain=error"):
+                with pytest.raises(
+                    FleetError, match="stays in the rotation"
+                ):
+                    r.remove_replica(addrs[0])
+                assert "fleet.drain" in failpoints.fired_sites()
+            st = r.status()
+            assert st["replicas"] == addrs and st["drains"] == 0
+            # BOTH replicas still serve (the aborted drain tore down
+            # nothing).
+            for i in range(10):
+                r.predict(
+                    models["x_num"][:1], models["x_cat"][:1], req_id=i
+                )
+            counts = [
+                s0["versions"]["v1"]["predicts"]
+                for s0 in r.replica_statuses()
+            ]
+            assert len(counts) == 2 and min(counts) >= 1, counts
+    finally:
+        WorkerPool(addrs, timeout_s=10.0).shutdown_all()
+
+
+def test_leave_raced_with_swap_resolves_consistent(models):
+    """A leave raced against a hot-swap: the membership lock serializes
+    them in SOME order, and either order ends with a consistent fleet —
+    the leaver gone, every remaining replica active on the new version,
+    answers bit-identical."""
+    addrs = _spin_replicas(3)
+    try:
+        with FleetRouter(addrs) as r:
+            r.deploy(models["m1"], "v1")
+            r.deploy(models["m2"], "v2", activate=False)
+            errs = []
+
+            def do_swap():
+                try:
+                    r.swap_to("v2")
+                except Exception as e:
+                    errs.append(e)
+
+            t = threading.Thread(target=do_swap, daemon=True)
+            t.start()
+            res = r.remove_replica(addrs[1])
+            t.join(timeout=60)
+            assert not t.is_alive() and not errs, errs
+            assert res["removed"] is True
+            st = r.status()
+            assert st["active_version"] == "v2"
+            assert addrs[1] not in st["replicas"]
+            assert len(st["replicas"]) == 2
+            for s0 in r.replica_statuses():
+                assert s0["active_version"] == "v2"
+            s, v = r.predict_versioned(models["x_num"], models["x_cat"])
+            assert v == "v2" and np.array_equal(s, models["oracle2"])
+    finally:
+        WorkerPool(addrs, timeout_s=10.0).shutdown_all()
+
+
+def test_frame_cache_evicted_on_retire(models):
+    """The satellite fix: cached deploy frames are dropped when their
+    version retires — by the swap rollout AND by retire_version (the
+    swap_to(retire=False) cleanup path) — with the freed bytes visible
+    in the memory ledger."""
+    addrs = _spin_replicas(2)
+    extra = []
+    try:
+        with telemetry.active():
+            with FleetRouter(addrs) as r:
+                r.deploy(models["m1"], "v1")
+                fb1 = r.status()["deploy_frame_bytes"]
+                assert fb1 > 0
+                assert (
+                    telemetry.ledger().get_bytes("fleet_deploy_frames")
+                    == fb1
+                )
+                r.deploy(models["m2"], "v2", activate=False)
+                fb2 = r.status()["deploy_frame_bytes"]
+                assert fb2 > fb1
+                # Swap retires v1: its frame entry is evicted and the
+                # ledger drops by exactly v1's frame bytes.
+                r.swap_to("v2")
+                fb3 = r.status()["deploy_frame_bytes"]
+                assert fb3 == fb2 - fb1
+                assert (
+                    telemetry.ledger().get_bytes("fleet_deploy_frames")
+                    == fb3
+                )
+                # retire_version: refuses the active version, retires a
+                # parked one everywhere, idempotent on the second call.
+                r.deploy(models["m1"], "v3", activate=False)
+                with pytest.raises(FleetError, match="ACTIVE"):
+                    r.retire_version("v2")
+                res = r.retire_version("v3")
+                assert res["retired"] is True and not res["errors"]
+                assert r.status()["deploy_frame_bytes"] == fb3
+                for s0 in r.replica_statuses():
+                    assert set(s0["versions"]) == {"v2"}
+                assert r.retire_version("v3")["retired"] is False
+                # A later join ships only what is still deployed.
+                spare = _spin_replicas(1)[0]
+                extra.append(spare)
+                res = r.add_replica(spare)
+                assert res["joined"] and res["versions"] == ["v2"]
+    finally:
+        WorkerPool(addrs + extra, timeout_s=10.0).shutdown_all()
+
+
+# --------------------------------------------------------------------- #
+# Training tier: worker churn at tree boundaries
+# --------------------------------------------------------------------- #
+
+
+def _frame(n=1600, seed=7):
+    rng = np.random.RandomState(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float64)
+    x[rng.rand(n) < 0.08, 0] = np.nan  # missing values
+    cat = rng.choice(["aa", "bb", "cc", "dd"], size=n)
+    y = (
+        x[:, 1] * 1.5
+        - np.nan_to_num(x[:, 0])
+        + (cat == "aa") * 2.0
+        + rng.normal(scale=0.3, size=n)
+    )
+    return {
+        "f0": x[:, 0], "f1": x[:, 1], "f2": x[:, 2], "f3": x[:, 3],
+        "c0": cat, "y": y.astype(np.float32),
+    }
+
+
+def _cache_for_mode(tmp_path, mode, name=None):
+    kw = {
+        "feature": {"feature_shards": 2},
+        "row": {"row_shards": 2},
+        "hybrid": {"row_shards": 2, "feature_shards": 2},
+    }[mode]
+    return create_dataset_cache(
+        _frame(), str(tmp_path / (name or f"cache_{mode}")),
+        label="y", task=Task.REGRESSION, **kw,
+    )
+
+
+def _learner(num_trees=4, **kw):
+    return ydf.GradientBoostedTreesLearner(
+        label="y", task=Task.REGRESSION, num_trees=num_trees,
+        max_depth=4, validation_ratio=0.0, early_stopping="NONE",
+        **kw,
+    )
+
+
+def _assert_bit_identical(m_a, m_b):
+    f_a = m_a.forest.to_numpy()
+    f_b = m_b.forest.to_numpy()
+    assert set(f_a) == set(f_b)
+    for k in sorted(f_b):
+        a, b = f_a[k], f_b[k]
+        if a is None or b is None:
+            assert a is b, k
+            continue
+        assert np.array_equal(
+            np.asarray(a), np.asarray(b)
+        ), f"forest field {k!r} differs"
+    assert np.array_equal(
+        np.asarray(m_a.initial_predictions),
+        np.asarray(m_b.initial_predictions),
+    )
+    assert np.allclose(
+        m_a.training_logs["train_loss"],
+        m_b.training_logs["train_loss"],
+        rtol=0, atol=0,
+    ), "per-iteration training losses differ"
+
+
+@pytest.fixture
+def workers():
+    started = []
+
+    def start(n):
+        ports = [_free_port() for _ in range(n)]
+        for p in ports:
+            start_worker(p, host="127.0.0.1", blocking=False)
+        addrs = [f"127.0.0.1:{p}" for p in ports]
+        WorkerPool(addrs).ping_all()
+        started.extend(addrs)
+        return addrs
+
+    yield start
+    try:
+        WorkerPool(started).shutdown_all() if started else None
+    except Exception:
+        pass
+    dist_worker.reset_state()
+
+
+@pytest.mark.parametrize(
+    "mode,quant",
+    [
+        ("feature", "f32"), ("feature", "int8"),
+        ("row", "f32"), ("row", "int8"),
+        ("hybrid", "f32"), ("hybrid", "int8"),
+    ],
+)
+def test_dist_churn_at_tree_boundaries_bit_identical(
+    tmp_path, workers, monkeypatch, mode, quant
+):
+    """The training-tier acceptance run: a worker JOINS the train at
+    tree boundary 1 and a founding worker LEAVES at boundary 2 — in
+    all three dist modes, both ends of the quant spectrum — and the
+    model is bit-identical to the fixed-membership run."""
+    from ydf_tpu.learners.gbt import _make_boost_fn
+
+    if quant != "f32":
+        monkeypatch.setenv("YDF_TPU_HIST_QUANT", quant)
+        _make_boost_fn.cache_clear()
+    try:
+        cache = _cache_for_mode(tmp_path, mode)
+        addrs = workers(3)
+        m_ref = _learner(distributed_workers=addrs[:2]).train(cache)
+        ch = MembershipChannel()
+        ch.post("join", addrs[2], at_tree=1)
+        ch.post("leave", addrs[0], at_tree=2)
+        m_ch = _learner(
+            distributed_workers=addrs[:2], distributed_membership=ch,
+        ).train(cache)
+        _assert_bit_identical(m_ch, m_ref)
+        assert [
+            (e["op"], e["applied_at_tree"]) for e in ch.applied()
+        ] == [("join", 1), ("leave", 2)]
+        assert ch.pending() == []
+        d_ref = m_ref.training_logs["distributed"]
+        d_ch = m_ch.training_logs["distributed"]
+        # Each membership change bumped the epoch fence once.
+        assert d_ch["epoch"] == d_ref["epoch"] + 2
+        assert d_ch["hist_quant"] == quant
+    finally:
+        if quant != "f32":
+            _make_boost_fn.cache_clear()
+
+
+def test_dist_member_join_chaos_drops_candidate_bit_identical(
+    tmp_path, workers
+):
+    """The dist.member_join chaos site: the join attempt faults at its
+    first boundary, the candidate is quarantined back out, the event
+    re-queues and SUCCEEDS at the next boundary — and the model is
+    bit-identical to the fixed-membership run either way."""
+    cache = _cache_for_mode(tmp_path, "feature")
+    addrs = workers(3)
+    m_ref = _learner(distributed_workers=addrs[:2]).train(cache)
+    ch = MembershipChannel()
+    ch.post("join", addrs[2], at_tree=1)
+    with failpoints.active("dist.member_join=error"):
+        m_ch = _learner(
+            distributed_workers=addrs[:2], distributed_membership=ch,
+        ).train(cache)
+        assert "dist.member_join" in failpoints.fired_sites()
+    _assert_bit_identical(m_ch, m_ref)
+    # Faulted at boundary 1, re-queued, admitted at boundary 2.
+    assert [
+        (e["op"], e["applied_at_tree"]) for e in ch.applied()
+    ] == [("join", 2)]
+    assert ch.pending() == []
+    d = m_ch.training_logs["distributed"]
+    assert d["epoch"] == m_ref.training_logs["distributed"]["epoch"] + 1
+
+
+def test_dist_join_of_killed_worker_recovers_bit_identical(
+    tmp_path, workers
+):
+    """A joining worker killed FOR REAL: the join probe fails, the
+    candidate is quarantined out of the rotation again and the event
+    retries until its budget drains — training never stalls and the
+    model is bit-identical to the fixed-membership run."""
+    cache = _cache_for_mode(tmp_path, "row")
+    addrs = workers(3)
+    m_ref = _learner(distributed_workers=addrs[:2]).train(cache)
+    # Kill the candidate before it can ever join.
+    WorkerPool([addrs[2]], timeout_s=10.0).shutdown_all()
+    ch = MembershipChannel()
+    ch.post("join", addrs[2], at_tree=1)
+    m_ch = _learner(
+        distributed_workers=addrs[:2], distributed_membership=ch,
+    ).train(cache)
+    _assert_bit_identical(m_ch, m_ref)
+    assert ch.applied() == [] and ch.pending() == []
+
+
+# --------------------------------------------------------------------- #
+# Autoscaler
+# --------------------------------------------------------------------- #
+
+
+def test_autoscaler_env_knobs_validated_eagerly(monkeypatch):
+    provider = InProcessReplicaProvider()
+    monkeypatch.setenv("YDF_TPU_AUTOSCALE_MIN", "two")
+    with pytest.raises(ValueError, match="YDF_TPU_AUTOSCALE_MIN"):
+        FleetAutoscaler(None, provider, register_statusz=False)
+    monkeypatch.delenv("YDF_TPU_AUTOSCALE_MIN")
+    monkeypatch.setenv("YDF_TPU_AUTOSCALE_COOLDOWN_S", "-3")
+    with pytest.raises(
+        ValueError, match="YDF_TPU_AUTOSCALE_COOLDOWN_S"
+    ):
+        FleetAutoscaler(None, provider, register_statusz=False)
+    monkeypatch.delenv("YDF_TPU_AUTOSCALE_COOLDOWN_S")
+    monkeypatch.setenv("YDF_TPU_AUTOSCALE_IDLE_TICKS", "0")
+    with pytest.raises(
+        ValueError, match="YDF_TPU_AUTOSCALE_IDLE_TICKS"
+    ):
+        FleetAutoscaler(None, provider, register_statusz=False)
+    monkeypatch.delenv("YDF_TPU_AUTOSCALE_IDLE_TICKS")
+    with pytest.raises(ValueError, match="must be >="):
+        FleetAutoscaler(
+            None, provider, min_replicas=4, max_replicas=2,
+            register_statusz=False,
+        )
+
+
+def test_autoscaler_grows_under_overload_then_shrinks_idle(models):
+    """The acceptance run: a 4x-overloaded single-replica fleet (four
+    closed-loop lanes against an in-flight cap of one) sheds; the
+    autoscaler — driven ONLY by the exported shed signal — grows the
+    fleet until a load round completes with ZERO sheds, then shrinks
+    back to min once idle, every decision in telemetry and the
+    decision log, every accepted answer bit-identical throughout."""
+    addrs = _spin_replicas(1)
+    provider = InProcessReplicaProvider()
+    try:
+        with telemetry.active():
+            with FleetRouter(addrs, max_inflight_per_replica=1) as r:
+                r.deploy(models["m1"], "v1")
+                scaler = FleetAutoscaler(
+                    r, provider, min_replicas=1, max_replicas=4,
+                    interval_s=0.05, cooldown_s=0.0, shed_high=1,
+                    idle_ticks=2, register_statusz=False,
+                )
+                scaler.tick()  # baseline sample
+
+                def call(i):
+                    j = i % 64
+                    s, v = r.predict_versioned(
+                        models["x_num"][j: j + 1],
+                        models["x_cat"][j: j + 1],
+                        req_id=i,
+                    )
+                    assert float(s[0]) == float(models["oracle1"][j])
+
+                rec = None
+                for rnd in range(8):
+                    rec = loadgen.run_closed_loop(
+                        call, 60, workers=4, seed=rnd
+                    )
+                    assert rec["errors"] == 0, rec
+                    if rec["shed"] == 0 and rnd > 0:
+                        break
+                    scaler.tick()
+                # Overload relieved: the last round shed NOTHING, and
+                # the only shed reason ever seen was the admission cap.
+                assert rec["shed"] == 0, rec
+                st = scaler.status()
+                assert st["scale_ups"] >= 1
+                assert 2 <= len(r.pool.addresses) <= 4
+                assert r.status()["admission_sheds"] >= 1
+                # Idle shrink: consecutive zero-shed ticks walk the
+                # fleet back to min, LIFO over the spawned replicas.
+                for _ in range(8):
+                    scaler.tick()
+                st = scaler.status()
+                assert st["scale_downs"] == st["scale_ups"]
+                assert st["spawned"] == []
+                assert len(r.pool.addresses) == 1
+                # Decisions visible: telemetry counters + the bounded
+                # decision log carry every scale event.
+                snap = telemetry.snapshot()
+                ups = snap["counters"].get(
+                    'ydf_fleet_scale_events_total'
+                    '{direction="up",reason="overload_shed"}', 0
+                )
+                downs = snap["counters"].get(
+                    'ydf_fleet_scale_events_total'
+                    '{direction="down",reason="idle"}', 0
+                )
+                assert ups >= 1 and downs == ups
+                assert snap["gauges"].get("ydf_fleet_replicas") == 1
+                reasons = [d["reason"] for d in st["decisions"]]
+                assert "overload_shed" in reasons and "idle" in reasons
+                scaler.close()
+    finally:
+        provider.close()
+        WorkerPool(addrs, timeout_s=10.0).shutdown_all()
+
+
+def test_autoscaler_hysteresis_cooldown_and_bounds(models):
+    """Deterministic band behavior, driven by injected shed samples
+    (the same counter the serving tier exports): below-band holds,
+    cooldown suppresses consecutive scales, at_max caps growth, and a
+    fleet whose replicas the autoscaler did NOT spawn is never shrunk
+    (nothing_to_remove)."""
+    addrs = _spin_replicas(1)
+    provider = InProcessReplicaProvider()
+    try:
+        with FleetRouter(addrs) as r:
+            r.deploy(models["m1"], "v1")
+            sc = FleetAutoscaler(
+                r, provider, min_replicas=1, max_replicas=3,
+                cooldown_s=30.0, shed_high=3, idle_ticks=2,
+                register_statusz=False,
+            )
+            assert sc.tick()["direction"] == "hold"  # baseline
+            # Below the band: hold.
+            _note_shed("elastic_test", 2)
+            d = sc.tick()
+            assert (d["direction"], d["reason"]) == ("hold", "steady")
+            # Over the band: grow (a real spawn + verified join).
+            _note_shed("elastic_test", 5)
+            d = sc.tick()
+            assert (d["direction"], d["reason"]) == (
+                "up", "overload_shed"
+            )
+            assert len(r.pool.addresses) == 2
+            assert d["replica"] in [
+                r.pool.addr_str(i)
+                for i in range(len(r.pool.addresses))
+            ]
+            # Still overloaded but inside cooldown: hold.
+            _note_shed("elastic_test", 5)
+            d = sc.tick()
+            assert (d["direction"], d["reason"]) == ("hold", "cooldown")
+            # Idle ticks inside cooldown never shrink either.
+            sc.tick()
+            d = sc.tick()
+            assert (d["direction"], d["reason"]) == ("hold", "cooldown")
+            # A second scaler (cooldown elapsed-equivalent: fresh, zero
+            # cooldown) at the 2-replica bound: at_max caps growth, and
+            # with NOTHING it spawned, idle never removes the
+            # operator's replicas.
+            sc2 = FleetAutoscaler(
+                r, provider, min_replicas=1, max_replicas=2,
+                cooldown_s=0.0, shed_high=3, idle_ticks=2,
+                register_statusz=False,
+            )
+            sc2.tick()  # baseline
+            _note_shed("elastic_test", 5)
+            d = sc2.tick()
+            assert (d["direction"], d["reason"]) == ("hold", "at_max")
+            sc2.tick()
+            d = sc2.tick()
+            assert (d["direction"], d["reason"]) == (
+                "hold", "nothing_to_remove"
+            )
+            # The decision log holds the full, ordered story.
+            reasons = [x["reason"] for x in sc2.status()["decisions"]]
+            assert reasons[-3:] == [
+                "at_max", "steady", "nothing_to_remove"
+            ]
+            # Manual cleanup of the replica sc spawned.
+            spawned = sc.status()["spawned"]
+            assert len(spawned) == 1
+            r.remove_replica(spawned[0])
+            sc.close()
+            sc2.close()
+    finally:
+        provider.close()
+        WorkerPool(addrs, timeout_s=10.0).shutdown_all()

@@ -388,6 +388,30 @@ with _tempfile.TemporaryDirectory() as _td:
         _mb = _json.load(_fb)
     _ma.pop("build", None); _mb.pop("build", None)
     assert _ma == _mb, "cache meta differs under the sanitizer"
+    # Elastic membership under the sanitizer (elastic round): a second
+    # worker JOINS the running distributed train at tree boundary 1 —
+    # the epoch-bumped re-shard ships crc-verified shards through the
+    # sanitized stream paths, the joined worker's RPCs drive the same
+    # native histogram kernels, and the churned model must equal the
+    # fixed-membership one bit for bit.
+    from ydf_tpu.parallel.dist_gbt import MembershipChannel
+    _chan = MembershipChannel()
+    _chan.post("join", f"127.0.0.1:{_port2}", at_tree=1)
+    _m_el = _mk(
+        distributed_workers=[f"127.0.0.1:{_port}"],
+        distributed_membership=_chan,
+    ).train(_cache)
+    _fel = _m_el.forest.to_numpy()
+    for _k in _fl:
+        if _fl[_k] is not None:
+            assert np.array_equal(np.asarray(_fl[_k]),
+                                  np.asarray(_fel[_k])), _k
+    assert [
+        (e["op"], e["applied_at_tree"]) for e in _chan.applied()
+    ] == [("join", 1)], _chan.applied()
+    assert _chan.pending() == []
+    assert (_m_el.training_logs["distributed"]["epoch"]
+            == _m_dist.training_logs["distributed"]["epoch"] + 1)
     WorkerPool([f"127.0.0.1:{_port2}"]).shutdown_all()
     WorkerPool([f"127.0.0.1:{_port}"]).shutdown_all()
 
@@ -438,6 +462,32 @@ assert not _fb_errs, _fb_errs
 _fb_snap = _router.pool.transport_snapshot()
 assert _fb_snap["rpc_connects"] <= 2, _fb_snap
 assert _fb_snap["rpc_conn_reuse_rate"] > 0.5, _fb_snap
+# Elastic fleet join -> leave -> join cycle under the sanitizer
+# (elastic round): a spare replica is admitted live (cached deploy
+# frame shipped + fingerprint-verified through the sanitized bank
+# paths), serves bit-identically, drains back out (the bank free path
+# under asan), and RE-joins — the rotation never serves a wrong bit.
+_es = _socket.socket(); _es.bind(("127.0.0.1", 0))
+_e_port = _es.getsockname()[1]; _es.close()
+start_worker(_e_port, host="127.0.0.1", blocking=False)
+_e_addr = f"127.0.0.1:{_e_port}"
+for _cycle in range(2):
+    _jr = _router.add_replica(_e_addr)
+    assert _jr["joined"] and _jr["versions"] == ["san_v2"], _jr
+    assert _jr["replicas"] == 3 and _jr["join_ns"] > 0, _jr
+    for _k in range(6):  # full rotations: the joiner serves too
+        _rk, _vk = _router.predict_versioned(x_num, x_cat)
+        assert _vk == "san_v2" and np.array_equal(_rk, _o2)
+    if _cycle == 0:
+        _lr = _router.remove_replica(_e_addr)
+        assert _lr["removed"] and _lr["freed_bytes"] > 0, _lr
+        for _k in range(4):  # survivors unaffected by the drain
+            _rk, _vk = _router.predict_versioned(x_num, x_cat)
+            assert _vk == "san_v2" and np.array_equal(_rk, _o2)
+assert _router.status()["joins"] == 2, _router.status()
+_lr2 = _router.remove_replica(_e_addr)
+assert _lr2["removed"], _lr2
+WorkerPool([_e_addr]).shutdown_all()
 WorkerPool([_f_addrs[0]]).shutdown_all()
 _time.sleep(0.1)
 for _k in range(6):  # failover: dead replica quarantined, traffic moves

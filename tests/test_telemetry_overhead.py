@@ -69,6 +69,28 @@ def test_fleet_overhead_within_budget():
     )
 
 
+def test_autoscaler_overhead_within_budget():
+    """Autoscaler variant (`--with-autoscaler`): a 2-replica fleet
+    predict load with the FleetAutoscaler ticking alongside — the
+    control loop is ACTIVE in both the telemetry-off and telemetry-on
+    measurements (min==max so every decision is a deterministic hold),
+    and its instrumentation (scale-event counters, the
+    ydf_fleet_replicas gauge refresh, decision-log bookkeeping) must
+    fit the same 3% + noise budget. The watchdog may not eat the
+    serving capacity it guards."""
+    mod = _load()
+    summary = mod.run_check(rows=4_000, trees=4, depth=4, reps=2,
+                            with_autoscaler=True)
+    assert summary["disabled_autoscaler_min_s"] > 0
+    assert summary["enabled_autoscaler_min_s"] > 0
+    # Both measurements actually drove the control loop.
+    assert summary["autoscaler_ticks"] >= 80, summary
+    assert summary["ok_autoscaler"], (
+        "autoscaler telemetry overhead exceeded its budget: "
+        f"{summary}"
+    )
+
+
 def test_dist_row_overhead_within_budget():
     """Row-parallel distributed variant (`--with-dist-row`): the
     per-layer dist.layer spans, merge accounting and RPC latency

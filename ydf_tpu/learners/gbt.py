@@ -128,6 +128,7 @@ class GradientBoostedTreesLearner(GenericLearner):
         random_seed: int = 123456,
         mesh=None,
         distributed_workers: Optional[Sequence[str]] = None,
+        distributed_membership=None,
         **kwargs,
     ):
         super().__init__(
@@ -295,6 +296,11 @@ class GradientBoostedTreesLearner(GenericLearner):
         self.distributed_workers = (
             list(distributed_workers) if distributed_workers else None
         )
+        # Elastic membership: a parallel.dist_gbt.MembershipChannel the
+        # manager polls at every tree boundary — workers join/leave a
+        # RUNNING distributed train without changing a bit of the model
+        # (docs/distributed_training.md "Elastic membership").
+        self.distributed_membership = distributed_membership
 
     # ------------------------------------------------------------------ #
 
@@ -2310,6 +2316,7 @@ def _train_gbt_distributed(
             learner.resume_training_snapshot_interval_trees
         ),
         preempt_after_snapshots=learner._preempt_after_chunks,
+        membership=learner.distributed_membership,
     )
     if row_mode:
         # Deterministic train/validation split — the EXACT expressions

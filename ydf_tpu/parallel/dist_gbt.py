@@ -67,6 +67,7 @@ import contextlib
 import functools
 import os
 import signal as _signal
+import threading
 import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor
@@ -412,6 +413,79 @@ class _DistStats:
         return out
 
 
+class MembershipChannel:
+    """Elastic-membership mailbox for a RUNNING distributed train: an
+    operator (or the churn tests) posts join/leave events, the manager
+    claims whatever is due at each tree boundary (`_tree_boundary` →
+    `_apply_membership`) and remaps shards onto the new worker set with
+    the resume machinery — epoch bump fences the old view, joiners get
+    verify-or-re-ship shard loads, leavers leave their state to the
+    worker-side idle-TTL reaper. Applying membership ONLY at tree
+    boundaries is what keeps the model bit-identical to a
+    fixed-membership run: every merge inside a tree is order-fixed and
+    worker-count invariant, and no tree ever spans two views.
+
+    A join that fails (unreachable candidate, or the `dist.member_join`
+    chaos site) is re-queued for a later boundary, bounded by
+    MAX_JOIN_RETRIES — a flapping candidate cannot stall training."""
+
+    #: Bounded re-queue budget for a failed join.
+    MAX_JOIN_RETRIES = 2
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._pending: List[Dict[str, Any]] = []
+        self._applied: List[Dict[str, Any]] = []
+
+    def post(self, op: str, address: str, at_tree: int = 0) -> None:
+        """Queues a membership event: `op` is "join" or "leave",
+        `address` a "host:port" worker, `at_tree` the earliest tree
+        boundary (completed-tree count) it may apply at."""
+        if op not in ("join", "leave"):
+            raise ValueError(
+                f"membership op {op!r} must be 'join' or 'leave'"
+            )
+        with self._lock:
+            self._pending.append({
+                "op": op, "address": str(address),
+                "at_tree": int(at_tree), "retries": 0,
+            })
+
+    def claim(self, done: int) -> List[Dict[str, Any]]:
+        """Pops every event due at boundary `done` (at_tree <= done),
+        in post order."""
+        with self._lock:
+            due = [e for e in self._pending if e["at_tree"] <= done]
+            self._pending = [
+                e for e in self._pending if e["at_tree"] > done
+            ]
+        return due
+
+    def requeue(self, event: Dict[str, Any], at_tree: int) -> bool:
+        """Puts a failed join back for a later boundary; False when its
+        retry budget is spent (the event is dropped)."""
+        event = dict(event)
+        event["retries"] = int(event.get("retries", 0)) + 1
+        if event["retries"] > self.MAX_JOIN_RETRIES:
+            return False
+        event["at_tree"] = int(at_tree)
+        with self._lock:
+            self._pending.append(event)
+        return True
+
+    def note_applied(self, event: Dict[str, Any], done: int) -> None:
+        with self._lock:
+            self._applied.append({**event, "applied_at_tree": int(done)})
+
+    def applied(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._applied)
+
+    def pending(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._pending)
+
+
 class DistGBTManager:
     """Drives one distributed GBT train over a WorkerPool + feature-
     sharded DatasetCache. See the module docstring for the protocol."""
@@ -428,8 +502,10 @@ class DistGBTManager:
         resume: bool = False,
         snapshot_interval: int = 50,
         preempt_after_snapshots: Optional[int] = None,
+        membership: Optional[MembershipChannel] = None,
     ):
         self.pool = pool
+        self.membership = membership
         self.cache = cache
         self.loss_obj = loss_obj
         self.rule = rule
@@ -711,7 +787,13 @@ class DistGBTManager:
         scheduled snapshot, the `_preempt_after_chunks` test hook
         (trigger after N snapshots — the same semantics as the
         single-machine checkpointed driver), and the forced-final-
-        snapshot → TrainingPreempted exit when the guard tripped."""
+        snapshot → TrainingPreempted exit when the guard tripped.
+
+        Elastic membership applies HERE, before the snapshot check: the
+        worker set may only change between trees (every merge inside a
+        tree is pinned to one view) and it must work without a
+        working_dir too."""
+        self._apply_membership(done)
         if self._snaps is None:
             return
         saved = self._maybe_snapshot(
@@ -749,6 +831,109 @@ class DistGBTManager:
             f"snapshot at {done}/{self.num_trees} trees in "
             f"{self.working_dir!r} is resumable "
             "(resume_training=True / --resume)"
+        )
+
+    def _apply_membership(self, done: int) -> None:
+        """Applies the membership channel's due join/leave events at
+        tree boundary `done`, then remaps every shard onto the new
+        worker set with the resume machinery:
+
+          * epoch bump — fences the old view: a delayed frame from a
+            worker that left (or a zombie manager's) is rejected by
+            the worker-side `_check_epoch`, and load verbs ADOPT the
+            higher epoch, which is exactly what re-admits a joiner.
+          * owner recompute + `_load_shards(with_state=False)` per
+            group — verify-or-re-ship: a worker that already holds a
+            shard verifies it idempotently, a joiner receives it. No
+            per-tree state ships because every tree's first layer
+            request carries `reset=True`.
+          * a failed JOIN (unreachable candidate, or the
+            `dist.member_join` chaos site) quarantines the candidate
+            out again and re-queues the event for a later boundary
+            (bounded by MembershipChannel.MAX_JOIN_RETRIES); a LEAVE of
+            a non-member is a no-op and the last worker is never
+            removed. Leavers keep their resident state until the
+            worker-side idle TTL reaps it.
+
+        Bit-identity: all histogram/validation merges are order-fixed
+        and worker-count invariant, so a remap between trees cannot
+        change a single bit of the model."""
+        ch = self.membership
+        if ch is None:
+            return
+        events = ch.claim(done)
+        if not events:
+            return
+        changed = False
+        for ev in events:
+            op, addr = ev["op"], ev["address"]
+            if op == "join":
+                try:
+                    failpoints.hit("dist.member_join")
+                    widx = self.pool.add_worker(addr)
+                    resp = self.pool.request(
+                        widx, {"verb": "ping"},
+                        timeout_s=min(10.0, self.rpc_timeout_s),
+                    )
+                    if not resp.get("ok"):
+                        raise ConnectionError(
+                            f"join probe refused: {resp}"
+                        )
+                except (
+                    failpoints.FailpointError, OSError, ConnectionError
+                ) as e:
+                    # Quarantine-and-retry: the candidate leaves the
+                    # rotation again (it never owned a shard) and the
+                    # event re-queues for a later boundary, bounded.
+                    try:
+                        self.pool.remove_worker(addr, drain_timeout_s=0.0)
+                    except ValueError:
+                        pass
+                    requeued = ch.requeue(ev, done + 1)
+                    log.info(
+                        f"dist: worker join {addr} failed at tree "
+                        f"{done} ({type(e).__name__}: {e}); "
+                        + (
+                            "re-queued" if requeued
+                            else "dropped (retry budget spent)"
+                        )
+                    )
+                    if telemetry.ENABLED:
+                        telemetry.counter(
+                            "ydf_dist_membership_total", op="join_failed"
+                        ).inc()
+                    continue
+                changed = True
+            else:
+                try:
+                    if not self.pool.remove_worker(
+                        addr, drain_timeout_s=5.0
+                    ):
+                        continue  # not a member — idempotent
+                except ValueError:
+                    log.info(
+                        f"dist: refusing leave of {addr} at tree "
+                        f"{done} — it is the last worker"
+                    )
+                    continue
+                self.stats.drop_worker_shards(addr)
+                changed = True
+            ch.note_applied(ev, done)
+            if telemetry.ENABLED:
+                telemetry.counter(
+                    "ydf_dist_membership_total", op=op
+                ).inc()
+        if not changed:
+            return
+        self.epoch += 1
+        W = len(self.pool.addresses)
+        n_units = len(self.owner)
+        self.owner = [k % W for k in range(n_units)]
+        for widx, sids in sorted(self._groups(range(n_units)).items()):
+            self._load_shards(widx, sids, with_state=False)
+        log.info(
+            f"dist: membership changed at tree boundary {done}: "
+            f"{W} workers, epoch {self.epoch}"
         )
 
     # ---- RPC plumbing ------------------------------------------------ #

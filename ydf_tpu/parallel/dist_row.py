@@ -133,6 +133,7 @@ class RowDistGBTManager(DistGBTManager):
         resume: bool = False,
         snapshot_interval: int = 50,
         preempt_after_snapshots: Optional[int] = None,
+        membership=None,
     ):
         from ydf_tpu.dataset.cache import (
             row_shard_ranges,
@@ -143,6 +144,7 @@ class RowDistGBTManager(DistGBTManager):
         # feature-shard layout. The RPC plumbing reused from the base
         # class only needs the fields set here.
         self.pool = pool
+        self.membership = membership
         self.cache = cache
         self.loss_obj = loss_obj
         self.rule = rule

@@ -1254,7 +1254,8 @@ class WorkerPool:
         i, dialing one — under the per-address dial lock — when none is
         alive. A dead connection was already evicted by its reader, so
         this IS the lazy-reconnect path."""
-        addr = self.addresses[i % len(self.addresses)]
+        addrs = self.addresses  # snapshot: membership swaps the list
+        addr = addrs[i % len(addrs)]
         with self._conn_lock:
             c = self._conns.get(addr)
             if c is not None and not c.closed:
@@ -1346,7 +1347,8 @@ class WorkerPool:
     # ---- retry / backoff / quarantine ------------------------------- #
 
     def addr_str(self, i: int) -> str:
-        host, port = self.addresses[i % len(self.addresses)]
+        addrs = self.addresses  # snapshot: membership swaps the list
+        host, port = addrs[i % len(addrs)]
         return f"{host}:{port}"
 
     def backoff_delay(self, attempt: int) -> float:
@@ -1360,7 +1362,8 @@ class WorkerPool:
     def mark_failed(self, i: int) -> None:
         """Records a transport failure: the worker is quarantined for a
         backoff that doubles with each consecutive failure."""
-        addr = self.addresses[i % len(self.addresses)]
+        addrs = self.addresses  # snapshot: membership swaps the list
+        addr = addrs[i % len(addrs)]
         if telemetry.ENABLED:
             telemetry.counter(
                 "ydf_worker_quarantine_total",
@@ -1376,7 +1379,8 @@ class WorkerPool:
             st["until"] = time.monotonic() + hold
 
     def mark_ok(self, i: int) -> None:
-        addr = self.addresses[i % len(self.addresses)]
+        addrs = self.addresses  # snapshot: membership swaps the list
+        addr = addrs[i % len(addrs)]
         with self._health_lock:
             self._health.pop(addr, None)
 
@@ -1385,7 +1389,8 @@ class WorkerPool:
         will not be picked and has not yet earned a re-probe). The
         fleet's swap rollout reads this to skip dead replicas instead
         of blocking a deploy on them."""
-        addr = self.addresses[i % len(self.addresses)]
+        addrs = self.addresses  # snapshot: membership swaps the list
+        addr = addrs[i % len(addrs)]
         with self._health_lock:
             st = self._health.get(addr)
             return bool(st is not None and st["until"] > time.monotonic())
@@ -1398,10 +1403,18 @@ class WorkerPool:
         rerouted traffic onto the same first-healthy worker). The
         load-spreading pick of the serving fleet's router
         (serving/fleet.py); same health/re-probe semantics as
-        pick_worker, None when everything is quarantined."""
+        pick_worker, None when everything is quarantined.
+
+        The cursor is reduced modulo the LIVE list at claim time, under
+        the same lock that reads it: a pool that shrank since the last
+        pick (remove_worker, ping_all pruning) must neither skip a
+        survivor nor visit one twice — remove_worker additionally
+        shifts the cursor down when the removed slot sat below it, so
+        the rotation position over the survivors is preserved."""
         with self._rr_lock:
-            start = self._rr
-            self._rr = (self._rr + 1) % len(self.addresses)
+            n = len(self.addresses)
+            start = self._rr % n
+            self._rr = (start + 1) % n
         return self.pick_worker(start)
 
     def pick_worker(self, start: int) -> Optional[int]:
@@ -1414,10 +1427,11 @@ class WorkerPool:
         when one is alive, and dials fresh when the failure that
         quarantined the worker killed it. None when every worker is
         currently quarantined (caller backs off and retries)."""
-        n = len(self.addresses)
+        addrs = self.addresses  # snapshot: membership swaps the list
+        n = len(addrs)
         for off in range(n):
             i = (start + off) % n
-            addr = self.addresses[i]
+            addr = addrs[i]
             with self._health_lock:
                 st = self._health.get(addr)
                 if st is not None and st["until"] > time.monotonic():
@@ -1518,6 +1532,83 @@ class WorkerPool:
                 f"dropping unreachable workers: {errors}", stacklevel=2
             )
         self.addresses = alive
+
+    # ------------------------------------------------------------------
+    # Dynamic membership — the shared primitive both elastic tiers
+    # (serving fleet join/drain, distributed-train churn at tree
+    # boundaries) build on. Membership changes swap self.addresses
+    # atomically under _rr_lock; every hot-path reader snapshots the
+    # list into a local, so an in-flight pick resolves against ONE
+    # consistent view (possibly one generation stale — harmless,
+    # because requests are addressed by (host, port) tuples and health
+    # state is keyed the same way).
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _parse_addr(address: str) -> Tuple[str, int]:
+        host, _, port = str(address).rpartition(":")
+        return (host or "127.0.0.1", int(port))
+
+    def add_worker(self, address: str) -> int:
+        """Admits `address` ("host:port") to the rotation and returns
+        its index. Idempotent: an address already in the rotation keeps
+        its slot. A returning member starts with a clean health record
+        — its old quarantine (from whenever it died) must not outlive
+        its re-admission."""
+        addr = self._parse_addr(address)
+        with self._health_lock:
+            self._health.pop(addr, None)
+        with self._rr_lock:
+            addrs = self.addresses
+            for i, a in enumerate(addrs):
+                if a == addr:
+                    return i
+            self.addresses = addrs + [addr]
+            return len(addrs)
+
+    def remove_worker(
+        self, address: str, drain_timeout_s: float = 10.0
+    ) -> bool:
+        """Removes `address` from the rotation, then drains and closes
+        its pooled connection. Ordering is the point: removal from
+        rotation happens FIRST (atomic list swap), so no new pick can
+        land on the departing worker, then the pooled connection's
+        in-flight requests get a bounded window to complete before the
+        socket closes. Returns False when the address was not a member;
+        refuses to empty the rotation (the pool would deadlock every
+        caller)."""
+        addr = self._parse_addr(address)
+        with self._rr_lock:
+            addrs = self.addresses
+            try:
+                j = addrs.index(addr)
+            except ValueError:
+                return False
+            if len(addrs) <= 1:
+                raise ValueError(
+                    "refusing to remove the last worker from the rotation"
+                )
+            self.addresses = addrs[:j] + addrs[j + 1:]
+            # Preserve the rotation position over the survivors:
+            # removing a slot below the cursor shifts every survivor
+            # down one, so the cursor must follow or the next pick
+            # would skip one survivor and later double-visit another.
+            if j < self._rr:
+                self._rr -= 1
+            self._rr %= len(self.addresses)
+        with self._health_lock:
+            self._health.pop(addr, None)
+        with self._conn_lock:
+            conn = self._conns.get(addr)
+        if conn is not None:
+            deadline = time.monotonic() + max(float(drain_timeout_s), 0.0)
+            while time.monotonic() < deadline:
+                with conn._lock:
+                    if not conn._pending:
+                        break
+                time.sleep(0.001)
+            conn.close()
+        return True
 
     def _ship_frames(self, frames: List[EncodedFrame], what: str) -> None:
         """Delivers frames[i] to worker i with the pinned-retry /

@@ -31,18 +31,38 @@ the RPC worker substrate with retry/backoff/quarantine
     latency histograms and a prediction-divergence counter
     (`ydf_fleet_divergence_total`) for canary validation.
 
+**Elastic membership** (`add_replica` / `remove_replica`): a live
+replica joins by receiving every cached deploy frame over a private
+connection OUTSIDE the rotation, is verified at the deploy
+fingerprints, and only then enters round-robin atomically — a failed
+or chaos-killed join (`fleet.join` site) leaves the fleet untouched. A
+leave removes the replica from rotation FIRST, drains its in-flight
+predicts (bounded), then tears its banks down (`serve_drain` verb;
+`fleet.drain` site fires before any mutation). Membership-shaped
+operations (join, drain, deploy, swap, retire) serialize on one
+reentrant lock, so a leave raced against a swap resolves to a
+consistent fleet; the predict path never takes that lock. An optional
+per-replica in-flight cap (`YDF_TPU_FLEET_MAX_INFLIGHT_PER_REPLICA`)
+sheds over-cap traffic fast (`ydf_serve_shed_total{reason=
+"fleet_admission"}`) — the signal the autoscaler
+(`serving/autoscaler.py`) scales on.
+
 Telemetry: `ydf_fleet_predict_total{version,route}`,
 `ydf_fleet_predict_latency_ns{version}`, `ydf_fleet_failover_total`,
 `ydf_fleet_swap_total`, `ydf_fleet_swap_latency_ns`,
-`ydf_fleet_divergence_total`; swap rollouts and failovers record
-`fleet.swap` / `fleet.failover` spans into the merged trace, and the
-router registers a `fleet` /statusz section (docs/observability.md).
+`ydf_fleet_divergence_total`, `ydf_fleet_join_total`,
+`ydf_fleet_join_latency_ns`, `ydf_fleet_drain_total`,
+`ydf_fleet_drain_latency_ns`; swap rollouts, failovers, joins and
+drains record `fleet.swap` / `fleet.failover` / `fleet.join` /
+`fleet.drain` spans into the merged trace, and the router registers a
+`fleet` /statusz section (docs/observability.md).
 """
 
 from __future__ import annotations
 
 import hashlib
 import itertools
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -50,6 +70,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ydf_tpu.parallel.worker_service import WorkerPool, _encode_frame
+from ydf_tpu.serving.registry import ServeOverloadError, _note_shed
 from ydf_tpu.utils import failpoints, telemetry, telemetry_http
 from ydf_tpu.utils.telemetry import LatencyHistogram
 
@@ -73,6 +94,32 @@ class FleetSwapError(FleetError):
     replica back to the previous version before raising, so the old
     version keeps serving — the swap either completes everywhere or
     changes nothing."""
+
+
+def _resolve_max_inflight(value: Optional[int]) -> Optional[int]:
+    """Per-replica admission cap: explicit arg wins, then
+    YDF_TPU_FLEET_MAX_INFLIGHT_PER_REPLICA, else uncapped. Eagerly
+    validated — a junk env value fails router CONSTRUCTION, not the
+    first overloaded predict."""
+    raw: Any = value
+    if raw is None:
+        raw = os.environ.get("YDF_TPU_FLEET_MAX_INFLIGHT_PER_REPLICA")
+        if raw is None or raw == "":
+            return None
+    try:
+        cap = int(raw)
+    except (TypeError, ValueError):
+        raise ValueError(
+            "YDF_TPU_FLEET_MAX_INFLIGHT_PER_REPLICA / "
+            f"max_inflight_per_replica must be an integer >= 1, got "
+            f"{raw!r}"
+        ) from None
+    if cap < 1:
+        raise ValueError(
+            "YDF_TPU_FLEET_MAX_INFLIGHT_PER_REPLICA / "
+            f"max_inflight_per_replica must be >= 1, got {cap}"
+        )
+    return cap
 
 
 def _req_hash(seed: int, req_id: int) -> float:
@@ -100,6 +147,7 @@ class FleetRouter:
         retry_attempts: int = 8,
         seed: int = 0,
         register_statusz: bool = True,
+        max_inflight_per_replica: Optional[int] = None,
     ):
         self.pool = WorkerPool(
             addresses, timeout_s=timeout_s, secret=secret,
@@ -107,6 +155,31 @@ class FleetRouter:
         )
         self.seed = int(seed)
         self._lock = threading.Lock()
+        #: Serializes MEMBERSHIP-SHAPED operations — add_replica,
+        #: remove_replica, deploy, swap_to, retire_version — so a leave
+        #: raced against a swap resolves to a consistent fleet (each
+        #: sees the other's completed state, never its middle). The
+        #: predict path NEVER takes it: joins/drains must be invisible
+        #: to callers. Reentrant so a membership op may call another.
+        self._member_lock = threading.RLock()
+        #: Per-replica admission cap (None = uncapped): bounds the
+        #: requests concurrently in flight to each replica, so fleet
+        #: CAPACITY really is replicas x cap and the autoscaler's
+        #: grow-until-sheds-stop loop is deterministic. Over-cap
+        #: requests shed fast with reason "fleet_admission".
+        self.max_inflight_per_replica = _resolve_max_inflight(
+            max_inflight_per_replica
+        )
+        self._adm_lock = threading.Lock()
+        self._inflight: Dict[str, int] = {}
+        self._admission_sheds = 0
+        self._joins = 0
+        self._drains = 0
+        self._join_ns = LatencyHistogram()
+        self._drain_ns = LatencyHistogram()
+        #: version -> serialized deploy-frame bytes held by the frame
+        #: cache (the ledger view of _deploy_frames; retire drops it).
+        self._frame_bytes: Dict[str, int] = {}
         self.active_version: Optional[str] = None
         #: version -> forest fingerprint, for every deployed version.
         self._versions: Dict[str, str] = {}
@@ -160,6 +233,13 @@ class FleetRouter:
         of a fresh fleet defaults to active); later versions default
         to loading ALONGSIDE the active one, to be promoted by
         `swap_to` or routed explicitly by a shadow/canary split."""
+        # Membership-shaped: serialized against add/remove_replica and
+        # swaps so a join never races a half-shipped version.
+        with self._member_lock:
+            return self._deploy(model, version, activate)
+
+    def _deploy(self, model, version: str,
+                activate: Optional[bool]) -> Dict[str, Any]:
         from ydf_tpu.serving.flatten import forest_fingerprint
 
         with self._lock:
@@ -195,8 +275,12 @@ class FleetRouter:
         with self._lock:
             self._versions[version] = fingerprint
             self._deploy_frames[version] = frame
+            self._frame_bytes[version] = (
+                frame.header_bytes + frame.payload_bytes
+            )
             if activate or self.active_version is None:
                 self.active_version = version
+        self._account_frames()
         return {
             "version": version, "fingerprint": fingerprint,
             "replicas": len(results), "active": bool(activate),
@@ -226,23 +310,30 @@ class FleetRouter:
         resolves its version once, under the replica's state lock, and
         keeps its bank through the compute (drain waits for it)."""
         t0 = time.perf_counter_ns()
-        with self._lock:
-            old = self.active_version
-            expected = self._versions.get(version)
-        if expected is None:
-            raise FleetSwapError(
-                f"swap target {version!r} was never deployed"
-            )
-        if version == old:
-            return {"from": old, "to": version, "flipped": 0,
-                    "freed_bytes": 0, "retire_errors": [], "skipped": []}
-        with self._lock:
-            self._swapping = True
-        try:
-            return self._swap_rollout(version, old, expected, retire, t0)
-        finally:
+        # Membership-shaped: a replica leave raced against this swap
+        # serializes behind it (or completes before it) — either order
+        # leaves one consistent fleet, never a half-flipped rotation.
+        with self._member_lock:
             with self._lock:
-                self._swapping = False
+                old = self.active_version
+                expected = self._versions.get(version)
+            if expected is None:
+                raise FleetSwapError(
+                    f"swap target {version!r} was never deployed"
+                )
+            if version == old:
+                return {"from": old, "to": version, "flipped": 0,
+                        "freed_bytes": 0, "retire_errors": [],
+                        "skipped": []}
+            with self._lock:
+                self._swapping = True
+            try:
+                return self._swap_rollout(
+                    version, old, expected, retire, t0
+                )
+            finally:
+                with self._lock:
+                    self._swapping = False
 
     def _swap_rollout(self, version: str, old: Optional[str],
                       expected: str, retire: bool,
@@ -338,8 +429,13 @@ class FleetRouter:
                         )
                 with self._lock:
                     self._versions.pop(old, None)
+                    # Evict the retired version's cached deploy frame
+                    # too — a long-lived router through many rollouts
+                    # must not pin every historical model's bytes.
                     self._deploy_frames.pop(old, None)
+                    self._frame_bytes.pop(old, None)
                     self._split_drop_version(old)
+                self._account_frames()
         if telemetry.ENABLED:
             telemetry.counter("ydf_fleet_swap_total").inc()
             telemetry.histogram("ydf_fleet_swap_latency_ns").observe_ns(
@@ -350,6 +446,251 @@ class FleetRouter:
             "freed_bytes": freed, "retire_errors": retire_errors,
             "skipped": skipped,
         }
+
+    # ---- elastic membership ----------------------------------------- #
+
+    def _account_frames(self) -> None:
+        """Mirrors the deploy-frame cache into the memory ledger
+        (subsystem `fleet_deploy_frames`) so retired versions visibly
+        release their serialized bytes."""
+        if telemetry.ENABLED:
+            with self._lock:
+                total = sum(self._frame_bytes.values())
+            telemetry.mem_set("fleet_deploy_frames", total)
+
+    def retire_version(self, version: str) -> Dict[str, Any]:
+        """Retires a NON-ACTIVE deployed version outside a swap (the
+        `swap_to(retire=False)` cleanup path): drains and frees its
+        bank on every live replica (serve_unload semantics), then drops
+        the router's version entry AND its cached deploy frame — the
+        frame cache must not pin every historical model's serialized
+        bytes. Unload failures are reported, never raised (a lingering
+        bank on a dead replica is memory, not correctness; its state
+        reaper or next drain frees it). Idempotent: an unknown version
+        returns {"retired": False}."""
+        with self._member_lock:
+            with self._lock:
+                if version == self.active_version:
+                    raise FleetError(
+                        f"refusing to retire ACTIVE version "
+                        f"{version!r} (swap first)"
+                    )
+                known = version in self._versions
+            if not known:
+                return {"retired": False, "version": version,
+                        "freed_bytes": 0, "errors": []}
+            freed = 0
+            errors: List[str] = []
+            for i in range(len(self.pool.addresses)):
+                if self.pool.is_quarantined(i):
+                    continue
+                try:
+                    r = self._replica_request(
+                        i, {"verb": "serve_unload", "version": version},
+                        f"retire:{version}",
+                    )
+                    freed += int(r.get("freed_bytes", 0))
+                except Exception as e:
+                    errors.append(f"{self.pool.addr_str(i)}: {e}")
+            with self._lock:
+                self._versions.pop(version, None)
+                self._deploy_frames.pop(version, None)
+                self._frame_bytes.pop(version, None)
+                self._split_drop_version(version)
+            self._account_frames()
+            return {"retired": True, "version": version,
+                    "freed_bytes": freed, "errors": errors}
+
+    def add_replica(self, address: str) -> Dict[str, Any]:
+        """Admits a LIVE replica to a serving fleet: PROBE+SHIP every
+        deployed version's cached deploy frame (the auto-redeploy
+        mechanism, generalized from "heal" to "join") over a private
+        connection OUTSIDE the rotation, VERIFY each landed at its
+        deploy fingerprint and that the candidate serves the active
+        version, then ADMIT it to the round-robin rotation atomically.
+        Any failure before ADMIT — including the `fleet.join` chaos
+        site and a candidate killed mid-join — raises FleetError and
+        leaves the fleet EXACTLY as it was: the candidate never entered
+        rotation, so a joining replica is invisible to callers until
+        the instant it can answer bit-identically."""
+        t0 = time.perf_counter_ns()
+        with self._member_lock, telemetry.span("fleet.join") as sp:
+            if telemetry.ENABLED:
+                sp.set(replica=address)
+            addr = WorkerPool._parse_addr(address)
+            if addr in self.pool.addresses:
+                # Idempotent: already a member.
+                return {
+                    "replica": address, "joined": False,
+                    "versions": [], "active": self.active_version,
+                    "replicas": len(self.pool.addresses),
+                    "join_ns": 0,
+                }
+            with self._lock:
+                active = self.active_version
+                ship = sorted(
+                    (
+                        (v, self._deploy_frames[v], fp)
+                        for v, fp in self._versions.items()
+                        if v in self._deploy_frames
+                    ),
+                    # Non-active versions first, active LAST: the
+                    # candidate's pointer lands on the active version
+                    # without an extra window where it serves another.
+                    key=lambda t: (t[0] == active, t[0]),
+                )
+            probe = WorkerPool(
+                [address], timeout_s=self.pool.timeout_s,
+                secret=self.pool.secret, retry_attempts=1,
+            )
+            try:
+                failpoints.hit("fleet.join")
+                for v, frame, expected in ship:
+                    resp = probe.request_frame(0, frame)
+                    if not resp.get("ok") or (
+                        resp.get("fingerprint") not in (None, expected)
+                    ):
+                        raise FleetError(
+                            f"candidate {address} failed to load "
+                            f"{v!r} at fingerprint {expected!r}: "
+                            f"{resp.get('error') or resp.get('fingerprint')!r}"
+                            " — join aborted; it never entered the "
+                            "rotation"
+                        )
+                if active is not None:
+                    sw = probe.request(
+                        0, {"verb": "serve_swap", "version": active}
+                    )
+                    if not sw.get("ok"):
+                        raise FleetError(
+                            f"candidate {address} refused to activate "
+                            f"{active!r}: {sw.get('error')} — join "
+                            "aborted; it never entered the rotation"
+                        )
+                    st = probe.request(0, {"verb": "serve_status"})
+                    info = st.get("versions", {}).get(active, {})
+                    with self._lock:
+                        expected = self._versions.get(active)
+                    if (
+                        st.get("active_version") != active
+                        or info.get("fingerprint") != expected
+                    ):
+                        raise FleetError(
+                            f"candidate {address} verification failed "
+                            f"(active={st.get('active_version')!r}, "
+                            f"fingerprint={info.get('fingerprint')!r}, "
+                            f"want {active!r}@{expected!r}) — join "
+                            "aborted; it never entered the rotation"
+                        )
+            except failpoints.FailpointError as e:
+                raise FleetError(
+                    f"join of {address} aborted by injected fault "
+                    f"({e}); it never entered the rotation"
+                ) from e
+            except (OSError, ConnectionError) as e:
+                raise FleetError(
+                    f"candidate {address} unreachable mid-join "
+                    f"({type(e).__name__}: {e}); it never entered the "
+                    "rotation"
+                ) from e
+            finally:
+                probe.close()
+            idx = self.pool.add_worker(address)
+            self.pool.mark_ok(idx)
+            dur = time.perf_counter_ns() - t0
+            with self._lock:
+                self._joins += 1
+            self._join_ns.observe_ns(dur)
+            if telemetry.ENABLED:
+                telemetry.counter("ydf_fleet_join_total").inc()
+                telemetry.histogram(
+                    "ydf_fleet_join_latency_ns"
+                ).observe_ns(dur)
+            return {
+                "replica": address, "joined": True,
+                "versions": [v for v, _, _ in ship], "active": active,
+                "replicas": len(self.pool.addresses), "join_ns": dur,
+            }
+
+    def remove_replica(self, address: str,
+                       drain_timeout_s: float = 10.0) -> Dict[str, Any]:
+        """Drains `address` out of the fleet: REMOVE it from the
+        round-robin rotation first (atomic — no new request can land on
+        it), DRAIN its pooled connection's in-flight predicts (bounded
+        by `drain_timeout_s`), then TEAR DOWN its banks with the
+        serve_drain verb (serve_unload semantics over every held
+        version, active included) over a private connection. Teardown
+        failures never fail the removal — the replica is already out of
+        rotation, and an unreachable departing replica frees its
+        memory when its process dies. The `fleet.drain` chaos site
+        fires BEFORE any mutation: an injected fault leaves the fleet
+        exactly as it was, the replica still serving. Refuses to empty
+        the rotation."""
+        t0 = time.perf_counter_ns()
+        with self._member_lock, telemetry.span("fleet.drain") as sp:
+            if telemetry.ENABLED:
+                sp.set(replica=address)
+            try:
+                failpoints.hit("fleet.drain")
+            except failpoints.FailpointError as e:
+                raise FleetError(
+                    f"drain of {address} aborted by injected fault "
+                    f"({e}); it stays in the rotation"
+                ) from e
+            removed = self.pool.remove_worker(
+                address, drain_timeout_s=drain_timeout_s
+            )
+            if not removed:
+                return {"replica": address, "removed": False,
+                        "freed_bytes": 0, "reachable": False,
+                        "replicas": len(self.pool.addresses),
+                        "drain_ns": 0}
+            freed = 0
+            reachable = True
+            probe = WorkerPool(
+                [address], timeout_s=self.pool.timeout_s,
+                secret=self.pool.secret, retry_attempts=1,
+            )
+            try:
+                resp = probe.request(0, {"verb": "serve_drain"})
+                freed = int(resp.get("freed_bytes", 0))
+            except (OSError, ConnectionError):
+                reachable = False
+            finally:
+                probe.close()
+            with self._adm_lock:
+                self._inflight.pop(address, None)
+            dur = time.perf_counter_ns() - t0
+            with self._lock:
+                self._drains += 1
+            self._drain_ns.observe_ns(dur)
+            if telemetry.ENABLED:
+                telemetry.counter("ydf_fleet_drain_total").inc()
+                telemetry.histogram(
+                    "ydf_fleet_drain_latency_ns"
+                ).observe_ns(dur)
+            return {
+                "replica": address, "removed": True,
+                "freed_bytes": freed, "reachable": reachable,
+                "replicas": len(self.pool.addresses), "drain_ns": dur,
+            }
+
+    def _admit(self, addr: str) -> bool:
+        cap = self.max_inflight_per_replica
+        with self._adm_lock:
+            cur = self._inflight.get(addr, 0)
+            if cap is not None and cur >= cap:
+                return False
+            self._inflight[addr] = cur + 1
+            return True
+
+    def _release(self, addr: str) -> None:
+        with self._adm_lock:
+            cur = self._inflight.get(addr, 1)
+            if cur <= 1:
+                self._inflight.pop(addr, None)
+            else:
+                self._inflight[addr] = cur - 1
 
     # ---- shadow / canary -------------------------------------------- #
 
@@ -500,11 +841,44 @@ class FleetRouter:
                     "all replicas quarantined"
                 )
                 continue
+            admitted: Optional[str] = None
+            if self.max_inflight_per_replica is not None:
+                # Admission: scan the live rotation ONCE for a replica
+                # under its in-flight cap (every pick still comes from
+                # next_worker, so spreading is preserved). No admitting
+                # replica -> shed FAST with a typed overload error
+                # (reason "fleet_admission") instead of queueing — the
+                # autoscaler reads exactly this signal to grow.
+                for _ in range(len(self.pool.addresses)):
+                    cand = self.pool.addr_str(idx)
+                    if self._admit(cand):
+                        admitted = cand
+                        break
+                    nxt = self.pool.next_worker()
+                    if nxt is None:
+                        break
+                    idx = nxt
+                if admitted is None:
+                    with self._lock:
+                        self._admission_sheds += 1
+                    _note_shed("fleet_admission")
+                    raise ServeOverloadError(
+                        "fleet admission: every live replica is at its "
+                        "max in-flight cap "
+                        f"({self.max_inflight_per_replica})",
+                        reason="fleet_admission",
+                    )
             try:
-                failpoints.hit("fleet.replica_predict")
-                t_rpc0 = time.perf_counter_ns()
-                resp = self.pool.request_frame(idx, frame)
-                self._rtt.observe_ns(time.perf_counter_ns() - t_rpc0)
+                try:
+                    failpoints.hit("fleet.replica_predict")
+                    t_rpc0 = time.perf_counter_ns()
+                    resp = self.pool.request_frame(idx, frame)
+                    self._rtt.observe_ns(
+                        time.perf_counter_ns() - t_rpc0
+                    )
+                finally:
+                    if admitted is not None:
+                        self._release(admitted)
             except (OSError, ConnectionError) as e:
                 self.pool.mark_failed(idx)
                 self._note_failover(idx, e)
@@ -748,6 +1122,14 @@ class FleetRouter:
                 "failovers": self._failovers,
                 "swaps": self._swaps,
                 "redeploys": self._redeploys,
+                "joins": self._joins,
+                "drains": self._drains,
+                "join_p50_ns": self._join_ns.percentile_ns(50),
+                "drain_p50_ns": self._drain_ns.percentile_ns(50),
+                "admission_sheds": self._admission_sheds,
+                "max_inflight_per_replica":
+                    self.max_inflight_per_replica,
+                "deploy_frame_bytes": sum(self._frame_bytes.values()),
                 "shadow_compared": self._shadow_compared,
                 "divergence": self._divergence,
                 "latency_ns": lat,

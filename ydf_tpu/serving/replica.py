@@ -40,6 +40,14 @@ state and the verb handlers:
                     swap count — the per-replica `/statusz`
                     model-version section and the router's pre-swap
                     verification read.
+  serve_drain       full teardown for a replica LEAVING the fleet
+                    (FleetRouter.remove_replica): every held bank —
+                    including the active one — is unreachable-ed in one
+                    lock hold, drained of in-flight predicts (bounded),
+                    then freed; the replica's serving state is reset so
+                    a later re-join starts clean. The router removes
+                    the replica from rotation BEFORE sending this verb,
+                    so no new request can race the teardown.
 
 State is keyed by WORKER INSTANCE id exactly like
 `parallel/dist_worker._STATE`: several in-process replicas (tests,
@@ -59,7 +67,7 @@ import numpy as np
 VERBS = frozenset(
     {
         "serve_load_bank", "serve_predict", "serve_swap",
-        "serve_unload", "serve_status",
+        "serve_unload", "serve_status", "serve_drain",
     }
 )
 
@@ -413,6 +421,55 @@ def _handle_unload(req: Dict[str, Any], st: _ReplicaState,
             "was_loaded": True}
 
 
+def _handle_drain(req: Dict[str, Any], st: _ReplicaState,
+                  worker_id: str) -> Dict[str, Any]:
+    """Full teardown for remove_replica: unlike serve_unload this frees
+    EVERY version, active included — the replica is leaving the fleet,
+    not retiring one model. All banks become unreachable in one lock
+    hold (so inflight on each only decreases), then each is drained
+    within a shared bounded deadline and freed. A version whose
+    in-flight predicts outlive the deadline is reported in `timed_out`
+    and its native bank is deliberately NOT closed: a predict thread
+    may still be inside the native walk, and leaking the bank beats a
+    use-after-free. In practice the router drained the pooled
+    connection before sending this verb, so inflight is already 0."""
+    with st.lock:
+        banks = dict(st.banks)
+        st.banks.clear()
+        st.active = None
+    deadline = time.perf_counter() + _DRAIN_TIMEOUT_S
+    freed = 0
+    timed_out = []
+    for version in sorted(banks):
+        lb = banks[version]
+        drained = True
+        while True:
+            with st.lock:
+                inflight = lb.inflight
+            if inflight == 0:
+                break
+            if time.perf_counter() > deadline:
+                timed_out.append(version)
+                drained = False
+                break
+            time.sleep(0.001)
+        if not drained:
+            continue
+        freed += lb.nbytes
+        if lb.bank is not None:
+            try:
+                lb.bank.close()  # releases the serve_bank ledger bytes
+            except Exception:
+                pass
+        lb.fn = None  # type: ignore[assignment]
+    reset_worker(worker_id)
+    return {
+        "ok": True, "freed_bytes": freed,
+        "versions_drained": sorted(banks), "timed_out": timed_out,
+        "replica": worker_id,
+    }
+
+
 def handle(verb: str, req: Dict[str, Any],
            worker_id: str = "local") -> Dict[str, Any]:
     """Dispatch for the fleet verbs (called by worker_service). Task
@@ -427,6 +484,8 @@ def handle(verb: str, req: Dict[str, Any],
         return _handle_swap(req, st, worker_id)
     if verb == "serve_unload":
         return _handle_unload(req, st, worker_id)
+    if verb == "serve_drain":
+        return _handle_drain(req, st, worker_id)
     if verb == "serve_status":
         out = status(worker_id)
         out.update(ok=True, replica=worker_id)

@@ -165,6 +165,25 @@ KNOWN_SITES = frozenset(
         # rollback path (old version keeps serving everywhere).
         "fleet.replica_predict",
         "fleet.swap",
+        # serving/fleet.py — elastic membership. fleet.join fires at
+        # the start of add_replica's admission sequence, BEFORE any
+        # cached deploy frame ships to the candidate: an injected fault
+        # aborts the join and the candidate NEVER enters the rotation
+        # (the serving fleet is untouched — the chaos suite proves a
+        # replica killed mid-join is invisible to callers). fleet.drain
+        # fires at the start of remove_replica, BEFORE any rotation
+        # mutation: an injected fault leaves the fleet exactly as it
+        # was, the departing replica still serving.
+        "fleet.join",
+        "fleet.drain",
+        # parallel/dist_gbt.py — tree-boundary membership join of a
+        # running distributed train (_apply_membership). An injected
+        # fault quarantines the joiner (it never receives shards and
+        # never enters the owner map), re-queues the join for a later
+        # boundary (bounded retries), and the train continues on the
+        # surviving set — chaos asserts the final model is
+        # bit-identical to the fixed-membership run.
+        "dist.member_join",
         # ops/pool_stats.py — adversarial-steal schedule for the native
         # work-stealing pool. The cooperative `stall` action makes
         # pool_stats.block_stall() arm a per-block busy-delay inside the
